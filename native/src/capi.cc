@@ -1,0 +1,270 @@
+// C API consumed by torchft_tpu/_native.py via ctypes. Strings cross the
+// boundary as malloc'd char* (caller frees with tft_string_free); structured
+// values as JSON. Status codes: 0 ok, 1 timeout (Python raises TimeoutError,
+// mirroring the reference's gRPC-status mapping in src/lib.rs:321-333),
+// 2 other error (Python raises RuntimeError with tft_last_error()).
+#include <cstring>
+#include <string>
+
+#include "json.h"
+#include "lighthouse.h"
+#include "manager.h"
+#include "net.h"
+#include "quorum.h"
+#include "store.h"
+#include "wire.h"
+
+using namespace tft;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+constexpr int kOk = 0;
+constexpr int kTimeout = 1;
+constexpr int kError = 2;
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return out;
+}
+
+char* dup_bytes(const std::string& s, size_t* len_out) {
+  char* out = static_cast<char*>(malloc(s.size() ? s.size() : 1));
+  memcpy(out, s.data(), s.size());
+  *len_out = s.size();
+  return out;
+}
+
+bool is_timeout(const torchft_tpu::ErrorResponse::Code code) {
+  return code == torchft_tpu::ErrorResponse::DEADLINE_EXCEEDED ||
+         code == torchft_tpu::ErrorResponse::CANCELLED;
+}
+
+// Runs fn, translating exceptions to status codes.
+template <typename Fn>
+int guarded(Fn&& fn) {
+  try {
+    fn();
+    return kOk;
+  } catch (const TimeoutError& e) {
+    g_last_error = e.what();
+    return kTimeout;
+  } catch (const RpcError& e) {
+    g_last_error = e.what();
+    return is_timeout(e.code) ? kTimeout : kError;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return kError;
+  } catch (...) {
+    g_last_error = "unknown error";
+    return kError;
+  }
+}
+
+} // namespace
+
+extern "C" {
+
+const char* tft_last_error() { return g_last_error.c_str(); }
+
+void tft_string_free(char* s) { free(s); }
+
+// ---- Lighthouse ----
+
+void* tft_lighthouse_create(const char* bind, uint64_t min_replicas,
+                            int64_t join_timeout_ms, int64_t quorum_tick_ms,
+                            int64_t heartbeat_timeout_ms) {
+  Lighthouse* lh = nullptr;
+  int rc = guarded([&] {
+    LighthouseOpt opt;
+    opt.min_replicas = min_replicas;
+    opt.join_timeout_ms = join_timeout_ms;
+    opt.quorum_tick_ms = quorum_tick_ms;
+    opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    lh = new Lighthouse(bind, opt);
+  });
+  return rc == kOk ? lh : nullptr;
+}
+
+char* tft_lighthouse_address(void* handle) {
+  return dup_string(static_cast<Lighthouse*>(handle)->address());
+}
+
+void tft_lighthouse_shutdown(void* handle) {
+  static_cast<Lighthouse*>(handle)->shutdown();
+}
+
+void tft_lighthouse_destroy(void* handle) {
+  delete static_cast<Lighthouse*>(handle);
+}
+
+int tft_lighthouse_heartbeat(const char* addr, const char* replica_id,
+                             int64_t timeout_ms) {
+  return guarded([&] {
+    LighthouseClient client(addr, timeout_ms);
+    client.heartbeat(replica_id, timeout_ms);
+  });
+}
+
+// ---- ManagerServer ----
+
+void* tft_manager_create(const char* replica_id, const char* lighthouse_addr,
+                         const char* hostname, const char* bind,
+                         const char* store_addr, uint64_t world_size,
+                         int64_t heartbeat_interval_ms, int64_t connect_timeout_ms) {
+  ManagerServer* m = nullptr;
+  int rc = guarded([&] {
+    m = new ManagerServer(replica_id, lighthouse_addr, hostname, bind, store_addr,
+                          world_size, heartbeat_interval_ms, connect_timeout_ms);
+  });
+  return rc == kOk ? m : nullptr;
+}
+
+char* tft_manager_address(void* handle) {
+  return dup_string(static_cast<ManagerServer*>(handle)->address());
+}
+
+void tft_manager_shutdown(void* handle) {
+  static_cast<ManagerServer*>(handle)->shutdown();
+}
+
+void tft_manager_destroy(void* handle) {
+  delete static_cast<ManagerServer*>(handle);
+}
+
+// ---- ManagerClient ----
+
+void* tft_client_create(const char* addr, int64_t connect_timeout_ms) {
+  return new ManagerClient(addr, connect_timeout_ms);
+}
+
+void tft_client_destroy(void* handle) {
+  delete static_cast<ManagerClient*>(handle);
+}
+
+int tft_client_quorum(void* handle, int64_t rank, int64_t step,
+                      const char* checkpoint_metadata, int shrink_only,
+                      int64_t timeout_ms, char** result_json) {
+  return guarded([&] {
+    auto resp = static_cast<ManagerClient*>(handle)->quorum(
+        rank, step, checkpoint_metadata, shrink_only != 0, timeout_ms);
+    *result_json = dup_string(quorum_response_to_json(resp).dump());
+  });
+}
+
+int tft_client_checkpoint_metadata(void* handle, int64_t rank, int64_t timeout_ms,
+                                   char** metadata_out) {
+  return guarded([&] {
+    *metadata_out = dup_string(
+        static_cast<ManagerClient*>(handle)->checkpoint_metadata(rank, timeout_ms));
+  });
+}
+
+int tft_client_should_commit(void* handle, int64_t rank, int64_t step,
+                             int should_commit, int64_t timeout_ms, int* result) {
+  return guarded([&] {
+    *result = static_cast<ManagerClient*>(handle)->should_commit(
+                  rank, step, should_commit != 0, timeout_ms)
+                  ? 1
+                  : 0;
+  });
+}
+
+int tft_client_kill(void* handle, const char* msg) {
+  return guarded([&] { static_cast<ManagerClient*>(handle)->kill(msg); });
+}
+
+// ---- Store ----
+
+void* tft_store_create(const char* bind) {
+  StoreServer* s = nullptr;
+  int rc = guarded([&] { s = new StoreServer(bind); });
+  return rc == kOk ? s : nullptr;
+}
+
+char* tft_store_address(void* handle) {
+  return dup_string(static_cast<StoreServer*>(handle)->address());
+}
+
+int tft_store_port(void* handle) {
+  return static_cast<StoreServer*>(handle)->port();
+}
+
+void tft_store_shutdown(void* handle) {
+  static_cast<StoreServer*>(handle)->shutdown();
+}
+
+void tft_store_destroy(void* handle) {
+  delete static_cast<StoreServer*>(handle);
+}
+
+void* tft_store_client_create(const char* addr, int64_t connect_timeout_ms) {
+  StoreClient* c = nullptr;
+  int rc = guarded([&] { c = new StoreClient(addr, connect_timeout_ms); });
+  return rc == kOk ? c : nullptr;
+}
+
+void tft_store_client_destroy(void* handle) {
+  delete static_cast<StoreClient*>(handle);
+}
+
+int tft_store_client_set(void* handle, const char* key, const char* value,
+                         size_t value_len, int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<StoreClient*>(handle)->set(key, std::string(value, value_len),
+                                           timeout_ms);
+  });
+}
+
+int tft_store_client_get(void* handle, const char* key, int64_t timeout_ms,
+                         char** value_out, size_t* value_len_out) {
+  return guarded([&] {
+    std::string v = static_cast<StoreClient*>(handle)->get(key, timeout_ms);
+    *value_out = dup_bytes(v, value_len_out);
+  });
+}
+
+int tft_store_client_add(void* handle, const char* key, int64_t delta,
+                         int64_t timeout_ms, int64_t* value_out) {
+  return guarded([&] {
+    *value_out = static_cast<StoreClient*>(handle)->add(key, delta, timeout_ms);
+  });
+}
+
+// ---- pure functions (test entry points) ----
+
+// state_json: {participants: {id: {joined_ms, member: {...}}}, heartbeats:
+// {id: ms}, prev_quorum: {...}|null, quorum_id: int}; opt_json: LighthouseOpt
+// fields. Returns {"quorum": [members]|null, "reason": str}.
+int tft_quorum_compute(int64_t now, const char* state_json, const char* opt_json,
+                       char** result_json) {
+  return guarded([&] {
+    LighthouseState state = lighthouse_state_from_json(Json::parse(state_json));
+    LighthouseOpt opt = lighthouse_opt_from_json(Json::parse(opt_json));
+    auto [quorum, reason] = quorum_compute(now, state, opt);
+    JsonObject out;
+    if (quorum.has_value()) {
+      JsonArray arr;
+      for (const auto& m : *quorum) arr.push_back(member_to_json(m));
+      out["quorum"] = Json(std::move(arr));
+    } else {
+      out["quorum"] = Json();
+    }
+    out["reason"] = reason;
+    *result_json = dup_string(Json(std::move(out)).dump());
+  });
+}
+
+int tft_compute_quorum_results(const char* replica_id, int64_t rank,
+                               const char* quorum_json, char** result_json) {
+  return guarded([&] {
+    torchft_tpu::Quorum quorum = quorum_from_json(Json::parse(quorum_json));
+    auto resp = compute_quorum_results(replica_id, rank, quorum);
+    *result_json = dup_string(quorum_response_to_json(resp).dump());
+  });
+}
+
+} // extern "C"

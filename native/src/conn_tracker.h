@@ -1,0 +1,60 @@
+// Per-connection thread bookkeeping shared by the three servers. Handler
+// threads are detached and self-reap (remove their fd and wake shutdown), so
+// long-lived servers don't accumulate zombie threads or stale fd numbers.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <sys/socket.h>
+#include <thread>
+
+#include "net.h"
+
+namespace tft {
+
+class ConnTracker {
+ public:
+  // Spawns a detached handler thread for sock. Returns false (dropping the
+  // connection) if shutdown already started.
+  template <typename Fn>
+  bool spawn(Socket sock, Fn fn) {
+    uint64_t id;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (shutting_down_) return false;
+      id = next_id_++;
+      fds_[id] = sock.fd();
+      active_++;
+    }
+    std::thread([this, id, s = std::move(sock), fn = std::move(fn)]() mutable {
+      fn(s);
+      std::lock_guard<std::mutex> lock(mu_);
+      fds_.erase(id);
+      active_--;
+      cv_.notify_all();
+    }).detach();
+    return true;
+  }
+
+  // Wakes all handlers blocked in socket IO and waits until every handler
+  // thread has finished. Callers must first unblock handlers waiting on
+  // their own condition variables.
+  void shutdown_all() {
+    std::unique_lock<std::mutex> lock(mu_);
+    shutting_down_ = true;
+    for (const auto& [id, fd] : fds_) ::shutdown(fd, SHUT_RDWR);
+    cv_.wait(lock, [&] { return active_ == 0; });
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<uint64_t, int> fds_;
+  uint64_t next_id_ = 0;
+  size_t active_ = 0;
+  bool shutting_down_ = false;
+};
+
+} // namespace tft

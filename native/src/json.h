@@ -1,0 +1,381 @@
+// Minimal JSON value type used only at the C-API boundary (structured results
+// and pure-function test entry points). The wire protocol is protobuf
+// (native/torchft.proto); JSON keeps the Python binding dependency-free.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tft {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Int), int_(v) {}
+  Json(int64_t v) : type_(Type::Int), int_(v) {}
+  Json(uint64_t v) : type_(Type::Int), int_(static_cast<int64_t>(v)) {}
+  Json(double v) : type_(Type::Double), double_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+
+  bool as_bool() const {
+    check(Type::Bool);
+    return bool_;
+  }
+  int64_t as_int() const {
+    if (type_ == Type::Double) return static_cast<int64_t>(double_);
+    check(Type::Int);
+    return int_;
+  }
+  double as_double() const {
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    check(Type::Double);
+    return double_;
+  }
+  const std::string& as_string() const {
+    check(Type::String);
+    return str_;
+  }
+  const JsonArray& as_array() const {
+    check(Type::Array);
+    return arr_;
+  }
+  JsonArray& as_array() {
+    check(Type::Array);
+    return arr_;
+  }
+  const JsonObject& as_object() const {
+    check(Type::Object);
+    return obj_;
+  }
+  JsonObject& as_object() {
+    check(Type::Object);
+    return obj_;
+  }
+
+  bool contains(const std::string& key) const {
+    check(Type::Object);
+    return obj_.count(key) > 0;
+  }
+  // Missing keys read as null, so optional fields need no special casing.
+  const Json& at(const std::string& key) const {
+    check(Type::Object);
+    auto it = obj_.find(key);
+    if (it == obj_.end()) {
+      static const Json kNull;
+      return kNull;
+    }
+    return it->second;
+  }
+  int64_t get_int(const std::string& key, int64_t dflt) const {
+    const Json& v = at(key);
+    return v.is_null() ? dflt : v.as_int();
+  }
+  std::string get_string(const std::string& key, const std::string& dflt) const {
+    const Json& v = at(key);
+    return v.is_null() ? dflt : v.as_string();
+  }
+  bool get_bool(const std::string& key, bool dflt) const {
+    const Json& v = at(key);
+    return v.is_null() ? dflt : v.as_bool();
+  }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("json: trailing data");
+    return v;
+  }
+
+ private:
+  void check(Type t) const {
+    if (type_ != t) throw std::runtime_error("json: wrong type access");
+  }
+
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null:
+        os << "null";
+        break;
+      case Type::Bool:
+        os << (bool_ ? "true" : "false");
+        break;
+      case Type::Int:
+        os << int_;
+        break;
+      case Type::Double:
+        if (std::isfinite(double_)) {
+          os << double_;
+        } else {
+          os << "null";
+        }
+        break;
+      case Type::String:
+        write_string(os, str_);
+        break;
+      case Type::Array: {
+        os << '[';
+        bool first = true;
+        for (const auto& v : arr_) {
+          if (!first) os << ',';
+          first = false;
+          v.write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, k);
+          os << ':';
+          v.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"':
+          os << "\\\"";
+          break;
+        case '\\':
+          os << "\\\\";
+          break;
+        case '\n':
+          os << "\\n";
+          break;
+        case '\r':
+          os << "\\r";
+          break;
+        case '\t':
+          os << "\\t";
+          break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& pos) {
+    while (pos < t.size() &&
+           (t[pos] == ' ' || t[pos] == '\t' || t[pos] == '\n' || t[pos] == '\r'))
+      pos++;
+  }
+
+  static Json parse_value(const std::string& t, size_t& pos) {
+    skip_ws(t, pos);
+    if (pos >= t.size()) throw std::runtime_error("json: unexpected end");
+    char c = t[pos];
+    if (c == '{') return parse_object(t, pos);
+    if (c == '[') return parse_array(t, pos);
+    if (c == '"') return Json(parse_string(t, pos));
+    if (c == 't') {
+      expect(t, pos, "true");
+      return Json(true);
+    }
+    if (c == 'f') {
+      expect(t, pos, "false");
+      return Json(false);
+    }
+    if (c == 'n') {
+      expect(t, pos, "null");
+      return Json();
+    }
+    return parse_number(t, pos);
+  }
+
+  static void expect(const std::string& t, size_t& pos, const char* lit) {
+    size_t n = strlen(lit);
+    if (t.compare(pos, n, lit) != 0) throw std::runtime_error("json: bad literal");
+    pos += n;
+  }
+
+  static Json parse_number(const std::string& t, size_t& pos) {
+    size_t start = pos;
+    bool is_double = false;
+    if (pos < t.size() && (t[pos] == '-' || t[pos] == '+')) pos++;
+    while (pos < t.size() &&
+           (isdigit(t[pos]) || t[pos] == '.' || t[pos] == 'e' || t[pos] == 'E' ||
+            t[pos] == '-' || t[pos] == '+')) {
+      if (t[pos] == '.' || t[pos] == 'e' || t[pos] == 'E') is_double = true;
+      pos++;
+    }
+    std::string num = t.substr(start, pos - start);
+    if (num.empty()) throw std::runtime_error("json: bad number");
+    if (is_double) return Json(std::stod(num));
+    return Json(static_cast<int64_t>(std::stoll(num)));
+  }
+
+  static std::string parse_string(const std::string& t, size_t& pos) {
+    if (t[pos] != '"') throw std::runtime_error("json: expected string");
+    pos++;
+    std::string out;
+    while (pos < t.size() && t[pos] != '"') {
+      char c = t[pos];
+      if (c == '\\') {
+        pos++;
+        if (pos >= t.size()) throw std::runtime_error("json: bad escape");
+        char e = t[pos];
+        switch (e) {
+          case '"':
+            out += '"';
+            break;
+          case '\\':
+            out += '\\';
+            break;
+          case '/':
+            out += '/';
+            break;
+          case 'n':
+            out += '\n';
+            break;
+          case 'r':
+            out += '\r';
+            break;
+          case 't':
+            out += '\t';
+            break;
+          case 'b':
+            out += '\b';
+            break;
+          case 'f':
+            out += '\f';
+            break;
+          case 'u': {
+            if (pos + 4 >= t.size()) throw std::runtime_error("json: bad \\u");
+            unsigned int cp = std::stoul(t.substr(pos + 1, 4), nullptr, 16);
+            pos += 4;
+            // Encode BMP code point as UTF-8 (surrogate pairs unsupported;
+            // control-plane strings are ASCII identifiers/addresses).
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default:
+            throw std::runtime_error("json: bad escape char");
+        }
+        pos++;
+      } else {
+        out += c;
+        pos++;
+      }
+    }
+    if (pos >= t.size()) throw std::runtime_error("json: unterminated string");
+    pos++; // closing quote
+    return out;
+  }
+
+  static Json parse_array(const std::string& t, size_t& pos) {
+    pos++; // '['
+    JsonArray arr;
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == ']') {
+      pos++;
+      return Json(std::move(arr));
+    }
+    while (true) {
+      arr.push_back(parse_value(t, pos));
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("json: unterminated array");
+      if (t[pos] == ',') {
+        pos++;
+        continue;
+      }
+      if (t[pos] == ']') {
+        pos++;
+        return Json(std::move(arr));
+      }
+      throw std::runtime_error("json: bad array");
+    }
+  }
+
+  static Json parse_object(const std::string& t, size_t& pos) {
+    pos++; // '{'
+    JsonObject obj;
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == '}') {
+      pos++;
+      return Json(std::move(obj));
+    }
+    while (true) {
+      skip_ws(t, pos);
+      std::string key = parse_string(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size() || t[pos] != ':') throw std::runtime_error("json: bad object");
+      pos++;
+      obj[key] = parse_value(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("json: unterminated object");
+      if (t[pos] == ',') {
+        pos++;
+        continue;
+      }
+      if (t[pos] == '}') {
+        pos++;
+        return Json(std::move(obj));
+      }
+      throw std::runtime_error("json: bad object");
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+} // namespace tft

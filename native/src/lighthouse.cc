@@ -1,0 +1,372 @@
+#include "lighthouse.h"
+
+#include <sys/socket.h>
+
+#include <sstream>
+
+#include "log.h"
+#include "manager.h"
+#include "wire.h"
+
+namespace tft {
+
+using torchft_tpu::ErrorResponse;
+using torchft_tpu::Quorum;
+using torchft_tpu::QuorumMember;
+
+Lighthouse::Lighthouse(const std::string& bind_addr, const LighthouseOpt& opt)
+    : opt_(opt),
+      listener_(std::make_unique<Listener>(bind_addr)),
+      hostname_(local_hostname()) {
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  tick_thread_ = std::thread([this] { tick_loop(); });
+  LOG_INFO("Lighthouse listening on: " << address());
+}
+
+Lighthouse::~Lighthouse() { shutdown(); }
+
+std::string Lighthouse::address() const {
+  return "http://" + hostname_ + ":" + std::to_string(listener_->port());
+}
+
+uint16_t Lighthouse::port() const { return listener_->port(); }
+
+void Lighthouse::shutdown() {
+  {
+    // Flag + notify under the cv's mutex so waiters can't miss the wakeup.
+    std::lock_guard<std::mutex> lock(mu_);
+    if (shutting_down_.exchange(true)) return;
+    quorum_cv_.notify_all();
+  }
+  listener_->close();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  conns_.shutdown_all();
+}
+
+void Lighthouse::accept_loop() {
+  while (!shutting_down_) {
+    Socket sock = listener_->accept();
+    if (!sock.valid()) return;
+    conns_.spawn(std::move(sock), [this](Socket& s) { handle_conn(s); });
+  }
+}
+
+void Lighthouse::tick_loop() {
+  while (!shutting_down_) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      quorum_tick_locked();
+    }
+    struct timespec ts;
+    ts.tv_sec = opt_.quorum_tick_ms / 1000;
+    ts.tv_nsec = (opt_.quorum_tick_ms % 1000) * 1000000;
+    nanosleep(&ts, nullptr);
+  }
+}
+
+void Lighthouse::quorum_tick_locked() {
+  auto [quorum_met, reason] = quorum_compute(now_ms(), state_, opt_);
+  LOG_DEBUG("Next quorum status: " << reason);
+
+  if (!quorum_met.has_value()) return;
+  std::vector<QuorumMember>& participants = *quorum_met;
+
+  bool changed = !state_.prev_quorum.has_value();
+  if (!changed) {
+    std::vector<QuorumMember> prev(state_.prev_quorum->participants().begin(),
+                                   state_.prev_quorum->participants().end());
+    changed = quorum_changed(participants, prev);
+  }
+  if (changed) {
+    state_.quorum_id += 1;
+    LOG_INFO("Detected quorum change, bumping quorum_id to " << state_.quorum_id);
+  }
+
+  Quorum quorum;
+  quorum.set_quorum_id(state_.quorum_id);
+  for (auto& p : participants) *quorum.add_participants() = std::move(p);
+  quorum.set_created_ms(unix_ms());
+
+  LOG_INFO("Quorum! id=" << quorum.quorum_id()
+                         << " participants=" << quorum.participants_size());
+
+  state_.prev_quorum = quorum;
+  state_.participants.clear();
+  latest_quorum_ = std::move(quorum);
+  quorum_gen_ += 1;
+  quorum_cv_.notify_all();
+}
+
+void Lighthouse::handle_conn(Socket& sock) {
+  try {
+    // Sniff: HTTP dashboards start with an ASCII method; protocol frames start
+    // with a u32 length whose first byte is 0 for any sane payload size.
+    char head[4] = {0};
+    size_t n = sock.peek(head, sizeof(head));
+    if (n >= 3 && (memcmp(head, "GET", 3) == 0 || memcmp(head, "POS", 3) == 0)) {
+      std::string req_head;
+      char buf[1024];
+      // Read until end of headers.
+      while (req_head.find("\r\n\r\n") == std::string::npos) {
+        size_t got = sock.peek(buf, sizeof(buf));
+        sock.recv_all(buf, got);
+        req_head.append(buf, got);
+        if (req_head.size() > 64 * 1024) break;
+      }
+      handle_http(sock, req_head);
+      return;
+    }
+
+    while (true) {
+      auto [type, payload] = recv_frame(sock);
+      switch (type) {
+        case MsgType::kLighthouseQuorumReq:
+          handle_quorum_req(sock, payload);
+          break;
+        case MsgType::kLighthouseHeartbeatReq: {
+          torchft_tpu::LighthouseHeartbeatRequest req;
+          req.ParseFromString(payload);
+          {
+            std::lock_guard<std::mutex> lock(mu_);
+            state_.heartbeats[req.replica_id()] = now_ms();
+          }
+          send_msg(sock, MsgType::kLighthouseHeartbeatResp,
+                   torchft_tpu::LighthouseHeartbeatResponse());
+          break;
+        }
+        default:
+          send_error(sock, ErrorResponse::INVALID_ARGUMENT,
+                     "unexpected message type");
+          return;
+      }
+    }
+  } catch (const std::exception&) {
+    // peer went away
+  }
+}
+
+void Lighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
+  torchft_tpu::LighthouseQuorumRequest req;
+  if (!req.ParseFromString(payload) || !req.has_requester()) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "missing requester");
+    return;
+  }
+  const QuorumMember& requester = req.requester();
+  LOG_INFO("got quorum request for replica " << requester.replica_id());
+
+  int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
+
+  std::unique_lock<std::mutex> lock(mu_);
+  // Joining the quorum is an implicit heartbeat.
+  state_.heartbeats[requester.replica_id()] = now_ms();
+  state_.participants[requester.replica_id()] =
+      ParticipantDetails{now_ms(), requester};
+  int64_t gen = quorum_gen_;
+  // Proactive tick so a now-complete quorum resolves without waiting a tick.
+  quorum_tick_locked();
+
+  while (true) {
+    // Wait for a quorum newer than our subscription point.
+    while (quorum_gen_ == gen && !shutting_down_) {
+      if (deadline < 0) {
+        quorum_cv_.wait(lock);
+      } else {
+        int64_t remain = deadline - now_ms();
+        if (remain <= 0) {
+          lock.unlock();
+          send_error(sock, ErrorResponse::DEADLINE_EXCEEDED,
+                     "lighthouse quorum timed out");
+          return;
+        }
+        quorum_cv_.wait_for(lock, std::chrono::milliseconds(remain));
+      }
+    }
+    if (shutting_down_) {
+      lock.unlock();
+      send_error(sock, ErrorResponse::CANCELLED, "lighthouse shutting down");
+      return;
+    }
+    gen = quorum_gen_;
+    bool in_quorum = false;
+    for (const auto& p : latest_quorum_.participants()) {
+      if (p.replica_id() == requester.replica_id()) {
+        in_quorum = true;
+        break;
+      }
+    }
+    if (in_quorum) {
+      torchft_tpu::LighthouseQuorumResponse resp;
+      *resp.mutable_quorum() = latest_quorum_;
+      lock.unlock();
+      send_msg(sock, MsgType::kLighthouseQuorumResp, resp);
+      return;
+    }
+    // A quorum formed without us (e.g. it was computed just before we joined);
+    // re-register and keep waiting.
+    LOG_INFO("Replica " << requester.replica_id() << " not in quorum, retrying");
+    state_.participants[requester.replica_id()] =
+        ParticipantDetails{now_ms(), requester};
+  }
+}
+
+namespace {
+
+const char kIndexHtml[] = R"html(<!DOCTYPE html>
+<html>
+<head>
+<title>torchft_tpu lighthouse</title>
+<style>
+body { font-family: system-ui, sans-serif; margin: 2em; background: #10141a; color: #e6e6e6; }
+h1 { font-size: 1.4em; }
+.card { border: 1px solid #2c3442; border-radius: 8px; padding: 0.8em 1.2em; margin: 0.6em 0; background: #161c26; }
+.recovering { border-color: #e0912f; }
+.muted { color: #8b96a8; font-size: 0.9em; }
+button { background: #933; color: #fff; border: none; border-radius: 4px; padding: 0.3em 0.8em; cursor: pointer; }
+table { border-collapse: collapse; }
+td, th { padding: 0.2em 0.8em; text-align: left; }
+</style>
+</head>
+<body>
+<h1>torchft_tpu lighthouse</h1>
+<div id="status">loading...</div>
+<script>
+async function refresh() {
+  try {
+    const r = await fetch('/status');
+    document.getElementById('status').innerHTML = await r.text();
+  } catch (e) {}
+}
+async function kill(id) {
+  await fetch('/replica/' + encodeURIComponent(id) + '/kill', {method: 'POST'});
+}
+refresh();
+setInterval(refresh, 1000);
+</script>
+</body>
+</html>
+)html";
+
+void http_respond(Socket& sock, int code, const std::string& content_type,
+                  const std::string& body) {
+  std::ostringstream os;
+  const char* reason = code == 200 ? "OK" : (code == 404 ? "Not Found" : "Error");
+  os << "HTTP/1.1 " << code << " " << reason << "\r\n"
+     << "Content-Type: " << content_type << "\r\n"
+     << "Content-Length: " << body.size() << "\r\n"
+     << "Connection: close\r\n\r\n"
+     << body;
+  std::string out = os.str();
+  sock.send_all(out.data(), out.size());
+}
+
+std::string html_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '<': out += "&lt;"; break;
+      case '>': out += "&gt;"; break;
+      case '&': out += "&amp;"; break;
+      case '"': out += "&quot;"; break;
+      case '\'': out += "&#39;"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+} // namespace
+
+std::string Lighthouse::render_status_locked() {
+  auto [_, quorum_status] = quorum_compute(now_ms(), state_, opt_);
+
+  int64_t max_step = -1;
+  int64_t num_participants = -1;
+  if (state_.prev_quorum.has_value()) {
+    num_participants = state_.prev_quorum->participants_size();
+    for (const auto& p : state_.prev_quorum->participants())
+      max_step = std::max(max_step, p.step());
+  }
+
+  std::ostringstream os;
+  os << "<div class=card><b>Quorum " << state_.quorum_id << "</b> &mdash; "
+     << num_participants << " participants, max step " << max_step
+     << "<div class=muted>" << html_escape(quorum_status) << "</div></div>";
+
+  if (state_.prev_quorum.has_value()) {
+    for (const auto& p : state_.prev_quorum->participants()) {
+      bool recovering = p.step() != max_step;
+      os << "<div class='card" << (recovering ? " recovering" : "") << "'><b>"
+         << html_escape(p.replica_id()) << "</b>"
+         << (recovering ? " <span class=muted>(recovering)</span>" : "")
+         << "<table>"
+         << "<tr><td>step</td><td>" << p.step() << "</td></tr>"
+         << "<tr><td>manager</td><td>" << html_escape(p.address()) << "</td></tr>"
+         << "<tr><td>store</td><td>" << html_escape(p.store_address()) << "</td></tr>"
+         << "<tr><td>world size</td><td>" << p.world_size() << "</td></tr>"
+         << "</table>"
+         // replica_id reaches JS only via dataset (never inlined in code),
+         // so a hostile id can't escape into script.
+         << "<button data-rid=\"" << html_escape(p.replica_id())
+         << "\" onclick=\"kill(this.dataset.rid)\">Kill</button></div>";
+    }
+  }
+
+  os << "<div class=card><b>Heartbeats</b><table>";
+  int64_t now = now_ms();
+  for (const auto& [replica_id, last] : state_.heartbeats) {
+    bool old = now - last >= opt_.heartbeat_timeout_ms;
+    os << "<tr><td>" << html_escape(replica_id) << "</td><td"
+       << (old ? " style='color:#e0912f'" : "") << ">" << (now - last)
+       << " ms ago</td></tr>";
+  }
+  os << "</table></div>";
+  return os.str();
+}
+
+void Lighthouse::handle_http(Socket& sock, const std::string& head) {
+  std::istringstream is(head);
+  std::string method, path;
+  is >> method >> path;
+
+  if (method == "GET" && (path == "/" || path.empty())) {
+    http_respond(sock, 200, "text/html", kIndexHtml);
+  } else if (method == "GET" && path == "/status") {
+    std::string body;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      body = render_status_locked();
+    }
+    http_respond(sock, 200, "text/html", body);
+  } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
+             path.size() > 14 && path.compare(path.size() - 5, 5, "/kill") == 0) {
+    std::string replica_id = path.substr(9, path.size() - 9 - 5);
+    std::string addr;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (state_.prev_quorum.has_value()) {
+        for (const auto& p : state_.prev_quorum->participants()) {
+          if (p.replica_id() == replica_id) {
+            addr = p.address();
+            break;
+          }
+        }
+      }
+    }
+    if (addr.empty()) {
+      http_respond(sock, 404, "text/plain", "failed to find replica");
+      return;
+    }
+    try {
+      ManagerClient client(addr, /*connect_timeout_ms=*/10000);
+      client.kill("killed from dashboard");
+      http_respond(sock, 200, "text/plain", "ok");
+    } catch (const std::exception& e) {
+      http_respond(sock, 500, "text/plain", e.what());
+    }
+  } else {
+    http_respond(sock, 404, "text/plain", "not found");
+  }
+}
+
+} // namespace tft

@@ -1,0 +1,61 @@
+// Global quorum service. One per job; replica-group managers heartbeat into it
+// and long-poll Quorum requests against it. Also serves an HTML dashboard on
+// the same port (HTTP requests are sniffed apart from protocol frames).
+// Reference: src/lighthouse.rs.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "conn_tracker.h"
+#include "net.h"
+#include "quorum.h"
+
+namespace tft {
+
+class Lighthouse {
+ public:
+  Lighthouse(const std::string& bind_addr, const LighthouseOpt& opt);
+  ~Lighthouse();
+
+  // "http://host:port" (dashboard is literally served over HTTP here).
+  std::string address() const;
+  uint16_t port() const;
+  void shutdown();
+
+ private:
+  void accept_loop();
+  void tick_loop();
+  void handle_conn(Socket& sock);
+  void handle_http(Socket& sock, const std::string& head);
+  void handle_quorum_req(Socket& sock, const std::string& payload);
+
+  // Runs one quorum check; called with mu_ held. On success publishes the new
+  // quorum (bumping quorum_id only when membership changed) and wakes waiters.
+  void quorum_tick_locked();
+
+  std::string render_status_locked();
+
+  LighthouseOpt opt_;
+  std::unique_ptr<Listener> listener_;
+  std::string hostname_;
+
+  std::mutex mu_;
+  std::condition_variable quorum_cv_;
+  LighthouseState state_;
+  // Broadcast channel equivalent: monotone generation + latest value.
+  int64_t quorum_gen_ = 0;
+  torchft_tpu::Quorum latest_quorum_;
+
+  std::atomic<bool> shutting_down_{false};
+  std::thread accept_thread_;
+  std::thread tick_thread_;
+  ConnTracker conns_;
+};
+
+} // namespace tft

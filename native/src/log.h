@@ -1,0 +1,55 @@
+// stderr logging with ms timestamps, the role of the reference's stderrlog
+// (reference src/lib.rs:341-354). Level from TORCHFT_TPU_LOG
+// (error|warn|info|debug), default warn.
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <iostream>
+#include <sstream>
+#include <sys/time.h>
+
+namespace tft {
+
+enum class LogLevel { kError = 0, kWarn = 1, kInfo = 2, kDebug = 3 };
+
+inline LogLevel log_level() {
+  static LogLevel level = [] {
+    const char* env = getenv("TORCHFT_TPU_LOG");
+    if (env == nullptr) return LogLevel::kWarn;
+    if (strcasecmp(env, "debug") == 0) return LogLevel::kDebug;
+    if (strcasecmp(env, "info") == 0) return LogLevel::kInfo;
+    if (strcasecmp(env, "error") == 0) return LogLevel::kError;
+    return LogLevel::kWarn;
+  }();
+  return level;
+}
+
+inline void log_line(const char* level, const std::string& msg) {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  struct tm tm_buf;
+  localtime_r(&tv.tv_sec, &tm_buf);
+  char ts[32];
+  strftime(ts, sizeof(ts), "%H:%M:%S", &tm_buf);
+  fprintf(stderr, "%s.%03ld [%s] torchft_tpu: %s\n", ts, tv.tv_usec / 1000, level,
+          msg.c_str());
+}
+
+#define TFT_LOG(lvl, name, expr)                         \
+  do {                                                   \
+    if (::tft::log_level() >= ::tft::LogLevel::lvl) {    \
+      std::ostringstream _os;                            \
+      _os << expr;                                       \
+      ::tft::log_line(name, _os.str());                  \
+    }                                                    \
+  } while (0)
+
+#define LOG_ERROR(expr) TFT_LOG(kError, "ERROR", expr)
+#define LOG_WARN(expr) TFT_LOG(kWarn, "WARN", expr)
+#define LOG_INFO(expr) TFT_LOG(kInfo, "INFO", expr)
+#define LOG_DEBUG(expr) TFT_LOG(kDebug, "DEBUG", expr)
+
+} // namespace tft

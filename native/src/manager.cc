@@ -1,0 +1,383 @@
+#include "manager.h"
+
+#include <sys/socket.h>
+
+#include <unistd.h>
+
+#include "log.h"
+#include "wire.h"
+
+namespace tft {
+
+using torchft_tpu::ErrorResponse;
+using torchft_tpu::Quorum;
+using torchft_tpu::QuorumMember;
+
+// ---- LighthouseClient ----
+
+LighthouseClient::LighthouseClient(const std::string& addr,
+                                   int64_t connect_timeout_ms)
+    : addr_(addr), connect_timeout_ms_(connect_timeout_ms) {}
+
+Quorum LighthouseClient::quorum(const QuorumMember& requester, int64_t timeout_ms) {
+  torchft_tpu::LighthouseQuorumRequest req;
+  *req.mutable_requester() = requester;
+  req.set_timeout_ms(timeout_ms);
+  auto resp = call<torchft_tpu::LighthouseQuorumRequest,
+                   torchft_tpu::LighthouseQuorumResponse>(
+      addr_, MsgType::kLighthouseQuorumReq, req, MsgType::kLighthouseQuorumResp,
+      connect_timeout_ms_, timeout_ms);
+  return resp.quorum();
+}
+
+void LighthouseClient::heartbeat(const std::string& replica_id, int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(hb_mu_);
+  torchft_tpu::LighthouseHeartbeatRequest req;
+  req.set_replica_id(replica_id);
+  int64_t deadline = now_ms() + timeout_ms;
+  if (!hb_sock_.valid()) hb_sock_ = connect_with_retry(addr_, timeout_ms);
+  try {
+    send_msg(hb_sock_, MsgType::kLighthouseHeartbeatReq, req, deadline);
+    recv_expect<torchft_tpu::LighthouseHeartbeatResponse>(
+        hb_sock_, MsgType::kLighthouseHeartbeatResp, deadline);
+  } catch (...) {
+    hb_sock_.close(); // reconnect on next call
+    throw;
+  }
+}
+
+// ---- ManagerServer ----
+
+ManagerServer::ManagerServer(const std::string& replica_id,
+                             const std::string& lighthouse_addr,
+                             const std::string& hostname, const std::string& bind,
+                             const std::string& store_addr, uint64_t world_size,
+                             int64_t heartbeat_interval_ms,
+                             int64_t connect_timeout_ms)
+    : replica_id_(replica_id),
+      lighthouse_addr_(lighthouse_addr),
+      hostname_(hostname.empty() ? local_hostname() : hostname),
+      store_addr_(store_addr),
+      world_size_(world_size),
+      heartbeat_interval_ms_(heartbeat_interval_ms),
+      connect_timeout_ms_(connect_timeout_ms),
+      listener_(std::make_unique<Listener>(bind)),
+      lighthouse_client_(
+          std::make_unique<LighthouseClient>(lighthouse_addr, connect_timeout_ms)) {
+  // Fail fast if the lighthouse is unreachable, mirroring the reference's
+  // connect-at-construction (src/manager.rs:97).
+  lighthouse_client_->heartbeat(replica_id_, connect_timeout_ms);
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+  LOG_INFO("Manager " << replica_id_ << " listening on " << address());
+}
+
+ManagerServer::~ManagerServer() { shutdown(); }
+
+std::string ManagerServer::address() const {
+  return "http://" + hostname_ + ":" + std::to_string(listener_->port());
+}
+
+void ManagerServer::shutdown() {
+  {
+    // Flag + notify under the cv's mutex so waiters can't miss the wakeup.
+    std::lock_guard<std::mutex> lock(mu_);
+    if (shutting_down_.exchange(true)) return;
+    quorum_cv_.notify_all();
+    commit_cv_.notify_all();
+  }
+  listener_->close();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  conns_.shutdown_all();
+}
+
+void ManagerServer::accept_loop() {
+  while (!shutting_down_) {
+    Socket sock = listener_->accept();
+    if (!sock.valid()) return;
+    conns_.spawn(std::move(sock), [this](Socket& s) { handle_conn(s); });
+  }
+}
+
+void ManagerServer::heartbeat_loop() {
+  while (!shutting_down_) {
+    try {
+      lighthouse_client_->heartbeat(replica_id_, heartbeat_interval_ms_ * 10);
+    } catch (const std::exception& e) {
+      LOG_WARN("heartbeat to lighthouse failed: " << e.what());
+    }
+    struct timespec ts;
+    ts.tv_sec = heartbeat_interval_ms_ / 1000;
+    ts.tv_nsec = (heartbeat_interval_ms_ % 1000) * 1000000;
+    nanosleep(&ts, nullptr);
+  }
+}
+
+void ManagerServer::handle_conn(Socket& sock) {
+  try {
+    while (true) {
+      auto [type, payload] = recv_frame(sock);
+      switch (type) {
+        case MsgType::kManagerQuorumReq:
+          handle_quorum(sock, payload);
+          break;
+        case MsgType::kCheckpointMetadataReq: {
+          torchft_tpu::CheckpointMetadataRequest req;
+          req.ParseFromString(payload);
+          std::optional<std::string> metadata;
+          {
+            std::lock_guard<std::mutex> lock(mu_);
+            auto it = checkpoint_metadata_.find(req.rank());
+            if (it != checkpoint_metadata_.end()) metadata = it->second;
+          }
+          if (!metadata.has_value()) {
+            send_error(sock, ErrorResponse::INVALID_ARGUMENT, "rank not found");
+          } else {
+            torchft_tpu::CheckpointMetadataResponse resp;
+            resp.set_checkpoint_metadata(*metadata);
+            send_msg(sock, MsgType::kCheckpointMetadataResp, resp);
+          }
+          break;
+        }
+        case MsgType::kShouldCommitReq:
+          handle_should_commit(sock, payload);
+          break;
+        case MsgType::kKillReq: {
+          torchft_tpu::KillRequest req;
+          req.ParseFromString(payload);
+          LOG_WARN("got kill request: " << req.msg());
+          // Reference src/manager.rs:349-354: hard exit, torchelastic-style
+          // supervision is responsible for restarting the trainer.
+          _exit(1);
+        }
+        default:
+          send_error(sock, ErrorResponse::INVALID_ARGUMENT,
+                     "unexpected message type");
+          return;
+      }
+    }
+  } catch (const std::exception&) {
+    // peer went away
+  }
+}
+
+void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
+  torchft_tpu::ManagerQuorumRequest req;
+  if (!req.ParseFromString(payload)) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "bad quorum request");
+    return;
+  }
+  LOG_INFO("got quorum request for rank " << req.rank());
+  int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
+
+  std::unique_lock<std::mutex> lock(mu_);
+  // Stash checkpoint server info for the healing flow.
+  checkpoint_metadata_[req.rank()] = req.checkpoint_metadata();
+  participants_.insert(req.rank());
+  int64_t gen = quorum_gen_;
+
+  if (participants_.size() >= world_size_) {
+    // Last local rank arrived: forward one request to the lighthouse on
+    // behalf of the whole replica group. The state lock is held across the
+    // call, matching the reference (src/manager.rs:181 TODO).
+    participants_.clear();
+    LOG_INFO("all workers joined -- starting quorum");
+    QuorumMember requester;
+    requester.set_replica_id(replica_id_);
+    requester.set_address(address());
+    requester.set_store_address(store_addr_);
+    requester.set_step(req.step());
+    requester.set_world_size(world_size_);
+    requester.set_shrink_only(req.shrink_only());
+    try {
+      Quorum quorum = lighthouse_client_->quorum(requester, req.timeout_ms());
+      LOG_INFO("got lighthouse quorum id=" << quorum.quorum_id());
+      latest_quorum_ = std::move(quorum);
+      quorum_error_.clear();
+    } catch (const std::exception& e) {
+      quorum_error_ = e.what();
+      LOG_ERROR("lighthouse quorum failed: " << quorum_error_);
+    }
+    quorum_gen_ += 1;
+    quorum_cv_.notify_all();
+  }
+
+  while (quorum_gen_ == gen && !shutting_down_) {
+    if (deadline < 0) {
+      quorum_cv_.wait(lock);
+    } else {
+      int64_t remain = deadline - now_ms();
+      if (remain <= 0) {
+        lock.unlock();
+        send_error(sock, ErrorResponse::DEADLINE_EXCEEDED, "quorum timed out");
+        return;
+      }
+      quorum_cv_.wait_for(lock, std::chrono::milliseconds(remain));
+    }
+  }
+  if (shutting_down_) {
+    lock.unlock();
+    send_error(sock, ErrorResponse::CANCELLED, "manager shutting down");
+    return;
+  }
+  if (!quorum_error_.empty()) {
+    std::string err = quorum_error_;
+    lock.unlock();
+    send_error(sock, ErrorResponse::UNAVAILABLE, err);
+    return;
+  }
+  Quorum quorum = latest_quorum_;
+  lock.unlock();
+
+  LOG_INFO("returning quorum for rank " << req.rank());
+  try {
+    torchft_tpu::ManagerQuorumResponse resp =
+        compute_quorum_results(replica_id_, req.rank(), quorum);
+    send_msg(sock, MsgType::kManagerQuorumResp, resp);
+  } catch (const std::exception& e) {
+    send_error(sock, ErrorResponse::NOT_FOUND, e.what());
+  }
+}
+
+void ManagerServer::handle_should_commit(Socket& sock, const std::string& payload) {
+  torchft_tpu::ShouldCommitRequest req;
+  if (!req.ParseFromString(payload)) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "bad should_commit request");
+    return;
+  }
+  LOG_INFO("should_commit request from " << req.rank()
+                                         << " should_commit=" << req.should_commit());
+  int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
+
+  std::unique_lock<std::mutex> lock(mu_);
+  if (!req.should_commit()) should_commit_failures_.insert(req.rank());
+  should_commit_count_.insert(req.rank());
+  int64_t gen = commit_gen_;
+
+  if (should_commit_count_.size() >= world_size_) {
+    bool decision = should_commit_failures_.empty();
+    LOG_INFO("should_commit completed should_commit=" << decision);
+    latest_decision_ = decision;
+    should_commit_count_.clear();
+    should_commit_failures_.clear();
+    commit_gen_ += 1;
+    commit_cv_.notify_all();
+  }
+
+  while (commit_gen_ == gen && !shutting_down_) {
+    if (deadline < 0) {
+      commit_cv_.wait(lock);
+    } else {
+      int64_t remain = deadline - now_ms();
+      if (remain <= 0) {
+        lock.unlock();
+        send_error(sock, ErrorResponse::DEADLINE_EXCEEDED, "should_commit timed out");
+        return;
+      }
+      commit_cv_.wait_for(lock, std::chrono::milliseconds(remain));
+    }
+  }
+  if (shutting_down_) {
+    lock.unlock();
+    send_error(sock, ErrorResponse::CANCELLED, "manager shutting down");
+    return;
+  }
+  bool decision = latest_decision_;
+  lock.unlock();
+
+  torchft_tpu::ShouldCommitResponse resp;
+  resp.set_should_commit(decision);
+  send_msg(sock, MsgType::kShouldCommitResp, resp);
+}
+
+// ---- ManagerClient ----
+
+ManagerClient::ManagerClient(const std::string& addr, int64_t connect_timeout_ms)
+    : addr_(addr), connect_timeout_ms_(connect_timeout_ms) {}
+
+// One request/response on the persistent connection. A SocketError before the
+// request was sent triggers one reconnect+resend (these RPCs are idempotent:
+// quorum/should_commit register the rank in a set). A client-side timeout
+// leaves an unconsumed response in flight, so the socket is invalidated and
+// the next call reconnects rather than reading a stale frame.
+template <typename Req, typename Resp>
+Resp ManagerClient::roundtrip(uint8_t req_type, const Req& req, uint8_t resp_type,
+                              int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  try {
+    if (!sock_.valid()) sock_ = connect_with_retry(addr_, connect_timeout_ms_);
+    try {
+      send_msg(sock_, static_cast<MsgType>(req_type), req, deadline);
+    } catch (const SocketError&) {
+      sock_ = connect_with_retry(addr_, connect_timeout_ms_);
+      send_msg(sock_, static_cast<MsgType>(req_type), req, deadline);
+    }
+    return recv_expect<Resp>(sock_, static_cast<MsgType>(resp_type), deadline);
+  } catch (const TimeoutError&) {
+    sock_.close();
+    throw;
+  } catch (const SocketError&) {
+    sock_.close();
+    throw;
+  }
+}
+
+torchft_tpu::ManagerQuorumResponse ManagerClient::quorum(
+    int64_t rank, int64_t step, const std::string& checkpoint_metadata,
+    bool shrink_only, int64_t timeout_ms) {
+  torchft_tpu::ManagerQuorumRequest req;
+  req.set_rank(rank);
+  req.set_step(step);
+  req.set_checkpoint_metadata(checkpoint_metadata);
+  req.set_shrink_only(shrink_only);
+  req.set_timeout_ms(timeout_ms);
+  return roundtrip<torchft_tpu::ManagerQuorumRequest,
+                   torchft_tpu::ManagerQuorumResponse>(
+      static_cast<uint8_t>(MsgType::kManagerQuorumReq), req,
+      static_cast<uint8_t>(MsgType::kManagerQuorumResp), timeout_ms);
+}
+
+std::string ManagerClient::checkpoint_metadata(int64_t rank, int64_t timeout_ms) {
+  torchft_tpu::CheckpointMetadataRequest req;
+  req.set_rank(rank);
+  req.set_timeout_ms(timeout_ms);
+  return roundtrip<torchft_tpu::CheckpointMetadataRequest,
+                   torchft_tpu::CheckpointMetadataResponse>(
+             static_cast<uint8_t>(MsgType::kCheckpointMetadataReq), req,
+             static_cast<uint8_t>(MsgType::kCheckpointMetadataResp), timeout_ms)
+      .checkpoint_metadata();
+}
+
+bool ManagerClient::should_commit(int64_t rank, int64_t step, bool should_commit,
+                                  int64_t timeout_ms) {
+  torchft_tpu::ShouldCommitRequest req;
+  req.set_rank(rank);
+  req.set_step(step);
+  req.set_should_commit(should_commit);
+  req.set_timeout_ms(timeout_ms);
+  return roundtrip<torchft_tpu::ShouldCommitRequest,
+                   torchft_tpu::ShouldCommitResponse>(
+             static_cast<uint8_t>(MsgType::kShouldCommitReq), req,
+             static_cast<uint8_t>(MsgType::kShouldCommitResp), timeout_ms)
+      .should_commit();
+}
+
+void ManagerClient::kill(const std::string& msg) {
+  torchft_tpu::KillRequest req;
+  req.set_msg(msg);
+  try {
+    // Dedicated connection: the peer _exit(1)s without replying, so don't
+    // disturb the persistent one.
+    Socket sock = connect_with_retry(addr_, connect_timeout_ms_);
+    int64_t deadline = now_ms() + connect_timeout_ms_;
+    send_msg(sock, MsgType::kKillReq, req, deadline);
+    recv_expect<torchft_tpu::KillResponse>(sock, MsgType::kKillResp,
+                                           now_ms() + 1000);
+  } catch (const std::exception&) {
+    // expected: connection drops as the process dies
+  }
+}
+
+} // namespace tft

@@ -1,0 +1,119 @@
+// Per-replica-group coordinator, hosted by group rank 0. Aggregates the
+// group's local ranks (quorum barrier, should_commit AND-vote, checkpoint
+// metadata exchange) and forwards one quorum request to the lighthouse on
+// their behalf. Reference: src/manager.rs.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "conn_tracker.h"
+#include "net.h"
+#include "quorum.h"
+
+namespace tft {
+
+// Client for the lighthouse protocol (used by ManagerServer and tests).
+class LighthouseClient {
+ public:
+  LighthouseClient(const std::string& addr, int64_t connect_timeout_ms);
+
+  torchft_tpu::Quorum quorum(const torchft_tpu::QuorumMember& requester,
+                             int64_t timeout_ms);
+  void heartbeat(const std::string& replica_id, int64_t timeout_ms);
+
+ private:
+  std::string addr_;
+  int64_t connect_timeout_ms_;
+  // Persistent heartbeat connection (re-established on error).
+  std::mutex hb_mu_;
+  Socket hb_sock_;
+};
+
+class ManagerServer {
+ public:
+  ManagerServer(const std::string& replica_id, const std::string& lighthouse_addr,
+                const std::string& hostname, const std::string& bind,
+                const std::string& store_addr, uint64_t world_size,
+                int64_t heartbeat_interval_ms, int64_t connect_timeout_ms);
+  ~ManagerServer();
+
+  std::string address() const; // "http://host:port"
+  void shutdown();
+
+ private:
+  void accept_loop();
+  void heartbeat_loop();
+  void handle_conn(Socket& sock);
+  void handle_quorum(Socket& sock, const std::string& payload);
+  void handle_should_commit(Socket& sock, const std::string& payload);
+
+  std::string replica_id_;
+  std::string lighthouse_addr_;
+  std::string hostname_;
+  std::string store_addr_;
+  uint64_t world_size_;
+  int64_t heartbeat_interval_ms_;
+  int64_t connect_timeout_ms_;
+
+  std::unique_ptr<Listener> listener_;
+  std::unique_ptr<LighthouseClient> lighthouse_client_;
+
+  std::mutex mu_;
+  // Reference: src/manager.rs:40-48 (ManagerState).
+  std::map<int64_t, std::string> checkpoint_metadata_;
+  std::set<int64_t> participants_;
+  std::condition_variable quorum_cv_;
+  int64_t quorum_gen_ = 0;
+  torchft_tpu::Quorum latest_quorum_;
+  std::string quorum_error_; // set when the lighthouse call failed
+
+  std::set<int64_t> should_commit_count_;
+  std::set<int64_t> should_commit_failures_;
+  std::condition_variable commit_cv_;
+  int64_t commit_gen_ = 0;
+  bool latest_decision_ = false;
+
+  std::atomic<bool> shutting_down_{false};
+  std::thread accept_thread_;
+  std::thread heartbeat_thread_;
+  ConnTracker conns_;
+};
+
+// Blocking client for a manager server, mirrored into Python.
+// Reference: src/lib.rs:88-197 (ManagerClient pyclass). Holds one persistent
+// mutex-serialized connection — should_commit runs every training step, so
+// per-call connection setup would be hot-path overhead.
+class ManagerClient {
+ public:
+  ManagerClient(const std::string& addr, int64_t connect_timeout_ms);
+
+  torchft_tpu::ManagerQuorumResponse quorum(int64_t rank, int64_t step,
+                                            const std::string& checkpoint_metadata,
+                                            bool shrink_only, int64_t timeout_ms);
+  std::string checkpoint_metadata(int64_t rank, int64_t timeout_ms);
+  bool should_commit(int64_t rank, int64_t step, bool should_commit,
+                     int64_t timeout_ms);
+  // Best-effort: the target exits before replying.
+  void kill(const std::string& msg);
+
+ private:
+  template <typename Req, typename Resp>
+  Resp roundtrip(uint8_t req_type, const Req& req, uint8_t resp_type,
+                 int64_t timeout_ms);
+
+  std::string addr_;
+  int64_t connect_timeout_ms_;
+  std::mutex mu_;
+  Socket sock_;
+};
+
+} // namespace tft

@@ -1,0 +1,369 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <random>
+
+namespace tft {
+
+int64_t now_ms() {
+  auto t = std::chrono::steady_clock::now().time_since_epoch();
+  return std::chrono::duration_cast<std::chrono::milliseconds>(t).count();
+}
+
+int64_t unix_ms() {
+  auto t = std::chrono::system_clock::now().time_since_epoch();
+  return std::chrono::duration_cast<std::chrono::milliseconds>(t).count();
+}
+
+std::string local_hostname() {
+  char buf[256];
+  if (gethostname(buf, sizeof(buf)) != 0) return "localhost";
+  buf[sizeof(buf) - 1] = '\0';
+  return buf;
+}
+
+namespace {
+
+uint16_t parse_port(const std::string& raw, const std::string& port_str) {
+  if (port_str.empty() ||
+      port_str.find_first_not_of("0123456789") != std::string::npos)
+    throw SocketError("bad port in address: " + raw);
+  long port = std::strtol(port_str.c_str(), nullptr, 10);
+  if (port < 0 || port > 65535)
+    throw SocketError("port out of range in address: " + raw);
+  return static_cast<uint16_t>(port);
+}
+
+} // namespace
+
+Addr parse_addr(const std::string& raw) {
+  std::string s = raw;
+  for (const char* scheme : {"http://", "tft://", "grpc://"}) {
+    if (s.rfind(scheme, 0) == 0) {
+      s = s.substr(strlen(scheme));
+      break;
+    }
+  }
+  // strip trailing slash but reject a real path
+  while (!s.empty() && s.back() == '/') s.pop_back();
+  if (s.find('/') != std::string::npos)
+    throw SocketError("address contains a path component: " + raw);
+
+  size_t colon;
+  if (!s.empty() && s[0] == '[') {
+    // [v6]:port
+    size_t close = s.find(']');
+    if (close == std::string::npos || close + 1 >= s.size() || s[close + 1] != ':')
+      throw SocketError("bad address: " + raw);
+    Addr a;
+    a.host = s.substr(1, close - 1);
+    a.port = parse_port(raw, s.substr(close + 2));
+    return a;
+  }
+  colon = s.rfind(':');
+  if (colon == std::string::npos) throw SocketError("address missing port: " + raw);
+  Addr a;
+  a.host = s.substr(0, colon);
+  a.port = parse_port(raw, s.substr(colon + 1));
+  if (a.host.empty()) a.host = "::";
+  return a;
+}
+
+std::pair<std::string, std::string> split_store_addr(const std::string& addr) {
+  std::string s = addr;
+  size_t slash = s.find('/');
+  if (slash == std::string::npos) return {s, ""};
+  return {s.substr(0, slash), s.substr(slash + 1)};
+}
+
+Socket& Socket::operator=(Socket&& other) noexcept {
+  if (this != &other) {
+    close();
+    fd_ = other.fd_;
+    other.fd_ = -1;
+  }
+  return *this;
+}
+
+Socket::~Socket() { close(); }
+
+void Socket::close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Socket::shutdown_rdwr() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
+void Socket::wait_ready(bool for_read, int64_t deadline_ms) {
+  struct pollfd pfd;
+  pfd.fd = fd_;
+  pfd.events = for_read ? POLLIN : POLLOUT;
+  while (true) {
+    int timeout = -1;
+    if (deadline_ms >= 0) {
+      int64_t remain = deadline_ms - now_ms();
+      if (remain <= 0) throw TimeoutError("socket io timed out");
+      timeout = static_cast<int>(std::min<int64_t>(remain, 1 << 30));
+    }
+    int rc = ::poll(&pfd, 1, timeout);
+    if (rc > 0) return;
+    if (rc == 0) throw TimeoutError("socket io timed out");
+    if (errno == EINTR) continue;
+    throw SocketError(std::string("poll: ") + strerror(errno));
+  }
+}
+
+void Socket::send_all(const void* buf, size_t len, int64_t deadline_ms) {
+  const char* p = static_cast<const char*>(buf);
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd_, p + sent, len - sent, MSG_NOSIGNAL);
+    if (n > 0) {
+      sent += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      wait_ready(/*for_read=*/false, deadline_ms);
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    throw SocketError(std::string("send: ") + strerror(errno));
+  }
+}
+
+void Socket::recv_all(void* buf, size_t len, int64_t deadline_ms) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd_, p + got, len - got, 0);
+    if (n > 0) {
+      got += static_cast<size_t>(n);
+      continue;
+    }
+    if (n == 0) throw SocketError("connection closed by peer");
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      wait_ready(/*for_read=*/true, deadline_ms);
+      continue;
+    }
+    if (errno == EINTR) continue;
+    throw SocketError(std::string("recv: ") + strerror(errno));
+  }
+}
+
+size_t Socket::peek(void* buf, size_t len, int64_t deadline_ms) {
+  while (true) {
+    ssize_t n = ::recv(fd_, buf, len, MSG_PEEK);
+    if (n > 0) return static_cast<size_t>(n);
+    if (n == 0) throw SocketError("connection closed by peer");
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      wait_ready(/*for_read=*/true, deadline_ms);
+      continue;
+    }
+    if (errno == EINTR) continue;
+    throw SocketError(std::string("peek: ") + strerror(errno));
+  }
+}
+
+namespace {
+
+void set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void set_common_opts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // TCP keepalive plays the role of reference src/net.rs HTTP2 keep-alive.
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  int idle = 60, intvl = 20, cnt = 3;
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+}
+
+} // namespace
+
+Listener::Listener(const std::string& bind_addr) {
+  Addr a = parse_addr(bind_addr);
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_PASSIVE;
+  std::string port_str = std::to_string(a.port);
+  const char* host = a.host == "::" || a.host.empty() ? nullptr : a.host.c_str();
+  int rc = getaddrinfo(host, port_str.c_str(), &hints, &res);
+  if (rc != 0) throw SocketError(std::string("getaddrinfo: ") + gai_strerror(rc));
+
+  int last_errno = 0;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) {
+      last_errno = errno;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (ai->ai_family == AF_INET6) {
+      int zero = 0; // dual-stack
+      setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
+    }
+    if (::bind(fd, ai->ai_addr, ai->ai_addrlen) == 0 && ::listen(fd, 1024) == 0) {
+      fd_ = fd;
+      struct sockaddr_storage ss;
+      socklen_t slen = sizeof(ss);
+      getsockname(fd, reinterpret_cast<struct sockaddr*>(&ss), &slen);
+      if (ss.ss_family == AF_INET)
+        port_ = ntohs(reinterpret_cast<struct sockaddr_in*>(&ss)->sin_port);
+      else
+        port_ = ntohs(reinterpret_cast<struct sockaddr_in6*>(&ss)->sin6_port);
+      break;
+    }
+    last_errno = errno;
+    ::close(fd);
+  }
+  freeaddrinfo(res);
+  if (fd_ < 0)
+    throw SocketError("bind " + bind_addr + ": " + strerror(last_errno));
+}
+
+Listener::~Listener() { close(); }
+
+void Listener::close() {
+  if (fd_ >= 0) {
+    ::shutdown(fd_, SHUT_RDWR);
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Socket Listener::accept() {
+  while (true) {
+    int fd = ::accept(fd_, nullptr, nullptr);
+    if (fd >= 0) {
+      set_common_opts(fd);
+      set_nonblocking(fd);
+      return Socket(fd);
+    }
+    // Transient failures (peer aborted in queue, fd pressure) must not stop
+    // the accept loop — only a closed listener should.
+    if (errno == EINTR || errno == ECONNABORTED) continue;
+    if (errno == EMFILE || errno == ENFILE || errno == ENOBUFS ||
+        errno == ENOMEM) {
+      struct timespec ts{0, 10 * 1000 * 1000}; // 10ms breather
+      nanosleep(&ts, nullptr);
+      continue;
+    }
+    return Socket(); // listener closed (EBADF/EINVAL)
+  }
+}
+
+Socket connect_once(const Addr& addr, int64_t deadline_ms) {
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string host = addr.host;
+  if (host == "::" || host.empty() || host == "0.0.0.0") host = "localhost";
+  std::string port_str = std::to_string(addr.port);
+  int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) throw SocketError(std::string("getaddrinfo: ") + gai_strerror(rc));
+
+  std::string last_err = "no addresses";
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) {
+      last_err = strerror(errno);
+      continue;
+    }
+    set_nonblocking(fd);
+    int crc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (crc != 0 && errno != EINPROGRESS) {
+      last_err = strerror(errno);
+      ::close(fd);
+      continue;
+    }
+    if (crc != 0) {
+      // wait for connect completion
+      struct pollfd pfd;
+      pfd.fd = fd;
+      pfd.events = POLLOUT;
+      int64_t remain = deadline_ms < 0 ? -1 : deadline_ms - now_ms();
+      if (deadline_ms >= 0 && remain <= 0) {
+        ::close(fd);
+        freeaddrinfo(res);
+        throw TimeoutError("connect timed out");
+      }
+      int prc = ::poll(&pfd, 1, deadline_ms < 0 ? -1 : static_cast<int>(remain));
+      if (prc <= 0) {
+        ::close(fd);
+        freeaddrinfo(res);
+        throw TimeoutError("connect timed out");
+      }
+      int err = 0;
+      socklen_t elen = sizeof(err);
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+      if (err != 0) {
+        last_err = strerror(err);
+        ::close(fd);
+        continue;
+      }
+    }
+    set_common_opts(fd);
+    freeaddrinfo(res);
+    return Socket(fd);
+  }
+  freeaddrinfo(res);
+  throw SocketError("connect " + host + ":" + port_str + ": " + last_err);
+}
+
+Socket connect_with_retry(const std::string& addr_str, int64_t timeout_ms) {
+  Addr addr = parse_addr(addr_str);
+  int64_t deadline = now_ms() + timeout_ms;
+  // Reference src/retry.rs: initial 100ms, multiplier 1.5, max 10s, jitter 100ms.
+  double backoff = 100.0;
+  std::mt19937 rng(static_cast<uint32_t>(now_ms()));
+  std::uniform_real_distribution<double> jitter(0.0, 100.0);
+  std::string last_err;
+  while (true) {
+    try {
+      return connect_once(addr, deadline);
+    } catch (const TimeoutError&) {
+      throw TimeoutError("connect to " + addr_str + " timed out after " +
+                         std::to_string(timeout_ms) + "ms" +
+                         (last_err.empty() ? "" : " (last error: " + last_err + ")"));
+    } catch (const SocketError& e) {
+      last_err = e.what();
+    }
+    int64_t remain = deadline - now_ms();
+    if (remain <= 0)
+      throw TimeoutError("connect to " + addr_str + " timed out after " +
+                         std::to_string(timeout_ms) + "ms (last error: " + last_err +
+                         ")");
+    int64_t sleep_ms =
+        std::min<int64_t>(static_cast<int64_t>(backoff + jitter(rng)), remain);
+    struct timespec ts;
+    ts.tv_sec = sleep_ms / 1000;
+    ts.tv_nsec = (sleep_ms % 1000) * 1000000;
+    nanosleep(&ts, nullptr);
+    backoff = std::min(backoff * 1.5, 10000.0);
+  }
+}
+
+} // namespace tft

@@ -1,0 +1,93 @@
+// TCP plumbing for the control plane: bind/listen, connect with exponential
+// backoff + overall deadline (the role of reference src/net.rs + src/retry.rs),
+// and blocking send/recv helpers with deadlines.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <stdexcept>
+#include <string>
+
+namespace tft {
+
+// Milliseconds since a fixed (steady) epoch; monotonic.
+int64_t now_ms();
+// Unix wall-clock milliseconds (for `Quorum.created_ms` only).
+int64_t unix_ms();
+
+std::string local_hostname();
+
+struct Addr {
+  std::string host;
+  uint16_t port;
+};
+
+// Accepts "host:port", "http://host:port", "tft://host:port", "[::]:port".
+// Trailing path components ("host:port/prefix") are rejected; use
+// split_store_addr for store addresses carrying a key prefix.
+Addr parse_addr(const std::string& addr);
+
+// Splits "host:port/some/prefix" into ("host:port", "some/prefix").
+std::pair<std::string, std::string> split_store_addr(const std::string& addr);
+
+class SocketError : public std::runtime_error {
+ public:
+  explicit SocketError(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+class TimeoutError : public std::runtime_error {
+ public:
+  explicit TimeoutError(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+// RAII fd wrapper. Movable, not copyable.
+class Socket {
+ public:
+  Socket() = default;
+  explicit Socket(int fd) : fd_(fd) {}
+  Socket(Socket&& other) noexcept : fd_(other.fd_) { other.fd_ = -1; }
+  Socket& operator=(Socket&& other) noexcept;
+  ~Socket();
+
+  int fd() const { return fd_; }
+  bool valid() const { return fd_ >= 0; }
+  void close();
+  // Wakes any thread blocked in send/recv on this socket.
+  void shutdown_rdwr();
+
+  // Blocking IO with absolute deadline (now_ms()-based); deadline<0 = none.
+  // Throws TimeoutError past the deadline, SocketError on EOF/reset.
+  void send_all(const void* buf, size_t len, int64_t deadline_ms = -1);
+  void recv_all(void* buf, size_t len, int64_t deadline_ms = -1);
+  // Peek up to len bytes without consuming (for HTTP-vs-frame sniffing).
+  size_t peek(void* buf, size_t len, int64_t deadline_ms = -1);
+
+ private:
+  void wait_ready(bool for_read, int64_t deadline_ms);
+  int fd_ = -1;
+};
+
+class Listener {
+ public:
+  // Binds and listens; port 0 picks an ephemeral port.
+  explicit Listener(const std::string& bind_addr);
+  ~Listener();
+
+  uint16_t port() const { return port_; }
+  // Blocks until a connection arrives; returns invalid Socket after close().
+  Socket accept();
+  void close();
+
+ private:
+  int fd_ = -1;
+  uint16_t port_ = 0;
+};
+
+// Single connect attempt with deadline (non-blocking connect + poll).
+Socket connect_once(const Addr& addr, int64_t deadline_ms);
+
+// Exponential backoff connect: 100ms initial, x1.5, max 10s, jittered,
+// bounded by an overall timeout. Mirrors reference src/retry.rs:14-41.
+Socket connect_with_retry(const std::string& addr, int64_t timeout_ms);
+
+} // namespace tft

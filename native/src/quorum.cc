@@ -1,0 +1,306 @@
+#include "quorum.h"
+
+#include <algorithm>
+#include <set>
+#include <sstream>
+#include <stdexcept>
+#include <unordered_map>
+#include <unordered_set>
+
+namespace tft {
+
+using torchft_tpu::ManagerQuorumResponse;
+using torchft_tpu::Quorum;
+using torchft_tpu::QuorumMember;
+
+bool quorum_changed(const std::vector<QuorumMember>& a,
+                    const std::vector<QuorumMember>& b) {
+  if (a.size() != b.size()) return true;
+  for (size_t i = 0; i < a.size(); i++) {
+    if (a[i].replica_id() != b[i].replica_id()) return true;
+  }
+  return false;
+}
+
+std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
+    int64_t now, const LighthouseState& state, const LighthouseOpt& opt) {
+  // Replicas whose heartbeat is fresh enough to be considered alive.
+  std::set<std::string> healthy_replicas;
+  for (const auto& [replica_id, last] : state.heartbeats) {
+    if (now - last < opt.heartbeat_timeout_ms) healthy_replicas.insert(replica_id);
+  }
+
+  // Participants (replicas actively requesting a quorum) that are healthy.
+  std::map<std::string, const ParticipantDetails*> healthy_participants;
+  for (const auto& [replica_id, details] : state.participants) {
+    if (healthy_replicas.count(replica_id)) healthy_participants[replica_id] = &details;
+  }
+
+  // std::map iteration already yields replica_id order — the deterministic
+  // ordering the whole protocol depends on.
+  std::vector<QuorumMember> candidates;
+  candidates.reserve(healthy_participants.size());
+  bool shrink_only = false;
+  for (const auto& [replica_id, details] : healthy_participants) {
+    candidates.push_back(details->member);
+    if (details->member.shrink_only()) shrink_only = true;
+  }
+
+  std::ostringstream meta;
+  meta << "[" << healthy_participants.size() << "/" << state.participants.size()
+       << " participants healthy][" << healthy_replicas.size() << " heartbeating]"
+       << "[shrink_only=" << (shrink_only ? "true" : "false") << "]";
+  std::string metadata = meta.str();
+
+  if (state.prev_quorum.has_value()) {
+    const Quorum& prev = *state.prev_quorum;
+    std::set<std::string> prev_ids;
+    for (const auto& p : prev.participants()) prev_ids.insert(p.replica_id());
+
+    if (shrink_only) {
+      std::vector<QuorumMember> filtered;
+      for (auto& c : candidates) {
+        if (prev_ids.count(c.replica_id())) filtered.push_back(std::move(c));
+      }
+      candidates = std::move(filtered);
+    }
+
+    // Fast quorum: every member of the previous quorum is present and healthy,
+    // so there is no need to wait out the join timeout.
+    bool is_fast_quorum = true;
+    for (const auto& p : prev.participants()) {
+      if (!healthy_participants.count(p.replica_id())) {
+        is_fast_quorum = false;
+        break;
+      }
+    }
+    if (is_fast_quorum) {
+      return {std::move(candidates), "Fast quorum found! " + metadata};
+    }
+  }
+
+  if (healthy_participants.size() < opt.min_replicas) {
+    std::ostringstream os;
+    os << "New quorum not ready, only have " << healthy_participants.size()
+       << " participants, need min_replicas " << opt.min_replicas << " " << metadata;
+    return {std::nullopt, os.str()};
+  }
+
+  // Split-brain guard: require a strict majority of every replica known to be
+  // alive, so two partitions can never both form a quorum.
+  if (healthy_participants.size() <= healthy_replicas.size() / 2) {
+    std::ostringstream os;
+    os << "New quorum not ready, only have " << healthy_participants.size()
+       << " participants, need at least half of " << healthy_replicas.size()
+       << " healthy workers " << metadata;
+    return {std::nullopt, os.str()};
+  }
+
+  // Valid quorum — but hold the door for heartbeating stragglers until the
+  // join timeout has elapsed since the first participant joined.
+  bool all_healthy_joined = healthy_participants.size() == healthy_replicas.size();
+  int64_t first_joined = now;
+  for (const auto& [_, details] : healthy_participants) {
+    first_joined = std::min(first_joined, details->joined_ms);
+  }
+  if (!all_healthy_joined && now - first_joined < opt.join_timeout_ms) {
+    std::ostringstream os;
+    os << "Valid quorum with " << healthy_participants.size() << " participants, waiting for "
+       << (healthy_replicas.size() - healthy_participants.size())
+       << " healthy but not participating stragglers due to join timeout " << metadata;
+    return {std::nullopt, os.str()};
+  }
+
+  return {std::move(candidates), "Valid quorum found " + metadata};
+}
+
+ManagerQuorumResponse compute_quorum_results(const std::string& replica_id,
+                                             int64_t rank, const Quorum& quorum) {
+  std::vector<QuorumMember> participants(quorum.participants().begin(),
+                                         quorum.participants().end());
+  std::sort(participants.begin(), participants.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id() < b.replica_id();
+            });
+
+  int64_t replica_rank = -1;
+  for (size_t i = 0; i < participants.size(); i++) {
+    if (participants[i].replica_id() == replica_id) {
+      replica_rank = static_cast<int64_t>(i);
+      break;
+    }
+  }
+  if (replica_rank < 0) {
+    throw std::runtime_error("replica " + replica_id +
+                             " not participating in returned quorum");
+  }
+
+  int64_t max_step = 0;
+  for (const auto& p : participants) max_step = std::max(max_step, p.step());
+
+  // The up-to-date cohort; recovery sources and the primary store come from it.
+  std::vector<int64_t> max_participants;
+  std::optional<int64_t> max_rank;
+  for (size_t i = 0; i < participants.size(); i++) {
+    if (participants[i].step() == max_step) {
+      if (participants[i].replica_id() == replica_id) {
+        max_rank = static_cast<int64_t>(max_participants.size());
+      }
+      max_participants.push_back(static_cast<int64_t>(i));
+    }
+  }
+
+  // Spread store load: each local rank picks a different max-step member.
+  const QuorumMember& primary =
+      participants[max_participants[rank % static_cast<int64_t>(max_participants.size())]];
+
+  // A replica needs recovery if it is behind max_step, or everyone is at step
+  // 0 and it is not the primary (initial weight synchronization).
+  std::vector<int64_t> all_recover_dst_ranks;
+  std::unordered_set<int64_t> dst_set;
+  for (size_t i = 0; i < participants.size(); i++) {
+    const auto& p = participants[i];
+    if (p.step() != max_step ||
+        (max_step == 0 && primary.replica_id() != p.replica_id())) {
+      all_recover_dst_ranks.push_back(static_cast<int64_t>(i));
+      dst_set.insert(static_cast<int64_t>(i));
+    }
+  }
+  std::vector<int64_t> up_to_date_ranks;
+  for (size_t i = 0; i < participants.size(); i++) {
+    if (!dst_set.count(static_cast<int64_t>(i)))
+      up_to_date_ranks.push_back(static_cast<int64_t>(i));
+  }
+
+  // Round-robin assignment of recovering replicas onto up-to-date sources,
+  // offset by the local rank so different local ranks hit different sources.
+  std::unordered_map<int64_t, std::vector<int64_t>> recovery_assignments;
+  std::optional<int64_t> recover_src_rank;
+  for (size_t i = 0; i < all_recover_dst_ranks.size(); i++) {
+    int64_t dst = all_recover_dst_ranks[i];
+    int64_t src = up_to_date_ranks[(i + static_cast<size_t>(rank)) %
+                                   up_to_date_ranks.size()];
+    recovery_assignments[src].push_back(dst);
+    if (dst == replica_rank) recover_src_rank = src;
+  }
+
+  ManagerQuorumResponse resp;
+  resp.set_quorum_id(quorum.quorum_id());
+  resp.set_replica_rank(replica_rank);
+  resp.set_replica_world_size(static_cast<int64_t>(participants.size()));
+  if (recover_src_rank.has_value()) {
+    resp.set_recover_src_rank(*recover_src_rank);
+    resp.set_recover_src_manager_address(
+        participants[static_cast<size_t>(*recover_src_rank)].address());
+    resp.set_heal(true);
+  } else {
+    resp.set_recover_src_manager_address("");
+    resp.set_heal(false);
+  }
+  auto it = recovery_assignments.find(replica_rank);
+  if (it != recovery_assignments.end()) {
+    for (int64_t dst : it->second) resp.add_recover_dst_ranks(dst);
+  }
+  resp.set_store_address(primary.store_address());
+  resp.set_max_step(max_step);
+  if (max_rank.has_value()) resp.set_max_rank(*max_rank);
+  resp.set_max_world_size(static_cast<int64_t>(max_participants.size()));
+  return resp;
+}
+
+// ---- JSON conversions ----
+
+Json member_to_json(const QuorumMember& m) {
+  JsonObject o;
+  o["replica_id"] = m.replica_id();
+  o["address"] = m.address();
+  o["store_address"] = m.store_address();
+  o["step"] = m.step();
+  o["world_size"] = static_cast<int64_t>(m.world_size());
+  o["shrink_only"] = m.shrink_only();
+  return Json(std::move(o));
+}
+
+QuorumMember member_from_json(const Json& j) {
+  QuorumMember m;
+  m.set_replica_id(j.get_string("replica_id", ""));
+  m.set_address(j.get_string("address", ""));
+  m.set_store_address(j.get_string("store_address", ""));
+  m.set_step(j.get_int("step", 0));
+  m.set_world_size(static_cast<uint64_t>(j.get_int("world_size", 1)));
+  m.set_shrink_only(j.get_bool("shrink_only", false));
+  return m;
+}
+
+Json quorum_to_json(const Quorum& q) {
+  JsonObject o;
+  o["quorum_id"] = q.quorum_id();
+  o["created_ms"] = q.created_ms();
+  JsonArray parts;
+  for (const auto& p : q.participants()) parts.push_back(member_to_json(p));
+  o["participants"] = Json(std::move(parts));
+  return Json(std::move(o));
+}
+
+Quorum quorum_from_json(const Json& j) {
+  Quorum q;
+  q.set_quorum_id(j.get_int("quorum_id", 0));
+  q.set_created_ms(j.get_int("created_ms", 0));
+  const Json& parts = j.at("participants");
+  if (!parts.is_null()) {
+    for (const auto& p : parts.as_array()) *q.add_participants() = member_from_json(p);
+  }
+  return q;
+}
+
+Json quorum_response_to_json(const ManagerQuorumResponse& r) {
+  JsonObject o;
+  o["quorum_id"] = r.quorum_id();
+  o["replica_rank"] = r.replica_rank();
+  o["replica_world_size"] = r.replica_world_size();
+  o["recover_src_manager_address"] = r.recover_src_manager_address();
+  if (r.has_recover_src_rank()) o["recover_src_rank"] = r.recover_src_rank();
+  JsonArray dsts;
+  for (int64_t d : r.recover_dst_ranks()) dsts.push_back(d);
+  o["recover_dst_ranks"] = Json(std::move(dsts));
+  o["store_address"] = r.store_address();
+  o["max_step"] = r.max_step();
+  if (r.has_max_rank()) o["max_rank"] = r.max_rank();
+  o["max_world_size"] = r.max_world_size();
+  o["heal"] = r.heal();
+  return Json(std::move(o));
+}
+
+LighthouseState lighthouse_state_from_json(const Json& j) {
+  LighthouseState state;
+  state.quorum_id = j.get_int("quorum_id", 0);
+  const Json& parts = j.at("participants");
+  if (!parts.is_null()) {
+    for (const auto& [replica_id, pj] : parts.as_object()) {
+      ParticipantDetails d;
+      d.joined_ms = pj.get_int("joined_ms", 0);
+      d.member = member_from_json(pj.at("member"));
+      state.participants[replica_id] = std::move(d);
+    }
+  }
+  const Json& hb = j.at("heartbeats");
+  if (!hb.is_null()) {
+    for (const auto& [replica_id, ts] : hb.as_object()) {
+      state.heartbeats[replica_id] = ts.as_int();
+    }
+  }
+  const Json& prev = j.at("prev_quorum");
+  if (!prev.is_null()) state.prev_quorum = quorum_from_json(prev);
+  return state;
+}
+
+LighthouseOpt lighthouse_opt_from_json(const Json& j) {
+  LighthouseOpt opt;
+  opt.join_timeout_ms = j.get_int("join_timeout_ms", 60000);
+  opt.min_replicas = static_cast<uint64_t>(j.get_int("min_replicas", 1));
+  opt.quorum_tick_ms = j.get_int("quorum_tick_ms", 100);
+  opt.heartbeat_timeout_ms = j.get_int("heartbeat_timeout_ms", 5000);
+  return opt;
+}
+
+} // namespace tft

@@ -1,0 +1,67 @@
+// Pure quorum logic: the lighthouse's quorum_compute and the manager's
+// compute_quorum_results, kept side-effect free so they can be unit tested
+// directly (mirroring the reference's pure-function tests,
+// src/lighthouse.rs:567-1141 / src/manager.rs:482-851).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "json.h"
+#include "torchft.pb.h"
+
+namespace tft {
+
+struct LighthouseOpt {
+  int64_t join_timeout_ms = 60000;
+  uint64_t min_replicas = 1;
+  int64_t quorum_tick_ms = 100;
+  int64_t heartbeat_timeout_ms = 5000;
+};
+
+struct ParticipantDetails {
+  int64_t joined_ms = 0;
+  torchft_tpu::QuorumMember member;
+};
+
+// Mutable lighthouse state guarded by the caller's lock.
+// Reference: src/lighthouse.rs:48-57 (State).
+struct LighthouseState {
+  std::map<std::string, ParticipantDetails> participants;
+  std::optional<torchft_tpu::Quorum> prev_quorum;
+  int64_t quorum_id = 0;
+  std::map<std::string, int64_t> heartbeats; // replica_id -> last now_ms()
+};
+
+// True iff membership (the ordered list of replica ids) differs.
+// Reference: src/lighthouse.rs:105-110.
+bool quorum_changed(const std::vector<torchft_tpu::QuorumMember>& a,
+                    const std::vector<torchft_tpu::QuorumMember>& b);
+
+// Decides whether a quorum can be formed right now. Returns the participant
+// list (sorted by replica_id) when one can, plus a human-readable reason
+// either way. Reference: src/lighthouse.rs:113-241.
+std::pair<std::optional<std::vector<torchft_tpu::QuorumMember>>, std::string>
+quorum_compute(int64_t now, const LighthouseState& state, const LighthouseOpt& opt);
+
+// Per-rank view of a quorum: replica rank, max-step cohort, primary store,
+// round-robin recovery assignments. Throws std::runtime_error if replica_id is
+// not in the quorum. Reference: src/manager.rs:357-480.
+torchft_tpu::ManagerQuorumResponse compute_quorum_results(
+    const std::string& replica_id, int64_t rank, const torchft_tpu::Quorum& quorum);
+
+// ---- JSON conversions (C-API boundary + pure-function test entry points) ----
+
+Json member_to_json(const torchft_tpu::QuorumMember& m);
+torchft_tpu::QuorumMember member_from_json(const Json& j);
+Json quorum_to_json(const torchft_tpu::Quorum& q);
+torchft_tpu::Quorum quorum_from_json(const Json& j);
+Json quorum_response_to_json(const torchft_tpu::ManagerQuorumResponse& r);
+LighthouseState lighthouse_state_from_json(const Json& j);
+LighthouseOpt lighthouse_opt_from_json(const Json& j);
+
+} // namespace tft

@@ -1,0 +1,213 @@
+#include "store.h"
+
+#include <sys/socket.h>
+
+#include <functional>
+
+#include "wire.h"
+
+namespace tft {
+
+using torchft_tpu::ErrorResponse;
+
+StoreServer::StoreServer(const std::string& bind_addr)
+    : listener_(std::make_unique<Listener>(bind_addr)),
+      hostname_(local_hostname()) {
+  accept_thread_ = std::thread([this] { serve(); });
+}
+
+StoreServer::~StoreServer() { shutdown(); }
+
+uint16_t StoreServer::port() const { return listener_->port(); }
+
+std::string StoreServer::address() const {
+  return hostname_ + ":" + std::to_string(listener_->port());
+}
+
+void StoreServer::shutdown() {
+  {
+    // Flag + notify under the cv's mutex so waiters can't miss the wakeup.
+    std::lock_guard<std::mutex> lock(mu_);
+    if (shutting_down_.exchange(true)) return;
+    cv_.notify_all();
+  }
+  listener_->close();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  conns_.shutdown_all();
+}
+
+void StoreServer::serve() {
+  while (true) {
+    Socket sock = listener_->accept();
+    if (!sock.valid()) return; // shut down
+    conns_.spawn(std::move(sock), [this](Socket& s) { handle_conn(s); });
+  }
+}
+
+void StoreServer::handle_conn(Socket& sock) {
+  try {
+    while (true) {
+      auto [type, payload] = recv_frame(sock);
+      switch (type) {
+        case MsgType::kStoreSetReq: {
+          torchft_tpu::StoreSetRequest req;
+          req.ParseFromString(payload);
+          {
+            std::lock_guard<std::mutex> lock(mu_);
+            data_[req.key()] = req.value();
+          }
+          cv_.notify_all();
+          send_msg(sock, MsgType::kStoreSetResp, torchft_tpu::StoreSetResponse());
+          break;
+        }
+        case MsgType::kStoreGetReq: {
+          torchft_tpu::StoreGetRequest req;
+          req.ParseFromString(payload);
+          int64_t deadline =
+              req.timeout_ms() < 0 ? -1 : now_ms() + req.timeout_ms();
+          std::unique_lock<std::mutex> lock(mu_);
+          bool timed_out = false;
+          while (!data_.count(req.key()) && !shutting_down_) {
+            if (deadline < 0) {
+              cv_.wait(lock);
+            } else {
+              int64_t remain = deadline - now_ms();
+              if (remain <= 0) {
+                timed_out = true;
+                break;
+              }
+              cv_.wait_for(lock, std::chrono::milliseconds(remain));
+            }
+          }
+          if (!data_.count(req.key())) {
+            bool cancelled = shutting_down_ && !timed_out;
+            lock.unlock();
+            if (cancelled) {
+              send_error(sock, ErrorResponse::CANCELLED, "store shutting down");
+            } else {
+              send_error(sock, ErrorResponse::DEADLINE_EXCEEDED,
+                         "timed out waiting for key " + req.key());
+            }
+            break;
+          }
+          torchft_tpu::StoreGetResponse resp;
+          resp.set_value(data_[req.key()]);
+          lock.unlock();
+          send_msg(sock, MsgType::kStoreGetResp, resp);
+          break;
+        }
+        case MsgType::kStoreAddReq: {
+          torchft_tpu::StoreAddRequest req;
+          req.ParseFromString(payload);
+          int64_t value;
+          {
+            std::unique_lock<std::mutex> lock(mu_);
+            std::string& cur = data_[req.key()];
+            int64_t v = 0;
+            if (!cur.empty()) {
+              try {
+                v = std::stoll(cur);
+              } catch (const std::exception&) {
+                lock.unlock();
+                send_error(sock, ErrorResponse::INVALID_ARGUMENT,
+                           "add on non-numeric key " + req.key());
+                break;
+              }
+            }
+            v += req.delta();
+            cur = std::to_string(v);
+            value = v;
+          }
+          cv_.notify_all();
+          torchft_tpu::StoreAddResponse resp;
+          resp.set_value(value);
+          send_msg(sock, MsgType::kStoreAddResp, resp);
+          break;
+        }
+        default:
+          send_error(sock, ErrorResponse::INVALID_ARGUMENT, "bad store request");
+          return;
+      }
+    }
+  } catch (const std::exception&) {
+    // connection closed or reset; drop it
+  }
+}
+
+StoreClient::StoreClient(const std::string& addr, int64_t connect_timeout_ms)
+    : addr_(addr), connect_timeout_ms_(connect_timeout_ms) {
+  reconnect();
+}
+
+void StoreClient::reconnect() {
+  sock_ = connect_with_retry(addr_, connect_timeout_ms_);
+}
+
+namespace {
+
+// One request/response on a persistent connection. A SocketError before the
+// request was fully sent triggers one reconnect+resend (store ops are
+// idempotent); any failure after that — including a client-side timeout, which
+// leaves an unconsumed response in flight — invalidates the socket so the next
+// op starts on a fresh connection instead of reading a stale frame.
+template <typename Req, typename Resp>
+Resp store_roundtrip(Socket& sock, const std::function<void()>& reconnect,
+                     MsgType req_type, const Req& req, MsgType resp_type,
+                     int64_t timeout_ms) {
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  try {
+    if (!sock.valid()) reconnect();
+    try {
+      send_msg(sock, req_type, req, deadline);
+    } catch (const SocketError&) {
+      reconnect();
+      send_msg(sock, req_type, req, deadline);
+    }
+    return recv_expect<Resp>(sock, resp_type, deadline);
+  } catch (const TimeoutError&) {
+    sock.close();
+    throw;
+  } catch (const SocketError&) {
+    sock.close();
+    throw;
+  }
+}
+
+} // namespace
+
+void StoreClient::set(const std::string& key, const std::string& value,
+                      int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  torchft_tpu::StoreSetRequest req;
+  req.set_key(key);
+  req.set_value(value);
+  store_roundtrip<torchft_tpu::StoreSetRequest, torchft_tpu::StoreSetResponse>(
+      sock_, [this] { reconnect(); }, MsgType::kStoreSetReq, req,
+      MsgType::kStoreSetResp, timeout_ms);
+}
+
+std::string StoreClient::get(const std::string& key, int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  torchft_tpu::StoreGetRequest req;
+  req.set_key(key);
+  req.set_timeout_ms(timeout_ms);
+  return store_roundtrip<torchft_tpu::StoreGetRequest,
+                         torchft_tpu::StoreGetResponse>(
+             sock_, [this] { reconnect(); }, MsgType::kStoreGetReq, req,
+             MsgType::kStoreGetResp, timeout_ms)
+      .value();
+}
+
+int64_t StoreClient::add(const std::string& key, int64_t delta, int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  torchft_tpu::StoreAddRequest req;
+  req.set_key(key);
+  req.set_delta(delta);
+  return store_roundtrip<torchft_tpu::StoreAddRequest,
+                         torchft_tpu::StoreAddResponse>(
+             sock_, [this] { reconnect(); }, MsgType::kStoreAddReq, req,
+             MsgType::kStoreAddResp, timeout_ms)
+      .value();
+}
+
+} // namespace tft

@@ -1,0 +1,65 @@
+// Rendezvous key-value store: the role c10d TCPStore plays in the reference
+// (reference torchft/manager.py:170-211 wires one per replica group; the
+// collectives layer namespaces keys per quorum like
+// torchft/process_group.py:81-99). set / blocking get / atomic add.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "conn_tracker.h"
+#include "net.h"
+
+namespace tft {
+
+class StoreServer {
+ public:
+  explicit StoreServer(const std::string& bind_addr);
+  ~StoreServer();
+
+  uint16_t port() const;
+  std::string address() const; // "host:port"
+  void shutdown();
+
+ private:
+  void serve();
+  void handle_conn(Socket& sock);
+
+  std::unique_ptr<Listener> listener_;
+  std::string hostname_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+  std::atomic<bool> shutting_down_{false};
+
+  std::thread accept_thread_;
+  ConnTracker conns_;
+};
+
+// Thread-safe client; one persistent connection, serialized by a mutex.
+class StoreClient {
+ public:
+  StoreClient(const std::string& addr, int64_t connect_timeout_ms);
+
+  void set(const std::string& key, const std::string& value, int64_t timeout_ms);
+  // Blocks until the key exists (timeout_ms < 0: forever). Throws
+  // TimeoutError on deadline.
+  std::string get(const std::string& key, int64_t timeout_ms);
+  int64_t add(const std::string& key, int64_t delta, int64_t timeout_ms);
+
+ private:
+  void reconnect();
+  std::mutex mu_;
+  std::string addr_;
+  int64_t connect_timeout_ms_;
+  Socket sock_;
+};
+
+} // namespace tft

@@ -1,0 +1,115 @@
+// Frame protocol: every message is [u32 payload_len (BE)] [u8 msg_type]
+// [protobuf payload]. One request frame yields exactly one response frame on
+// the same connection (the Store and Manager connections carry many
+// request/response pairs sequentially).
+//
+// This plays the role of tonic gRPC in the reference; the explicit
+// `timeout_ms` fields in requests replace the `grpc-timeout` header parsed by
+// reference src/timeout.rs.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "net.h"
+#include "torchft.pb.h"
+
+namespace tft {
+
+enum class MsgType : uint8_t {
+  kError = 0,
+  kLighthouseQuorumReq = 1,
+  kLighthouseQuorumResp = 2,
+  kLighthouseHeartbeatReq = 3,
+  kLighthouseHeartbeatResp = 4,
+  kManagerQuorumReq = 5,
+  kManagerQuorumResp = 6,
+  kCheckpointMetadataReq = 7,
+  kCheckpointMetadataResp = 8,
+  kShouldCommitReq = 9,
+  kShouldCommitResp = 10,
+  kKillReq = 11,
+  kKillResp = 12,
+  kStoreSetReq = 13,
+  kStoreSetResp = 14,
+  kStoreGetReq = 15,
+  kStoreGetResp = 16,
+  kStoreAddReq = 17,
+  kStoreAddResp = 18,
+};
+
+// Raised when the peer replied with an ErrorResponse frame.
+class RpcError : public std::runtime_error {
+ public:
+  RpcError(torchft_tpu::ErrorResponse::Code code, const std::string& msg)
+      : std::runtime_error(msg), code(code) {}
+  torchft_tpu::ErrorResponse::Code code;
+};
+
+constexpr size_t kMaxFrameBytes = 64 << 20;
+
+inline void send_frame(Socket& sock, MsgType type, const std::string& payload,
+                       int64_t deadline_ms = -1) {
+  if (payload.size() > kMaxFrameBytes) throw SocketError("frame too large");
+  uint8_t header[5];
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  header[0] = (len >> 24) & 0xff;
+  header[1] = (len >> 16) & 0xff;
+  header[2] = (len >> 8) & 0xff;
+  header[3] = len & 0xff;
+  header[4] = static_cast<uint8_t>(type);
+  sock.send_all(header, sizeof(header), deadline_ms);
+  if (!payload.empty()) sock.send_all(payload.data(), payload.size(), deadline_ms);
+}
+
+inline std::pair<MsgType, std::string> recv_frame(Socket& sock,
+                                                  int64_t deadline_ms = -1) {
+  uint8_t header[5];
+  sock.recv_all(header, sizeof(header), deadline_ms);
+  uint32_t len = (uint32_t(header[0]) << 24) | (uint32_t(header[1]) << 16) |
+                 (uint32_t(header[2]) << 8) | uint32_t(header[3]);
+  if (len > kMaxFrameBytes) throw SocketError("oversized frame");
+  std::string payload(len, '\0');
+  if (len > 0) sock.recv_all(payload.data(), len, deadline_ms);
+  return {static_cast<MsgType>(header[4]), std::move(payload)};
+}
+
+template <typename Msg>
+void send_msg(Socket& sock, MsgType type, const Msg& msg, int64_t deadline_ms = -1) {
+  send_frame(sock, type, msg.SerializeAsString(), deadline_ms);
+}
+
+inline void send_error(Socket& sock, torchft_tpu::ErrorResponse::Code code,
+                       const std::string& message, int64_t deadline_ms = -1) {
+  torchft_tpu::ErrorResponse err;
+  err.set_code(code);
+  err.set_message(message);
+  send_msg(sock, MsgType::kError, err, deadline_ms);
+}
+
+// Receives one frame and parses it as Msg; converts error frames to RpcError.
+template <typename Msg>
+Msg recv_expect(Socket& sock, MsgType expected, int64_t deadline_ms = -1) {
+  auto [type, payload] = recv_frame(sock, deadline_ms);
+  if (type == MsgType::kError) {
+    torchft_tpu::ErrorResponse err;
+    if (!err.ParseFromString(payload)) throw SocketError("bad error frame");
+    throw RpcError(err.code(), err.message());
+  }
+  if (type != expected) throw SocketError("unexpected frame type");
+  Msg msg;
+  if (!msg.ParseFromString(payload)) throw SocketError("bad frame payload");
+  return msg;
+}
+
+// One round-trip on a fresh connection.
+template <typename Req, typename Resp>
+Resp call(const std::string& addr, MsgType req_type, const Req& req,
+          MsgType resp_type, int64_t connect_timeout_ms, int64_t op_timeout_ms) {
+  Socket sock = connect_with_retry(addr, connect_timeout_ms);
+  int64_t deadline = op_timeout_ms < 0 ? -1 : now_ms() + op_timeout_ms;
+  send_msg(sock, req_type, req, deadline);
+  return recv_expect<Resp>(sock, resp_type, deadline);
+}
+
+} // namespace tft

@@ -1,0 +1,518 @@
+"""ctypes bindings for the C++ control plane (native/).
+
+Plays the role of the reference's pyo3 module ``torchft.torchft``
+(reference src/lib.rs): exposes ``Lighthouse``, ``Manager`` (the native
+per-replica-group server), ``ManagerClient``, ``QuorumResult`` and the
+rendezvous ``Store``/``StoreClient``. Timeouts surface as ``TimeoutError``
+(matching the DeadlineExceeded/Cancelled mapping in reference
+src/lib.rs:321-333); other failures as ``RuntimeError``.
+
+ctypes releases the GIL for the duration of each native call, so blocking
+RPCs (quorum long-polls, store waits) never stall other Python threads —
+the same property the reference gets from ``py.allow_threads``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import ctypes
+import json
+import os
+import weakref
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import List, Optional, Union
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_libtorchft.so")
+
+
+def _load_lib() -> ctypes.CDLL:
+    if not os.path.exists(_LIB_PATH):
+        raise ImportError(
+            f"native library not found at {_LIB_PATH}; build it with "
+            f"`make -C native` from the repository root"
+        )
+    lib = ctypes.CDLL(_LIB_PATH)
+
+    lib.tft_last_error.restype = ctypes.c_char_p
+    lib.tft_string_free.argtypes = [ctypes.c_void_p]
+
+    lib.tft_lighthouse_create.restype = ctypes.c_void_p
+    lib.tft_lighthouse_create.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tft_lighthouse_address.restype = ctypes.c_void_p
+    lib.tft_lighthouse_address.argtypes = [ctypes.c_void_p]
+    lib.tft_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tft_lighthouse_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_lighthouse_heartbeat.restype = ctypes.c_int
+    lib.tft_lighthouse_heartbeat.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+    ]
+
+    lib.tft_manager_create.restype = ctypes.c_void_p
+    lib.tft_manager_create.argtypes = [ctypes.c_char_p] * 5 + [
+        ctypes.c_uint64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tft_manager_address.restype = ctypes.c_void_p
+    lib.tft_manager_address.argtypes = [ctypes.c_void_p]
+    lib.tft_manager_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tft_manager_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.tft_client_create.restype = ctypes.c_void_p
+    lib.tft_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tft_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_client_quorum.restype = ctypes.c_int
+    lib.tft_client_quorum.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_client_checkpoint_metadata.restype = ctypes.c_int
+    lib.tft_client_checkpoint_metadata.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_client_should_commit.restype = ctypes.c_int
+    lib.tft_client_should_commit.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.tft_client_kill.restype = ctypes.c_int
+    lib.tft_client_kill.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+
+    lib.tft_store_create.restype = ctypes.c_void_p
+    lib.tft_store_create.argtypes = [ctypes.c_char_p]
+    lib.tft_store_address.restype = ctypes.c_void_p
+    lib.tft_store_address.argtypes = [ctypes.c_void_p]
+    lib.tft_store_port.restype = ctypes.c_int
+    lib.tft_store_port.argtypes = [ctypes.c_void_p]
+    lib.tft_store_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tft_store_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.tft_store_client_create.restype = ctypes.c_void_p
+    lib.tft_store_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tft_store_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_store_client_set.restype = ctypes.c_int
+    lib.tft_store_client_set.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_int64,
+    ]
+    lib.tft_store_client_get.restype = ctypes.c_int
+    lib.tft_store_client_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.tft_store_client_add.restype = ctypes.c_int
+    lib.tft_store_client_add.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+
+    lib.tft_quorum_compute.restype = ctypes.c_int
+    lib.tft_quorum_compute.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_compute_quorum_results.restype = ctypes.c_int
+    lib.tft_compute_quorum_results.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    return lib
+
+
+_lib = _load_lib()
+
+_OK = 0
+_TIMEOUT = 1
+
+
+def _check(rc: int) -> None:
+    if rc == _OK:
+        return
+    msg = _lib.tft_last_error().decode("utf-8", "replace")
+    if rc == _TIMEOUT:
+        raise TimeoutError(msg)
+    raise RuntimeError(msg)
+
+
+def _take_string(ptr: ctypes.c_void_p) -> str:
+    try:
+        return ctypes.cast(ptr, ctypes.c_char_p).value.decode("utf-8")
+    finally:
+        _lib.tft_string_free(ptr)
+
+
+def _ms(t: Union[timedelta, float, int]) -> int:
+    """Convert a timedelta (or seconds) to integer milliseconds."""
+    if isinstance(t, timedelta):
+        return int(t.total_seconds() * 1000)
+    return int(t * 1000)
+
+
+# Native servers own background threads; if the interpreter exits while they
+# are still running, libc teardown races those threads and can segfault. Every
+# server registers here and is shut down at exit (CPython does not guarantee
+# __del__ for module-global objects).
+_live_servers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _shutdown_live_servers() -> None:
+    for server in list(_live_servers):
+        try:
+            server.shutdown()
+        except Exception:
+            pass
+
+
+@dataclass
+class QuorumResult:
+    """Per-rank quorum outcome. Reference: src/lib.rs:199-232."""
+
+    quorum_id: int = 0
+    replica_rank: int = 0
+    replica_world_size: int = 0
+    recover_src_manager_address: str = ""
+    recover_src_rank: Optional[int] = None
+    recover_dst_ranks: List[int] = field(default_factory=list)
+    store_address: str = ""
+    max_step: int = 0
+    max_rank: Optional[int] = None
+    max_world_size: int = 0
+    heal: bool = False
+
+    @classmethod
+    def _from_json(cls, raw: str) -> "QuorumResult":
+        d = json.loads(raw)
+        return cls(
+            quorum_id=d["quorum_id"],
+            replica_rank=d["replica_rank"],
+            replica_world_size=d["replica_world_size"],
+            recover_src_manager_address=d["recover_src_manager_address"],
+            recover_src_rank=d.get("recover_src_rank"),
+            recover_dst_ranks=list(d.get("recover_dst_ranks", [])),
+            store_address=d["store_address"],
+            max_step=d["max_step"],
+            max_rank=d.get("max_rank"),
+            max_world_size=d["max_world_size"],
+            heal=d["heal"],
+        )
+
+
+class Lighthouse:
+    """In-process global quorum server (C++). Reference: src/lib.rs:266-319."""
+
+    def __init__(
+        self,
+        bind: str = "[::]:0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 100,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+    ) -> None:
+        self._handle = _lib.tft_lighthouse_create(
+            bind.encode(),
+            min_replicas,
+            join_timeout_ms,
+            quorum_tick_ms,
+            heartbeat_timeout_ms,
+        )
+        if not self._handle:
+            _check(2)
+        _live_servers.add(self)
+
+    def address(self) -> str:
+        return _take_string(_lib.tft_lighthouse_address(self._handle))
+
+    def shutdown(self) -> None:
+        if self._handle:
+            _lib.tft_lighthouse_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            _lib.tft_lighthouse_destroy(handle)
+
+    def __enter__(self) -> "Lighthouse":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.shutdown()
+
+
+def lighthouse_heartbeat(
+    addr: str, replica_id: str, timeout: timedelta = timedelta(seconds=5)
+) -> None:
+    """One-shot heartbeat, used by tests to simulate live non-participants."""
+    _check(
+        _lib.tft_lighthouse_heartbeat(addr.encode(), replica_id.encode(), _ms(timeout))
+    )
+
+
+class Manager:
+    """Native per-replica-group manager server, hosted by group rank 0.
+
+    Reference: src/lib.rs:33-86 (pyo3 ``Manager``).
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: str,
+        bind: str,
+        store_addr: str,
+        world_size: int,
+        heartbeat_interval: timedelta = timedelta(milliseconds=100),
+        connect_timeout: timedelta = timedelta(seconds=60),
+    ) -> None:
+        self._handle = _lib.tft_manager_create(
+            replica_id.encode(),
+            lighthouse_addr.encode(),
+            hostname.encode(),
+            bind.encode(),
+            store_addr.encode(),
+            world_size,
+            _ms(heartbeat_interval),
+            _ms(connect_timeout),
+        )
+        if not self._handle:
+            _check(2)
+        _live_servers.add(self)
+
+    def address(self) -> str:
+        return _take_string(_lib.tft_manager_address(self._handle))
+
+    def shutdown(self) -> None:
+        if self._handle:
+            _lib.tft_manager_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            _lib.tft_manager_destroy(handle)
+
+
+class ManagerClient:
+    """Blocking client for a manager server. Reference: src/lib.rs:88-197."""
+
+    def __init__(
+        self, addr: str, connect_timeout: timedelta = timedelta(seconds=60)
+    ) -> None:
+        self._handle = _lib.tft_client_create(addr.encode(), _ms(connect_timeout))
+
+    def quorum(
+        self,
+        rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool = False,
+        timeout: timedelta = timedelta(seconds=60),
+    ) -> QuorumResult:
+        out = ctypes.c_void_p()
+        _check(
+            _lib.tft_client_quorum(
+                self._handle,
+                rank,
+                step,
+                checkpoint_metadata.encode(),
+                1 if shrink_only else 0,
+                _ms(timeout),
+                ctypes.byref(out),
+            )
+        )
+        return QuorumResult._from_json(_take_string(out))
+
+    def checkpoint_metadata(
+        self, rank: int, timeout: timedelta = timedelta(seconds=60)
+    ) -> str:
+        out = ctypes.c_void_p()
+        _check(
+            _lib.tft_client_checkpoint_metadata(
+                self._handle, rank, _ms(timeout), ctypes.byref(out)
+            )
+        )
+        return _take_string(out)
+
+    def should_commit(
+        self,
+        rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: timedelta = timedelta(seconds=60),
+    ) -> bool:
+        out = ctypes.c_int()
+        _check(
+            _lib.tft_client_should_commit(
+                self._handle,
+                rank,
+                step,
+                1 if should_commit else 0,
+                _ms(timeout),
+                ctypes.byref(out),
+            )
+        )
+        return bool(out.value)
+
+    def kill(self, msg: str = "") -> None:
+        _check(_lib.tft_client_kill(self._handle, msg.encode()))
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            _lib.tft_client_destroy(handle)
+
+
+class Store:
+    """Rendezvous KV store server (the c10d TCPStore role)."""
+
+    def __init__(self, bind: str = "[::]:0") -> None:
+        self._handle = _lib.tft_store_create(bind.encode())
+        if not self._handle:
+            _check(2)
+        _live_servers.add(self)
+
+    def address(self) -> str:
+        return _take_string(_lib.tft_store_address(self._handle))
+
+    @property
+    def port(self) -> int:
+        return _lib.tft_store_port(self._handle)
+
+    def shutdown(self) -> None:
+        if self._handle:
+            _lib.tft_store_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            _lib.tft_store_destroy(handle)
+
+
+class StoreClient:
+    """Client for a :class:`Store`; supports per-quorum key prefixes the way
+    the reference uses PrefixStore (reference torchft/process_group.py:81-99).
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        prefix: str = "",
+        connect_timeout: timedelta = timedelta(seconds=60),
+    ) -> None:
+        self._addr = addr
+        self._prefix = prefix
+        self._handle = _lib.tft_store_client_create(addr.encode(), _ms(connect_timeout))
+        if not self._handle:
+            _check(2)
+
+    def _key(self, key: str) -> bytes:
+        return (f"{self._prefix}/{key}" if self._prefix else key).encode()
+
+    def set(
+        self,
+        key: str,
+        value: bytes,
+        timeout: timedelta = timedelta(seconds=60),
+    ) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        _check(
+            _lib.tft_store_client_set(
+                self._handle, self._key(key), value, len(value), _ms(timeout)
+            )
+        )
+
+    def get(self, key: str, timeout: timedelta = timedelta(seconds=60)) -> bytes:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_size_t()
+        _check(
+            _lib.tft_store_client_get(
+                self._handle,
+                self._key(key),
+                _ms(timeout),
+                ctypes.byref(out),
+                ctypes.byref(out_len),
+            )
+        )
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            _lib.tft_string_free(out)
+
+    def add(
+        self, key: str, delta: int, timeout: timedelta = timedelta(seconds=60)
+    ) -> int:
+        out = ctypes.c_int64()
+        _check(
+            _lib.tft_store_client_add(
+                self._handle, self._key(key), delta, _ms(timeout), ctypes.byref(out)
+            )
+        )
+        return out.value
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            _lib.tft_store_client_destroy(handle)
+
+
+def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict:
+    """Pure-function entry to the C++ quorum_compute, for unit tests.
+
+    Returns ``{"quorum": [members] | None, "reason": str}``.
+    """
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_quorum_compute(
+            now_ms,
+            json.dumps(state).encode(),
+            json.dumps(opt).encode(),
+            ctypes.byref(out),
+        )
+    )
+    return json.loads(_take_string(out))
+
+
+def compute_quorum_results(replica_id: str, rank: int, quorum: dict) -> QuorumResult:
+    """Pure-function entry to the C++ compute_quorum_results, for unit tests."""
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_compute_quorum_results(
+            replica_id.encode(), rank, json.dumps(quorum).encode(), ctypes.byref(out)
+        )
+    )
+    return QuorumResult._from_json(_take_string(out))
