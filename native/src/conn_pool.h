@@ -1,0 +1,57 @@
+// Small connection pool used by the RPC clients. Persistent connections keep
+// per-step RPCs (should_commit runs every training step) off the TCP
+// handshake path, while allowing concurrent blocking calls from multiple
+// threads — a single shared connection would serialize them, and a barrier
+// RPC (quorum, should_commit vote) held by one thread would deadlock another.
+#pragma once
+
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "net.h"
+
+namespace tft {
+
+class ConnPool {
+ public:
+  ConnPool(std::string addr, int64_t connect_timeout_ms, size_t max_idle = 4)
+      : addr_(std::move(addr)),
+        connect_timeout_ms_(connect_timeout_ms),
+        max_idle_(max_idle) {}
+
+  // Returns an idle connection or dials a new one.
+  Socket acquire() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (!idle_.empty()) {
+        Socket s = std::move(idle_.back());
+        idle_.pop_back();
+        return s;
+      }
+    }
+    return connect_with_retry(addr_, connect_timeout_ms_);
+  }
+
+  // Hand back a connection that is still in a clean request/response state.
+  // Connections that desynchronized (timeout mid-response, socket error) must
+  // simply be dropped by the caller instead.
+  void release(Socket s) {
+    if (!s.valid()) return;
+    std::lock_guard<std::mutex> lock(mu_);
+    if (idle_.size() < max_idle_) idle_.push_back(std::move(s));
+  }
+
+  const std::string& addr() const { return addr_; }
+  int64_t connect_timeout_ms() const { return connect_timeout_ms_; }
+
+ private:
+  std::string addr_;
+  int64_t connect_timeout_ms_;
+  size_t max_idle_;
+  std::mutex mu_;
+  std::vector<Socket> idle_;
+};
+
+} // namespace tft

@@ -294,34 +294,35 @@ void ManagerServer::handle_should_commit(Socket& sock, const std::string& payloa
 // ---- ManagerClient ----
 
 ManagerClient::ManagerClient(const std::string& addr, int64_t connect_timeout_ms)
-    : addr_(addr), connect_timeout_ms_(connect_timeout_ms) {}
+    : pool_(addr, connect_timeout_ms) {}
 
-// One request/response on the persistent connection. A SocketError before the
+// One request/response on a pooled connection. A SocketError before the
 // request was sent triggers one reconnect+resend (these RPCs are idempotent:
-// quorum/should_commit register the rank in a set). A client-side timeout
-// leaves an unconsumed response in flight, so the socket is invalidated and
-// the next call reconnects rather than reading a stale frame.
+// quorum/should_commit register the rank in a set). A desynchronized
+// connection — client-side timeout with the response still in flight, or a
+// mid-response socket error — is dropped instead of returned to the pool.
 template <typename Req, typename Resp>
 Resp ManagerClient::roundtrip(uint8_t req_type, const Req& req, uint8_t resp_type,
                               int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(mu_);
   int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  Socket sock = pool_.acquire();
   try {
-    if (!sock_.valid()) sock_ = connect_with_retry(addr_, connect_timeout_ms_);
     try {
-      send_msg(sock_, static_cast<MsgType>(req_type), req, deadline);
+      send_msg(sock, static_cast<MsgType>(req_type), req, deadline);
     } catch (const SocketError&) {
-      sock_ = connect_with_retry(addr_, connect_timeout_ms_);
-      send_msg(sock_, static_cast<MsgType>(req_type), req, deadline);
+      // Pooled connection had gone stale; dial a fresh one.
+      sock = connect_with_retry(pool_.addr(), pool_.connect_timeout_ms());
+      send_msg(sock, static_cast<MsgType>(req_type), req, deadline);
     }
-    return recv_expect<Resp>(sock_, static_cast<MsgType>(resp_type), deadline);
-  } catch (const TimeoutError&) {
-    sock_.close();
-    throw;
-  } catch (const SocketError&) {
-    sock_.close();
+    Resp resp = recv_expect<Resp>(sock, static_cast<MsgType>(resp_type), deadline);
+    pool_.release(std::move(sock));
+    return resp;
+  } catch (const RpcError&) {
+    // Error frame fully consumed: the connection is still in sync.
+    pool_.release(std::move(sock));
     throw;
   }
+  // TimeoutError / SocketError: sock destructs here, dropping the connection.
 }
 
 torchft_tpu::ManagerQuorumResponse ManagerClient::quorum(
@@ -369,9 +370,9 @@ void ManagerClient::kill(const std::string& msg) {
   req.set_msg(msg);
   try {
     // Dedicated connection: the peer _exit(1)s without replying, so don't
-    // disturb the persistent one.
-    Socket sock = connect_with_retry(addr_, connect_timeout_ms_);
-    int64_t deadline = now_ms() + connect_timeout_ms_;
+    // disturb the pool.
+    Socket sock = connect_with_retry(pool_.addr(), pool_.connect_timeout_ms());
+    int64_t deadline = now_ms() + pool_.connect_timeout_ms();
     send_msg(sock, MsgType::kKillReq, req, deadline);
     recv_expect<torchft_tpu::KillResponse>(sock, MsgType::kKillResp,
                                            now_ms() + 1000);

@@ -15,6 +15,7 @@
 #include <thread>
 #include <vector>
 
+#include "conn_pool.h"
 #include "conn_tracker.h"
 #include "net.h"
 #include "quorum.h"
@@ -89,9 +90,9 @@ class ManagerServer {
 };
 
 // Blocking client for a manager server, mirrored into Python.
-// Reference: src/lib.rs:88-197 (ManagerClient pyclass). Holds one persistent
-// mutex-serialized connection — should_commit runs every training step, so
-// per-call connection setup would be hot-path overhead.
+// Reference: src/lib.rs:88-197 (ManagerClient pyclass). Uses a connection
+// pool: persistent connections (should_commit runs every training step) that
+// still allow concurrent barrier RPCs from multiple threads.
 class ManagerClient {
  public:
   ManagerClient(const std::string& addr, int64_t connect_timeout_ms);
@@ -110,10 +111,7 @@ class ManagerClient {
   Resp roundtrip(uint8_t req_type, const Req& req, uint8_t resp_type,
                  int64_t timeout_ms);
 
-  std::string addr_;
-  int64_t connect_timeout_ms_;
-  std::mutex mu_;
-  Socket sock_;
+  ConnPool pool_;
 };
 
 } // namespace tft

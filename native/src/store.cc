@@ -135,78 +135,66 @@ void StoreServer::handle_conn(Socket& sock) {
 }
 
 StoreClient::StoreClient(const std::string& addr, int64_t connect_timeout_ms)
-    : addr_(addr), connect_timeout_ms_(connect_timeout_ms) {
-  reconnect();
+    : pool_(addr, connect_timeout_ms) {
+  // Fail fast on an unreachable store, like the reference's TCPStore client.
+  pool_.release(connect_with_retry(addr, connect_timeout_ms));
 }
 
-void StoreClient::reconnect() {
-  sock_ = connect_with_retry(addr_, connect_timeout_ms_);
-}
-
-namespace {
-
-// One request/response on a persistent connection. A SocketError before the
+// One request/response on a pooled connection. A SocketError before the
 // request was fully sent triggers one reconnect+resend (store ops are
-// idempotent); any failure after that — including a client-side timeout, which
-// leaves an unconsumed response in flight — invalidates the socket so the next
-// op starts on a fresh connection instead of reading a stale frame.
+// idempotent); a desynchronized connection — client-side timeout with the
+// response still in flight, or a mid-response socket error — is dropped
+// instead of returned to the pool.
 template <typename Req, typename Resp>
-Resp store_roundtrip(Socket& sock, const std::function<void()>& reconnect,
-                     MsgType req_type, const Req& req, MsgType resp_type,
-                     int64_t timeout_ms) {
+Resp StoreClient::roundtrip(uint8_t req_type, const Req& req, uint8_t resp_type,
+                            int64_t timeout_ms) {
   int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  Socket sock = pool_.acquire();
   try {
-    if (!sock.valid()) reconnect();
     try {
-      send_msg(sock, req_type, req, deadline);
+      send_msg(sock, static_cast<MsgType>(req_type), req, deadline);
     } catch (const SocketError&) {
-      reconnect();
-      send_msg(sock, req_type, req, deadline);
+      sock = connect_with_retry(pool_.addr(), pool_.connect_timeout_ms());
+      send_msg(sock, static_cast<MsgType>(req_type), req, deadline);
     }
-    return recv_expect<Resp>(sock, resp_type, deadline);
-  } catch (const TimeoutError&) {
-    sock.close();
-    throw;
-  } catch (const SocketError&) {
-    sock.close();
+    Resp resp = recv_expect<Resp>(sock, static_cast<MsgType>(resp_type), deadline);
+    pool_.release(std::move(sock));
+    return resp;
+  } catch (const RpcError&) {
+    // Error frame fully consumed: the connection is still in sync.
+    pool_.release(std::move(sock));
     throw;
   }
+  // TimeoutError / SocketError: sock destructs here, dropping the connection.
 }
-
-} // namespace
 
 void StoreClient::set(const std::string& key, const std::string& value,
                       int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(mu_);
   torchft_tpu::StoreSetRequest req;
   req.set_key(key);
   req.set_value(value);
-  store_roundtrip<torchft_tpu::StoreSetRequest, torchft_tpu::StoreSetResponse>(
-      sock_, [this] { reconnect(); }, MsgType::kStoreSetReq, req,
-      MsgType::kStoreSetResp, timeout_ms);
+  roundtrip<torchft_tpu::StoreSetRequest, torchft_tpu::StoreSetResponse>(
+      static_cast<uint8_t>(MsgType::kStoreSetReq), req,
+      static_cast<uint8_t>(MsgType::kStoreSetResp), timeout_ms);
 }
 
 std::string StoreClient::get(const std::string& key, int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(mu_);
   torchft_tpu::StoreGetRequest req;
   req.set_key(key);
   req.set_timeout_ms(timeout_ms);
-  return store_roundtrip<torchft_tpu::StoreGetRequest,
-                         torchft_tpu::StoreGetResponse>(
-             sock_, [this] { reconnect(); }, MsgType::kStoreGetReq, req,
-             MsgType::kStoreGetResp, timeout_ms)
+  return roundtrip<torchft_tpu::StoreGetRequest, torchft_tpu::StoreGetResponse>(
+             static_cast<uint8_t>(MsgType::kStoreGetReq), req,
+             static_cast<uint8_t>(MsgType::kStoreGetResp), timeout_ms)
       .value();
 }
 
 int64_t StoreClient::add(const std::string& key, int64_t delta, int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(mu_);
   torchft_tpu::StoreAddRequest req;
   req.set_key(key);
   req.set_delta(delta);
-  return store_roundtrip<torchft_tpu::StoreAddRequest,
-                         torchft_tpu::StoreAddResponse>(
-             sock_, [this] { reconnect(); }, MsgType::kStoreAddReq, req,
-             MsgType::kStoreAddResp, timeout_ms)
+  return roundtrip<torchft_tpu::StoreAddRequest, torchft_tpu::StoreAddResponse>(
+             static_cast<uint8_t>(MsgType::kStoreAddReq), req,
+             static_cast<uint8_t>(MsgType::kStoreAddResp), timeout_ms)
       .value();
 }
 

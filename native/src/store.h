@@ -13,6 +13,7 @@
 #include <thread>
 #include <vector>
 
+#include "conn_pool.h"
 #include "conn_tracker.h"
 #include "net.h"
 
@@ -43,7 +44,8 @@ class StoreServer {
   ConnTracker conns_;
 };
 
-// Thread-safe client; one persistent connection, serialized by a mutex.
+// Thread-safe client over pooled persistent connections (a blocking get on
+// one thread must not stall sets from another).
 class StoreClient {
  public:
   StoreClient(const std::string& addr, int64_t connect_timeout_ms);
@@ -55,11 +57,11 @@ class StoreClient {
   int64_t add(const std::string& key, int64_t delta, int64_t timeout_ms);
 
  private:
-  void reconnect();
-  std::mutex mu_;
-  std::string addr_;
-  int64_t connect_timeout_ms_;
-  Socket sock_;
+  template <typename Req, typename Resp>
+  Resp roundtrip(uint8_t req_type, const Req& req, uint8_t resp_type,
+                 int64_t timeout_ms);
+
+  ConnPool pool_;
 };
 
 } // namespace tft

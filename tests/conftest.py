@@ -1,0 +1,21 @@
+import os
+import subprocess
+import sys
+
+# JAX on a virtual 8-device CPU mesh: multi-chip sharding paths are tested
+# without TPU hardware (the driver's dryrun uses the same trick). Must be set
+# before the first `import jax` anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+_LIB = os.path.join(REPO_ROOT, "torchft_tpu", "_libtorchft.so")
+if not os.path.exists(_LIB):
+    subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "native")], check=True)

@@ -1,0 +1,326 @@
+"""Live-server tests for the native control plane.
+
+Mirrors the reference's in-process gRPC e2e tests
+(reference src/lighthouse.rs:910-952,1036-1140; src/manager.rs:504-660)
+and the fast-fail timeout bounds (reference torchft/manager_integ_test.py:356-368,
+torchft/lighthouse_test.py:44-47).
+"""
+
+import subprocess
+import sys
+import threading
+import time
+from datetime import timedelta
+
+import pytest
+
+from torchft_tpu._native import (
+    Lighthouse,
+    Manager,
+    ManagerClient,
+    Store,
+    StoreClient,
+    lighthouse_heartbeat,
+)
+
+TIMEOUT = timedelta(seconds=20)
+
+
+@pytest.fixture
+def lighthouse():
+    lh = Lighthouse(min_replicas=1, join_timeout_ms=100)
+    yield lh
+    lh.shutdown()
+
+
+def _quorum_threads(clients_steps, shrink_only=None):
+    """Run quorum() for several (name, client, step) tuples concurrently."""
+    results, errors = {}, {}
+
+    def run(name, client, step):
+        try:
+            results[name] = client.quorum(
+                0,
+                step,
+                f"ckpt-{name}",
+                shrink_only=bool(shrink_only and name in shrink_only),
+                timeout=TIMEOUT,
+            )
+        except Exception as e: # noqa: BLE001
+            errors[name] = e
+
+    threads = [
+        threading.Thread(target=run, args=t, daemon=True) for t in clients_steps
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    return results
+
+
+class TestStore:
+    def test_set_get_add(self):
+        store = Store()
+        client = StoreClient(store.address())
+        client.set("k", b"v")
+        assert client.get("k") == b"v"
+        assert client.add("n", 2) == 2
+        assert client.add("n", 40) == 42
+        store.shutdown()
+
+    def test_get_timeout_bound(self):
+        store = Store()
+        client = StoreClient(store.address())
+        start = time.monotonic()
+        with pytest.raises(TimeoutError):
+            client.get("never", timeout=timedelta(milliseconds=50))
+        assert time.monotonic() - start < 1.0
+        # connection usable afterwards (fresh reconnect under the hood)
+        client.set("k", b"v")
+        assert client.get("k") == b"v"
+        store.shutdown()
+
+    def test_prefixes_isolate(self):
+        store = Store()
+        a = StoreClient(store.address(), prefix="quorum_1/0")
+        b = StoreClient(store.address(), prefix="quorum_2/0")
+        a.set("x", b"one")
+        with pytest.raises(TimeoutError):
+            b.get("x", timeout=timedelta(milliseconds=50))
+        b.set("x", b"two")
+        assert a.get("x") == b"one"
+        assert b.get("x") == b"two"
+        store.shutdown()
+
+    def test_blocking_get_wakes_on_set(self):
+        store = Store()
+        client_w = StoreClient(store.address())
+        client_r = StoreClient(store.address())
+        out = {}
+
+        def read():
+            out["v"] = client_r.get("later", timeout=timedelta(seconds=10))
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        client_w.set("later", b"data")
+        t.join(timeout=5)
+        assert out["v"] == b"data"
+        store.shutdown()
+
+
+class TestLighthouse:
+    # Reference src/lighthouse.rs:910-952 (test_lighthouse_e2e) — the single
+    # replica long-poll path, plus the <0.4s join-latency bound from
+    # torchft/lighthouse_test.py:44-47.
+    def test_single_replica_quorum_latency(self, lighthouse):
+        store = Store()
+        m = Manager(
+            "foo", lighthouse.address(), "localhost", "[::]:0", store.address(), 1
+        )
+        client = ManagerClient(m.address())
+        start = time.monotonic()
+        result = client.quorum(0, 10, "md", timeout=TIMEOUT)
+        elapsed = time.monotonic() - start
+        assert result.quorum_id == 1
+        assert result.replica_world_size == 1
+        assert result.max_step == 10
+        assert elapsed < 0.4, f"quorum took {elapsed:.3f}s"
+        m.shutdown()
+        store.shutdown()
+
+    # Reference src/lighthouse.rs:1036-1140 (test_lighthouse_join_during_shrink).
+    def test_join_during_shrink(self):
+        lh = Lighthouse(min_replicas=2, join_timeout_ms=1000)
+        store = Store()
+        managers = {
+            name: Manager(
+                name, lh.address(), "localhost", "[::]:0", store.address(), 1
+            )
+            for name in ("replica0", "replica1", "joiner")
+        }
+        clients = {name: ManagerClient(m.address()) for name, m in managers.items()}
+
+        # 1. first quorum: replica0 + replica1
+        first = _quorum_threads(
+            [("replica0", clients["replica0"], 1), ("replica1", clients["replica1"], 1)]
+        )
+        assert first["replica0"].replica_world_size == 2
+        q1 = first["replica0"].quorum_id
+
+        # 2. joiner asks to join; replica0 requests shrink_only — joiner must
+        # be excluded even though it is heartbeating and participating
+        joiner_result = {}
+
+        def join():
+            joiner_result["r"] = clients["joiner"].quorum(
+                0, 1, "ckpt-joiner", timeout=TIMEOUT
+            )
+
+        jt = threading.Thread(target=join, daemon=True)
+        jt.start()
+        time.sleep(0.2)
+
+        second = _quorum_threads(
+            [
+                ("replica0", clients["replica0"], 2),
+                ("replica1", clients["replica1"], 2),
+            ],
+            shrink_only={"replica0"},
+        )
+        assert second["replica0"].replica_world_size == 2
+        assert second["replica0"].quorum_id == q1  # same members -> no bump
+
+        # 3. next quorum without shrink_only admits the joiner
+        third = _quorum_threads(
+            [
+                ("replica0", clients["replica0"], 3),
+                ("replica1", clients["replica1"], 3),
+            ]
+        )
+        assert third["replica0"].replica_world_size == 3
+        assert third["replica0"].quorum_id != q1
+
+        jt.join(timeout=10)
+        assert joiner_result["r"].replica_world_size == 3
+        assert joiner_result["r"].heal  # behind max_step -> must recover
+
+        for m in managers.values():
+            m.shutdown()
+        lh.shutdown()
+        store.shutdown()
+
+    def test_failover_after_heartbeat_expiry(self):
+        lh = Lighthouse(min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=400)
+        store = Store()
+        mA = Manager("repA", lh.address(), "localhost", "[::]:0", store.address(), 1)
+        mB = Manager("repB", lh.address(), "localhost", "[::]:0", store.address(), 1)
+        cA, cB = ManagerClient(mA.address()), ManagerClient(mB.address())
+
+        both = _quorum_threads([("A", cA, 1), ("B", cB, 1)])
+        assert both["A"].replica_world_size == 2
+        q1 = both["A"].quorum_id
+
+        mB.shutdown() # heartbeats stop
+        time.sleep(0.6) # > heartbeat_timeout_ms
+
+        start = time.monotonic()
+        alone = cA.quorum(0, 2, "ckpt-A", timeout=TIMEOUT)
+        elapsed = time.monotonic() - start
+        assert alone.replica_world_size == 1
+        assert alone.quorum_id != q1
+        assert elapsed < 2.0, f"failover quorum took {elapsed:.3f}s"
+
+        mA.shutdown()
+        lh.shutdown()
+        store.shutdown()
+
+    def test_heartbeat_only_participant_blocks_quorum(self, lighthouse):
+        # A heartbeating non-participant triggers the split-brain guard.
+        lighthouse_heartbeat(lighthouse.address(), "bystander")
+        store = Store()
+        m = Manager(
+            "active", lighthouse.address(), "localhost", "[::]:0", store.address(), 1
+        )
+        client = ManagerClient(m.address())
+        with pytest.raises(TimeoutError):
+            client.quorum(0, 1, "md", timeout=timedelta(milliseconds=300))
+        m.shutdown()
+        store.shutdown()
+
+
+class TestManager:
+    # Reference src/manager.rs:504-556 (test_should_commit).
+    def test_should_commit_votes(self, lighthouse):
+        store = Store()
+        m = Manager(
+            "rep", lighthouse.address(), "localhost", "[::]:0", store.address(), 2
+        )
+        client = ManagerClient(m.address())
+
+        results = {}
+
+        def vote(rank, ok):
+            results[rank] = client.should_commit(rank, 0, ok, timeout=TIMEOUT)
+
+        # unanimous yes
+        ts = [
+            threading.Thread(target=vote, args=(0, True), daemon=True),
+            threading.Thread(target=vote, args=(1, True), daemon=True),
+        ]
+        [t.start() for t in ts]
+        [t.join(timeout=10) for t in ts]
+        assert results == {0: True, 1: True}
+
+        # one failure vetoes the group
+        ts = [
+            threading.Thread(target=vote, args=(0, True), daemon=True),
+            threading.Thread(target=vote, args=(1, False), daemon=True),
+        ]
+        [t.start() for t in ts]
+        [t.join(timeout=10) for t in ts]
+        assert results == {0: False, 1: False}
+
+        m.shutdown()
+        store.shutdown()
+
+    # Reference src/manager.rs:606-660 (test_checkpoint_metadata).
+    def test_checkpoint_metadata(self, lighthouse):
+        store = Store()
+        m = Manager(
+            "rep", lighthouse.address(), "localhost", "[::]:0", store.address(), 1
+        )
+        client = ManagerClient(m.address())
+        with pytest.raises(RuntimeError, match="rank not found"):
+            client.checkpoint_metadata(0, timeout=TIMEOUT)
+        client.quorum(0, 0, "the-metadata", timeout=TIMEOUT)
+        assert client.checkpoint_metadata(0, timeout=TIMEOUT) == "the-metadata"
+        m.shutdown()
+        store.shutdown()
+
+    # Fast-fail bound mirroring torchft/manager_integ_test.py:356-368.
+    def test_quorum_fast_timeout(self, lighthouse):
+        store = Store()
+        m = Manager(
+            "rep", lighthouse.address(), "localhost", "[::]:0", store.address(), 2
+        )
+        client = ManagerClient(m.address())
+        start = time.monotonic()
+        with pytest.raises(TimeoutError):
+            # world_size=2 but only one rank joins
+            client.quorum(0, 0, "md", timeout=timedelta(milliseconds=10))
+        assert time.monotonic() - start < 1.0
+        start = time.monotonic()
+        with pytest.raises(TimeoutError):
+            client.should_commit(0, 0, True, timeout=timedelta(milliseconds=10))
+        assert time.monotonic() - start < 1.0
+        m.shutdown()
+        store.shutdown()
+
+    def test_kill_rpc_exits_process(self, lighthouse, tmp_path):
+        store = Store()
+        script = f"""
+import sys, time
+sys.path.insert(0, {sys.path[0]!r})
+sys.path.insert(0, {__file__.rsplit("/tests", 1)[0]!r})
+from torchft_tpu._native import Manager
+m = Manager("victim", {lighthouse.address()!r}, "localhost", "[::]:0",
+             {store.address()!r}, world_size=1)
+print(m.address(), flush=True)
+time.sleep(60)
+"""
+        child = subprocess.Popen(
+            [sys.executable, "-c", script], stdout=subprocess.PIPE, text=True
+        )
+        try:
+            addr = child.stdout.readline().strip()
+            assert addr.startswith("http://")
+            ManagerClient(addr).kill("test kill")
+            assert child.wait(timeout=10) == 1
+        finally:
+            if child.poll() is None:
+                child.kill()
+        store.shutdown()

@@ -1,0 +1,279 @@
+"""Pure-function tests for the C++ quorum logic.
+
+Ports the reference's Rust unit tests for ``quorum_compute``
+(reference src/lighthouse.rs:582-1001) and ``compute_quorum_results``
+(reference src/manager.rs:661-850) through the JSON C-API entry points.
+"""
+
+from torchft_tpu._native import compute_quorum_results, quorum_compute
+
+HOUR_MS = 60 * 60 * 1000
+
+
+def member(replica_id, step=1, world_size=1, shrink_only=False, addr_num=None):
+    n = addr_num if addr_num is not None else replica_id
+    return {
+        "replica_id": replica_id,
+        "address": f"addr_{n}",
+        "store_address": f"store_addr_{n}",
+        "step": step,
+        "world_size": world_size,
+        "shrink_only": shrink_only,
+    }
+
+
+def participant(replica_id, joined_ms=0, **kw):
+    return {"joined_ms": joined_ms, "member": member(replica_id, **kw)}
+
+
+def opts(min_replicas=1, join_timeout_ms=HOUR_MS, heartbeat_timeout_ms=5000):
+    return {
+        "min_replicas": min_replicas,
+        "join_timeout_ms": join_timeout_ms,
+        "quorum_tick_ms": 10,
+        "heartbeat_timeout_ms": heartbeat_timeout_ms,
+    }
+
+
+def state(participants=(), heartbeats=None, prev_quorum=None, now=0):
+    return {
+        "participants": {p["member"]["replica_id"]: p for p in participants},
+        "heartbeats": heartbeats or {},
+        "prev_quorum": prev_quorum,
+        "quorum_id": 0,
+    }
+
+
+class TestQuorumCompute:
+    # Reference src/lighthouse.rs:582-655 (test_quorum_join_timeout).
+    def test_join_timeout(self):
+        now = HOUR_MS * 100
+        o = opts(min_replicas=1, join_timeout_ms=HOUR_MS)
+
+        r = quorum_compute(now, state(), o)
+        assert r["quorum"] is None
+        assert (
+            "New quorum not ready, only have 0 participants, need min_replicas 1"
+            in r["reason"]
+        )
+
+        s = state(
+            [participant("a", joined_ms=now), participant("b", joined_ms=now)],
+            heartbeats={"a": now, "b": now},
+        )
+        # all healthy workers participating
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+
+        # healthy worker not participating -> wait for join timeout
+        s["heartbeats"]["c"] = now
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is None
+        assert "join timeout" in r["reason"]
+
+        # elapse past the join timeout
+        s["participants"]["a"]["joined_ms"] = now - 10 * HOUR_MS
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+
+    # Reference src/lighthouse.rs:657-737 (test_quorum_heartbeats).
+    def test_heartbeats(self):
+        now = HOUR_MS
+        o = opts(min_replicas=1, join_timeout_ms=0)
+
+        s = state([participant("a", joined_ms=now)], heartbeats={"a": now})
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+        assert "[1/1 participants healthy][1 heartbeating]" in r["reason"]
+
+        # expired heartbeat
+        s["heartbeats"]["a"] = now - 10_000
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is None
+        assert "[0/1 participants healthy][0 heartbeating]" in r["reason"]
+
+        # 1 healthy, 1 expired
+        s["participants"]["b"] = participant("b", joined_ms=now)
+        s["heartbeats"]["b"] = now
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+        assert len(r["quorum"]) == 1
+
+    # Reference src/lighthouse.rs:739-821 (test_quorum_fast_prev_quorum).
+    def test_fast_prev_quorum(self):
+        now = HOUR_MS
+        o = opts(min_replicas=1, join_timeout_ms=HOUR_MS)
+
+        assert quorum_compute(now, state(), o)["quorum"] is None
+
+        s = state([participant("a", joined_ms=now)], heartbeats={"a": now})
+        # one worker alive but not participating -> split brain guard
+        s["heartbeats"]["b"] = now
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is None
+        assert "need at least half" in r["reason"]
+
+        # previous quorum containing only "a" -> fast quorum
+        s["prev_quorum"] = {"quorum_id": 1, "participants": [member("a")]}
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+        assert "Fast quorum" in r["reason"]
+
+        # expanding quorum via fast quorum
+        s["participants"]["b"] = participant("b", joined_ms=now)
+        s["heartbeats"]["b"] = now
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+        assert len(r["quorum"]) == 2
+
+    # Reference src/lighthouse.rs:823-908 (test_quorum_shrink_only).
+    def test_shrink_only(self):
+        now = HOUR_MS
+        o = opts(min_replicas=1, join_timeout_ms=HOUR_MS)
+        s = state(
+            [
+                participant("a", joined_ms=now, shrink_only=True),
+                # participant not in the previous quorum
+                participant("c", joined_ms=now, shrink_only=True),
+            ],
+            heartbeats={"a": now, "c": now},
+            prev_quorum={
+                "quorum_id": 1,
+                "participants": [member("a"), member("b")],
+            },
+        )
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+        assert "[shrink_only=true]" in r["reason"]
+        assert len(r["quorum"]) == 1
+        assert r["quorum"][0]["replica_id"] == "a"
+
+    # Reference src/lighthouse.rs:954-1001 (test_quorum_split_brain).
+    def test_split_brain(self):
+        now = HOUR_MS
+        o = opts(min_replicas=1, join_timeout_ms=HOUR_MS)
+
+        assert quorum_compute(now, state(), o)["quorum"] is None
+
+        s = state([participant("a", joined_ms=now)], heartbeats={"a": now})
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is not None, r["reason"]
+
+        # another worker alive but not participating: 1 <= 2/2
+        s["heartbeats"]["b"] = now
+        r = quorum_compute(now, s, o)
+        assert r["quorum"] is None
+        assert (
+            "New quorum not ready, only have 1 participants, need at least half of 2 "
+            "healthy workers [1/1 participants healthy][2 heartbeating]" in r["reason"]
+        )
+
+    def test_deterministic_ordering(self):
+        now = HOUR_MS
+        o = opts(min_replicas=1, join_timeout_ms=0)
+        s = state(
+            [participant(rid, joined_ms=now) for rid in ("zeta", "alpha", "mid")],
+            heartbeats={"zeta": now, "alpha": now, "mid": now},
+        )
+        r = quorum_compute(now, s, o)
+        assert [m["replica_id"] for m in r["quorum"]] == ["alpha", "mid", "zeta"]
+
+
+class TestComputeQuorumResults:
+    # Reference src/manager.rs:727-776 (test_compute_quorum_results_first_step).
+    def test_first_step(self):
+        quorum = {
+            "quorum_id": 1,
+            "participants": [
+                member("replica_0", step=0, addr_num="0"),
+                member("replica_1", step=0, addr_num="1"),
+            ],
+        }
+
+        r = compute_quorum_results("replica_0", 0, quorum)
+        assert not r.heal
+        assert r.replica_rank == 0
+        assert r.recover_src_rank is None
+        assert r.recover_dst_ranks == [1]
+
+        r = compute_quorum_results("replica_1", 0, quorum)
+        assert r.heal
+        assert r.replica_rank == 1
+        assert r.recover_src_rank == 0
+        assert r.recover_dst_ranks == []
+
+        # rank 1 assignments are offset from rank 0's
+        r = compute_quorum_results("replica_1", 1, quorum)
+        assert not r.heal
+        assert r.replica_rank == 1
+        assert r.recover_src_rank is None
+        assert r.recover_dst_ranks == [0]
+
+    # Reference src/manager.rs:778-850 (test_compute_quorum_results_recovery):
+    # 5 replicas, 0/2/4 behind at step 0, 1/3 at max step 1.
+    def test_recovery_matrix(self):
+        quorum = {
+            "quorum_id": 1,
+            "participants": [
+                member("replica_0", step=0, addr_num="0"),
+                member("replica_1", step=1, addr_num="1"),
+                member("replica_2", step=0, addr_num="2"),
+                member("replica_3", step=1, addr_num="3"),
+                member("replica_4", step=0, addr_num="4"),
+            ],
+        }
+
+        r = compute_quorum_results("replica_0", 0, quorum)
+        assert r.heal
+        assert r.recover_src_manager_address == "addr_1"
+        assert r.replica_rank == 0
+        assert r.recover_src_rank == 1
+        assert r.recover_dst_ranks == []
+
+        r = compute_quorum_results("replica_1", 0, quorum)
+        assert not r.heal
+        assert r.recover_src_manager_address == ""
+        assert r.replica_rank == 1
+        assert r.recover_src_rank is None
+        assert sorted(r.recover_dst_ranks) == [0, 4]
+
+        r = compute_quorum_results("replica_3", 0, quorum)
+        assert not r.heal
+        assert r.replica_rank == 3
+        assert r.recover_src_rank is None
+        assert r.recover_dst_ranks == [2]
+
+        # rank 1 assignments are offset from rank 0's
+        r = compute_quorum_results("replica_1", 1, quorum)
+        assert not r.heal
+        assert r.replica_rank == 1
+        assert r.recover_src_rank is None
+        assert r.recover_dst_ranks == [2]
+
+    def test_max_step_cohort(self):
+        quorum = {
+            "quorum_id": 7,
+            "participants": [
+                member("a", step=5, addr_num="a"),
+                member("b", step=3, addr_num="b"),
+                member("c", step=5, addr_num="c"),
+            ],
+        }
+        r = compute_quorum_results("a", 0, quorum)
+        assert r.max_step == 5
+        assert r.max_world_size == 2
+        assert r.max_rank == 0
+        assert r.replica_world_size == 3
+        # primary store for rank 0 comes from the max-step cohort
+        assert r.store_address == "store_addr_a"
+
+        r = compute_quorum_results("b", 0, quorum)
+        assert r.heal and r.max_rank is None
+
+    def test_not_in_quorum_raises(self):
+        quorum = {"quorum_id": 1, "participants": [member("a")]}
+        try:
+            compute_quorum_results("ghost", 0, quorum)
+            raise AssertionError("expected error")
+        except RuntimeError as e:
+            assert "not participating" in str(e)
