@@ -195,8 +195,19 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
       LOG_INFO("got lighthouse quorum id=" << quorum.quorum_id());
       latest_quorum_ = std::move(quorum);
       quorum_error_.clear();
+    } catch (const TimeoutError& e) {
+      // Preserve deadline semantics so the client raises TimeoutError,
+      // mirroring the reference's DeadlineExceeded mapping (src/lib.rs:321-333).
+      quorum_error_ = e.what();
+      quorum_error_code_ = ErrorResponse::DEADLINE_EXCEEDED;
+      LOG_ERROR("lighthouse quorum failed: " << quorum_error_);
+    } catch (const RpcError& e) {
+      quorum_error_ = e.what();
+      quorum_error_code_ = e.code;
+      LOG_ERROR("lighthouse quorum failed: " << quorum_error_);
     } catch (const std::exception& e) {
       quorum_error_ = e.what();
+      quorum_error_code_ = ErrorResponse::UNAVAILABLE;
       LOG_ERROR("lighthouse quorum failed: " << quorum_error_);
     }
     quorum_gen_ += 1;
@@ -223,8 +234,9 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
   }
   if (!quorum_error_.empty()) {
     std::string err = quorum_error_;
+    ErrorResponse::Code code = quorum_error_code_;
     lock.unlock();
-    send_error(sock, ErrorResponse::UNAVAILABLE, err);
+    send_error(sock, code, err);
     return;
   }
   Quorum quorum = latest_quorum_;

@@ -76,6 +76,8 @@ class ManagerServer {
   int64_t quorum_gen_ = 0;
   torchft_tpu::Quorum latest_quorum_;
   std::string quorum_error_; // set when the lighthouse call failed
+  torchft_tpu::ErrorResponse::Code quorum_error_code_ =
+      torchft_tpu::ErrorResponse::UNAVAILABLE;
 
   std::set<int64_t> should_commit_count_;
   std::set<int64_t> should_commit_failures_;
