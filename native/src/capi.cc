@@ -6,6 +6,7 @@
 #include <cstring>
 #include <string>
 
+#include "collectives.h"
 #include "json.h"
 #include "lighthouse.h"
 #include "manager.h"
@@ -232,6 +233,55 @@ int tft_store_client_add(void* handle, const char* key, int64_t delta,
   return guarded([&] {
     *value_out = static_cast<StoreClient*>(handle)->add(key, delta, timeout_ms);
   });
+}
+
+// ---- HostCollectives ----
+
+void* tft_hc_create() { return new HostCollectives(); }
+
+void tft_hc_destroy(void* handle) { delete static_cast<HostCollectives*>(handle); }
+
+int tft_hc_configure(void* handle, const char* store_addr, int64_t rank,
+                     int64_t world_size, int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->configure(store_addr, rank, world_size,
+                                                     timeout_ms);
+  });
+}
+
+int tft_hc_allreduce(void* handle, void* data, size_t count, int dtype, int op,
+                     int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->allreduce(
+        data, count, static_cast<Dtype>(dtype), static_cast<ReduceOp>(op),
+        timeout_ms);
+  });
+}
+
+int tft_hc_allgather(void* handle, const void* in, void* out, size_t nbytes,
+                     int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->allgather(in, out, nbytes, timeout_ms);
+  });
+}
+
+int tft_hc_broadcast(void* handle, void* data, size_t nbytes, int64_t root,
+                     int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->broadcast(data, nbytes, root,
+                                                     timeout_ms);
+  });
+}
+
+int tft_hc_barrier(void* handle, int64_t timeout_ms) {
+  return guarded(
+      [&] { static_cast<HostCollectives*>(handle)->barrier(timeout_ms); });
+}
+
+void tft_hc_abort(void* handle) { static_cast<HostCollectives*>(handle)->abort(); }
+
+int64_t tft_hc_world_size(void* handle) {
+  return static_cast<HostCollectives*>(handle)->world_size();
 }
 
 // ---- pure functions (test entry points) ----

@@ -1,0 +1,314 @@
+#include "collectives.h"
+
+#include <poll.h>
+#include <string.h>
+
+#include <algorithm>
+#include <vector>
+
+#include "log.h"
+#include "store.h"
+
+namespace tft {
+
+size_t dtype_size(Dtype d) {
+  switch (d) {
+    case Dtype::kF32:
+    case Dtype::kI32:
+      return 4;
+    case Dtype::kF64:
+    case Dtype::kI64:
+      return 8;
+  }
+  throw SocketError("bad dtype");
+}
+
+namespace {
+
+constexpr uint32_t kHelloMagic = 0x74667463; // "tftc"
+
+template <typename T>
+void reduce_typed(T* dst, const T* src, size_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+      for (size_t i = 0; i < n; i++) dst[i] += src[i];
+      return;
+    case ReduceOp::kProduct:
+      for (size_t i = 0; i < n; i++) dst[i] *= src[i];
+      return;
+    case ReduceOp::kMin:
+      for (size_t i = 0; i < n; i++) dst[i] = std::min(dst[i], src[i]);
+      return;
+    case ReduceOp::kMax:
+      for (size_t i = 0; i < n; i++) dst[i] = std::max(dst[i], src[i]);
+      return;
+  }
+  throw SocketError("bad reduce op");
+}
+
+void reduce_into(void* dst, const void* src, size_t n, Dtype dtype, ReduceOp op) {
+  switch (dtype) {
+    case Dtype::kF32:
+      reduce_typed(static_cast<float*>(dst), static_cast<const float*>(src), n, op);
+      return;
+    case Dtype::kF64:
+      reduce_typed(static_cast<double*>(dst), static_cast<const double*>(src), n,
+                   op);
+      return;
+    case Dtype::kI32:
+      reduce_typed(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src), n,
+                   op);
+      return;
+    case Dtype::kI64:
+      reduce_typed(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src), n,
+                   op);
+      return;
+  }
+  throw SocketError("bad dtype");
+}
+
+// Element range of ring chunk `c` when `count` elements are split into `ws`
+// near-equal chunks (first `count % ws` chunks get one extra element).
+std::pair<size_t, size_t> chunk_range(size_t count, int64_t ws, int64_t c) {
+  size_t q = count / ws;
+  size_t r = count % ws;
+  size_t start = c * q + std::min<size_t>(c, r);
+  size_t len = q + (static_cast<size_t>(c) < r ? 1 : 0);
+  return {start, len};
+}
+
+} // namespace
+
+HostCollectives::~HostCollectives() { abort(); }
+
+void HostCollectives::abort() {
+  std::lock_guard<std::mutex> lock(cfg_mu_);
+  aborted_ = true;
+  abort_epoch_++;
+  if (listener_) listener_->close();
+  next_.shutdown_rdwr();
+  prev_.shutdown_rdwr();
+}
+
+namespace {
+
+// Remaining budget before `deadline`; throws once it is exhausted (a
+// non-positive timeout must never leak into a blocking call, where some
+// callees read <0 as "wait forever").
+int64_t remain_or_throw(int64_t deadline) {
+  int64_t r = deadline - now_ms();
+  if (r <= 0) throw TimeoutError("configure timed out");
+  return r;
+}
+
+} // namespace
+
+void HostCollectives::configure(const std::string& store_addr, int64_t rank,
+                                int64_t world_size, int64_t timeout_ms) {
+  if (rank < 0 || world_size <= 0 || rank >= world_size)
+    throw SocketError("bad rank/world_size");
+  abort(); // unblock any op stuck on the old ring
+  std::lock_guard<std::mutex> op_lock(op_mu_); // wait for it to drain
+
+  // Phase 1 (under cfg_mu_, non-blocking): retire the old ring, stand up the
+  // new listener so a concurrent abort() can close it and wake phase 2.
+  int64_t epoch;
+  {
+    std::lock_guard<std::mutex> lock(cfg_mu_);
+    next_.close();
+    prev_.close();
+    listener_.reset();
+    rank_ = rank;
+    world_size_ = world_size;
+    aborted_ = true;
+    epoch = abort_epoch_;
+    if (world_size == 1) {
+      aborted_ = false;
+      return;
+    }
+    listener_ = std::make_unique<Listener>("[::]:0");
+  }
+
+  // Phase 2 (no locks held, every step deadline-bounded): rendezvous through
+  // the store and wire the ring. Both neighbors dial concurrently; connect()
+  // lands in the peer's listen backlog, so no accept ordering is needed.
+  int64_t deadline = now_ms() + timeout_ms;
+  auto [kv_addr, prefix] = split_store_addr(store_addr);
+  StoreClient store(kv_addr, remain_or_throw(deadline));
+
+  std::string my_addr =
+      local_hostname() + ":" + std::to_string(listener_->port());
+  store.set(prefix + "/hc_addr_" + std::to_string(rank), my_addr,
+            remain_or_throw(deadline));
+
+  int64_t next_rank = (rank + 1) % world_size;
+  std::string next_addr =
+      store.get(prefix + "/hc_addr_" + std::to_string(next_rank),
+                remain_or_throw(deadline));
+  Socket next_sock = connect_with_retry(next_addr, remain_or_throw(deadline));
+  uint32_t hello[2] = {kHelloMagic, static_cast<uint32_t>(rank)};
+  next_sock.send_all(hello, sizeof(hello), deadline);
+
+  Socket prev_sock = listener_->accept(deadline);
+  if (!prev_sock.valid()) throw SocketError("listener closed during configure");
+  uint32_t peer_hello[2];
+  prev_sock.recv_all(peer_hello, sizeof(peer_hello), deadline);
+  int64_t prev_rank = (rank - 1 + world_size) % world_size;
+  if (peer_hello[0] != kHelloMagic ||
+      peer_hello[1] != static_cast<uint32_t>(prev_rank))
+    throw SocketError("ring handshake mismatch");
+
+  // Phase 3: publish the new ring unless an abort raced in.
+  std::lock_guard<std::mutex> lock(cfg_mu_);
+  if (abort_epoch_ != epoch) throw SocketError("aborted during configure");
+  next_ = std::move(next_sock);
+  prev_ = std::move(prev_sock);
+  aborted_ = false;
+}
+
+void HostCollectives::duplex(const char* send_buf, size_t send_len,
+                             char* recv_buf, size_t recv_len,
+                             int64_t deadline_ms) {
+  size_t sent = 0, got = 0;
+  while (sent < send_len || got < recv_len) {
+    struct pollfd pfds[2];
+    int n = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sent < send_len) {
+      send_idx = n;
+      pfds[n].fd = next_.fd();
+      pfds[n].events = POLLOUT;
+      n++;
+    }
+    if (got < recv_len) {
+      recv_idx = n;
+      pfds[n].fd = prev_.fd();
+      pfds[n].events = POLLIN;
+      n++;
+    }
+    int timeout = -1;
+    if (deadline_ms >= 0) {
+      int64_t remain = deadline_ms - now_ms();
+      if (remain <= 0) throw TimeoutError("collective timed out");
+      timeout = static_cast<int>(std::min<int64_t>(remain, 1 << 30));
+    }
+    int prc = ::poll(pfds, n, timeout);
+    if (prc == 0) throw TimeoutError("collective timed out");
+    if (prc < 0) {
+      if (errno == EINTR) continue;
+      throw SocketError(std::string("poll: ") + strerror(errno));
+    }
+    if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t w = ::send(next_.fd(), send_buf + sent, send_len - sent,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w > 0) {
+        sent += static_cast<size_t>(w);
+      } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                 errno != EINTR) {
+        throw SocketError(std::string("ring send: ") + strerror(errno));
+      }
+    }
+    if (recv_idx >= 0 &&
+        (pfds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r = ::recv(prev_.fd(), recv_buf + got, recv_len - got, MSG_DONTWAIT);
+      if (r > 0) {
+        got += static_cast<size_t>(r);
+      } else if (r == 0) {
+        throw SocketError("ring peer closed connection");
+      } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        throw SocketError(std::string("ring recv: ") + strerror(errno));
+      }
+    }
+  }
+}
+
+void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
+                                ReduceOp op, int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  if (world_size_ == 1 || count == 0) return;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  char* bytes = static_cast<char*>(data);
+  size_t esize = dtype_size(dtype);
+  size_t max_chunk = count / world_size_ + 1;
+  std::vector<char> recv_tmp(max_chunk * esize);
+
+  // Reduce-scatter: after step s, chunk (rank - s) has accumulated the values
+  // of ranks rank-s..rank. After ws-1 steps chunk (rank+1) holds the full
+  // reduction at this rank — computed in the identical rank order everywhere.
+  for (int64_t s = 0; s < world_size_ - 1; s++) {
+    int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+    int64_t recv_c = ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
+    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+    duplex(bytes + s_start * esize, s_len * esize, recv_tmp.data(),
+           r_len * esize, deadline);
+    reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
+  }
+  // Allgather: circulate the fully-reduced chunks.
+  for (int64_t s = 0; s < world_size_ - 1; s++) {
+    int64_t send_c = ((rank_ + 1 - s) % world_size_ + world_size_) % world_size_;
+    int64_t recv_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+    duplex(bytes + s_start * esize, s_len * esize, bytes + r_start * esize,
+           r_len * esize, deadline);
+  }
+}
+
+void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
+                                int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  char* slots = static_cast<char*>(out);
+  memcpy(slots + rank_ * nbytes, in, nbytes);
+  if (world_size_ == 1 || nbytes == 0) return;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  for (int64_t s = 0; s < world_size_ - 1; s++) {
+    int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+    int64_t recv_c = ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
+    duplex(slots + send_c * nbytes, nbytes, slots + recv_c * nbytes, nbytes,
+           deadline);
+  }
+}
+
+void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
+                                int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  if (world_size_ == 1 || nbytes == 0) return;
+  if (root < 0 || root >= world_size_) throw SocketError("bad broadcast root");
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  char* bytes = static_cast<char*>(data);
+  // Forward around the ring, root first; the last hop before root does not
+  // send. recv-then-send per hop (latency is fine at control-plane sizes;
+  // bulk weight transfer goes through the checkpoint transport instead).
+  if (rank_ == root) {
+    duplex(bytes, nbytes, nullptr, 0, deadline);
+  } else {
+    duplex(nullptr, 0, bytes, nbytes, deadline);
+    if ((rank_ + 1) % world_size_ != root)
+      duplex(bytes, nbytes, nullptr, 0, deadline);
+  }
+}
+
+void HostCollectives::barrier(int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  if (world_size_ == 1) return;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  // Two full ring passes: after the first, rank 0 knows everyone arrived;
+  // the second releases everyone.
+  char token = 1;
+  for (int round = 0; round < 2; round++) {
+    if (rank_ == 0) {
+      duplex(&token, 1, nullptr, 0, deadline);
+      duplex(nullptr, 0, &token, 1, deadline);
+    } else {
+      duplex(nullptr, 0, &token, 1, deadline);
+      duplex(&token, 1, nullptr, 0, deadline);
+    }
+  }
+}
+
+} // namespace tft

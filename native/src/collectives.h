@@ -1,0 +1,103 @@
+// Host-side collective communication over TCP: the role Gloo plays in the
+// reference (reference torchft/process_group.py:282-296 ProcessGroupGloo and
+// the reconfigure discipline of process_group.py:238-254).
+//
+// Design for the TPU build: cross-replica-group traffic stays OUTSIDE XLA
+// (host-side sockets), so a dead peer surfaces as a socket error on an
+// abortable fd instead of a wedged ICI collective — the property the
+// reference gets from subprocess-isolated NCCL ("Baby" PGs,
+// process_group.py:551-1064). Intra-group collectives are XLA's job (pjit
+// over the slice mesh); this class only ever spans replica groups.
+//
+// Topology: a ring. configure() rendezvouses through the Store (the caller
+// passes "host:port/prefix" where prefix is unique per quorum, mirroring
+// manager.py:470-477), each rank listens on an ephemeral port, connects to
+// rank+1 and accepts from rank-1. Ring allreduce = reduce-scatter +
+// allgather; each chunk is reduced in the same rank order on every
+// participant, so results are bit-identical across ranks and across runs —
+// the determinism oracle the reference tests demand
+// (manager_integ_test.py:279-282).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "net.h"
+
+namespace tft {
+
+enum class ReduceOp : int {
+  kSum = 0,
+  kProduct = 1,
+  kMin = 2,
+  kMax = 3,
+};
+
+enum class Dtype : int {
+  kF32 = 0,
+  kF64 = 1,
+  kI32 = 2,
+  kI64 = 3,
+};
+
+size_t dtype_size(Dtype d);
+
+class HostCollectives {
+ public:
+  HostCollectives() = default;
+  ~HostCollectives();
+
+  // Rebuilds the ring for a (possibly new) membership. store_addr is
+  // "host:port/prefix"; the prefix must be unique per quorum — stale members
+  // of an old quorum never see the new keys, so they cannot cross-talk
+  // (reference manager.py:470-477 store-prefix discipline). Aborts any
+  // in-flight op first.
+  void configure(const std::string& store_addr, int64_t rank, int64_t world_size,
+                 int64_t timeout_ms);
+
+  // In-place ring allreduce over `count` elements of `data`.
+  void allreduce(void* data, size_t count, Dtype dtype, ReduceOp op,
+                 int64_t timeout_ms);
+  // Gathers `nbytes` from every rank into `out` (world_size * nbytes), in
+  // rank order.
+  void allgather(const void* in, void* out, size_t nbytes, int64_t timeout_ms);
+  // Broadcasts `nbytes` of `data` from `root` to all ranks, in place.
+  void broadcast(void* data, size_t nbytes, int64_t root, int64_t timeout_ms);
+  void barrier(int64_t timeout_ms);
+
+  int64_t rank() const { return rank_; }
+  int64_t world_size() const { return world_size_; }
+
+  // Wakes any thread blocked inside an op with a SocketError; the instance
+  // stays usable via a subsequent configure(). Safe to call from any thread.
+  void abort();
+
+ private:
+  // Sends send_len bytes to next_ while concurrently receiving recv_len
+  // bytes from prev_ (full-duplex pump; one-directional blocking would
+  // deadlock once kernel buffers fill on a large ring step).
+  void duplex(const char* send_buf, size_t send_len, char* recv_buf,
+              size_t recv_len, int64_t deadline_ms);
+
+  // Guards socket object identity (swap/close) against concurrent abort.
+  // Never held across blocking IO, so abort() always runs promptly.
+  std::mutex cfg_mu_;
+  // Serializes collective ops (they share the ring sockets and must issue in
+  // the same order on every rank anyway).
+  std::mutex op_mu_;
+
+  int64_t rank_ = -1;
+  int64_t world_size_ = 0;
+  std::unique_ptr<Listener> listener_;
+  Socket next_;
+  Socket prev_;
+  std::atomic<bool> aborted_{true}; // not configured yet
+  // Bumped by every abort(); configure() uses it to detect an abort that
+  // raced with its (lock-free) rendezvous phase.
+  std::atomic<int64_t> abort_epoch_{0};
+};
+
+} // namespace tft

@@ -199,6 +199,9 @@ void set_common_opts(int fd) {
 
 } // namespace
 
+// The listener fd is non-blocking: accept() waits in poll, so a deadline is
+// always enforceable and a peer that vanishes from the backlog between poll
+// and ::accept surfaces as EAGAIN (retried) instead of a blocking accept.
 Listener::Listener(const std::string& bind_addr) {
   Addr a = parse_addr(bind_addr);
   struct addrinfo hints, *res = nullptr;
@@ -225,6 +228,7 @@ Listener::Listener(const std::string& bind_addr) {
       setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
     }
     if (::bind(fd, ai->ai_addr, ai->ai_addrlen) == 0 && ::listen(fd, 1024) == 0) {
+      set_nonblocking(fd);
       fd_ = fd;
       struct sockaddr_storage ss;
       socklen_t slen = sizeof(ss);
@@ -253,17 +257,37 @@ void Listener::close() {
   }
 }
 
-Socket Listener::accept() {
+Socket Listener::accept() { return accept(-1); }
+
+Socket Listener::accept(int64_t deadline_ms) {
   while (true) {
+    struct pollfd pfd;
+    pfd.fd = fd_;
+    pfd.events = POLLIN;
+    int timeout = -1;
+    if (deadline_ms >= 0) {
+      int64_t remain = deadline_ms - now_ms();
+      if (remain <= 0) throw TimeoutError("accept timed out");
+      timeout = static_cast<int>(std::min<int64_t>(remain, 1 << 30));
+    }
+    int prc = ::poll(&pfd, 1, timeout);
+    if (prc == 0) throw TimeoutError("accept timed out");
+    if (prc < 0) {
+      if (errno == EINTR) continue;
+      throw SocketError(std::string("poll: ") + strerror(errno));
+    }
     int fd = ::accept(fd_, nullptr, nullptr);
     if (fd >= 0) {
       set_common_opts(fd);
       set_nonblocking(fd);
       return Socket(fd);
     }
-    // Transient failures (peer aborted in queue, fd pressure) must not stop
-    // the accept loop — only a closed listener should.
-    if (errno == EINTR || errno == ECONNABORTED) continue;
+    // Transient failures (peer vanished from the backlog between poll and
+    // accept, fd pressure) must not stop the loop — only a closed listener
+    // should. The fd is non-blocking, so the retry waits in poll above.
+    if (errno == EINTR || errno == ECONNABORTED || errno == EAGAIN ||
+        errno == EWOULDBLOCK)
+      continue;
     if (errno == EMFILE || errno == ENFILE || errno == ENOBUFS ||
         errno == ENOMEM) {
       struct timespec ts{0, 10 * 1000 * 1000}; // 10ms breather

@@ -76,6 +76,8 @@ class Listener {
   uint16_t port() const { return port_; }
   // Blocks until a connection arrives; returns invalid Socket after close().
   Socket accept();
+  // As accept(), but throws TimeoutError past the deadline (deadline<0 = none).
+  Socket accept(int64_t deadline_ms);
   void close();
 
  private:

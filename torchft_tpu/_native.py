@@ -263,7 +263,7 @@ class Lighthouse:
 
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
-        if handle:
+        if handle and _lib is not None:
             _lib.tft_lighthouse_destroy(handle)
 
     def __enter__(self) -> "Lighthouse":
@@ -322,7 +322,7 @@ class Manager:
 
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
-        if handle:
+        if handle and _lib is not None:
             _lib.tft_manager_destroy(handle)
 
 
@@ -392,7 +392,7 @@ class ManagerClient:
 
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
-        if handle:
+        if handle and _lib is not None:
             _lib.tft_client_destroy(handle)
 
 
@@ -418,7 +418,7 @@ class Store:
 
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
-        if handle:
+        if handle and _lib is not None:
             _lib.tft_store_destroy(handle)
 
 
@@ -486,7 +486,7 @@ class StoreClient:
 
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
-        if handle:
+        if handle and _lib is not None:
             _lib.tft_store_client_destroy(handle)
 
 

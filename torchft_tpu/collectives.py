@@ -1,0 +1,478 @@
+"""Reconfigurable collective communication for cross-replica-group traffic.
+
+Plays the role of the reference's reconfigurable ProcessGroup abstraction
+(reference torchft/process_group.py:109-166): a ``Collectives`` object can be
+``configure()``d onto a new membership every time the quorum changes, using a
+per-quorum store prefix so stale members never cross-talk (reference
+torchft/manager.py:470-477).
+
+TPU-first design: these collectives deliberately run on the HOST, outside
+XLA. Intra-replica-group parallelism (the HSDP "shard" dimension) belongs to
+pjit/``shard_map`` over the slice's ICI mesh and never spans a failure
+domain; only the cross-group gradient average travels through this layer
+(over DCN in production). Because the transport is plain sockets, a dead
+replica group surfaces as an abortable socket error instead of a wedged
+device collective — the property the reference buys with subprocess-isolated
+NCCL ("Baby" process groups, reference torchft/process_group.py:551-1064).
+
+Ops are asynchronous: each returns a :class:`Work` whose result is the
+reduced pytree. A single-thread executor issues ops in submission order (the
+ordering contract collective backends require), and the GIL is released for
+the duration of each native call.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from abc import ABC, abstractmethod
+from concurrent.futures import Future, ThreadPoolExecutor
+from datetime import timedelta
+from enum import IntEnum
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import _native
+from ._native import _check, _lib, _ms
+
+
+class ReduceOp(IntEnum):
+    """Matches tft::ReduceOp in native/src/collectives.h. AVG is SUM followed
+    by a host-side divide (the reference divides in the manager too,
+    torchft/manager.py:279-291)."""
+
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    AVG = 100
+
+
+# Native dtype codes (tft::Dtype). Other dtypes are accumulated in one of
+# these and cast back (bf16/f16 sums in f32 to avoid precision collapse).
+_NATIVE_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+
+
+class Work:
+    """Handle for an async collective; the result is the output pytree.
+
+    Mirrors the role of torch.distributed Work / torch futures in the
+    reference (torchft/process_group.py:318-330).
+    """
+
+    def __init__(self, future: "Future[Any]") -> None:
+        self._future = future
+
+    def wait(self, timeout: Optional[timedelta] = None) -> Any:
+        return self._future.result(
+            timeout=timeout.total_seconds() if timeout is not None else None
+        )
+
+    def result(self, timeout: Optional[timedelta] = None) -> Any:
+        return self.wait(timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def exception(self) -> Optional[BaseException]:
+        return self._future.exception()
+
+    def add_done_callback(self, fn: Callable[["Future[Any]"], None]) -> None:
+        self._future.add_done_callback(fn)
+
+    def then(self, fn: Callable[[Any], Any]) -> "Work":
+        """Returns a Work whose result is fn(result); errors propagate."""
+        out: "Future[Any]" = Future()
+
+        def _chain(f: "Future[Any]") -> None:
+            exc = f.exception()
+            if exc is not None:
+                out.set_exception(exc)
+                return
+            try:
+                out.set_result(fn(f.result()))
+            except Exception as e:  # noqa: BLE001 - propagate into future
+                out.set_exception(e)
+
+        self._future.add_done_callback(_chain)
+        return Work(out)
+
+
+def _completed(value: Any) -> Work:
+    f: "Future[Any]" = Future()
+    f.set_result(value)
+    return Work(f)
+
+
+def _flatten(tree: Any) -> Tuple[List[Any], Any]:
+    """Flatten a pytree without importing jax at module load."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return leaves, treedef
+
+
+def _unflatten(treedef: Any, leaves: Sequence[Any]) -> Any:
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class Collectives(ABC):
+    """Reconfigurable collectives over replica groups.
+
+    Reference interface: torchft/process_group.py:109-166 (configure /
+    allreduce / allgather / broadcast / size).
+    """
+
+    @abstractmethod
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        """(Re)builds the communicator for a new membership. ``store_addr``
+        is ``host:port/prefix`` with a prefix unique to the quorum."""
+
+    @abstractmethod
+    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
+        """Reduces a pytree of arrays across the group; result pytree has the
+        same structure/dtypes. Bit-identical on every rank."""
+
+    @abstractmethod
+    def allgather(self, tree: Any) -> Work:
+        """Gathers each rank's pytree; result is a list of pytrees in rank
+        order (all ranks must pass identical structures and shapes)."""
+
+    @abstractmethod
+    def broadcast(self, tree: Any, root: int = 0) -> Work:
+        """Broadcasts root's pytree to all ranks."""
+
+    @abstractmethod
+    def barrier(self) -> Work:
+        ...
+
+    @abstractmethod
+    def size(self) -> int:
+        ...
+
+    @abstractmethod
+    def rank(self) -> int:
+        ...
+
+    def abort(self) -> None:
+        """Unblocks in-flight ops with an error (safe from any thread)."""
+
+    def shutdown(self) -> None:
+        ...
+
+
+def _declare_hc(lib: ctypes.CDLL) -> None:
+    if getattr(lib, "_hc_declared", False):
+        return
+    lib.tft_hc_create.restype = ctypes.c_void_p
+    lib.tft_hc_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_configure.restype = ctypes.c_int
+    lib.tft_hc_configure.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_allreduce.restype = ctypes.c_int
+    lib.tft_hc_allreduce.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_allgather.restype = ctypes.c_int
+    lib.tft_hc_allgather.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_broadcast.restype = ctypes.c_int
+    lib.tft_hc_broadcast.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_barrier.restype = ctypes.c_int
+    lib.tft_hc_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tft_hc_abort.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_world_size.restype = ctypes.c_int64
+    lib.tft_hc_world_size.argtypes = [ctypes.c_void_p]
+    lib._hc_declared = True
+
+
+def _as_numpy(leaf: Any) -> np.ndarray:
+    """Host copy of a leaf (device→host transfer for jax arrays)."""
+    return np.asarray(leaf)
+
+
+def _is_jax_array(leaf: Any) -> bool:
+    import jax
+
+    return isinstance(leaf, jax.Array)
+
+
+class HostCollectives(Collectives):
+    """Deterministic TCP ring collectives (native C++), the Gloo role.
+
+    One contiguous buffer per dtype group is reduced per op — leaves are
+    packed host-side, so a whole gradient pytree costs a single ring pass
+    per dtype (the bucketing the reference gets from DDP's reducer).
+    """
+
+    def __init__(
+        self,
+        timeout: timedelta = timedelta(seconds=60),
+        connect_timeout: timedelta = timedelta(seconds=60),
+    ) -> None:
+        _declare_hc(_lib)
+        self._handle = _lib.tft_hc_create()
+        self._timeout = timeout
+        self._connect_timeout = connect_timeout
+        self._world_size = 0
+        self._rank = -1
+        # One thread: collectives must issue in submission order.
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="host_collectives"
+        )
+        self._shutdown = False
+
+    # -- lifecycle --
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        # Abort synchronously so a wedged op can't block the executor, then
+        # run the (blocking) rendezvous on the op thread to keep ordering.
+        _lib.tft_hc_abort(self._handle)
+        f = self._executor.submit(
+            lambda: _check(
+                _lib.tft_hc_configure(
+                    self._handle,
+                    store_addr.encode(),
+                    rank,
+                    world_size,
+                    _ms(self._connect_timeout),
+                )
+            )
+        )
+        f.result()
+        self._rank = rank
+        self._world_size = world_size
+
+    def abort(self) -> None:
+        _lib.tft_hc_abort(self._handle)
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        _lib.tft_hc_abort(self._handle)
+        self._executor.shutdown(wait=True)
+
+    def __del__(self) -> None:
+        handle = getattr(self, "_handle", None)
+        if handle and _lib is not None:
+            try:
+                self.shutdown()  # aborts + drains the executor, handle intact
+            except Exception:
+                pass
+            self._handle = None
+            _lib.tft_hc_destroy(handle)
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- ops --
+
+    def _submit(self, fn: Callable[[], Any]) -> Work:
+        if self._shutdown:
+            raise RuntimeError("collectives already shut down")
+        return Work(self._executor.submit(fn))
+
+    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
+        timeout_ms = _ms(self._timeout)
+        return self._submit(lambda: self._allreduce_sync(tree, op, timeout_ms))
+
+    def _allreduce_sync(self, tree: Any, op: ReduceOp, timeout_ms: int) -> Any:
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return tree
+        divisor = self._world_size if op == ReduceOp.AVG else None
+        native_op = int(ReduceOp.SUM if op == ReduceOp.AVG else op)
+
+        arrays = [_as_numpy(l) for l in leaves]
+        was_jax = [_is_jax_array(l) for l in leaves]
+        # Group leaves by accumulation dtype; pack each group into one
+        # contiguous buffer so the ring runs once per dtype.
+        out_arrays: List[Optional[np.ndarray]] = [None] * len(arrays)
+        groups: dict = {}
+        for i, a in enumerate(arrays):
+            acc = a.dtype if a.dtype in _NATIVE_DTYPES else np.dtype(np.float32)
+            groups.setdefault(acc, []).append(i)
+        for acc_dtype, idxs in groups.items():
+            buf = np.concatenate(
+                [arrays[i].astype(acc_dtype, copy=False).ravel() for i in idxs]
+            )
+            _check(
+                _lib.tft_hc_allreduce(
+                    self._handle,
+                    buf.ctypes.data_as(ctypes.c_void_p),
+                    buf.size,
+                    _NATIVE_DTYPES[acc_dtype],
+                    native_op,
+                    timeout_ms,
+                )
+            )
+            if divisor is not None:
+                if np.issubdtype(buf.dtype, np.floating):
+                    buf /= divisor
+                else:
+                    buf //= divisor
+            offset = 0
+            for i in idxs:
+                n = arrays[i].size
+                out_arrays[i] = (
+                    buf[offset : offset + n]
+                    .reshape(arrays[i].shape)
+                    .astype(arrays[i].dtype, copy=False)
+                )
+                offset += n
+        out_leaves: List[Any] = []
+        for i, a in enumerate(out_arrays):
+            if was_jax[i]:
+                import jax.numpy as jnp
+
+                out_leaves.append(jnp.asarray(a))
+            else:
+                out_leaves.append(a)
+        return _unflatten(treedef, out_leaves)
+
+    def allgather(self, tree: Any) -> Work:
+        timeout_ms = _ms(self._timeout)
+        return self._submit(lambda: self._allgather_sync(tree, timeout_ms))
+
+    def _allgather_sync(self, tree: Any, timeout_ms: int) -> List[Any]:
+        leaves, treedef = _flatten(tree)
+        arrays = [np.ascontiguousarray(_as_numpy(l)) for l in leaves]
+        was_jax = [_is_jax_array(l) for l in leaves]
+        packed = b"".join(a.tobytes() for a in arrays)
+        nbytes = len(packed)
+        inbuf = ctypes.create_string_buffer(packed, nbytes) if nbytes else None
+        out = np.empty(max(nbytes * self._world_size, 1), dtype=np.uint8)
+        _check(
+            _lib.tft_hc_allgather(
+                self._handle,
+                inbuf,
+                out.ctypes.data_as(ctypes.c_void_p),
+                nbytes,
+                timeout_ms,
+            )
+        )
+        results: List[Any] = []
+        for r in range(self._world_size):
+            offset = r * nbytes
+            out_leaves: List[Any] = []
+            for i, a in enumerate(arrays):
+                leaf = (
+                    out[offset : offset + a.nbytes]
+                    .view(a.dtype)
+                    .reshape(a.shape)
+                    .copy()
+                )
+                offset += a.nbytes
+                if was_jax[i]:
+                    import jax.numpy as jnp
+
+                    leaf = jnp.asarray(leaf)
+                out_leaves.append(leaf)
+            results.append(_unflatten(treedef, out_leaves))
+        return results
+
+    def broadcast(self, tree: Any, root: int = 0) -> Work:
+        timeout_ms = _ms(self._timeout)
+        return self._submit(lambda: self._broadcast_sync(tree, root, timeout_ms))
+
+    def _broadcast_sync(self, tree: Any, root: int, timeout_ms: int) -> Any:
+        leaves, treedef = _flatten(tree)
+        arrays = [np.ascontiguousarray(_as_numpy(l)) for l in leaves]
+        was_jax = [_is_jax_array(l) for l in leaves]
+        packed = bytearray(b"".join(a.tobytes() for a in arrays))
+        nbytes = len(packed)
+        buf = (ctypes.c_char * nbytes).from_buffer(packed) if nbytes else None
+        _check(_lib.tft_hc_broadcast(self._handle, buf, nbytes, root, timeout_ms))
+        offset = 0
+        out_leaves: List[Any] = []
+        for i, a in enumerate(arrays):
+            size = a.nbytes
+            out = (
+                np.frombuffer(bytes(packed[offset : offset + size]), dtype=a.dtype)
+                .reshape(a.shape)
+                .copy()
+            )
+            offset += size
+            if was_jax[i]:
+                import jax.numpy as jnp
+
+                out = jnp.asarray(out)
+            out_leaves.append(out)
+        return _unflatten(treedef, out_leaves)
+
+    def barrier(self) -> Work:
+        timeout_ms = _ms(self._timeout)
+        return self._submit(
+            lambda: _check(_lib.tft_hc_barrier(self._handle, timeout_ms))
+        )
+
+
+class DummyCollectives(Collectives):
+    """No-op fake for tests and wrapper semantics, the reference's
+    ProcessGroupDummy (torchft/process_group.py:333-384)."""
+
+    def __init__(self, rank: int = 0, world_size: int = 1) -> None:
+        self._rank = rank
+        self._world_size = world_size
+        self.configure_count = 0
+        self.op_count = 0
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.configure_count += 1
+        self._rank = rank
+        self._world_size = world_size
+
+    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
+        self.op_count += 1
+        return _completed(tree)
+
+    def allgather(self, tree: Any) -> Work:
+        self.op_count += 1
+        return _completed([tree] * self._world_size)
+
+    def broadcast(self, tree: Any, root: int = 0) -> Work:
+        self.op_count += 1
+        return _completed(tree)
+
+    def barrier(self) -> Work:
+        self.op_count += 1
+        return _completed(None)
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
