@@ -186,12 +186,7 @@ void HostCollectives::duplex(const char* send_buf, size_t send_len,
       pfds[n].events = POLLIN;
       n++;
     }
-    int timeout = -1;
-    if (deadline_ms >= 0) {
-      int64_t remain = deadline_ms - now_ms();
-      if (remain <= 0) throw TimeoutError("collective timed out");
-      timeout = static_cast<int>(std::min<int64_t>(remain, 1 << 30));
-    }
+    int timeout = poll_timeout_or_throw(deadline_ms, "collective timed out");
     int prc = ::poll(pfds, n, timeout);
     if (prc == 0) throw TimeoutError("collective timed out");
     if (prc < 0) {

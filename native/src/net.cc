@@ -26,6 +26,13 @@ int64_t unix_ms() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(t).count();
 }
 
+int poll_timeout_or_throw(int64_t deadline_ms, const char* what) {
+  if (deadline_ms < 0) return -1;
+  int64_t remain = deadline_ms - now_ms();
+  if (remain <= 0) throw TimeoutError(what);
+  return static_cast<int>(std::min<int64_t>(remain, 1 << 30));
+}
+
 std::string local_hostname() {
   char buf[256];
   if (gethostname(buf, sizeof(buf)) != 0) return "localhost";
@@ -114,12 +121,7 @@ void Socket::wait_ready(bool for_read, int64_t deadline_ms) {
   pfd.fd = fd_;
   pfd.events = for_read ? POLLIN : POLLOUT;
   while (true) {
-    int timeout = -1;
-    if (deadline_ms >= 0) {
-      int64_t remain = deadline_ms - now_ms();
-      if (remain <= 0) throw TimeoutError("socket io timed out");
-      timeout = static_cast<int>(std::min<int64_t>(remain, 1 << 30));
-    }
+    int timeout = poll_timeout_or_throw(deadline_ms, "socket io timed out");
     int rc = ::poll(&pfd, 1, timeout);
     if (rc > 0) return;
     if (rc == 0) throw TimeoutError("socket io timed out");
@@ -261,21 +263,20 @@ Socket Listener::accept() { return accept(-1); }
 
 Socket Listener::accept(int64_t deadline_ms) {
   while (true) {
+    // close() from another thread sets fd_ = -1; poll() would silently skip
+    // a negative fd and sleep the whole timeout, so bail out first.
+    if (fd_ < 0) return Socket();
     struct pollfd pfd;
     pfd.fd = fd_;
     pfd.events = POLLIN;
-    int timeout = -1;
-    if (deadline_ms >= 0) {
-      int64_t remain = deadline_ms - now_ms();
-      if (remain <= 0) throw TimeoutError("accept timed out");
-      timeout = static_cast<int>(std::min<int64_t>(remain, 1 << 30));
-    }
+    int timeout = poll_timeout_or_throw(deadline_ms, "accept timed out");
     int prc = ::poll(&pfd, 1, timeout);
     if (prc == 0) throw TimeoutError("accept timed out");
     if (prc < 0) {
       if (errno == EINTR) continue;
       throw SocketError(std::string("poll: ") + strerror(errno));
     }
+    if (pfd.revents & POLLNVAL) return Socket(); // fd closed under us
     int fd = ::accept(fd_, nullptr, nullptr);
     if (fd >= 0) {
       set_common_opts(fd);

@@ -22,6 +22,10 @@ struct Addr {
   uint16_t port;
 };
 
+// Converts an absolute deadline into a poll() timeout in ms (-1 = none),
+// throwing TimeoutError when the deadline has already passed.
+int poll_timeout_or_throw(int64_t deadline_ms, const char* what);
+
 // Accepts "host:port", "http://host:port", "tft://host:port", "[::]:port".
 // Trailing path components ("host:port/prefix") are rejected; use
 // split_store_addr for store addresses carrying a key prefix.

@@ -1,0 +1,144 @@
+"""Checkpoint transport tests. Mirrors reference checkpointing_test.py:17-105:
+HTTP round-trip, step mismatch -> error, timeout behavior, lock gating."""
+
+import threading
+import urllib.error
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import (
+    CheckpointServer,
+    deserialize_state_dict,
+    serialize_state_dict,
+)
+
+
+@pytest.fixture
+def server():
+    s = CheckpointServer(timeout=timedelta(seconds=10))
+    yield s
+    s.shutdown()
+
+
+def test_roundtrip_pytree(server):
+    state = {
+        "model": {"w": np.arange(12, dtype=np.float32).reshape(3, 4)},
+        "opt": [np.ones(3, np.float64), 7],
+        "step": 42,
+    }
+    server.send_checkpoint([1], step=5, state_dict=state, timeout=timedelta(seconds=5))
+    out = server.recv_checkpoint(
+        src_rank=0, metadata=server.metadata(), step=5, timeout=timedelta(seconds=5)
+    )
+    np.testing.assert_array_equal(out["model"]["w"], state["model"]["w"])
+    np.testing.assert_array_equal(out["opt"][0], state["opt"][0])
+    assert out["opt"][1] == 7 and out["step"] == 42
+
+
+def test_roundtrip_jax_arrays(server):
+    import jax.numpy as jnp
+
+    state = {"w": jnp.arange(8, dtype=jnp.bfloat16)}
+    server.send_checkpoint([1], step=0, state_dict=state, timeout=timedelta(seconds=5))
+    out = server.recv_checkpoint(
+        src_rank=0, metadata=server.metadata(), step=0, timeout=timedelta(seconds=5)
+    )
+    # Received on host as numpy with the dtype preserved.
+    assert out["w"].dtype == jnp.bfloat16.dtype
+    np.testing.assert_array_equal(
+        np.asarray(out["w"], np.float32), np.arange(8, dtype=np.float32)
+    )
+
+
+def test_wrong_step_is_an_error(server):
+    server.send_checkpoint([1], step=3, state_dict={"x": 1}, timeout=timedelta(seconds=5))
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        server.recv_checkpoint(
+            src_rank=0,
+            metadata=server.metadata(),
+            step=4,
+            timeout=timedelta(seconds=5),
+        )
+    assert exc_info.value.code == 400
+
+
+def test_starts_disallowed_and_regates(server):
+    # Before any send_checkpoint, reads block until the server-side timeout.
+    fast = CheckpointServer(timeout=timedelta(milliseconds=100))
+    try:
+        with pytest.raises(Exception):
+            fast.recv_checkpoint(
+                src_rank=0,
+                metadata=fast.metadata(),
+                step=0,
+                timeout=timedelta(seconds=5),
+            )
+        fast.send_checkpoint([1], 1, {"x": 1}, timeout=timedelta(seconds=5))
+        assert (
+            fast.recv_checkpoint(
+                src_rank=0,
+                metadata=fast.metadata(),
+                step=1,
+                timeout=timedelta(seconds=5),
+            )["x"]
+            == 1
+        )
+        # disallow_checkpoint re-locks the gate (manager.py:591 discipline).
+        fast.disallow_checkpoint()
+        with pytest.raises(Exception):
+            fast.recv_checkpoint(
+                src_rank=0,
+                metadata=fast.metadata(),
+                step=1,
+                timeout=timedelta(seconds=5),
+            )
+    finally:
+        fast.shutdown()
+
+
+def test_allow_disallow_idempotent(server):
+    server.disallow_checkpoint()
+    server.disallow_checkpoint()
+    server.allow_checkpoint(1)
+    server.allow_checkpoint(2)
+    out = server.recv_checkpoint(
+        src_rank=0, metadata=server.metadata(), step=2, timeout=timedelta(seconds=5)
+    )
+    assert out is None  # no state dict was ever set
+
+
+def test_concurrent_readers(server):
+    state = {"w": np.ones((256, 256), np.float32)}
+    server.send_checkpoint(
+        [1, 2, 3], step=9, state_dict=state, timeout=timedelta(seconds=5)
+    )
+    results = []
+    errors = []
+
+    def fetch():
+        try:
+            results.append(
+                server.recv_checkpoint(
+                    0, server.metadata(), 9, timeout=timedelta(seconds=10)
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=fetch) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(results) == 4
+    for r in results:
+        np.testing.assert_array_equal(r["w"], state["w"])
+
+
+def test_serialize_handles_scalars_and_none():
+    tree = {"a": None, "b": 3.5, "c": [np.int64(2), "s"]}
+    out = deserialize_state_dict(serialize_state_dict(tree))
+    assert out == tree

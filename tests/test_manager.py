@@ -1,0 +1,391 @@
+"""Manager state-machine unit tests with a mocked ManagerClient.
+
+Mirrors the reference's dominant pattern (reference manager_test.py:131-581):
+the native client is patched wholesale, QuorumResult objects are fabricated
+field by field, and the collectives are fakes — so quorum transitions,
+healing, error latching, FIXED_WITH_SPARES numerics and commit votes are
+tested without any network or lighthouse.
+"""
+
+from concurrent.futures import Future
+from datetime import timedelta
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu._native import QuorumResult, Store, StoreClient
+from torchft_tpu.collectives import DummyCollectives, ReduceOp, Work
+from torchft_tpu.manager import (
+    MANAGER_ADDR_KEY,
+    REPLICA_ID_KEY,
+    Manager,
+    WorldSizeMode,
+)
+
+
+class FailingCollectives(DummyCollectives):
+    """Allreduce resolves (or raises) with an error."""
+
+    def __init__(self, immediate: bool, **kwargs) -> None:
+        super().__init__(**kwargs)
+        self._immediate = immediate
+
+    def allreduce(self, tree, op=ReduceOp.SUM) -> Work:
+        self.op_count += 1
+        if self._immediate:
+            raise RuntimeError("injected immediate failure")
+        f: Future = Future()
+        f.set_exception(RuntimeError("injected async failure"))
+        return Work(f)
+
+
+def _quorum_result(**overrides) -> QuorumResult:
+    defaults = dict(
+        quorum_id=1,
+        replica_rank=0,
+        replica_world_size=2,
+        recover_src_manager_address="",
+        recover_src_rank=None,
+        recover_dst_ranks=[],
+        store_address="localhost:0",
+        max_step=0,
+        max_rank=0,
+        max_world_size=2,
+        heal=False,
+    )
+    defaults.update(overrides)
+    return QuorumResult(**defaults)
+
+
+@pytest.fixture(autouse=True)
+def mock_manager_client():
+    # Patch for the whole test: the healing path constructs a second
+    # ManagerClient for the recovery peer from inside the quorum thread.
+    with patch("torchft_tpu.manager.ManagerClient") as cls:
+        yield cls
+
+
+@pytest.fixture
+def store():
+    s = Store()
+    client = StoreClient(s.address())
+    client.set(MANAGER_ADDR_KEY, b"mock://manager")
+    client.set(REPLICA_ID_KEY, b"testrep")
+    yield s
+    s.shutdown()
+
+
+def _create_manager(
+    store,
+    use_async_quorum: bool = True,
+    min_replica_size: int = 2,
+    world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+    collectives=None,
+    timeout: timedelta = timedelta(seconds=10),
+    load_state_dict=None,
+    state_dict=None,
+    transport=None,
+):
+    collectives = collectives if collectives is not None else DummyCollectives()
+    transport = transport if transport is not None else MagicMock()
+    if not isinstance(transport, MagicMock):
+        pass
+    else:
+        transport.metadata.return_value = "transport:meta"
+    import torchft_tpu.manager as manager_mod
+
+    client = manager_mod.ManagerClient.return_value  # the active patch
+    manager = Manager(
+        collectives=collectives,
+        load_state_dict=load_state_dict,
+        state_dict=state_dict,
+        min_replica_size=min_replica_size,
+        use_async_quorum=use_async_quorum,
+        world_size_mode=world_size_mode,
+        timeout=timeout,
+        rank=1,  # not group rank 0: no native server is spawned
+        world_size=2,
+        store_addr=store.address(),
+        checkpoint_transport=transport,
+    )
+    return manager, client, collectives, transport
+
+
+class TestManagerState:
+    def test_state_dict_roundtrip(self, store):
+        m, _, _, _ = _create_manager(store)
+        assert m.state_dict() == {"step": 0, "batches_committed": 0}
+        m.load_state_dict({"step": 1234, "batches_committed": 2345})
+        assert m.current_step() == 1234
+        assert m.batches_committed() == 2345
+        m.shutdown()
+
+    def test_replica_id_comes_from_store(self, store):
+        m, _, _, _ = _create_manager(store)
+        assert m._replica_id == "testrep"
+        m.shutdown()
+
+
+class TestQuorumHappyPath:
+    def test_step_commit_increments(self, store):
+        m, client, col, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = True
+
+        m.start_quorum()
+        grads = {"w": np.full(4, 6.0, np.float32)}
+        out = m.allreduce(grads).wait()
+        # Dummy collectives return input; AVG divides by num_participants=2.
+        np.testing.assert_array_equal(out["w"], np.full(4, 3.0))
+        assert m.should_commit()
+        assert m.current_step() == 1
+        assert m.batches_committed() == 2
+        # local vote was True
+        assert client.should_commit.call_args.args[2] is True
+        m.shutdown()
+
+    def test_collectives_reconfigured_only_on_quorum_change(self, store):
+        m, client, col, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result(quorum_id=7)
+        client.should_commit.return_value = True
+        m.start_quorum()
+        m.wait_quorum()
+        assert col.configure_count == 1
+        assert m.should_commit()
+
+        m.start_quorum()  # same quorum id: no reconfigure
+        m.wait_quorum()
+        assert col.configure_count == 1
+
+        client.quorum.return_value = _quorum_result(quorum_id=8)
+        m.start_quorum()
+        m.wait_quorum()
+        assert col.configure_count == 2
+        m.shutdown()
+
+    def test_quorum_uses_step_and_metadata(self, store):
+        m, client, _, transport = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = True
+        m.load_state_dict({"step": 5, "batches_committed": 10})
+        m.start_quorum()
+        m.wait_quorum()
+        kwargs = client.quorum.call_args.kwargs
+        assert kwargs["rank"] == 1
+        assert kwargs["step"] == 5
+        assert kwargs["checkpoint_metadata"] == "transport:meta"
+        m.shutdown()
+
+
+class TestHealing:
+    def test_sync_quorum_heals_eagerly(self, store):
+        loaded = {}
+        m, client, _, transport = _create_manager(
+            store,
+            use_async_quorum=False,
+            load_state_dict=lambda sd: loaded.update(sd),
+        )
+        client.quorum.return_value = _quorum_result(
+            quorum_id=2,
+            replica_rank=1,
+            heal=True,
+            max_step=20,
+            max_rank=None,
+            recover_src_manager_address="mock://peer",
+            recover_src_rank=0,
+        )
+        client.checkpoint_metadata.return_value = "peer:meta"
+        transport.recv_checkpoint.return_value = {
+            "user": {"model": "weights"},
+            "torchft": {"step": 20, "batches_committed": 40},
+        }
+        client.should_commit.return_value = True
+
+        m.start_quorum()  # sync: heal completes before returning
+        assert m.current_step() == 20
+        assert loaded == {"model": "weights"}
+        # Sync-mode healing participates in the step (replica cohort).
+        assert m.is_participating()
+        assert m.participating_rank() == 1
+        m.shutdown()
+
+    def test_async_quorum_healing_sits_out(self, store):
+        loaded = {}
+        m, client, col, transport = _create_manager(
+            store,
+            use_async_quorum=True,
+            min_replica_size=1,
+            load_state_dict=lambda sd: loaded.update(sd),
+        )
+        client.quorum.return_value = _quorum_result(
+            quorum_id=2,
+            replica_rank=1,
+            replica_world_size=2,
+            heal=True,
+            max_step=20,
+            max_rank=None,  # not in the max-step cohort
+            max_world_size=1,
+            recover_src_manager_address="mock://peer",
+            recover_src_rank=0,
+        )
+        client.checkpoint_metadata.return_value = "peer:meta"
+        transport.recv_checkpoint.return_value = {
+            "user": {"model": "w"},
+            "torchft": {"step": 20, "batches_committed": 40},
+        }
+        client.should_commit.return_value = True
+
+        m.start_quorum()
+        grads = {"g": np.full(3, 8.0, np.float32)}
+        out = m.allreduce(grads).wait()
+        # Healing: contribution zeroed, divided by max-step cohort size (1).
+        np.testing.assert_array_equal(out["g"], np.zeros(3))
+        assert not m.is_participating()
+        assert m.num_participants() == 1
+
+        # User state dict applied at the should_commit safe point.
+        assert loaded == {}
+        assert m.should_commit()
+        assert loaded == {"model": "w"}
+        assert m.current_step() == 21
+        m.shutdown()
+
+    def test_recovery_source_sends_checkpoint(self, store):
+        m, client, _, transport = _create_manager(
+            store, state_dict=lambda: {"model": "mine"}
+        )
+        client.quorum.return_value = _quorum_result(
+            quorum_id=3, recover_dst_ranks=[2], max_step=7
+        )
+        client.should_commit.return_value = True
+        m.start_quorum()
+        m.wait_quorum()
+        call = transport.send_checkpoint.call_args.kwargs
+        assert call["dst_ranks"] == [2]
+        assert call["step"] == 7
+        assert call["state_dict"]["user"] == {"model": "mine"}
+        assert call["state_dict"]["torchft"] == {
+            "step": 0,
+            "batches_committed": 0,
+        }
+        m.shutdown()
+
+
+class TestErrorHandling:
+    def test_immediate_allreduce_failure_latches(self, store):
+        col = FailingCollectives(immediate=True)
+        m, client, _, _ = _create_manager(store, collectives=col)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = False
+        m.start_quorum()
+        grads = {"g": np.ones(2, np.float32)}
+        out = m.allreduce(grads).wait()
+        np.testing.assert_array_equal(out["g"], np.ones(2))  # input unchanged
+        assert m.errored() is not None
+        assert not m.should_commit()
+        assert client.should_commit.call_args.args[2] is False
+        assert m.current_step() == 0
+        m.shutdown()
+
+    def test_async_allreduce_failure_swallowed_and_latched(self, store):
+        col = FailingCollectives(immediate=False)
+        m, client, _, _ = _create_manager(store, collectives=col)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = False
+        m.start_quorum()
+        grads = {"g": np.full(2, 5.0, np.float32)}
+        out = m.allreduce(grads).wait()
+        # Default = the (participating, so unzeroed) input tree.
+        np.testing.assert_array_equal(out["g"], np.full(2, 5.0))
+        assert m.errored() is not None
+        assert not m.should_commit()
+        m.shutdown()
+
+    def test_errored_allreduce_is_noop(self, store):
+        m, client, col, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        m.start_quorum()
+        m.report_error(RuntimeError("user error"))
+        out = m.allreduce({"g": np.ones(1)}).wait()
+        np.testing.assert_array_equal(out["g"], np.ones(1))
+        assert col.op_count == 0  # never reached the collectives
+        m.shutdown()
+
+    def test_error_cleared_by_next_quorum(self, store):
+        m, client, _, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = True
+        m.start_quorum()
+        m.report_error(RuntimeError("boom"))
+        m.should_commit()
+        # Local vote was False while errored...
+        assert client.should_commit.call_args.args[2] is False
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.errored() is None
+        m.should_commit()
+        # ...and True again after the next quorum cleared the error.
+        assert client.should_commit.call_args.args[2] is True
+        m.shutdown()
+
+    def test_wrap_work_timeout_returns_default(self, store):
+        m, client, _, _ = _create_manager(
+            store, timeout=timedelta(milliseconds=100)
+        )
+        client.quorum.return_value = _quorum_result()
+        m.start_quorum()
+        never: Future = Future()
+        out = m.wrap_work(Work(never), default="fallback").wait(
+            timeout=timedelta(seconds=5)
+        )
+        assert out == "fallback"
+        assert isinstance(m.errored(), TimeoutError)
+        m.shutdown()
+
+
+class TestWorldSizeModes:
+    def test_fixed_with_spares_clamps(self, store):
+        m, client, _, _ = _create_manager(
+            store,
+            min_replica_size=2,
+            world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+        )
+        # 3 live replicas, we are the spare (max_rank=2 >= min_replica_size)
+        client.quorum.return_value = _quorum_result(
+            replica_rank=2, replica_world_size=3, max_rank=2, max_world_size=3
+        )
+        client.should_commit.return_value = True
+        m.start_quorum()
+        assert m.num_participants() == 2  # fixed divisor
+        assert not m.is_participating()  # spare
+        out = m.allreduce({"g": np.full(2, 4.0, np.float32)}).wait()
+        np.testing.assert_array_equal(out["g"], np.zeros(2))  # zeroed, /2
+        m.shutdown()
+
+    def test_fixed_with_spares_participant(self, store):
+        m, client, _, _ = _create_manager(
+            store,
+            min_replica_size=2,
+            world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+        )
+        client.quorum.return_value = _quorum_result(
+            replica_rank=1, replica_world_size=3, max_rank=1, max_world_size=3
+        )
+        m.start_quorum()
+        assert m.num_participants() == 2
+        assert m.is_participating()
+        m.shutdown()
+
+
+class TestMinReplicaVote:
+    def test_below_min_votes_false(self, store):
+        m, client, _, _ = _create_manager(store, min_replica_size=2)
+        client.quorum.return_value = _quorum_result(
+            replica_world_size=1, max_world_size=1
+        )
+        client.should_commit.return_value = False
+        m.start_quorum()
+        assert not m.should_commit()
+        assert client.should_commit.call_args.args[2] is False
+        m.shutdown()

@@ -1,0 +1,292 @@
+"""End-to-end integration: replica groups as threads, real lighthouse +
+managers + host collectives, fault injection, recovery.
+
+Mirrors the reference harness (reference manager_integ_test.py): each replica
+group is a thread with its own Store and Manager against one in-process
+Lighthouse; ``FailureInjector.fail_at(rank, step)`` raises inside the train
+loop; ``Runner.run_replica`` catches it and re-enters (simulating
+torchelastic restart, manager_integ_test.py:113-126). Correctness oracle:
+after recovery all replicas' state dicts are **bit-identical**
+(manager_integ_test.py:279-282).
+"""
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu import (
+    FTTrainState,
+    HostCollectives,
+    Lighthouse,
+    Manager,
+    OptimizerWrapper,
+    Store,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+class FailureInjector:
+    """Raises at a (replica, step) once. Reference manager_integ_test.py:43-61."""
+
+    def __init__(self) -> None:
+        self._failures: Set[Tuple[int, int]] = set()
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def fail_at(self, replica: int, step: int) -> "FailureInjector":
+        with self._lock:
+            self._failures.add((replica, step))
+        return self
+
+    def check(self, replica: int, step: int) -> None:
+        with self._lock:
+            if (replica, step) in self._failures:
+                self._failures.remove((replica, step))
+                self.count += 1
+                logger.info(f"injecting failure replica={replica} step={step}")
+                raise InjectedFailure(f"injected at {replica=} {step=}")
+
+
+def _init_state(seed: int = 42):
+    """Tiny deterministic MLP + SGD state; identical on every replica."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    params = {
+        "w1": jax.random.normal(k1, (4, 8), jnp.float32) * 0.1,
+        "b1": jnp.zeros((8,), jnp.float32),
+        "w2": jax.random.normal(k2, (8, 2), jnp.float32) * 0.1,
+        "b2": jnp.zeros((2,), jnp.float32),
+    }
+    return params
+
+
+def _loss_fn(params, x, y):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    return jnp.mean((logits - y) ** 2)
+
+
+_grad_fn = jax.jit(jax.grad(_loss_fn))
+
+
+def _batch(step: int):
+    """Deterministic per-step batch, identical across replicas (pure DP over
+    identical data keeps the oracle simple, like the reference's all-ones
+    inputs)."""
+    rng = np.random.default_rng(1000 + step)
+    x = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((8, 2)).astype(np.float32))
+    return x, y
+
+
+@dataclass
+class Runner:
+    """One replica group (single rank). Reference manager_integ_test.py:64-126."""
+
+    replica_id: int
+    lighthouse_address: str
+    failure_injector: FailureInjector
+    num_steps: int = 5
+    use_async_quorum: bool = True
+    attempts: int = 3
+
+    def run_replica(self) -> Dict[str, Any]:
+        for attempt in range(self.attempts):
+            try:
+                return self._train_loop()
+            except InjectedFailure:
+                logger.info(
+                    f"replica {self.replica_id} died (attempt {attempt}); "
+                    "restarting"
+                )
+                continue
+        raise RuntimeError(f"replica {self.replica_id} exhausted attempts")
+
+    def _train_loop(self) -> Dict[str, Any]:
+        store = Store()
+        collectives = HostCollectives(timeout=timedelta(seconds=10))
+        state = FTTrainState(_init_state(), optax.sgd(0.1))
+
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=state.load_state_dict,
+            state_dict=state.state_dict,
+            min_replica_size=1,
+            use_async_quorum=self.use_async_quorum,
+            timeout=timedelta(seconds=10),
+            quorum_timeout=timedelta(seconds=10),
+            connect_timeout=timedelta(seconds=10),
+            rank=0,
+            world_size=1,
+            store_addr=store.address(),
+            lighthouse_addr=self.lighthouse_address,
+            replica_id=f"replica_{self.replica_id}",
+        )
+        optimizer = OptimizerWrapper(manager, state)
+        try:
+            while manager.current_step() < self.num_steps:
+                self.failure_injector.check(
+                    self.replica_id, manager.current_step()
+                )
+                optimizer.zero_grad()  # start_quorum
+                x, y = _batch(manager.current_step())
+                grads = _grad_fn(state.params, x, y)
+                avg_grads = manager.allreduce(grads).wait()
+                optimizer.step(avg_grads)
+            return {
+                "replica_id": self.replica_id,
+                "state_dict": jax.tree_util.tree_map(
+                    np.asarray, state.state_dict()
+                ),
+                "manager_state": manager.state_dict(),
+            }
+        finally:
+            manager.shutdown()
+            collectives.shutdown()
+            store.shutdown()
+
+
+def _run_replicas(
+    num_replicas: int,
+    num_steps: int,
+    injectors: Optional[List[FailureInjector]] = None,
+    use_async_quorum: bool = True,
+    min_replicas_lighthouse: int = 1,
+) -> List[Dict[str, Any]]:
+    lighthouse = Lighthouse(
+        bind="[::]:0",
+        min_replicas=min_replicas_lighthouse,
+        join_timeout_ms=200,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=1000,
+    )
+    injectors = injectors or [FailureInjector() for _ in range(num_replicas)]
+    try:
+        with ThreadPoolExecutor(max_workers=num_replicas) as ex:
+            futures = [
+                ex.submit(
+                    Runner(
+                        replica_id=i,
+                        lighthouse_address=lighthouse.address(),
+                        failure_injector=injectors[i],
+                        num_steps=num_steps,
+                        use_async_quorum=use_async_quorum,
+                    ).run_replica
+                )
+                for i in range(num_replicas)
+            ]
+            return [f.result(timeout=120) for f in futures]
+    finally:
+        lighthouse.shutdown()
+
+
+def _assert_bitwise_identical(results: List[Dict[str, Any]]) -> None:
+    ref = results[0]["state_dict"]
+    for other in results[1:]:
+        leaves_a, td_a = jax.tree_util.tree_flatten(ref)
+        leaves_b, td_b = jax.tree_util.tree_flatten(other["state_dict"])
+        assert td_a == td_b
+        for a, b in zip(leaves_a, leaves_b):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), (
+                "state dicts diverged"
+            )
+
+
+class TestManagerInteg:
+    def test_happy_path_two_replicas(self):
+        results = _run_replicas(num_replicas=2, num_steps=5)
+        assert len(results) == 2
+        for r in results:
+            assert r["manager_state"]["step"] == 5
+        _assert_bitwise_identical(results)
+
+    def test_ddp_recovery_async(self):
+        injectors = [FailureInjector(), FailureInjector().fail_at(1, 2)]
+        results = _run_replicas(
+            num_replicas=2, num_steps=6, injectors=injectors
+        )
+        assert injectors[1].count == 1
+        for r in results:
+            assert r["manager_state"]["step"] == 6
+        _assert_bitwise_identical(results)
+
+    def test_ddp_recovery_sync_quorum(self):
+        injectors = [FailureInjector(), FailureInjector().fail_at(1, 2)]
+        results = _run_replicas(
+            num_replicas=2,
+            num_steps=6,
+            injectors=injectors,
+            use_async_quorum=False,
+        )
+        assert injectors[1].count == 1
+        _assert_bitwise_identical(results)
+
+    def test_ddp_recovery_multiple_failures(self):
+        injectors = [
+            FailureInjector().fail_at(0, 4),
+            FailureInjector().fail_at(1, 2),
+        ]
+        results = _run_replicas(
+            num_replicas=2, num_steps=7, injectors=injectors
+        )
+        assert injectors[0].count == 1
+        assert injectors[1].count == 1
+        _assert_bitwise_identical(results)
+
+    def test_three_replicas_one_death(self):
+        injectors = [
+            FailureInjector(),
+            FailureInjector(),
+            FailureInjector().fail_at(2, 1),
+        ]
+        results = _run_replicas(
+            num_replicas=3, num_steps=5, injectors=injectors
+        )
+        _assert_bitwise_identical(results)
+
+    def test_quorum_timeout_fast_fail(self):
+        # A quorum that cannot complete (min_replicas=2, one participant)
+        # must fail fast with TimeoutError, not hang
+        # (reference manager_integ_test.py:356-368).
+        import time
+
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=2, join_timeout_ms=60000
+        )
+        store = Store()
+        collectives = HostCollectives()
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=lambda sd: None,
+            state_dict=lambda: {},
+            min_replica_size=2,
+            rank=0,
+            world_size=1,
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="lonely",
+            use_async_quorum=False,
+        )
+        try:
+            start = time.monotonic()
+            with pytest.raises(TimeoutError):
+                manager.start_quorum(timeout=timedelta(milliseconds=250))
+            assert time.monotonic() - start < 2.0
+        finally:
+            manager.shutdown()
+            collectives.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()

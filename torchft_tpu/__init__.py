@@ -1,0 +1,52 @@
+"""torchft_tpu: per-step fault tolerance for TPU (JAX/XLA) training.
+
+A TPU-native framework with the capabilities of torchft (reference
+torchft/__init__.py:13-20): replicated training keeps making progress when
+replica groups (TPU slices) die or rejoin — membership is recomputed at
+training-step granularity, recovering replicas fetch live weights from a
+healthy peer, and every step ends in a distributed commit vote.
+"""
+
+from torchft_tpu._native import (
+    Lighthouse,
+    ManagerClient,
+    QuorumResult,
+    Store,
+    StoreClient,
+)
+from torchft_tpu.checkpointing import CheckpointServer, CheckpointTransport
+from torchft_tpu.collectives import (
+    Collectives,
+    DummyCollectives,
+    HostCollectives,
+    ReduceOp,
+    Work,
+)
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.ddp import DistributedDataParallel
+from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.optim import OptimizerWrapper as Optimizer
+from torchft_tpu.optim import OptimizerWrapper
+from torchft_tpu.train_state import FTTrainState
+
+__all__ = [
+    "CheckpointServer",
+    "CheckpointTransport",
+    "Collectives",
+    "DistributedDataParallel",
+    "DistributedSampler",
+    "DummyCollectives",
+    "HostCollectives",
+    "Lighthouse",
+    "FTTrainState",
+    "Manager",
+    "ManagerClient",
+    "Optimizer",
+    "OptimizerWrapper",
+    "QuorumResult",
+    "ReduceOp",
+    "Store",
+    "StoreClient",
+    "Work",
+    "WorldSizeMode",
+]

@@ -1,0 +1,229 @@
+"""Live checkpoint transport: recovering replicas fetch weights from a healthy
+peer over HTTP instead of from disk.
+
+Reference: torchft/checkpointing.py (CheckpointTransport ABC :34-88,
+CheckpointServer :110-270). The lock-gating discipline is identical: the
+server starts *disallowed*; ``send_checkpoint`` publishes a state dict for
+exactly one step and allows reads; ``disallow_checkpoint`` (called from
+``Manager.should_commit``, reference manager.py:591) re-locks it so the dict
+can never be read mid-mutation. A request for any other step gets a 400.
+
+Serialization is pytree-native: leaves are pulled to host (numpy) and the
+whole tree is pickled. jax arrays are reconstructed as numpy on the receiver;
+the caller decides device placement/sharding (``jax.device_put``) — the
+transport never touches devices.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+import socket
+import threading
+import urllib.error
+import urllib.request
+from abc import ABC, abstractmethod
+from datetime import timedelta
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Generic, List, TypeVar
+
+import numpy as np
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class CheckpointTransport(Generic[T], ABC):
+    """Pluggable live-recovery transport. Reference checkpointing.py:34-88."""
+
+    @abstractmethod
+    def metadata(self) -> str:
+        """Returns transport metadata (e.g. the URL prefix) that recovering
+        replicas need; shipped to peers through the quorum RPC."""
+
+    @abstractmethod
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: timedelta
+    ) -> None:
+        """Makes ``state_dict`` for ``step`` available to ``dst_ranks``."""
+
+    def disallow_checkpoint(self) -> None:
+        """Called once the training loop may mutate the state dict again."""
+
+    @abstractmethod
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: timedelta
+    ) -> T:
+        """Fetches the state dict for ``step`` from the peer described by
+        ``metadata``."""
+
+    def shutdown(self, wait: bool = True) -> None:
+        ...
+
+
+def _to_host(tree: Any) -> Any:
+    """Device→host: every array leaf becomes numpy (zero-copy where possible)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: np.asarray(l) if hasattr(l, "__array__") else l, tree
+    )
+
+
+def serialize_state_dict(state_dict: Any) -> bytes:
+    """Pickles a pytree with all array leaves on host."""
+    buf = io.BytesIO()
+    pickle.dump(_to_host(state_dict), buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def deserialize_state_dict(raw: bytes) -> Any:
+    """Inverse of :func:`serialize_state_dict`. Array leaves come back as
+    numpy; only exchange checkpoints with trusted peers (pickle, like the
+    reference's ``torch.load(weights_only=False)``, checkpointing.py:203)."""
+    return pickle.loads(raw)
+
+
+class _TimedAcquire:
+    """Lock acquire with timeout that raises instead of returning False.
+    Reference checkpointing.py:91-107."""
+
+    def __init__(self, lock: threading.Lock, timeout: timedelta) -> None:
+        self._lock = lock
+        self._timeout = timeout
+
+    def __enter__(self) -> None:
+        if not self._lock.acquire(timeout=self._timeout.total_seconds()):
+            raise TimeoutError(
+                f"timed out acquiring checkpoint lock after {self._timeout}"
+            )
+
+    def __exit__(self, *exc: object) -> None:
+        self._lock.release()
+
+
+class CheckpointServer(CheckpointTransport[T]):
+    """Threaded HTTP server streaming ``GET /checkpoint/{step}``.
+
+    Reference checkpointing.py:110-270. The server starts in the *disallowed*
+    state: requests block on the gate lock until ``send_checkpoint``
+    publishes a dict, and re-block after ``disallow_checkpoint``.
+    """
+
+    def __init__(self, timeout: timedelta = timedelta(seconds=30)) -> None:
+        self._checkpoint_lock = threading.Lock()
+        self._disallowed = False
+        self._step = -1
+        self._timeout = timeout
+        self._state_dict: Any = None
+
+        # Gate starts held: nothing readable until the first send_checkpoint.
+        self.disallow_checkpoint()
+
+        ckpt_server = self
+
+        class RequestHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self) -> None:
+                try:
+                    with _TimedAcquire(
+                        ckpt_server._checkpoint_lock, ckpt_server._timeout
+                    ):
+                        step = ckpt_server._step
+                        prefix = "/checkpoint/"
+                        if not self.path.startswith(prefix):
+                            self.send_error(404, "unknown path")
+                            return
+                        requested = int(self.path[len(prefix) :])
+                        if requested != step:
+                            self.send_error(
+                                400,
+                                f"invalid checkpoint requested: serving {step} "
+                                f"but got {requested}",
+                            )
+                            return
+                        payload = serialize_state_dict(ckpt_server._state_dict)
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "application/octet-stream"
+                        )
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                except Exception as e:  # noqa: BLE001 - report to the peer
+                    logger.exception("checkpoint server error")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:
+                        pass
+
+            def log_message(self, format: str, *args: object) -> None:
+                logger.debug(f"checkpoint server: {format % args}")
+
+        class _Server(ThreadingHTTPServer):
+            address_family = socket.AF_INET6
+            request_queue_size = 1024
+            daemon_threads = True
+
+        self._server = _Server(("::", 0), RequestHandler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name="checkpoint_server",
+        )
+        self._thread.start()
+
+    @classmethod
+    def load_from_address(cls, address: str, timeout: timedelta) -> T:
+        """Fetches a checkpoint from a step-qualified URL.
+        Reference checkpointing.py:187-203."""
+        logger.info(f"fetching checkpoint from {address}")
+        with urllib.request.urlopen(
+            address, timeout=timeout.total_seconds()
+        ) as f:
+            data = f.read()
+        return deserialize_state_dict(data)
+
+    def address(self) -> str:
+        """URL prefix of this server; append the step to fetch."""
+        port = self._server.socket.getsockname()[1]
+        return f"http://{socket.gethostname()}:{port}/checkpoint/"
+
+    def allow_checkpoint(self, step: int) -> None:
+        """Publishes ``step``; unblocks readers. Reference :246-254."""
+        self._step = step
+        if self._disallowed:
+            self._disallowed = False
+            self._checkpoint_lock.release()
+
+    def disallow_checkpoint(self) -> None:
+        """Re-locks the gate so the dict can be mutated. Reference :256-259."""
+        if not self._disallowed:
+            self._disallowed = True
+            self._checkpoint_lock.acquire()
+
+    # -- CheckpointTransport --
+
+    def metadata(self) -> str:
+        return self.address()
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: timedelta
+    ) -> None:
+        self._state_dict = state_dict
+        self.allow_checkpoint(step)
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: timedelta
+    ) -> T:
+        return self.load_from_address(f"{metadata}{step}", timeout)
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Stops serving. Requests in flight hold the gate lock until done."""
+        self._server.shutdown()
+        if wait:
+            self._thread.join()
+        self._server.server_close()

@@ -257,8 +257,9 @@ class HostCollectives(Collectives):
         # Abort synchronously so a wedged op can't block the executor, then
         # run the (blocking) rendezvous on the op thread to keep ordering.
         _lib.tft_hc_abort(self._handle)
-        f = self._executor.submit(
-            lambda: _check(
+
+        def do_configure() -> None:
+            _check(
                 _lib.tft_hc_configure(
                     self._handle,
                     store_addr.encode(),
@@ -267,10 +268,12 @@ class HostCollectives(Collectives):
                     _ms(self._connect_timeout),
                 )
             )
-        )
-        f.result()
-        self._rank = rank
-        self._world_size = world_size
+            # Assign on the op thread: ops queued after this configure see
+            # the new size, earlier ones the old — never a mix.
+            self._rank = rank
+            self._world_size = world_size
+
+        self._executor.submit(do_configure).result()
 
     def abort(self) -> None:
         _lib.tft_hc_abort(self._handle)
@@ -417,11 +420,12 @@ class HostCollectives(Collectives):
         buf = (ctypes.c_char * nbytes).from_buffer(packed) if nbytes else None
         _check(_lib.tft_hc_broadcast(self._handle, buf, nbytes, root, timeout_ms))
         offset = 0
+        view = memoryview(packed)
         out_leaves: List[Any] = []
         for i, a in enumerate(arrays):
             size = a.nbytes
             out = (
-                np.frombuffer(bytes(packed[offset : offset + size]), dtype=a.dtype)
+                np.frombuffer(view[offset : offset + size], dtype=a.dtype)
                 .reshape(a.shape)
                 .copy()
             )

@@ -1,0 +1,76 @@
+"""Data sharding across replica groups and ranks.
+
+Reference: torchft/data.py — a DistributedSampler sharding by
+``global_rank = rank + num_replicas * replica_group`` over
+``num_replicas * num_replica_groups`` shards (data.py:46-77). Like the
+reference, this is documented-lossy under faults: when a replica group dies
+and rejoins, it resumes from its own dataloader position; exactly-once data
+visitation is out of scope (reference data.py:33-36).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Yields dataset indices for this (replica_group, rank)'s shard.
+
+    Args:
+        dataset_len: total number of examples.
+        replica_group: which fault-tolerance replica group this is.
+        num_replica_groups: total replica groups.
+        rank: rank within the replica group (0 for pure DP).
+        num_replicas: ranks per replica group.
+        shuffle: reshuffle each epoch (seeded, identical on all shards).
+        seed: base RNG seed shared by every shard.
+    """
+
+    def __init__(
+        self,
+        dataset_len: int,
+        replica_group: int,
+        num_replica_groups: int,
+        rank: int = 0,
+        num_replicas: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ) -> None:
+        self._dataset_len = dataset_len
+        # Reference data.py:46-77: one flat shard space over all ranks of
+        # all replica groups.
+        self.global_rank = rank + num_replicas * replica_group
+        self.global_world_size = num_replicas * num_replica_groups
+        self._shuffle = shuffle
+        self._seed = seed
+        self._drop_last = drop_last
+        self._epoch = 0
+        if drop_last:
+            self.num_samples = dataset_len // self.global_world_size
+        else:
+            self.num_samples = -(-dataset_len // self.global_world_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            order = rng.permutation(self._dataset_len)
+        else:
+            order = np.arange(self._dataset_len)
+        if not self._drop_last:
+            # Pad to a multiple of the world size by wrapping, so every
+            # shard has the same length (torch DistributedSampler semantics).
+            pad = self.num_samples * self.global_world_size - len(order)
+            if pad > 0:
+                order = np.concatenate([order, order[:pad]])
+        else:
+            order = order[: self.num_samples * self.global_world_size]
+        yield from order[self.global_rank :: self.global_world_size].tolist()

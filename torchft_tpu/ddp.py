@@ -1,0 +1,56 @@
+"""Fault-tolerant data parallelism across replica groups.
+
+Reference: torchft/ddp.py — there, a comm-hook routes each gradient bucket
+through ``Manager.allreduce`` during backward. JAX has no backward hooks;
+gradients materialize as one pytree from ``jax.grad``, which is *better* for
+this transport: the whole tree is packed into one ring pass per dtype by the
+collectives layer (the bucketing DDP's reducer approximates).
+
+Intra-replica-group sharding (FSDP/TP-style) stays in user pjit code over
+the slice mesh — this wrapper only averages across groups, mirroring the
+reference's division of labor (torchft owns the replicate dim only,
+process_group.py:1067-1341).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+from .collectives import Work
+from .manager import Manager
+
+
+class DistributedDataParallel:
+    """Averages gradient pytrees across replica groups, fault-tolerantly.
+
+    Usage::
+
+        ddp = DistributedDataParallel(manager)
+        grads = grad_fn(params, batch)
+        grads = ddp.allreduce_grads(grads).wait()   # async; overlap-friendly
+
+    or wrap a grad function so the average happens on call::
+
+        value_and_avg_grads = ddp.wrap_grad_fn(jax.value_and_grad(loss_fn))
+    """
+
+    def __init__(self, manager: Manager) -> None:
+        self._manager = manager
+
+    def allreduce_grads(self, grads: Any) -> Work:
+        """Starts the async cross-group average of ``grads``; the Work
+        resolves to the averaged pytree (input unchanged on error, with the
+        error latched for ``should_commit`` — reference ddp.py:67-71)."""
+        return self._manager.allreduce(grads)
+
+    def wrap_grad_fn(
+        self, grad_fn: Callable[..., Tuple[Any, Any]]
+    ) -> Callable[..., Tuple[Any, Any]]:
+        """Wraps a ``jax.value_and_grad``-style fn so returned grads are
+        already averaged across replica groups (blocking)."""
+
+        def wrapped(*args: Any, **kwargs: Any) -> Tuple[Any, Any]:
+            value, grads = grad_fn(*args, **kwargs)
+            return value, self.allreduce_grads(grads).wait()
+
+        return wrapped

@@ -1,0 +1,61 @@
+"""Timeout layer for async work.
+
+Plays the role of reference torchft/futures.py: a hung collective must fail
+the step, never hang it (the wrap happens in ``Manager.wrap_work``, mirroring
+reference manager.py:326-363). Timers fire on daemon threads; completion
+cancels the timer, and whichever of {result, timeout} lands first wins the
+output future (the loser is ignored).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, InvalidStateError
+from datetime import timedelta
+from typing import Any, Optional
+
+from .collectives import Work
+
+
+def future_timeout(fut: "Future[Any]", timeout: timedelta) -> "Future[Any]":
+    """Returns a future that mirrors ``fut`` but fails with ``TimeoutError``
+    if ``fut`` has not completed within ``timeout``."""
+    out: "Future[Any]" = Future()
+
+    def on_timeout() -> None:
+        try:
+            out.set_exception(
+                TimeoutError(f"future did not complete within {timeout}")
+            )
+        except InvalidStateError:
+            pass  # completed first
+
+    timer = threading.Timer(timeout.total_seconds(), on_timeout)
+    timer.daemon = True
+    timer.start()
+
+    def on_done(f: "Future[Any]") -> None:
+        timer.cancel()
+        try:
+            exc = f.exception()
+            if exc is not None:
+                out.set_exception(exc)
+            else:
+                out.set_result(f.result())
+        except InvalidStateError:
+            pass  # timed out first
+
+    fut.add_done_callback(on_done)
+    return out
+
+
+def work_timeout(work: Work, timeout: timedelta) -> Work:
+    """:func:`future_timeout` lifted to :class:`Work`."""
+    return Work(future_timeout(work._future, timeout))
+
+
+def future_wait(fut: "Future[Any]", timeout: Optional[timedelta] = None) -> Any:
+    """Blocks for the result, raising ``TimeoutError`` past ``timeout``."""
+    return fut.result(
+        timeout=timeout.total_seconds() if timeout is not None else None
+    )

@@ -1,0 +1,52 @@
+"""Lighthouse CLI: ``python -m torchft_tpu.lighthouse``.
+
+The standalone global quorum service, the role of the reference's
+``torchft_lighthouse`` entrypoint (reference pyproject.toml:37-38,
+src/bin/lighthouse.rs:10-23). Defaults mirror the reference CLI
+(src/lighthouse.rs:66-103).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from typing import Optional, Sequence
+
+from . import _native
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="torchft_tpu.lighthouse",
+        description="Global quorum service for torchft_tpu replica groups.",
+    )
+    parser.add_argument("--bind", default="[::]:29510")
+    parser.add_argument("--min_replicas", type=int, default=1)
+    parser.add_argument("--join_timeout_ms", type=int, default=60000)
+    parser.add_argument("--quorum_tick_ms", type=int, default=100)
+    parser.add_argument("--heartbeat_timeout_ms", type=int, default=5000)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    lighthouse = _native.Lighthouse(
+        bind=args.bind,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        quorum_tick_ms=args.quorum_tick_ms,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+    )
+    logger.info(f"lighthouse serving on {lighthouse.address()}")
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    lighthouse.shutdown()
+
+
+if __name__ == "__main__":
+    main()

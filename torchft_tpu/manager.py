@@ -1,0 +1,539 @@
+"""Fault-tolerant training-step state machine.
+
+Reference: torchft/manager.py:73-705. Every training step is a transaction:
+
+- ``start_quorum()`` establishes membership asynchronously, overlapped with
+  the forward/backward computation (quorum RPCs ride a one-thread executor;
+  the jitted step runs concurrently — XLA dispatch is already async).
+- ``allreduce()`` averages gradient pytrees across replica groups through the
+  reconfigurable host collectives; errors are latched, never raised into the
+  train loop, and a failed reduce returns the input unchanged so the step can
+  be discarded by the commit vote.
+- ``should_commit()`` is a distributed AND-vote: if any rank in the group saw
+  an error, every group discards the step.
+- Recovering replicas fetch live weights from a healthy peer over HTTP
+  (:mod:`torchft_tpu.checkpointing`) instead of restarting the world.
+
+TPU mapping: a "replica group" is a TPU slice. Intra-group parallelism (the
+HSDP shard dimension) is pjit/shard_map over the slice's ICI mesh and is
+invisible to this class; only the cross-group (DCN) gradient average and the
+control plane live here, so a dead slice can never wedge an ICI collective.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
+
+from . import _native
+from ._native import ManagerClient, StoreClient
+from .checkpointing import CheckpointServer, CheckpointTransport
+from .collectives import Collectives, ReduceOp, Work, _completed
+from .futures import work_timeout
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+MANAGER_ADDR_KEY: str = "manager_addr"
+REPLICA_ID_KEY: str = "replica_id"
+T = TypeVar("T")
+
+
+class WorldSizeMode(Enum):
+    """How the effective world size behaves under faults.
+    Reference manager.py:55-70."""
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class Manager:
+    """Fault tolerance manager for one rank of one replica group.
+
+    Reference manager.py:73-705. Typically composed with
+    :class:`torchft_tpu.optim.OptimizerWrapper` and a gradient-averaging
+    wrapper so the train loop stays ``zero_grad(); grads; step()``-shaped.
+    """
+
+    def __init__(
+        self,
+        collectives: Collectives,
+        load_state_dict: Optional[Callable[[T], None]],
+        state_dict: Optional[Callable[[], T]],
+        min_replica_size: int,
+        use_async_quorum: bool = True,
+        timeout: timedelta = timedelta(seconds=60),
+        quorum_timeout: timedelta = timedelta(seconds=60),
+        connect_timeout: timedelta = timedelta(seconds=20),
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        store_addr: Optional[str] = None,
+        lighthouse_addr: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        hostname: str = socket.gethostname(),
+        heartbeat_interval: timedelta = timedelta(milliseconds=100),
+        checkpoint_transport: Optional[CheckpointTransport[Dict[str, T]]] = None,
+    ) -> None:
+        """
+        Args:
+            collectives: the reconfigurable cross-replica-group collectives.
+            load_state_dict: callback restoring USER state from a recovery
+                checkpoint (the manager handles its own state separately).
+            state_dict: callback capturing USER state for recovery transfer.
+            min_replica_size: minimum replica groups for a committable step.
+            use_async_quorum: overlap quorum with forward/backward; healing
+                replicas then skip participation for one step (reference
+                manager.py:119-127).
+            rank / world_size: this rank within the replica group (env
+                ``RANK``/``WORLD_SIZE`` when None).
+            store_addr: ``host:port`` of the replica group's rendezvous
+                Store (env ``MASTER_ADDR``+``MASTER_PORT`` when None; if
+                neither is set and world_size == 1, an in-process Store is
+                created).
+            lighthouse_addr: global lighthouse (env ``TORCHFT_LIGHTHOUSE``).
+            replica_id: replica group name; a uuid suffix is appended by
+                group rank 0 (reference manager.py:196-200).
+        """
+        self._load_state_dict = load_state_dict
+        self._user_state_dict = state_dict
+        self._min_replica_size = min_replica_size
+        self._use_async_quorum = use_async_quorum
+        self._timeout = timeout
+        self._quorum_timeout = quorum_timeout
+        self._connect_timeout = connect_timeout
+        self._world_size_mode = world_size_mode
+
+        self._rank: int = rank if rank is not None else int(os.environ.get("RANK", 0))
+        self._world_size: int = (
+            world_size
+            if world_size is not None
+            else int(os.environ.get("WORLD_SIZE", 1))
+        )
+
+        self._owned_store: Optional[_native.Store] = None
+        if store_addr is None:
+            if "MASTER_ADDR" in os.environ and "MASTER_PORT" in os.environ:
+                store_addr = (
+                    f"{os.environ['MASTER_ADDR']}:{os.environ['MASTER_PORT']}"
+                )
+            elif self._world_size == 1:
+                self._owned_store = _native.Store()
+                store_addr = self._owned_store.address()
+            else:
+                raise ValueError(
+                    "store_addr (or MASTER_ADDR/MASTER_PORT) required when "
+                    "world_size > 1"
+                )
+        self._store_addr = store_addr
+        self._store = StoreClient(store_addr, connect_timeout=connect_timeout)
+
+        self._collectives = collectives
+        self._checkpoint_transport: CheckpointTransport[Dict[str, T]] = (
+            checkpoint_transport
+            if checkpoint_transport is not None
+            else CheckpointServer(timeout=timeout)
+        )
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="async_quorum"
+        )
+        self._quorum_future: Optional[Any] = None
+
+        self._step = 0
+        self._batches_committed = 0
+        self._quorum_id = -1
+        self._errored: Optional[Exception] = None
+        self._healing = False
+        self._pending_work: List[Work] = []
+        self._pending_state_dict: Optional[Dict[str, object]] = None
+        self._participating_rank: Optional[int] = None
+        self._participating_world_size: int = 0
+
+        lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
+        replica_id = replica_id if replica_id is not None else ""
+
+        self._manager: Optional[_native.Manager] = None
+        if self._rank == 0:
+            if lighthouse_addr is None:
+                raise ValueError(
+                    "lighthouse_addr (or TORCHFT_LIGHTHOUSE) required on rank 0"
+                )
+            # Group rank 0 hosts the native manager server and publishes its
+            # address + the uuid-qualified replica id through the store
+            # (reference manager.py:184-211).
+            replica_id = (
+                f"{replica_id}:{uuid.uuid4()}" if replica_id else str(uuid.uuid4())
+            )
+            bind = f"[::]:{int(os.environ.get('TORCHFT_MANAGER_PORT', 0))}"
+            self._manager = _native.Manager(
+                replica_id=replica_id,
+                lighthouse_addr=lighthouse_addr,
+                hostname=hostname,
+                bind=bind,
+                store_addr=store_addr,
+                world_size=self._world_size,
+                heartbeat_interval=heartbeat_interval,
+                connect_timeout=connect_timeout,
+            )
+            self._store.set(MANAGER_ADDR_KEY, self._manager.address().encode())
+            self._store.set(REPLICA_ID_KEY, replica_id.encode())
+
+        addr = self._store.get(MANAGER_ADDR_KEY, timeout=connect_timeout).decode()
+        self._client = ManagerClient(addr, connect_timeout=connect_timeout)
+        self._replica_id = self._store.get(
+            REPLICA_ID_KEY, timeout=connect_timeout
+        ).decode()
+        self._logger = _ManagerLogger(self, self._replica_id, self._rank)
+
+    def shutdown(self) -> None:
+        self._checkpoint_transport.shutdown(wait=False)
+        self._executor.shutdown(wait=True)
+        if self._manager is not None:
+            self._manager.shutdown()
+
+    # -- step lifecycle --
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: Optional[timedelta] = None,
+    ) -> None:
+        """Computes a new quorum, asynchronously unless configured otherwise.
+
+        Must be called at the start of every train step (before the first
+        ``allreduce``) on every rank. Reference manager.py:365-415.
+        """
+        if self._quorum_future is not None:
+            # Wait for the previous quorum (and any healing) to finish. Its
+            # errors were already surfaced through allreduce/should_commit;
+            # a new step starts from a clean slate.
+            try:
+                self._quorum_future.result()
+            except Exception:
+                pass
+
+        self._errored = None
+        self._healing = False
+        self._pending_work = []
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=timeout or self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # Eagerly apply the fetched checkpoint so the optimizer sees
+                # the recovered state this same step; sync-mode healers then
+                # participate fully (reference :406-414).
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    def wait_quorum(self) -> None:
+        """Blocks until the quorum started by ``start_quorum`` completes."""
+        assert (
+            self._quorum_future is not None
+        ), "must call start_quorum before wait_quorum"
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: timedelta
+    ) -> None:
+        result = self._client.quorum(
+            rank=self._rank,
+            step=self._step,
+            checkpoint_metadata=self._checkpoint_transport.metadata(),
+            shrink_only=shrink_only,
+            timeout=quorum_timeout,
+        )
+
+        quorum_id = result.quorum_id
+        store_address = result.store_address
+
+        if self._use_async_quorum or not allow_heal:
+            # Participate only if already at max step: healing overlaps with
+            # this step, so recovering replicas sit it out (reference
+            # manager.py:452-456).
+            participating_rank: Optional[int] = result.max_rank
+            participating_world = result.max_world_size
+        else:
+            participating_rank = result.replica_rank
+            participating_world = result.replica_world_size
+
+        if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            # Spares join collectives with zeroed grads; the divisor stays
+            # fixed so numerics never change under churn (reference :460-468).
+            if (
+                participating_rank is not None
+                and participating_rank >= self._min_replica_size
+            ):
+                participating_rank = None
+            participating_world = self._min_replica_size
+
+        self._participating_rank = participating_rank
+        self._participating_world_size = participating_world
+        heal = allow_heal and result.heal
+
+        if quorum_id != self._quorum_id:
+            # Reconfigure the data plane on a store prefix unique to this
+            # quorum AND this local rank: cross-group rings are per local
+            # rank, and stale members can't collide (reference :470-477).
+            prefix = f"{store_address}/torchft/{quorum_id}/{self._rank}"
+            self._logger.info(f"reconfiguring collectives quorum_id={quorum_id}")
+            self._collectives.configure(
+                prefix, result.replica_rank, result.replica_world_size
+            )
+            self._quorum_id = quorum_id
+
+        if allow_heal:
+            if result.recover_dst_ranks:
+                # This replica is a recovery source: publish live weights.
+                self._logger.info(
+                    f"peers need recovery from us {result.recover_dst_ranks}"
+                )
+                self._checkpoint_transport.send_checkpoint(
+                    dst_ranks=result.recover_dst_ranks,
+                    step=result.max_step,
+                    state_dict=self._manager_state_dict(),
+                    timeout=self._timeout,
+                )
+            if heal:
+                self._healing = True
+                self._logger.info(
+                    f"healing required, fetching checkpoint from "
+                    f"{result.recover_src_manager_address} step={result.max_step}"
+                )
+                primary_client = ManagerClient(
+                    result.recover_src_manager_address,
+                    connect_timeout=self._connect_timeout,
+                )
+                checkpoint_metadata = primary_client.checkpoint_metadata(
+                    self._rank, timeout=self._timeout
+                )
+                assert result.recover_src_rank is not None
+                checkpoint = self._checkpoint_transport.recv_checkpoint(
+                    src_rank=result.recover_src_rank,
+                    metadata=checkpoint_metadata,
+                    step=result.max_step,
+                    timeout=self._timeout,
+                )
+                # Manager state is applied immediately (so step/commit
+                # counters are right); user state waits for a safe point on
+                # the main thread (reference :514-526).
+                self._pending_state_dict = cast(Dict[str, object], checkpoint)
+                self.load_state_dict(
+                    cast(Dict[str, int], checkpoint["torchft"])
+                )
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._healing, "apply_pending_state_dict called when not healing"
+        assert (
+            self._pending_state_dict is not None
+        ), "checkpoint was not fetched before apply"
+        assert self._load_state_dict is not None, "no load_state_dict callback"
+        self._logger.info("applying pending state dict")
+        self._load_state_dict(cast(T, self._pending_state_dict["user"]))
+        self._pending_state_dict = None
+
+    # -- data plane --
+
+    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.AVG) -> Work:
+        """Fault-tolerantly averages a gradient pytree across replica groups.
+
+        Never raises: on error the returned Work resolves to the INPUT tree
+        and the error is latched for ``should_commit`` (reference
+        manager.py:242-303). Non-participating (healing/spare) replicas
+        contribute zeros. ``op`` must be AVG (divide by ``num_participants``,
+        the live divisor, reference :279-291) or SUM.
+        """
+        if self.errored() is not None:
+            return _completed(tree)
+
+        self.wait_quorum()
+        num_participants = self.num_participants()
+
+        try:
+            import jax
+
+            if not self.is_participating():
+                tree = jax.tree_util.tree_map(
+                    lambda l: l * 0 if hasattr(l, "__mul__") else l, tree
+                )
+            work = self._collectives.allreduce(tree, ReduceOp.SUM)
+            if op == ReduceOp.AVG:
+                assert num_participants >= 1
+                work = work.then(
+                    lambda t: jax.tree_util.tree_map(
+                        lambda l: l / num_participants, t
+                    )
+                )
+            elif op != ReduceOp.SUM:
+                raise ValueError(f"unsupported managed allreduce op: {op}")
+            return self.wrap_work(work, default=tree)
+        except Exception as e:  # noqa: BLE001 - latch, never raise
+            self._logger.exception(f"allreduce failed immediately: {e}")
+            self.report_error(e)
+            return _completed(tree)
+
+    def wrap_work(self, work: Work, default: Any, timeout: Optional[timedelta] = None) -> Work:
+        """Adds a timeout and error-swallowing to a Work: on failure the
+        error is latched and ``default`` is returned (reference
+        manager.py:326-363)."""
+        timed = work_timeout(work, timeout or self._timeout)
+
+        def swallow() -> Work:
+            from concurrent.futures import Future
+
+            out: "Future[Any]" = Future()
+
+            def on_done(f: "Future[Any]") -> None:
+                exc = f.exception()
+                if exc is not None:
+                    self._logger.exception(f"async work failed: {exc}")
+                    self.report_error(cast(Exception, exc))
+                    out.set_result(default)
+                else:
+                    out.set_result(f.result())
+
+            timed._future.add_done_callback(on_done)
+            return Work(out)
+
+        wrapped = swallow()
+        self._pending_work.append(wrapped)
+        return wrapped
+
+    # -- error tracking --
+
+    def report_error(self, e: Exception) -> None:
+        """Latch an error: the current step will not commit and collectives
+        are no-ops until the next quorum (reference manager.py:305-317)."""
+        self._errored = e
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    # -- commit protocol --
+
+    def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
+        """Distributed AND-vote on step validity. Reference manager.py:545-598.
+
+        Returns True iff every rank of every participating replica group
+        completed the step without errors and quorum size >= min_replica_size.
+        """
+        for work in self._pending_work:
+            work.wait()  # error-swallowing: never raises, latches instead
+        self._pending_work = []
+
+        if self._errored is None and self._healing:
+            self._apply_pending_state_dict()
+
+        local_should_commit = (
+            self._errored is None
+            and self.num_participants() >= self._min_replica_size
+        )
+        should_commit = self._client.should_commit(
+            self._rank,
+            self._step,
+            local_should_commit,
+            timeout=timeout or self._timeout,
+        )
+        self._logger.info(
+            f"should_commit={should_commit} enough_replicas="
+            f"{self.num_participants() >= self._min_replica_size}, "
+            f"errored={self._errored}"
+        )
+
+        # The checkpoint dict must not be readable while the optimizer
+        # mutates it (reference manager.py:591).
+        self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+        self._healing = False
+        return should_commit
+
+    # -- state --
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        """Restores manager state (call when resuming from a durable
+        checkpoint, alongside the user state). Reference manager.py:600-613."""
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    def _manager_state_dict(self) -> Dict[str, object]:
+        assert self._user_state_dict is not None, "no state_dict callback"
+        return {
+            "user": self._user_state_dict(),
+            "torchft": self.state_dict(),
+        }
+
+    def state_dict(self) -> Dict[str, int]:
+        """Manager state to persist alongside user checkpoints.
+        Reference manager.py:615-629."""
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    # -- introspection --
+
+    def current_step(self) -> int:
+        """Committed step count; skipped steps don't increment it."""
+        return self._step
+
+    def batches_committed(self) -> int:
+        """Total batches committed across all replicas and steps."""
+        return self._batches_committed
+
+    def num_participants(self) -> int:
+        """Replica groups participating in the current step."""
+        assert self._quorum_future is not None, "quorum not started"
+        self.wait_quorum()
+        return self._participating_world_size
+
+    def participating_rank(self) -> Optional[int]:
+        """This group's rank among participants; None when healing/spare."""
+        assert self._quorum_future is not None, "quorum not started"
+        self.wait_quorum()
+        return self._participating_rank
+
+    def is_participating(self) -> bool:
+        """False while healing or a spare: gradients are zeroed then
+        (reference manager.py:693-705)."""
+        if self._participating_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
+
+
+class _ManagerLogger:
+    """Prefixes logs with [replica/rank - step N]. Reference manager.py:708-727."""
+
+    def __init__(self, manager: Manager, replica_id: str, rank: int) -> None:
+        self._logger = logging.getLogger(f"{__name__}.{replica_id}")
+        self._replica_id = replica_id
+        self._rank = rank
+        self._manager = manager
+
+    def prefix(self) -> str:
+        return (
+            f"[{self._replica_id}/{self._rank} - step "
+            f"{self._manager.current_step()}]"
+        )
+
+    def info(self, msg: str) -> None:
+        self._logger.info(f"{self.prefix()} {msg}")
+
+    def warn(self, msg: str) -> None:
+        self._logger.warning(f"{self.prefix()} {msg}")
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception(f"{self.prefix()} {msg}")

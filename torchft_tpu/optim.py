@@ -1,0 +1,52 @@
+"""Optimizer wrapper binding optax updates to the commit protocol.
+
+Reference: torchft/optim.py — ``zero_grad()`` starts the quorum,
+``step()`` applies the update only if the distributed commit vote passes.
+State lives in an :class:`~torchft_tpu.train_state.FTTrainState` so a heal
+applied at the ``should_commit`` safe point is visible to the very update
+that follows it (the reference gets this from torch's in-place
+``load_state_dict``; immutable jax pytrees need the holder).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .manager import Manager
+from .train_state import FTTrainState
+
+
+class OptimizerWrapper:
+    """Quorum + commit gating around an optax optimizer.
+
+    Canonical loop (reference train_ddp.py:119-152 shape)::
+
+        state = FTTrainState(params, optax.adamw(1e-3))
+        manager = Manager(..., state_dict=state.state_dict,
+                          load_state_dict=state.load_state_dict)
+        optimizer = OptimizerWrapper(manager, state)
+        for step in ...:
+            optimizer.zero_grad()                  # starts async quorum
+            grads = grad_fn(state.params, batch)
+            avg = manager.allreduce(grads).wait()  # fault-tolerant average
+            optimizer.step(avg)                    # applies iff committed
+    """
+
+    def __init__(self, manager: Manager, state: FTTrainState) -> None:
+        self.manager = manager
+        self.state = state
+
+    def zero_grad(self) -> None:
+        """Starts the (async) quorum for this step. Name kept for parity
+        with the reference API (optim.py:48-50)."""
+        self.manager.start_quorum()
+
+    def step(self, grads: Any) -> bool:
+        """Votes, then applies ``grads`` iff every rank committed (reference
+        optim.py:52-54). ``should_commit`` applies any pending recovery
+        checkpoint into ``self.state`` first, so the update always starts
+        from the healed weights. Returns whether the step committed."""
+        if not self.manager.should_commit():
+            return False
+        self.state.apply_gradients(grads)
+        return True

@@ -1,0 +1,65 @@
+"""Identity-stable training state for fault-tolerant JAX loops.
+
+In torch, healing works because ``load_state_dict`` mutates the same tensors
+the optimizer later steps (reference manager.py:528-543). JAX pytrees are
+immutable values, so a recovered checkpoint applied through a callback can
+be silently shadowed by stale ``params`` bound earlier in the step — the
+divergence class the reference never has. :class:`FTTrainState` restores the
+in-place property at the *holder* level: the manager's state callbacks and
+the optimizer update both go through one mutable object, so post-heal reads
+always see the recovered weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _to_device_tree(tree: Any) -> Any:
+    """Checkpointed leaves arrive as host numpy; rebuild jax arrays (same
+    dtypes) so downstream jitted code never sees numpy."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda l: jnp.asarray(l) if isinstance(l, np.ndarray) else l, tree
+    )
+
+
+class FTTrainState:
+    """Mutable holder for ``params`` + ``opt_state`` + the optax transform.
+
+    Wire its ``state_dict``/``load_state_dict`` into the
+    :class:`~torchft_tpu.manager.Manager` so live recovery flows through the
+    same object the train loop reads::
+
+        state = FTTrainState(params, optax.adamw(1e-3))
+        manager = Manager(..., state_dict=state.state_dict,
+                          load_state_dict=state.load_state_dict)
+    """
+
+    def __init__(self, params: Any, tx: Any, opt_state: Optional[Any] = None) -> None:
+        self.params = params
+        self.tx = tx
+        self.opt_state = opt_state if opt_state is not None else tx.init(params)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Snapshot for recovery transfer / durable checkpoints. The returned
+        dict holds the current (immutable) pytrees, so a concurrent
+        ``apply_gradients`` can never corrupt an in-flight transfer."""
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self.params = _to_device_tree(state_dict["params"])
+        self.opt_state = _to_device_tree(state_dict["opt_state"])
+
+    def apply_gradients(self, grads: Any) -> None:
+        """One optimizer update, in place (holder-level)."""
+        import optax
+
+        updates, self.opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        self.params = optax.apply_updates(self.params, updates)
