@@ -1,0 +1,137 @@
+"""Benchmark: fault-tolerant training throughput on the flagship model.
+
+Measures steps/sec of the FULL fault-tolerance path (async quorum +
+fault-tolerant gradient allreduce + distributed commit vote, every step)
+against a raw jitted train loop on the same model and hardware.
+
+The reference publishes no absolute numbers (BASELINE.md); the driver-set
+north star is >= 90% of healthy-state throughput under churn. This bench
+reports the no-churn FT overhead — the upper bound of that ratio:
+``vs_baseline = (ft_steps_per_sec / raw_steps_per_sec) / 0.90``, so 1.0
+means exactly the 90% target and > 1.0 beats it.
+
+Prints ONE JSON line, e.g.:
+{"metric": "steps_per_sec_ft", "value": 12.3, "unit": "steps/s", "vs_baseline": 1.07}
+"""
+
+import json
+import os
+import sys
+import time
+from datetime import timedelta
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu import (
+        FTTrainState,
+        HostCollectives,
+        Lighthouse,
+        Manager,
+        OptimizerWrapper,
+    )
+    from torchft_tpu.models import TransformerConfig, init_params, loss_fn
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = TransformerConfig(
+        vocab_size=8192,
+        d_model=512,
+        n_heads=8,
+        n_layers=6 if on_tpu else 2,
+        d_ff=2048,
+        max_seq_len=512,
+    )
+    batch_size = 16 if on_tpu else 4
+    seq_len = 512 if on_tpu else 128
+    warmup, steps = 5, 30 if on_tpu else 15
+
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(batch_size, seq_len), dtype=np.int32)
+    )
+
+    def barrier(tree) -> None:
+        # Readback barrier: on the axon-tunneled TPU, block_until_ready
+        # returns before remote execution drains, so force a (tiny) device
+        # read to fence the timing.
+        jax.block_until_ready(tree)
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        np.asarray(leaf.ravel()[0:1])
+    tx = optax.adamw(1e-3)
+    grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
+
+    def apply_fn_raw(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    apply_jit = jax.jit(apply_fn_raw, donate_argnums=(0, 1))
+
+    # -- raw loop --
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt_state = tx.init(params)
+    for _ in range(warmup):
+        loss, grads = grad_fn(params, batch)
+        params, opt_state = apply_jit(params, opt_state, grads)
+    barrier(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, grads = grad_fn(params, batch)
+        params, opt_state = apply_jit(params, opt_state, grads)
+    barrier(params)
+    raw_sps = steps / (time.perf_counter() - t0)
+
+    # -- fault-tolerant loop (full machinery, single replica group) --
+    lighthouse = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+    state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
+    collectives = HostCollectives(timeout=timedelta(seconds=30))
+    manager = Manager(
+        collectives=collectives,
+        load_state_dict=state.load_state_dict,
+        state_dict=state.state_dict,
+        min_replica_size=1,
+        rank=0,
+        world_size=1,
+        lighthouse_addr=lighthouse.address(),
+        replica_id="bench",
+    )
+    optimizer = OptimizerWrapper(manager, state)
+
+    def ft_step():
+        optimizer.zero_grad()
+        loss, grads = grad_fn(state.params, batch)
+        avg = manager.allreduce(grads).wait()
+        optimizer.step(avg)
+
+    for _ in range(warmup):
+        ft_step()
+    barrier(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ft_step()
+    barrier(state.params)
+    ft_sps = steps / (time.perf_counter() - t0)
+
+    manager.shutdown()
+    collectives.shutdown()
+    lighthouse.shutdown()
+
+    print(
+        json.dumps(
+            {
+                "metric": "steps_per_sec_ft",
+                "value": round(ft_sps, 3),
+                "unit": "steps/s",
+                "vs_baseline": round((ft_sps / raw_sps) / 0.90, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -5,12 +5,21 @@ import sys
 # JAX on a virtual 8-device CPU mesh: multi-chip sharding paths are tested
 # without TPU hardware (the driver's dryrun uses the same trick). Must be set
 # before the first `import jax` anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when a real TPU is tunneled in: the unit suite needs 8
+# virtual devices (and TPU jit compiles are 20-40s each); the driver runs
+# bench.py / dryrun on real hardware separately. The axon sitecustomize
+# pins the TPU backend via jax.config at startup, so the env var alone is
+# not enough — override the config after import too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

@@ -313,6 +313,13 @@ class HostCollectives(Collectives):
         return self._submit(lambda: self._allreduce_sync(tree, op, timeout_ms))
 
     def _allreduce_sync(self, tree: Any, op: ReduceOp, timeout_ms: int) -> Any:
+        if self._world_size == 1:
+            # Identity (SUM of one member; AVG divides by 1): skip the host
+            # pack/transfer entirely — device arrays never leave HBM. NOTE:
+            # single-member results may ALIAS the input tree (treat op
+            # results as immutable, the jax norm — multi-member paths return
+            # fresh buffers).
+            return tree
         leaves, treedef = _flatten(tree)
         if not leaves:
             return tree
@@ -371,6 +378,8 @@ class HostCollectives(Collectives):
         return self._submit(lambda: self._allgather_sync(tree, timeout_ms))
 
     def _allgather_sync(self, tree: Any, timeout_ms: int) -> List[Any]:
+        if self._world_size == 1:
+            return [tree]
         leaves, treedef = _flatten(tree)
         arrays = [np.ascontiguousarray(_as_numpy(l)) for l in leaves]
         was_jax = [_is_jax_array(l) for l in leaves]
@@ -412,6 +421,10 @@ class HostCollectives(Collectives):
         return self._submit(lambda: self._broadcast_sync(tree, root, timeout_ms))
 
     def _broadcast_sync(self, tree: Any, root: int, timeout_ms: int) -> Any:
+        if self._world_size == 1:
+            if root != 0:
+                raise RuntimeError(f"bad broadcast root {root} for world size 1")
+            return tree
         leaves, treedef = _flatten(tree)
         arrays = [np.ascontiguousarray(_as_numpy(l)) for l in leaves]
         was_jax = [_is_jax_array(l) for l in leaves]

@@ -1,0 +1,17 @@
+from torchft_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_sharding_rules,
+    tiny_config,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "param_sharding_rules",
+    "tiny_config",
+]

@@ -1,0 +1,167 @@
+"""Decoder-only transformer LM: the flagship model for fault-tolerant
+training demos and benchmarks.
+
+Pure-functional (pytree params + jax fns), designed TPU-first:
+
+- all matmuls are large, batched and bfloat16 (MXU-shaped; dims multiples
+  of 128 at the flagship config),
+- static shapes and compiler-friendly control flow only (no data-dependent
+  Python branching under jit),
+- Megatron-style tensor-parallel sharding rules over a ``model`` mesh axis
+  (column-parallel QKV/up-projection, row-parallel out/down-projection),
+  expressed as PartitionSpecs — XLA inserts the ICI collectives,
+- batch sharded over a ``data`` mesh axis.
+
+The reference has no model zoo (torchft wraps user models, train_ddp.py's
+CNN is the only demo); this module is the analog of that demo model plus
+the sharding contract the HSDP composition needs
+(reference process_group.py:1310-1341 leaves intra-group dims to the user —
+here the intra-group sharding is first-class).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16  # activation/matmul dtype; params stay f32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def tiny_config() -> TransformerConfig:
+    """Small config for tests / virtual-device dry runs."""
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=128,
+    )
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """f32 master params; matmuls cast to cfg.dtype at use."""
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    scale = cfg.d_model ** -0.5
+
+    def dense(k, shape, s):
+        return jax.random.normal(k, shape, jnp.float32) * s
+
+    blocks = []
+    for i in range(cfg.n_layers):
+        bk = jax.random.split(keys[2 + i], 4)
+        blocks.append(
+            {
+                "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+                "attn": {
+                    # fused QKV, column-parallel over the model axis
+                    "wqkv": dense(bk[0], (cfg.d_model, 3 * cfg.d_model), scale),
+                    # out projection, row-parallel
+                    "wo": dense(bk[1], (cfg.d_model, cfg.d_model), scale),
+                },
+                "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+                "mlp": {
+                    "wi": dense(bk[2], (cfg.d_model, cfg.d_ff), scale),
+                    "wo": dense(bk[3], (cfg.d_ff, cfg.d_model),
+                                cfg.d_ff ** -0.5),
+                },
+            }
+        )
+    return {
+        "embed": jax.random.normal(
+            keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32
+        ) * scale,
+        "pos_embed": jax.random.normal(
+            keys[1], (cfg.max_seq_len, cfg.d_model), jnp.float32
+        ) * 0.01,
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
+
+
+def param_sharding_rules(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs (pytree matching init_params) for a mesh with a
+    ``model`` axis: Megatron column/row parallelism. Replicated leaves get
+    P() so every spec is explicit."""
+    block = {
+        "ln1": {"scale": P()},
+        "attn": {
+            "wqkv": P(None, "model"),  # column-parallel: heads split
+            "wo": P("model", None),    # row-parallel: partial sums psum'd
+        },
+        "ln2": {"scale": P()},
+        "mlp": {
+            "wi": P(None, "model"),
+            "wo": P("model", None),
+        },
+    }
+    return {
+        "embed": P(None, "model"),
+        "pos_embed": P(),
+        "blocks": [block] * cfg.n_layers,
+        "ln_f": {"scale": P()},
+    }
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _attention(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
+    B, S, D = x.shape
+    qkv = x @ p["wqkv"].astype(cfg.dtype)  # (B, S, 3D)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.n_heads, cfg.head_dim)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (cfg.head_dim ** -0.5)
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+    return out @ p["wo"].astype(cfg.dtype)
+
+
+def _block(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
+    x = x + _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
+    h = _rmsnorm(x, p["ln2"]["scale"])
+    h = jax.nn.gelu(h @ p["mlp"]["wi"].astype(cfg.dtype))
+    return x + h @ p["mlp"]["wo"].astype(cfg.dtype)
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab) f32."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = x + params["pos_embed"].astype(cfg.dtype)[:S]
+    for p in params["blocks"]:
+        x = _block(cfg, p, x)
+    x = _rmsnorm(x, params["ln_f"]["scale"])
+    # weight-tied readout; f32 logits for a stable softmax
+    return (x @ params["embed"].astype(cfg.dtype).T).astype(jnp.float32)
+
+
+def loss_fn(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy over (B, S) int32 tokens."""
+    logits = forward(cfg, params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

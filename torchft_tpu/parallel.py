@@ -1,0 +1,118 @@
+"""Intra-replica-group parallelism: pjit/shard_map over the slice's ICI mesh.
+
+This is the TPU-native answer to the reference's HSDP composition
+(reference process_group.py:1067-1341 ``ManagedDeviceMesh`` /
+``ft_init_device_mesh``): there, torchft owns the resizable replicate dim of
+a DeviceMesh and leaves intra-group dims to FSDP; here, the replicate
+dimension lives OUTSIDE jit (the manager's host collectives over DCN —
+reconfigurable per quorum, never wedging a device collective), while
+intra-group sharding is ordinary ``jax.sharding`` over the slice mesh, with
+XLA inserting the ICI collectives.
+
+The composition contract: ``build_grad_step`` produces a jitted function
+whose output grads are already averaged over the mesh's ``data`` axis (XLA
+psum over ICI); ``Manager.allreduce`` then averages those across replica
+groups; ``build_apply_step`` applies the update, sharded. A replica-group
+membership change only reconfigures the host ring — the jitted step and its
+mesh are untouched, so there is NO recompile on quorum change (the re-jit
+hazard called out in SURVEY.md §7)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+
+def make_mesh(
+    axis_sizes: Optional[Mapping[str, int]] = None, devices: Optional[Any] = None
+):
+    """Builds a ``jax.sharding.Mesh`` over this replica group's devices.
+
+    ``axis_sizes`` maps axis name -> size (product must equal device count);
+    default: all local devices on one ``data`` axis. Axis name conventions:
+    ``data`` (batch/FSDP), ``model`` (tensor parallel), ``seq`` (sequence/
+    context parallel)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = {"data": devices.size}
+    names = tuple(axis_sizes.keys())
+    shape = tuple(axis_sizes.values())
+    if int(np.prod(shape)) != devices.size:
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} needs {int(np.prod(shape))} devices, "
+            f"have {devices.size}"
+        )
+    return Mesh(devices.reshape(shape), names)
+
+
+def shard_pytree(tree: Any, rules: Any, mesh: Any) -> Any:
+    """Places a pytree onto the mesh per PartitionSpec ``rules`` (a matching
+    pytree; see models.transformer.param_sharding_rules)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        tree,
+        rules,
+        is_leaf=lambda l: l is None,
+    )
+
+
+def replicate_pytree(tree: Any, mesh: Any) -> Any:
+    """Fully replicates a pytree across the mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map(lambda l: jax.device_put(l, sharding), tree)
+
+
+def build_grad_step(
+    loss_fn: Callable[[Any, Any], Any],
+    mesh: Any,
+    param_rules: Any,
+    batch_spec: Optional[Any] = None,
+) -> Callable[[Any, Any], Tuple[Any, Any]]:
+    """Jits ``(params, batch) -> (loss, grads)`` over the slice mesh.
+
+    ``loss_fn(params, batch)`` must return a scalar mean loss. The batch is
+    sharded over the ``data`` axis (XLA turns the mean's reduction into an
+    ICI psum, so returned grads are the slice-wide average); params/grads
+    follow ``param_rules``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if batch_spec is None:
+        batch_spec = P("data")
+    param_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), param_rules,
+        is_leaf=lambda l: isinstance(l, P) or l is None,
+    )
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    scalar = NamedSharding(mesh, P())
+
+    return jax.jit(
+        jax.value_and_grad(loss_fn),
+        in_shardings=(param_shardings, batch_sharding),
+        out_shardings=(scalar, param_shardings),
+    )
+
+
+def build_apply_step(tx: Any) -> Callable[[Any, Any, Any], Tuple[Any, Any]]:
+    """Jits the optax update ``(params, opt_state, grads) -> (params,
+    opt_state)``. Shardings are inferred from the (mesh-placed) inputs, so
+    the mesh needs no explicit plumbing; donation keeps HBM flat."""
+    from .train_state import make_apply_fn
+
+    return make_apply_fn(tx)
+
+
+def cross_group_average(manager: Any, grads: Any) -> Any:
+    """Blocking cross-replica-group gradient average through the manager's
+    fault-tolerant host collectives (the DCN/replicate dimension)."""
+    return manager.allreduce(grads).wait()
