@@ -1,0 +1,118 @@
+"""Lighthouse HTTP dashboard + launcher tests.
+Dashboard parity with reference templates/ + src/lighthouse.rs:320-437."""
+
+import sys
+import urllib.request
+from datetime import timedelta
+
+import pytest
+
+from torchft_tpu._native import (
+    Lighthouse,
+    Manager,
+    ManagerClient,
+    Store,
+)
+from torchft_tpu.launcher import launch, replica_group_spec
+
+
+def _get(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as f:
+        return f.read().decode()
+
+
+class TestDashboard:
+    def test_index_and_status(self):
+        lh = Lighthouse(bind="[::]:0", min_replicas=1)
+        try:
+            base = lh.address()
+            index = _get(base + "/")
+            assert "lighthouse" in index
+            status = _get(base + "/status")
+            assert "Quorum" in status
+
+            # With a live member, status shows its card and heartbeat age.
+            store = Store()
+            m = Manager(
+                "dash_rep", lh.address(), "localhost", "[::]:0",
+                store.address(), 1,
+            )
+            client = ManagerClient(m.address())
+            client.quorum(0, 3, "md", timeout=timedelta(seconds=10))
+            status = _get(base + "/status")
+            assert "dash_rep" in status
+            assert "Kill" in status
+            assert "Heartbeats" in status
+            m.shutdown()
+            store.shutdown()
+        finally:
+            lh.shutdown()
+
+    def test_kill_unknown_replica_404(self):
+        lh = Lighthouse(bind="[::]:0", min_replicas=1)
+        try:
+            req = urllib.request.Request(
+                lh.address() + "/replica/nope/kill", method="POST"
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 404
+        finally:
+            lh.shutdown()
+
+    def test_unknown_path_404(self):
+        lh = Lighthouse(bind="[::]:0", min_replicas=1)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(lh.address() + "/bogus", timeout=10)
+            assert e.value.code == 404
+        finally:
+            lh.shutdown()
+
+
+class TestLauncher:
+    def test_spec_env_plumbing(self):
+        spec = replica_group_spec(
+            ["python", "x.py"], 1, 4, "http://lh:1", env={"EXTRA": "1"}
+        )
+        assert spec["env"]["REPLICA_GROUP_ID"] == "1"
+        assert spec["env"]["NUM_REPLICA_GROUPS"] == "4"
+        assert spec["env"]["TORCHFT_LIGHTHOUSE"] == "http://lh:1"
+        assert spec["env"]["EXTRA"] == "1"
+        assert spec["max_restarts"] == 10
+
+    def test_launch_restarts_failed_group(self, tmp_path):
+        # Each group fails once (marker file), then succeeds: the supervisor
+        # must restart it (the reference's torchelastic max_restarts role).
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import os, sys\n"
+            "marker = os.path.join(\n"
+            "    os.path.dirname(os.path.abspath(__file__)),\n"
+            "    'marker_' + os.environ['REPLICA_GROUP_ID'],\n"
+            ")\n"
+            "if not os.path.exists(marker):\n"
+            "    open(marker, 'w').close()\n"
+            "    sys.exit(1)\n"
+            "sys.exit(0)\n"
+        )
+        rc = launch(
+            [sys.executable, str(script)],
+            num_replica_groups=2,
+            lighthouse_addr="http://unused:1",
+            max_restarts=2,
+        )
+        assert rc == 0
+        assert (tmp_path / "marker_0").exists()
+        assert (tmp_path / "marker_1").exists()
+
+    def test_launch_gives_up_after_max_restarts(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        rc = launch(
+            [sys.executable, str(script)],
+            num_replica_groups=1,
+            lighthouse_addr="http://unused:1",
+            max_restarts=1,
+        )
+        assert rc == 1

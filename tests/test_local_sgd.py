@@ -1,0 +1,220 @@
+"""LocalSGD / DiLoCo tests.
+
+Unit tests against an autospec'd Manager (reference local_sgd_test.py:41-146)
+plus thread-per-replica integration with fault injection and the
+algorithm-specific oracles (reference local_sgd_integ_test.py:207-316).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import Any, Dict
+from unittest.mock import create_autospec
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu import (
+    FTTrainState,
+    HostCollectives,
+    Lighthouse,
+    Manager,
+    Store,
+)
+from torchft_tpu.collectives import _completed
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.manager import Manager as RealManager
+
+
+def _state(value: float = 1.0) -> FTTrainState:
+    return FTTrainState(
+        {"w": jnp.full((4,), value, jnp.float32)}, optax.sgd(0.1)
+    )
+
+
+def _mock_manager(commit: bool = True):
+    manager = create_autospec(RealManager, instance=True)
+    manager.allreduce.side_effect = lambda tree, op=None: _completed(tree)
+    manager.should_commit.return_value = commit
+    manager._use_async_quorum = False
+    return manager
+
+
+class TestLocalSGDUnit:
+    def test_syncs_every_n_steps(self):
+        manager = _mock_manager()
+        local = LocalSGD(manager, _state(), sync_every=3)
+        grads = {"w": jnp.ones((4,))}
+        for i in range(5):
+            local.step(grads)
+        assert manager.start_quorum.call_count == 1  # one sync at step 3
+        local.step(grads)
+        assert manager.start_quorum.call_count == 2
+
+    def test_commit_saves_backup(self):
+        manager = _mock_manager(commit=True)
+        st = _state(1.0)
+        local = LocalSGD(manager, st, sync_every=1)
+        local.step({"w": jnp.ones((4,))})  # sgd(0.1): w = 1 - 0.1
+        np.testing.assert_allclose(np.asarray(st.params["w"]), 0.9)
+        np.testing.assert_allclose(local._backup_params["w"], 0.9)
+
+    def test_abort_restores_backup(self):
+        manager = _mock_manager(commit=False)
+        st = _state(1.0)
+        local = LocalSGD(manager, st, sync_every=2)
+        local.step({"w": jnp.ones((4,))})
+        local.step({"w": jnp.ones((4,))})
+        # Window discarded: params back to the last synced value.
+        np.testing.assert_allclose(np.asarray(st.params["w"]), 1.0)
+        assert local._local_step == 0
+
+    def test_state_dict_roundtrip(self):
+        manager = _mock_manager()
+        st = _state(2.0)
+        local = LocalSGD(manager, st, sync_every=4)
+        local.step({"w": jnp.ones((4,))})
+        sd = local.state_dict()
+        st2 = _state(0.0)
+        local2 = LocalSGD(_mock_manager(), st2, sync_every=4)
+        local2.load_state_dict(sd)
+        np.testing.assert_allclose(
+            np.asarray(st2.params["w"]), np.asarray(st.params["w"])
+        )
+        assert local2._local_step == 1
+
+
+class TestDiLoCoUnit:
+    def test_requires_sync_quorum(self):
+        manager = _mock_manager()
+        manager._use_async_quorum = True
+        with pytest.raises(ValueError):
+            DiLoCo(manager, _state(), optax.sgd(0.5), sync_every=2)
+
+    def test_outer_step_moves_toward_inner(self):
+        manager = _mock_manager(commit=True)
+        st = _state(1.0)
+        diloco = DiLoCo(manager, st, optax.sgd(1.0), sync_every=2)
+        for _ in range(2):
+            diloco.step({"w": jnp.ones((4,))})
+        # inner: w = 1 - 0.1 - 0.1 = 0.8; pseudo = 1.0 - 0.8 = 0.2;
+        # outer sgd(lr=1): w = 1.0 - 1.0 * 0.2 = 0.8 — toward the inner
+        # result, reproducing it exactly at lr=1 (paper sign convention).
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), 0.8, rtol=1e-6
+        )
+        np.testing.assert_allclose(diloco._backup_params["w"], 0.8, rtol=1e-6)
+
+    def test_abort_restores_without_outer_step(self):
+        manager = _mock_manager(commit=False)
+        st = _state(1.0)
+        diloco = DiLoCo(manager, st, optax.sgd(0.7), sync_every=1)
+        diloco.step({"w": jnp.ones((4,))})
+        np.testing.assert_allclose(np.asarray(st.params["w"]), 1.0)
+
+
+# -- integration: real control plane, threads as replica groups --
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+def _run_local_sgd_replicas(
+    algo: str,
+    num_replicas: int,
+    num_syncs: int,
+    sync_every: int,
+    fail_at: Dict[int, int],
+):
+    """Each replica runs inner steps + periodic sync; fail_at maps
+    replica_id -> manager step at which to die once."""
+    lighthouse = Lighthouse(
+        bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=50, heartbeat_timeout_ms=1000,
+    )
+    remaining_failures = dict(fail_at)
+    lock = threading.Lock()
+
+    def run_replica(rid: int):
+        for attempt in range(3):
+            try:
+                return _train(rid)
+            except InjectedFailure:
+                continue
+        raise RuntimeError(f"replica {rid} exhausted attempts")
+
+    def _train(rid: int):
+        store = Store()
+        col = HostCollectives(timeout=timedelta(seconds=10))
+        st = FTTrainState(
+            {"w": jnp.full((8,), 1.0, jnp.float32)}, optax.sgd(0.05)
+        )
+        holder: Dict[str, Any] = {}
+        manager = Manager(
+            collectives=col,
+            load_state_dict=lambda sd: holder["algo"].load_state_dict(sd),
+            state_dict=lambda: holder["algo"].state_dict(),
+            min_replica_size=1,
+            use_async_quorum=(algo == "local_sgd"),
+            timeout=timedelta(seconds=10),
+            quorum_timeout=timedelta(seconds=10),
+            connect_timeout=timedelta(seconds=10),
+            rank=0,
+            world_size=1,
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"{algo}_{rid}",
+        )
+        if algo == "local_sgd":
+            holder["algo"] = LocalSGD(manager, st, sync_every)
+        else:
+            holder["algo"] = DiLoCo(manager, st, optax.sgd(0.7), sync_every)
+        algo_obj = holder["algo"]
+        try:
+            while manager.current_step() < num_syncs:
+                with lock:
+                    if remaining_failures.get(rid) == manager.current_step():
+                        del remaining_failures[rid]
+                        raise InjectedFailure(f"{rid}")
+                step = manager.current_step()
+                grads = {
+                    "w": jnp.full((8,), 0.1 * (step + 1), jnp.float32)
+                }
+                algo_obj.step(grads)
+            return {
+                "params": np.asarray(st.params["w"]),
+                "backup": np.asarray(algo_obj._backup_params["w"]),
+            }
+        finally:
+            manager.shutdown()
+            col.shutdown()
+            store.shutdown()
+
+    try:
+        with ThreadPoolExecutor(max_workers=num_replicas) as ex:
+            futs = [ex.submit(run_replica, i) for i in range(num_replicas)]
+            return [f.result(timeout=120) for f in futs]
+    finally:
+        lighthouse.shutdown()
+
+
+class TestLocalSGDInteg:
+    def test_local_sgd_recovery(self):
+        results = _run_local_sgd_replicas(
+            "local_sgd", num_replicas=2, num_syncs=4, sync_every=2,
+            fail_at={1: 1},
+        )
+        # Model-only oracle (reference local_sgd_integ_test.py:207-214).
+        np.testing.assert_array_equal(results[0]["params"], results[1]["params"])
+
+    def test_diloco_recovery(self):
+        results = _run_local_sgd_replicas(
+            "diloco", num_replicas=2, num_syncs=4, sync_every=2,
+            fail_at={1: 1},
+        )
+        np.testing.assert_array_equal(results[0]["params"], results[1]["params"])
+        np.testing.assert_array_equal(results[0]["backup"], results[1]["backup"])

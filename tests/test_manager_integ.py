@@ -12,6 +12,7 @@ after recovery all replicas' state dicts are **bit-identical**
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass, field
 from datetime import timedelta
@@ -102,11 +103,21 @@ class Runner:
     num_steps: int = 5
     use_async_quorum: bool = True
     attempts: int = 3
+    # Deterministic overlap gate. With only 2 replicas the split-brain guard
+    # blocks the survivor until the dead peer's heartbeat expires, but with
+    # >= 3 the surviving majority can finish (and exit) before the victim
+    # restarts — after which the joiner legitimately trains alone and the
+    # bit-identical oracle no longer applies. Survivors therefore wait at
+    # `gate_step` until `gate_event` is set; the restarting replica sets
+    # `announce_restart` once its new Manager is up.
+    gate_step: Optional[int] = None
+    gate_event: Optional[threading.Event] = None
+    announce_restart: Optional[threading.Event] = None
 
     def run_replica(self) -> Dict[str, Any]:
         for attempt in range(self.attempts):
             try:
-                return self._train_loop()
+                return self._train_loop(attempt)
             except InjectedFailure:
                 logger.info(
                     f"replica {self.replica_id} died (attempt {attempt}); "
@@ -115,7 +126,7 @@ class Runner:
                 continue
         raise RuntimeError(f"replica {self.replica_id} exhausted attempts")
 
-    def _train_loop(self) -> Dict[str, Any]:
+    def _train_loop(self, attempt: int = 0) -> Dict[str, Any]:
         store = Store()
         collectives = HostCollectives(timeout=timedelta(seconds=10))
         state = FTTrainState(_init_state(), optax.sgd(0.1))
@@ -136,8 +147,15 @@ class Runner:
             replica_id=f"replica_{self.replica_id}",
         )
         optimizer = OptimizerWrapper(manager, state)
+        if attempt > 0 and self.announce_restart is not None:
+            self.announce_restart.set()
         try:
             while manager.current_step() < self.num_steps:
+                if (
+                    self.gate_event is not None
+                    and manager.current_step() == self.gate_step
+                ):
+                    assert self.gate_event.wait(timeout=60)
                 self.failure_injector.check(
                     self.replica_id, manager.current_step()
                 )
@@ -165,6 +183,7 @@ def _run_replicas(
     injectors: Optional[List[FailureInjector]] = None,
     use_async_quorum: bool = True,
     min_replicas_lighthouse: int = 1,
+    gates: Optional[Dict[int, Dict[str, Any]]] = None,
 ) -> List[Dict[str, Any]]:
     lighthouse = Lighthouse(
         bind="[::]:0",
@@ -184,6 +203,7 @@ def _run_replicas(
                         failure_injector=injectors[i],
                         num_steps=num_steps,
                         use_async_quorum=use_async_quorum,
+                        **(gates or {}).get(i, {}),
                     ).run_replica
                 )
                 for i in range(num_replicas)
@@ -252,9 +272,22 @@ class TestManagerInteg:
             FailureInjector(),
             FailureInjector().fail_at(2, 1),
         ]
+        # Survivors hold at step 3 until replica 2's restart is live, so the
+        # heal deterministically overlaps their run (see Runner.gate_step).
+        rejoined = threading.Event()
         results = _run_replicas(
-            num_replicas=3, num_steps=5, injectors=injectors
+            num_replicas=3,
+            num_steps=8,
+            injectors=injectors,
+            gates={
+                0: {"gate_step": 3, "gate_event": rejoined},
+                1: {"gate_step": 3, "gate_event": rejoined},
+                2: {"announce_restart": rejoined},
+            },
         )
+        assert injectors[2].count == 1
+        for r in results:
+            assert r["manager_state"]["step"] == 8
         _assert_bitwise_identical(results)
 
     def test_quorum_timeout_fast_fail(self):

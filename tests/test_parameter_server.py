@@ -1,0 +1,54 @@
+"""Parameter server end-to-end in one process.
+Mirrors reference parameter_server_test.py:33-47."""
+
+from datetime import timedelta
+
+import numpy as np
+
+from torchft_tpu.collectives import Collectives, HostCollectives, ReduceOp
+from torchft_tpu.parameter_server import ParameterServer
+
+
+class EchoAverageServer(ParameterServer):
+    """Server that averages one tree with the client, twice."""
+
+    @classmethod
+    def new_collectives(cls) -> Collectives:
+        return HostCollectives(timeout=timedelta(seconds=10))
+
+    def forward(self, session_id: str, collectives: Collectives) -> None:
+        for _ in range(2):
+            collectives.allreduce(
+                {"w": np.full(4, 2.0, np.float32)}, ReduceOp.AVG
+            ).wait()
+        collectives.shutdown()
+
+
+def test_parameter_server_session_roundtrip():
+    server = EchoAverageServer()
+    try:
+        client = EchoAverageServer.new_session(server.address())
+        for _ in range(2):
+            out = client.allreduce(
+                {"w": np.full(4, 4.0, np.float32)}, ReduceOp.AVG
+            ).wait()
+            np.testing.assert_array_equal(out["w"], np.full(4, 3.0))
+        client.shutdown()
+    finally:
+        server.shutdown()
+
+
+def test_multiple_sessions():
+    server = EchoAverageServer()
+    try:
+        for _ in range(2):
+            client = EchoAverageServer.new_session(server.address())
+            out = client.allreduce(
+                {"w": np.zeros(4, np.float32)}, ReduceOp.AVG
+            ).wait()
+            np.testing.assert_array_equal(out["w"], np.full(4, 1.0))
+            # finish the session protocol so the server thread completes
+            client.allreduce({"w": np.zeros(4, np.float32)}, ReduceOp.AVG).wait()
+            client.shutdown()
+    finally:
+        server.shutdown()

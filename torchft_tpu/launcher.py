@@ -1,0 +1,171 @@
+"""Replica-group launcher: one supervised process per replica group.
+
+The reference ships a torchx component producing one torchrun role per
+replica group with ``max_restarts=10`` and the fault-tolerance env plumbed
+through (reference torchft/torchx.py:27-76); process-level restart is
+delegated to torchelastic (reference torchx.py:54). This module plays both
+parts for TPU deployments: ``replica_group_spec`` emits the command + env
+for external schedulers (GKE/xpk-style), and ``launch``/the CLI supervise
+locally with restart-on-failure — the restart half of the recovery story
+(the healing half is the Manager's).
+
+CLI::
+
+    python -m torchft_tpu.launcher --num-replica-groups 2 -- \
+        python examples/train_ddp.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+def replica_group_spec(
+    cmd: Sequence[str],
+    replica_group: int,
+    num_replica_groups: int,
+    lighthouse_addr: str,
+    env: Optional[Dict[str, str]] = None,
+    max_restarts: int = 10,
+) -> Dict[str, object]:
+    """Process spec for one replica group (the reference's torchx role,
+    torchx.py:37-69): command, env, and restart budget."""
+    spec_env = {
+        "TORCHFT_LIGHTHOUSE": lighthouse_addr,
+        "REPLICA_GROUP_ID": str(replica_group),
+        "NUM_REPLICA_GROUPS": str(num_replica_groups),
+        **(env or {}),
+    }
+    return {
+        "name": f"replica_group_{replica_group}",
+        "cmd": list(cmd),
+        "env": spec_env,
+        "max_restarts": max_restarts,
+    }
+
+
+@dataclass
+class _Supervised:
+    spec: Dict[str, object]
+    proc: Optional[subprocess.Popen] = None
+    restarts: int = 0
+    returncode: Optional[int] = None
+
+
+def launch(
+    cmd: Sequence[str],
+    num_replica_groups: int,
+    lighthouse_addr: str,
+    max_restarts: int = 10,
+    env: Optional[Dict[str, str]] = None,
+) -> int:
+    """Runs one process per replica group locally, restarting any that exit
+    non-zero up to ``max_restarts`` times (torchelastic's role in the
+    reference stack). Returns 0 iff every group eventually exited cleanly."""
+    groups = [
+        _Supervised(
+            replica_group_spec(
+                cmd, g, num_replica_groups, lighthouse_addr, env, max_restarts
+            )
+        )
+        for g in range(num_replica_groups)
+    ]
+
+    def spawn(s: _Supervised) -> None:
+        full_env = {**os.environ, **s.spec["env"]}  # type: ignore[arg-type]
+        s.proc = subprocess.Popen(list(s.spec["cmd"]), env=full_env)  # type: ignore[arg-type]
+        logger.info(f"{s.spec['name']}: started pid {s.proc.pid}")
+
+    for s in groups:
+        spawn(s)
+
+    try:
+        while True:
+            running = 0
+            for s in groups:
+                if s.returncode is not None or s.proc is None:
+                    continue
+                rc = s.proc.poll()
+                if rc is None:
+                    running += 1
+                elif rc == 0:
+                    s.returncode = 0
+                    logger.info(f"{s.spec['name']}: exited cleanly")
+                elif s.restarts < int(s.spec["max_restarts"]):  # type: ignore[arg-type]
+                    s.restarts += 1
+                    logger.warning(
+                        f"{s.spec['name']}: exited rc={rc}, restart "
+                        f"{s.restarts}/{s.spec['max_restarts']}"
+                    )
+                    spawn(s)
+                    running += 1
+                else:
+                    s.returncode = rc
+                    logger.error(
+                        f"{s.spec['name']}: exhausted restarts (rc={rc}); "
+                        "failing the job"
+                    )
+                    # A permanently failed group fails the whole job
+                    # (torchelastic semantics): survivors could otherwise
+                    # block forever in quorum waiting for it.
+                    for other in groups:
+                        if other.proc is not None and other.proc.poll() is None:
+                            other.proc.terminate()
+            if running == 0:
+                break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for s in groups:
+            if s.proc is not None and s.proc.poll() is None:
+                s.proc.terminate()
+        raise
+    return 0 if all(s.returncode == 0 for s in groups) else 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="torchft_tpu.launcher",
+        description="Launch one supervised process per replica group.",
+    )
+    parser.add_argument("--num-replica-groups", type=int, default=2)
+    parser.add_argument(
+        "--lighthouse",
+        default=os.environ.get("TORCHFT_LIGHTHOUSE", ""),
+        help="lighthouse address; spawns an in-process one when omitted",
+    )
+    parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument("cmd", nargs="+", help="command to run per group")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    lighthouse = None
+    lighthouse_addr = args.lighthouse
+    if not lighthouse_addr:
+        from . import _native
+
+        lighthouse = _native.Lighthouse(bind="[::]:0", min_replicas=1)
+        lighthouse_addr = lighthouse.address()
+        logger.info(f"started lighthouse at {lighthouse_addr}")
+    try:
+        return launch(
+            args.cmd,
+            num_replica_groups=args.num_replica_groups,
+            lighthouse_addr=lighthouse_addr,
+            max_restarts=args.max_restarts,
+        )
+    finally:
+        if lighthouse is not None:
+            lighthouse.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

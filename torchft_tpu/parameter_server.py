@@ -1,0 +1,130 @@
+"""Prototype fault-tolerant parameter server on reconfigurable collectives.
+
+Reference: torchft/parameter_server.py:31-195. No lighthouse needed
+(reference README.md:142-145): the server owns a rendezvous Store and an
+HTTP endpoint; each ``GET /new_session`` mints a uuid-prefixed store
+namespace, replies with JSON, then hijacks the handler thread to run
+``forward(session_id, collectives)`` over a world-size-2 ring (server
+rank 0, client rank 1). A failed session frees the collectives; the client
+just opens a new session.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import urllib.request
+import uuid
+from abc import ABC, abstractmethod
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import _native
+from .collectives import Collectives
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+
+class ParameterServer(ABC):
+    """Threaded parameter server over the reconfigurable collectives."""
+
+    def __init__(self, port: int = 0) -> None:
+        self.store = _native.Store()
+
+        ps = self
+
+        class RequestHandler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:
+                if self.path != "/new_session":
+                    self.send_error(400, f"invalid path, got {self.path}")
+                    return
+                try:
+                    session_id = str(uuid.uuid4())
+                    store_addr = f"{ps.store.address()}/session/{session_id}"
+                    logger.info(f"creating new session {session_id}")
+
+                    data = (
+                        json.dumps(
+                            {"session_id": session_id, "store_addr": store_addr}
+                        )
+                        + "\n"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    # Close eagerly so the client knows the JSON is complete,
+                    # then hijack this handler thread for the session
+                    # (reference parameter_server.py:91-97).
+                    self.finish()
+                    self.connection.close()
+
+                    ps._handle_session(session_id, store_addr)
+                except Exception:
+                    logger.exception(
+                        f"got exception in request handler for {self.path}"
+                    )
+                    raise
+
+            def log_message(self, format: str, *args: object) -> None:
+                logger.debug(f"parameter server: {format % args}")
+
+        class _Server(ThreadingHTTPServer):
+            address_family = socket.AF_INET6
+            daemon_threads = True
+
+        self._server = _Server(("::", port), RequestHandler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="parameter_server",
+        )
+        self._thread.start()
+        logger.info(f"Started ParameterServer on {self.address()}...")
+
+    def address(self) -> str:
+        """HTTP address for creating sessions: http://host:port/new_session"""
+        port = self._server.socket.getsockname()[1]
+        return f"http://{socket.gethostname()}:{port}/new_session"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._thread.join()
+        self._server.server_close()
+        self.store.shutdown()
+
+    @classmethod
+    @abstractmethod
+    def new_collectives(cls) -> Collectives:
+        """A fresh, unconfigured Collectives for one session (both sides)."""
+
+    @classmethod
+    def new_session(cls, address: str) -> Collectives:
+        """Client side: opens a session, returns collectives configured with
+        the server (server rank 0, client rank 1)."""
+        with urllib.request.urlopen(address) as f:
+            data = json.load(f)
+        session_id = data["session_id"]
+        store_addr = data["store_addr"]
+        logger.info(f"connecting to session {session_id} at {store_addr}")
+
+        collectives = cls.new_collectives()
+        collectives.configure(store_addr, rank=1, world_size=2)
+        return collectives
+
+    def _handle_session(self, session_id: str, store_addr: str) -> None:
+        collectives = self.new_collectives()
+        try:
+            collectives.configure(store_addr, rank=0, world_size=2)
+            self.forward(session_id, collectives)
+        finally:
+            # A finished or failed session frees its collectives (ring
+            # sockets + op thread) immediately, not at GC time.
+            collectives.shutdown()
+
+    @abstractmethod
+    def forward(self, session_id: str, collectives: Collectives) -> None:
+        """Runs once per session on a dedicated thread; loop inside for
+        multiple operations. Errors free the collectives — the client then
+        opens a new session (reference parameter_server.py:177-195)."""
