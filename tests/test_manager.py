@@ -329,6 +329,118 @@ class TestErrorHandling:
         assert client.should_commit.call_args.args[2] is True
         m.shutdown()
 
+    def test_healing_applies_state_dict_even_when_errored(self, store):
+        # An error latched during a healing step must not skip the apply:
+        # the quorum thread already advanced the manager step to max_step,
+        # so without the apply the replica would report max_step on stale
+        # weights and never be healed again (reference manager.py:575-577).
+        loaded = {}
+        m, client, _, transport = _create_manager(
+            store,
+            use_async_quorum=True,
+            min_replica_size=1,
+            load_state_dict=lambda sd: loaded.update(sd),
+        )
+        client.quorum.return_value = _quorum_result(
+            quorum_id=2,
+            replica_rank=1,
+            heal=True,
+            max_step=20,
+            max_rank=None,
+            max_world_size=1,
+            recover_src_manager_address="mock://peer",
+            recover_src_rank=0,
+        )
+        client.checkpoint_metadata.return_value = "peer:meta"
+        transport.recv_checkpoint.return_value = {
+            "user": {"model": "recovered"},
+            "torchft": {"step": 20, "batches_committed": 40},
+        }
+        client.should_commit.return_value = False
+        m.start_quorum()
+        m.wait_quorum()
+        m.report_error(RuntimeError("mid-heal failure"))
+        assert not m.should_commit()
+        # The step aborted, but the recovered weights were still applied —
+        # consistent with the advanced manager step.
+        assert loaded == {"model": "recovered"}
+        assert m.current_step() == 20
+        m.shutdown()
+
+    def test_early_error_does_not_skip_heal_apply(self, store):
+        # An error latched BEFORE any allreduce (so nothing ever waited on
+        # the quorum) must not let should_commit read _healing while the
+        # quorum thread is still fetching: the apply would be skipped while
+        # the step counter advances to max_step — permanent stale weights.
+        import time
+
+        loaded = {}
+        m, client, _, transport = _create_manager(
+            store,
+            use_async_quorum=True,
+            min_replica_size=1,
+            load_state_dict=lambda sd: loaded.update(sd),
+        )
+
+        def slow_quorum(*args, **kwargs):
+            time.sleep(0.3)
+            return _quorum_result(
+                quorum_id=2,
+                replica_rank=1,
+                heal=True,
+                max_step=20,
+                max_rank=None,
+                max_world_size=1,
+                recover_src_manager_address="mock://peer",
+                recover_src_rank=0,
+            )
+
+        client.quorum.side_effect = slow_quorum
+        client.checkpoint_metadata.return_value = "peer:meta"
+        transport.recv_checkpoint.return_value = {
+            "user": {"model": "recovered"},
+            "torchft": {"step": 20, "batches_committed": 40},
+        }
+        client.should_commit.return_value = False
+        m.start_quorum()
+        m.report_error(RuntimeError("pre-allreduce failure"))  # no wait_quorum
+        assert not m.should_commit()
+        assert loaded == {"model": "recovered"}
+        assert m.current_step() == 20
+        m.shutdown()
+
+    def test_failed_quorum_raises_from_allreduce(self, store):
+        # Contract pin: data-plane errors are latched, but a failed quorum
+        # RPC raises out of allreduce via wait_quorum (reference
+        # manager.py:265).
+        m, client, _, _ = _create_manager(store)
+        client.quorum.side_effect = TimeoutError("quorum timed out")
+        m.start_quorum()
+        with pytest.raises(TimeoutError):
+            m.allreduce({"g": np.ones(1)})
+        m.shutdown()
+
+    def test_stale_work_error_does_not_latch_next_step(self, store):
+        # A work abandoned by a fail-fast should_commit that settles with an
+        # error AFTER the next start_quorum must not latch into the new step.
+        m, client, _, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = True
+        m.start_quorum()
+        late: Future = Future()
+        m.wrap_work(Work(late), default="fallback")
+        m.report_error(RuntimeError("step-N error"))  # triggers fail-fast
+        m.should_commit()  # drains; vote value irrelevant here
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.errored() is None
+        late.set_exception(RuntimeError("stale step-N work error"))
+        import time
+
+        time.sleep(0.1)  # let callbacks run
+        assert m.errored() is None  # stale error did not latch
+        m.shutdown()
+
     def test_wrap_work_timeout_returns_default(self, store):
         m, client, _, _ = _create_manager(
             store, timeout=timedelta(milliseconds=100)
@@ -361,6 +473,28 @@ class TestWorldSizeModes:
         assert not m.is_participating()  # spare
         out = m.allreduce({"g": np.full(2, 4.0, np.float32)}).wait()
         np.testing.assert_array_equal(out["g"], np.zeros(2))  # zeroed, /2
+        m.shutdown()
+
+    def test_fixed_with_spares_below_min_aborts(self, store):
+        # Live cohort BELOW min_replica_size: the divisor must follow the
+        # live count (min()-clamped, reference manager.py:459-468) so the
+        # enough-replicas vote fails and the step aborts — it must NOT be
+        # pinned to min_replica_size (which would commit a lone replica's
+        # halved gradient).
+        m, client, _, _ = _create_manager(
+            store,
+            min_replica_size=2,
+            world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+        )
+        client.quorum.return_value = _quorum_result(
+            replica_rank=0, replica_world_size=1, max_rank=0, max_world_size=1
+        )
+        client.should_commit.return_value = False
+        m.start_quorum()
+        assert m.num_participants() == 1  # live count, not min_replica_size
+        assert not m.should_commit()
+        assert client.should_commit.call_args.args[2] is False  # local vote
+        assert m.current_step() == 0
         m.shutdown()
 
     def test_fixed_with_spares_participant(self, store):

@@ -149,6 +149,7 @@ class Manager:
         self._batches_committed = 0
         self._quorum_id = -1
         self._errored: Optional[Exception] = None
+        self._op_epoch = 0
         self._healing = False
         self._pending_work: List[Work] = []
         self._pending_state_dict: Optional[Dict[str, object]] = None
@@ -219,6 +220,10 @@ class Manager:
             except Exception:
                 pass
 
+        # Epoch first: a stale work's error callback firing between these
+        # two statements must already fail the epoch check, or it would
+        # latch into the step whose _errored was just cleared.
+        self._op_epoch += 1
         self._errored = None
         self._healing = False
         self._pending_work = []
@@ -270,13 +275,15 @@ class Manager:
 
         if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
             # Spares join collectives with zeroed grads; the divisor stays
-            # fixed so numerics never change under churn (reference :460-468).
+            # fixed so numerics never change under churn. Clamped with min()
+            # so a cohort BELOW min_replica_size still fails the
+            # enough-replicas vote in should_commit (reference :459-468).
             if (
                 participating_rank is not None
                 and participating_rank >= self._min_replica_size
             ):
                 participating_rank = None
-            participating_world = self._min_replica_size
+            participating_world = min(participating_world, self._min_replica_size)
 
         self._participating_rank = participating_rank
         self._participating_world_size = participating_world
@@ -335,6 +342,9 @@ class Manager:
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "apply_pending_state_dict called when not healing"
+        # Settle the quorum thread first: it is the writer of
+        # _pending_state_dict (reference manager.py:531-532).
+        self.wait_quorum()
         assert (
             self._pending_state_dict is not None
         ), "checkpoint was not fetched before apply"
@@ -348,11 +358,15 @@ class Manager:
     def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.AVG) -> Work:
         """Fault-tolerantly averages a gradient pytree across replica groups.
 
-        Never raises: on error the returned Work resolves to the INPUT tree
-        and the error is latched for ``should_commit`` (reference
-        manager.py:242-303). Non-participating (healing/spare) replicas
-        contribute zeros. ``op`` must be AVG (divide by ``num_participants``,
-        the live divisor, reference :279-291) or SUM.
+        Data-plane errors never raise: on a collective failure the returned
+        Work resolves to the INPUT tree and the error is latched for
+        ``should_commit`` (reference manager.py:242-303). A failed or
+        timed-out QUORUM, however, DOES raise out of this call (via
+        ``wait_quorum``) — membership failure means the step cannot proceed
+        at all, matching reference manager.py:265. Non-participating
+        (healing/spare) replicas contribute zeros. ``op`` must be AVG
+        (divide by ``num_participants``, the live divisor, reference
+        :279-291) or SUM.
         """
         if self.errored() is not None:
             return _completed(tree)
@@ -388,6 +402,7 @@ class Manager:
         error is latched and ``default`` is returned (reference
         manager.py:326-363)."""
         timed = work_timeout(work, timeout or self._timeout)
+        epoch = self._op_epoch
 
         def swallow() -> Work:
             from concurrent.futures import Future
@@ -398,7 +413,12 @@ class Manager:
                 exc = f.exception()
                 if exc is not None:
                     self._logger.exception(f"async work failed: {exc}")
-                    self.report_error(cast(Exception, exc))
+                    if epoch == self._op_epoch:
+                        # Works abandoned by a fail-fast should_commit may
+                        # settle during a LATER step; their errors belong to
+                        # the (already aborted) step that issued them and
+                        # must not latch into the current one.
+                        self.report_error(cast(Exception, exc))
                     out.set_result(default)
                 else:
                     out.set_result(f.result())
@@ -428,11 +448,24 @@ class Manager:
         Returns True iff every rank of every participating replica group
         completed the step without errors and quorum size >= min_replica_size.
         """
+        # Settle the quorum thread before reading _healing/_errored: it is
+        # their writer, and an early-errored step may reach here without any
+        # allreduce having waited on it. (A failed quorum raises, as it
+        # would from num_participants below.)
+        self.wait_quorum()
+
         for work in self._pending_work:
+            if self._errored is not None:
+                break
             work.wait()  # error-swallowing: never raises, latches instead
         self._pending_work = []
 
-        if self._errored is None and self._healing:
+        # Apply the fetched checkpoint whenever healing — even if an error
+        # latched this step. The manager step was already advanced to
+        # max_step by the quorum thread, so skipping the apply would leave
+        # this replica reporting max_step on stale weights and never healed
+        # again (reference manager.py:575-577 applies unconditionally).
+        if self._healing:
             self._apply_pending_state_dict()
 
         local_should_commit = (
