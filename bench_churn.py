@@ -1,0 +1,358 @@
+"""Churn benchmark: throughput under replica-group kills (the north star).
+
+Measures the driver-set target from BASELINE.md: steps/sec with one
+replica-group kill every ``--kill-every`` steps must stay >= 90% of
+healthy-state steps/sec. The reference makes this claim qualitatively
+("avoid stop the world training on errors", reference README.md:46-47) and
+exercises the recovery flow in tests (reference torchft/manager.py:470-526);
+this benchmark puts a number on it.
+
+Topology: N replica groups as local processes (CPU JAX), one real
+HostCollectives TCP ring between them, one lighthouse. Two phases with the
+same model/config:
+
+  healthy: all groups train ``--steps`` steps, no faults.
+  churn:   a supervisor SIGKILLs one (rotating, never group 0) group each
+           time group 0 commits ``--kill-every`` more steps, then restarts
+           it; the restarted process heals from a live peer over HTTP.
+
+Reported (CHURN_BENCH.json + one JSON line on stdout):
+  steps_per_sec_healthy / steps_per_sec_churn  (group 0's committed steps)
+  ratio  = churn / healthy       (north star: >= 0.90)
+  heal_p50_s = median time from SIGKILL to the restarted group's first
+               committed step (includes process restart + jit recompile —
+               on real multi-host deployments each group has its own host,
+               so single-host numbers are pessimistic: the restarting
+               process competes for this machine's CPUs).
+
+Usage::
+
+    python bench_churn.py --groups 4 --steps 300 --kill-every 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+# --------------------------------------------------------------------------
+# worker: one replica group
+# --------------------------------------------------------------------------
+
+
+def worker() -> None:
+    """Trains the flagship transformer (small config) with the full FT path,
+    appending one JSONL record per attempted step."""
+    from torchft_tpu.platform import apply_jax_platform_env
+
+    apply_jax_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from datetime import timedelta
+
+    from torchft_tpu import (
+        FTTrainState,
+        HostCollectives,
+        Manager,
+        OptimizerWrapper,
+    )
+    from torchft_tpu.models import TransformerConfig, init_params, loss_fn
+
+    group = int(os.environ["REPLICA_GROUP_ID"])
+    num_steps = int(os.environ["NUM_STEPS"])
+    log_path = os.environ["BENCH_LOG"]
+
+    cfg = TransformerConfig(
+        vocab_size=2048, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        max_seq_len=64,
+    )
+    batch_size, seq_len = 4, 64
+    rng = np.random.default_rng(group)
+    batch = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(batch_size, seq_len), dtype=np.int32)
+    )
+
+    state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), optax.adamw(1e-3))
+    grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
+
+    # Compile BEFORE joining the quorum, then hold at the start line until
+    # every group is ready (parent touches the go file). Without this the
+    # first group up forms a solo quorum and races at world-size-1 speed
+    # while peers are still importing/compiling, polluting the measured
+    # window. Restarted workers find the go file already present and rejoin
+    # immediately through the normal heal path.
+    jax.block_until_ready(grad_fn(state.params, batch))
+    go_path = os.environ["BENCH_GO"]
+    open(log_path + ".ready", "w").close()
+    while not os.path.exists(go_path):
+        time.sleep(0.05)
+
+    collectives = HostCollectives(timeout=timedelta(seconds=30))
+    manager = Manager(
+        collectives=collectives,
+        load_state_dict=state.load_state_dict,
+        state_dict=state.state_dict,
+        min_replica_size=1,
+        heartbeat_interval=timedelta(milliseconds=50),
+        replica_id=f"bench_{group}",
+    )
+    optimizer = OptimizerWrapper(manager, state)
+
+    with open(log_path, "a", buffering=1) as log:
+        while manager.current_step() < num_steps:
+            t0 = time.perf_counter()
+            optimizer.zero_grad()
+            t1 = time.perf_counter()
+            loss, grads = grad_fn(state.params, batch)
+            jax.block_until_ready(grads)
+            t2 = time.perf_counter()
+            avg = manager.allreduce(grads).wait()
+            t3 = time.perf_counter()
+            committed = optimizer.step(avg)
+            t4 = time.perf_counter()
+            log.write(
+                json.dumps(
+                    {
+                        "t": time.time(),
+                        "step": manager.current_step(),
+                        "committed": bool(committed),
+                        "participants": manager.num_participants(),
+                        "ms": {
+                            "quorum_start": round((t1 - t0) * 1e3, 1),
+                            "grad": round((t2 - t1) * 1e3, 1),
+                            "allreduce": round((t3 - t2) * 1e3, 1),
+                            "commit": round((t4 - t3) * 1e3, 1),
+                        },
+                    }
+                )
+                + "\n"
+            )
+    manager.shutdown()
+    collectives.shutdown()
+
+
+# --------------------------------------------------------------------------
+# parent: orchestration + measurement
+# --------------------------------------------------------------------------
+
+
+class _Group:
+    def __init__(self, gid: int, log_path: str, env: Dict[str, str]) -> None:
+        self.gid = gid
+        self.log_path = log_path
+        self.env = env
+        self.proc: Optional[subprocess.Popen] = None
+
+    def spawn(self) -> None:
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env={**os.environ, **self.env},
+            cwd=REPO,
+        )
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+def _read_log(path: str) -> List[dict]:
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn write
+    except FileNotFoundError:
+        pass
+    return records
+
+
+def _committed(records: List[dict]) -> List[dict]:
+    return [r for r in records if r["committed"]]
+
+
+def _steps_per_sec(records: List[dict], skip: int = 5) -> float:
+    """Committed steps/sec, excluding the first ``skip`` commits (compile +
+    ramp)."""
+    done = _committed(records)[skip:]
+    if len(done) < 2:
+        return 0.0
+    return (len(done) - 1) / (done[-1]["t"] - done[0]["t"])
+
+
+def _run_phase(
+    name: str,
+    groups: int,
+    steps: int,
+    kill_every: int,
+    out_dir: str,
+    lighthouse_addr: str,
+) -> dict:
+    go_path = os.path.join(out_dir, f"{name}.go")
+    gs: List[_Group] = []
+    for g in range(groups):
+        log_path = os.path.join(out_dir, f"{name}_g{g}.jsonl")
+        gs.append(
+            _Group(
+                g,
+                log_path,
+                {
+                    "JAX_PLATFORMS": "cpu",
+                    "TORCHFT_LIGHTHOUSE": lighthouse_addr,
+                    "REPLICA_GROUP_ID": str(g),
+                    "NUM_REPLICA_GROUPS": str(groups),
+                    "NUM_STEPS": str(steps),
+                    "BENCH_LOG": log_path,
+                    "BENCH_GO": go_path,
+                },
+            )
+        )
+    for g in gs:
+        g.spawn()
+
+    # Start line: release every group at once, after all have compiled.
+    ready_deadline = time.time() + 300
+    while time.time() < ready_deadline:
+        if all(os.path.exists(g.log_path + ".ready") for g in gs):
+            break
+        time.sleep(0.25)
+    open(go_path, "w").close()
+
+    kills: List[dict] = []
+    next_kill = kill_every if kill_every > 0 else None
+    victim = 1  # rotate over groups 1..N-1; group 0 is the measurement group
+    deadline = time.time() + 1200
+    try:
+        while any(g.alive() for g in gs) and time.time() < deadline:
+            time.sleep(0.25)
+            # Restart any dead group (supervisor role, launcher semantics).
+            for g in gs:
+                if g.proc is not None and g.proc.poll() not in (None, 0):
+                    g.spawn()
+            if next_kill is not None:
+                lead = len(_committed(_read_log(gs[0].log_path)))
+                if lead >= next_kill and lead < steps - 5:
+                    v = gs[victim]
+                    if v.alive():
+                        v.proc.send_signal(signal.SIGKILL)
+                        kills.append(
+                            {"t": time.time(), "gid": v.gid, "at_step": lead}
+                        )
+                        victim = victim % (groups - 1) + 1
+                    next_kill += kill_every
+    finally:
+        for g in gs:
+            if g.alive():
+                g.proc.terminate()
+        for g in gs:
+            if g.proc is not None:
+                try:
+                    g.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    g.proc.kill()
+
+    # Heal latency: kill -> first commit recorded by the restarted process.
+    heal_s = []
+    for k in kills:
+        log = _read_log(gs[k["gid"]].log_path)
+        after = [r["t"] for r in _committed(log) if r["t"] > k["t"]]
+        if after:
+            heal_s.append(after[0] - k["t"])
+    heal_s.sort()
+
+    return {
+        "steps_per_sec": round(_steps_per_sec(_read_log(gs[0].log_path)), 3),
+        "kills": len(kills),
+        "heal_s": [round(h, 2) for h in heal_s],
+        "heal_p50_s": round(heal_s[len(heal_s) // 2], 2) if heal_s else None,
+        "committed_steps_g0": len(_committed(_read_log(gs[0].log_path))),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--groups", type=int, default=4)
+    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--kill-every", type=int, default=100)
+    parser.add_argument("--out", default=os.path.join(REPO, "CHURN_BENCH.json"))
+    args = parser.parse_args()
+
+    if args.worker:
+        worker()
+        return
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from torchft_tpu import Lighthouse
+
+    out_dir = os.path.join(REPO, ".bench_churn_logs")
+    os.makedirs(out_dir, exist_ok=True)
+    for f in os.listdir(out_dir):
+        os.unlink(os.path.join(out_dir, f))
+
+    # Fast failure detection so a kill costs survivors ~join_timeout, not
+    # the CLI-default 60 s (reference defaults: src/lighthouse.rs:77-102).
+    lighthouse = Lighthouse(
+        bind="[::]:0",
+        min_replicas=1,
+        join_timeout_ms=200,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=500,
+    )
+
+    healthy = _run_phase(
+        "healthy", args.groups, args.steps, 0, out_dir, lighthouse.address()
+    )
+    churn = _run_phase(
+        "churn", args.groups, args.steps, args.kill_every, out_dir,
+        lighthouse.address(),
+    )
+    lighthouse.shutdown()
+
+    ratio = (
+        round(churn["steps_per_sec"] / healthy["steps_per_sec"], 3)
+        if healthy["steps_per_sec"]
+        else 0.0
+    )
+    result = {
+        "config": {
+            "groups": args.groups,
+            "steps": args.steps,
+            "kill_every": args.kill_every,
+            "host_cpus": os.cpu_count(),
+        },
+        "healthy": healthy,
+        "churn": churn,
+        "ratio": ratio,
+        "target": 0.90,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(
+        json.dumps(
+            {
+                "metric": "steps_per_sec_churn_ratio",
+                "value": ratio,
+                "unit": "ratio",
+                "vs_baseline": round(ratio / 0.90, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,12 +23,16 @@ import os
 import sys
 from datetime import timedelta
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from torchft_tpu.platform import apply_jax_platform_env  # noqa: E402
+
+apply_jax_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
 
 from torchft_tpu import (  # noqa: E402
     DistributedSampler,

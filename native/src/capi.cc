@@ -148,10 +148,12 @@ void tft_client_destroy(void* handle) {
 
 int tft_client_quorum(void* handle, int64_t rank, int64_t step,
                       const char* checkpoint_metadata, int shrink_only,
-                      int64_t timeout_ms, char** result_json) {
+                      int force_reconfigure, int64_t timeout_ms,
+                      char** result_json) {
   return guarded([&] {
     auto resp = static_cast<ManagerClient*>(handle)->quorum(
-        rank, step, checkpoint_metadata, shrink_only != 0, timeout_ms);
+        rank, step, checkpoint_metadata, shrink_only != 0,
+        force_reconfigure != 0, timeout_ms);
     *result_json = dup_string(quorum_response_to_json(resp).dump());
   });
 }

@@ -222,33 +222,38 @@ void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
   std::lock_guard<std::mutex> lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1 || count == 0) return;
-  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
-  char* bytes = static_cast<char*>(data);
-  size_t esize = dtype_size(dtype);
-  size_t max_chunk = count / world_size_ + 1;
-  std::vector<char> recv_tmp(max_chunk * esize);
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    char* bytes = static_cast<char*>(data);
+    size_t esize = dtype_size(dtype);
+    size_t max_chunk = count / world_size_ + 1;
+    std::vector<char> recv_tmp(max_chunk * esize);
 
-  // Reduce-scatter: after step s, chunk (rank - s) has accumulated the values
-  // of ranks rank-s..rank. After ws-1 steps chunk (rank+1) holds the full
-  // reduction at this rank — computed in the identical rank order everywhere.
-  for (int64_t s = 0; s < world_size_ - 1; s++) {
-    int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-    int64_t recv_c = ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
-    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-    duplex(bytes + s_start * esize, s_len * esize, recv_tmp.data(),
-           r_len * esize, deadline);
-    reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
-  }
-  // Allgather: circulate the fully-reduced chunks.
-  for (int64_t s = 0; s < world_size_ - 1; s++) {
-    int64_t send_c = ((rank_ + 1 - s) % world_size_ + world_size_) % world_size_;
-    int64_t recv_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-    duplex(bytes + s_start * esize, s_len * esize, bytes + r_start * esize,
-           r_len * esize, deadline);
-  }
+    // Reduce-scatter: after step s, chunk (rank - s) has accumulated the
+    // values of ranks rank-s..rank. After ws-1 steps chunk (rank+1) holds the
+    // full reduction at this rank — computed in the identical rank order
+    // everywhere.
+    for (int64_t s = 0; s < world_size_ - 1; s++) {
+      int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+      int64_t recv_c =
+          ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
+      auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+      duplex(bytes + s_start * esize, s_len * esize, recv_tmp.data(),
+             r_len * esize, deadline);
+      reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
+    }
+    // Allgather: circulate the fully-reduced chunks.
+    for (int64_t s = 0; s < world_size_ - 1; s++) {
+      int64_t send_c =
+          ((rank_ + 1 - s) % world_size_ + world_size_) % world_size_;
+      int64_t recv_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+      auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+      duplex(bytes + s_start * esize, s_len * esize, bytes + r_start * esize,
+             r_len * esize, deadline);
+    }
+  });
 }
 
 void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
@@ -258,13 +263,16 @@ void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
   char* slots = static_cast<char*>(out);
   memcpy(slots + rank_ * nbytes, in, nbytes);
   if (world_size_ == 1 || nbytes == 0) return;
-  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
-  for (int64_t s = 0; s < world_size_ - 1; s++) {
-    int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-    int64_t recv_c = ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
-    duplex(slots + send_c * nbytes, nbytes, slots + recv_c * nbytes, nbytes,
-           deadline);
-  }
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    for (int64_t s = 0; s < world_size_ - 1; s++) {
+      int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+      int64_t recv_c =
+          ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
+      duplex(slots + send_c * nbytes, nbytes, slots + recv_c * nbytes, nbytes,
+             deadline);
+    }
+  });
 }
 
 void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
@@ -273,37 +281,41 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1 || nbytes == 0) return;
   if (root < 0 || root >= world_size_) throw SocketError("bad broadcast root");
-  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
-  char* bytes = static_cast<char*>(data);
-  // Forward around the ring, root first; the last hop before root does not
-  // send. recv-then-send per hop (latency is fine at control-plane sizes;
-  // bulk weight transfer goes through the checkpoint transport instead).
-  if (rank_ == root) {
-    duplex(bytes, nbytes, nullptr, 0, deadline);
-  } else {
-    duplex(nullptr, 0, bytes, nbytes, deadline);
-    if ((rank_ + 1) % world_size_ != root)
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    char* bytes = static_cast<char*>(data);
+    // Forward around the ring, root first; the last hop before root does not
+    // send. recv-then-send per hop (latency is fine at control-plane sizes;
+    // bulk weight transfer goes through the checkpoint transport instead).
+    if (rank_ == root) {
       duplex(bytes, nbytes, nullptr, 0, deadline);
-  }
+    } else {
+      duplex(nullptr, 0, bytes, nbytes, deadline);
+      if ((rank_ + 1) % world_size_ != root)
+        duplex(bytes, nbytes, nullptr, 0, deadline);
+    }
+  });
 }
 
 void HostCollectives::barrier(int64_t timeout_ms) {
   std::lock_guard<std::mutex> lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
-  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
-  // Two full ring passes: after the first, rank 0 knows everyone arrived;
-  // the second releases everyone.
-  char token = 1;
-  for (int round = 0; round < 2; round++) {
-    if (rank_ == 0) {
-      duplex(&token, 1, nullptr, 0, deadline);
-      duplex(nullptr, 0, &token, 1, deadline);
-    } else {
-      duplex(nullptr, 0, &token, 1, deadline);
-      duplex(&token, 1, nullptr, 0, deadline);
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // Two full ring passes: after the first, rank 0 knows everyone arrived;
+    // the second releases everyone.
+    char token = 1;
+    for (int round = 0; round < 2; round++) {
+      if (rank_ == 0) {
+        duplex(&token, 1, nullptr, 0, deadline);
+        duplex(nullptr, 0, &token, 1, deadline);
+      } else {
+        duplex(nullptr, 0, &token, 1, deadline);
+        duplex(&token, 1, nullptr, 0, deadline);
+      }
     }
-  }
+  });
 }
 
 } // namespace tft

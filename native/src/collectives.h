@@ -82,6 +82,25 @@ class HostCollectives {
   void duplex(const char* send_buf, size_t send_len, char* recv_buf,
               size_t recv_len, int64_t deadline_ms);
 
+  // Runs an op body; on ANY failure shuts down both ring sockets before
+  // rethrowing. The FIN propagates the failure around the ring: every
+  // member's in-flight op fails within milliseconds instead of blocking on
+  // its timeout while a majority of survivors can't reach the next quorum —
+  // the distributed analog of NCCL's abort-on-error. The dead ring stays
+  // dead (ops throw immediately) until the next configure().
+  template <typename Fn>
+  void run_op(Fn&& fn) {
+    try {
+      fn();
+    } catch (...) {
+      std::lock_guard<std::mutex> lock(cfg_mu_);
+      next_.shutdown_rdwr();
+      prev_.shutdown_rdwr();
+      aborted_ = true;
+      throw;
+    }
+  }
+
   // Guards socket object identity (swap/close) against concurrent abort.
   // Never held across blocking IO, so abort() always runs promptly.
   std::mutex cfg_mu_;

@@ -78,6 +78,15 @@ void Lighthouse::quorum_tick_locked() {
                                    state_.prev_quorum->participants().end());
     changed = quorum_changed(participants, prev);
   }
+  // A member with a failed data plane needs everyone to rebuild on a fresh
+  // rendezvous namespace, which only a quorum_id bump triggers.
+  for (const auto& p : participants) {
+    if (p.force_reconfigure()) {
+      changed = true;
+      LOG_INFO("Member " << p.replica_id() << " requested reconfigure");
+      break;
+    }
+  }
   if (changed) {
     state_.quorum_id += 1;
     LOG_INFO("Detected quorum change, bumping quorum_id to " << state_.quorum_id);

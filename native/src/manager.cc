@@ -175,6 +175,7 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
   // Stash checkpoint server info for the healing flow.
   checkpoint_metadata_[req.rank()] = req.checkpoint_metadata();
   participants_.insert(req.rank());
+  if (req.force_reconfigure()) force_reconfigure_pending_ = true;
   int64_t gen = quorum_gen_;
 
   if (participants_.size() >= world_size_) {
@@ -190,6 +191,8 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
     requester.set_step(req.step());
     requester.set_world_size(world_size_);
     requester.set_shrink_only(req.shrink_only());
+    requester.set_force_reconfigure(force_reconfigure_pending_);
+    force_reconfigure_pending_ = false;
     try {
       Quorum quorum = lighthouse_client_->quorum(requester, req.timeout_ms());
       LOG_INFO("got lighthouse quorum id=" << quorum.quorum_id());
@@ -339,12 +342,13 @@ Resp ManagerClient::roundtrip(uint8_t req_type, const Req& req, uint8_t resp_typ
 
 torchft_tpu::ManagerQuorumResponse ManagerClient::quorum(
     int64_t rank, int64_t step, const std::string& checkpoint_metadata,
-    bool shrink_only, int64_t timeout_ms) {
+    bool shrink_only, bool force_reconfigure, int64_t timeout_ms) {
   torchft_tpu::ManagerQuorumRequest req;
   req.set_rank(rank);
   req.set_step(step);
   req.set_checkpoint_metadata(checkpoint_metadata);
   req.set_shrink_only(shrink_only);
+  req.set_force_reconfigure(force_reconfigure);
   req.set_timeout_ms(timeout_ms);
   return roundtrip<torchft_tpu::ManagerQuorumRequest,
                    torchft_tpu::ManagerQuorumResponse>(

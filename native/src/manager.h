@@ -72,6 +72,8 @@ class ManagerServer {
   // Reference: src/manager.rs:40-48 (ManagerState).
   std::map<int64_t, std::string> checkpoint_metadata_;
   std::set<int64_t> participants_;
+  // OR of local ranks' force_reconfigure since the last lighthouse forward.
+  bool force_reconfigure_pending_ = false;
   std::condition_variable quorum_cv_;
   int64_t quorum_gen_ = 0;
   torchft_tpu::Quorum latest_quorum_;
@@ -101,7 +103,9 @@ class ManagerClient {
 
   torchft_tpu::ManagerQuorumResponse quorum(int64_t rank, int64_t step,
                                             const std::string& checkpoint_metadata,
-                                            bool shrink_only, int64_t timeout_ms);
+                                            bool shrink_only,
+                                            bool force_reconfigure,
+                                            int64_t timeout_ms);
   std::string checkpoint_metadata(int64_t rank, int64_t timeout_ms);
   bool should_commit(int64_t rank, int64_t step, bool should_commit,
                      int64_t timeout_ms);

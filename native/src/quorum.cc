@@ -218,6 +218,7 @@ Json member_to_json(const QuorumMember& m) {
   o["step"] = m.step();
   o["world_size"] = static_cast<int64_t>(m.world_size());
   o["shrink_only"] = m.shrink_only();
+  o["force_reconfigure"] = m.force_reconfigure();
   return Json(std::move(o));
 }
 
@@ -229,6 +230,7 @@ QuorumMember member_from_json(const Json& j) {
   m.set_step(j.get_int("step", 0));
   m.set_world_size(static_cast<uint64_t>(j.get_int("world_size", 1)));
   m.set_shrink_only(j.get_bool("shrink_only", false));
+  m.set_force_reconfigure(j.get_bool("force_reconfigure", false));
   return m;
 }
 

@@ -7,6 +7,7 @@ directly, and reconfiguration / peer-death behavior is asserted.
 """
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 
@@ -228,6 +229,42 @@ class TestHostCollectives:
         with pytest.raises(RuntimeError):
             cols[0].allreduce(np.ones(1024, np.float32)).wait()
         cols[0].shutdown()
+
+    def test_ring_failure_propagates_to_all_members(self, store):
+        # One member's death must fail EVERY member's in-flight op within
+        # milliseconds (each failing member shuts its ring sockets down,
+        # sweeping EOF around the ring) — not just its direct neighbors.
+        # Otherwise non-adjacent members block on the full op timeout and a
+        # majority of survivors can never reach the next quorum to heal.
+        cols = _make_ring(store, 4, timeout=timedelta(seconds=30))
+        big = np.ones(1 << 20, np.float32)
+        works = [cols[r].allreduce(big.copy()) for r in range(3)]
+        threading.Timer(0.3, cols[3].shutdown).start()  # rank 3 dies mid-op
+        start = time.monotonic()
+        for w in works:
+            with pytest.raises(RuntimeError):
+                w.wait(timeout=timedelta(seconds=20))
+        elapsed = time.monotonic() - start
+        assert elapsed < 5.0, f"failure took {elapsed:.1f}s to propagate"
+        # The ring is down until reconfigured: ops fail fast, no hang.
+        with pytest.raises(RuntimeError):
+            cols[0].allreduce(np.ones(4, np.float32)).wait()
+        # A fresh configure (new prefix, as a new quorum provides) restores
+        # service for the survivors.
+        addr = f"{store.address()}/q_rebuilt"
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            futs = [
+                ex.submit(cols[r].configure, addr, r, 3) for r in range(3)
+            ]
+            for f in futs:
+                f.result()
+        out = _run_all(
+            cols[:3], lambda r, c: c.allreduce(np.ones(8, np.float32)).wait()
+        )
+        for o in out:
+            np.testing.assert_array_equal(o, np.full(8, 3.0))
+        for c in cols[:3]:
+            c.shutdown()
 
     def test_abort_unblocks_inflight_op(self, store):
         cols = _make_ring(store, 2, timeout=timedelta(seconds=30))

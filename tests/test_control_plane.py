@@ -132,6 +132,25 @@ class TestLighthouse:
         m.shutdown()
         store.shutdown()
 
+    def test_force_reconfigure_bumps_quorum_id(self, lighthouse):
+        # A member whose data plane failed requests force_reconfigure: the
+        # lighthouse must bump quorum_id even though membership is
+        # unchanged, so every member rebuilds on a fresh rendezvous prefix.
+        store = Store()
+        m = Manager(
+            "fr", lighthouse.address(), "localhost", "[::]:0", store.address(), 1
+        )
+        client = ManagerClient(m.address())
+        r1 = client.quorum(0, 1, "md", timeout=TIMEOUT)
+        r2 = client.quorum(0, 2, "md", timeout=TIMEOUT)
+        assert r2.quorum_id == r1.quorum_id  # same membership: stable id
+        r3 = client.quorum(0, 3, "md", force_reconfigure=True, timeout=TIMEOUT)
+        assert r3.quorum_id == r1.quorum_id + 1
+        r4 = client.quorum(0, 4, "md", timeout=TIMEOUT)
+        assert r4.quorum_id == r3.quorum_id  # one-shot: flag does not stick
+        m.shutdown()
+        store.shutdown()
+
     # Reference src/lighthouse.rs:1036-1140 (test_lighthouse_join_during_shrink).
     def test_join_during_shrink(self):
         lh = Lighthouse(min_replicas=2, join_timeout_ms=1000)

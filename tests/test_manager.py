@@ -312,6 +312,28 @@ class TestErrorHandling:
         assert col.op_count == 0  # never reached the collectives
         m.shutdown()
 
+    def test_error_requests_force_reconfigure(self, store):
+        # A latched error leaves the ring sockets shut down (native
+        # fail-fast propagation); the next quorum request must carry
+        # force_reconfigure so every member rebuilds even when membership
+        # is unchanged. The flag is one-shot.
+        m, client, _, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = False
+        m.start_quorum()
+        m.wait_quorum()
+        assert client.quorum.call_args.kwargs["force_reconfigure"] is False
+        m.report_error(RuntimeError("ring failed"))
+        m.should_commit()
+        m.start_quorum()
+        m.wait_quorum()
+        assert client.quorum.call_args.kwargs["force_reconfigure"] is True
+        m.should_commit()
+        m.start_quorum()
+        m.wait_quorum()
+        assert client.quorum.call_args.kwargs["force_reconfigure"] is False
+        m.shutdown()
+
     def test_error_cleared_by_next_quorum(self, store):
         m, client, _, _ = _create_manager(store)
         client.quorum.return_value = _quorum_result()

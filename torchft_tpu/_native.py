@@ -77,6 +77,7 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_char_p,
         ctypes.c_int,
+        ctypes.c_int,
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_void_p),
     ]
@@ -340,6 +341,7 @@ class ManagerClient:
         step: int,
         checkpoint_metadata: str,
         shrink_only: bool = False,
+        force_reconfigure: bool = False,
         timeout: timedelta = timedelta(seconds=60),
     ) -> QuorumResult:
         out = ctypes.c_void_p()
@@ -350,6 +352,7 @@ class ManagerClient:
                 step,
                 checkpoint_metadata.encode(),
                 1 if shrink_only else 0,
+                1 if force_reconfigure else 0,
                 _ms(timeout),
                 ctypes.byref(out),
             )

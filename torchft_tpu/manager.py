@@ -150,6 +150,12 @@ class Manager:
         self._quorum_id = -1
         self._errored: Optional[Exception] = None
         self._op_epoch = 0
+        # Makes the {epoch check -> error latch} in work callbacks atomic
+        # against the {epoch bump -> error clear} in start_quorum; without
+        # it a stale callback could pass the check, lose the GIL across the
+        # bump+clear, then latch into the new step.
+        self._error_lock = threading.Lock()
+        self._force_reconfigure = False
         self._healing = False
         self._pending_work: List[Work] = []
         self._pending_state_dict: Optional[Dict[str, object]] = None
@@ -220,11 +226,9 @@ class Manager:
             except Exception:
                 pass
 
-        # Epoch first: a stale work's error callback firing between these
-        # two statements must already fail the epoch check, or it would
-        # latch into the step whose _errored was just cleared.
-        self._op_epoch += 1
-        self._errored = None
+        with self._error_lock:
+            self._op_epoch += 1
+            self._errored = None
         self._healing = False
         self._pending_work = []
         self._quorum_future = self._executor.submit(
@@ -252,13 +256,26 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: timedelta
     ) -> None:
-        result = self._client.quorum(
-            rank=self._rank,
-            step=self._step,
-            checkpoint_metadata=self._checkpoint_transport.metadata(),
-            shrink_only=shrink_only,
-            timeout=quorum_timeout,
-        )
+        # Atomically consume the rebuild request so a report_error racing
+        # with the RPC can't be wiped by an unconditional clear afterwards;
+        # restore it if the RPC fails (the rebuild still hasn't happened).
+        with self._error_lock:
+            force_reconfigure = self._force_reconfigure
+            self._force_reconfigure = False
+        try:
+            result = self._client.quorum(
+                rank=self._rank,
+                step=self._step,
+                checkpoint_metadata=self._checkpoint_transport.metadata(),
+                shrink_only=shrink_only,
+                force_reconfigure=force_reconfigure,
+                timeout=quorum_timeout,
+            )
+        except Exception:
+            if force_reconfigure:
+                with self._error_lock:
+                    self._force_reconfigure = True
+            raise
 
         quorum_id = result.quorum_id
         store_address = result.store_address
@@ -413,12 +430,15 @@ class Manager:
                 exc = f.exception()
                 if exc is not None:
                     self._logger.exception(f"async work failed: {exc}")
-                    if epoch == self._op_epoch:
-                        # Works abandoned by a fail-fast should_commit may
-                        # settle during a LATER step; their errors belong to
-                        # the (already aborted) step that issued them and
-                        # must not latch into the current one.
-                        self.report_error(cast(Exception, exc))
+                    with self._error_lock:
+                        if epoch == self._op_epoch:
+                            # Works abandoned by a fail-fast should_commit
+                            # may settle during a LATER step; their errors
+                            # belong to the (already aborted) step that
+                            # issued them and must not latch into the
+                            # current one.
+                            self._errored = cast(Exception, exc)
+                            self._force_reconfigure = True
                     out.set_result(default)
                 else:
                     out.set_result(f.result())
@@ -434,8 +454,16 @@ class Manager:
 
     def report_error(self, e: Exception) -> None:
         """Latch an error: the current step will not commit and collectives
-        are no-ops until the next quorum (reference manager.py:305-317)."""
-        self._errored = e
+        are no-ops until the next quorum (reference manager.py:305-317).
+
+        Any error also requests a data-plane rebuild through the next quorum
+        (``force_reconfigure``): a failed ring op shuts the ring down
+        (native fail-fast propagation), and if membership happens to be
+        unchanged the quorum_id would otherwise not bump — leaving every
+        member with dead sockets. Spurious rebuilds cost one rendezvous."""
+        with self._error_lock:
+            self._errored = e
+            self._force_reconfigure = True
 
     def errored(self) -> Optional[Exception]:
         return self._errored

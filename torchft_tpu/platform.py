@@ -1,0 +1,25 @@
+"""Backend-selection helper for entry scripts.
+
+On hosts where a sitecustomize registers and pins an accelerator backend
+via ``jax.config`` at interpreter start, the ``JAX_PLATFORMS`` env var
+alone loses that race — subprocesses that must run on CPU (tests, local
+replica-group simulation, bench peers) silently land on the accelerator
+and pay a device round-trip per collective. Entry points call
+:func:`apply_jax_platform_env` right after ``import jax`` to make the env
+var authoritative again.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platform_env() -> None:
+    """Re-applies ``JAX_PLATFORMS`` through ``jax.config`` (no-op when the
+    env var is unset or jax is already initialized on the right backend)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if not platforms:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platforms)
