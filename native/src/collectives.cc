@@ -19,6 +19,8 @@ size_t dtype_size(Dtype d) {
     case Dtype::kF64:
     case Dtype::kI64:
       return 8;
+    case Dtype::kBF16:
+      return 2;
   }
   throw SocketError("bad dtype");
 }
@@ -46,6 +48,39 @@ void reduce_typed(T* dst, const T* src, size_t n, ReduceOp op) {
   throw SocketError("bad reduce op");
 }
 
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  memcpy(&f, &bits, sizeof(f));
+  return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, sizeof(bits));
+  // Round to nearest even (NaN payloads preserved by the +0x7FFF carry-free
+  // path since NaN mantissas survive truncation of the low half).
+  uint32_t lsb = (bits >> 16) & 1;
+  bits += 0x7FFF + lsb;
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+void reduce_bf16(uint16_t* dst, const uint16_t* src, size_t n, ReduceOp op) {
+  for (size_t i = 0; i < n; i++) {
+    float a = bf16_to_f32(dst[i]);
+    float b = bf16_to_f32(src[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::kSum: r = a + b; break;
+      case ReduceOp::kProduct: r = a * b; break;
+      case ReduceOp::kMin: r = std::min(a, b); break;
+      case ReduceOp::kMax: r = std::max(a, b); break;
+      default: throw SocketError("bad reduce op");
+    }
+    dst[i] = f32_to_bf16(r);
+  }
+}
+
 void reduce_into(void* dst, const void* src, size_t n, Dtype dtype, ReduceOp op) {
   switch (dtype) {
     case Dtype::kF32:
@@ -62,6 +97,10 @@ void reduce_into(void* dst, const void* src, size_t n, Dtype dtype, ReduceOp op)
     case Dtype::kI64:
       reduce_typed(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src), n,
                    op);
+      return;
+    case Dtype::kBF16:
+      reduce_bf16(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+                  n, op);
       return;
   }
   throw SocketError("bad dtype");

@@ -41,6 +41,10 @@ enum class Dtype : int {
   kF64 = 1,
   kI32 = 2,
   kI64 = 3,
+  // bfloat16 ships natively (2 bytes on the wire — half the DCN traffic of
+  // an f32 upcast); reduction arithmetic is f32 per hop with
+  // round-to-nearest-even back to bf16.
+  kBF16 = 4,
 };
 
 size_t dtype_size(Dtype d);

@@ -129,7 +129,10 @@ class TestHostCollectives:
         for c in cols:
             c.shutdown()
 
-    def test_allreduce_bfloat16_accumulates_in_f32(self, store):
+    def test_allreduce_bfloat16_native_wire(self, store):
+        # bf16 ships natively (2 bytes on the wire — half the DCN bytes of
+        # an f32 upcast); reduction math is f32 per hop, rounded to nearest
+        # even back to bf16. These values are bf16-exact, so the sum is too.
         import ml_dtypes
 
         cols = _make_ring(store, 3)
@@ -142,6 +145,26 @@ class TestHostCollectives:
             np.testing.assert_array_equal(
                 out.astype(np.float32), np.full(7, 0.75, np.float32)
             )
+        for c in cols:
+            c.shutdown()
+
+    def test_allreduce_bfloat16_rounds_per_hop(self, store):
+        # Inexact sums round per ring hop (the documented bf16 tradeoff);
+        # results remain bit-identical across ranks.
+        import ml_dtypes
+
+        cols = _make_ring(store, 2)
+        data = [
+            np.full(5, 1.0 + r * 0.00390625, dtype=ml_dtypes.bfloat16)
+            for r in range(2)
+        ]
+        results = _run_all(cols, lambda r, c: c.allreduce(data[r]).wait())
+        expected = (
+            data[0].astype(np.float32) + data[1].astype(np.float32)
+        ).astype(ml_dtypes.bfloat16)
+        for out in results:
+            assert out.dtype == ml_dtypes.bfloat16
+            np.testing.assert_array_equal(out, expected)
         for c in cols:
             c.shutdown()
 

@@ -25,7 +25,7 @@ from torchft_tpu import (
     Store,
 )
 from torchft_tpu.collectives import _completed
-from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager as RealManager
 
 
@@ -114,6 +114,94 @@ class TestDiLoCoUnit:
         diloco = DiLoCo(manager, st, optax.sgd(0.7), sync_every=1)
         diloco.step({"w": jnp.ones((4,))})
         np.testing.assert_allclose(np.asarray(st.params["w"]), 1.0)
+
+
+class TestAsyncDiLoCoUnit:
+    def test_lr1_single_group_degenerates_to_local(self):
+        # Invariant: one group + outer SGD(lr=1) makes the delayed outer
+        # update G' = B − Δ, so the reconciliation correction vanishes and
+        # AsyncDiLoCo must track pure local SGD exactly.
+        manager = _mock_manager(commit=True)
+        st = _state(1.0)
+        ad = AsyncDiLoCo(manager, st, optax.sgd(1.0), sync_every=2)
+        ref = _state(1.0)
+        grads = {"w": jnp.ones((4,))}
+        for _ in range(6):
+            ad.step(grads)
+            ref.apply_gradients(grads)
+        ad.flush()
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), np.asarray(ref.params["w"]), rtol=1e-6
+        )
+
+    def test_outer_update_applied_one_window_late(self):
+        manager = _mock_manager(commit=True)
+        st = _state(1.0)
+        ad = AsyncDiLoCo(manager, st, optax.sgd(1.0), sync_every=2)
+        grads = {"w": jnp.ones((4,))}
+        ad.step(grads)
+        ad.step(grads)  # boundary k=0: launch, nothing applied yet
+        assert manager.allreduce.call_count == 1
+        assert manager.should_commit.call_count == 0
+        np.testing.assert_allclose(ad._backup_params["w"], 1.0)  # B unchanged
+        ad.step(grads)
+        ad.step(grads)  # boundary k=1: window 0's sync completes first
+        assert manager.should_commit.call_count == 1
+        # lr=1 outer: G' = 1 − 0.2 = 0.8 becomes the new global backup.
+        np.testing.assert_allclose(ad._backup_params["w"], 0.8, rtol=1e-6)
+
+    def test_abort_rolls_back_only_inflight_window(self):
+        manager = _mock_manager(commit=False)
+        st = _state(1.0)
+        ad = AsyncDiLoCo(manager, st, optax.sgd(1.0), sync_every=2)
+        grads = {"w": jnp.ones((4,))}
+        for _ in range(4):
+            ad.step(grads)  # window 0 launched at step 2, aborted at step 4
+        # At the step-4 boundary window 0 (Δ=0.2) is rolled back; window 1's
+        # local progress (2 × 0.1) survives on top of B=1.0; then window 1's
+        # sync launches (result still pending).
+        ad.flush()  # window 1 also aborts: params return to B = 1.0
+        np.testing.assert_allclose(np.asarray(st.params["w"]), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(ad._backup_params["w"], 1.0)
+
+    def test_bf16_compression_ships_bf16_and_tracks_local(self):
+        import jax
+
+        manager = _mock_manager(commit=True)
+        seen_dtypes = []
+
+        def capture(tree, op=None):
+            seen_dtypes.extend(
+                str(l.dtype) for l in jax.tree_util.tree_leaves(tree)
+            )
+            from torchft_tpu.collectives import _completed
+
+            return _completed(tree)
+
+        manager.allreduce.side_effect = capture
+        st = _state(1.0)
+        ad = AsyncDiLoCo(
+            manager, st, optax.sgd(1.0), sync_every=2, compress="bf16"
+        )
+        grads = {"w": jnp.ones((4,))}
+        for _ in range(4):
+            ad.step(grads)
+        ad.flush()
+        assert seen_dtypes and all(d == "bfloat16" for d in seen_dtypes)
+        # lr=1 single group still tracks local training, within bf16 error.
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), 0.6, rtol=2e-2
+        )
+        assert st.params["w"].dtype == jnp.float32  # master stays f32
+
+    def test_state_dict_flushes_pending(self):
+        manager = _mock_manager(commit=True)
+        st = _state(1.0)
+        ad = AsyncDiLoCo(manager, st, optax.sgd(1.0), sync_every=1)
+        ad.step({"w": jnp.ones((4,))})
+        sd = ad.state_dict()  # must not checkpoint with a window in flight
+        assert ad._pending is None
+        np.testing.assert_allclose(sd["backup_params"]["w"], 0.9, rtol=1e-6)
 
 
 # -- integration: real control plane, threads as replica groups --

@@ -24,18 +24,22 @@ from torchft_tpu.collectives import (
 )
 from torchft_tpu.data import DistributedSampler
 from torchft_tpu.ddp import DistributedDataParallel
+from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
 from torchft_tpu.optim import OptimizerWrapper
 from torchft_tpu.train_state import FTTrainState
 
 __all__ = [
+    "AsyncDiLoCo",
     "CheckpointServer",
     "CheckpointTransport",
     "Collectives",
+    "DiLoCo",
     "DistributedDataParallel",
     "DistributedSampler",
     "DummyCollectives",
+    "LocalSGD",
     "HostCollectives",
     "Lighthouse",
     "FTTrainState",

@@ -176,3 +176,142 @@ class DiLoCo(LocalSGD):
                 self._state.params, updates
             )
             self._save_parameters()
+
+
+class AsyncDiLoCo(DiLoCo):
+    """DiLoCo with the cross-group sync OVERLAPPED with the next window's
+    inner steps (the delayed/eager outer-update idea of Streaming DiLoCo,
+    https://arxiv.org/pdf/2501.18512): at a window boundary the
+    pseudogradient allreduce is *launched* asynchronously and training
+    continues immediately; the outer update is applied one window late,
+    reconciled against the inner progress made in the meantime.
+
+    This is the bandwidth-appropriate cross-replica-group mode on TPU pods:
+    the host ring rides DCN at a fraction of step time only if it can hide
+    behind compute, and inner steps never leave the chip. Let B be the last
+    global params, θ the live params. At boundary k:
+
+      1. finish window k-1's in-flight sync (below),
+      2. compute Δ = B − θ, launch ``allreduce(Δ)`` (device→host packing and
+         ring transfer run on the collectives' op thread), keep training.
+
+    When the result lands (checked at boundary k+1):
+      commit → G' = outer_update(B, Δ_avg);  θ += G' − (B − Δ);  B = G'
+               (replaces window k's local-only progress with the
+               globally-agreed version, keeping window k+1's progress)
+      abort  → θ += Δ   (rolls back window k, keeps window k+1's progress)
+
+    With a single group and outer SGD(lr=1), G' = B − Δ and the correction
+    vanishes — AsyncDiLoCo degenerates to pure local training, the identity
+    the unit tests pin. Inherits DiLoCo's sync-quorum requirement for heal
+    correctness; call :meth:`flush` before checkpointing or shutdown so no
+    window is left in flight."""
+
+    def __init__(
+        self,
+        manager: Manager,
+        state: FTTrainState,
+        outer_tx: Any,
+        sync_every: int,
+        compress: Any = None,
+    ) -> None:
+        """``compress="bf16"`` casts pseudogradients to bfloat16 on-device
+        before the allreduce — halving device→host, wire (native bf16
+        dtype), and host→device bytes. Standard DiLoCo practice: the outer
+        optimizer sees bf16-rounded pseudogradients, the f32 master params
+        are untouched."""
+        if compress not in (None, "bf16"):
+            raise ValueError(f"unsupported compress mode: {compress}")
+        super().__init__(manager, state, outer_tx, sync_every)
+        self._compress = compress
+        self._pending: Any = None  # (work, delta) of the in-flight window
+        self._delta_fn: Any = None  # jitted Δ = B − θ (with optional cast)
+        self._commit_fn: Any = None  # jitted delayed outer update + reconcile
+        self._abort_fn: Any = None  # jitted window rollback
+
+    def sync(self) -> None:
+        self._finish_pending()
+        self._manager.start_quorum()
+        self._launch_sync()
+        self._local_step = 0
+
+    def flush(self) -> None:
+        """Completes any in-flight window sync (call before reading final
+        params, checkpointing durably, or shutdown)."""
+        self._finish_pending()
+
+    def state_dict(self) -> Dict[str, Any]:
+        self._finish_pending()
+        return super().state_dict()
+
+    def _launch_sync(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._delta_fn is None:
+            wire_dtype = jnp.bfloat16 if self._compress == "bf16" else None
+
+            def delta_fn(old, new):
+                return jax.tree_util.tree_map(
+                    lambda o, n: (o - n).astype(wire_dtype)
+                    if wire_dtype is not None
+                    else o - n,
+                    old,
+                    new,
+                )
+
+            self._delta_fn = jax.jit(delta_fn)
+
+        old_global = _to_device_tree(self._backup_params)
+        delta = self._delta_fn(old_global, self._state.params)
+        work = self._manager.allreduce(delta, op=ReduceOp.AVG)
+        self._pending = (work, delta)
+
+    def _finish_pending(self) -> None:
+        import jax
+        import optax
+
+        if self._pending is None:
+            return
+        work, delta = self._pending
+        self._pending = None
+        averaged = work.wait()
+        old_global = _to_device_tree(self._backup_params)
+
+        if self._commit_fn is None:
+            outer_tx = self._outer_tx
+
+            def commit_fn(avg, glob, dlt, outer_state, theta):
+                # Upcast the (possibly bf16) averaged pseudogradient to the
+                # master param dtype before the outer update.
+                avg = jax.tree_util.tree_map(
+                    lambda a, g: a.astype(g.dtype), avg, glob
+                )
+                updates, new_outer = outer_tx.update(avg, outer_state, glob)
+                new_global = optax.apply_updates(glob, updates)
+                # θ += G' − L0 where L0 = B − Δ is the launch point: window
+                # k's local-only progress is replaced by the agreed version,
+                # window k+1's progress (already in θ) is kept.
+                new_theta = jax.tree_util.tree_map(
+                    lambda th, g, b, d: th + (g - (b - d.astype(th.dtype))),
+                    theta, new_global, glob, dlt,
+                )
+                return new_theta, new_global, new_outer
+
+            def abort_fn(theta, dlt):
+                return jax.tree_util.tree_map(
+                    lambda th, d: th + d.astype(th.dtype), theta, dlt
+                )
+
+            self._commit_fn = jax.jit(commit_fn)
+            self._abort_fn = jax.jit(abort_fn)
+
+        if self._manager.should_commit():
+            self._state.params, new_global, self._outer_state = self._commit_fn(
+                averaged, old_global, delta, self._outer_state,
+                self._state.params,
+            )
+            self._backup_params = _to_host_copy(new_global)
+        else:
+            # Window k discarded; window k+1's local progress survives.
+            self._state.params = self._abort_fn(self._state.params, delta)

@@ -161,6 +161,8 @@ class Manager:
         self._pending_state_dict: Optional[Dict[str, object]] = None
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
+        self._div_fn: Optional[Any] = None  # jitted AVG divide (per-leaf
+        # eager division costs one dispatch per leaf on remote devices)
 
         lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
         replica_id = replica_id if replica_id is not None else ""
@@ -401,10 +403,14 @@ class Manager:
             work = self._collectives.allreduce(tree, ReduceOp.SUM)
             if op == ReduceOp.AVG:
                 assert num_participants >= 1
-                work = work.then(
-                    lambda t: jax.tree_util.tree_map(
-                        lambda l: l / num_participants, t
+                if self._div_fn is None:
+                    self._div_fn = jax.jit(
+                        lambda t, n: jax.tree_util.tree_map(
+                            lambda l: l / n, t
+                        )
                     )
+                work = work.then(
+                    lambda t: self._div_fn(t, float(num_participants))
                 )
             elif op != ReduceOp.SUM:
                 raise ValueError(f"unsupported managed allreduce op: {op}")
