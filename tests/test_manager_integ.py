@@ -41,25 +41,26 @@ class InjectedFailure(Exception):
 
 
 class FailureInjector:
-    """Raises at a (replica, step) once. Reference manager_integ_test.py:43-61."""
+    """Raises at a (local rank, step) once; one injector per replica group.
+    Reference manager_integ_test.py:43-61."""
 
     def __init__(self) -> None:
         self._failures: Set[Tuple[int, int]] = set()
         self._lock = threading.Lock()
         self.count = 0
 
-    def fail_at(self, replica: int, step: int) -> "FailureInjector":
+    def fail_at(self, rank: int, step: int) -> "FailureInjector":
         with self._lock:
-            self._failures.add((replica, step))
+            self._failures.add((rank, step))
         return self
 
-    def check(self, replica: int, step: int) -> None:
+    def check(self, rank: int, step: int) -> None:
         with self._lock:
-            if (replica, step) in self._failures:
-                self._failures.remove((replica, step))
+            if (rank, step) in self._failures:
+                self._failures.remove((rank, step))
                 self.count += 1
-                logger.info(f"injecting failure replica={replica} step={step}")
-                raise InjectedFailure(f"injected at {replica=} {step=}")
+                logger.info(f"injecting failure rank={rank} step={step}")
+                raise InjectedFailure(f"injected at {rank=} {step=}")
 
 
 def _init_state(seed: int = 42):
@@ -95,7 +96,11 @@ def _batch(step: int):
 
 @dataclass
 class Runner:
-    """One replica group (single rank). Reference manager_integ_test.py:64-126."""
+    """One replica group of ``world_size`` local-rank threads sharing a
+    Store, mirroring the reference's nested-executor harness (reference
+    manager_integ_test.py:64-126). An InjectedFailure in any rank takes the
+    whole group down (torchelastic restarts groups, not ranks); the group
+    then re-enters with a fresh Store/Managers."""
 
     replica_id: int
     lighthouse_address: str
@@ -103,6 +108,7 @@ class Runner:
     num_steps: int = 5
     use_async_quorum: bool = True
     attempts: int = 3
+    world_size: int = 1
     # Deterministic overlap gate. With only 2 replicas the split-brain guard
     # blocks the survivor until the dead peer's heartbeat expires, but with
     # >= 3 the surviving majority can finish (and exit) before the victim
@@ -114,10 +120,10 @@ class Runner:
     gate_event: Optional[threading.Event] = None
     announce_restart: Optional[threading.Event] = None
 
-    def run_replica(self) -> Dict[str, Any]:
+    def run_replica(self) -> List[Dict[str, Any]]:
         for attempt in range(self.attempts):
             try:
-                return self._train_loop(attempt)
+                return self._replica_main(attempt)
             except InjectedFailure:
                 logger.info(
                     f"replica {self.replica_id} died (attempt {attempt}); "
@@ -126,8 +132,40 @@ class Runner:
                 continue
         raise RuntimeError(f"replica {self.replica_id} exhausted attempts")
 
-    def _train_loop(self, attempt: int = 0) -> Dict[str, Any]:
-        store = Store()
+    def _replica_main(self, attempt: int) -> List[Dict[str, Any]]:
+        store = Store()  # the group's rendezvous store, shared by its ranks
+        try:
+            with ThreadPoolExecutor(
+                max_workers=self.world_size,
+                thread_name_prefix=f"replica{self.replica_id}",
+            ) as ex:
+                futures = [
+                    ex.submit(self._train_loop, rank, store.address(), attempt)
+                    for rank in range(self.world_size)
+                ]
+                results: List[Dict[str, Any]] = []
+                errors: List[BaseException] = []
+                for f in as_completed(futures):
+                    e = f.exception()
+                    if e is not None:
+                        errors.append(e)
+                    else:
+                        results.append(f.result())
+                if errors:
+                    # One rank's injected death cascades to its peers as
+                    # connection errors when the group's manager goes down;
+                    # the injected failure is the root cause to surface.
+                    for e in errors:
+                        if isinstance(e, InjectedFailure):
+                            raise e
+                    raise errors[0]
+                return sorted(results, key=lambda r: r["rank"])
+        finally:
+            store.shutdown()
+
+    def _train_loop(
+        self, rank: int, store_addr: str, attempt: int = 0
+    ) -> Dict[str, Any]:
         collectives = HostCollectives(timeout=timedelta(seconds=10))
         state = FTTrainState(_init_state(), optax.sgd(0.1))
 
@@ -140,14 +178,14 @@ class Runner:
             timeout=timedelta(seconds=10),
             quorum_timeout=timedelta(seconds=10),
             connect_timeout=timedelta(seconds=10),
-            rank=0,
-            world_size=1,
-            store_addr=store.address(),
+            rank=rank,
+            world_size=self.world_size,
+            store_addr=store_addr,
             lighthouse_addr=self.lighthouse_address,
             replica_id=f"replica_{self.replica_id}",
         )
         optimizer = OptimizerWrapper(manager, state)
-        if attempt > 0 and self.announce_restart is not None:
+        if attempt > 0 and rank == 0 and self.announce_restart is not None:
             self.announce_restart.set()
         try:
             while manager.current_step() < self.num_steps:
@@ -156,9 +194,7 @@ class Runner:
                     and manager.current_step() == self.gate_step
                 ):
                     assert self.gate_event.wait(timeout=60)
-                self.failure_injector.check(
-                    self.replica_id, manager.current_step()
-                )
+                self.failure_injector.check(rank, manager.current_step())
                 optimizer.zero_grad()  # start_quorum
                 x, y = _batch(manager.current_step())
                 grads = _grad_fn(state.params, x, y)
@@ -166,6 +202,7 @@ class Runner:
                 optimizer.step(avg_grads)
             return {
                 "replica_id": self.replica_id,
+                "rank": rank,
                 "state_dict": jax.tree_util.tree_map(
                     np.asarray, state.state_dict()
                 ),
@@ -174,7 +211,6 @@ class Runner:
         finally:
             manager.shutdown()
             collectives.shutdown()
-            store.shutdown()
 
 
 def _run_replicas(
@@ -184,7 +220,10 @@ def _run_replicas(
     use_async_quorum: bool = True,
     min_replicas_lighthouse: int = 1,
     gates: Optional[Dict[int, Dict[str, Any]]] = None,
+    world_size: int = 1,
 ) -> List[Dict[str, Any]]:
+    """Runs ``num_replicas`` groups of ``world_size`` ranks; returns the flat
+    list of per-rank results (group-major order)."""
     lighthouse = Lighthouse(
         bind="[::]:0",
         min_replicas=min_replicas_lighthouse,
@@ -203,12 +242,13 @@ def _run_replicas(
                         failure_injector=injectors[i],
                         num_steps=num_steps,
                         use_async_quorum=use_async_quorum,
+                        world_size=world_size,
                         **(gates or {}).get(i, {}),
                     ).run_replica
                 )
                 for i in range(num_replicas)
             ]
-            return [f.result(timeout=120) for f in futures]
+            return [r for f in futures for r in f.result(timeout=120)]
     finally:
         lighthouse.shutdown()
 
@@ -234,7 +274,7 @@ class TestManagerInteg:
         _assert_bitwise_identical(results)
 
     def test_ddp_recovery_async(self):
-        injectors = [FailureInjector(), FailureInjector().fail_at(1, 2)]
+        injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
         results = _run_replicas(
             num_replicas=2, num_steps=6, injectors=injectors
         )
@@ -244,7 +284,7 @@ class TestManagerInteg:
         _assert_bitwise_identical(results)
 
     def test_ddp_recovery_sync_quorum(self):
-        injectors = [FailureInjector(), FailureInjector().fail_at(1, 2)]
+        injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
         results = _run_replicas(
             num_replicas=2,
             num_steps=6,
@@ -257,7 +297,7 @@ class TestManagerInteg:
     def test_ddp_recovery_multiple_failures(self):
         injectors = [
             FailureInjector().fail_at(0, 4),
-            FailureInjector().fail_at(1, 2),
+            FailureInjector().fail_at(0, 2),
         ]
         results = _run_replicas(
             num_replicas=2, num_steps=7, injectors=injectors
@@ -270,7 +310,7 @@ class TestManagerInteg:
         injectors = [
             FailureInjector(),
             FailureInjector(),
-            FailureInjector().fail_at(2, 1),
+            FailureInjector().fail_at(0, 1),
         ]
         # Survivors hold at step 3 until replica 2's restart is live, so the
         # heal deterministically overlaps their run (see Runner.gate_step).
@@ -288,6 +328,37 @@ class TestManagerInteg:
         assert injectors[2].count == 1
         for r in results:
             assert r["manager_state"]["step"] == 8
+        _assert_bitwise_identical(results)
+
+    def test_happy_path_multi_rank(self):
+        # 2 groups x 2 local ranks: exercises the C++ local-rank quorum
+        # barrier (one lighthouse request per group), the per-rank ring
+        # namespacing ({store}/torchft/{quorum_id}/{rank}), and the
+        # AND-vote across local ranks in should_commit.
+        results = _run_replicas(num_replicas=2, num_steps=4, world_size=2)
+        assert len(results) == 4
+        assert [(r["replica_id"], r["rank"]) for r in results] == [
+            (0, 0), (0, 1), (1, 0), (1, 1),
+        ]
+        for r in results:
+            assert r["manager_state"]["step"] == 4
+        _assert_bitwise_identical(results)
+
+    def test_ddp_recovery_multi_rank(self):
+        # Reference manager_integ_test.py:284-323: both ranks of group 1 die
+        # at step 2; the whole group restarts, rejoins, heals from group 0,
+        # and every rank of every group converges bit-identically.
+        injectors = [
+            FailureInjector(),
+            FailureInjector().fail_at(0, 2).fail_at(1, 2),
+        ]
+        results = _run_replicas(
+            num_replicas=2, num_steps=6, injectors=injectors, world_size=2
+        )
+        assert injectors[1].count >= 1  # rank races: >=1 of the 2 fires
+        assert len(results) == 4
+        for r in results:
+            assert r["manager_state"]["step"] == 6
         _assert_bitwise_identical(results)
 
     def test_quorum_timeout_fast_fail(self):
