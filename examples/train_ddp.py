@@ -40,6 +40,7 @@ from torchft_tpu import (  # noqa: E402
     HostCollectives,
     Manager,
     OptimizerWrapper,
+    StatefulDataLoader,
 )
 
 logging.basicConfig(level=logging.INFO)
@@ -86,29 +87,66 @@ def main() -> None:
         shuffle=True,
     )
 
+    # Dataloader position is part of the recovery state: a healed replica
+    # resumes its shard mid-epoch instead of re-deriving an offset from the
+    # step count (reference train_ddp.py:57-61,141-148 via StatefulDataLoader).
+    loader = StatefulDataLoader(sampler, batch_size)
+
     state = FTTrainState(init_params(), optax.adamw(1e-3))
+
+    # Checkpoints (recovery or durable) must pair step-N weights with the
+    # loader position AS OF the last commit — not the live position, which
+    # is already past the in-flight, possibly-never-committed batch.
+    ckpt_box = {"loader": loader.state_dict(), "healed": False}
+
+    def full_state_dict():
+        return {"train": state.state_dict(), "loader": ckpt_box["loader"]}
+
+    def load_full_state_dict(sd):
+        state.load_state_dict(sd["train"])
+        loader.load_state_dict(sd["loader"])
+        ckpt_box["loader"] = dict(sd["loader"])
+        ckpt_box["healed"] = True
+
     collectives = HostCollectives(timeout=timedelta(seconds=30))
     manager = Manager(
         collectives=collectives,
-        load_state_dict=state.load_state_dict,
-        state_dict=state.state_dict,
+        load_state_dict=load_full_state_dict,
+        state_dict=full_state_dict,
         min_replica_size=1,
         replica_id=f"train_ddp_{replica_group}",
     )
     optimizer = OptimizerWrapper(manager, state)
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))
 
-    indices = list(sampler)
     while manager.current_step() < num_steps:
         step = manager.current_step()
-        offset = (step * batch_size) % max(len(indices) - batch_size, 1)
-        batch_idx = indices[offset : offset + batch_size]
+        ckpt_box["healed"] = False
+        loader_ckpt = loader.state_dict()
+        batch_idx = next(loader)
         bx, by = jnp.asarray(x[batch_idx]), jnp.asarray(y[batch_idx])
 
         optimizer.zero_grad()  # async quorum, overlapped with fwd/bwd
         loss, grads = grad_fn(state.params, bx, by)
         avg_grads = manager.allreduce(grads).wait()
         committed = optimizer.step(avg_grads)
+        if committed:
+            if ckpt_box["healed"]:
+                # The heal restored the source's position as of ITS last
+                # commit; this step's commit adds one more. Skip one batch
+                # (zero-contributed while healing, lossy by design —
+                # reference data.py:33-36) so position stays aligned with
+                # the committed-step count and epoch boundaries stay
+                # synchronized across replica groups.
+                next(loader)
+            ckpt_box["loader"] = loader.state_dict()
+        elif not ckpt_box["healed"]:
+            # Replay the same batch on the retry: an uncommitted step must
+            # not advance the durable data position, or the stream drifts
+            # from the committed-step count and the batch is lost. (A heal
+            # applied this step already reset the loader to the peer's
+            # committed position — rolling back would clobber it.)
+            loader.load_state_dict(loader_ckpt)
 
         if step % 10 == 0:
             logger.info(

@@ -11,7 +11,7 @@ import pytest
 
 from torchft_tpu import FTTrainState, OptimizerWrapper
 from torchft_tpu.collectives import _completed
-from torchft_tpu.data import DistributedSampler
+from torchft_tpu.data import DistributedSampler, StatefulDataLoader
 from torchft_tpu.ddp import DistributedDataParallel
 from torchft_tpu.manager import Manager
 
@@ -128,3 +128,79 @@ class TestDistributedSampler:
     def test_drop_last(self):
         s = DistributedSampler(10, 0, 3, shuffle=False, drop_last=True)
         assert len(list(s)) == 3
+
+
+class TestStatefulDataLoader:
+    """Dataloader-position recovery (reference train_ddp.py:57-61,141-148)."""
+
+    def _loader(self, n=20, batch=4, shuffle=True, **kw):
+        s = DistributedSampler(n, 0, 2, shuffle=shuffle, seed=3)
+        return StatefulDataLoader(s, batch, **kw)
+
+    def test_batches_cover_shard_then_roll_epoch(self):
+        loader = self._loader(n=16, batch=4, shuffle=False)  # shard = 8 idxs
+        b1, b2 = next(loader), next(loader)
+        assert sorted(b1 + b2) == list(range(0, 16, 2))
+        assert loader.epoch == 0 and loader.position == 8
+        b3 = next(loader)  # epoch rolls: shard exhausted
+        assert loader.epoch == 1 and loader.position == 4
+        assert len(b3) == 4
+
+    def test_resume_mid_epoch_bit_identical(self):
+        # The oracle: a restored loader replays the EXACT remaining stream.
+        a = self._loader()
+        for _ in range(3):
+            next(a)
+        saved = a.state_dict()
+        expected = [next(a) for _ in range(7)]
+
+        b = self._loader()
+        b.load_state_dict(saved)
+        assert [next(b) for _ in range(7)] == expected
+
+    def test_step_derived_offset_is_wrong_after_epoch_boundary(self):
+        # The failure mode VERDICT #6 calls out: position-from-step ignores
+        # the reshuffle at epoch boundaries.
+        loader = self._loader(n=16, batch=4)  # 2 batches per epoch-shard
+        stream = [next(loader) for _ in range(4)]  # crosses into epoch 1
+        naive = self._loader(n=16, batch=4)
+        flat = naive._sampler.indices_for_epoch(0) * 2
+        naive_batches = [flat[i * 4 : (i + 1) * 4] for i in range(4)]
+        assert stream[:2] == naive_batches[:2]
+        assert stream[2:] != naive_batches[2:]  # epoch-1 reshuffle matters
+
+    def test_drop_last_keeps_batch_shape_static(self):
+        loader = self._loader(n=18, batch=4, shuffle=False)  # shard = 9
+        sizes = [len(next(loader)) for _ in range(6)]
+        assert sizes == [4, 4, 4, 4, 4, 4]  # tail of 1 dropped each epoch
+
+    def test_keep_last_partial_batch(self):
+        loader = self._loader(n=18, batch=4, shuffle=False, drop_last=False)
+        sizes = [len(next(loader)) for _ in range(3)]
+        assert sizes == [4, 4, 1]
+
+    def test_batch_size_exceeding_shard_rejected(self):
+        with pytest.raises(ValueError, match="exceeds the shard size"):
+            self._loader(n=16, batch=16)  # shard is only 8
+
+    def test_uncommitted_step_replay(self):
+        # The train-loop discipline: save state before drawing, restore on
+        # an uncommitted step, so the retry trains the same batch.
+        loader = self._loader()
+        ckpt = loader.state_dict()
+        first = next(loader)
+        loader.load_state_dict(ckpt)
+        assert next(loader) == first
+
+    def test_roundtrip_through_checkpoint_serialization(self):
+        from torchft_tpu.checkpointing import (
+            deserialize_state_dict,
+            serialize_state_dict,
+        )
+
+        loader = self._loader()
+        next(loader)
+        sd = deserialize_state_dict(serialize_state_dict(loader.state_dict()))
+        fresh = self._loader()
+        fresh.load_state_dict(sd)
+        assert fresh.state_dict() == loader.state_dict()

@@ -22,7 +22,7 @@ from torchft_tpu.collectives import (
     ReduceOp,
     Work,
 )
-from torchft_tpu.data import DistributedSampler
+from torchft_tpu.data import DistributedSampler, StatefulDataLoader
 from torchft_tpu.ddp import DistributedDataParallel
 from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -49,6 +49,7 @@ __all__ = [
     "OptimizerWrapper",
     "QuorumResult",
     "ReduceOp",
+    "StatefulDataLoader",
     "Store",
     "StoreClient",
     "Work",

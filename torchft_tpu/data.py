@@ -10,7 +10,7 @@ visitation is out of scope (reference data.py:33-36).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -74,3 +74,107 @@ class DistributedSampler:
         else:
             order = order[: self.num_samples * self.global_world_size]
         yield from order[self.global_rank :: self.global_world_size].tolist()
+
+    def indices_for_epoch(self, epoch: int) -> List[int]:
+        """This shard's full index order for ``epoch`` (stateless: does not
+        touch the sampler's own epoch counter)."""
+        saved = self._epoch
+        self._epoch = epoch
+        try:
+            return list(self)
+        finally:
+            self._epoch = saved
+
+
+class StatefulDataLoader:
+    """Endless batch iterator over a :class:`DistributedSampler` shard with a
+    durable ``(epoch, position)`` state.
+
+    Plays the role of torchdata's ``StatefulDataLoader`` in the reference
+    trainer (reference train_ddp.py:57-61): its ``state_dict`` travels inside
+    the recovery / durable checkpoint (reference train_ddp.py:141-148), so a
+    healed or resumed replica continues exactly where its shard left off —
+    instead of re-deriving an offset from the step count, which goes wrong
+    at every epoch boundary and whenever the shuffle seed or world layout
+    changes.
+
+    Iteration is endless: when the shard is exhausted the epoch advances
+    (which reshuffles) and position resets, so fault-tolerant loops bounded
+    by ``manager.current_step()`` never run dry.
+
+    Args:
+        sampler: the shard to draw from.
+        batch_size: indices per batch.
+        drop_last: drop a short tail batch at the epoch end (default True so
+            jitted train steps see a static batch shape — a new shape would
+            trigger an XLA recompile mid-epoch).
+    """
+
+    def __init__(
+        self,
+        sampler: DistributedSampler,
+        batch_size: int,
+        drop_last: bool = True,
+    ) -> None:
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        if drop_last and batch_size > sampler.num_samples:
+            # Otherwise no epoch could ever yield a full batch and the
+            # static-shape guarantee below would be silently broken.
+            raise ValueError(
+                f"batch_size {batch_size} exceeds the shard size "
+                f"{sampler.num_samples}; lower it or use drop_last=False"
+            )
+        if sampler.num_samples == 0:
+            raise ValueError("sampler shard is empty")
+        self._sampler = sampler
+        self._batch_size = batch_size
+        self._drop_last = drop_last
+        self._epoch = 0
+        self._position = 0  # samples consumed within the current epoch
+        self._order: Optional[List[int]] = None
+
+    def _ensure_order(self) -> List[int]:
+        if self._order is None:
+            self._order = self._sampler.indices_for_epoch(self._epoch)
+        return self._order
+
+    def _advance_epoch(self) -> None:
+        self._epoch += 1
+        self._position = 0
+        self._order = None
+
+    def __iter__(self) -> "StatefulDataLoader":
+        return self
+
+    def __next__(self) -> List[int]:
+        order = self._ensure_order()
+        remaining = len(order) - self._position
+        want = self._batch_size if self._drop_last else 1
+        if remaining < want:
+            self._advance_epoch()
+            order = self._ensure_order()
+        batch = order[self._position : self._position + self._batch_size]
+        self._position += len(batch)
+        return batch
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def position(self) -> int:
+        return self._position
+
+    def state_dict(self) -> Dict[str, int]:
+        """Durable position; save alongside the model (and automatically
+        shipped in recovery checkpoints when wired into the manager's user
+        state dict)."""
+        return {"epoch": self._epoch, "position": self._position}
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        epoch = int(state_dict["epoch"])
+        if epoch != self._epoch:
+            self._order = None  # regenerate for the restored epoch
+        self._epoch = epoch
+        self._position = int(state_dict["position"])
