@@ -1,0 +1,221 @@
+"""Measured comparison of the two cross-replica-group data planes.
+
+VERDICT.md round 1 item 7 asked for the DCN story to be decided with data,
+not defaults. This benchmark runs both backends over the same 2-process
+cohort on this host and records, for each:
+
+  - allreduce throughput at small/large payloads (the steady-state cost),
+  - configure() latency on a membership change (the churn cost),
+  - behavior when the peer dies mid-collective (the wedge hazard).
+
+Writes DCN_BENCH.json and prints a summary. The architectural conclusions
+live in DCN.md. CPU/gloo/localhost numbers are proxies for TPU-host/DCN —
+absolute bandwidths will differ on real fabric, but the structural gaps
+(reconfigure invalidating device state; wedge-on-death vs fail-fast) are
+platform-independent.
+
+Usage: python bench_dcn.py            # orchestrates everything
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import timedelta
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+SIZES = {"4MB": 1 << 20, "64MB": 16 << 20}  # f32 element counts
+ITERS = 5
+DEATH_CAP_S = 20.0
+
+
+def _worker_host(rank: int, store_addr: str, mode: str) -> None:
+    import numpy as np
+
+    from torchft_tpu.collectives import HostCollectives, ReduceOp
+
+    hc = HostCollectives(timeout=timedelta(seconds=60),
+                         connect_timeout=timedelta(seconds=60))
+    t0 = time.perf_counter()
+    hc.configure(f"{store_addr}/q0", rank, 2)
+    configure_s = time.perf_counter() - t0
+    results = {"configure_s": configure_s}
+
+    if mode == "bench":
+        for name, n in SIZES.items():
+            buf = np.ones((n,), np.float32) * (rank + 1)
+            hc.allreduce(buf, ReduceOp.SUM).wait()  # warm
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                hc.allreduce(buf, ReduceOp.SUM).wait()
+            dt = (time.perf_counter() - t0) / ITERS
+            results[name] = {"s": dt, "MBps": (n * 4 / 1e6) / dt}
+        t0 = time.perf_counter()
+        hc.configure(f"{store_addr}/q1", rank, 2)  # membership change
+        results["reconfigure_s"] = time.perf_counter() - t0
+    elif mode == "death":
+        buf = np.ones((SIZES["4MB"],), np.float32)
+        hc.allreduce(buf, ReduceOp.SUM).wait()  # both alive
+        if rank == 1:
+            os._exit(1)  # die before the next op
+        time.sleep(0.5)
+        t0 = time.perf_counter()
+        try:
+            hc.allreduce(buf, ReduceOp.SUM).wait(
+                timeout=timedelta(seconds=DEATH_CAP_S)
+            )
+            results["death"] = {"outcome": "no-error", "s": None}
+        except Exception as e:  # noqa: BLE001
+            results["death"] = {
+                "outcome": f"error:{type(e).__name__}",
+                "s": time.perf_counter() - t0,
+            }
+    print("RESULT " + json.dumps(results), flush=True)
+    hc.shutdown()
+
+
+def _worker_xla(rank: int, store_addr: str, mode: str) -> None:
+    from torchft_tpu.platform import apply_jax_platform_env
+
+    apply_jax_platform_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchft_tpu import XLACollectives
+    from torchft_tpu.collectives import ReduceOp
+
+    keep_global = mode == "bench_global"
+    xc = XLACollectives(timeout=timedelta(seconds=60),
+                        connect_timeout=timedelta(seconds=60),
+                        keep_global=keep_global)
+    t0 = time.perf_counter()
+    xc.configure(f"{store_addr}/q0", rank, 2)
+    results = {"configure_s": time.perf_counter() - t0}
+
+    if mode in ("bench", "bench_global"):
+        for name, n in SIZES.items():
+            buf = jnp.ones((n,), jnp.float32) * (rank + 1)
+            jax.block_until_ready(buf)
+            jax.block_until_ready(xc.allreduce(buf, ReduceOp.SUM).wait())
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                jax.block_until_ready(xc.allreduce(buf, ReduceOp.SUM).wait())
+            dt = (time.perf_counter() - t0) / ITERS
+            results[name] = {"s": dt, "MBps": (n * 4 / 1e6) / dt}
+        if mode == "bench":
+            # Membership change = full runtime teardown + re-init; live
+            # arrays (params!) do not survive, so the realistic cost also
+            # includes snapshotting state to host and re-placing it.
+            state = jnp.ones((SIZES["64MB"],), jnp.float32)
+            jax.block_until_ready(state)
+            t0 = time.perf_counter()
+            saved = np.asarray(state)
+            xc.configure(f"{store_addr}/q1", rank, 2)
+            state = jnp.asarray(saved)
+            jax.block_until_ready(state)
+            results["reconfigure_s"] = time.perf_counter() - t0
+    elif mode == "death":
+        buf = jnp.ones((SIZES["4MB"],), jnp.float32)
+        jax.block_until_ready(xc.allreduce(buf, ReduceOp.SUM).wait())
+        if rank == 1:
+            os._exit(1)
+        time.sleep(0.5)
+        t0 = time.perf_counter()
+        try:
+            w = xc.allreduce(buf, ReduceOp.SUM)
+            jax.block_until_ready(
+                w.wait(timeout=timedelta(seconds=DEATH_CAP_S))
+            )
+            results["death"] = {"outcome": "no-error", "s": None}
+        except Exception as e:  # noqa: BLE001
+            elapsed = time.perf_counter() - t0
+            kind = type(e).__name__
+            outcome = (
+                f"wedged>= {DEATH_CAP_S}s" if elapsed >= DEATH_CAP_S - 0.5
+                else f"error:{kind}"
+            )
+            results["death"] = {"outcome": outcome, "s": elapsed}
+    print("RESULT " + json.dumps(results), flush=True)
+    if mode != "death":
+        xc.shutdown()
+    else:
+        os._exit(0)  # distributed runtime knows the peer is gone; skip teardown
+
+
+def _spawn(backend: str, mode: str, store_addr: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JAX_CPU_COLLECTIVES_IMPLEMENTATION="gloo")
+    env.pop("XLA_FLAGS", None)
+    return [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker", backend,
+             str(r), store_addr, mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+
+
+def _collect(procs, allow_fail=False, timeout=300.0):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = "(timeout)"
+        outs.append((p.returncode, out))
+    results = []
+    for rc, out in outs:
+        if not allow_fail:
+            assert rc == 0, f"worker failed:\n{out[-2000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results.append(json.loads(line[len("RESULT "):]))
+    return results
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        backend, rank, store_addr, mode = (
+            sys.argv[2], int(sys.argv[3]), sys.argv[4], sys.argv[5]
+        )
+        if backend == "host":
+            _worker_host(rank, store_addr, mode)
+        else:
+            _worker_xla(rank, store_addr, mode)
+        return
+
+    from torchft_tpu import Store
+
+    report = {"sizes": {k: v * 4 // (1 << 20) for k, v in SIZES.items()},
+              "iters": ITERS}
+    for backend, modes in (
+        ("host", ["bench", "death"]),
+        ("xla", ["bench", "bench_global", "death"]),
+    ):
+        report[backend] = {}
+        for mode in modes:
+            store = Store()
+            try:
+                procs = _spawn(backend, mode, store.address())
+                res = _collect(procs, allow_fail=(mode == "death"))
+            finally:
+                store.shutdown()
+            # rank 0's numbers (rank 1 exits early in death mode)
+            report[backend][mode] = res[0] if res else {}
+            print(f"{backend}/{mode}: {json.dumps(report[backend][mode])}",
+                  flush=True)
+
+    with open(os.path.join(REPO, "DCN_BENCH.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print("wrote DCN_BENCH.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
 from torchft_tpu.optim import OptimizerWrapper
 from torchft_tpu.train_state import FTTrainState
+from torchft_tpu.xla_collectives import XLACollectives
 
 __all__ = [
     "AsyncDiLoCo",
@@ -54,4 +55,5 @@ __all__ = [
     "StoreClient",
     "Work",
     "WorldSizeMode",
+    "XLACollectives",
 ]

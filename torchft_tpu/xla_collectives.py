@@ -1,0 +1,369 @@
+"""Cross-replica-group collectives compiled by XLA over a multi-process mesh.
+
+The second of the two cross-group (DCN) data-plane options SURVEY.md §5
+maps out for the TPU build (the role of the reference's NCCL backend choice,
+reference torchft/process_group.py:299-315):
+
+- :class:`~torchft_tpu.collectives.HostCollectives` (the default): host TCP
+  ring, outside XLA. Elastic — reconfigure is a millisecond-scale socket
+  rendezvous, device state is untouched, and a dead peer surfaces as an
+  abortable socket error.
+- :class:`XLACollectives` (this module): the reduction is a jitted psum over
+  a GLOBAL device mesh spanning every replica group's processes — gloo
+  between CPU hosts, DCN between TPU slices. XLA owns the wire, so large
+  payloads ride the fastest path available with zero host involvement
+  (pass ``keep_global=True``), but the membership is baked into the
+  distributed runtime:
+
+  * ``configure()`` onto a NEW membership must tear down and re-create the
+    XLA distributed runtime (``jax.distributed.shutdown`` + backend clear +
+    re-initialize), **orphaning every live jax array in the process**:
+    measured on CPU, their buffers keep their data (the retired client
+    lives while referenced) and implicit transfers let new jits consume
+    them, but they pin old-backend memory and none of this is contractual
+    on accelerator backends — snapshot training state to host around a
+    reconfigure. Measured at ~1.0-1.2 s per reconfigure on CPU vs ~1 ms
+    for the host ring (bench_dcn.py, DCN.md).
+  * a peer that dies mid-collective wedges the compiled op until the
+    distributed-runtime heartbeat gives up (minutes by default) — exactly
+    the hazard the reference isolates NCCL in a subprocess for (reference
+    process_group.py:303-307,551-1064) and that keeps the host ring the
+    default here.
+
+  Use it for static-membership deployments (fixed cohort, spares handled by
+  ``WorldSizeMode.FIXED_WITH_SPARES`` restarts) where cross-group bandwidth
+  dominates; use the host ring whenever membership is elastic.
+
+Deployment model: ONE process per replica group (slice), same as the
+manager. ``configure()`` performs coordinator rendezvous through the same
+store/prefix discipline as the host ring, so healthy-membership quorum
+changes drop into ``Manager``'s reconfiguration; after a WEDGED collective,
+however, ``configure()`` can only fail fast with ``TimeoutError`` (a
+compiled op cannot be interrupted — see ``abort()``) and the process must
+be restarted, unlike the ring's in-place abort.
+"""
+
+from __future__ import annotations
+
+import socket
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ._native import StoreClient
+from .collectives import Collectives, ReduceOp, Work, _flatten, _unflatten
+
+_COORD_KEY = "xla_coordinator"
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _split_store_addr(store_addr: str) -> tuple:
+    """``host:port/prefix`` -> (``host:port``, ``prefix``)."""
+    if "/" in store_addr:
+        hostport, prefix = store_addr.split("/", 1)
+    else:
+        hostport, prefix = store_addr, ""
+    return hostport, prefix
+
+
+class XLACollectives(Collectives):
+    """Reconfigurable cross-group collectives as jitted global-mesh psums.
+
+    Results are returned as host-backed local arrays by default (drop-in
+    parity with ``HostCollectives``: downstream per-group jitted steps can
+    consume them); construct with ``keep_global=True`` to keep results on
+    the global mesh (no host hop — the pure-DCN path) when the consumer is
+    itself jitted over the global mesh.
+    """
+
+    def __init__(
+        self,
+        timeout: timedelta = timedelta(seconds=60),
+        connect_timeout: timedelta = timedelta(seconds=60),
+        keep_global: bool = False,
+    ) -> None:
+        self._timeout = timeout
+        self._connect_timeout = connect_timeout
+        self._keep_global = keep_global
+        self._rank = -1
+        self._world_size = 0
+        self._mesh: Optional[Any] = None
+        self._initialized = False
+        # One thread: collectives must issue in submission order.
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="xla_collectives"
+        )
+        self._shutdown_flag = False
+        self._aborted = False
+        self._jit_cache: dict = {}
+
+    # -- lifecycle --
+
+    def abort(self) -> None:
+        """Fails queued-but-unstarted ops fast. An IN-FLIGHT compiled
+        collective cannot be interrupted — XLA owns it until the
+        distributed runtime gives up (the wedge hazard DCN.md documents;
+        after that the process must reconfigure or restart)."""
+        self._aborted = True
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        # Unblock the queue the way HostCollectives does pre-configure;
+        # do_configure clears the flag once the new membership is live.
+        self._aborted = True
+
+        def do_configure() -> None:
+            import jax
+
+            hostport, prefix = _split_store_addr(store_addr)
+            store = StoreClient(hostport, connect_timeout=self._connect_timeout)
+            key = f"{prefix}/{_COORD_KEY}" if prefix else _COORD_KEY
+            if rank == 0:
+                coord = f"{socket.gethostname()}:{_free_port()}"
+                store.set(key, coord.encode())
+            else:
+                coord = store.get(key, timeout=self._connect_timeout).decode()
+
+            if self._initialized:
+                # Membership change: the distributed runtime is torn down
+                # and rebuilt, orphaning live jax arrays (see module
+                # docstring) — snapshot state to host first.
+                jax.distributed.shutdown()
+                jax.clear_caches()
+                import jax.extend.backend
+
+                jax.extend.backend.clear_backends()
+                self._jit_cache.clear()
+                self._initialized = False
+
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=world_size,
+                process_id=rank,
+                initialization_timeout=max(
+                    int(self._connect_timeout.total_seconds()), 1
+                ),
+            )
+            self._initialized = True
+            from jax.sharding import Mesh
+
+            self._mesh = Mesh(np.array(jax.devices()), ("replica",))
+            self._rank = rank
+            self._world_size = world_size
+            self._aborted = False
+
+        # Bounded wait: if a wedged in-flight collective is holding the op
+        # thread (see abort()), surface a TimeoutError for the manager's
+        # error latching instead of blocking the train loop forever.
+        budget = (
+            self._connect_timeout.total_seconds()
+            + self._timeout.total_seconds()
+        )
+        self._executor.submit(do_configure).result(timeout=budget)
+
+    def global_mesh(self) -> Any:
+        """The global mesh spanning every group's devices — jit whole train
+        steps over it for the zero-host-copy multi-slice mode."""
+        assert self._mesh is not None, "configure() first"
+        return self._mesh
+
+    def shutdown(self) -> None:
+        if self._shutdown_flag:
+            return
+        self._shutdown_flag = True
+
+        def do_shutdown() -> None:
+            if self._initialized:
+                import jax
+
+                jax.distributed.shutdown()
+                self._initialized = False
+
+        self._executor.submit(do_shutdown).result()
+        self._executor.shutdown(wait=True)
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- ops --
+
+    def _submit(self, fn: Callable[[], Any]) -> Work:
+        if self._shutdown_flag:
+            raise RuntimeError("collectives already shut down")
+
+        def guarded() -> Any:
+            if self._aborted:
+                raise RuntimeError("collectives aborted")
+            return fn()
+
+        return Work(self._executor.submit(guarded))
+
+    def _stack_global(self, leaves: List[Any]) -> List[Any]:
+        """Each process's leaf becomes row ``rank`` of a (world, *shape)
+        global array sharded over the replica axis. jax-array leaves stay
+        on device (the process's row IS its local shard); host leaves are
+        uploaded."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh
+        out = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                local = jnp.expand_dims(leaf, 0)  # no host hop
+                sharding = NamedSharding(
+                    mesh, P("replica", *([None] * leaf.ndim))
+                )
+                local = jax.device_put(
+                    local, next(iter(sharding.addressable_devices))
+                )
+                out.append(
+                    jax.make_array_from_single_device_arrays(
+                        (self._world_size,) + tuple(leaf.shape),
+                        sharding,
+                        [local],
+                    )
+                )
+            else:
+                local = np.asarray(leaf)[None]
+                sharding = NamedSharding(
+                    mesh, P("replica", *([None] * (local.ndim - 1)))
+                )
+                out.append(
+                    jax.make_array_from_process_local_data(sharding, local)
+                )
+        return out
+
+    def _localize(self, leaves: List[Any]) -> List[Any]:
+        if self._keep_global:
+            return list(leaves)
+        import jax.numpy as jnp
+
+        return [jnp.asarray(np.asarray(l)) for l in leaves]
+
+    def _reduce_jit(self, n_leaves: int, op: ReduceOp) -> Any:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("reduce", n_leaves, int(op))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            world = self._world_size
+            replicated = NamedSharding(self._mesh, P())
+
+            def reduce(leaves):
+                outs = []
+                for l in leaves:
+                    if op == ReduceOp.SUM:
+                        r = jnp.sum(l, axis=0)
+                    elif op == ReduceOp.AVG:
+                        s = jnp.sum(l, axis=0)
+                        # Same-dtype contract (Collectives.allreduce):
+                        # integers floor-divide like the host ring does.
+                        if jnp.issubdtype(l.dtype, jnp.integer):
+                            r = s // world
+                        else:
+                            r = (s / world).astype(l.dtype)
+                    elif op == ReduceOp.MAX:
+                        r = jnp.max(l, axis=0)
+                    elif op == ReduceOp.MIN:
+                        r = jnp.min(l, axis=0)
+                    elif op == ReduceOp.PRODUCT:
+                        r = jnp.prod(l, axis=0)
+                    else:
+                        raise ValueError(f"unsupported op {op}")
+                    outs.append(r)
+                return outs
+
+            fn = self._jit_cache[key] = jax.jit(
+                reduce, out_shardings=[replicated] * n_leaves
+            )
+        return fn
+
+    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._submit(lambda: self._allreduce_sync(tree, op))
+
+    def _allreduce_sync(self, tree: Any, op: ReduceOp) -> Any:
+        if self._world_size == 1:
+            return tree
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return tree
+        stacked = self._stack_global(leaves)
+        reduced = self._reduce_jit(len(leaves), op)(stacked)
+        return _unflatten(treedef, self._localize(reduced))
+
+    def allgather(self, tree: Any) -> Work:
+        return self._submit(lambda: self._allgather_sync(tree))
+
+    def _allgather_sync(self, tree: Any) -> List[Any]:
+        if self._world_size == 1:
+            return [tree]
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return [tree] * self._world_size
+        stacked = self._stack_global(leaves)
+        key = ("gather", len(leaves))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            replicated = NamedSharding(self._mesh, P())
+            fn = self._jit_cache[key] = jax.jit(
+                lambda ls: [l + 0 for l in ls],
+                out_shardings=[replicated] * len(leaves),
+            )
+        gathered = fn(stacked)  # (world, *shape), replicated everywhere
+        host = [np.asarray(g) for g in gathered]
+        return [
+            _unflatten(treedef, self._localize([h[r] for h in host]))
+            for r in range(self._world_size)
+        ]
+
+    def broadcast(self, tree: Any, root: int = 0) -> Work:
+        return self._submit(lambda: self._broadcast_sync(tree, root))
+
+    def _broadcast_sync(self, tree: Any, root: int) -> Any:
+        if self._world_size == 1:
+            if root != 0:
+                raise RuntimeError(f"bad broadcast root {root} for world size 1")
+            return tree
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return tree
+        stacked = self._stack_global(leaves)
+        key = ("bcast", len(leaves), root)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            replicated = NamedSharding(self._mesh, P())
+            fn = self._jit_cache[key] = jax.jit(
+                lambda ls: [l[root] for l in ls],
+                out_shardings=[replicated] * len(leaves),
+            )
+        return _unflatten(treedef, self._localize(fn(stacked)))
+
+    def barrier(self) -> Work:
+        import jax.numpy as jnp
+
+        return self._submit(
+            lambda: self._allreduce_sync(
+                jnp.zeros((1,), jnp.float32), ReduceOp.SUM
+            )
+        )
