@@ -1,0 +1,115 @@
+"""Measures the data-plane overlap pipeline (VERDICT round 1 item 3).
+
+Times a gradient-sized allreduce through a real 2-member host ring with the
+chunked pipeline ON (d2h DMA / TCP ring / h2d upload overlapped) vs OFF
+(sequential single-shot per dtype group), from this host's accelerator.
+The payload is sized at ~10x the flagship bench model's gradients, where
+the transfer+ring cost is the dominant fault-tolerance overhead.
+
+Writes OVERLAP_BENCH.json and prints one summary line per config.
+
+Usage: python bench_overlap.py [--peer <store_addr>]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import timedelta
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+N_LEAVES = 64
+TOTAL_MB = 256  # ~64M f32 elements ~= 10x the bench model's ~25M params
+ITERS = 3
+
+
+def _tree(fill: float):
+    import jax.numpy as jnp
+
+    n = TOTAL_MB * (1 << 20) // 4 // N_LEAVES
+    return {f"g{i}": jnp.full((n,), fill, jnp.float32) for i in range(N_LEAVES)}
+
+
+def peer(store_addr: str) -> None:
+    from torchft_tpu.platform import apply_jax_platform_env
+
+    apply_jax_platform_env()
+    from torchft_tpu.collectives import HostCollectives, ReduceOp
+
+    hc = HostCollectives(timeout=timedelta(seconds=600),
+                         connect_timeout=timedelta(seconds=600))
+    zeros = _tree(0.0)
+    for phase in range(2):  # one ring per main-side config
+        hc.configure(f"{store_addr}/overlap{phase}", 1, 2)
+        for _ in range(1 + ITERS):  # warm + timed
+            hc.allreduce(zeros, ReduceOp.SUM).wait()
+    hc.shutdown()
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--peer":
+        peer(sys.argv[2])
+        return
+
+    import jax
+
+    from torchft_tpu import Store
+    from torchft_tpu.collectives import HostCollectives, ReduceOp
+
+    store = Store()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    peer_proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--peer", store.address()],
+        env=env,
+    )
+
+    tree = _tree(1.0)
+    jax.block_until_ready(tree)
+    report = {
+        "platform": jax.devices()[0].platform,
+        "payload_MB": TOTAL_MB,
+        "leaves": N_LEAVES,
+        "iters": ITERS,
+    }
+    try:
+        for phase, (name, chunks) in enumerate(
+            (("single_shot", 1), ("pipelined", 8))
+        ):
+            hc = HostCollectives(
+                timeout=timedelta(seconds=600),
+                connect_timeout=timedelta(seconds=600),
+                pipeline_chunks=chunks,
+            )
+            hc.configure(f"{store.address()}/overlap{phase}", 0, 2)
+            out = hc.allreduce(tree, ReduceOp.SUM).wait()  # warm (jit pack)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                out = hc.allreduce(tree, ReduceOp.SUM).wait()
+                jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / ITERS
+            report[name] = {"s": round(dt, 3),
+                            "MBps": round(TOTAL_MB / dt, 1)}
+            print(f"{name} (chunks={chunks}): {dt:.3f}s "
+                  f"{TOTAL_MB / dt:.1f} MB/s", flush=True)
+            hc.shutdown()
+        report["speedup"] = round(
+            report["single_shot"]["s"] / report["pipelined"]["s"], 3
+        )
+        assert peer_proc.wait(timeout=600) == 0
+    finally:
+        if peer_proc.poll() is None:
+            peer_proc.kill()
+        store.shutdown()
+
+    with open(os.path.join(REPO, "OVERLAP_BENCH.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps({"overlap_speedup": report["speedup"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -89,7 +89,27 @@ void Lighthouse::quorum_tick_locked() {
   }
   if (changed) {
     state_.quorum_id += 1;
+    state_.quorum_formed_ms = now_ms();
     LOG_INFO("Detected quorum change, bumping quorum_id to " << state_.quorum_id);
+
+    // Event log entry: membership + who is healing (step behind max).
+    int64_t max_step = -1;
+    for (const auto& p : participants) max_step = std::max(max_step, p.step());
+    std::ostringstream ev;
+    ev << "[" << format_unix_ms(unix_ms()) << "] quorum " << state_.quorum_id
+       << ": " << participants.size() << " member"
+       << (participants.size() == 1 ? "" : "s");
+    std::string healing;
+    for (const auto& p : participants) {
+      if (p.step() != max_step) {
+        if (!healing.empty()) healing += ", ";
+        healing += p.replica_id();
+      }
+    }
+    if (!healing.empty())
+      ev << "; healing to step " << max_step << ": " << healing;
+    state_.events.push_front(ev.str());
+    while (state_.events.size() > 20) state_.events.pop_back();
   }
 
   Quorum quorum;
@@ -299,8 +319,12 @@ std::string Lighthouse::render_status_locked() {
 
   std::ostringstream os;
   os << "<div class=card><b>Quorum " << state_.quorum_id << "</b> &mdash; "
-     << num_participants << " participants, max step " << max_step
-     << "<div class=muted>" << html_escape(quorum_status) << "</div></div>";
+     << num_participants << " participants, max step " << max_step;
+  if (state_.quorum_formed_ms >= 0) {
+    int64_t age_s = (now_ms() - state_.quorum_formed_ms) / 1000;
+    os << ", age " << age_s << " s";
+  }
+  os << "<div class=muted>" << html_escape(quorum_status) << "</div></div>";
 
   if (state_.prev_quorum.has_value()) {
     for (const auto& p : state_.prev_quorum->participants()) {
@@ -330,6 +354,13 @@ std::string Lighthouse::render_status_locked() {
        << " ms ago</td></tr>";
   }
   os << "</table></div>";
+
+  if (!state_.events.empty()) {
+    os << "<div class=card><b>Events</b>";
+    for (const auto& ev : state_.events)
+      os << "<div class=muted>" << html_escape(ev) << "</div>";
+    os << "</div>";
+  }
   return os.str();
 }
 

@@ -26,6 +26,16 @@ int64_t unix_ms() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(t).count();
 }
 
+std::string format_unix_ms(int64_t ms) {
+  time_t secs = static_cast<time_t>(ms / 1000);
+  struct tm tm_utc;
+  gmtime_r(&secs, &tm_utc);
+  char buf[16];
+  snprintf(buf, sizeof(buf), "%02d:%02d:%02d", tm_utc.tm_hour, tm_utc.tm_min,
+           tm_utc.tm_sec);
+  return buf;
+}
+
 int poll_timeout_or_throw(int64_t deadline_ms, const char* what) {
   if (deadline_ms < 0) return -1;
   int64_t remain = deadline_ms - now_ms();

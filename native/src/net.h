@@ -12,8 +12,10 @@ namespace tft {
 
 // Milliseconds since a fixed (steady) epoch; monotonic.
 int64_t now_ms();
-// Unix wall-clock milliseconds (for `Quorum.created_ms` only).
+// Unix wall-clock milliseconds (for `Quorum.created_ms` and display).
 int64_t unix_ms();
+// "HH:MM:SS" (UTC) for dashboard/event-log display.
+std::string format_unix_ms(int64_t ms);
 
 std::string local_hostname();
 

@@ -5,6 +5,7 @@
 #pragma once
 
 #include <cstdint>
+#include <deque>
 #include <map>
 #include <optional>
 #include <string>
@@ -35,6 +36,11 @@ struct LighthouseState {
   std::optional<torchft_tpu::Quorum> prev_quorum;
   int64_t quorum_id = 0;
   std::map<std::string, int64_t> heartbeats; // replica_id -> last now_ms()
+  // Dashboard telemetry (reference templates/status.html shows live
+  // per-member recovery state; here membership/heal transitions are also
+  // kept as a short event log).
+  int64_t quorum_formed_ms = -1;            // now_ms() of last quorum_id bump
+  std::deque<std::string> events;           // newest first, capped
 };
 
 // True iff membership (the ordered list of replica ids) differs.

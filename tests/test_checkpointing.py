@@ -1,6 +1,7 @@
 """Checkpoint transport tests. Mirrors reference checkpointing_test.py:17-105:
 HTTP round-trip, step mismatch -> error, timeout behavior, lock gating."""
 
+import os
 import threading
 import urllib.error
 from datetime import timedelta
@@ -142,3 +143,61 @@ def test_serialize_handles_scalars_and_none():
     tree = {"a": None, "b": 3.5, "c": [np.int64(2), "s"]}
     out = deserialize_state_dict(serialize_state_dict(tree))
     assert out == tree
+
+
+def test_optax_state_roundtrips_through_safelist():
+    # Real recovery payloads carry optax namedtuple states; the safelisted
+    # unpickler must reconstruct them type-intact so tx.update still works.
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    params = {"w": jnp.ones((3,))}
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+    out = deserialize_state_dict(
+        serialize_state_dict({"params": params, "opt_state": opt_state})
+    )
+    restored = jax.tree_util.tree_map(jnp.asarray, out["opt_state"])
+    updates, _ = tx.update(
+        {"w": jnp.ones((3,))},
+        restored,
+        jax.tree_util.tree_map(jnp.asarray, out["params"]),
+    )
+    assert jax.tree_util.tree_structure(restored) == (
+        jax.tree_util.tree_structure(opt_state)
+    )
+
+
+def test_malicious_pickle_rejected():
+    # The classic RCE gadget must not resolve (reference posture is
+    # torch.load(weights_only=False); this transport is stricter).
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("echo pwned",))
+
+    payload = pickle.dumps(Evil())
+    with pytest.raises(pickle.UnpicklingError, match="disallowed global"):
+        deserialize_state_dict(payload)
+
+
+def test_register_safe_modules_extends_allowlist():
+    from torchft_tpu.checkpointing import (
+        _SAFE_MODULE_ROOTS,
+        register_safe_modules,
+    )
+
+    assert "fractions" not in _SAFE_MODULE_ROOTS
+    import fractions
+    import pickle
+
+    payload = pickle.dumps(fractions.Fraction(1, 3))
+    with pytest.raises(pickle.UnpicklingError):
+        deserialize_state_dict(payload)
+    register_safe_modules("fractions")
+    try:
+        assert deserialize_state_dict(payload) == fractions.Fraction(1, 3)
+    finally:
+        _SAFE_MODULE_ROOTS.discard("fractions")

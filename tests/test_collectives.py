@@ -184,6 +184,44 @@ class TestHostCollectives:
         for c in cols:
             c.shutdown()
 
+    def test_allreduce_pipelined_chunks_match_single_shot(self, store):
+        # The overlap pipeline (chunked d2h/ring/h2d) must be bit-identical
+        # to the unchunked path and to the analytic expectation.
+        import jax.numpy as jnp
+
+        cols = [
+            HostCollectives(
+                timeout=timedelta(seconds=10),
+                pipeline_chunks=4,
+                pipeline_min_bytes=0,  # force the pipeline even when tiny
+            )
+            for _ in range(2)
+        ]
+        addr = f"{store.address()}/q0"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            for f in [
+                ex.submit(cols[r].configure, addr, r, 2) for r in range(2)
+            ]:
+                f.result()
+        rng = np.random.default_rng(5)
+        base = rng.standard_normal(10_007).astype(np.float32)  # odd size
+        data = [
+            {"w": jnp.asarray(base * (r + 1)), "b": jnp.asarray(base[:33])}
+            for r in range(2)
+        ]
+        results = _run_all(
+            cols, lambda r, c: c.allreduce(data[r], ReduceOp.AVG).wait()
+        )
+        expect_w = (base * 1 + base * 2) / 2
+        for out in results:
+            np.testing.assert_array_equal(np.asarray(out["w"]), expect_w)
+            np.testing.assert_array_equal(np.asarray(out["b"]), base[:33])
+        assert np.asarray(results[0]["w"]).tobytes() == np.asarray(
+            results[1]["w"]
+        ).tobytes()
+        for c in cols:
+            c.shutdown()
+
     def test_allgather(self, store):
         cols = _make_ring(store, 3)
         results = _run_all(

@@ -43,6 +43,11 @@ class TestDashboard:
             assert "dash_rep" in status
             assert "Kill" in status
             assert "Heartbeats" in status
+            # Quorum age + event log (reference templates/status.html shows
+            # the quorum's live state; heal/membership transitions logged).
+            assert ", age " in status
+            assert "Events" in status
+            assert "quorum 1: 1 member" in status
             m.shutdown()
             store.shutdown()
         finally:

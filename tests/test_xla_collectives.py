@@ -43,12 +43,18 @@ _WORKER_PRELUDE = textwrap.dedent(
 ).format(repo=REPO)
 
 
-def _run_workers(body: str, nprocs: int = 2, timeout: float = 180.0):
+def _run_workers(
+    body: str, nprocs: int = 2, timeout: float = 180.0, devices_per_proc: int = 1
+):
     """Runs the worker script in nprocs subprocesses; returns stdouts."""
     store = Store()
     script = _WORKER_PRELUDE + textwrap.dedent(body)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("XLA_FLAGS", None)  # workers use 1 device per process
+    env.pop("XLA_FLAGS", None)
+    if devices_per_proc > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices_per_proc}"
+        )
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", script, str(r), store.address()],
@@ -113,6 +119,49 @@ class TestXLACollectives:
             assert np.allclose(np.asarray(g[0]), 1.0)
             assert np.allclose(np.asarray(g[1]), 11.0)
             xc.barrier().wait()
+            print("OK")
+            xc.shutdown()
+            """
+        )
+        for out in outs:
+            assert "OK" in out
+
+    def test_multi_device_processes(self):
+        # The target deployment: one process per TPU slice with SEVERAL
+        # local chips. The mesh is (replica, local); collectives must agree
+        # and results must be consumable by a local jit.
+        outs = _run_workers(
+            """
+            xc.configure(store_addr + "/q0", rank, 2)
+            assert jax.local_device_count() == 2
+            mesh = xc.global_mesh()
+            assert dict(zip(mesh.axis_names, mesh.devices.shape)) == (
+                {"replica": 2, "local": 2}
+            )
+            tree = {"g": jnp.full((5,), float(rank + 1))}
+            s = xc.allreduce(tree, ReduceOp.AVG).wait()
+            assert np.allclose(np.asarray(s["g"]), 1.5), s
+            g = xc.allgather(jnp.full((2,), float(rank))).wait()
+            assert np.allclose(np.asarray(g[1]), 1.0)
+            print("OK")
+            xc.shutdown()
+            """,
+            devices_per_proc=2,
+        )
+        for out in outs:
+            assert "OK" in out
+
+    def test_configure_after_jax_use(self):
+        # Manager drop-in reality: the user builds params on device BEFORE
+        # the first quorum configures the collectives. The backend must
+        # clear and re-initialize instead of raising.
+        outs = _run_workers(
+            """
+            pre = jax.jit(lambda: jnp.ones((3,)) * 2)()  # backend init'd
+            jax.block_until_ready(pre)
+            xc.configure(store_addr + "/q0", rank, 2)
+            s = xc.allreduce(jnp.full((3,), float(rank + 1))).wait()
+            assert np.allclose(np.asarray(s), 3.0), s
             print("OK")
             xc.shutdown()
             """

@@ -12,6 +12,18 @@ Serialization is pytree-native: leaves are pulled to host (numpy) and the
 whole tree is pickled. jax arrays are reconstructed as numpy on the receiver;
 the caller decides device placement/sharding (``jax.device_put``) — the
 transport never touches devices.
+
+Security model: deserialization uses a SAFELISTED unpickler — only the
+scientific-stack modules state dicts are actually made of (numpy, optax,
+jax, collections, ml_dtypes, torchft_tpu, plus a narrow builtins set) can
+be referenced, so the classic pickle code-execution gadgets (``os.system``,
+``subprocess``, ``builtins.eval``...) are rejected. This is deliberately
+stricter than the reference's ``torch.load(weights_only=False)``
+(reference checkpointing.py:203). It is hardening, not authentication:
+the endpoint is unauthenticated HTTP, so the checkpoint port must only be
+reachable inside the training cluster's trusted network — same deployment
+requirement as the reference. Custom user state classes outside the
+safelist: call :func:`register_safe_modules` at startup on every replica.
 """
 
 from __future__ import annotations
@@ -79,11 +91,44 @@ def serialize_state_dict(state_dict: Any) -> bytes:
     return buf.getvalue()
 
 
+# Module roots state dicts are really made of. Extendable for user classes
+# via register_safe_modules.
+_SAFE_MODULE_ROOTS = {
+    "numpy", "optax", "jax", "collections", "ml_dtypes", "torchft_tpu",
+}
+# Builtins narrowed to data constructors: resolving e.g. builtins.eval or
+# getattr is how pickle payloads become code execution.
+_SAFE_BUILTINS = {
+    "complex", "bytearray", "set", "frozenset", "slice", "range",
+    "dict", "list", "tuple",
+}
+
+
+def register_safe_modules(*roots: str) -> None:
+    """Allows additional top-level modules (e.g. your package defining a
+    custom state class) to be referenced by incoming checkpoints."""
+    _SAFE_MODULE_ROOTS.update(roots)
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str) -> Any:
+        if module == "builtins":
+            if name in _SAFE_BUILTINS:
+                return super().find_class(module, name)
+        elif module.partition(".")[0] in _SAFE_MODULE_ROOTS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"checkpoint references disallowed global {module}.{name}; "
+            "if this is your own state class, call "
+            "torchft_tpu.checkpointing.register_safe_modules"
+            f"({module.partition('.')[0]!r}) on every replica"
+        )
+
+
 def deserialize_state_dict(raw: bytes) -> Any:
-    """Inverse of :func:`serialize_state_dict`. Array leaves come back as
-    numpy; only exchange checkpoints with trusted peers (pickle, like the
-    reference's ``torch.load(weights_only=False)``, checkpointing.py:203)."""
-    return pickle.loads(raw)
+    """Inverse of :func:`serialize_state_dict` through the safelisted
+    unpickler (see module docstring). Array leaves come back as numpy."""
+    return _SafeUnpickler(io.BytesIO(raw)).load()
 
 
 class _TimedAcquire:

@@ -294,11 +294,22 @@ class HostCollectives(Collectives):
         self,
         timeout: timedelta = timedelta(seconds=60),
         connect_timeout: timedelta = timedelta(seconds=60),
+        pipeline_chunks: int = 4,
+        pipeline_min_bytes: int = 4 << 20,
     ) -> None:
+        """``pipeline_chunks`` > 1 splits large device-packed buffers so
+        device->host DMA, the TCP ring, and host->device upload overlap
+        (chunk i rides the ring while chunk i+1 is still downloading and
+        chunk i-1 re-uploads). Buffers under ``pipeline_min_bytes`` take
+        the single-shot path — per-transfer latency would beat the
+        overlap. Chunk boundaries depend only on size, so results stay
+        bit-identical across ranks and against the unchunked path."""
         _declare_hc(_lib)
         self._handle = _lib.tft_hc_create()
         self._timeout = timeout
         self._connect_timeout = connect_timeout
+        self._pipeline_chunks = max(int(pipeline_chunks), 1)
+        self._pipeline_min_bytes = int(pipeline_min_bytes)
         self._world_size = 0
         self._rank = -1
         # One thread: collectives must issue in submission order.
@@ -440,40 +451,84 @@ class HostCollectives(Collectives):
     def _allreduce_device_packed(
         self, leaves, treedef, native_op: int, divisor, timeout_ms: int
     ) -> Any:
-        """All-jax-leaf fast path: ONE device→host transfer, ring pass, and
-        host→device transfer per dtype group."""
-        import jax.numpy as jnp
-
+        """All-jax-leaf fast path: pack on device, then (for large buffers)
+        a chunked pipeline where d2h DMA, the TCP ring, and h2d upload all
+        overlap; small buffers take one transfer per dtype group."""
         key = (treedef, tuple((l.shape, np.dtype(l.dtype)) for l in leaves))
         packer = self._packers.get(key)
         if packer is None:
             packer = self._packers[key] = _DevicePacker(leaves)
         bufs = packer.pack(leaves)
-        host: dict = {}
-        for name, dev in bufs.items():
+        dev_bufs = {
+            name: self._ring_reduce_device_buffer(
+                dev, native_op, divisor, timeout_ms
+            )
+            for name, dev in bufs.items()
+        }
+        return _unflatten(treedef, packer.unpack(dev_bufs))
+
+    def _apply_divisor(self, arr: np.ndarray, divisor) -> np.ndarray:
+        if arr.dtype == _BF16:
+            return (arr.astype(np.float32) / divisor).astype(_BF16)
+        if np.issubdtype(arr.dtype, np.floating):
+            arr /= divisor
+            return arr
+        arr //= divisor
+        return arr
+
+    def _ring_chunk(self, arr: np.ndarray, native_op: int, timeout_ms: int) -> None:
+        _check(
+            _lib.tft_hc_allreduce(
+                self._handle,
+                arr.ctypes.data_as(ctypes.c_void_p),
+                arr.size,
+                _NATIVE_DTYPES[arr.dtype],
+                native_op,
+                timeout_ms,
+            )
+        )
+
+    def _ring_reduce_device_buffer(
+        self, dev, native_op: int, divisor, timeout_ms: int
+    ):
+        """Reduces one flat device buffer through the ring, pipelined.
+
+        The pipeline (reference analog: DDP bucket overlap intent,
+        torchft/ddp.py:47-71): all chunk DMAs are enqueued up front
+        (``copy_to_host_async``); while chunk i rides the TCP ring, chunks
+        i+1.. are still downloading and reduced chunks re-upload under
+        jax's async dispatch. End-to-end time approaches
+        max(d2h, ring, h2d) + one chunk instead of their sum."""
+        import jax.numpy as jnp
+
+        itemsize = np.dtype(dev.dtype).itemsize
+        n = dev.size
+        k = self._pipeline_chunks
+        if k <= 1 or n * itemsize < self._pipeline_min_bytes:
             arr = np.asarray(dev)  # one transfer per group
             if not arr.flags.writeable or not arr.flags.c_contiguous:
                 arr = np.array(arr)  # ring reduces in place
-            _check(
-                _lib.tft_hc_allreduce(
-                    self._handle,
-                    arr.ctypes.data_as(ctypes.c_void_p),
-                    arr.size,
-                    _NATIVE_DTYPES[arr.dtype],
-                    native_op,
-                    timeout_ms,
-                )
-            )
+            self._ring_chunk(arr, native_op, timeout_ms)
             if divisor is not None:
-                if arr.dtype == _BF16:
-                    arr = (arr.astype(np.float32) / divisor).astype(_BF16)
-                elif np.issubdtype(arr.dtype, np.floating):
-                    arr /= divisor
-                else:
-                    arr //= divisor
-            host[name] = arr
-        dev_bufs = {name: jnp.asarray(a) for name, a in host.items()}
-        return _unflatten(treedef, packer.unpack(dev_bufs))
+                arr = self._apply_divisor(arr, divisor)
+            return jnp.asarray(arr)
+
+        bounds = [n * i // k for i in range(k + 1)]
+        chunks = [dev[a:b] for a, b in zip(bounds, bounds[1:])]
+        for c in chunks:
+            c.copy_to_host_async()  # queue every DMA up front
+        out_chunks = []
+        for c in chunks:
+            arr = np.asarray(c)  # completes when THIS chunk's DMA lands
+            if not arr.flags.writeable or not arr.flags.c_contiguous:
+                arr = np.array(arr)
+            self._ring_chunk(arr, native_op, timeout_ms)
+            if divisor is not None:
+                arr = self._apply_divisor(arr, divisor)
+            # Async dispatch: the upload starts now and overlaps the next
+            # chunk's ring pass.
+            out_chunks.append(jnp.asarray(arr))
+        return jnp.concatenate(out_chunks)
 
     def allgather(self, tree: Any) -> Work:
         timeout_ms = _ms(self._timeout)

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import socket
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from datetime import timedelta
 from typing import Any, Callable, List, Optional
 
@@ -59,7 +60,12 @@ _COORD_KEY = "xla_coordinator"
 
 
 def _free_port() -> int:
+    # Close-then-rebind race: another process can take the port before the
+    # distributed runtime binds it. SO_REUSEADDR narrows the window; a lost
+    # race surfaces as a failed initialize, which the manager's quorum
+    # retry path reruns with a fresh port.
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("", 0))
     port = s.getsockname()[1]
     s.close()
@@ -132,19 +138,23 @@ class XLACollectives(Collectives):
             else:
                 coord = store.get(key, timeout=self._connect_timeout).decode()
 
+            from jax.extend import backend as jax_backend
+
+            def teardown_backends() -> None:
+                # Orphans live jax arrays (see module docstring) —
+                # snapshot state to host first.
+                jax.clear_caches()
+                jax_backend.clear_backends()
+                self._jit_cache.clear()
+
             if self._initialized:
                 # Membership change: the distributed runtime is torn down
-                # and rebuilt, orphaning live jax arrays (see module
-                # docstring) — snapshot state to host first.
+                # and rebuilt.
                 jax.distributed.shutdown()
-                jax.clear_caches()
-                import jax.extend.backend
-
-                jax.extend.backend.clear_backends()
-                self._jit_cache.clear()
+                teardown_backends()
                 self._initialized = False
 
-            jax.distributed.initialize(
+            init_kwargs = dict(
                 coordinator_address=coord,
                 num_processes=world_size,
                 process_id=rank,
@@ -152,10 +162,38 @@ class XLACollectives(Collectives):
                     int(self._connect_timeout.total_seconds()), 1
                 ),
             )
+            try:
+                jax.distributed.initialize(**init_kwargs)
+            except RuntimeError:
+                # The process already ran jax computations, so the XLA
+                # backend pre-dates the distributed runtime ("initialize()
+                # must be called before any JAX calls"). Clear it and
+                # retry once — pre-existing arrays are orphaned, same
+                # contract as a reconfigure.
+                teardown_backends()
+                jax.distributed.initialize(**init_kwargs)
             self._initialized = True
             from jax.sharding import Mesh
 
-            self._mesh = Mesh(np.array(jax.devices()), ("replica",))
+            # One mesh row per process, its local devices as columns, so
+            # multi-chip processes (a TPU slice per replica group) shard
+            # correctly: the replica axis has size world_size and local
+            # devices hold replicated copies of their process's row.
+            devs = sorted(
+                jax.devices(), key=lambda d: (d.process_index, d.id)
+            )
+            local_counts = {d.process_index: 0 for d in devs}
+            for d in devs:
+                local_counts[d.process_index] += 1
+            if len(set(local_counts.values())) != 1:
+                raise RuntimeError(
+                    f"uneven devices per process: {local_counts}"
+                )
+            per_proc = len(devs) // world_size
+            self._mesh = Mesh(
+                np.array(devs).reshape(world_size, per_proc),
+                ("replica", "local"),
+            )
             self._rank = rank
             self._world_size = world_size
             self._aborted = False
@@ -187,8 +225,17 @@ class XLACollectives(Collectives):
                 jax.distributed.shutdown()
                 self._initialized = False
 
-        self._executor.submit(do_shutdown).result()
-        self._executor.shutdown(wait=True)
+        # Same bounded-wait rationale as configure(): a wedged in-flight
+        # collective must not hang process teardown forever. On timeout the
+        # op thread stays wedged (only process exit reclaims it — the
+        # documented hazard); skip joining it.
+        try:
+            self._executor.submit(do_shutdown).result(
+                timeout=self._timeout.total_seconds()
+            )
+            self._executor.shutdown(wait=True)
+        except FuturesTimeoutError:
+            self._executor.shutdown(wait=False)
 
     def size(self) -> int:
         return self._world_size
@@ -226,14 +273,20 @@ class XLACollectives(Collectives):
                 sharding = NamedSharding(
                     mesh, P("replica", *([None] * leaf.ndim))
                 )
-                local = jax.device_put(
-                    local, next(iter(sharding.addressable_devices))
-                )
+                # The replica axis shards dim 0 (size world == mesh rows);
+                # the local axis is unused, so EVERY local device holds a
+                # replicated copy of this process's row.
+                shards = [
+                    jax.device_put(local, d)
+                    for d in sorted(
+                        sharding.addressable_devices, key=lambda d: d.id
+                    )
+                ]
                 out.append(
                     jax.make_array_from_single_device_arrays(
                         (self._world_size,) + tuple(leaf.shape),
                         sharding,
-                        [local],
+                        shards,
                     )
                 )
             else:
@@ -328,6 +381,22 @@ class XLACollectives(Collectives):
                 out_shardings=[replicated] * len(leaves),
             )
         gathered = fn(stacked)  # (world, *shape), replicated everywhere
+        if self._keep_global:
+            # Slice on the global mesh so rows keep the no-host-hop
+            # contract (same as allreduce/broadcast in this mode).
+            skey = ("gather_rows", len(leaves))
+            row_fn = self._jit_cache.get(skey)
+            if row_fn is None:
+                replicated = NamedSharding(self._mesh, P())
+                world = self._world_size
+                row_fn = self._jit_cache[skey] = jax.jit(
+                    lambda ls: [[l[r] for l in ls] for r in range(world)],
+                    out_shardings=[[replicated] * len(leaves)]
+                    * self._world_size,
+                )
+            return [
+                _unflatten(treedef, rows) for rows in row_fn(gathered)
+            ]
         host = [np.asarray(g) for g in gathered]
         return [
             _unflatten(treedef, self._localize([h[r] for h in host]))
