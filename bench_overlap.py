@@ -33,20 +33,27 @@ def _tree(fill: float):
     return {f"g{i}": jnp.full((n,), fill, jnp.float32) for i in range(N_LEAVES)}
 
 
+PHASES = (("single_shot", 1), ("pipelined", 8))
+
+
 def peer(store_addr: str) -> None:
     from torchft_tpu.platform import apply_jax_platform_env
 
     apply_jax_platform_env()
     from torchft_tpu.collectives import HostCollectives, ReduceOp
 
-    hc = HostCollectives(timeout=timedelta(seconds=600),
-                         connect_timeout=timedelta(seconds=600))
     zeros = _tree(0.0)
-    for phase in range(2):  # one ring per main-side config
+    for phase, (_, chunks) in enumerate(PHASES):
+        # One ring + one HostCollectives per phase, chunk config matching
+        # the main side exactly — the chunk schedule is part of the wire
+        # contract (configure() validates it).
+        hc = HostCollectives(timeout=timedelta(seconds=600),
+                             connect_timeout=timedelta(seconds=600),
+                             pipeline_chunks=chunks)
         hc.configure(f"{store_addr}/overlap{phase}", 1, 2)
         for _ in range(1 + ITERS):  # warm + timed
             hc.allreduce(zeros, ReduceOp.SUM).wait()
-    hc.shutdown()
+        hc.shutdown()
 
 
 def main() -> None:
@@ -76,9 +83,7 @@ def main() -> None:
         "iters": ITERS,
     }
     try:
-        for phase, (name, chunks) in enumerate(
-            (("single_shot", 1), ("pipelined", 8))
-        ):
+        for phase, (name, chunks) in enumerate(PHASES):
             hc = HostCollectives(
                 timeout=timedelta(seconds=600),
                 connect_timeout=timedelta(seconds=600),

@@ -183,6 +183,43 @@ def test_malicious_pickle_rejected():
         deserialize_state_dict(payload)
 
 
+def test_safelist_not_extensible_from_payload():
+    # The bypass class: a payload that calls register_safe_modules("os")
+    # mid-load and then resolves os.system. Both hops must fail — functions
+    # are never resolvable and the safelist is snapshotted per load.
+    import pickle
+
+    from torchft_tpu.checkpointing import register_safe_modules
+
+    class Sneaky:
+        def __reduce__(self):
+            return (register_safe_modules, ("os",))
+
+    with pytest.raises(pickle.UnpicklingError, match="disallowed global"):
+        deserialize_state_dict(pickle.dumps(Sneaky()))
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("echo pwned",))
+
+    # ...and "os" must not have leaked into the process-global safelist.
+    with pytest.raises(pickle.UnpicklingError, match="disallowed global"):
+        deserialize_state_dict(pickle.dumps(Evil()))
+
+
+def test_functions_in_safe_modules_rejected():
+    # Class-only rule: numpy itself is safelisted, but a REDUCE on one of
+    # its functions (arbitrary-call primitive) must not resolve.
+    import pickle
+
+    class FnGadget:
+        def __reduce__(self):
+            return (np.array, ([1, 2],))
+
+    with pytest.raises(pickle.UnpicklingError, match="disallowed global"):
+        deserialize_state_dict(pickle.dumps(FnGadget()))
+
+
 def test_register_safe_modules_extends_allowlist():
     from torchft_tpu.checkpointing import (
         _SAFE_MODULE_ROOTS,

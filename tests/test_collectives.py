@@ -222,6 +222,27 @@ class TestHostCollectives:
         for c in cols:
             c.shutdown()
 
+    def test_mismatched_pipeline_config_fails_fast(self, store):
+        # The chunk schedule is part of the wire contract; disagreeing
+        # members must error at configure, not silently desync gradients.
+        cols = [
+            HostCollectives(
+                timeout=timedelta(seconds=10),
+                connect_timeout=timedelta(seconds=5),  # rank 0's rendezvous
+                pipeline_chunks=chunks,                # times out solo
+            )
+            for chunks in (4, 8)
+        ]
+        addr = f"{store.address()}/q0"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [
+                ex.submit(cols[r].configure, addr, r, 2) for r in range(2)
+            ]
+            with pytest.raises(RuntimeError, match="pipeline config mismatch"):
+                futs[1].result()
+        for c in cols:
+            c.shutdown()
+
     def test_allgather(self, store):
         cols = _make_ring(store, 3)
         results = _run_all(

@@ -1,0 +1,108 @@
+"""Ring attention (context parallelism) tests on the virtual 8-device CPU
+mesh: numerical equivalence with dense causal attention, differentiability,
+and composition with data- and tensor-parallel axes in one mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.context_parallel import ring_attention
+from torchft_tpu.parallel import make_mesh
+
+
+def _dense_causal(q, k, v):
+    """Reference: full-materialization causal attention, f32."""
+    Dh = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (Dh ** -0.5)
+    S = q.shape[1]
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _qkv(key, B=2, S=32, H=4, Dh=8, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    shape = (B, S, H, Dh)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+class TestRingAttention:
+    def test_matches_dense_seq_only(self):
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        out = ring_attention(q, k, v, mesh=mesh, seq_axis="seq",
+                             batch_axis=None)
+        ref = _dense_causal(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_dense_dp_x_seq_x_tp(self):
+        # The composition claim: batch over "data", sequence ring over
+        # "seq", heads over "model" — one mesh, one op.
+        mesh = make_mesh({"data": 2, "seq": 2, "model": 2},
+                         devices=jax.devices()[:8])
+        q, k, v = _qkv(jax.random.PRNGKey(1), B=4, S=16, H=4)
+        out = ring_attention(q, k, v, mesh=mesh, seq_axis="seq",
+                             batch_axis="data", head_axis="model")
+        ref = _dense_causal(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_non_causal(self):
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(jax.random.PRNGKey(2))
+        out = ring_attention(q, k, v, mesh=mesh, batch_axis=None,
+                             causal=False)
+        Dh = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (Dh ** -0.5)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradients_flow_through_ring(self):
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(jax.random.PRNGKey(3))
+
+        def loss_ring(qkv):
+            out = ring_attention(*qkv, mesh=mesh, batch_axis=None)
+            return jnp.sum(out ** 2)
+
+        def loss_dense(qkv):
+            return jnp.sum(_dense_causal(*qkv) ** 2)
+
+        g_ring = jax.grad(loss_ring)((q, k, v))
+        g_dense = jax.grad(loss_dense)((q, k, v))
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_inside_jit(self):
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(jax.random.PRNGKey(4))
+        f = jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, mesh=mesh, batch_axis=None))
+        np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                                   np.asarray(_dense_causal(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_uneven_sequence_rejected(self):
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(jax.random.PRNGKey(5), S=30)
+        with pytest.raises(ValueError, match="not divisible"):
+            ring_attention(q, k, v, mesh=mesh, batch_axis=None)
+
+    def test_bf16_inputs(self):
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(jax.random.PRNGKey(6), dtype=jnp.bfloat16)
+        out = ring_attention(q, k, v, mesh=mesh, batch_axis=None)
+        assert out.dtype == jnp.bfloat16
+        ref = _dense_causal(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=0.05, atol=0.05,
+        )

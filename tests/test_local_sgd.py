@@ -134,6 +134,31 @@ class TestAsyncDiLoCoUnit:
             np.asarray(st.params["w"]), np.asarray(ref.params["w"]), rtol=1e-6
         )
 
+    def test_serial_mode_matches_sync_diloco(self):
+        # overlap=False completes the sync AT the boundary; the delayed
+        # reconciliation must degenerate to exact synchronous DiLoCo.
+        grads = {"w": jnp.ones((4,))}
+
+        serial_state = _state(1.0)
+        serial = AsyncDiLoCo(
+            _mock_manager(commit=True), serial_state, optax.sgd(0.5),
+            sync_every=2, overlap=False,
+        )
+        ref_state = _state(1.0)
+        ref = DiLoCo(
+            _mock_manager(commit=True), ref_state, optax.sgd(0.5),
+            sync_every=2,
+        )
+        for _ in range(4):
+            serial.step(grads)
+            ref.step(grads)
+        assert serial._pending is None  # nothing left in flight
+        np.testing.assert_allclose(
+            np.asarray(serial_state.params["w"]),
+            np.asarray(ref_state.params["w"]),
+            rtol=1e-6,
+        )
+
     def test_outer_update_applied_one_window_late(self):
         manager = _mock_manager(commit=True)
         st = _state(1.0)

@@ -31,7 +31,7 @@ class FailingCollectives(DummyCollectives):
         super().__init__(**kwargs)
         self._immediate = immediate
 
-    def allreduce(self, tree, op=ReduceOp.SUM) -> Work:
+    def allreduce(self, tree, op=ReduceOp.SUM, divisor=None) -> Work:
         self.op_count += 1
         if self._immediate:
             raise RuntimeError("injected immediate failure")

@@ -193,7 +193,7 @@ class Runner:
                     self.gate_event is not None
                     and manager.current_step() == self.gate_step
                 ):
-                    assert self.gate_event.wait(timeout=60)
+                    assert self.gate_event.wait(timeout=180)
                 self.failure_injector.check(rank, manager.current_step())
                 optimizer.zero_grad()  # start_quorum
                 x, y = _batch(manager.current_step())
@@ -207,6 +207,7 @@ class Runner:
                     np.asarray, state.state_dict()
                 ),
                 "manager_state": manager.state_dict(),
+                "metrics": manager.metrics().snapshot(),
             }
         finally:
             manager.shutdown()
@@ -229,7 +230,11 @@ def _run_replicas(
         min_replicas=min_replicas_lighthouse,
         join_timeout_ms=200,
         quorum_tick_ms=50,
-        heartbeat_timeout_ms=1000,
+        # Wide enough that a loaded CI host (the full suite runs many
+        # thread-per-replica tests back to back) can't age out a LIVE
+        # member between 100 ms heartbeats; failure detection latency is
+        # not what these tests assert.
+        heartbeat_timeout_ms=2500,
     )
     injectors = injectors or [FailureInjector() for _ in range(num_replicas)]
     try:
@@ -282,6 +287,15 @@ class TestManagerInteg:
         for r in results:
             assert r["manager_state"]["step"] == 6
         _assert_bitwise_identical(results)
+        # Observability: the restarted replica's manager recorded its heal
+        # and both sides timed the transaction phases.
+        healed = next(r for r in results if r["replica_id"] == 1)
+        assert healed["metrics"]["counters"]["heals"] >= 1
+        for r in results:
+            c, t = r["metrics"]["counters"], r["metrics"]["timers_s"]
+            assert c["commits"] >= 1 and c["reconfigures"] >= 1
+            for phase in ("quorum", "reconfigure", "allreduce", "commit_vote"):
+                assert t[phase]["n"] >= 1, phase
 
     def test_ddp_recovery_sync_quorum(self):
         injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]

@@ -13,15 +13,17 @@ whole tree is pickled. jax arrays are reconstructed as numpy on the receiver;
 the caller decides device placement/sharding (``jax.device_put``) — the
 transport never touches devices.
 
-Security model: deserialization uses a SAFELISTED unpickler — only the
-scientific-stack modules state dicts are actually made of (numpy, optax,
-jax, collections, ml_dtypes, torchft_tpu, plus a narrow builtins set) can
-be referenced, so the classic pickle code-execution gadgets (``os.system``,
-``subprocess``, ``builtins.eval``...) are rejected. This is deliberately
-stricter than the reference's ``torch.load(weights_only=False)``
-(reference checkpointing.py:203). It is hardening, not authentication:
-the endpoint is unauthenticated HTTP, so the checkpoint port must only be
-reachable inside the training cluster's trusted network — same deployment
+Security model: deserialization uses a SAFELISTED unpickler — only CLASSES
+from the scientific-stack modules state dicts are actually made of (numpy,
+optax, jax, collections, ml_dtypes), the two numpy array reconstructors,
+and a narrow builtins set can be referenced. Plain functions are never
+resolvable (a REDUCE on a function is the pickle code-execution
+primitive), and the safelist is snapshotted per load so a payload cannot
+widen it mid-deserialization. This is deliberately stricter than the
+reference's ``torch.load(weights_only=False)`` (reference
+checkpointing.py:203). It is hardening, not authentication: the endpoint
+is unauthenticated HTTP, so the checkpoint port must only be reachable
+inside the training cluster's trusted network — same deployment
 requirement as the reference. Custom user state classes outside the
 safelist: call :func:`register_safe_modules` at startup on every replica.
 """
@@ -91,35 +93,60 @@ def serialize_state_dict(state_dict: Any) -> bytes:
     return buf.getvalue()
 
 
-# Module roots state dicts are really made of. Extendable for user classes
-# via register_safe_modules.
+# Module roots whose CLASSES state dicts are really made of. Extendable for
+# user classes via register_safe_modules. NOTE: deliberately does NOT
+# include torchft_tpu itself — a payload resolving this module's own
+# helpers (e.g. register_safe_modules) could widen the list mid-load.
 _SAFE_MODULE_ROOTS = {
-    "numpy", "optax", "jax", "collections", "ml_dtypes", "torchft_tpu",
+    "numpy", "optax", "jax", "collections", "ml_dtypes",
+}
+# Non-class globals required by the numpy array pickle format. Functions
+# are otherwise NEVER resolvable (a REDUCE on an arbitrary function is the
+# code-execution primitive); these two reconstructors only build arrays.
+_SAFE_EXACT = {
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
 }
 # Builtins narrowed to data constructors: resolving e.g. builtins.eval or
 # getattr is how pickle payloads become code execution.
 _SAFE_BUILTINS = {
-    "complex", "bytearray", "set", "frozenset", "slice", "range",
-    "dict", "list", "tuple",
+    "bool", "bytearray", "bytes", "complex", "dict", "float", "frozenset",
+    "int", "list", "range", "set", "slice", "str", "tuple",
 }
 
 
 def register_safe_modules(*roots: str) -> None:
-    """Allows additional top-level modules (e.g. your package defining a
-    custom state class) to be referenced by incoming checkpoints."""
+    """Allows CLASSES from additional top-level modules (e.g. your package
+    defining a custom state holder) to be referenced by incoming
+    checkpoints. Call at startup on every replica — the set is snapshotted
+    when a load begins, so a payload cannot extend it mid-load."""
     _SAFE_MODULE_ROOTS.update(roots)
 
 
 class _SafeUnpickler(pickle.Unpickler):
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        # Snapshot: registration during a hostile load has no effect on it.
+        self._roots = frozenset(_SAFE_MODULE_ROOTS)
+
     def find_class(self, module: str, name: str) -> Any:
-        if module == "builtins":
-            if name in _SAFE_BUILTINS:
-                return super().find_class(module, name)
-        elif module.partition(".")[0] in _SAFE_MODULE_ROOTS:
+        if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
+        if (module, name) in _SAFE_EXACT:
+            return super().find_class(module, name)
+        if module.partition(".")[0] in self._roots:
+            obj = super().find_class(module, name)
+            # Classes only: data containers may be constructed, but plain
+            # functions (the REDUCE code-execution primitive) may not.
+            if isinstance(obj, type):
+                return obj
         raise pickle.UnpicklingError(
             f"checkpoint references disallowed global {module}.{name}; "
-            "if this is your own state class, call "
+            "if this is your own state CLASS, call "
             "torchft_tpu.checkpointing.register_safe_modules"
             f"({module.partition('.')[0]!r}) on every replica"
         )

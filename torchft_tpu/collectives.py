@@ -117,6 +117,16 @@ def _completed(value: Any) -> Work:
     return Work(f)
 
 
+def _divide_leaf(leaf: Any, divisor: float) -> Any:
+    """Same-dtype divide for the divisor/AVG contract: integers
+    floor-divide (matching the multi-member ring), floats keep their
+    dtype. Handles numpy and jax leaves alike."""
+    dtype = np.dtype(getattr(leaf, "dtype", np.float64))
+    if np.issubdtype(dtype, np.integer):
+        return leaf // int(divisor)
+    return (leaf / divisor).astype(dtype)
+
+
 def _flatten(tree: Any) -> Tuple[List[Any], Any]:
     """Flatten a pytree without importing jax at module load."""
     import jax
@@ -144,9 +154,19 @@ class Collectives(ABC):
         is ``host:port/prefix`` with a prefix unique to the quorum."""
 
     @abstractmethod
-    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+    ) -> Work:
         """Reduces a pytree of arrays across the group; result pytree has the
-        same structure/dtypes. Bit-identical on every rank."""
+        same structure/dtypes. Bit-identical on every rank.
+
+        ``divisor`` (SUM only) divides the reduced result before it returns
+        — the manager's num_participants average, applied host-side where
+        the data already is, so no extra device dispatch or jit program is
+        needed. ``op=AVG`` is equivalent to SUM with divisor=world_size."""
 
     @abstractmethod
     def allgather(self, tree: Any) -> Work:
@@ -327,6 +347,32 @@ class HostCollectives(Collectives):
         _lib.tft_hc_abort(self._handle)
 
         def do_configure() -> None:
+            # The pipeline parameters are part of the ring's op schedule
+            # (they decide how many native allreduce calls one logical
+            # allreduce issues, and the wire has no per-op framing), so
+            # every member must agree — validate against rank 0's via the
+            # rendezvous store and fail fast instead of desyncing. A solo
+            # member has no peers (and possibly no real store) to check.
+            if world_size > 1:
+                hostport, _, prefix = store_addr.partition("/")
+                store = _native.StoreClient(
+                    hostport, connect_timeout=self._connect_timeout
+                )
+                mine = f"{self._pipeline_chunks}:{self._pipeline_min_bytes}"
+                key = f"{prefix}/pipecfg" if prefix else "pipecfg"
+                if rank == 0:
+                    store.set(key, mine.encode())
+                else:
+                    theirs = store.get(
+                        key, timeout=self._connect_timeout
+                    ).decode()
+                    if theirs != mine:
+                        raise RuntimeError(
+                            f"pipeline config mismatch: rank {rank} has "
+                            f"{mine}, rank 0 has {theirs} — all ring members "
+                            "must construct HostCollectives with the same "
+                            "pipeline_chunks / pipeline_min_bytes"
+                        )
             _check(
                 _lib.tft_hc_configure(
                     self._handle,
@@ -376,22 +422,47 @@ class HostCollectives(Collectives):
             raise RuntimeError("collectives already shut down")
         return Work(self._executor.submit(fn))
 
-    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+    ) -> Work:
         timeout_ms = _ms(self._timeout)
-        return self._submit(lambda: self._allreduce_sync(tree, op, timeout_ms))
+        return self._submit(
+            lambda: self._allreduce_sync(tree, op, timeout_ms, divisor)
+        )
 
-    def _allreduce_sync(self, tree: Any, op: ReduceOp, timeout_ms: int) -> Any:
+    def _allreduce_sync(
+        self,
+        tree: Any,
+        op: ReduceOp,
+        timeout_ms: int,
+        divisor: Optional[float] = None,
+    ) -> Any:
+        if divisor is not None and op != ReduceOp.SUM:
+            raise ValueError("divisor only composes with ReduceOp.SUM")
         if self._world_size == 1:
-            # Identity (SUM of one member; AVG divides by 1): skip the host
-            # pack/transfer entirely — device arrays never leave HBM. NOTE:
-            # single-member results may ALIAS the input tree (treat op
-            # results as immutable, the jax norm — multi-member paths return
-            # fresh buffers).
+            # Identity-ish (SUM of one member; AVG divides by 1): skip the
+            # host pack/transfer entirely — device arrays never leave HBM.
+            # NOTE: single-member undivided results may ALIAS the input
+            # tree (treat op results as immutable, the jax norm —
+            # multi-member paths return fresh buffers).
+            if divisor is not None and divisor != 1:
+                import jax
+
+                return jax.tree_util.tree_map(
+                    lambda l: _divide_leaf(l, divisor)
+                    if hasattr(l, "__truediv__")
+                    else l,
+                    tree,
+                )
             return tree
         leaves, treedef = _flatten(tree)
         if not leaves:
             return tree
-        divisor = self._world_size if op == ReduceOp.AVG else None
+        if op == ReduceOp.AVG:
+            divisor = self._world_size
         native_op = int(ReduceOp.SUM if op == ReduceOp.AVG else op)
 
         if all(_is_jax_array(l) for l in leaves):
@@ -629,8 +700,22 @@ class DummyCollectives(Collectives):
         self._rank = rank
         self._world_size = world_size
 
-    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
+    def allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+    ) -> Work:
         self.op_count += 1
+        if divisor is not None and divisor != 1:
+            # The manager's AVG contract delegates the participant divide
+            # to the backend; the fake must honor it or wrapper-semantics
+            # tests see undivided gradients.
+            import jax
+
+            tree = jax.tree_util.tree_map(
+                lambda l: _divide_leaf(l, divisor), tree
+            )
         return _completed(tree)
 
     def allgather(self, tree: Any) -> Work:

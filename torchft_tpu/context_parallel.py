@@ -1,0 +1,157 @@
+"""Context parallelism: ring attention over a ``seq`` mesh axis.
+
+Long sequences don't fit one device's HBM because attention is O(S²) in
+compute and O(S·D) in activations per device. Ring attention (Liu et al.,
+https://arxiv.org/abs/2310.01889) shards the SEQUENCE across devices:
+each device keeps its own query block resident and k/v blocks travel
+around the ring (``lax.ppermute`` over ICI), while an online-softmax
+accumulator (the flash-attention recurrence) combines one incoming block
+at a time — full S×S scores are never materialized, and k/v transfer
+overlaps the current block's matmuls.
+
+The reference framework has no sequence-length scaling machinery (SURVEY.md
+§5 "long-context: absent"); here it is a first-class intra-replica-group
+capability: the ``seq`` axis lives INSIDE a replica group's slice mesh
+(never spanning a failure domain), composing with tensor parallel
+(``model`` axis splits heads) and data parallel (``data`` axis splits
+batch) under one jitted step — and with the cross-group fault-tolerance
+layer exactly like any other intra-group sharding.
+
+Usage inside a jitted step (the mesh's sequence axis must evenly divide S):
+
+    out = ring_attention(q, k, v, mesh=mesh, seq_axis="seq",
+                         batch_axis="data", head_axis="model")
+
+where q/k/v are (B, S, H, head_dim) arrays (globally sharded or not — the
+embedded shard_map re-shards as needed).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str,
+    varying_axes: tuple,
+    n_blocks: int,
+    causal: bool,
+) -> jax.Array:
+    """Device-local body: q is this device's query block (B, Sl, H, Dh);
+    k/v start as its key/value block and rotate around the ring."""
+    B, Sl, H, Dh = q.shape
+    scale = Dh ** -0.5
+    blk = jax.lax.axis_index(seq_axis)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = blk * Sl + jnp.arange(Sl)
+
+    # Online-softmax state: running max m, normalizer l, weighted sum acc.
+    # pcast to varying: the carries start as shard-invariant constants but
+    # the loop output differs per shard of every mapped axis
+    # (new-shard_map VMA typing).
+    def _varying(x):
+        return jax.lax.pcast(x, varying_axes, to="varying")
+
+    m0 = _varying(jnp.full((B, H, Sl), -jnp.inf, jnp.float32))
+    l0 = _varying(jnp.zeros((B, H, Sl), jnp.float32))
+    acc0 = _varying(jnp.zeros((B, H, Sl, Dh), jnp.float32))
+
+    # Ring step s: this device holds kv block (blk - s) mod n.
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    def step(s, carry):
+        m, l, acc, k_blk, v_blk = carry
+        kv_idx = (blk - s) % n_blocks
+        kv_pos = kv_idx * Sl + jnp.arange(Sl)
+
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+            )
+            * scale
+        )
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask, scores, -jnp.inf)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # Blocks entirely masked keep m = -inf; guard the exp against
+        # (-inf) - (-inf).
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+
+        k_next = jax.lax.ppermute(k_blk, seq_axis, perm)
+        v_next = jax.lax.ppermute(v_blk, seq_axis, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    m, l, acc, _, _ = jax.lax.fori_loop(
+        0, n_blocks, step, (m0, l0, acc0, k, v)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, H, Sl, Dh)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Any,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = "data",
+    head_axis: Optional[str] = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Sequence-sharded causal self-attention.
+
+    Args:
+        q, k, v: (B, S, H, head_dim). S must divide evenly by the mesh's
+            ``seq_axis`` size.
+        mesh: the replica group's slice mesh.
+        seq_axis: mesh axis the sequence is sharded over (k/v ring).
+        batch_axis: mesh axis the batch is sharded over (pure data
+            parallel inside the op), or None.
+        head_axis: mesh axis heads are split over (tensor parallel), or
+            None.
+    Returns:
+        (B, S, H, head_dim), same sharding layout as q.
+    """
+    n_blocks = mesh.shape[seq_axis]
+    if q.shape[1] % n_blocks:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by "
+            f"{seq_axis}={n_blocks}"
+        )
+    spec = P(batch_axis, seq_axis, head_axis, None)
+    local = functools.partial(
+        _ring_attention_local,
+        seq_axis=seq_axis,
+        varying_axes=tuple(
+            a for a in (batch_axis, seq_axis, head_axis) if a is not None
+        ),
+        n_blocks=n_blocks,
+        causal=causal,
+    )
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)

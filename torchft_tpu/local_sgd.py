@@ -11,8 +11,12 @@ last synchronized state, preserving exactly-``sync_every`` semantics
 JAX shape: the reference hooks ``optimizer.step``; here the train loop calls
 ``local_sgd.step(grads)`` explicitly (optax has no hooks), which applies the
 inner update and triggers ``sync()`` on the window boundary. The backup copy
-lives on HOST (the reference's CPU backup, local_sgd.py:81-91) — one
-device→host snapshot per window, not per step.
+stays ON DEVICE — the reference offloads it to pinned CPU memory
+(local_sgd.py:81-91) because GPU memory is scarce, but on TPU a second
+params copy is cheap HBM while every device↔host crossing rides the slow
+link; an HBM↔HBM copy per window replaces two full-tree transfers. The
+checkpoint transport converts to host only when a recovery peer actually
+asks (checkpointing._to_host).
 
 DiLoCo (https://arxiv.org/pdf/2311.08105): inner optimizer steps locally;
 at the window boundary the *pseudogradient* Δ = θ_global_old − θ_local_new
@@ -36,11 +40,15 @@ from .train_state import FTTrainState, _to_device_tree
 logger: logging.Logger = logging.getLogger(__name__)
 
 
-def _to_host_copy(tree: Any) -> Any:
-    """Detached host (numpy) copy of every array leaf."""
+def _detached_copy(tree: Any) -> Any:
+    """Detached same-device copy of every array leaf (HBM→HBM for jax
+    arrays — never crosses the host link); numpy leaves are copied on
+    host."""
     import jax
 
-    return jax.tree_util.tree_map(lambda l: np.array(np.asarray(l)), tree)
+    return jax.tree_util.tree_map(
+        lambda l: l.copy() if isinstance(l, jax.Array) else np.array(l), tree
+    )
 
 
 class LocalSGD:
@@ -65,8 +73,9 @@ class LocalSGD:
         self._state = state
         self._sync_every = sync_every
         self._local_step = 0
-        # Host backup of the last synchronized params (reference :81-95).
-        self._backup_params: Any = _to_host_copy(state.params)
+        # On-device backup of the last synchronized params (role of the
+        # reference's CPU backup, :81-95; see module docstring).
+        self._backup_params: Any = _detached_copy(state.params)
 
     # -- train-loop surface --
 
@@ -95,16 +104,20 @@ class LocalSGD:
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self._state.load_state_dict(sd["state"])
-        self._backup_params = sd["backup_params"]
+        # Checkpoints deliver numpy leaves; bring the backup to device.
+        self._backup_params = _to_device_tree(sd["backup_params"])
         self._local_step = sd["local_step"]
 
     # -- internals --
 
     def _save_parameters(self) -> None:
-        self._backup_params = _to_host_copy(self._state.params)
+        self._backup_params = _detached_copy(self._state.params)
 
     def _restore_parameters(self) -> None:
-        self._state.params = _to_device_tree(self._backup_params)
+        # COPY, never alias: FTTrainState.apply_gradients donates its
+        # params buffers, so handing the backup itself to state.params
+        # would let the next inner step delete the backup.
+        self._state.params = _detached_copy(self._backup_params)
 
     def _perform_sync(self) -> None:
         """Average params; commit -> new backup, abort -> roll the whole
@@ -166,7 +179,9 @@ class DiLoCo(LocalSGD):
         averaged = self._manager.allreduce(pseudo_grads, op=ReduceOp.AVG).wait()
 
         # Restore to the last global state before applying the outer step.
-        self._state.params = old_global
+        # Copy: state.params buffers get donated by the next inner step,
+        # and old_global aliases the on-device backup.
+        self._state.params = _detached_copy(old_global)
 
         if self._manager.should_commit():
             updates, self._outer_state = self._outer_tx.update(
@@ -214,16 +229,27 @@ class AsyncDiLoCo(DiLoCo):
         outer_tx: Any,
         sync_every: int,
         compress: Any = None,
+        overlap: bool = True,
     ) -> None:
         """``compress="bf16"`` casts pseudogradients to bfloat16 on-device
         before the allreduce — halving device→host, wire (native bf16
         dtype), and host→device bytes. Standard DiLoCo practice: the outer
         optimizer sees bf16-rounded pseudogradients, the f32 master params
-        are untouched."""
+        are untouched.
+
+        ``overlap=False`` completes the sync AT the boundary instead of one
+        window later (the reconciliation degenerates to θ = G', i.e. exact
+        synchronous DiLoCo, but through the same jitted ops). Use it on
+        hosts where device↔host transfers contend with compute dispatch
+        (e.g. a tunneled/proxied device runtime): there, an in-flight
+        transfer under a stream of async dispatches can starve for far
+        longer than its serial wall time, and a blocking boundary sync is
+        strictly faster."""
         if compress not in (None, "bf16"):
             raise ValueError(f"unsupported compress mode: {compress}")
         super().__init__(manager, state, outer_tx, sync_every)
         self._compress = compress
+        self._overlap = overlap
         self._pending: Any = None  # (work, delta) of the in-flight window
         self._delta_fn: Any = None  # jitted Δ = B − θ (with optional cast)
         self._commit_fn: Any = None  # jitted delayed outer update + reconcile
@@ -233,6 +259,8 @@ class AsyncDiLoCo(DiLoCo):
         self._finish_pending()
         self._manager.start_quorum()
         self._launch_sync()
+        if not self._overlap:
+            self._finish_pending()
         self._local_step = 0
 
     def flush(self) -> None:
@@ -311,7 +339,7 @@ class AsyncDiLoCo(DiLoCo):
                 averaged, old_global, delta, self._outer_state,
                 self._state.params,
             )
-            self._backup_params = _to_host_copy(new_global)
+            self._backup_params = _detached_copy(new_global)
         else:
             # Window k discarded; window k+1's local progress survives.
             self._state.params = self._abort_fn(self._state.params, delta)

@@ -26,6 +26,7 @@ import logging
 import os
 import socket
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
@@ -37,6 +38,7 @@ from ._native import ManagerClient, StoreClient
 from .checkpointing import CheckpointServer, CheckpointTransport
 from .collectives import Collectives, ReduceOp, Work, _completed
 from .futures import work_timeout
+from .metrics import Metrics
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -161,8 +163,7 @@ class Manager:
         self._pending_state_dict: Optional[Dict[str, object]] = None
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
-        self._div_fn: Optional[Any] = None  # jitted AVG divide (per-leaf
-        # eager division costs one dispatch per leaf on remote devices)
+        self._metrics = Metrics()
 
         lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
         replica_id = replica_id if replica_id is not None else ""
@@ -265,14 +266,15 @@ class Manager:
             force_reconfigure = self._force_reconfigure
             self._force_reconfigure = False
         try:
-            result = self._client.quorum(
-                rank=self._rank,
-                step=self._step,
-                checkpoint_metadata=self._checkpoint_transport.metadata(),
-                shrink_only=shrink_only,
-                force_reconfigure=force_reconfigure,
-                timeout=quorum_timeout,
-            )
+            with self._metrics.timed("quorum"):
+                result = self._client.quorum(
+                    rank=self._rank,
+                    step=self._step,
+                    checkpoint_metadata=self._checkpoint_transport.metadata(),
+                    shrink_only=shrink_only,
+                    force_reconfigure=force_reconfigure,
+                    timeout=quorum_timeout,
+                )
         except Exception:
             if force_reconfigure:
                 with self._error_lock:
@@ -314,9 +316,11 @@ class Manager:
             # rank, and stale members can't collide (reference :470-477).
             prefix = f"{store_address}/torchft/{quorum_id}/{self._rank}"
             self._logger.info(f"reconfiguring collectives quorum_id={quorum_id}")
-            self._collectives.configure(
-                prefix, result.replica_rank, result.replica_world_size
-            )
+            with self._metrics.timed("reconfigure"):
+                self._collectives.configure(
+                    prefix, result.replica_rank, result.replica_world_size
+                )
+            self._metrics.incr("reconfigures")
             self._quorum_id = quorum_id
 
         if allow_heal:
@@ -333,6 +337,7 @@ class Manager:
                 )
             if heal:
                 self._healing = True
+                self._metrics.incr("heals")
                 self._logger.info(
                     f"healing required, fetching checkpoint from "
                     f"{result.recover_src_manager_address} step={result.max_step}"
@@ -400,20 +405,28 @@ class Manager:
                 tree = jax.tree_util.tree_map(
                     lambda l: l * 0 if hasattr(l, "__mul__") else l, tree
                 )
-            work = self._collectives.allreduce(tree, ReduceOp.SUM)
             if op == ReduceOp.AVG:
+                # The participant average rides the collectives' divisor
+                # path (applied host-side in the ring, where the bytes
+                # already are) — no extra jit program or device dispatch
+                # per step. Divisor = num_participants, NOT ring size:
+                # healing/spare members contribute zeros and don't count
+                # (reference manager.py:279-291).
                 assert num_participants >= 1
-                if self._div_fn is None:
-                    self._div_fn = jax.jit(
-                        lambda t, n: jax.tree_util.tree_map(
-                            lambda l: l / n, t
-                        )
-                    )
-                work = work.then(
-                    lambda t: self._div_fn(t, float(num_participants))
-                )
-            elif op != ReduceOp.SUM:
+                divisor: Optional[float] = float(num_participants)
+            elif op == ReduceOp.SUM:
+                divisor = None
+            else:
                 raise ValueError(f"unsupported managed allreduce op: {op}")
+            t0 = time.perf_counter()
+            work = self._collectives.allreduce(
+                tree, ReduceOp.SUM, divisor=divisor
+            )
+            work.add_done_callback(
+                lambda _f: self._metrics.record(
+                    "allreduce", time.perf_counter() - t0
+                )
+            )
             return self.wrap_work(work, default=tree)
         except Exception as e:  # noqa: BLE001 - latch, never raise
             self._logger.exception(f"allreduce failed immediately: {e}")
@@ -506,12 +519,13 @@ class Manager:
             self._errored is None
             and self.num_participants() >= self._min_replica_size
         )
-        should_commit = self._client.should_commit(
-            self._rank,
-            self._step,
-            local_should_commit,
-            timeout=timeout or self._timeout,
-        )
+        with self._metrics.timed("commit_vote"):
+            should_commit = self._client.should_commit(
+                self._rank,
+                self._step,
+                local_should_commit,
+                timeout=timeout or self._timeout,
+            )
         self._logger.info(
             f"should_commit={should_commit} enough_replicas="
             f"{self.num_participants() >= self._min_replica_size}, "
@@ -525,6 +539,9 @@ class Manager:
         if should_commit:
             self._step += 1
             self._batches_committed += self.num_participants()
+        self._metrics.incr("commits" if should_commit else "aborts")
+        if self._errored is not None:
+            self._metrics.incr("errors")
         self._healing = False
         return should_commit
 
@@ -549,6 +566,14 @@ class Manager:
         return {"step": self._step, "batches_committed": self._batches_committed}
 
     # -- introspection --
+
+    def metrics(self) -> "Metrics":
+        """Step-level counters and timers (commits/aborts/heals/errors,
+        quorum / reconfigure / allreduce / commit-vote latencies). Closes
+        the observability gap the reference leaves at batches_committed
+        (reference manager.py:642-653); ``metrics().snapshot()`` is
+        JSON-able."""
+        return self._metrics
 
     def current_step(self) -> int:
         """Committed step count; skipped steps don't increment it."""

@@ -1,0 +1,97 @@
+"""Lightweight step-level metrics for the fault-tolerance runtime.
+
+The reference's only progress metric is ``batches_committed``
+(reference torchft/manager.py:642-653); observability is otherwise logs +
+the dashboard. This module closes the SURVEY.md §5 tracing gap with
+in-process counters/timers the Manager feeds at the transaction's
+boundaries — no external dependencies, negligible overhead (a deque append
+per event), and a one-call JSON-able snapshot for progress loops,
+dashboards, or tests::
+
+    manager.metrics().snapshot()
+    # {"counters": {"commits": 98, "aborts": 2, "heals": 1, ...},
+    #  "timers_s": {"quorum": {"n":100,"p50":0.0012,"p90":0.003,...}, ...}}
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict
+
+
+class _Timer:
+    """Bounded reservoir of durations with percentile snapshots."""
+
+    def __init__(self, maxlen: int = 512) -> None:
+        self._samples: deque = deque(maxlen=maxlen)
+        self.count = 0
+        self.total_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        self._samples.append(seconds)
+        self.count += 1
+        self.total_s += seconds
+
+    def snapshot(self) -> Dict[str, float]:
+        samples = sorted(self._samples)
+        if not samples:
+            return {"n": 0}
+
+        def pct(p: float) -> float:
+            return samples[min(int(p * len(samples)), len(samples) - 1)]
+
+        return {
+            "n": self.count,
+            "total_s": round(self.total_s, 6),
+            "p50": round(pct(0.50), 6),
+            "p90": round(pct(0.90), 6),
+            "max": round(samples[-1], 6),
+        }
+
+
+class Metrics:
+    """Thread-safe counters + timers. All methods are cheap enough for the
+    hot path; reading is lock-held but O(window)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._timers: Dict[str, _Timer] = {}
+
+    def incr(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += by
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            timer = self._timers.get(name)
+            if timer is None:
+                timer = self._timers[name] = _Timer()
+            timer.record(seconds)
+
+    def timed(self, name: str) -> "_TimedBlock":
+        return _TimedBlock(self, name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "timers_s": {
+                    name: t.snapshot() for name, t in self._timers.items()
+                },
+            }
+
+
+class _TimedBlock:
+    def __init__(self, metrics: Metrics, name: str) -> None:
+        self._metrics = metrics
+        self._name = name
+
+    def __enter__(self) -> "_TimedBlock":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self._metrics.record(self._name, time.perf_counter() - self._t0)

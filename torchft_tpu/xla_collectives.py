@@ -306,30 +306,33 @@ class XLACollectives(Collectives):
 
         return [jnp.asarray(np.asarray(l)) for l in leaves]
 
-    def _reduce_jit(self, n_leaves: int, op: ReduceOp) -> Any:
+    def _reduce_jit(self, n_leaves: int, op: ReduceOp, with_divisor: bool) -> Any:
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        key = ("reduce", n_leaves, int(op))
+        key = ("reduce", n_leaves, int(op), with_divisor)
         fn = self._jit_cache.get(key)
         if fn is None:
             world = self._world_size
             replicated = NamedSharding(self._mesh, P())
 
-            def reduce(leaves):
+            def _div(s, leaf_dtype, d):
+                # Same-dtype contract (Collectives.allreduce): integers
+                # floor-divide like the host ring does.
+                if jnp.issubdtype(leaf_dtype, jnp.integer):
+                    return s // jnp.asarray(d, s.dtype)
+                return (s / d).astype(leaf_dtype)
+
+            def reduce(leaves, divisor=None):
                 outs = []
                 for l in leaves:
                     if op == ReduceOp.SUM:
                         r = jnp.sum(l, axis=0)
+                        if divisor is not None:
+                            r = _div(r, l.dtype, divisor)
                     elif op == ReduceOp.AVG:
-                        s = jnp.sum(l, axis=0)
-                        # Same-dtype contract (Collectives.allreduce):
-                        # integers floor-divide like the host ring does.
-                        if jnp.issubdtype(l.dtype, jnp.integer):
-                            r = s // world
-                        else:
-                            r = (s / world).astype(l.dtype)
+                        r = _div(jnp.sum(l, axis=0), l.dtype, world)
                     elif op == ReduceOp.MAX:
                         r = jnp.max(l, axis=0)
                     elif op == ReduceOp.MIN:
@@ -346,17 +349,40 @@ class XLACollectives(Collectives):
             )
         return fn
 
-    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.SUM) -> Work:
-        return self._submit(lambda: self._allreduce_sync(tree, op))
+    def allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+    ) -> Work:
+        return self._submit(lambda: self._allreduce_sync(tree, op, divisor))
 
-    def _allreduce_sync(self, tree: Any, op: ReduceOp) -> Any:
+    def _allreduce_sync(
+        self, tree: Any, op: ReduceOp, divisor: Optional[float] = None
+    ) -> Any:
+        if divisor is not None and op != ReduceOp.SUM:
+            raise ValueError("divisor only composes with ReduceOp.SUM")
         if self._world_size == 1:
+            if divisor is not None and divisor != 1:
+                import jax
+
+                from .collectives import _divide_leaf
+
+                return jax.tree_util.tree_map(
+                    lambda l: _divide_leaf(l, divisor), tree
+                )
             return tree
         leaves, treedef = _flatten(tree)
         if not leaves:
             return tree
         stacked = self._stack_global(leaves)
-        reduced = self._reduce_jit(len(leaves), op)(stacked)
+        fn = self._reduce_jit(len(leaves), op, divisor is not None)
+        if divisor is not None:
+            import jax.numpy as jnp
+
+            reduced = fn(stacked, jnp.float32(divisor))
+        else:
+            reduced = fn(stacked)
         return _unflatten(treedef, self._localize(reduced))
 
     def allgather(self, tree: Any) -> Work:
