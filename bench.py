@@ -281,14 +281,18 @@ def _bench_big(lighthouse) -> dict:
         manager._load_state_dict = diloco.load_state_dict
         manager._user_state_dict = diloco.state_dict
 
-        for _ in range(sync_every):  # warm window (compile + 1st sync launch)
+        for i in range(sync_every):  # warm window (compile + 1st sync launch)
             loss, grads = grad_fn(state.params, batch)
             diloco.step(grads)
+            if i % 64 == 63:
+                np.asarray(loss)  # real drain (see _barrier note)
         _barrier(state.params)
         t0 = time.perf_counter()
-        for _ in range(sync_every * windows):
+        for i in range(sync_every * windows):
             loss, grads = grad_fn(state.params, batch)
             diloco.step(grads)
+            if i % 64 == 63:
+                np.asarray(loss)  # real drain (see _barrier note)
         diloco.flush()
         _barrier(state.params)
         ft_sps = (sync_every * windows) / (time.perf_counter() - t0)
@@ -489,7 +493,7 @@ def main() -> None:
         + 1.0  # ring + dispatch slack
     )
     sync_every = int(
-        min(max(12 * sync_est_s * raw_sps, SYNC_EVERY), 6144) // 128 * 128
+        min(max(12 * sync_est_s * raw_sps, SYNC_EVERY), 4096) // 128 * 128
     ) or SYNC_EVERY
     diloco_windows = 1
     total_steps = sync_every * diloco_windows
@@ -522,16 +526,25 @@ def main() -> None:
 
     # Warmup: one full window (compiles the step AND both sync-side jits —
     # in serial mode the warm boundary runs launch+finish end to end).
-    for _ in range(sync_every):
+    # The periodic block bounds the in-flight dispatch queue: on the
+    # tunneled device runtime an unbounded multi-thousand-op queue can
+    # wedge the session (observed reproducibly at 6k+ queued steps).
+    for i in range(sync_every):
         loss, grads = grad_fn(state.params, batch)
         diloco.step(grads)
+        if i % 64 == 63:
+            np.asarray(loss)  # real drain: block_until_ready returns
+            # before remote execution finishes on this tunnel (_barrier)
     if overlap:
         diloco.flush()  # pull the warm window's sync out of the timed region
     _barrier(state.params)
     t0 = time.perf_counter()
-    for _ in range(total_steps):
+    for i in range(total_steps):
         loss, grads = grad_fn(state.params, batch)
         diloco.step(grads)
+        if i % 64 == 63:
+            np.asarray(loss)  # real drain: block_until_ready returns
+            # before remote execution finishes on this tunnel (_barrier)
     diloco.flush()
     _barrier(state.params)
     ft_sps = total_steps / (time.perf_counter() - t0)
@@ -581,7 +594,7 @@ def _supervised() -> None:
     sessions keep working — an orchestrator that never touches the device
     can kill the stuck child and re-roll, instead of losing the round's
     metric. The child's final JSON line is re-printed verbatim."""
-    deadline_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 1500))
+    deadline_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 1200))
     env = dict(os.environ, BENCH_INNER="1")
     last_output = ""
     for attempt in range(2):

@@ -40,12 +40,27 @@ from .train_state import FTTrainState, _to_device_tree
 logger: logging.Logger = logging.getLogger(__name__)
 
 
+_copy_jit: Any = None
+
+
 def _detached_copy(tree: Any) -> Any:
     """Detached same-device copy of every array leaf (HBM→HBM for jax
     arrays — never crosses the host link); numpy leaves are copied on
-    host."""
+    host. All-jax trees copy through ONE jitted program (one dispatch per
+    window instead of one per leaf — eager per-leaf RPCs add up on remote
+    device runtimes)."""
     import jax
+    import jax.numpy as jnp
 
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves and all(isinstance(l, jax.Array) for l in leaves):
+        global _copy_jit
+        if _copy_jit is None:
+            # jit outputs never alias non-donated inputs: fresh buffers.
+            _copy_jit = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t)
+            )
+        return _copy_jit(tree)
     return jax.tree_util.tree_map(
         lambda l: l.copy() if isinstance(l, jax.Array) else np.array(l), tree
     )
