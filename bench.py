@@ -74,6 +74,9 @@ def _model_setup(size: str = None):
             d_ff=4096,
             max_seq_len=2048,
             remat=True,  # 2048-seq activations exceed HBM without it
+            # fused pallas attention: no S x S score tensor in HBM
+            # (1.4x over XLA dense attention at seq 2048 on v5e)
+            use_flash=on_tpu,
         )
         batch_size, seq_len = 4, 2048
     else:

@@ -1,0 +1,92 @@
+"""Profiling subsystem: windowed jax profiler capture + spans.
+
+Closes SURVEY.md §5's tracing gap; the reference has no analog, so these
+tests pin OUR contract: captures are step-windowed, env-configurable,
+failure-tolerant, and spans are no-ops without an active session.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchft_tpu.profiling import Profiler, span, step_span
+
+
+def test_span_noop_without_capture():
+    with span("torchft::test"):
+        pass
+    with step_span(3):
+        jnp.ones(4).sum()
+
+
+def test_windowed_capture_writes_trace(tmp_path):
+    logdir = str(tmp_path / "trace")
+    prof = Profiler(logdir, start_step=2, num_steps=2)
+    assert prof.state == "idle"
+    prof.on_step(0)
+    prof.on_step(1)
+    assert prof.state == "idle"
+    prof.on_step(2)  # starts
+    assert prof.state == "active"
+    with step_span(2), span("torchft::quorum"):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    prof.on_step(3)
+    assert prof.state == "active"  # stop_after = start + num = 4
+    prof.on_step(4)  # stops
+    assert prof.state == "done"
+    files = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace files written"
+    # further steps are no-ops
+    prof.on_step(5)
+    assert prof.state == "done"
+
+
+def test_late_start_still_captures_num_steps(tmp_path):
+    # a replica resuming at step 100 with start_step=10 must still get a
+    # num_steps-wide window, not stop on the next step
+    prof = Profiler(str(tmp_path / "late"), start_step=10, num_steps=5)
+    prof.on_step(100)
+    assert prof.state == "active"
+    prof.on_step(101)
+    prof.on_step(104)
+    assert prof.state == "active"
+    prof.on_step(105)
+    assert prof.state == "done"
+
+
+def test_shutdown_flushes_active_capture(tmp_path):
+    logdir = str(tmp_path / "trace2")
+    prof = Profiler(logdir, start_step=0, num_steps=100)
+    prof.on_step(0)
+    assert prof.state == "active"
+    prof.shutdown()
+    assert prof.state == "done"
+    files = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files)
+
+
+def test_from_env(monkeypatch, tmp_path):
+    assert Profiler.from_env() is None
+    monkeypatch.setenv("TORCHFT_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("TORCHFT_PROFILE_START", "7")
+    monkeypatch.setenv("TORCHFT_PROFILE_STEPS", "3")
+    prof = Profiler.from_env()
+    assert prof is not None
+    assert prof.logdir == str(tmp_path)
+    assert prof.start_step == 7
+    assert prof.num_steps == 3
+
+
+def test_double_start_is_swallowed(tmp_path):
+    # a second Profiler starting while one is active must log, not raise
+    a = Profiler(str(tmp_path / "a"), start_step=0, num_steps=10)
+    b = Profiler(str(tmp_path / "b"), start_step=0, num_steps=10)
+    a.on_step(0)
+    b.on_step(0)  # jax only allows one trace; failure must be swallowed
+    a.shutdown()
+    b.shutdown()
+    assert a.state == "done"
+    assert b.state == "done"

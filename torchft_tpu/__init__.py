@@ -28,6 +28,8 @@ from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
 from torchft_tpu.optim import OptimizerWrapper
+from torchft_tpu.pipeline import pipeline_blocks, stack_blocks
+from torchft_tpu.profiling import Profiler
 from torchft_tpu.train_state import FTTrainState
 from torchft_tpu.xla_collectives import XLACollectives
 
@@ -48,7 +50,10 @@ __all__ = [
     "ManagerClient",
     "Optimizer",
     "OptimizerWrapper",
+    "Profiler",
     "QuorumResult",
+    "pipeline_blocks",
+    "stack_blocks",
     "ReduceOp",
     "StatefulDataLoader",
     "Store",

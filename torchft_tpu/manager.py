@@ -39,6 +39,7 @@ from .checkpointing import CheckpointServer, CheckpointTransport
 from .collectives import Collectives, ReduceOp, Work, _completed
 from .futures import work_timeout
 from .metrics import Metrics
+from .profiling import Profiler, span
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -82,6 +83,7 @@ class Manager:
         hostname: str = socket.gethostname(),
         heartbeat_interval: timedelta = timedelta(milliseconds=100),
         checkpoint_transport: Optional[CheckpointTransport[Dict[str, T]]] = None,
+        profiler: Optional["Profiler"] = None,
     ) -> None:
         """
         Args:
@@ -102,6 +104,9 @@ class Manager:
             lighthouse_addr: global lighthouse (env ``TORCHFT_LIGHTHOUSE``).
             replica_id: replica group name; a uuid suffix is appended by
                 group rank 0 (reference manager.py:196-200).
+            profiler: windowed jax profiler capture advanced once per
+                step; defaults to ``Profiler.from_env()``
+                (``TORCHFT_PROFILE_DIR`` etc., torchft_tpu.profiling).
         """
         self._load_state_dict = load_state_dict
         self._user_state_dict = state_dict
@@ -164,6 +169,9 @@ class Manager:
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
         self._metrics = Metrics()
+        self._profiler = (
+            profiler if profiler is not None else Profiler.from_env()
+        )
 
         lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
         replica_id = replica_id if replica_id is not None else ""
@@ -202,6 +210,8 @@ class Manager:
         self._logger = _ManagerLogger(self, self._replica_id, self._rank)
 
     def shutdown(self) -> None:
+        if self._profiler is not None:
+            self._profiler.shutdown()
         self._checkpoint_transport.shutdown(wait=False)
         self._executor.shutdown(wait=True)
         if self._manager is not None:
@@ -220,6 +230,8 @@ class Manager:
         Must be called at the start of every train step (before the first
         ``allreduce``) on every rank. Reference manager.py:365-415.
         """
+        if self._profiler is not None:
+            self._profiler.on_step(self._step)
         if self._quorum_future is not None:
             # Wait for the previous quorum (and any healing) to finish. Its
             # errors were already surfaced through allreduce/should_commit;
@@ -266,7 +278,7 @@ class Manager:
             force_reconfigure = self._force_reconfigure
             self._force_reconfigure = False
         try:
-            with self._metrics.timed("quorum"):
+            with self._metrics.timed("quorum"), span("torchft::quorum"):
                 result = self._client.quorum(
                     rank=self._rank,
                     step=self._step,
@@ -316,7 +328,9 @@ class Manager:
             # rank, and stale members can't collide (reference :470-477).
             prefix = f"{store_address}/torchft/{quorum_id}/{self._rank}"
             self._logger.info(f"reconfiguring collectives quorum_id={quorum_id}")
-            with self._metrics.timed("reconfigure"):
+            with self._metrics.timed("reconfigure"), span(
+                "torchft::reconfigure"
+            ):
                 self._collectives.configure(
                     prefix, result.replica_rank, result.replica_world_size
                 )
@@ -329,12 +343,13 @@ class Manager:
                 self._logger.info(
                     f"peers need recovery from us {result.recover_dst_ranks}"
                 )
-                self._checkpoint_transport.send_checkpoint(
-                    dst_ranks=result.recover_dst_ranks,
-                    step=result.max_step,
-                    state_dict=self._manager_state_dict(),
-                    timeout=self._timeout,
-                )
+                with span("torchft::send_checkpoint"):
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=result.recover_dst_ranks,
+                        step=result.max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
             if heal:
                 self._healing = True
                 self._metrics.incr("heals")
@@ -350,12 +365,13 @@ class Manager:
                     self._rank, timeout=self._timeout
                 )
                 assert result.recover_src_rank is not None
-                checkpoint = self._checkpoint_transport.recv_checkpoint(
-                    src_rank=result.recover_src_rank,
-                    metadata=checkpoint_metadata,
-                    step=result.max_step,
-                    timeout=self._timeout,
-                )
+                with span("torchft::recv_checkpoint"):
+                    checkpoint = self._checkpoint_transport.recv_checkpoint(
+                        src_rank=result.recover_src_rank,
+                        metadata=checkpoint_metadata,
+                        step=result.max_step,
+                        timeout=self._timeout,
+                    )
                 # Manager state is applied immediately (so step/commit
                 # counters are right); user state waits for a safe point on
                 # the main thread (reference :514-526).
@@ -419,9 +435,10 @@ class Manager:
             else:
                 raise ValueError(f"unsupported managed allreduce op: {op}")
             t0 = time.perf_counter()
-            work = self._collectives.allreduce(
-                tree, ReduceOp.SUM, divisor=divisor
-            )
+            with span("torchft::allreduce_dispatch"):
+                work = self._collectives.allreduce(
+                    tree, ReduceOp.SUM, divisor=divisor
+                )
             work.add_done_callback(
                 lambda _f: self._metrics.record(
                     "allreduce", time.perf_counter() - t0
@@ -519,7 +536,7 @@ class Manager:
             self._errored is None
             and self.num_participants() >= self._min_replica_size
         )
-        with self._metrics.timed("commit_vote"):
+        with self._metrics.timed("commit_vote"), span("torchft::commit_vote"):
             should_commit = self._client.should_commit(
                 self._rank,
                 self._step,

@@ -1,3 +1,5 @@
+from torchft_tpu.models import moe
+from torchft_tpu.models.moe import MoEConfig, tiny_moe_config
 from torchft_tpu.models.transformer import (
     TransformerConfig,
     forward,
@@ -8,10 +10,13 @@ from torchft_tpu.models.transformer import (
 )
 
 __all__ = [
+    "MoEConfig",
     "TransformerConfig",
     "forward",
     "init_params",
     "loss_fn",
+    "moe",
     "param_sharding_rules",
     "tiny_config",
+    "tiny_moe_config",
 ]

@@ -47,6 +47,12 @@ class TransformerConfig:
     cp_mesh: Any = None
     cp_batch_axis: Any = "data"
     cp_head_axis: Any = None
+    # Fused pallas flash attention (torchft_tpu.ops.flash_attention) for the
+    # dense path: no S x S score matrix in HBM. When cp_mesh is set (and
+    # cp_seq_axis is not — that selects ring attention), the kernel runs
+    # per-shard under shard_map with batch over cp_batch_axis and heads
+    # over cp_head_axis.
+    use_flash: bool = False
     # Rematerialize each block's activations in backward (jax.checkpoint):
     # trades ~1/3 extra FLOPs for O(n_layers) less HBM — the standard TPU
     # recipe for long-sequence / large-batch configs.
@@ -66,68 +72,132 @@ def tiny_config() -> TransformerConfig:
     )
 
 
-def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
-    """f32 master params; matmuls cast to cfg.dtype at use."""
-    keys = jax.random.split(key, 2 + cfg.n_layers)
+def _dense_init(k, shape, s):
+    return jax.random.normal(k, shape, jnp.float32) * s
+
+
+def attn_sublayer_init(
+    cfg: TransformerConfig, k_qkv: jax.Array, k_o: jax.Array
+) -> Dict[str, Any]:
+    """ln1 + attention weights; shared by the dense and MoE families."""
     scale = cfg.d_model ** -0.5
+    return {
+        "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+        "attn": {
+            # fused QKV, column-parallel over the model axis
+            "wqkv": _dense_init(k_qkv, (cfg.d_model, 3 * cfg.d_model), scale),
+            # out projection, row-parallel
+            "wo": _dense_init(k_o, (cfg.d_model, cfg.d_model), scale),
+        },
+        "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
 
-    def dense(k, shape, s):
-        return jax.random.normal(k, shape, jnp.float32) * s
 
-    blocks = []
-    for i in range(cfg.n_layers):
-        bk = jax.random.split(keys[2 + i], 4)
-        blocks.append(
-            {
-                "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
-                "attn": {
-                    # fused QKV, column-parallel over the model axis
-                    "wqkv": dense(bk[0], (cfg.d_model, 3 * cfg.d_model), scale),
-                    # out projection, row-parallel
-                    "wo": dense(bk[1], (cfg.d_model, cfg.d_model), scale),
-                },
-                "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
-                "mlp": {
-                    "wi": dense(bk[2], (cfg.d_model, cfg.d_ff), scale),
-                    "wo": dense(bk[3], (cfg.d_ff, cfg.d_model),
-                                cfg.d_ff ** -0.5),
-                },
-            }
-        )
+def mlp_init(
+    cfg: TransformerConfig, k_i: jax.Array, k_o: jax.Array
+) -> Dict[str, Any]:
+    scale = cfg.d_model ** -0.5
+    return {
+        "wi": _dense_init(k_i, (cfg.d_model, cfg.d_ff), scale),
+        "wo": _dense_init(k_o, (cfg.d_ff, cfg.d_model), cfg.d_ff ** -0.5),
+    }
+
+
+def backbone_init(
+    cfg: TransformerConfig, k_embed: jax.Array, k_pos: jax.Array
+) -> Dict[str, Any]:
+    """embed / pos_embed / ln_f — the non-block params both families
+    share."""
+    scale = cfg.d_model ** -0.5
     return {
         "embed": jax.random.normal(
-            keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32
+            k_embed, (cfg.vocab_size, cfg.d_model), jnp.float32
         ) * scale,
         "pos_embed": jax.random.normal(
-            keys[1], (cfg.max_seq_len, cfg.d_model), jnp.float32
+            k_pos, (cfg.max_seq_len, cfg.d_model), jnp.float32
         ) * 0.01,
-        "blocks": blocks,
         "ln_f": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
     }
 
 
-def param_sharding_rules(cfg: TransformerConfig) -> Dict[str, Any]:
-    """PartitionSpecs (pytree matching init_params) for a mesh with a
-    ``model`` axis: Megatron column/row parallelism. Replicated leaves get
-    P() so every spec is explicit."""
-    block = {
+def backbone_specs() -> Dict[str, Any]:
+    return {
+        "embed": P(None, "model"),
+        "pos_embed": P(),
+        "ln_f": {"scale": P()},
+    }
+
+
+def embed_tokens(
+    cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array
+) -> jax.Array:
+    """(B, S) int32 -> (B, S, D) activations in cfg.dtype."""
+    S = tokens.shape[1]
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    return x + params["pos_embed"].astype(cfg.dtype)[:S]
+
+
+def readout(
+    cfg: TransformerConfig, params: Dict[str, Any], x: jax.Array
+) -> jax.Array:
+    """Final norm + weight-tied readout; f32 logits for a stable
+    softmax."""
+    x = _rmsnorm(x, params["ln_f"]["scale"])
+    return (x @ params["embed"].astype(cfg.dtype).T).astype(jnp.float32)
+
+
+def mlp_apply(
+    cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array
+) -> jax.Array:
+    h = jax.nn.gelu(x @ p["wi"].astype(cfg.dtype))
+    return h @ p["wo"].astype(cfg.dtype)
+
+
+def next_token_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def attn_sublayer_specs() -> Dict[str, Any]:
+    """Megatron attention PartitionSpecs; shared with the MoE family."""
+    return {
         "ln1": {"scale": P()},
         "attn": {
             "wqkv": P(None, "model"),  # column-parallel: heads split
             "wo": P("model", None),    # row-parallel: partial sums psum'd
         },
         "ln2": {"scale": P()},
-        "mlp": {
-            "wi": P(None, "model"),
-            "wo": P("model", None),
-        },
     }
-    return {
-        "embed": P(None, "model"),
-        "pos_embed": P(),
-        "blocks": [block] * cfg.n_layers,
-        "ln_f": {"scale": P()},
-    }
+
+
+def mlp_specs() -> Dict[str, Any]:
+    return {"wi": P(None, "model"), "wo": P("model", None)}
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """f32 master params; matmuls cast to cfg.dtype at use."""
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    blocks = []
+    for i in range(cfg.n_layers):
+        bk = jax.random.split(keys[2 + i], 4)
+        block = attn_sublayer_init(cfg, bk[0], bk[1])
+        block["mlp"] = mlp_init(cfg, bk[2], bk[3])
+        blocks.append(block)
+    params = backbone_init(cfg, keys[0], keys[1])
+    params["blocks"] = blocks
+    return params
+
+
+def param_sharding_rules(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs (pytree matching init_params) for a mesh with a
+    ``model`` axis: Megatron column/row parallelism. Replicated leaves get
+    P() so every spec is explicit."""
+    block = attn_sublayer_specs()
+    block["mlp"] = mlp_specs()
+    rules = backbone_specs()
+    rules["blocks"] = [block] * cfg.n_layers
+    return rules
 
 
 def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
@@ -158,6 +228,17 @@ def _attention(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.A
         ).reshape(B, S, D)
         return out @ p["wo"].astype(cfg.dtype)
 
+    if cfg.use_flash:
+        from ..ops import flash_attention
+
+        out = flash_attention(
+            q, k, v,
+            mesh=cfg.cp_mesh,
+            batch_axis=cfg.cp_batch_axis if cfg.cp_mesh is not None else None,
+            head_axis=cfg.cp_head_axis,
+        ).reshape(B, S, D)
+        return out @ p["wo"].astype(cfg.dtype)
+
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (cfg.head_dim ** -0.5)
     causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
     scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
@@ -168,30 +249,21 @@ def _attention(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.A
 
 def _block(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
     x = x + _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
-    h = _rmsnorm(x, p["ln2"]["scale"])
-    h = jax.nn.gelu(h @ p["mlp"]["wi"].astype(cfg.dtype))
-    return x + h @ p["mlp"]["wo"].astype(cfg.dtype)
+    return x + mlp_apply(cfg, p["mlp"], _rmsnorm(x, p["ln2"]["scale"]))
 
 
 def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
     """tokens (B, S) int32 -> logits (B, S, vocab) f32."""
-    B, S = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    x = x + params["pos_embed"].astype(cfg.dtype)[:S]
+    x = embed_tokens(cfg, params, tokens)
     block = _block
     if cfg.remat:
         block = jax.checkpoint(_block, static_argnums=(0,))
     for p in params["blocks"]:
         x = block(cfg, p, x)
-    x = _rmsnorm(x, params["ln_f"]["scale"])
-    # weight-tied readout; f32 logits for a stable softmax
-    return (x @ params["embed"].astype(cfg.dtype).T).astype(jnp.float32)
+    return readout(cfg, params, x)
 
 
 def loss_fn(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
     """Next-token cross entropy over (B, S) int32 tokens."""
     logits = forward(cfg, params, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return next_token_loss(logits, tokens[:, 1:])

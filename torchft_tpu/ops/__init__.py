@@ -1,0 +1,11 @@
+"""TPU-native fused ops (pallas kernels).
+
+The reference framework has no custom kernels (its hot ops live inside
+PyTorch/NCCL); on TPU the hot op of the flagship training loop is
+attention, implemented here as a fused pallas flash-attention kernel so
+the O(S²) score matrix never round-trips HBM.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,438 @@
+"""Fused causal attention as a pallas TPU kernel (FlashAttention-2 style).
+
+The dense attention path in ``models/transformer.py`` materializes the
+(B, H, S, S) score matrix in HBM — at seq 2048 that is the single largest
+activation of the step and a pure HBM-bandwidth tax. This kernel keeps
+each (query-block × key-block) score tile in VMEM, runs the online-softmax
+recurrence (the same one ``context_parallel.ring_attention`` uses across
+devices, here across VMEM tiles within one device), and writes only the
+(S, D) output plus an (S,) logsumexp residual for the backward pass.
+
+Backward is the standard two-kernel FlashAttention-2 split: one kernel
+accumulates dq over key blocks, one accumulates dk/dv over query blocks,
+both recomputing probabilities from the saved logsumexp instead of storing
+the S×S matrix.
+
+Design notes (pallas_guide.md):
+- all matmuls request ``preferred_element_type=float32`` so the MXU
+  accumulates in f32 regardless of the bf16 inputs;
+- iota is always 2D (``broadcasted_iota``) — 1D iota does not lower;
+- blocks always span the full head dim, satisfying Mosaic's "divisible by
+  128 OR equal to the array dim" lane rule without padding D (padding to
+  128 lanes would double the QK FLOPs at the flagship head_dim of 64);
+  arbitrary sequence lengths ARE padded — up to the block multiple, with
+  padded keys masked in-kernel and padded queries carrying zero
+  cotangents;
+- causal kernels bound their inner ``fori_loop`` by the block diagonal so
+  masked-out tiles are never computed (dynamic trip counts lower to
+  ``while_loop``).
+
+Off-TPU the same kernels run under ``interpret=True`` so CPU tests and the
+virtual-device dryrun exercise the identical code path.
+
+Reference parity: none — the reference has no fused kernels (SURVEY.md
+§2.3: its compute path is plain torch ops + NCCL). This is the
+"pallas kernels for the hot ops" part of the TPU-first mandate.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = float("-inf")
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _dot_f32(a: jax.Array, b: jax.Array) -> jax.Array:
+    """MXU matmul keeping the inputs' (bf16) dtype, f32 accumulation —
+    casting inputs to f32 first would run the MXU at f32 rate (~8x slower
+    on v5e)."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _tile_mask(
+    q_start, k_start, block_q: int, block_k: int, kv_len: int,
+    causal: bool, padded: bool,
+):
+    """Validity mask for one (block_q, block_k) score tile, or None when
+    every position is live. Shared by the forward and both backward
+    kernels so the mask semantics cannot drift apart."""
+    if not (causal or padded):
+        return None
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    ok = k_pos < kv_len if padded else True
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        ok = (q_pos >= k_pos) & ok
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+    sm_scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
+    kv_len: int,
+):
+    qi = pl.program_id(1)
+    q = q_ref[0]  # (block_q, D), input dtype
+    D = q.shape[-1]
+    padded = kv_len < num_k * block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot_f32(q, k_blk.T) * sm_scale  # (block_q, block_k) f32
+        ok = _tile_mask(
+            qi * block_q, j * block_k, block_q, block_k, kv_len,
+            causal, padded,
+        )
+        if ok is not None:
+            s = jnp.where(ok, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # rows with every key masked keep m = -inf; guard exp(-inf - -inf)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m[:, None]), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[:, None] + _dot_f32(
+            p.astype(v_blk.dtype), v_blk
+        )
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((block_q,), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+        jnp.zeros((block_q, D), jnp.float32),
+    )
+    num_k_live = _cdiv(kv_len, block_k)  # skip fully-padded key blocks
+    if causal:
+        # key blocks strictly above the block diagonal are fully masked
+        hi = jnp.minimum(
+            num_k_live, ((qi + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        hi = num_k_live
+    m, l, acc = jax.lax.fori_loop(0, hi, body, init)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse rides a full-row (1, 1, S) block revisited across the sequential
+    # qi grid dim (a (1, block_q) 2D block violates Mosaic's (8, 128) tile
+    # floor); each step writes its slice
+    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = jnp.where(
+        jnp.isfinite(m), m + jnp.log(l_safe), _NEG_INF
+    )
+
+
+def _flash_fwd_call(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    sm_scale: float, causal: bool, block_q: int, block_k: int,
+    interpret: bool, kv_len: int,
+):
+    """q/k/v: (BH, S_pad, D) -> out (BH, S_pad, D), lse (BH, 1, S_pad)
+    f32. Positions >= kv_len are zero padding, masked out of every
+    softmax."""
+    BH, S, D = q.shape
+    num_q, num_k = _cdiv(S, block_q), _cdiv(S, block_k)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k, kv_len=kv_len,
+    )
+    row = pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0))
+    qspec = pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, num_q),
+        in_specs=[qspec, row, row],
+        out_specs=[
+            qspec,
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+    sm_scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
+    kv_len: int,
+):
+    qi = pl.program_id(1)
+    q = q_ref[0]  # (block_q, D), input dtype
+    do = do_ref[0]
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]  # (block_q,)
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    D = q.shape[-1]
+    padded = kv_len < num_k * block_k
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot_f32(q, k_blk.T) * sm_scale
+        p = jnp.exp(s - lse[:, None])  # exp(-inf) = 0 for fully-masked rows
+        ok = _tile_mask(
+            qi * block_q, j * block_k, block_q, block_k, kv_len,
+            causal, padded,
+        )
+        if ok is not None:
+            p = jnp.where(ok, p, 0.0)
+        dp = _dot_f32(do, v_blk.T)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + _dot_f32(ds.astype(k_blk.dtype), k_blk)
+
+    num_k_live = _cdiv(kv_len, block_k)
+    if causal:
+        hi = jnp.minimum(
+            num_k_live, ((qi + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        hi = num_k_live
+    dq = jax.lax.fori_loop(
+        0, hi, body, jnp.zeros((block_q, D), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+    sm_scale: float, causal: bool, block_q: int, block_k: int, num_q: int,
+    kv_len: int,
+):
+    ki = pl.program_id(1)
+    k_blk = k_ref[0]  # (block_k, D), input dtype
+    v_blk = v_ref[0]
+    D = k_blk.shape[-1]
+    # Padded QUERY rows need no mask here: their cotangent (do) and delta
+    # are zero, so ds and p.T @ do vanish. Padded KEY columns do: their
+    # scores are finite (zero), and without masking they would scatter
+    # real-query probability mass into dk/dv of positions that are then
+    # sliced off — and, worse, steal none from real keys since p is
+    # recomputed, not renormalized.
+    padded = kv_len < q_ref.shape[1]  # static: S_pad > kv_len
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = _dot_f32(q_blk, k_blk.T) * sm_scale
+        p = jnp.exp(s - lse[:, None])
+        ok = _tile_mask(
+            i * block_q, ki * block_k, block_q, block_k, kv_len,
+            causal, padded,
+        )
+        if ok is not None:
+            p = jnp.where(ok, p, 0.0)
+        dv_new = dv + _dot_f32(p.T.astype(do_blk.dtype), do_blk)
+        dp = _dot_f32(do_blk, v_blk.T)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_new = dk + _dot_f32(ds.T.astype(q_blk.dtype), q_blk)
+        return dk_new, dv_new
+
+    if causal:
+        # query blocks strictly below the block diagonal see none of this
+        # key block
+        lo = (ki * block_k) // block_q
+    else:
+        lo = 0
+    dk, dv = jax.lax.fori_loop(
+        lo, num_q, body,
+        (jnp.zeros((block_k, D), jnp.float32),
+         jnp.zeros((block_k, D), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_call(
+    q, k, v, o, lse, do, *,
+    sm_scale: float, causal: bool, block_q: int, block_k: int,
+    interpret: bool, kv_len: int,
+):
+    BH, S, D = q.shape
+    num_q, num_k = _cdiv(S, block_q), _cdiv(S, block_k)
+    # delta_i = sum_d do_id * o_id — one fused elementwise+reduce, not worth
+    # a kernel
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )[:, None, :]  # (BH, 1, S) — same full-row layout as lse
+
+    row3 = pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0))
+    row2 = pl.BlockSpec((1, 1, S), lambda bh, i: (bh, 0, 0))
+    qblk3 = pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0))
+    kblk3 = pl.BlockSpec((1, block_k, D), lambda bh, i: (bh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k=num_k, kv_len=kv_len,
+        ),
+        grid=(BH, num_q),
+        in_specs=[qblk3, row3, row3, qblk3, row2, row2],
+        out_specs=qblk3,
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q=num_q, kv_len=kv_len,
+        ),
+        grid=(BH, num_k),
+        in_specs=[row3, kblk3, kblk3, row3, row2, row2],
+        out_specs=[kblk3, kblk3],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp plumbing on the (BH, S, D) canonical layout
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg, q, k, v):
+    out, _ = _flash_fwd_res(cfg, q, k, v)
+    return out
+
+
+def _flash_fwd_res(cfg, q, k, v):
+    sm_scale, causal, block_q, block_k, interpret, kv_len = cfg
+    out, lse = _flash_fwd_call(
+        q, k, v, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        kv_len=kv_len,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_res(cfg, res, g):
+    sm_scale, causal, block_q, block_k, interpret, kv_len = cfg
+    q, k, v, out, lse = res
+    return _flash_bwd_call(
+        q, k, v, out, lse, g, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        kv_len=kv_len,
+    )
+
+
+_flash.defvjp(_flash_fwd_res, _flash_bwd_res)
+
+
+def _pick_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    mesh: Any = None,
+    batch_axis: Optional[str] = "data",
+    head_axis: Optional[str] = None,
+) -> jax.Array:
+    """Fused multi-head causal attention.
+
+    Args:
+        q, k, v: (B, S, H, head_dim), any float dtype.
+        causal: apply the autoregressive mask.
+        sm_scale: score scale; default ``head_dim ** -0.5``.
+        block_q, block_k: VMEM tile sizes; clamped to S. Default auto:
+            ``clamp(S // 8, 128, 512)`` — measured best on v5e (S=2048:
+            256/256 is 1.4x over XLA dense, S=8192: 512/512 is 3.9x).
+        interpret: force pallas interpret mode; default: on iff the backend
+            is not TPU (CPU tests / virtual-device dryruns).
+        mesh/batch_axis/head_axis: when ``mesh`` is given the kernel runs
+            per shard under ``shard_map`` with batch split over
+            ``batch_axis`` and heads over ``head_axis`` (a pallas call is a
+            single custom op XLA cannot partition on its own).
+    Returns:
+        (B, S, H, head_dim) attention output, dtype of q.
+    """
+    B, S, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+
+    if mesh is not None:
+        spec = P(batch_axis, None, head_axis, None)
+        local = functools.partial(
+            flash_attention, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        # check_vma=False: pallas out_shapes carry no varying-mesh-axes
+        # annotation, which the new shard_map VMA typing would reject
+        return shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    interp = _pick_interpret(interpret)
+    # Auto tile sizes (measured on v5e: 256 best at S=2048, 512 at 8192);
+    # arbitrary S is handled by zero-padding the sequence up to the block
+    # multiple — padded keys are masked in-kernel, padded queries carry
+    # zero cotangents, so numerics are exact.
+    auto = 512 if S >= 4096 else (256 if S >= 2048 else 128)
+    s8 = _cdiv(S, 8) * 8  # Mosaic sublane floor
+    block_q = min(block_q or auto, s8)
+    block_k = min(block_k or auto, s8)
+    base = block_q * block_k // math.gcd(block_q, block_k)
+    S_pad = _cdiv(S, base) * base
+
+    # (B, S, H, D) -> (B*H, S_pad, D). Blocks always span the full head
+    # dim, so Mosaic's "divisible by 128 OR equal to the array dim" lane
+    # rule is satisfied without padding D (padding to 128 lanes would
+    # double the QK FLOPs at the flagship head_dim of 64).
+    def to_rows(x):
+        x = x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+        if S_pad != S:
+            x = jnp.pad(x, ((0, 0), (0, S_pad - S), (0, 0)))
+        return x
+
+    cfg = (float(sm_scale), bool(causal), block_q, block_k, interp, S)
+    out = _flash(cfg, to_rows(q), to_rows(k), to_rows(v))
+    return out[:, :S].reshape(B, H, S, D).transpose(0, 2, 1, 3)

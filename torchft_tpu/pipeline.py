@@ -1,0 +1,187 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a
+``pipe`` mesh axis.
+
+TPU-first design — no per-stage processes, no send/recv threads, no
+schedulers: the whole pipeline is ONE jitted SPMD program.
+
+- Layer params are stacked on a leading stage dim and sharded
+  ``P("pipe")``, so each device materializes only its own stage's weights.
+- Activations move between stages with ``lax.ppermute`` over ICI inside
+  ``shard_map``; the classic GPipe schedule (M microbatches drained
+  through S stages in M + S - 1 ticks, bubble fraction (S-1)/(M+S-1))
+  is a ``lax.fori_loop`` — static shapes, compiler-friendly.
+- Backward needs nothing special: jax AD transposes the ppermutes and
+  replays the loop in reverse, so ``jax.grad`` of a pipelined loss just
+  works, and the FT layer (host-side cross-group allreduce of the
+  resulting grads) composes unchanged.
+
+The ``pipe`` axis lives INSIDE a replica group's slice mesh like
+``model``/``seq``/``expert`` — never spanning a failure domain — and is
+opaque to the fault-tolerance runtime, mirroring how the reference leaves
+intra-group dims to the user (reference process_group.py:1310-1341,
+train_ddp.py:52 "FSDP/PP/CP would need more ranks per group"; the
+reference itself has no PP implementation — SURVEY.md §2.3 "PP: absent").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+
+def stack_blocks(block_params: list) -> Any:
+    """Stack a list of identically-structured per-layer pytrees into one
+    pytree with a leading layer dim; shard it ``P("pipe", None, ...)`` so
+    each device stores only its stage's layers."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *block_params
+    )
+
+
+def stage_specs(stacked_params: Any, axis: str = "pipe") -> Any:
+    """PartitionSpecs for :func:`stack_blocks` output: ``axis`` on the
+    leading layer dim, replicated behind it (stages run tensor-unsharded
+    inside the pipe shard_map; compose TP by sharding block_fn's
+    internals explicitly if needed)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(
+        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), stacked_params
+    )
+
+
+def pipeline_blocks(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Any,
+    axis: str = "pipe",
+    microbatches: int,
+    data_axis: Any = None,
+) -> jax.Array:
+    """Run a stack of identical layers as a pipelined SPMD program.
+
+    Args:
+        block_fn: ``(one_layer_params, activations) -> activations``;
+            shapes must be preserved.
+        stacked_params: pytree with leading dim ``n_layers`` (from
+            :func:`stack_blocks`), n_layers divisible by the pipe size.
+        x: (B, ...) activations; B divisible by ``microbatches``, and each
+            microbatch must still be a well-formed batch for ``block_fn``.
+        mesh: the replica group's slice mesh containing ``axis``.
+        microbatches: GPipe M; bubble fraction is (S-1)/(M+S-1).
+        data_axis: optional mesh axis the batch dim is sharded over
+            (DP x PP composition); the microbatch split then happens on
+            the per-shard batch.
+    Returns:
+        (B, ...) activations, same sharding as ``x``.
+    """
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis]
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages}")
+    # the microbatch split happens on the PER-SHARD batch when the batch
+    # dim is also data-parallel
+    local_batch = x.shape[0] // (
+        mesh.shape[data_axis] if data_axis is not None else 1
+    )
+    if local_batch % microbatches:
+        raise ValueError(
+            f"per-shard batch {local_batch} not divisible by "
+            f"{microbatches} microbatches"
+        )
+
+    param_specs = stage_specs(stacked_params, axis)
+    x_spec = P(data_axis, *([None] * (x.ndim - 1)))
+
+    local = functools.partial(
+        _pipeline_local,
+        block_fn=block_fn,
+        axis=axis,
+        n_stages=n_stages,
+        microbatches=microbatches,
+    )
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stacked_params, x)
+
+
+def _pipeline_local(
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    axis: str,
+    n_stages: int,
+    microbatches: int,
+) -> jax.Array:
+    """Per-device body: my stage = my slice of the layer stack; run the
+    GPipe tick loop."""
+    import jax
+    import jax.numpy as jnp
+
+    stage_idx = jax.lax.axis_index(axis)
+    M = microbatches
+    B = x.shape[0]
+    mb = B // M
+    # (M, mb, ...) microbatch stream; every device carries the stream
+    # buffer, but only stage 0 reads it and only the last stage fills the
+    # output buffer (SPMD: same program, data-dependent roles).
+    stream = x.reshape((M, mb) + x.shape[1:])
+    out_buf = jnp.zeros_like(stream)
+
+    def stage_apply(h: jax.Array) -> jax.Array:
+        # my layers: (n_layers/n_stages, ...) leading dim, scanned in order
+        def body(carry, layer_params):
+            return block_fn(layer_params, carry), None
+
+        out, _ = jax.lax.scan(body, h, stacked_params)
+        return out
+
+    # Tick t: stage s processes microbatch (t - s) when 0 <= t - s < M.
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        # stage 0 injects microbatch t (clamped; masked by validity below)
+        inject = jax.lax.dynamic_index_in_dim(
+            stream, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+        )
+        h = jnp.where(stage_idx == 0, inject, recv)
+        y = stage_apply(h)
+        # last stage commits microbatch (t - (n_stages - 1)) when valid
+        out_idx = t - (n_stages - 1)
+        valid = (stage_idx == n_stages - 1) & (out_idx >= 0)
+        committed = jax.lax.dynamic_update_index_in_dim(
+            out_buf, y, jnp.clip(out_idx, 0, M - 1), axis=0
+        )
+        out_buf = jnp.where(valid, committed, out_buf)
+        # hand my output to the next stage (the wrap-around edge
+        # last->0 is ignored: stage 0 always injects)
+        recv = jax.lax.ppermute(y, axis, fwd_perm)
+        return (recv, out_buf), None
+
+    recv0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    # scan (not fori_loop/while_loop) so the tick loop is
+    # reverse-differentiable: grad of a pipelined loss replays ticks
+    # backwards with transposed ppermutes
+    (_, out_buf), _ = jax.lax.scan(
+        tick, (recv0, out_buf), jnp.arange(M + n_stages - 1)
+    )
+    # only the last stage holds real outputs; broadcast over the pipe axis
+    out_buf = jnp.where(stage_idx == n_stages - 1, out_buf, 0.0)
+    out_buf = jax.lax.psum(out_buf, axis)
+    return out_buf.reshape(x.shape)

@@ -1,0 +1,144 @@
+"""Tracing/profiling hooks: jax profiler spans around the FT transaction.
+
+The reference has NO tracing or profiling subsystem (SURVEY.md §5:
+"Tracing / profiling: none... Gap we may close on TPU with jax profiler
+hooks") — observability there is logs + dashboard. This module closes the
+gap the TPU-native way: the runtime's phase boundaries (quorum,
+reconfigure, allreduce dispatch, checkpoint send/recv, commit vote) are
+annotated with
+``jax.profiler.TraceAnnotation`` spans so they appear on the host track of
+a TensorBoard/XProf capture alongside XLA's device ops, and step
+boundaries with ``StepTraceAnnotation`` so XProf's step-time breakdown
+(compute vs host vs comms) works out of the box.
+
+Capture is driven either programmatically::
+
+    prof = Profiler(logdir="/tmp/trace", start_step=10, num_steps=5)
+    manager = Manager(..., profiler=prof)   # or prof.on_step(step) by hand
+
+or zero-code via environment variables (the config surface style of the
+reference, SURVEY.md §5 config/flags)::
+
+    TORCHFT_PROFILE_DIR=/tmp/trace TORCHFT_PROFILE_START=10 \
+        TORCHFT_PROFILE_STEPS=5 python train.py
+
+``span(name)`` is safe (and near-free) when no capture is active —
+TraceAnnotation without an active session is a no-op — so the Manager
+annotates unconditionally.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_ENV_DIR = "TORCHFT_PROFILE_DIR"
+_ENV_START = "TORCHFT_PROFILE_START"
+_ENV_STEPS = "TORCHFT_PROFILE_STEPS"
+
+
+def span(name: str):
+    """Named host-track span; shows up in an active jax profiler capture.
+
+    Usage: ``with span("torchft::quorum"): ...``
+    """
+    import jax.profiler
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_span(step: int):
+    """XProf step annotation: ``with step_span(step): train_step(...)``."""
+    import jax.profiler
+
+    return jax.profiler.StepTraceAnnotation("torchft_step", step_num=step)
+
+
+class Profiler:
+    """Windowed jax profiler capture keyed on the manager's step counter.
+
+    The capture starts when ``on_step(step)`` first sees
+    ``step >= start_step`` and stops ``num_steps`` steps later (or at
+    ``shutdown()``). Thread-safe; start/stop failures are logged, never
+    raised — profiling must not take down training.
+    """
+
+    def __init__(
+        self,
+        logdir: str,
+        start_step: int = 1,
+        num_steps: int = 5,
+    ) -> None:
+        self.logdir = logdir
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._lock = threading.Lock()
+        self._state = "idle"  # idle -> active -> done
+        self._stop_after: Optional[int] = None
+
+    @classmethod
+    def from_env(cls) -> Optional["Profiler"]:
+        """Build from TORCHFT_PROFILE_* env vars; None when unset."""
+        logdir = os.environ.get(_ENV_DIR)
+        if not logdir:
+            return None
+        return cls(
+            logdir,
+            start_step=int(os.environ.get(_ENV_START, "1")),
+            num_steps=int(os.environ.get(_ENV_STEPS, "5")),
+        )
+
+    def on_step(self, step: int) -> None:
+        """Advance the capture window; called once per training step."""
+        with self._lock:
+            if self._state == "idle" and step >= self.start_step:
+                self._start(step)
+            elif (
+                self._state == "active"
+                and self._stop_after is not None
+                and step >= self._stop_after
+            ):
+                self._stop()
+
+    def shutdown(self) -> None:
+        """Flush an in-flight capture (e.g. at trainer exit)."""
+        with self._lock:
+            if self._state == "active":
+                self._stop()
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    # -- internal (lock held) --
+
+    def _start(self, step: int) -> None:
+        import jax.profiler
+
+        try:
+            jax.profiler.start_trace(self.logdir)
+        except Exception as e:  # noqa: BLE001 - observability must not kill
+            logger.warning("profiler start failed: %s", e)
+            self._state = "done"
+            return
+        self._state = "active"
+        # Window from the step the capture ACTUALLY started at — a replica
+        # that resumes/heals past start_step still profiles num_steps.
+        self._stop_after = step + self.num_steps
+        logger.info(
+            "profiling %d steps to %s", self.num_steps, self.logdir
+        )
+
+    def _stop(self) -> None:
+        import jax.profiler
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("profiler stop failed: %s", e)
+        self._state = "done"
+        logger.info("profile written to %s", self.logdir)
