@@ -66,6 +66,13 @@ def _model_setup(size: str = None):
     if size == "big":
         # MXU-saturating: d_model >= 1024 matmuls, seq 2048, bf16-sized
         # payloads. ~110M params -> ~5.4 TFLOP/step at batch 8 x 2048.
+        # Config choice is MEASURED on v5e (8-step raw loop, this exact
+        # shape): dense+no-remat 7.61 steps/s > flash+no-remat 6.65 >
+        # dense+remat 6.27 > flash+remat 5.10. At S=2048/B=4 the fused
+        # XLA dense attention (bf16 probs) fits HBM and wins; the pallas
+        # flash kernel takes over at longer sequences (3.9x at S=8192,
+        # see ops/flash_attention.py) or bigger batches where the S^2
+        # scores no longer fit.
         cfg = TransformerConfig(
             vocab_size=8192,
             d_model=1024,
@@ -73,10 +80,6 @@ def _model_setup(size: str = None):
             n_layers=8,
             d_ff=4096,
             max_seq_len=2048,
-            remat=True,  # 2048-seq activations exceed HBM without it
-            # fused pallas attention: no S x S score tensor in HBM
-            # (1.4x over XLA dense attention at seq 2048 on v5e)
-            use_flash=on_tpu,
         )
         batch_size, seq_len = 4, 2048
     else:
@@ -320,9 +323,10 @@ def _bench_big(lighthouse) -> dict:
         "ratio_vs_raw": round(ft_sps / raw_sps, 3),
         "sync_every": sync_every,
         "window_capped": bool(sync_every >= 768),
-        "note": "MXU-saturating config (remat); window sized so the bf16 "
-        "sync stays a small fraction of compute, capped at 768 to bound "
-        "bench time",
+        "note": "MXU-saturating config (dense attention, no remat — the "
+        "measured-fastest combination at this shape); window sized so the "
+        "bf16 sync stays a small fraction of compute, capped at 768 to "
+        "bound bench time",
     }
 
 

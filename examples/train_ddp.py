@@ -73,13 +73,37 @@ def loss_fn(params, x, y):
     return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
 
+def build_model():
+    """MODEL=mlp (default, synthetic blobs) or MODEL=cnn (synthetic
+    CIFAR-shaped images through models.cnn — the reference demo's model
+    family, reference train_ddp.py:64-72)."""
+    if os.environ.get("MODEL", "mlp") == "cnn":
+        from torchft_tpu.models import cnn, tiny_cnn_config
+
+        cfg = tiny_cnn_config()
+        rng = np.random.default_rng(0)
+        n = 2048
+        x = rng.standard_normal(
+            (n, cfg.image_size, cfg.image_size, cfg.channels)
+        ).astype(np.float32)
+        y = rng.integers(0, cfg.classes, n).astype(np.int32)
+        params = cnn.init_params(cfg, jax.random.PRNGKey(0))
+
+        def loss(params, xb, yb):
+            return cnn.loss_fn(cfg, params, (xb, yb))
+
+        return params, loss, x, y
+    x, y = make_synthetic_dataset()
+    return init_params(), loss_fn, x, y
+
+
 def main() -> None:
     replica_group = int(os.environ.get("REPLICA_GROUP_ID", 0))
     num_replica_groups = int(os.environ.get("NUM_REPLICA_GROUPS", 2))
     num_steps = int(os.environ.get("NUM_STEPS", 200))
     batch_size = 64
 
-    x, y = make_synthetic_dataset()
+    params0, model_loss_fn, x, y = build_model()
     sampler = DistributedSampler(
         dataset_len=len(x),
         replica_group=replica_group,
@@ -92,7 +116,7 @@ def main() -> None:
     # step count (reference train_ddp.py:57-61,141-148 via StatefulDataLoader).
     loader = StatefulDataLoader(sampler, batch_size)
 
-    state = FTTrainState(init_params(), optax.adamw(1e-3))
+    state = FTTrainState(params0, optax.adamw(1e-3))
 
     # Checkpoints (recovery or durable) must pair step-N weights with the
     # loader position AS OF the last commit — not the live position, which
@@ -117,7 +141,7 @@ def main() -> None:
         replica_id=f"train_ddp_{replica_group}",
     )
     optimizer = OptimizerWrapper(manager, state)
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    grad_fn = jax.jit(jax.value_and_grad(model_loss_fn))
 
     while manager.current_step() < num_steps:
         step = manager.current_step()

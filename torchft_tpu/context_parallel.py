@@ -1,4 +1,5 @@
-"""Context parallelism: ring attention over a ``seq`` mesh axis.
+"""Context parallelism: ring attention AND Ulysses (all-to-all) over a
+``seq`` mesh axis.
 
 Long sequences don't fit one device's HBM because attention is O(S²) in
 compute and O(S·D) in activations per device. Ring attention (Liu et al.,
@@ -24,6 +25,19 @@ Usage inside a jitted step (the mesh's sequence axis must evenly divide S):
 
 where q/k/v are (B, S, H, head_dim) arrays (globally sharded or not — the
 embedded shard_map re-shards as needed).
+
+Two strategies, both keeping the ``seq`` axis inside the replica group:
+
+- :func:`ring_attention` — k/v blocks rotate over ICI (``ppermute``) while
+  an online-softmax accumulator folds them in; communication scales with
+  k/v size and overlaps the per-block matmuls. Best when S/devices is
+  large and heads are few.
+- :func:`ulysses_attention` (DeepSpeed-Ulysses, arXiv:2309.14509) — one
+  ``all_to_all`` re-shards sequence->heads, each device runs FULL-sequence
+  attention on H/s heads (through the fused pallas flash kernel), and a
+  second ``all_to_all`` re-shards back. Communication scales with
+  activation size only; best when heads are plentiful and the fused
+  kernel should do the attention work.
 """
 
 from __future__ import annotations
@@ -154,4 +168,103 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+    )(q, k, v)
+
+
+def _ulysses_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str,
+    causal: bool,
+    use_flash: bool,
+) -> jax.Array:
+    """Device-local body: all_to_all seq->heads, full-seq attention on my
+    head subset, all_to_all heads->seq."""
+    # (B, Sl, H, D) -> (B, Sl*s, H/s, D): split the head dim across the
+    # seq axis, gather the full sequence
+    def a2a(x, split, concat):
+        return jax.lax.all_to_all(
+            x, seq_axis, split_axis=split, concat_axis=concat, tiled=True
+        )
+
+    qg, kg, vg = (a2a(t, 2, 1) for t in (q, k, v))
+
+    if use_flash:
+        from .ops import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal)
+    else:
+        B, S, Hl, Dh = qg.shape
+        scale = Dh ** -0.5
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            qg.astype(jnp.float32),
+            kg.astype(jnp.float32),
+        ) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, vg.astype(jnp.float32)
+        ).astype(qg.dtype)
+    # (B, S, H/s, D) -> (B, Sl, H, D)
+    return a2a(out, 1, 2)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Any,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = "data",
+    head_axis: Optional[str] = None,
+    causal: bool = True,
+    use_flash: bool = True,
+) -> jax.Array:
+    """Sequence-sharded causal self-attention via head/sequence
+    all-to-alls (DeepSpeed-Ulysses).
+
+    Args:
+        q, k, v: (B, S, H, head_dim); S divisible by the ``seq_axis``
+            size, and the per-device head count (H, or H/tp when
+            ``head_axis`` also splits heads) divisible by it too.
+        use_flash: run the per-device full-sequence attention through the
+            fused pallas kernel (default) instead of dense jnp.
+    Returns:
+        (B, S, H, head_dim), same layout as q.
+    """
+    n_shards = mesh.shape[seq_axis]
+    if q.shape[1] % n_shards:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by "
+            f"{seq_axis}={n_shards}"
+        )
+    local_heads = q.shape[2] // (
+        mesh.shape[head_axis] if head_axis is not None else 1
+    )
+    if local_heads % n_shards:
+        raise ValueError(
+            f"per-device head count {local_heads} not divisible by "
+            f"{seq_axis}={n_shards} (Ulysses shards heads during attention)"
+        )
+    spec = P(batch_axis, seq_axis, head_axis, None)
+    local = functools.partial(
+        _ulysses_local,
+        seq_axis=seq_axis,
+        causal=causal,
+        use_flash=use_flash,
+    )
+    # check_vma=False: the embedded pallas call's out_shape carries no
+    # varying-mesh-axes annotation (same caveat as ops.flash_attention)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
     )(q, k, v)

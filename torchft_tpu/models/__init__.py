@@ -1,4 +1,5 @@
-from torchft_tpu.models import moe
+from torchft_tpu.models import cnn, moe
+from torchft_tpu.models.cnn import CNNConfig, tiny_cnn_config
 from torchft_tpu.models.moe import MoEConfig, tiny_moe_config
 from torchft_tpu.models.transformer import (
     TransformerConfig,
@@ -10,8 +11,11 @@ from torchft_tpu.models.transformer import (
 )
 
 __all__ = [
+    "CNNConfig",
     "MoEConfig",
     "TransformerConfig",
+    "cnn",
+    "tiny_cnn_config",
     "forward",
     "init_params",
     "loss_fn",

@@ -50,6 +50,7 @@ from .transformer import (
     mlp_specs,
     next_token_loss,
     readout,
+    remat_wrap,
 )
 
 
@@ -210,7 +211,12 @@ def moe_layer(
 def _block(
     cfg: MoEConfig, i: int, p: Dict[str, Any], x: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
-    x = x + _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
+    attn_out = _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
+    if cfg.remat and cfg.remat_policy == "save_attn":
+        from jax.ad_checkpoint import checkpoint_name
+
+        attn_out = checkpoint_name(attn_out, "attn_out")
+    x = x + attn_out
     h = _rmsnorm(x, p["ln2"]["scale"])
     if cfg.is_moe_block(i):
         y, aux = moe_layer(cfg, p["moe"], h)
@@ -224,10 +230,7 @@ def forward(
     """tokens (B, S) int32 -> (logits (B, S, vocab) f32, aux loss)."""
     x = embed_tokens(cfg, params, tokens)
     aux_total = jnp.float32(0.0)
-    block = (
-        jax.checkpoint(_block, static_argnums=(0, 1)) if cfg.remat
-        else _block
-    )
+    block = remat_wrap(cfg, _block, static_argnums=(0, 1))
     for i, p in enumerate(params["blocks"]):
         x, aux = block(cfg, i, p, x)
         aux_total = aux_total + aux

@@ -47,16 +47,42 @@ class TransformerConfig:
     cp_mesh: Any = None
     cp_batch_axis: Any = "data"
     cp_head_axis: Any = None
-    # Fused pallas flash attention (torchft_tpu.ops.flash_attention) for the
-    # dense path: no S x S score matrix in HBM. When cp_mesh is set (and
-    # cp_seq_axis is not — that selects ring attention), the kernel runs
-    # per-shard under shard_map with batch over cp_batch_axis and heads
-    # over cp_head_axis.
+    # "ring" (k/v ppermute + online softmax) or "ulysses" (head/seq
+    # all-to-alls around full-sequence attention — which then runs through
+    # the fused pallas kernel when use_flash is set)
+    cp_strategy: str = "ring"
+    # Fused pallas flash attention (torchft_tpu.ops.flash_attention): no
+    # S x S score matrix in HBM. Consumed by (a) the non-CP path — when
+    # cp_mesh is set the kernel runs per-shard under shard_map with batch
+    # over cp_batch_axis and heads over cp_head_axis — and (b) the
+    # cp_strategy="ulysses" path, where each device's full-sequence
+    # attention runs through the kernel. Ignored by cp_strategy="ring"
+    # (that path fuses its own online-softmax loop).
     use_flash: bool = False
     # Rematerialize each block's activations in backward (jax.checkpoint):
     # trades ~1/3 extra FLOPs for O(n_layers) less HBM — the standard TPU
     # recipe for long-sequence / large-batch configs.
     remat: bool = False
+    # With remat on, "save_attn" keeps each block's attention output AND
+    # the flash kernel's (out, lse) residuals (cheap: O(B*S*D) per layer)
+    # so the backward replay prunes the forward flash launch — the
+    # standard pairing for the flash kernel under remat. On the dense
+    # path it only saves the post-projection output (the softmax
+    # internals are still recomputed: its vjp needs them either way).
+    # None = full recompute.
+    remat_policy: Any = None
+
+    def __post_init__(self):
+        if self.cp_strategy not in ("ring", "ulysses"):
+            raise ValueError(
+                f"cp_strategy must be 'ring' or 'ulysses', got "
+                f"{self.cp_strategy!r}"
+            )
+        if self.remat_policy not in (None, "save_attn"):
+            raise ValueError(
+                f"remat_policy must be None or 'save_attn', got "
+                f"{self.remat_policy!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -216,16 +242,27 @@ def _attention(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.A
 
     if cfg.cp_seq_axis is not None:
         # Context parallel: sequence sharded over the slice mesh's seq
-        # axis, k/v ring over ICI, no S x S materialization.
-        from ..context_parallel import ring_attention
+        # axis, no S x S materialization. Strategy: k/v ring (ppermute) or
+        # Ulysses all-to-alls (full-seq attention per head subset).
+        from ..context_parallel import ring_attention, ulysses_attention
 
-        out = ring_attention(
-            q, k, v,
-            mesh=cfg.cp_mesh,
-            seq_axis=cfg.cp_seq_axis,
-            batch_axis=cfg.cp_batch_axis,
-            head_axis=cfg.cp_head_axis,
-        ).reshape(B, S, D)
+        if cfg.cp_strategy == "ulysses":
+            out = ulysses_attention(
+                q, k, v,
+                mesh=cfg.cp_mesh,
+                seq_axis=cfg.cp_seq_axis,
+                batch_axis=cfg.cp_batch_axis,
+                head_axis=cfg.cp_head_axis,
+                use_flash=cfg.use_flash,
+            ).reshape(B, S, D)
+        else:
+            out = ring_attention(
+                q, k, v,
+                mesh=cfg.cp_mesh,
+                seq_axis=cfg.cp_seq_axis,
+                batch_axis=cfg.cp_batch_axis,
+                head_axis=cfg.cp_head_axis,
+            ).reshape(B, S, D)
         return out @ p["wo"].astype(cfg.dtype)
 
     if cfg.use_flash:
@@ -248,16 +285,33 @@ def _attention(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.A
 
 
 def _block(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
-    x = x + _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
+    attn_out = _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
+    if cfg.remat and cfg.remat_policy == "save_attn":
+        from jax.ad_checkpoint import checkpoint_name
+
+        attn_out = checkpoint_name(attn_out, "attn_out")
+    x = x + attn_out
     return x + mlp_apply(cfg, p["mlp"], _rmsnorm(x, p["ln2"]["scale"]))
+
+
+def remat_wrap(cfg: TransformerConfig, fn, static_argnums=(0,)):
+    """Apply cfg's remat settings to a block fn; shared by the dense and
+    MoE families so remat_policy means the same thing in both."""
+    if not cfg.remat:
+        return fn
+    if cfg.remat_policy == "save_attn":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "flash_out", "flash_lse"
+        )
+        return jax.checkpoint(fn, static_argnums=static_argnums,
+                              policy=policy)
+    return jax.checkpoint(fn, static_argnums=static_argnums)
 
 
 def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
     """tokens (B, S) int32 -> logits (B, S, vocab) f32."""
     x = embed_tokens(cfg, params, tokens)
-    block = _block
-    if cfg.remat:
-        block = jax.checkpoint(_block, static_argnums=(0,))
+    block = remat_wrap(cfg, _block)
     for p in params["blocks"]:
         x = block(cfg, p, x)
     return readout(cfg, params, x)

@@ -340,6 +340,15 @@ def _flash_fwd_res(cfg, q, k, v):
         block_q=block_q, block_k=block_k, interpret=interpret,
         kv_len=kv_len,
     )
+    # Name the kernel outputs so a jax.checkpoint policy can SAVE them:
+    # the vjp needs (out, lse) as residuals, and with both saved the remat
+    # backward's forward replay prunes the fwd pallas launch entirely
+    # (q/k/v are re-derived from the cheap qkv projection instead).
+    # checkpoint_name is the identity outside a policy-remat context.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 
