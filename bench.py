@@ -100,6 +100,16 @@ def _model_setup(size: str = None):
     return cfg, batch, on_tpu
 
 
+def _mark(msg: str) -> None:
+    """Timestamped phase marker on stderr: which phase a wedged/slow run
+    died in is the first thing a post-mortem needs."""
+    print(
+        f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+        file=sys.stderr,
+        flush=True,
+    )
+
+
 def _barrier(tree) -> None:
     # Readback barrier: on the tunneled TPU, block_until_ready returns
     # before remote execution drains, so force a tiny device read.
@@ -287,17 +297,25 @@ def _bench_big(lighthouse) -> dict:
         manager._load_state_dict = diloco.load_state_dict
         manager._user_state_dict = diloco.state_dict
 
-        for i in range(sync_every):  # warm window (compile + 1st sync launch)
+        # Short warmup: compile the inner step, then force ONE early
+        # boundary sync (the peer's first of two rounds) instead of
+        # crawling a full window to the boundary (see main()'s note).
+        # Must stay BELOW sync_every (floor-clamped to 64): hitting the
+        # auto-sync in the warm loop would spend a peer round and
+        # desynchronize the 2-round accounting.
+        for i in range(min(65, sync_every - 1)):
             loss, grads = grad_fn(state.params, batch)
             diloco.step(grads)
             if i % 64 == 63:
                 np.asarray(loss)  # real drain (see _barrier note)
+        diloco.sync()
+        diloco.flush()
         _barrier(state.params)
         t0 = time.perf_counter()
         for i in range(sync_every * windows):
             loss, grads = grad_fn(state.params, batch)
             diloco.step(grads)
-            if i % 64 == 63:
+            if i % 128 == 127:
                 np.asarray(loss)  # real drain (see _barrier note)
         diloco.flush()
         _barrier(state.params)
@@ -384,6 +402,7 @@ def main() -> None:
     detail = {"host": {"cpus": os.cpu_count(), "platform": jax.devices()[0].platform}}
 
     # -- raw loop --
+    _mark("phase: raw (compile + timed loop)")
     params = init_params(cfg, jax.random.PRNGKey(0))
     opt_state = tx.init(params)
     for _ in range(warmup):
@@ -398,6 +417,7 @@ def main() -> None:
     raw_sps = steps / (time.perf_counter() - t0)
     detail["raw"] = {"steps_per_sec": round(raw_sps, 3)}
     del params, opt_state
+    _mark(f"phase: transfer probe (raw={raw_sps:.1f} steps/s)")
 
     # Device<->host bandwidth of the gradient-sized payload: the number that
     # decides whether per-step DDP or windowed DiLoCo fits this host.
@@ -432,6 +452,7 @@ def main() -> None:
     grad_mb = n_params * 4 / 1e6
     d2h_MBps = detail["transfer"]["d2h_MBps"]
     h2d_MBps = detail["transfer"]["h2d_MBps"]
+    _mark(f"phase: ft_ddp (d2h={d2h_MBps:.1f} MB/s)")
     if not on_tpu or d2h_MBps >= 100:
         ddp_warmup, ddp_steps = 1, 4 if on_tpu else 6
         peer_proc = _spawn_peer(
@@ -493,6 +514,7 @@ def main() -> None:
     #  - on degraded links (tunneled device runtime) the sync runs
     #    serially at the boundary: an in-flight transfer starves under the
     #    async dispatch flood there, so overlap is strictly worse.
+    _mark("phase: ft_diloco")
     overlap = d2h_MBps >= 100
     sync_mb = n_params * 2 / 1e6  # bf16-compressed pseudogradient
     sync_est_s = (
@@ -531,28 +553,36 @@ def main() -> None:
     manager._load_state_dict = diloco.load_state_dict
     manager._user_state_dict = diloco.state_dict
 
-    # Warmup: one full window (compiles the step AND both sync-side jits —
-    # in serial mode the warm boundary runs launch+finish end to end).
-    # The periodic block bounds the in-flight dispatch queue: on the
+    # Warmup: compile the inner step, then force ONE early boundary sync
+    # (compiles the quorum + both sync-side jits; in serial mode it runs
+    # launch+finish end to end) — the measurement semantics don't need a
+    # full sync_every-step crawl to the first boundary, and skipping it
+    # cuts several minutes of warmup at sync_every in the thousands.
+    # The periodic drain bounds the in-flight dispatch queue: on the
     # tunneled device runtime an unbounded multi-thousand-op queue can
     # wedge the session (observed reproducibly at 6k+ queued steps).
-    for i in range(sync_every):
+    _mark("diloco: warm inner steps")
+    for i in range(65):
         loss, grads = grad_fn(state.params, batch)
         diloco.step(grads)
         if i % 64 == 63:
             np.asarray(loss)  # real drain: block_until_ready returns
             # before remote execution finishes on this tunnel (_barrier)
+    _mark("diloco: warm sync")
+    diloco.sync()  # early warm sync = the peer's first of two rounds
+    _mark("diloco: warm sync launched")
     if overlap:
-        diloco.flush()  # pull the warm window's sync out of the timed region
+        diloco.flush()  # pull the warm sync out of the timed region
     _barrier(state.params)
+    _mark(f"diloco: timed window (sync_every={sync_every})")
     t0 = time.perf_counter()
     for i in range(total_steps):
         loss, grads = grad_fn(state.params, batch)
         diloco.step(grads)
-        if i % 64 == 63:
-            np.asarray(loss)  # real drain: block_until_ready returns
-            # before remote execution finishes on this tunnel (_barrier)
+        if i % 128 == 127:
+            np.asarray(loss)  # real drain (bounded queue, fewer RTTs)
     diloco.flush()
+    _mark("diloco: timed window done")
     _barrier(state.params)
     ft_sps = total_steps / (time.perf_counter() - t0)
     detail["ft_diloco"] = {
@@ -569,8 +599,13 @@ def main() -> None:
     collectives.shutdown()
 
     # Headline line + detail land BEFORE the (long) big-model phase so a
-    # timeout there can never lose the round's primary metric.
-    with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
+    # timeout there can never lose the round's primary metric. CPU smoke
+    # runs write a separate file so they can never clobber the committed
+    # TPU artifact.
+    detail_name = (
+        "BENCH_DETAIL.json" if on_tpu else "BENCH_DETAIL_cpu.json"
+    )
+    with open(os.path.join(REPO, detail_name), "w") as f:
         json.dump(detail, f, indent=2)
     print(
         json.dumps(
@@ -589,7 +624,7 @@ def main() -> None:
             detail["big"] = _bench_big(lighthouse)
         except Exception as e:  # noqa: BLE001 - best effort, keep headline
             detail["big"] = {"error": f"{type(e).__name__}: {e}"}
-        with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
+        with open(os.path.join(REPO, detail_name), "w") as f:
             json.dump(detail, f, indent=2)
     lighthouse.shutdown()
 

@@ -1,0 +1,247 @@
+"""Shared harness: an intra-group-sharded model family composed with the
+cross-group fault-tolerance layer, under kills.
+
+Each replica group is a thread owning a disjoint 4-device slice of the
+virtual CPU platform, running its family's jitted sharded train step;
+gradients average across groups through a REAL 2-member host TCP ring;
+failures are injected and healed; the oracle is bit-identical state
+across groups (reference manager_integ_test.py:279-282, fsdp_test.py:38-74).
+
+Families plug in via a ``setup(gid) -> GroupSetup``; see test_hsdp_integ
+(dp x tp), test_pp_integ (dp x pipe), test_ep_integ (dp x expert).
+"""
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from datetime import timedelta
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+import optax
+
+from torchft_tpu import (
+    FTTrainState,
+    HostCollectives,
+    Lighthouse,
+    Manager,
+    OptimizerWrapper,
+)
+from torchft_tpu.parallel import shard_pytree
+
+from test_manager_integ import FailureInjector, InjectedFailure
+
+logger = logging.getLogger(__name__)
+
+DEVICES_PER_GROUP = 4
+
+
+@dataclass
+class GroupSetup:
+    devices: Any
+    mesh: Any
+    rules: Any                      # PartitionSpec pytree matching params
+    grad_step: Callable             # (params, batch) -> (loss, grads)
+    fresh_params: Callable[[], Any]
+    batch_fn: Callable[[int], Any]  # step -> batch
+    # leaves that must still live on the group's devices at the end
+    check_subtree: Optional[str] = None
+
+
+class ReshardingFTTrainState(FTTrainState):
+    """Heal path re-shards healed leaves (host numpy off the ring) onto
+    the group's mesh so the jitted step's in_shardings contract holds."""
+
+    def __init__(self, params, tx, mesh, rules) -> None:
+        super().__init__(shard_pytree(params, rules, mesh), tx)
+        self._mesh = mesh
+        self._rules = rules
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self.params = shard_pytree(
+            state_dict["params"], self._rules, self._mesh
+        )
+        self.opt_state = self.tx.init(self.params)
+
+
+class ShardedGroupRunner:
+    """One replica group; restarts on injected failure, healing through
+    the ring. One compiled step per (family, gid), shared across restarts
+    (re-jitting from scratch on a loaded 1-CPU host can starve the
+    survivor's gate; real deployments have XLA's persistent cache)."""
+
+    _setup_cache: Dict[Any, GroupSetup] = {}
+
+    def __init__(
+        self,
+        family: str,
+        setup_fn: Callable[[int], GroupSetup],
+        replica_id: int,
+        lighthouse_address: str,
+        injector: FailureInjector,
+        num_steps: int,
+        attempts: int = 3,
+        gate_step: Optional[int] = None,
+        gate_event: Optional[threading.Event] = None,
+        announce_restart: Optional[threading.Event] = None,
+    ) -> None:
+        self.family = family
+        self.setup_fn = setup_fn
+        self.replica_id = replica_id
+        self.lighthouse_address = lighthouse_address
+        self.injector = injector
+        self.num_steps = num_steps
+        self.attempts = attempts
+        # Deterministic-overlap gate (same as test_manager_integ.Runner):
+        # the survivor holds at gate_step until the victim's restart is
+        # live, so the heal really overlaps.
+        self.gate_step = gate_step
+        self.gate_event = gate_event
+        self.announce_restart = announce_restart
+
+    def run(self) -> Dict[str, Any]:
+        for attempt in range(self.attempts):
+            try:
+                return self._main(attempt)
+            except InjectedFailure:
+                logger.info(f"group {self.replica_id} died; restarting")
+                continue
+        raise RuntimeError(f"group {self.replica_id} exhausted attempts")
+
+    def _main(self, attempt: int) -> Dict[str, Any]:
+        gid = self.replica_id
+        key = (self.family, gid)
+        su = self._setup_cache.get(key)
+        if su is None:
+            su = self._setup_cache[key] = self.setup_fn(gid)
+
+        state = ReshardingFTTrainState(
+            su.fresh_params(), optax.sgd(0.05), su.mesh, su.rules
+        )
+        # Pre-warm the compile BEFORE joining the control plane: a long
+        # jit inside the quorum window would time out the peer's long-poll.
+        jax.block_until_ready(su.grad_step(state.params, su.batch_fn(0)))
+
+        collectives = HostCollectives(timeout=timedelta(seconds=60))
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=state.load_state_dict,
+            state_dict=state.state_dict,
+            min_replica_size=1,
+            timeout=timedelta(seconds=60),
+            quorum_timeout=timedelta(seconds=60),
+            connect_timeout=timedelta(seconds=60),
+            lighthouse_addr=self.lighthouse_address,
+            replica_id=f"{self.family}_{gid}",
+        )
+        optimizer = OptimizerWrapper(manager, state)
+        if attempt > 0 and self.announce_restart is not None:
+            self.announce_restart.set()
+        try:
+            while manager.current_step() < self.num_steps:
+                if (
+                    self.gate_event is not None
+                    and manager.current_step() == self.gate_step
+                ):
+                    assert self.gate_event.wait(timeout=300)
+                self.injector.check(0, manager.current_step())
+                optimizer.zero_grad()  # async quorum
+                loss, grads = su.grad_step(
+                    state.params, su.batch_fn(manager.current_step())
+                )
+                # Cross-group (DCN) average through the real ring; the
+                # ring returns unsharded leaves — re-place on the mesh.
+                avg = manager.allreduce(grads).wait()
+                avg = shard_pytree(avg, su.rules, su.mesh)
+                optimizer.step(avg)
+            leaves_tree = (
+                state.params[su.check_subtree]
+                if su.check_subtree is not None
+                else state.params
+            )
+            for leaf in jax.tree_util.tree_leaves(leaves_tree):
+                assert set(leaf.sharding.device_set) <= set(su.devices)
+            return {
+                "replica_id": gid,
+                "state_dict": jax.tree_util.tree_map(
+                    np.asarray, state.state_dict()
+                ),
+                "manager_state": manager.state_dict(),
+                "metrics": manager.metrics().snapshot(),
+            }
+        finally:
+            manager.shutdown()
+            collectives.shutdown()
+
+
+def run_sharded_groups(
+    family: str,
+    setup_fn: Callable[[int], GroupSetup],
+    num_steps: int,
+    injectors: Optional[List[FailureInjector]] = None,
+    gates: Optional[Dict[int, Dict[str, Any]]] = None,
+) -> List[Dict[str, Any]]:
+    assert len(jax.devices()) >= 2 * DEVICES_PER_GROUP
+    lighthouse = Lighthouse(
+        bind="[::]:0",
+        min_replicas=1,
+        join_timeout_ms=200,
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=2500,
+    )
+    injectors = injectors or [FailureInjector() for _ in range(2)]
+    try:
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futures = [
+                ex.submit(
+                    ShardedGroupRunner(
+                        family=family,
+                        setup_fn=setup_fn,
+                        replica_id=i,
+                        lighthouse_address=lighthouse.address(),
+                        injector=injectors[i],
+                        num_steps=num_steps,
+                        **(gates or {}).get(i, {}),
+                    ).run
+                )
+                for i in range(2)
+            ]
+            return [f.result(timeout=240) for f in futures]
+    finally:
+        lighthouse.shutdown()
+
+
+def assert_bitwise_identical(results: List[Dict[str, Any]]) -> None:
+    a, ta = jax.tree_util.tree_flatten(results[0]["state_dict"]["params"])
+    b, tb = jax.tree_util.tree_flatten(results[1]["state_dict"]["params"])
+    assert ta == tb
+    for x, y in zip(a, b):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes(), (
+            "sharded states diverged across replica groups"
+        )
+
+
+def run_kill_and_heal(family: str, setup_fn) -> List[Dict[str, Any]]:
+    """Standard scenario: group 1 dies at step 2, group 0 gates at step 4
+    until the restart is live; 6 steps total; asserts heal + identity."""
+    injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
+    rejoined = threading.Event()
+    results = run_sharded_groups(
+        family,
+        setup_fn,
+        num_steps=6,
+        injectors=injectors,
+        gates={
+            0: {"gate_step": 4, "gate_event": rejoined},
+            1: {"announce_restart": rejoined},
+        },
+    )
+    assert injectors[1].count == 1
+    for r in results:
+        assert r["manager_state"]["step"] == 6
+    healed = next(r for r in results if r["replica_id"] == 1)
+    assert healed["metrics"]["counters"]["heals"] >= 1
+    assert_bitwise_identical(results)
+    return results

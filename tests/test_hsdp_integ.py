@@ -12,261 +12,68 @@ manager_integ_test.py:279-282).
 
 Runs on the virtual 8-device CPU platform from conftest.py: group g owns
 devices [4g, 4g+4), so both sharded steps execute concurrently in one
-process exactly as two slices would.
+process exactly as two slices would. Harness shared with the pp/ep
+variants: sharded_integ.py.
 """
-
-import logging
-import threading
-from concurrent.futures import ThreadPoolExecutor
-from datetime import timedelta
-from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
-import pytest
 
-from torchft_tpu import (
-    FTTrainState,
-    HostCollectives,
-    Lighthouse,
-    Manager,
-    OptimizerWrapper,
-)
 from torchft_tpu.models import (
     init_params,
     loss_fn,
     param_sharding_rules,
     tiny_config,
 )
-from torchft_tpu.parallel import build_grad_step, make_mesh, shard_pytree
+from torchft_tpu.parallel import build_grad_step, make_mesh
 
-from test_manager_integ import FailureInjector, InjectedFailure
-
-logger = logging.getLogger(__name__)
-
-DEVICES_PER_GROUP = 4
-
-
-class ShardedFTTrainState(FTTrainState):
-    """FTTrainState whose heal path re-shards onto the group's mesh.
-
-    Checkpoint leaves arrive as host numpy; the base class rebuilds them on
-    the default device, which would leave a healed replica's params off its
-    mesh. Re-placing through the sharding rules keeps the jitted step's
-    in_shardings contract intact. Uses a stateless optimizer (plain SGD) so
-    opt_state needs no sharding rules of its own.
-    """
-
-    def __init__(self, params: Any, tx: Any, mesh: Any, rules: Any) -> None:
-        super().__init__(shard_pytree(params, rules, mesh), tx)
-        self._mesh = mesh
-        self._rules = rules
-
-    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
-        self.params = shard_pytree(state_dict["params"], self._rules, self._mesh)
-        self.opt_state = self.tx.init(self.params)
+from sharded_integ import (
+    DEVICES_PER_GROUP,
+    GroupSetup,
+    assert_bitwise_identical,
+    run_kill_and_heal,
+    run_sharded_groups,
+)
 
 
-def _batch(cfg, step: int, mesh) -> jax.Array:
-    """Deterministic per-step token batch, identical across groups, sharded
-    over the group's data axis."""
+def _setup(gid: int) -> GroupSetup:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    rng = np.random.default_rng(7000 + step)
-    tokens = rng.integers(0, cfg.vocab_size, size=(4, 32), dtype=np.int32)
-    return jax.device_put(jnp.asarray(tokens), NamedSharding(mesh, P("data")))
+    devices = jax.devices()[
+        gid * DEVICES_PER_GROUP : (gid + 1) * DEVICES_PER_GROUP
+    ]
+    mesh = make_mesh({"data": 2, "model": 2}, devices=devices)
+    cfg = tiny_config()
+    rules = param_sharding_rules(cfg)
 
-
-class ShardedRunner:
-    """One replica group: a thread owning 4 devices, running the sharded
-    step, healing through the real ring on restart."""
-
-    def __init__(
-        self,
-        replica_id: int,
-        lighthouse_address: str,
-        injector: FailureInjector,
-        num_steps: int,
-        attempts: int = 3,
-        gate_step: Optional[int] = None,
-        gate_event: Optional[threading.Event] = None,
-        announce_restart: Optional[threading.Event] = None,
-    ) -> None:
-        self.replica_id = replica_id
-        self.lighthouse_address = lighthouse_address
-        self.injector = injector
-        self.num_steps = num_steps
-        self.attempts = attempts
-        # Same deterministic-overlap gate as test_manager_integ.Runner:
-        # the survivor holds at gate_step until the victim's restart is
-        # live, so the heal really overlaps (and the survivor's manager is
-        # still up to serve the checkpoint).
-        self.gate_step = gate_step
-        self.gate_event = gate_event
-        self.announce_restart = announce_restart
-
-    def run(self) -> Dict[str, Any]:
-        for attempt in range(self.attempts):
-            try:
-                return self._main(attempt)
-            except InjectedFailure:
-                logger.info(f"group {self.replica_id} died; restarting")
-                continue
-        raise RuntimeError(f"group {self.replica_id} exhausted attempts")
-
-    # One compiled sharded step per group, shared across restart attempts:
-    # a restart re-jitting from scratch on this 1-CPU host can take >100 s
-    # under suite load, starving the survivor's gate (a real deployment
-    # has XLA's persistent compilation cache for the same reason).
-    _setup_cache: Dict[int, Any] = {}
-
-    def _group_setup(self, gid: int):
-        cached = self._setup_cache.get(gid)
-        if cached is None:
-            devices = jax.devices()[
-                gid * DEVICES_PER_GROUP : (gid + 1) * DEVICES_PER_GROUP
-            ]
-            mesh = make_mesh({"data": 2, "model": 2}, devices=devices)
-            cfg = tiny_config()
-            rules = param_sharding_rules(cfg)
-            grad_step = build_grad_step(
-                lambda p, b: loss_fn(cfg, p, b), mesh, rules
-            )
-            cached = self._setup_cache[gid] = (
-                devices, mesh, cfg, rules, grad_step
-            )
-        return cached
-
-    def _main(self, attempt: int) -> Dict[str, Any]:
-        gid = self.replica_id
-        devices, mesh, cfg, rules, grad_step = self._group_setup(gid)
-        state = ShardedFTTrainState(
-            init_params(cfg, jax.random.PRNGKey(42)), optax.sgd(0.05), mesh, rules
+    def batch_fn(step: int):
+        # Deterministic per-step batch, identical across groups, sharded
+        # over the group's data axis.
+        rng = np.random.default_rng(7000 + step)
+        tokens = rng.integers(0, cfg.vocab_size, size=(4, 32), dtype=np.int32)
+        return jax.device_put(
+            jnp.asarray(tokens), NamedSharding(mesh, P("data"))
         )
-        # Pre-warm the sharded compile BEFORE joining the control plane: a
-        # long jit under CPU load inside the quorum window would time out
-        # the peer's long-poll.
-        jax.block_until_ready(grad_step(state.params, _batch(cfg, 0, mesh)))
 
-        collectives = HostCollectives(timeout=timedelta(seconds=60))
-        manager = Manager(
-            collectives=collectives,
-            load_state_dict=state.load_state_dict,
-            state_dict=state.state_dict,
-            min_replica_size=1,
-            timeout=timedelta(seconds=60),
-            quorum_timeout=timedelta(seconds=60),
-            connect_timeout=timedelta(seconds=60),
-            lighthouse_addr=self.lighthouse_address,
-            replica_id=f"hsdp_{gid}",
-        )
-        optimizer = OptimizerWrapper(manager, state)
-        if attempt > 0 and self.announce_restart is not None:
-            self.announce_restart.set()
-        try:
-            while manager.current_step() < self.num_steps:
-                if (
-                    self.gate_event is not None
-                    and manager.current_step() == self.gate_step
-                ):
-                    assert self.gate_event.wait(timeout=300)
-                self.injector.check(0, manager.current_step())
-                optimizer.zero_grad()  # async quorum
-                batch = _batch(cfg, manager.current_step(), mesh)
-                loss, grads = grad_step(state.params, batch)
-                # Cross-group (DCN) average through the real ring; the ring
-                # returns unsharded leaves — re-place on the group mesh so
-                # the donated apply keeps its sharded layout.
-                avg = manager.allreduce(grads).wait()
-                avg = shard_pytree(avg, rules, mesh)
-                optimizer.step(avg)
-            # Every param leaf must still live on this group's mesh with
-            # its declared sharding (the composition claim).
-            for leaf in jax.tree_util.tree_leaves(state.params):
-                assert set(leaf.sharding.device_set) <= set(devices)
-            return {
-                "replica_id": gid,
-                "state_dict": jax.tree_util.tree_map(
-                    np.asarray, state.state_dict()
-                ),
-                "manager_state": manager.state_dict(),
-                "metrics": manager.metrics().snapshot(),
-            }
-        finally:
-            manager.shutdown()
-            collectives.shutdown()
-
-
-def _run_groups(
-    num_steps: int,
-    injectors: Optional[List[FailureInjector]] = None,
-    gates: Optional[Dict[int, Dict[str, Any]]] = None,
-) -> List[Dict[str, Any]]:
-    assert len(jax.devices()) >= 2 * DEVICES_PER_GROUP
-    lighthouse = Lighthouse(
-        bind="[::]:0",
-        min_replicas=1,
-        join_timeout_ms=200,
-        quorum_tick_ms=50,
-        heartbeat_timeout_ms=2500,
+    return GroupSetup(
+        devices=devices,
+        mesh=mesh,
+        rules=rules,
+        grad_step=build_grad_step(
+            lambda p, b: loss_fn(cfg, p, b), mesh, rules
+        ),
+        fresh_params=lambda: init_params(cfg, jax.random.PRNGKey(42)),
+        batch_fn=batch_fn,
     )
-    injectors = injectors or [FailureInjector() for _ in range(2)]
-    try:
-        with ThreadPoolExecutor(max_workers=2) as ex:
-            futures = [
-                ex.submit(
-                    ShardedRunner(
-                        replica_id=i,
-                        lighthouse_address=lighthouse.address(),
-                        injector=injectors[i],
-                        num_steps=num_steps,
-                        **(gates or {}).get(i, {}),
-                    ).run
-                )
-                for i in range(2)
-            ]
-            return [f.result(timeout=240) for f in futures]
-    finally:
-        lighthouse.shutdown()
-
-
-def _assert_bitwise_identical(results: List[Dict[str, Any]]) -> None:
-    a, ta = jax.tree_util.tree_flatten(results[0]["state_dict"]["params"])
-    b, tb = jax.tree_util.tree_flatten(results[1]["state_dict"]["params"])
-    assert ta == tb
-    for x, y in zip(a, b):
-        assert np.asarray(x).tobytes() == np.asarray(y).tobytes(), (
-            "sharded states diverged across replica groups"
-        )
 
 
 class TestHSDPUnderFaults:
     def test_sharded_groups_stay_identical(self):
-        results = _run_groups(num_steps=4)
+        results = run_sharded_groups("hsdp", _setup, num_steps=4)
         for r in results:
             assert r["manager_state"]["step"] == 4
-        _assert_bitwise_identical(results)
+        assert_bitwise_identical(results)
 
     def test_sharded_group_kill_and_heal(self):
-        injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
-        # Group 0 holds at step 4 until group 1's restart is live, so the
-        # heal deterministically overlaps (group 1 really fetches group
-        # 0's sharded state through the ring-side transport rather than
-        # re-deriving it solo).
-        rejoined = threading.Event()
-        results = _run_groups(
-            num_steps=6,
-            injectors=injectors,
-            gates={
-                0: {"gate_step": 4, "gate_event": rejoined},
-                1: {"announce_restart": rejoined},
-            },
-        )
-        assert injectors[1].count == 1
-        for r in results:
-            assert r["manager_state"]["step"] == 6
-        healed = next(r for r in results if r["replica_id"] == 1)
-        assert healed["metrics"]["counters"]["heals"] >= 1
-        _assert_bitwise_identical(results)
+        run_kill_and_heal("hsdp", _setup)

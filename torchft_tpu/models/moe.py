@@ -211,12 +211,7 @@ def moe_layer(
 def _block(
     cfg: MoEConfig, i: int, p: Dict[str, Any], x: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
-    attn_out = _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
-    if cfg.remat and cfg.remat_policy == "save_attn":
-        from jax.ad_checkpoint import checkpoint_name
-
-        attn_out = checkpoint_name(attn_out, "attn_out")
-    x = x + attn_out
+    x = x + _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
     h = _rmsnorm(x, p["ln2"]["scale"])
     if cfg.is_moe_block(i):
         y, aux = moe_layer(cfg, p["moe"], h)

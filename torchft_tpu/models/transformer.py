@@ -233,6 +233,15 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
 
 
 def _attention(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
+    """Returns the attention sublayer output, checkpoint-named "attn_out"
+    (identity outside a policy-remat context) so remat_policy="save_attn"
+    works for every family that calls this — no per-family re-tagging."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(_attention_impl(cfg, p, x), "attn_out")
+
+
+def _attention_impl(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
     B, S, D = x.shape
     qkv = x @ p["wqkv"].astype(cfg.dtype)  # (B, S, 3D)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -285,12 +294,7 @@ def _attention(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.A
 
 
 def _block(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> jax.Array:
-    attn_out = _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
-    if cfg.remat and cfg.remat_policy == "save_attn":
-        from jax.ad_checkpoint import checkpoint_name
-
-        attn_out = checkpoint_name(attn_out, "attn_out")
-    x = x + attn_out
+    x = x + _attention(cfg, p["attn"], _rmsnorm(x, p["ln1"]["scale"]))
     return x + mlp_apply(cfg, p["mlp"], _rmsnorm(x, p["ln2"]["scale"]))
 
 
