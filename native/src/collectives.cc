@@ -28,6 +28,8 @@ size_t dtype_size(Dtype d) {
 namespace {
 
 constexpr uint32_t kHelloMagic = 0x74667463; // "tftc"
+// "tftp": per-op header magic (part of the wire protocol).
+constexpr uint32_t kOpMagic = 0x74667470;
 
 template <typename T>
 void reduce_typed(T* dst, const T* src, size_t n, ReduceOp op) {
@@ -256,6 +258,36 @@ void HostCollectives::duplex(const char* send_buf, size_t send_len,
   }
 }
 
+void HostCollectives::check_op_header(uint32_t kind, uint64_t count,
+                                      uint32_t dtype, uint32_t op,
+                                      int64_t deadline_ms) {
+  // One tiny duplex exchange describing the op each neighbor is about to
+  // run. A mismatched op (different tree sizes, dtypes, or op kinds on
+  // different members) otherwise DEADLOCKS silently: the small member
+  // finishes, stops reading, and the large member blocks forever once
+  // kernel buffers fill. ~20 bytes per collective — noise next to any
+  // payload — converts that into an immediate, descriptive error.
+  struct Header {
+    uint32_t magic, kind;
+    uint64_t count;
+    uint32_t dtype, op;
+  } mine{kOpMagic, kind, count, dtype, op}, theirs{};
+  duplex(reinterpret_cast<const char*>(&mine), sizeof(mine),
+         reinterpret_cast<char*>(&theirs), sizeof(theirs), deadline_ms);
+  if (theirs.magic != kOpMagic)
+    throw SocketError("ring op header corrupt (protocol desync)");
+  if (theirs.kind != mine.kind || theirs.count != mine.count ||
+      theirs.dtype != mine.dtype || theirs.op != mine.op)
+    throw SocketError(
+        "ring op mismatch: this rank kind=" + std::to_string(kind) +
+        " count=" + std::to_string(count) + " dtype=" +
+        std::to_string(dtype) + " op=" + std::to_string(op) +
+        ", prev rank kind=" + std::to_string(theirs.kind) + " count=" +
+        std::to_string(theirs.count) + " dtype=" +
+        std::to_string(theirs.dtype) + " op=" + std::to_string(theirs.op) +
+        " (members must reduce identical trees)");
+}
+
 void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
                                 ReduceOp op, int64_t timeout_ms) {
   std::lock_guard<std::mutex> lock(op_mu_);
@@ -263,6 +295,8 @@ void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
   if (world_size_ == 1 || count == 0) return;
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    check_op_header(0, count, static_cast<uint32_t>(dtype),
+                    static_cast<uint32_t>(op), deadline);
     char* bytes = static_cast<char*>(data);
     size_t esize = dtype_size(dtype);
     size_t max_chunk = count / world_size_ + 1;
@@ -304,6 +338,7 @@ void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
   if (world_size_ == 1 || nbytes == 0) return;
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    check_op_header(1, nbytes, 0, 0, deadline);
     for (int64_t s = 0; s < world_size_ - 1; s++) {
       int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
       int64_t recv_c =
@@ -322,6 +357,7 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
   if (root < 0 || root >= world_size_) throw SocketError("bad broadcast root");
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    check_op_header(2, nbytes, static_cast<uint32_t>(root), 0, deadline);
     char* bytes = static_cast<char*>(data);
     // Forward around the ring, root first; the last hop before root does not
     // send. recv-then-send per hop (latency is fine at control-plane sizes;
@@ -342,6 +378,7 @@ void HostCollectives::barrier(int64_t timeout_ms) {
   if (world_size_ == 1) return;
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    check_op_header(3, 0, 0, 0, deadline);
     // Two full ring passes: after the first, rank 0 knows everyone arrived;
     // the second releases everyone.
     char token = 1;

@@ -86,6 +86,12 @@ class HostCollectives {
   void duplex(const char* send_buf, size_t send_len, char* recv_buf,
               size_t recv_len, int64_t deadline_ms);
 
+  // Exchanges a tiny (kind, count, dtype, op) header with both neighbors
+  // before a collective and throws on mismatch — a size/dtype-mismatched
+  // op would otherwise deadlock silently once kernel buffers fill.
+  void check_op_header(uint32_t kind, uint64_t count, uint32_t dtype,
+                       uint32_t op, int64_t deadline_ms);
+
   // Runs an op body; on ANY failure shuts down both ring sockets before
   // rethrowing. The FIN propagates the failure around the ring: every
   // member's in-flight op fails within milliseconds instead of blocking on

@@ -407,3 +407,46 @@ class TestDummyCollectives:
         assert len(d.allgather(t).wait()) == 3
         d.configure("x:0/p", 0, 2)
         assert d.configure_count == 1 and d.size() == 2
+
+
+class TestOpMismatchDetection:
+    """Size/dtype-mismatched collective ops must error immediately, not
+    deadlock with the smaller member done and the larger one blocked on a
+    full kernel buffer (the failure mode behind the bench's wedged diloco
+    sync: a 6-layer tree reduced against a 2-layer zeros tree)."""
+
+    def test_mismatched_sizes_error_fast(self, store):
+        cols = _make_ring(store, 2, prefix="mismatch")
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            f0 = ex.submit(
+                lambda: cols[0].allreduce(np.ones(1 << 20, np.float32)).wait()
+            )
+            f1 = ex.submit(
+                lambda: cols[1].allreduce(np.ones(1 << 10, np.float32)).wait()
+            )
+            start = time.monotonic()
+            for f in (f0, f1):
+                with pytest.raises(RuntimeError, match="mismatch|desync|ring"):
+                    f.result(timeout=15)
+            assert time.monotonic() - start < 10
+        for c in cols:
+            c.shutdown()
+
+    def test_mismatched_dtype_error_fast(self, store):
+        import jax.numpy as jnp
+
+        cols = _make_ring(store, 2, prefix="mismatch_dt")
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            f0 = ex.submit(
+                lambda: cols[0].allreduce(np.ones(256, np.float32)).wait()
+            )
+            f1 = ex.submit(
+                lambda: cols[1]
+                .allreduce(jnp.ones(256, jnp.bfloat16))
+                .wait()
+            )
+            for f in (f0, f1):
+                with pytest.raises(RuntimeError, match="mismatch|desync|ring"):
+                    f.result(timeout=15)
+        for c in cols:
+            c.shutdown()

@@ -24,6 +24,7 @@ the duration of each native call.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 from abc import ABC, abstractmethod
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -314,7 +315,7 @@ class HostCollectives(Collectives):
         self,
         timeout: timedelta = timedelta(seconds=60),
         connect_timeout: timedelta = timedelta(seconds=60),
-        pipeline_chunks: int = 4,
+        pipeline_chunks: Optional[int] = None,
         pipeline_min_bytes: int = 4 << 20,
     ) -> None:
         """``pipeline_chunks`` > 1 splits large device-packed buffers so
@@ -323,11 +324,20 @@ class HostCollectives(Collectives):
         chunk i-1 re-uploads). Buffers under ``pipeline_min_bytes`` take
         the single-shot path — per-transfer latency would beat the
         overlap. Chunk boundaries depend only on size, so results stay
-        bit-identical across ranks and against the unchunked path."""
+        bit-identical across ranks and against the unchunked path.
+
+        Default: env ``TORCHFT_HC_PIPELINE_CHUNKS`` (else 4). Set it to 1
+        on hosts whose device runtime wedges in-flight transfers under
+        overlapping async dispatch (observed on tunneled/proxied device
+        sessions) — every member of a ring must use the same value."""
         _declare_hc(_lib)
         self._handle = _lib.tft_hc_create()
         self._timeout = timeout
         self._connect_timeout = connect_timeout
+        if pipeline_chunks is None:
+            pipeline_chunks = int(
+                os.environ.get("TORCHFT_HC_PIPELINE_CHUNKS", "4")
+            )
         self._pipeline_chunks = max(int(pipeline_chunks), 1)
         self._pipeline_min_bytes = int(pipeline_min_bytes)
         self._world_size = 0

@@ -288,8 +288,12 @@ class AsyncDiLoCo(DiLoCo):
         return super().state_dict()
 
     def _launch_sync(self) -> None:
+        import time
+
         import jax
         import jax.numpy as jnp
+
+        t0 = time.perf_counter()
 
         if self._delta_fn is None:
             wire_dtype = jnp.bfloat16 if self._compress == "bf16" else None
@@ -309,8 +313,13 @@ class AsyncDiLoCo(DiLoCo):
         delta = self._delta_fn(old_global, self._state.params)
         work = self._manager.allreduce(delta, op=ReduceOp.AVG)
         self._pending = (work, delta)
+        logger.debug(
+            "sync launched in %.2fs", time.perf_counter() - t0
+        )
 
     def _finish_pending(self) -> None:
+        import time
+
         import jax
         import optax
 
@@ -318,7 +327,10 @@ class AsyncDiLoCo(DiLoCo):
             return
         work, delta = self._pending
         self._pending = None
+        t0 = time.perf_counter()
         averaged = work.wait()
+        logger.debug("sync ring wait %.2fs", time.perf_counter() - t0)
+        t0 = time.perf_counter()
         old_global = _to_device_tree(self._backup_params)
 
         if self._commit_fn is None:
@@ -348,13 +360,23 @@ class AsyncDiLoCo(DiLoCo):
 
             self._commit_fn = jax.jit(commit_fn)
             self._abort_fn = jax.jit(abort_fn)
+        logger.debug(
+            "sync reconcile prep %.2fs", time.perf_counter() - t0
+        )
 
+        t0 = time.perf_counter()
         if self._manager.should_commit():
             self._state.params, new_global, self._outer_state = self._commit_fn(
                 averaged, old_global, delta, self._outer_state,
                 self._state.params,
             )
             self._backup_params = _detached_copy(new_global)
+            logger.debug(
+                "sync commit apply+backup %.2fs", time.perf_counter() - t0
+            )
         else:
             # Window k discarded; window k+1's local progress survives.
             self._state.params = self._abort_fn(self._state.params, delta)
+            logger.debug(
+                "sync abort rollback %.2fs", time.perf_counter() - t0
+            )
