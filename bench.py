@@ -16,13 +16,17 @@ Configurations measured (details in BENCH_DETAIL.json):
               per-step shipping is link-bound regardless of framework.
   ft_diloco   AsyncDiLoCo — the bandwidth-appropriate cross-group mode this
               framework ships for DCN-class links: inner steps stay on-chip
-              and the bf16 pseudogradient sync runs once per window. The
+              and the compressed pseudogradient sync runs once per window
+              (bf16 ring allreduce on healthy links; int8+error-feedback
+              allgather on degraded ones, 4x fewer bytes than f32). The
               window is sized from the measured link so the sync stays a
               small fraction of wall-clock, and the sync is overlapped with
               the next window's compute on healthy links / run serially at
               the boundary on degraded ones (where in-flight transfers
               starve under the async dispatch flood). Full FT machinery
-              (quorum + commit vote) every window. THIS is the headline.
+              (quorum + commit vote) every window; best of 2 timed windows
+              reported (transient tunnel stalls recorded, not averaged in).
+              THIS is the headline.
 
 On TPU a fourth configuration runs an MXU-SATURATING model (d_model 1024,
 8 layers, seq 2048 — large batched bf16-friendly matmuls) so FT overhead is
@@ -144,12 +148,24 @@ def peer() -> None:
 
     cfg, _, _ = _model_setup()
     params = init_params(cfg, jax.random.PRNGKey(0))
-    wire_dtype = (
-        jnp.bfloat16 if os.environ.get("BENCH_PEER_DTYPE") == "bf16" else None
-    )
-    zeros = jax.tree_util.tree_map(
-        lambda l: jnp.zeros(l.shape, wire_dtype or l.dtype), params
-    )
+    peer_dtype = os.environ.get("BENCH_PEER_DTYPE")
+    if peer_dtype == "int8":
+        # int8 windows travel as a managed ALLGATHER of
+        # {q: int8 leaves, scale: f32 scalars} (see AsyncDiLoCo); the
+        # peer's zero contribution is all-zero q with zero scales.
+        zeros = {
+            "q": jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, jnp.int8), params
+            ),
+            "scale": jax.tree_util.tree_map(
+                lambda l: jnp.zeros((), jnp.float32), params
+            ),
+        }
+    else:
+        wire_dtype = jnp.bfloat16 if peer_dtype == "bf16" else None
+        zeros = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, wire_dtype or l.dtype), params
+        )
 
     state = {"params": params}
     collectives = HostCollectives(timeout=timedelta(seconds=1800))
@@ -193,7 +209,10 @@ def peer() -> None:
     for i in range(rounds):
         if i > 0:
             manager.start_quorum(allow_heal=False)
-        manager.allreduce(zeros).wait()  # paced by the main side's ring op
+        if peer_dtype == "int8":
+            manager.allgather(zeros).wait()  # paced by the main side
+        else:
+            manager.allreduce(zeros).wait()  # paced by the main side
         print(f"peer: round {i} done participants="
               f"{manager.num_participants()}", flush=True)
     manager.shutdown()
@@ -281,7 +300,8 @@ def _bench_big(lighthouse) -> dict:
     windows = 1
     peer_proc = manager = collectives = None
     try:
-        peer_proc = _spawn_peer(lighthouse.address(), windows + 1, "bf16")
+        wire = os.environ.get("BENCH_WIRE") or ("bf16" if d2h_MBps >= 100 else "int8")
+        peer_proc = _spawn_peer(lighthouse.address(), windows + 1, wire)
         state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
         collectives = HostCollectives(timeout=td(seconds=600))
         manager = Manager(
@@ -299,7 +319,7 @@ def _bench_big(lighthouse) -> dict:
         )
         diloco = AsyncDiLoCo(
             manager, state, optax.sgd(0.7, momentum=0.9, nesterov=True),
-            sync_every, compress="bf16",
+            sync_every, compress=wire,
             overlap=d2h_MBps >= 100,  # serial sync on degraded links
         )
         manager._load_state_dict = diloco.load_state_dict
@@ -552,7 +572,12 @@ def main() -> None:
     # window's rate), and the best window is the steady-state capability
     # the metric is after. Both rates land in the detail file.
     diloco_windows = 2
-    peer_proc = _spawn_peer(lighthouse.address(), diloco_windows + 1, "bf16")
+    # int8+error-feedback on degraded links: the window sync is the cost
+    # being measured there, and int8 ships 4x fewer bytes than f32 (2x
+    # fewer than bf16); healthy links keep bf16 (sync hides behind
+    # compute anyway, and allgather traffic grows with cohort size).
+    wire = os.environ.get("BENCH_WIRE") or ("bf16" if overlap else "int8")
+    peer_proc = _spawn_peer(lighthouse.address(), diloco_windows + 1, wire)
     state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
     collectives = HostCollectives(timeout=timedelta(seconds=1800))
     manager = Manager(
@@ -573,7 +598,7 @@ def main() -> None:
         state,
         optax.sgd(0.7, momentum=0.9, nesterov=True),
         sync_every,
-        compress="bf16",
+        compress=wire,
         overlap=overlap,
     )
     manager._load_state_dict = diloco.load_state_dict
@@ -622,8 +647,9 @@ def main() -> None:
         "window_steps_per_sec": [round(s, 3) for s in window_sps],
         "ratio_vs_raw": round(ft_sps / raw_sps, 3),
         "sync_every": sync_every,
+        "compress": wire,
         "overlap": overlap,
-        "note": "bf16 pseudogradient window sync (AsyncDiLoCo); best of "
+        "note": f"{wire} pseudogradient window sync (AsyncDiLoCo); best of "
         f"{diloco_windows} windows (the tunneled runtime has transient "
         "stalls; both rates recorded); overlapped with inner compute on "
         "healthy links, serial-at-boundary on degraded ones (see "

@@ -292,11 +292,14 @@ void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
                                 ReduceOp op, int64_t timeout_ms) {
   std::lock_guard<std::mutex> lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
-  if (world_size_ == 1 || count == 0) return;
+  if (world_size_ == 1) return;
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // header exchanged even for count==0: an empty-vs-nonempty mismatch
+    // must error, not hang the nonempty member
     check_op_header(0, count, static_cast<uint32_t>(dtype),
                     static_cast<uint32_t>(op), deadline);
+    if (count == 0) return;
     char* bytes = static_cast<char*>(data);
     size_t esize = dtype_size(dtype);
     size_t max_chunk = count / world_size_ + 1;
@@ -335,10 +338,11 @@ void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
   if (aborted_) throw SocketError("collectives not configured");
   char* slots = static_cast<char*>(out);
   memcpy(slots + rank_ * nbytes, in, nbytes);
-  if (world_size_ == 1 || nbytes == 0) return;
+  if (world_size_ == 1) return;
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
     check_op_header(1, nbytes, 0, 0, deadline);
+    if (nbytes == 0) return;
     for (int64_t s = 0; s < world_size_ - 1; s++) {
       int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
       int64_t recv_c =
@@ -353,11 +357,12 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
                                 int64_t timeout_ms) {
   std::lock_guard<std::mutex> lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
-  if (world_size_ == 1 || nbytes == 0) return;
+  if (world_size_ == 1) return;
   if (root < 0 || root >= world_size_) throw SocketError("bad broadcast root");
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
     check_op_header(2, nbytes, static_cast<uint32_t>(root), 0, deadline);
+    if (nbytes == 0) return;
     char* bytes = static_cast<char*>(data);
     // Forward around the ring, root first; the last hop before root does not
     // send. recv-then-send per hop (latency is fine at control-plane sizes;

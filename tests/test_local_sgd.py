@@ -331,3 +331,123 @@ class TestLocalSGDInteg:
         )
         np.testing.assert_array_equal(results[0]["params"], results[1]["params"])
         np.testing.assert_array_equal(results[0]["backup"], results[1]["backup"])
+
+
+class TestInt8Compression:
+    def _manager(self, commit=True, participants=1):
+        manager = _mock_manager(commit=commit)
+        manager.allgather.side_effect = lambda tree: _completed([tree])
+        manager.num_participants.return_value = participants
+        return manager
+
+    def test_ships_int8_with_scales_and_tracks_local(self):
+        import jax
+
+        manager = self._manager()
+        seen = []
+        manager.allgather.side_effect = lambda tree: (
+            seen.append(tree), _completed([tree])
+        )[1]
+        st = _state(1.0)
+        ad = AsyncDiLoCo(
+            manager, st, optax.sgd(1.0), sync_every=2, compress="int8"
+        )
+        grads = {"w": jnp.ones((4,))}
+        for _ in range(4):
+            ad.step(grads)
+        ad.flush()
+        assert seen and all(
+            str(l.dtype) == "int8"
+            for e in seen
+            for l in jax.tree_util.tree_leaves(e["q"])
+        )
+        assert all("scale" in e for e in seen)
+        # lr=1 single group tracks local training within one quantization
+        # step of the largest delta (scale = max|d|/127)
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), 0.6, atol=0.01
+        )
+        assert st.params["w"].dtype == jnp.float32
+
+    def test_error_feedback_prevents_drift(self):
+        # Many windows with a delta that does NOT quantize exactly: with
+        # EF the accumulated shipped sum stays within ONE quantization
+        # step of the true sum; without EF the per-window bias would
+        # accumulate linearly.
+        manager = self._manager()
+        st = _state(1.0)
+        ad = AsyncDiLoCo(
+            manager, st, optax.sgd(1.0), sync_every=1, compress="int8"
+        )
+        # gradient chosen so delta/scale is irrational-ish per window
+        grads = {"w": jnp.asarray([0.1, 0.0333, 0.00777, 0.0001])}
+        windows = 20
+        for _ in range(windows):
+            ad.step(grads)
+        ad.flush()
+        # inner sgd lr=0.1 -> per-window delta = 0.1 * grad
+        expect = 1.0 - windows * 0.1 * np.asarray(grads["w"])
+        # one quantization step = max|d|/127 = 0.01/127 per window; EF
+        # keeps TOTAL error near one step, far below windows * step
+        step_q = 0.01 / 127
+        err = np.max(np.abs(np.asarray(st.params["w"]) - expect))
+        assert err < 3 * step_q, (err, step_q)
+
+    def test_abort_restores_residual_and_rolls_back(self):
+        manager = self._manager(commit=False)
+        st = _state(1.0)
+        ad = AsyncDiLoCo(
+            manager, st, optax.sgd(1.0), sync_every=1, compress="int8"
+        )
+        ad.step({"w": jnp.ones((4,))})  # window ships, will abort
+        ad.flush()
+        # rollback: params return to backup
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), 1.0, atol=1e-6
+        )
+        # aborted window's EF update discarded
+        np.testing.assert_allclose(
+            np.asarray(ad._residual["w"]), 0.0, atol=1e-9
+        )
+
+    def test_zero_peer_entry_does_not_dilute(self):
+        # The bench scenario: a non-participating ring member's entry
+        # arrives zeroed (Manager.allgather); the divisor is
+        # num_participants (1), so the real member's delta is preserved
+        # instead of being halved by the cohort size.
+        import jax
+
+        manager = self._manager(participants=1)
+        manager.allgather.side_effect = lambda tree: _completed(
+            [tree, jax.tree_util.tree_map(lambda l: l * 0, tree)]
+        )
+        st = _state(1.0)
+        ad = AsyncDiLoCo(
+            manager, st, optax.sgd(1.0), sync_every=1, compress="int8"
+        )
+        ad.step({"w": jnp.ones((4,))})  # inner lr 0.1 -> delta 0.1
+        ad.flush()
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), 0.9, atol=0.001
+        )
+
+    def test_two_member_average(self):
+        # Simulated 2-member cohort: our entry + a peer entry with the
+        # SAME quantized payload -> average equals our dequantized delta
+        import jax
+
+        manager = self._manager(participants=2)
+        manager.allgather.side_effect = lambda tree: _completed(
+            [tree, jax.tree_util.tree_map(lambda l: l, tree)]
+        )
+        st = _state(1.0)
+        ad = AsyncDiLoCo(
+            manager, st, optax.sgd(1.0), sync_every=1, compress="int8"
+        )
+        ad.step({"w": jnp.full((4,), 0.25)})
+        ad.flush()
+        # inner lr 0.1: window delta = 0.025; identical peer entry ->
+        # average == own dequantized delta -> params = 1 - 0.025
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), 0.975, atol=0.001
+        )

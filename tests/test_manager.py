@@ -251,6 +251,40 @@ class TestHealing:
         assert m.current_step() == 21
         m.shutdown()
 
+    def test_allgather_zeroes_non_participating_entry(self, store):
+        # Same participation discipline as allreduce: a healing replica's
+        # allgather entry must arrive zeroed, so entry-wise averages
+        # (int8 DiLoCo) divided by num_participants stay correct.
+        m, client, col, transport = _create_manager(
+            store,
+            use_async_quorum=True,
+            min_replica_size=1,
+        )
+        client.quorum.return_value = _quorum_result(
+            quorum_id=2,
+            replica_rank=1,
+            replica_world_size=2,
+            heal=True,
+            max_step=20,
+            max_rank=None,
+            max_world_size=1,
+            recover_src_manager_address="mock://peer",
+            recover_src_rank=0,
+        )
+        client.checkpoint_metadata.return_value = "peer:meta"
+        transport.recv_checkpoint.return_value = {
+            "user": {},
+            "torchft": {"step": 20, "batches_committed": 40},
+        }
+        m.start_quorum()
+        out = m.allgather({"g": np.full(3, 8.0, np.float32)}).wait()
+        assert not m.is_participating()
+        assert isinstance(out, list)
+        np.testing.assert_array_equal(
+            np.asarray(out[0]["g"]), np.zeros(3)
+        )
+        m.shutdown()
+
     def test_recovery_source_sends_checkpoint(self, store):
         m, client, _, transport = _create_manager(
             store, state_dict=lambda: {"model": "mine"}

@@ -252,6 +252,15 @@ class AsyncDiLoCo(DiLoCo):
         optimizer sees bf16-rounded pseudogradients, the f32 master params
         are untouched.
 
+        ``compress="int8"`` quantizes each pseudogradient leaf to int8
+        with a per-leaf f32 scale and ERROR FEEDBACK (the quantization
+        residual is added to the next window's delta, so rounding error
+        never accumulates) — 4x fewer bytes than f32, 2x fewer than bf16.
+        The wire op becomes a managed ALLGATHER with member-wise
+        dequantize-and-average (an int8 SUM on the wire would overflow),
+        so per-member traffic scales with cohort size; intended for the
+        small replica-group counts DiLoCo targets.
+
         ``overlap=False`` completes the sync AT the boundary instead of one
         window later (the reconciliation degenerates to θ = G', i.e. exact
         synchronous DiLoCo, but through the same jitted ops). Use it on
@@ -260,15 +269,19 @@ class AsyncDiLoCo(DiLoCo):
         transfer under a stream of async dispatches can starve for far
         longer than its serial wall time, and a blocking boundary sync is
         strictly faster."""
-        if compress not in (None, "bf16"):
+        if compress not in (None, "bf16", "int8"):
             raise ValueError(f"unsupported compress mode: {compress}")
         super().__init__(manager, state, outer_tx, sync_every)
         self._compress = compress
         self._overlap = overlap
-        self._pending: Any = None  # (work, delta) of the in-flight window
+        # (work, shipped delta, pre-launch residual) of the in-flight window
+        self._pending: Any = None
         self._delta_fn: Any = None  # jitted Δ = B − θ (with optional cast)
         self._commit_fn: Any = None  # jitted delayed outer update + reconcile
         self._abort_fn: Any = None  # jitted window rollback
+        self._quant_fn: Any = None       # int8: jitted quantize + EF update
+        self._combine_fns: Dict[int, Any] = {}  # int8: per-cohort-size avg
+        self._residual: Any = None       # int8: error-feedback carry
 
     def sync(self) -> None:
         self._finish_pending()
@@ -294,6 +307,57 @@ class AsyncDiLoCo(DiLoCo):
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
+        old_global = _to_device_tree(self._backup_params)
+
+        if self._compress == "int8":
+            if self._residual is None:
+                self._residual = jax.tree_util.tree_map(
+                    lambda l: jnp.zeros(l.shape, jnp.float32),
+                    self._state.params,
+                )
+            if self._quant_fn is None:
+
+                def quant_fn(old, new, residual):
+                    def leaf(o, n, r):
+                        d = (o - n).astype(jnp.float32) + r
+                        scale = jnp.maximum(
+                            jnp.max(jnp.abs(d)) / 127.0, 1e-12
+                        )
+                        q = jnp.clip(
+                            jnp.round(d / scale), -127, 127
+                        ).astype(jnp.int8)
+                        dq = q.astype(jnp.float32) * scale
+                        return {"q": q, "scale": scale, "dq": dq,
+                                "res": d - dq}
+
+                    packed = jax.tree_util.tree_map(
+                        leaf, old, new, residual
+                    )
+                    return jax.tree_util.tree_transpose(
+                        jax.tree_util.tree_structure(old),
+                        jax.tree_util.tree_structure(
+                            {"q": 0, "scale": 0, "dq": 0, "res": 0}
+                        ),
+                        packed,
+                    )
+
+                self._quant_fn = jax.jit(quant_fn)
+
+            prev_residual = self._residual
+            out = self._quant_fn(
+                old_global, self._state.params, prev_residual
+            )
+            self._residual = out["res"]  # EF carry (restored on abort)
+            work = self._manager.allgather(
+                {"q": out["q"], "scale": out["scale"]}
+            )
+            # reconcile against what we actually SHIPPED (the dequantized
+            # local delta), same role as the bf16-rounded delta below
+            self._pending = (work, out["dq"], prev_residual)
+            logger.debug(
+                "int8 sync launched in %.2fs", time.perf_counter() - t0
+            )
+            return
 
         if self._delta_fn is None:
             wire_dtype = jnp.bfloat16 if self._compress == "bf16" else None
@@ -309,10 +373,9 @@ class AsyncDiLoCo(DiLoCo):
 
             self._delta_fn = jax.jit(delta_fn)
 
-        old_global = _to_device_tree(self._backup_params)
         delta = self._delta_fn(old_global, self._state.params)
         work = self._manager.allreduce(delta, op=ReduceOp.AVG)
-        self._pending = (work, delta)
+        self._pending = (work, delta, None)
         logger.debug(
             "sync launched in %.2fs", time.perf_counter() - t0
         )
@@ -325,12 +388,44 @@ class AsyncDiLoCo(DiLoCo):
 
         if self._pending is None:
             return
-        work, delta = self._pending
+        work, delta, prev_residual = self._pending
         self._pending = None
         t0 = time.perf_counter()
-        averaged = work.wait()
+        result = work.wait()
         logger.debug("sync ring wait %.2fs", time.perf_counter() - t0)
         t0 = time.perf_counter()
+        if self._compress == "int8":
+            # member-wise dequantize, then average over PARTICIPANTS:
+            # non-participating (healing/spare) entries arrive zeroed
+            # (Manager.allgather) and must not dilute the divisor
+            import jax.numpy as jnp
+
+            cohort = len(result)
+            combine = self._combine_fns.get(cohort)
+            if combine is None:
+
+                def combine_fn(entries, n_participants):
+                    acc = None
+                    for e in entries:
+                        dq = jax.tree_util.tree_map(
+                            lambda q, s: q.astype(jnp.float32) * s,
+                            e["q"], e["scale"],
+                        )
+                        acc = (
+                            dq if acc is None
+                            else jax.tree_util.tree_map(jnp.add, acc, dq)
+                        )
+                    return jax.tree_util.tree_map(
+                        lambda a: a / n_participants, acc
+                    )
+
+                combine = self._combine_fns[cohort] = jax.jit(combine_fn)
+            averaged = combine(
+                result,
+                jnp.float32(max(self._manager.num_participants(), 1)),
+            )
+        else:
+            averaged = result
         old_global = _to_device_tree(self._backup_params)
 
         if self._commit_fn is None:
@@ -377,6 +472,9 @@ class AsyncDiLoCo(DiLoCo):
         else:
             # Window k discarded; window k+1's local progress survives.
             self._state.params = self._abort_fn(self._state.params, delta)
+            if prev_residual is not None:
+                # discard the aborted window's EF update with it
+                self._residual = prev_residual
             logger.debug(
                 "sync abort rollback %.2fs", time.perf_counter() - t0
             )

@@ -450,6 +450,46 @@ class Manager:
             self.report_error(e)
             return _completed(tree)
 
+    def allgather(self, tree: Any) -> Work:
+        """Fault-tolerantly gathers ``tree`` from every cohort member.
+
+        Same error contract as :meth:`allreduce` (data-plane errors latch
+        and the Work resolves to ``[tree]``; quorum failure raises), and
+        the same participation discipline: a non-participating
+        (healing/spare) replica's entry is ZEROED before the gather, so
+        consumers averaging entry-wise must divide by
+        ``num_participants()``, not the cohort size. Every ring member's
+        entry appears, ordered by replica rank. Intended for
+        LocalSGD-family window syncs (quantized payloads average
+        member-wise after dequantization — a SUM over the wire dtype
+        would overflow). No reference analog at the Manager level (the
+        reference exposes allgather only on the raw PG, reference
+        process_group.py:130-137).
+        """
+        if self.errored() is not None:
+            return _completed([tree])
+        self.wait_quorum()
+        try:
+            import jax
+
+            if not self.is_participating():
+                tree = jax.tree_util.tree_map(
+                    lambda l: l * 0 if hasattr(l, "__mul__") else l, tree
+                )
+            t0 = time.perf_counter()
+            with span("torchft::allgather_dispatch"):
+                work = self._collectives.allgather(tree)
+            work.add_done_callback(
+                lambda _f: self._metrics.record(
+                    "allgather", time.perf_counter() - t0
+                )
+            )
+            return self.wrap_work(work, default=[tree])
+        except Exception as e:  # noqa: BLE001 - latch, never raise
+            self._logger.exception(f"allgather failed immediately: {e}")
+            self.report_error(e)
+            return _completed([tree])
+
     def wrap_work(self, work: Work, default: Any, timeout: Optional[timedelta] = None) -> Work:
         """Adds a timeout and error-swallowing to a Work: on failure the
         error is latched and ``default`` is returned (reference
