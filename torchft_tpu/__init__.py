@@ -23,6 +23,7 @@ from torchft_tpu.collectives import (
     Work,
 )
 from torchft_tpu.data import DistributedSampler, StatefulDataLoader
+from torchft_tpu.durable import DurableCheckpointer
 from torchft_tpu.ddp import DistributedDataParallel
 from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -42,6 +43,7 @@ __all__ = [
     "DistributedDataParallel",
     "DistributedSampler",
     "DummyCollectives",
+    "DurableCheckpointer",
     "LocalSGD",
     "HostCollectives",
     "Lighthouse",

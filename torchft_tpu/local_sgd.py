@@ -300,6 +300,15 @@ class AsyncDiLoCo(DiLoCo):
         self._finish_pending()
         return super().state_dict()
 
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        super().load_state_dict(sd)
+        # The int8 error-feedback carry is trajectory-local: after a heal
+        # or durable restore the replica is on ANOTHER trajectory's
+        # params, so the stale residual would inject a fraction of a
+        # discarded correction into the next window. Reset it (a clean
+        # restart's state).
+        self._residual = None
+
     def _launch_sync(self) -> None:
         import time
 

@@ -408,19 +408,7 @@ class Manager:
         (divide by ``num_participants``, the live divisor, reference
         :279-291) or SUM.
         """
-        if self.errored() is not None:
-            return _completed(tree)
-
-        self.wait_quorum()
-        num_participants = self.num_participants()
-
-        try:
-            import jax
-
-            if not self.is_participating():
-                tree = jax.tree_util.tree_map(
-                    lambda l: l * 0 if hasattr(l, "__mul__") else l, tree
-                )
+        def dispatch(zeroed_tree: Any) -> Work:
             if op == ReduceOp.AVG:
                 # The participant average rides the collectives' divisor
                 # path (applied host-side in the ring, where the bytes
@@ -428,27 +416,18 @@ class Manager:
                 # per step. Divisor = num_participants, NOT ring size:
                 # healing/spare members contribute zeros and don't count
                 # (reference manager.py:279-291).
+                num_participants = self.num_participants()
                 assert num_participants >= 1
                 divisor: Optional[float] = float(num_participants)
             elif op == ReduceOp.SUM:
                 divisor = None
             else:
                 raise ValueError(f"unsupported managed allreduce op: {op}")
-            t0 = time.perf_counter()
-            with span("torchft::allreduce_dispatch"):
-                work = self._collectives.allreduce(
-                    tree, ReduceOp.SUM, divisor=divisor
-                )
-            work.add_done_callback(
-                lambda _f: self._metrics.record(
-                    "allreduce", time.perf_counter() - t0
-                )
+            return self._collectives.allreduce(
+                zeroed_tree, ReduceOp.SUM, divisor=divisor
             )
-            return self.wrap_work(work, default=tree)
-        except Exception as e:  # noqa: BLE001 - latch, never raise
-            self._logger.exception(f"allreduce failed immediately: {e}")
-            self.report_error(e)
-            return _completed(tree)
+
+        return self._managed_dispatch("allreduce", tree, dispatch, tree)
 
     def allgather(self, tree: Any) -> Work:
         """Fault-tolerantly gathers ``tree`` from every cohort member.
@@ -466,8 +445,23 @@ class Manager:
         reference exposes allgather only on the raw PG, reference
         process_group.py:130-137).
         """
+        return self._managed_dispatch(
+            "allgather", tree, self._collectives.allgather, [tree]
+        )
+
+    def _managed_dispatch(
+        self,
+        op_name: str,
+        tree: Any,
+        dispatch: Callable[[Any], Work],
+        default: Any,
+    ) -> Work:
+        """The shared managed-collective discipline: errored short-circuit,
+        quorum join, participant zeroing, profiler span + metrics timer,
+        timeout + error-latching wrap; immediate failures latch and
+        resolve to ``default`` (reference manager.py:242-303, 326-363)."""
         if self.errored() is not None:
-            return _completed([tree])
+            return _completed(default)
         self.wait_quorum()
         try:
             import jax
@@ -477,18 +471,18 @@ class Manager:
                     lambda l: l * 0 if hasattr(l, "__mul__") else l, tree
                 )
             t0 = time.perf_counter()
-            with span("torchft::allgather_dispatch"):
-                work = self._collectives.allgather(tree)
+            with span(f"torchft::{op_name}_dispatch"):
+                work = dispatch(tree)
             work.add_done_callback(
                 lambda _f: self._metrics.record(
-                    "allgather", time.perf_counter() - t0
+                    op_name, time.perf_counter() - t0
                 )
             )
-            return self.wrap_work(work, default=[tree])
+            return self.wrap_work(work, default=default)
         except Exception as e:  # noqa: BLE001 - latch, never raise
-            self._logger.exception(f"allgather failed immediately: {e}")
+            self._logger.exception(f"{op_name} failed immediately: {e}")
             self.report_error(e)
-            return _completed([tree])
+            return _completed(default)
 
     def wrap_work(self, work: Work, default: Any, timeout: Optional[timedelta] = None) -> Work:
         """Adds a timeout and error-swallowing to a Work: on failure the
