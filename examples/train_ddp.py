@@ -74,10 +74,28 @@ def loss_fn(params, x, y):
 
 
 def build_model():
-    """MODEL=mlp (default, synthetic blobs) or MODEL=cnn (synthetic
+    """MODEL=mlp (default, synthetic blobs), MODEL=cnn (synthetic
     CIFAR-shaped images through models.cnn — the reference demo's model
-    family, reference train_ddp.py:64-72)."""
-    if os.environ.get("MODEL", "mlp") == "cnn":
+    family, reference train_ddp.py:64-72), or MODEL=moe (tiny
+    mixture-of-experts LM on synthetic tokens)."""
+    model = os.environ.get("MODEL", "mlp")
+    if model == "moe":
+        from torchft_tpu.models import moe, tiny_moe_config
+
+        cfg = tiny_moe_config()
+        rng = np.random.default_rng(0)
+        n, seq = 2048, 33
+        x = rng.integers(
+            0, cfg.vocab_size, (n, seq), dtype=np.int64
+        ).astype(np.int32)
+        y = np.zeros((n,), np.int32)  # unused: LM loss reads the tokens
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+
+        def loss(params, xb, yb):
+            return moe.loss_fn(cfg, params, xb)
+
+        return params, loss, x, y
+    if model == "cnn":
         from torchft_tpu.models import cnn, tiny_cnn_config
 
         cfg = tiny_cnn_config()

@@ -141,3 +141,24 @@ def test_no_resave_at_same_step_after_abort(rig, tmp_path):
         assert __import__("os").path.getmtime(first) == mtime
     finally:
         manager.shutdown()
+
+
+def test_restore_arms_same_step_guard(rig, tmp_path):
+    # The re-save guard must survive a restore: an aborted first
+    # post-restore step at the boundary must not overwrite the file.
+    state = FTTrainState({"w": jnp.ones((4,), jnp.float32)}, optax.sgd(1.0))
+    manager = rig(state)
+    ckpt = DurableCheckpointer(str(tmp_path), manager, state, every=1)
+    try:
+        _train(manager, state, ckpt, 1)
+    finally:
+        manager.shutdown()
+
+    state2 = FTTrainState({"w": jnp.zeros((4,), jnp.float32)}, optax.sgd(1.0))
+    manager2 = rig(state2)
+    ckpt2 = DurableCheckpointer(str(tmp_path), manager2, state2, every=1)
+    try:
+        assert ckpt2.restore_latest() == 1
+        assert ckpt2.maybe_save() is None  # restored step: guard armed
+    finally:
+        manager2.shutdown()

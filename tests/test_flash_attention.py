@@ -14,14 +14,17 @@ import pytest
 from torchft_tpu.ops import flash_attention
 
 
-def dense_attention(q, k, v, causal=True, sm_scale=None):
+def dense_attention(q, k, v, causal=True, sm_scale=None, window=None):
     B, S, H, D = q.shape
     if sm_scale is None:
         sm_scale = D ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-        s = jnp.where(mask, s, -jnp.inf)
+    qpos = jnp.arange(S)[:, None]
+    kpos = jnp.arange(S)[None, :]
+    mask = (qpos >= kpos) if causal else jnp.ones((S, S), jnp.bool_)
+    if window is not None:
+        mask = mask & (qpos - kpos < window)
+    s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
         q.dtype
@@ -146,3 +149,42 @@ def test_nondivisible_seq_is_padded_exactly(causal):
         np.testing.assert_allclose(
             a, b, atol=1e-4, rtol=1e-4, err_msg=f"d{name}"
         )
+
+
+def dense_windowed(q, k, v, window):
+    return dense_attention(q, k, v, causal=True, window=window)
+
+
+@pytest.mark.parametrize("window", [1, 16, 40, 200])
+def test_sliding_window_matches_dense(window):
+    # windows smaller than / straddling / larger than the 32-blocks
+    q, k, v = rand_qkv(jax.random.PRNGKey(7), (1, 128, 2, 16))
+    out = flash_attention(
+        q, k, v, window=window, block_q=32, block_k=32
+    )
+    ref = dense_windowed(q, k, v, window)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_grads_match_dense():
+    q, k, v = rand_qkv(jax.random.PRNGKey(8), (1, 96, 2, 8))
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, window=24, block_q=32, block_k=32) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(
+        lambda q, k, v: jnp.sum(dense_windowed(q, k, v, 24) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(
+            a, b, atol=1e-4, rtol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_window_requires_causal():
+    q, k, v = rand_qkv(jax.random.PRNGKey(9), (1, 64, 1, 8))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8)

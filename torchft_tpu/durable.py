@@ -128,6 +128,10 @@ class DurableCheckpointer:
         if self._loader is not None and "loader" in payload:
             self._loader.load_state_dict(payload["loader"])
         step = int(payload["torchft"]["step"])
+        # Arm the same-step guard for the restored step too: an aborted
+        # first post-restore step must not overwrite this checkpoint with
+        # a drifted loader position.
+        self._last_saved = step
         logger.info("restored durable checkpoint %s (step %d)", latest, step)
         return step
 
