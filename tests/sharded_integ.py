@@ -189,7 +189,7 @@ def run_sharded_groups(
         min_replicas=1,
         join_timeout_ms=200,
         quorum_tick_ms=50,
-        heartbeat_timeout_ms=2500,
+        heartbeat_timeout_ms=4000,
     )
     injectors = injectors or [FailureInjector() for _ in range(2)]
     try:

@@ -188,3 +188,31 @@ def test_window_requires_causal():
     q, k, v = rand_qkv(jax.random.PRNGKey(9), (1, 64, 1, 8))
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, k, v, causal=False, window=8)
+
+
+def test_transformer_attn_window():
+    import dataclasses
+
+    from torchft_tpu.models import init_params, loss_fn, tiny_config
+
+    cfg = dataclasses.replace(tiny_config(), use_flash=True, attn_window=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 65)),
+        jnp.int32,
+    )
+    l_win = float(loss_fn(cfg, params, tokens))
+    l_full = float(
+        loss_fn(dataclasses.replace(cfg, attn_window=None), params, tokens)
+    )
+    assert np.isfinite(l_win) and abs(l_win - l_full) > 1e-6  # window bites
+
+    with pytest.raises(ValueError, match="use_flash"):
+        dataclasses.replace(tiny_config(), attn_window=16)
+    # windowing is not implemented on the CP paths: must refuse, not
+    # silently train full-attention
+    with pytest.raises(ValueError, match="context-parallel"):
+        dataclasses.replace(
+            tiny_config(), use_flash=True, attn_window=16,
+            cp_seq_axis="seq",
+        )

@@ -234,7 +234,7 @@ def _run_replicas(
         # thread-per-replica tests back to back) can't age out a LIVE
         # member between 100 ms heartbeats; failure detection latency is
         # not what these tests assert.
-        heartbeat_timeout_ms=2500,
+        heartbeat_timeout_ms=4000,
     )
     injectors = injectors or [FailureInjector() for _ in range(num_replicas)]
     try:

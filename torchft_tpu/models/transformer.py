@@ -59,6 +59,9 @@ class TransformerConfig:
     # attention runs through the kernel. Ignored by cp_strategy="ring"
     # (that path fuses its own online-softmax loop).
     use_flash: bool = False
+    # Sliding-window (local) attention width; requires use_flash (the
+    # kernel skips out-of-window tiles). None = full causal attention.
+    attn_window: Any = None
     # Rematerialize each block's activations in backward (jax.checkpoint):
     # trades ~1/3 extra FLOPs for O(n_layers) less HBM — the standard TPU
     # recipe for long-sequence / large-batch configs.
@@ -82,6 +85,17 @@ class TransformerConfig:
             raise ValueError(
                 f"remat_policy must be None or 'save_attn', got "
                 f"{self.remat_policy!r}"
+            )
+        if self.attn_window is not None and not self.use_flash:
+            raise ValueError(
+                "attn_window requires use_flash=True (the dense and ring "
+                "paths do not implement sliding windows)"
+            )
+        if self.attn_window is not None and self.cp_seq_axis is not None:
+            raise ValueError(
+                "attn_window is not implemented on the context-parallel "
+                "paths (ring/ulysses take the attention branch before the "
+                "flash kernel); unset cp_seq_axis or attn_window"
             )
 
     @property
@@ -282,6 +296,7 @@ def _attention_impl(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> 
             mesh=cfg.cp_mesh,
             batch_axis=cfg.cp_batch_axis if cfg.cp_mesh is not None else None,
             head_axis=cfg.cp_head_axis,
+            window=cfg.attn_window,
         ).reshape(B, S, D)
         return out @ p["wo"].astype(cfg.dtype)
 
