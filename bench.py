@@ -343,7 +343,7 @@ def _bench_big(lighthouse) -> dict:
         for i in range(sync_every * windows):
             loss, grads = grad_fn(state.params, batch)
             diloco.step(grads)
-            if i % 128 == 127:
+            if i % 512 == 511:
                 np.asarray(loss)  # real drain (see _barrier note)
         diloco.flush()
         _barrier(state.params)
@@ -438,21 +438,23 @@ def main() -> None:
     detail = {"host": {"cpus": os.cpu_count(), "platform": jax.devices()[0].platform}}
 
     # -- raw loop --
+    def time_raw(warm: int) -> float:
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt_state = tx.init(params)
+        for _ in range(warm):
+            loss, grads = grad_fn(params, batch)
+            params, opt_state = apply_jit(params, opt_state, grads)
+        _barrier(params)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, grads = grad_fn(params, batch)
+            params, opt_state = apply_jit(params, opt_state, grads)
+        _barrier(params)
+        return steps / (time.perf_counter() - t0)
+
     _mark("phase: raw (compile + timed loop)")
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    opt_state = tx.init(params)
-    for _ in range(warmup):
-        loss, grads = grad_fn(params, batch)
-        params, opt_state = apply_jit(params, opt_state, grads)
-    _barrier(params)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, grads = grad_fn(params, batch)
-        params, opt_state = apply_jit(params, opt_state, grads)
-    _barrier(params)
-    raw_sps = steps / (time.perf_counter() - t0)
+    raw_sps = time_raw(warmup)
     detail["raw"] = {"steps_per_sec": round(raw_sps, 3)}
-    del params, opt_state
     _mark(f"phase: transfer probe (raw={raw_sps:.1f} steps/s)")
 
     # Device<->host bandwidth of the gradient-sized payload: the number that
@@ -635,8 +637,9 @@ def main() -> None:
         for i in range(sync_every):
             loss, grads = grad_fn(state.params, batch)
             diloco.step(grads)
-            if i % 128 == 127:
-                np.asarray(loss)  # real drain (bounded queue, fewer RTTs)
+            if i % 512 == 511:
+                np.asarray(loss)  # real drain: bounded queue; sparse because each
+                # drain costs a full tunnel RTT (seconds when degraded)
         diloco.flush()  # window boundary: sync complete before the clock stops
         _barrier(state.params)
         window_sps.append(sync_every / (time.perf_counter() - t0))
@@ -659,25 +662,44 @@ def main() -> None:
     manager.shutdown()
     collectives.shutdown()
 
-    # Headline line + detail land BEFORE the (long) big-model phase so a
-    # timeout there can never lose the round's primary metric. CPU smoke
-    # runs write a separate file so they can never clobber the committed
-    # TPU artifact.
+    # Headline line + detail land BEFORE any further device phases (the
+    # raw re-measure, the big model) so a tunnel wedge there can never
+    # lose the round's primary metric; the supervisor takes the LAST
+    # metric line, so a refined headline can safely overwrite this one.
+    # CPU smoke runs write a separate file so they can never clobber the
+    # committed TPU artifact.
     detail_name = (
         "BENCH_DETAIL.json" if on_tpu else "BENCH_DETAIL_cpu.json"
     )
-    with open(os.path.join(REPO, detail_name), "w") as f:
-        json.dump(detail, f, indent=2)
-    print(
-        json.dumps(
-            {
-                "metric": "steps_per_sec_ft",
-                "value": round(ft_sps, 3),
-                "unit": "steps/s",
-                "vs_baseline": round((ft_sps / raw_sps) / 0.90, 3),
-            }
+
+    def land_headline() -> None:
+        with open(os.path.join(REPO, detail_name), "w") as f:
+            json.dump(detail, f, indent=2)
+        print(
+            json.dumps(
+                {
+                    "metric": "steps_per_sec_ft",
+                    "value": round(ft_sps, 3),
+                    "unit": "steps/s",
+                    "vs_baseline": round((ft_sps / raw_sps) / 0.90, 3),
+                }
+            ),
+            flush=True,
         )
-    )
+
+    land_headline()
+
+    # Symmetric noise treatment: the numerator is best-of-2 windows, so
+    # the denominator is best-of-2 raw measurements too (re-timed here,
+    # minutes after the first — tunnel stalls are minute-scale). The
+    # provisional headline above already landed in case this wedges.
+    _mark("phase: raw re-measure")
+    raw_again = time_raw(1)
+    detail["raw"]["steps_per_sec_2nd"] = round(raw_again, 3)
+    raw_sps = max(raw_sps, raw_again)
+    detail["raw"]["best"] = round(raw_sps, 3)
+    detail["ft_diloco"]["ratio_vs_raw"] = round(ft_sps / raw_sps, 3)
+    land_headline()
 
     # -- big: FT overhead at MXU-saturating arithmetic intensity --
     if on_tpu and not os.environ.get("BENCH_SKIP_BIG"):
