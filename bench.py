@@ -56,6 +56,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 SYNC_EVERY = 128  # AsyncDiLoCo window (inner steps per cross-group sync)
+_T0 = time.monotonic()  # process start, for supervisor-budget guards
 
 
 def _model_setup(size: str = None):
@@ -273,20 +274,25 @@ def _bench_big(lighthouse) -> dict:
         )(*tx.update(g, o, p)),
         donate_argnums=(0, 1),
     )
-    opt_state = tx.init(params)
-    for _ in range(2):
-        loss, grads = grad_fn(params, batch)
-        params, opt_state = apply_jit(params, opt_state, grads)
-    _barrier(params)
-    raw_steps = 8
-    t0 = time.perf_counter()
-    for _ in range(raw_steps):
-        loss, grads = grad_fn(params, batch)
-        params, opt_state = apply_jit(params, opt_state, grads)
-    _barrier(params)
-    step_s = (time.perf_counter() - t0) / raw_steps
-    raw_sps = 1.0 / step_s
-    del params, opt_state
+    del params
+
+    def time_raw_big(warm: int) -> float:
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt_state = tx.init(params)
+        for _ in range(warm):
+            loss, grads = grad_fn(params, batch)
+            params, opt_state = apply_jit(params, opt_state, grads)
+        _barrier(params)
+        raw_steps = 8
+        t0 = time.perf_counter()
+        for _ in range(raw_steps):
+            loss, grads = grad_fn(params, batch)
+            params, opt_state = apply_jit(params, opt_state, grads)
+        _barrier(params)
+        return raw_steps / (time.perf_counter() - t0)
+
+    raw_sps = time_raw_big(2)
+    step_s = 1.0 / raw_sps
 
     # Window sizing: sync ships n_params bf16 bytes each way; size H so
     # the sync is a small fraction of window compute (capped to keep the
@@ -297,7 +303,7 @@ def _bench_big(lighthouse) -> dict:
     sync_every = int(min(max(12 * sync_s_est / step_s, 64), 1536))
 
     os.environ["BENCH_MODEL"] = "big"
-    windows = 1
+    windows = 2  # best-of, matching the headline phase
     peer_proc = manager = collectives = None
     try:
         wire = os.environ.get("BENCH_WIRE") or ("bf16" if d2h_MBps >= 100 else "int8")
@@ -326,7 +332,7 @@ def _bench_big(lighthouse) -> dict:
         manager._user_state_dict = diloco.state_dict
 
         # Short warmup: compile the inner step, then force ONE early
-        # boundary sync (the peer's first of two rounds) instead of
+        # boundary sync (the peer's first of windows+1 rounds) instead of
         # crawling a full window to the boundary (see main()'s note).
         # Must stay BELOW sync_every (floor-clamped to 64): hitting the
         # auto-sync in the warm loop would spend a peer round and
@@ -339,17 +345,39 @@ def _bench_big(lighthouse) -> dict:
         diloco.sync()
         diloco.flush()
         _barrier(state.params)
-        t0 = time.perf_counter()
-        for i in range(sync_every * windows):
-            loss, grads = grad_fn(state.params, batch)
-            diloco.step(grads)
-            if i % 512 == 511:
-                np.asarray(loss)  # real drain (see _barrier note)
-        diloco.flush()
-        _barrier(state.params)
-        ft_sps = (sync_every * windows) / (time.perf_counter() - t0)
+        # Best-of-N windows, same noise treatment as the headline phase:
+        # a single tunnel stall must not masquerade as framework cost.
+        window_sps = []
+        skipped = False
+        for w in range(windows):
+            if w > 0 and time.monotonic() - _T0 > 800:
+                skipped = True
+                # The supervisor kills the run at BENCH_ATTEMPT_TIMEOUT_S
+                # (default 1200); a second window on a badly degraded
+                # tunnel could push past it and lose this whole section.
+                _mark(f"big: skipping window {w} (time budget)")
+                break
+            _mark(f"big: timed window {w} (sync_every={sync_every})")
+            t0 = time.perf_counter()
+            for i in range(sync_every):
+                loss, grads = grad_fn(state.params, batch)
+                diloco.step(grads)
+                if i % 512 == 511:
+                    np.asarray(loss)  # real drain (see _barrier note)
+            diloco.flush()
+            _barrier(state.params)
+            window_sps.append(sync_every / (time.perf_counter() - t0))
+            _mark(f"big: window {w} done ({window_sps[-1]:.2f} steps/s)")
+        ft_sps = max(window_sps)
+        if time.monotonic() - _T0 < 900:
+            # symmetric noise treatment (same rule as the headline phase)
+            _mark("big: raw re-measure")
+            raw_sps = max(raw_sps, time_raw_big(1))
         assert collectives.size() == 2, "big-bench peer did not join the ring"
-        peer_proc.wait(timeout=600)
+        if not skipped:
+            peer_proc.wait(timeout=600)
+        # else: the peer still expects the skipped window's sync round;
+        # the finally below kills it rather than deadlocking here
     finally:
         # main() swallows exceptions from this phase; never leak the peer
         # process, the op thread, the manager server, or the env override.
@@ -366,6 +394,7 @@ def _bench_big(lighthouse) -> dict:
         "raw_steps_per_sec": round(raw_sps, 3),
         "raw_tflops": round(6 * n_params * batch.size * raw_sps / 1e12, 1),
         "ft_diloco_steps_per_sec": round(ft_sps, 3),
+        "window_steps_per_sec": [round(s, 3) for s in window_sps],
         "ratio_vs_raw": round(ft_sps / raw_sps, 3),
         "sync_every": sync_every,
         "window_capped": bool(sync_every >= 1536),
@@ -616,8 +645,8 @@ def main() -> None:
     # wedge the session (observed reproducibly at 6k+ queued steps).
     _mark("diloco: warm inner steps")
     # min() guard: warm steps must stay below sync_every or diloco.step
-    # auto-syncs here, consuming the peer's first of two rounds (same
-    # guard as _bench_big, whose floor is lower)
+    # auto-syncs here, consuming the peer's first of windows+1 rounds
+    # (same guard as _bench_big, whose floor is lower)
     for i in range(min(65, sync_every - 1)):
         loss, grads = grad_fn(state.params, batch)
         diloco.step(grads)
@@ -625,7 +654,7 @@ def main() -> None:
             np.asarray(loss)  # real drain: block_until_ready returns
             # before remote execution finishes on this tunnel (_barrier)
     _mark("diloco: warm sync")
-    diloco.sync()  # early warm sync = the peer's first of two rounds
+    diloco.sync()  # early warm sync = the peer's first of windows+1 rounds
     _mark("diloco: warm sync launched")
     if overlap:
         diloco.flush()  # pull the warm sync out of the timed region
@@ -699,6 +728,10 @@ def main() -> None:
     raw_sps = max(raw_sps, raw_again)
     detail["raw"]["best"] = round(raw_sps, 3)
     detail["ft_diloco"]["ratio_vs_raw"] = round(ft_sps / raw_sps, 3)
+    if "steps_per_sec" in detail.get("ft_ddp", {}):
+        detail["ft_ddp"]["ratio_vs_raw"] = round(
+            detail["ft_ddp"]["steps_per_sec"] / raw_sps, 3
+        )
     land_headline()
 
     # -- big: FT overhead at MXU-saturating arithmetic intensity --
