@@ -30,6 +30,7 @@ from torchft_tpu import (
     Lighthouse,
     Manager,
     OptimizerWrapper,
+    PipelinedDDP,
     Store,
 )
 
@@ -82,6 +83,7 @@ def _loss_fn(params, x, y):
 
 
 _grad_fn = jax.jit(jax.grad(_loss_fn))
+_value_and_grad_fn = jax.jit(jax.value_and_grad(_loss_fn))
 
 
 def _batch(step: int):
@@ -119,6 +121,11 @@ class Runner:
     gate_step: Optional[int] = None
     gate_event: Optional[threading.Event] = None
     announce_restart: Optional[threading.Event] = None
+    # None = blocking OptimizerWrapper loop; "plain"/"bf16" = PipelinedDDP
+    # (step i's ring overlapped with step i+1's grads; see
+    # torchft_tpu/ddp.py). The pipelined loop settles one step late, so
+    # its exit overshoots num_steps by exactly one committed step.
+    pipelined: Optional[str] = None
 
     def run_replica(self) -> List[Dict[str, Any]]:
         for attempt in range(self.attempts):
@@ -188,18 +195,10 @@ class Runner:
         if attempt > 0 and rank == 0 and self.announce_restart is not None:
             self.announce_restart.set()
         try:
-            while manager.current_step() < self.num_steps:
-                if (
-                    self.gate_event is not None
-                    and manager.current_step() == self.gate_step
-                ):
-                    assert self.gate_event.wait(timeout=180)
-                self.failure_injector.check(rank, manager.current_step())
-                optimizer.zero_grad()  # start_quorum
-                x, y = _batch(manager.current_step())
-                grads = _grad_fn(state.params, x, y)
-                avg_grads = manager.allreduce(grads).wait()
-                optimizer.step(avg_grads)
+            if self.pipelined is not None:
+                self._pipelined_loop(rank, manager, state)
+            else:
+                self._blocking_loop(rank, manager, state, optimizer)
             return {
                 "replica_id": self.replica_id,
                 "rank": rank,
@@ -213,6 +212,44 @@ class Runner:
             manager.shutdown()
             collectives.shutdown()
 
+    def _blocking_loop(self, rank, manager, state, optimizer) -> None:
+        while manager.current_step() < self.num_steps:
+            if (
+                self.gate_event is not None
+                and manager.current_step() == self.gate_step
+            ):
+                assert self.gate_event.wait(timeout=180)
+            self.failure_injector.check(rank, manager.current_step())
+            optimizer.zero_grad()  # start_quorum
+            x, y = _batch(manager.current_step())
+            grads = _grad_fn(state.params, x, y)
+            avg_grads = manager.allreduce(grads).wait()
+            optimizer.step(avg_grads)
+
+    def _pipelined_loop(self, rank, manager, state) -> None:
+        ddp = PipelinedDDP(
+            manager,
+            state,
+            lambda p, x, y: _value_and_grad_fn(p, x, y),
+            compress=None if self.pipelined == "plain" else self.pipelined,
+        )
+        # Local dispatch counter, not manager.current_step(): the settle
+        # runs one iteration behind, and a non-committed batch is consumed
+        # rather than replayed (the reference's sampler is lossy under
+        # faults too, reference data.py:33-36). Batch choice only affects
+        # this group's contribution — the averaged update every group
+        # applies is shared, so the bitwise oracle is unaffected.
+        i = 0
+        while manager.current_step() < self.num_steps:
+            self.failure_injector.check(rank, manager.current_step())
+            x, y = _batch(i)
+            i += 1
+            ddp.step(x, y)
+        # Every group exits its loop at the same settle (the shared ring
+        # paces iterations), each holding one in-flight step; flushing
+        # commits it jointly, overshooting num_steps by one everywhere.
+        ddp.flush()
+
 
 def _run_replicas(
     num_replicas: int,
@@ -222,6 +259,7 @@ def _run_replicas(
     min_replicas_lighthouse: int = 1,
     gates: Optional[Dict[int, Dict[str, Any]]] = None,
     world_size: int = 1,
+    pipelined: Optional[str] = None,
 ) -> List[Dict[str, Any]]:
     """Runs ``num_replicas`` groups of ``world_size`` ranks; returns the flat
     list of per-rank results (group-major order)."""
@@ -242,13 +280,16 @@ def _run_replicas(
             futures = [
                 ex.submit(
                     Runner(
-                        replica_id=i,
-                        lighthouse_address=lighthouse.address(),
-                        failure_injector=injectors[i],
-                        num_steps=num_steps,
-                        use_async_quorum=use_async_quorum,
-                        world_size=world_size,
-                        **(gates or {}).get(i, {}),
+                        **{
+                            "replica_id": i,
+                            "lighthouse_address": lighthouse.address(),
+                            "failure_injector": injectors[i],
+                            "num_steps": num_steps,
+                            "use_async_quorum": use_async_quorum,
+                            "world_size": world_size,
+                            "pipelined": pipelined,
+                            **(gates or {}).get(i, {}),
+                        }
                     ).run_replica
                 )
                 for i in range(num_replicas)
@@ -373,6 +414,55 @@ class TestManagerInteg:
         assert len(results) == 4
         for r in results:
             assert r["manager_state"]["step"] == 6
+        _assert_bitwise_identical(results)
+
+    def test_pipelined_happy_path(self):
+        # PipelinedDDP: step i's ring overlaps step i+1's gradient program.
+        # The settle runs one step behind, so both groups exit the loop
+        # holding one in-flight step and flush() commits it jointly.
+        results = _run_replicas(num_replicas=2, num_steps=5, pipelined="plain")
+        for r in results:
+            assert r["manager_state"]["step"] == 6  # 5 + the flushed step
+        _assert_bitwise_identical(results)
+
+    def test_pipelined_bf16_compress(self):
+        # bf16 wire compression (the torch-DDP bf16_compress_hook analog):
+        # both members compress identically, so the averaged update is
+        # still bit-identical across groups.
+        results = _run_replicas(num_replicas=2, num_steps=4, pipelined="bf16")
+        _assert_bitwise_identical(results)
+
+    def test_pipelined_recovery(self):
+        # Group 1 dies at step 2 mid-pipeline (an in-flight ring op is
+        # abandoned), restarts, heals; the heal path recomputes the
+        # pre-dispatched gradients from the recovered weights
+        # (PipelinedDDP.step's is_healing branch).
+        injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
+        results = _run_replicas(
+            num_replicas=2, num_steps=6, injectors=injectors,
+            pipelined="plain",
+        )
+        assert injectors[1].count == 1
+        steps = {r["manager_state"]["step"] for r in results}
+        assert len(steps) == 1 and steps.pop() >= 6
+        _assert_bitwise_identical(results)
+        healed = next(r for r in results if r["replica_id"] == 1)
+        assert healed["metrics"]["counters"]["heals"] >= 1
+
+    def test_pipelined_mixed_with_blocking(self):
+        # Protocol interop: a pipelined group and a blocking group share a
+        # cohort. The pipelined member runs one fewer loop step (its flush
+        # settles the last) so both dispatch exactly 5 ring ops and end at
+        # step 5 — and since every group applies the same averaged update,
+        # states match bit-for-bit even though the pipelined member
+        # contributes one-step-stale gradients.
+        results = _run_replicas(
+            num_replicas=2,
+            num_steps=5,
+            gates={1: {"pipelined": "plain", "num_steps": 4}},
+        )
+        for r in results:
+            assert r["manager_state"]["step"] == 5
         _assert_bitwise_identical(results)
 
     def test_quorum_timeout_fast_fail(self):

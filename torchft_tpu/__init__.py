@@ -24,7 +24,7 @@ from torchft_tpu.collectives import (
 )
 from torchft_tpu.data import DistributedSampler, StatefulDataLoader
 from torchft_tpu.durable import DurableCheckpointer
-from torchft_tpu.ddp import DistributedDataParallel
+from torchft_tpu.ddp import DistributedDataParallel, PipelinedDDP
 from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
@@ -52,6 +52,7 @@ __all__ = [
     "ManagerClient",
     "Optimizer",
     "OptimizerWrapper",
+    "PipelinedDDP",
     "Profiler",
     "QuorumResult",
     "pipeline_blocks",

@@ -14,10 +14,11 @@ process_group.py:1067-1341).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 from .collectives import Work
 from .manager import Manager
+from .train_state import FTTrainState
 
 
 class DistributedDataParallel:
@@ -54,3 +55,121 @@ class DistributedDataParallel:
             return value, self.allreduce_grads(grads).wait()
 
         return wrapped
+
+
+class PipelinedDDP:
+    """Per-step DDP with the cross-group ring overlapped with compute.
+
+    The reference hides its allreduce behind backward via bucket hooks
+    (reference ddp.py:47-71): bucket ``b``'s ring pass overlaps computing
+    bucket ``b+1``'s gradients. JAX materializes the whole gradient pytree
+    from one jitted program, so the equivalent overlap is across the *step*
+    boundary instead: step ``i``'s ring pass runs while the device computes
+    step ``i+1``'s forward/backward (a one-step-stale gradient schedule,
+    the standard pipelined-SGD delay-1 discipline). Device dispatch is
+    async, so the host thread that would otherwise idle in ``wait()``
+    instead settles the previous step's transaction.
+
+    Per call, the full manager transaction still runs for every step —
+    quorum, managed allreduce, AND-vote commit — just one iteration behind
+    the compute. Recovery is handled: when a heal lands at the commit safe
+    point, the already-dispatched gradients were computed from pre-heal
+    weights, so they are recomputed from the recovered state before being
+    contributed (a fresh restart otherwise pollutes the cohort average
+    with init-weight gradients).
+
+    ``compress="bf16"`` casts float32 gradients to bfloat16 for the wire
+    (half the cross-group bytes; ring hops accumulate in f32) and restores
+    the original dtypes on return — the JAX analog of torch DDP's
+    ``bf16_compress_hook``.
+
+    Usage::
+
+        ddp = PipelinedDDP(manager, state, grad_fn)  # grad_fn: (params, batch) -> (loss, grads)
+        for batch in batches:
+            loss = ddp.step(batch)
+        ddp.flush()      # settle the final in-flight step
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        state: FTTrainState,
+        grad_fn: Callable[..., Tuple[Any, Any]],
+        compress: Optional[str] = None,
+    ) -> None:
+        if compress not in (None, "bf16"):
+            raise ValueError(f"unsupported compress: {compress!r}")
+        self._manager = manager
+        self._state = state
+        self._grad_fn = grad_fn
+        self._compress_mode = compress
+        self._inflight: Optional[Work] = None
+        self._compress_jit: Optional[Any] = None
+        self._decompress_jit: Optional[Any] = None
+
+    def _compress(self, grads: Any) -> Any:
+        if self._compress_mode is None:
+            return grads
+        import jax
+        import jax.numpy as jnp
+
+        if self._compress_jit is None:
+            dtypes = jax.tree_util.tree_map(lambda l: l.dtype, grads)
+
+            def down(t: Any) -> Any:
+                return jax.tree_util.tree_map(
+                    lambda l: l.astype(jnp.bfloat16)
+                    if l.dtype == jnp.float32
+                    else l,
+                    t,
+                )
+
+            def up(t: Any) -> Any:
+                return jax.tree_util.tree_map(
+                    lambda l, dt: l.astype(dt), t, dtypes
+                )
+
+            self._compress_jit = jax.jit(down)
+            self._decompress_jit = jax.jit(up)
+        return self._compress_jit(grads)
+
+    def _decompress(self, avg: Any) -> Any:
+        if self._compress_mode is None:
+            return avg
+        return self._decompress_jit(avg)
+
+    def _settle(self) -> bool:
+        """Waits the in-flight ring pass, votes, applies on commit."""
+        assert self._inflight is not None
+        avg = self._inflight.wait()
+        self._inflight = None
+        committed = self._manager.should_commit()
+        if committed:
+            self._state.apply_gradients(self._decompress(avg))
+        return committed
+
+    def step(self, *batch: Any) -> Any:
+        """One pipelined step: dispatches this batch's gradient program,
+        settles the PREVIOUS step's transaction while the device computes,
+        then contributes these gradients to a newly-started quorum. Returns
+        the loss (a device value; don't block on it in the hot loop)."""
+        loss, grads = self._grad_fn(self._state.params, *batch)
+        if self._inflight is not None:
+            healed = self._manager.is_healing()
+            self._settle()
+            if healed:
+                # The dispatched grads came from pre-heal weights; recompute
+                # from the recovered (and just-updated) state.
+                loss, grads = self._grad_fn(self._state.params, *batch)
+        self._manager.start_quorum()
+        self._inflight = self._manager.allreduce(self._compress(grads))
+        return loss
+
+    def flush(self) -> bool:
+        """Settles the final in-flight step; returns whether it committed.
+        Call once after the loop (and before reading ``state`` as the
+        final model)."""
+        if self._inflight is None:
+            return False
+        return self._settle()

@@ -399,8 +399,10 @@ class Manager:
         """Fault-tolerantly averages a gradient pytree across replica groups.
 
         Data-plane errors never raise: on a collective failure the returned
-        Work resolves to the INPUT tree and the error is latched for
-        ``should_commit`` (reference manager.py:242-303). A failed or
+        Work resolves to the tree AS CONTRIBUTED — the input tree for a
+        participating replica, the zeroed tree for a healing/spare one
+        (zero-contribution holds even on the fallback) — and the error is
+        latched for ``should_commit`` (reference manager.py:242-303). A failed or
         timed-out QUORUM, however, DOES raise out of this call (via
         ``wait_quorum``) — membership failure means the step cannot proceed
         at all, matching reference manager.py:265. Non-participating
@@ -427,7 +429,7 @@ class Manager:
                 zeroed_tree, ReduceOp.SUM, divisor=divisor
             )
 
-        return self._managed_dispatch("allreduce", tree, dispatch, tree)
+        return self._managed_dispatch("allreduce", tree, dispatch, lambda t: t)
 
     def allgather(self, tree: Any) -> Work:
         """Fault-tolerantly gathers ``tree`` from every cohort member.
@@ -446,7 +448,7 @@ class Manager:
         process_group.py:130-137).
         """
         return self._managed_dispatch(
-            "allgather", tree, self._collectives.allgather, [tree]
+            "allgather", tree, self._collectives.allgather, lambda t: [t]
         )
 
     def _managed_dispatch(
@@ -454,14 +456,17 @@ class Manager:
         op_name: str,
         tree: Any,
         dispatch: Callable[[Any], Work],
-        default: Any,
+        default_factory: Callable[[Any], Any],
     ) -> Work:
         """The shared managed-collective discipline: errored short-circuit,
         quorum join, participant zeroing, profiler span + metrics timer,
-        timeout + error-latching wrap; immediate failures latch and
-        resolve to ``default`` (reference manager.py:242-303, 326-363)."""
+        timeout + error-latching wrap; failures latch and resolve to
+        ``default_factory`` applied to the tree AS DISPATCHED — for a
+        non-participating (healing/spare) replica that is the zeroed tree,
+        preserving the zero-contribution discipline even on the error
+        fallback (reference manager.py:242-303, 326-363)."""
         if self.errored() is not None:
-            return _completed(default)
+            return _completed(default_factory(tree))
         self.wait_quorum()
         try:
             import jax
@@ -478,11 +483,11 @@ class Manager:
                     op_name, time.perf_counter() - t0
                 )
             )
-            return self.wrap_work(work, default=default)
+            return self.wrap_work(work, default=default_factory(tree))
         except Exception as e:  # noqa: BLE001 - latch, never raise
             self._logger.exception(f"{op_name} failed immediately: {e}")
             self.report_error(e)
-            return _completed(default)
+            return _completed(default_factory(tree))
 
     def wrap_work(self, work: Work, default: Any, timeout: Optional[timedelta] = None) -> Work:
         """Adds a timeout and error-swallowing to a Work: on failure the
@@ -655,6 +660,17 @@ class Manager:
             assert self._use_async_quorum
             return False
         return True
+
+    def is_healing(self) -> bool:
+        """True while this step is recovering state from a peer (the fetched
+        checkpoint is applied at the ``should_commit`` safe point). Pipelined
+        wrappers read this BEFORE voting to know that gradients dispatched
+        earlier in the step were computed from pre-heal weights and must be
+        recomputed (torchft_tpu.ddp.PipelinedDDP). Settles the quorum thread
+        first — it is the writer."""
+        assert self._quorum_future is not None, "quorum not started"
+        self.wait_quorum()
+        return self._healing
 
 
 class _ManagerLogger:
