@@ -10,10 +10,13 @@ Configurations measured (details in BENCH_DETAIL.json):
 
   raw         jitted loss/grad/apply loop, no FT machinery.
   ft_ddp      per-step gradient allreduce through the ring (the reference
-              train_ddp mode). Run only where the device<->host link is
-              production-grade (>=100 MB/s d2h); on a degraded tunnel it is
-              skipped with the measured link speed recorded, because
-              per-step shipping is link-bound regardless of framework.
+              train_ddp mode), measured at representative arithmetic
+              intensity against a same-batch raw baseline; both the
+              blocking loop and PipelinedDDP (ring overlapped with the
+              next step's grads) are recorded. On a degraded device<->host
+              link it is skipped (per-step shipping is link-bound
+              regardless of framework) unless BENCH_FORCE_DDP=1, which
+              records the link-bound pipelined+bf16 number explicitly.
   ft_diloco   AsyncDiLoCo — the bandwidth-appropriate cross-group mode this
               framework ships for DCN-class links: inner steps stay on-chip
               and the compressed pseudogradient sync runs once per window
@@ -57,6 +60,14 @@ sys.path.insert(0, REPO)
 
 SYNC_EVERY = 128  # AsyncDiLoCo window (inner steps per cross-group sync)
 _T0 = time.monotonic()  # process start, for supervisor-budget guards
+
+
+def _env_wire():
+    """BENCH_WIRE as a compress dtype; the special value "ddp" is a
+    force-DDP trigger, not a wire dtype, and must not leak into the
+    diloco phases' compress selection."""
+    w = os.environ.get("BENCH_WIRE")
+    return None if w == "ddp" else w
 
 
 def _model_setup(size: str = None):
@@ -132,6 +143,25 @@ def _barrier(tree) -> None:
     jax.block_until_ready(tree)
     leaf = jax.tree_util.tree_leaves(tree)[0]
     np.asarray(leaf.ravel()[0:1])
+
+
+def _time_raw_loop(grad_fn, apply_fn, init_fn, tx, batch, warm: int, n: int) -> float:
+    """The one warm+timed raw-loop discipline every phase shares (fresh
+    state per call; _barrier drains before both clock edges). Keeping a
+    single copy means a change to the timing/drain semantics cannot make
+    phases silently measure differently."""
+    params = init_fn()
+    opt_state = tx.init(params)
+    for _ in range(warm):
+        loss, grads = grad_fn(params, batch)
+        params, opt_state = apply_fn(params, opt_state, grads)
+    _barrier(params)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss, grads = grad_fn(params, batch)
+        params, opt_state = apply_fn(params, opt_state, grads)
+    _barrier(params)
+    return n / (time.perf_counter() - t0)
 
 
 def peer() -> None:
@@ -248,7 +278,7 @@ def _spawn_peer(lighthouse_addr: str, rounds: int, dtype: str) -> subprocess.Pop
     return proc
 
 
-def _bench_big(lighthouse) -> dict:
+def _bench_big() -> dict:
     """Raw vs AsyncDiLoCo throughput on the MXU-saturating config, with the
     window sized so the (bf16, pipelined) sync can hide behind compute —
     the deployment-tuning rule DiLoCo practice prescribes (H in the
@@ -261,37 +291,67 @@ def _bench_big(lighthouse) -> dict:
     from torchft_tpu import AsyncDiLoCo, FTTrainState, HostCollectives, Manager
     from torchft_tpu.models import init_params, loss_fn
 
+    import dataclasses
+
     cfg, batch, _ = _model_setup("big")
     tx = optax.adamw(1e-3)
-    grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
 
-    # raw
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
-    apply_jit = jax.jit(
-        lambda p, o, g: (
+    # Attention-path selection is MEASURED per run, not assumed: time a
+    # short raw loop with XLA dense attention and with the pallas flash
+    # kernel (v5e-tuned tiles, ops/flash_attention.py), run the FT phase
+    # on the winner, and record both timings (the round-2 verdict's ask).
+    n_params = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(
+            init_params(cfg, jax.random.PRNGKey(0))
+        )
+    )
+
+    _fns_cache: dict = {}
+    # The optimizer apply doesn't depend on the attention config: ONE
+    # executable serves every variant (a per-config copy would recompile
+    # the 110M-param adamw program per candidate on the tunneled runtime).
+    _apply_jit = jax.jit(
+        lambda p, o, gr: (
             lambda u, no: (optax.apply_updates(p, u), no)
-        )(*tx.update(g, o, p)),
+        )(*tx.update(gr, o, p)),
         donate_argnums=(0, 1),
     )
-    del params
+
+    def make_step_fns(c):
+        # Memoized per config: a fresh jit wrapper would retrace+recompile
+        # the big model (minutes on the tunneled runtime) on every timing
+        # helper call, burning the phase's time budget.
+        if c not in _fns_cache:
+            _fns_cache[c] = (
+                jax.jit(jax.value_and_grad(lambda p, b: loss_fn(c, p, b))),
+                _apply_jit,
+            )
+        return _fns_cache[c]
+
+    def time_raw_variant(c, warm: int, raw_steps: int = 8) -> float:
+        g, a = make_step_fns(c)
+        return _time_raw_loop(
+            g, a, lambda: init_params(c, jax.random.PRNGKey(0)), tx, batch,
+            warm, raw_steps,
+        )
+
+    _mark("big: attention-path selection (dense vs flash)")
+    dense_cfg = dataclasses.replace(cfg, use_flash=False)
+    flash_cfg = dataclasses.replace(cfg, use_flash=True)
+    dense_sps = time_raw_variant(dense_cfg, 2)
+    flash_sps = time_raw_variant(flash_cfg, 2)
+    cfg = flash_cfg if flash_sps >= dense_sps else dense_cfg
+    _mark(
+        f"big: dense {dense_sps:.2f} vs flash {flash_sps:.2f} steps/s -> "
+        f"{'flash' if cfg.use_flash else 'dense'}"
+    )
+    grad_fn, apply_jit = make_step_fns(cfg)
 
     def time_raw_big(warm: int) -> float:
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        opt_state = tx.init(params)
-        for _ in range(warm):
-            loss, grads = grad_fn(params, batch)
-            params, opt_state = apply_jit(params, opt_state, grads)
-        _barrier(params)
-        raw_steps = 8
-        t0 = time.perf_counter()
-        for _ in range(raw_steps):
-            loss, grads = grad_fn(params, batch)
-            params, opt_state = apply_jit(params, opt_state, grads)
-        _barrier(params)
-        return raw_steps / (time.perf_counter() - t0)
+        return time_raw_variant(cfg, warm)
 
-    raw_sps = time_raw_big(2)
+    raw_sps = max(dense_sps, flash_sps)
     step_s = 1.0 / raw_sps
 
     # Window sizing: sync ships n_params bf16 bytes each way; size H so
@@ -304,9 +364,10 @@ def _bench_big(lighthouse) -> dict:
 
     os.environ["BENCH_MODEL"] = "big"
     windows = 2  # best-of, matching the headline phase
-    peer_proc = manager = collectives = None
+    lighthouse = peer_proc = manager = collectives = None
     try:
-        wire = os.environ.get("BENCH_WIRE") or ("bf16" if d2h_MBps >= 100 else "int8")
+        lighthouse = _fresh_lighthouse()  # own instance: no ghost members
+        wire = _env_wire() or ("bf16" if d2h_MBps >= 100 else "int8")
         peer_proc = _spawn_peer(lighthouse.address(), windows + 1, wire)
         state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
         collectives = HostCollectives(timeout=td(seconds=600))
@@ -369,10 +430,12 @@ def _bench_big(lighthouse) -> dict:
             window_sps.append(sync_every / (time.perf_counter() - t0))
             _mark(f"big: window {w} done ({window_sps[-1]:.2f} steps/s)")
         ft_sps = max(window_sps)
+        raw_remeasured = False
         if time.monotonic() - _T0 < 900:
             # symmetric noise treatment (same rule as the headline phase)
             _mark("big: raw re-measure")
             raw_sps = max(raw_sps, time_raw_big(1))
+            raw_remeasured = True
         assert collectives.size() == 2, "big-bench peer did not join the ring"
         if not skipped:
             peer_proc.wait(timeout=600)
@@ -388,21 +451,54 @@ def _bench_big(lighthouse) -> dict:
             manager.shutdown()
         if collectives is not None:
             collectives.shutdown()
+        if lighthouse is not None:
+            lighthouse.shutdown()
+    # Symmetric comparison discipline: FT is best-of-N windows, so the raw
+    # denominator must be best-of-N too. When the time budget skipped the
+    # raw re-measure, compare FIRST window vs the single raw sample
+    # (best-of-1 vs best-of-1) instead of biasing the ratio FT-ward.
+    ft_for_ratio = ft_sps if raw_remeasured else window_sps[0]
     return {
         "params_M": round(n_params / 1e6, 1),
         "tflop_per_step": round(6 * n_params * batch.size / 1e12, 2),
+        "attention": "flash" if cfg.use_flash else "dense",
+        "attention_raw_steps_per_sec": {
+            "dense": round(dense_sps, 3),
+            "flash": round(flash_sps, 3),
+        },
         "raw_steps_per_sec": round(raw_sps, 3),
         "raw_tflops": round(6 * n_params * batch.size * raw_sps / 1e12, 1),
         "ft_diloco_steps_per_sec": round(ft_sps, 3),
         "window_steps_per_sec": [round(s, 3) for s in window_sps],
-        "ratio_vs_raw": round(ft_sps / raw_sps, 3),
+        "ratio_vs_raw": round(ft_for_ratio / raw_sps, 3),
+        "ratio_symmetric": raw_remeasured,
         "sync_every": sync_every,
         "window_capped": bool(sync_every >= 1536),
-        "note": "MXU-saturating config (dense attention, no remat — the "
-        "measured-fastest combination at this shape); window sized so the "
-        "bf16 sync stays a small fraction of compute, capped at 1536 to "
-        "bound bench time",
+        "note": "MXU-saturating config; attention path chosen by "
+        "measurement this run (both timings recorded); window sized so "
+        "the sync stays a small fraction of compute, capped at 1536 to "
+        "bound bench time"
+        + (
+            ""
+            if raw_remeasured
+            else "; raw re-measure skipped (time budget) so the ratio "
+            "compares first-window FT vs the single raw sample"
+        ),
     }
+
+
+def _fresh_lighthouse():
+    """One lighthouse PER bench phase. Phases reusing a lighthouse within
+    the heartbeat window (~5 s) of the previous phase's members see their
+    ghost heartbeats; the new step-0 manager can then elect a dead ghost
+    as its recovery primary and wedge healing from it until timeout
+    (observed on this harness; the ghost stays a quorum participant until
+    its heartbeat ages out)."""
+    from torchft_tpu import Lighthouse
+
+    return Lighthouse(
+        bind="[::]:0", min_replicas=1, join_timeout_ms=5000, quorum_tick_ms=50
+    )
 
 
 def _measure_d2h_MBps() -> float:
@@ -445,7 +541,6 @@ def main() -> None:
         AsyncDiLoCo,
         FTTrainState,
         HostCollectives,
-        Lighthouse,
         Manager,
         OptimizerWrapper,
     )
@@ -468,18 +563,11 @@ def main() -> None:
 
     # -- raw loop --
     def time_raw(warm: int) -> float:
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        opt_state = tx.init(params)
-        for _ in range(warm):
-            loss, grads = grad_fn(params, batch)
-            params, opt_state = apply_jit(params, opt_state, grads)
-        _barrier(params)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss, grads = grad_fn(params, batch)
-            params, opt_state = apply_jit(params, opt_state, grads)
-        _barrier(params)
-        return steps / (time.perf_counter() - t0)
+        return _time_raw_loop(
+            grad_fn, apply_jit,
+            lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
+            warm, steps,
+        )
 
     _mark("phase: raw (compile + timed loop)")
     raw_sps = time_raw(warmup)
@@ -504,14 +592,18 @@ def main() -> None:
     }
     del probe, host_probe
 
-    lighthouse = Lighthouse(
-        bind="[::]:0", min_replicas=1, join_timeout_ms=5000, quorum_tick_ms=50
-    )
-
     # -- ft_ddp: per-step gradient allreduce over a real 2-group ring --
-    # Only meaningful where the device<->host link is production-grade: a
-    # degraded tunnel makes EVERY per-step-shipping scheme transfer-bound,
-    # so the measurement would characterize the tunnel, not the framework.
+    # The reference's product mode (per-step allreduce hidden behind
+    # backward, reference ddp.py:47-71). Measured at REPRESENTATIVE
+    # arithmetic intensity: the smoke config's 512 tokens/step against a
+    # full gradient ship is a compute:comm balance no DDP deployment has
+    # (measured breakdown on 1 CPU core: grad 546 ms vs ring 127 ms +
+    # unpack 66 ms — fixed ring WORK that neither overlap nor bf16 can
+    # remove on a single core). The DDP phase therefore scales the batch
+    # (4x tokens) and measures its OWN raw baseline at the same config;
+    # blocking and pipelined (PipelinedDDP: step i's ring overlapped with
+    # step i+1's grads — the torch bucket-hook overlap, restructured for
+    # JAX's one-pytree gradients) are both recorded.
     n_params = sum(
         int(np.prod(l.shape))
         for l in jax.tree_util.tree_leaves(init_params(cfg, jax.random.PRNGKey(0)))
@@ -519,59 +611,153 @@ def main() -> None:
     grad_mb = n_params * 4 / 1e6
     d2h_MBps = detail["transfer"]["d2h_MBps"]
     h2d_MBps = detail["transfer"]["h2d_MBps"]
+    force_ddp = os.environ.get("BENCH_FORCE_DDP") == "1" or (
+        os.environ.get("BENCH_WIRE") == "ddp"
+    )
     _mark(f"phase: ft_ddp (d2h={d2h_MBps:.1f} MB/s)")
-    if not on_tpu or d2h_MBps >= 100:
-        ddp_warmup, ddp_steps = 1, 4 if on_tpu else 6
-        peer_proc = _spawn_peer(
-            lighthouse.address(), ddp_warmup + ddp_steps, "f32"
-        )
-        state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
-        collectives = HostCollectives(timeout=timedelta(seconds=1800))
-        manager = Manager(
-            collectives=collectives,
-            load_state_dict=state.load_state_dict,
-            state_dict=state.state_dict,
-            min_replica_size=1,
-            timeout=timedelta(seconds=300),  # first step rides a jit compile
-            quorum_timeout=timedelta(seconds=300),
-            rank=0,
-            world_size=1,
-            lighthouse_addr=lighthouse.address(),
-            replica_id="bench_main",
-        )
-        optimizer = OptimizerWrapper(manager, state)
+    if not on_tpu or d2h_MBps >= 100 or force_ddp:
+        from torchft_tpu import PipelinedDDP
 
-        def ft_step():
-            optimizer.zero_grad()
-            loss, grads = grad_fn(state.params, batch)
-            avg = manager.allreduce(grads).wait()
-            optimizer.step(avg)
+        # TPU with a degraded link under BENCH_FORCE_DDP: fewer steps
+        # (each ships the full gradient through the tunnel) and the
+        # bf16 wire, so the forced artifact stays bounded.
+        degraded = on_tpu and d2h_MBps < 100
+        ddp_batch = batch if on_tpu else jnp.concatenate([batch] * 4, axis=0)
+        # Same shapes on TPU -> reuse the already-compiled programs.
+        ddp_grad_fn = (
+            grad_fn
+            if on_tpu
+            else jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
+        )
+        ddp_apply = (
+            apply_jit
+            if on_tpu
+            else jax.jit(apply_fn_raw, donate_argnums=(0, 1))
+        )
 
-        for _ in range(ddp_warmup):
-            ft_step()
-        _barrier(state.params)
-        t0 = time.perf_counter()
-        for _ in range(ddp_steps):
-            ft_step()
-        _barrier(state.params)
-        ddp_sps = ddp_steps / (time.perf_counter() - t0)
-        # The claim being enforced: a real 2-member ring carried every byte
-        # (no world-size-1 identity shortcut).
-        assert collectives.size() == 2, "peer did not join the ring"
+        def time_ddp_raw(warm: int, n: int) -> float:
+            return _time_raw_loop(
+                ddp_grad_fn, ddp_apply,
+                lambda: init_params(cfg, jax.random.PRNGKey(0)), tx,
+                ddp_batch, warm, n,
+            )
+
+        ddp_steps = 2 if degraded else (4 if on_tpu else 5)
+        ddp_raw_sps = (
+            raw_sps if (on_tpu and not degraded) else time_ddp_raw(1, ddp_steps)
+        )
+
+        def run_ddp(mode: str, wire: str) -> float:
+            # Fresh lighthouse per session (_fresh_lighthouse) and every
+            # resource constructed INSIDE the try: a constructor failure
+            # must not leak a heartbeating "bench_peer" into later phases.
+            lh = peer_proc = manager = collectives = None
+            try:
+                lh = _fresh_lighthouse()
+                peer_proc = _spawn_peer(lh.address(), 1 + ddp_steps, wire)
+                state = FTTrainState(
+                    init_params(cfg, jax.random.PRNGKey(0)), tx
+                )
+                collectives = HostCollectives(timeout=timedelta(seconds=1800))
+                manager = Manager(
+                    collectives=collectives,
+                    load_state_dict=state.load_state_dict,
+                    state_dict=state.state_dict,
+                    min_replica_size=1,
+                    timeout=timedelta(seconds=600),  # 1st step rides a compile
+                    quorum_timeout=timedelta(seconds=600),
+                    rank=0,
+                    world_size=1,
+                    lighthouse_addr=lh.address(),
+                    # sorts before "bench_peer": the step-0 primary is the
+                    # first-sorted id and the peer never serves checkpoints
+                    replica_id=f"bench_main_ddp_{mode}",
+                )
+                if mode == "blocking":
+                    optimizer = OptimizerWrapper(manager, state)
+
+                    def ft_step():
+                        optimizer.zero_grad()
+                        loss, grads = ddp_grad_fn(state.params, ddp_batch)
+                        avg = manager.allreduce(grads).wait()
+                        optimizer.step(avg)
+
+                    ft_step()  # warm (peer round 0)
+                    _barrier(state.params)
+                    t0 = time.perf_counter()
+                    for _ in range(ddp_steps):
+                        ft_step()
+                    _barrier(state.params)
+                    t_end = time.perf_counter()
+                else:
+                    ddp = PipelinedDDP(
+                        manager, state,
+                        lambda p, b: ddp_grad_fn(p, b),
+                        compress="bf16" if wire == "bf16" else None,
+                    )
+                    ddp.step(ddp_batch)  # warm dispatch (peer round 0)
+                    _barrier(state.params)
+                    # Steady-state rate: each timed step settles exactly
+                    # one prior transaction and dispatches one ring (one
+                    # in-flight at entry, one left at exit); the fully-
+                    # exposed flush stays OUTSIDE the window so the
+                    # blocking-vs-pipelined comparison is unbiased.
+                    t0 = time.perf_counter()
+                    for _ in range(ddp_steps):
+                        ddp.step(ddp_batch)
+                    t_end = time.perf_counter()
+                    ddp.flush()
+                    _barrier(state.params)
+                sps = ddp_steps / (t_end - t0)
+                # A real 2-member ring carried every byte (no world-size-1
+                # identity shortcut).
+                assert collectives.size() == 2, "peer did not join the ring"
+                peer_proc.wait(timeout=600)
+            finally:
+                if peer_proc is not None and peer_proc.poll() is None:
+                    peer_proc.kill()
+                if manager is not None:
+                    manager.shutdown()
+                if collectives is not None:
+                    collectives.shutdown()
+                if lh is not None:
+                    lh.shutdown()
+            return sps
+
+        wire = "bf16" if degraded else "f32"
+        # Degraded-link forced mode runs only the pipelined+bf16 variant:
+        # the blocking variant's f32 tree would mismatch the peer's bf16
+        # zeros on the ring, and each extra step ships the full gradient
+        # through the crippled tunnel.
+        ddp_sps = None if degraded else run_ddp("blocking", wire)
+        pipe_sps = run_ddp("pipelined", wire)
+        best = max(s for s in (ddp_sps, pipe_sps) if s is not None)
         detail["ft_ddp"] = {
-            "steps_per_sec": round(ddp_sps, 3),
-            "ratio_vs_raw": round(ddp_sps / raw_sps, 3),
-            "note": "per-step full-gradient shipping",
+            "steps_per_sec": round(best, 3),
+            "ratio_vs_raw": round(best / ddp_raw_sps, 3),
+            "raw_steps_per_sec": round(ddp_raw_sps, 3),
+            "blocking_steps_per_sec": (
+                None if ddp_sps is None else round(ddp_sps, 3)
+            ),
+            "pipelined_steps_per_sec": round(pipe_sps, 3),
+            "wire": wire,
+            "tokens_per_step": int(ddp_batch.size),
+            "note": "per-step full-gradient shipping over a live 2-member "
+            "ring; raw baseline measured at the same batch"
+            + (
+                "; FORCED run on a degraded device<->host link — the "
+                "absolute rate is link-bound, not framework-bound"
+                if degraded
+                else ""
+            ),
         }
-        peer_proc.wait(timeout=120)
-        manager.shutdown()
-        collectives.shutdown()
     else:
         detail["ft_ddp"] = {
             "skipped": f"device<->host link degraded ({d2h_MBps} MB/s d2h); "
             f"per-step shipping of {grad_mb:.0f} MB grads is link-bound "
             f"(>= {grad_mb / d2h_MBps:.0f} s/step floor) regardless of "
-            "framework — use the windowed mode (ft_diloco) on such links",
+            "framework — use the windowed mode (ft_diloco) on such links, "
+            "or set BENCH_FORCE_DDP=1 to record the link-bound number",
         }
 
     # -- ft_diloco: AsyncDiLoCo over the same real ring (headline) --
@@ -607,7 +793,8 @@ def main() -> None:
     # being measured there, and int8 ships 4x fewer bytes than f32 (2x
     # fewer than bf16); healthy links keep bf16 (sync hides behind
     # compute anyway, and allgather traffic grows with cohort size).
-    wire = os.environ.get("BENCH_WIRE") or ("bf16" if overlap else "int8")
+    wire = _env_wire() or ("bf16" if overlap else "int8")
+    lighthouse = _fresh_lighthouse()  # own instance: no ghost members
     peer_proc = _spawn_peer(lighthouse.address(), diloco_windows + 1, wire)
     state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
     collectives = HostCollectives(timeout=timedelta(seconds=1800))
@@ -690,6 +877,7 @@ def main() -> None:
     peer_proc.wait(timeout=300)
     manager.shutdown()
     collectives.shutdown()
+    lighthouse.shutdown()
 
     # Headline line + detail land BEFORE any further device phases (the
     # raw re-measure, the big model) so a tunnel wedge there can never
@@ -728,21 +916,18 @@ def main() -> None:
     raw_sps = max(raw_sps, raw_again)
     detail["raw"]["best"] = round(raw_sps, 3)
     detail["ft_diloco"]["ratio_vs_raw"] = round(ft_sps / raw_sps, 3)
-    if "steps_per_sec" in detail.get("ft_ddp", {}):
-        detail["ft_ddp"]["ratio_vs_raw"] = round(
-            detail["ft_ddp"]["steps_per_sec"] / raw_sps, 3
-        )
+    # (ft_ddp's ratio is against its OWN same-batch raw baseline and is
+    # not rewritten here.)
     land_headline()
 
     # -- big: FT overhead at MXU-saturating arithmetic intensity --
     if on_tpu and not os.environ.get("BENCH_SKIP_BIG"):
         try:
-            detail["big"] = _bench_big(lighthouse)
+            detail["big"] = _bench_big()
         except Exception as e:  # noqa: BLE001 - best effort, keep headline
             detail["big"] = {"error": f"{type(e).__name__}: {e}"}
         with open(os.path.join(REPO, detail_name), "w") as f:
             json.dump(detail, f, indent=2)
-    lighthouse.shutdown()
 
 
 def _supervised() -> None:
