@@ -179,6 +179,8 @@ def _ulysses_local(
     seq_axis: str,
     causal: bool,
     use_flash: bool,
+    block_q=None,
+    block_k=None,
 ) -> jax.Array:
     """Device-local body: all_to_all seq->heads, full-seq attention on my
     head subset, all_to_all heads->seq."""
@@ -194,7 +196,9 @@ def _ulysses_local(
     if use_flash:
         from .ops import flash_attention
 
-        out = flash_attention(qg, kg, vg, causal=causal)
+        out = flash_attention(
+            qg, kg, vg, causal=causal, block_q=block_q, block_k=block_k
+        )
     else:
         B, S, Hl, Dh = qg.shape
         scale = Dh ** -0.5
@@ -225,6 +229,8 @@ def ulysses_attention(
     head_axis: Optional[str] = None,
     causal: bool = True,
     use_flash: bool = True,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-sharded causal self-attention via head/sequence
     all-to-alls (DeepSpeed-Ulysses).
@@ -235,6 +241,8 @@ def ulysses_attention(
             ``head_axis`` also splits heads) divisible by it too.
         use_flash: run the per-device full-sequence attention through the
             fused pallas kernel (default) instead of dense jnp.
+        block_q, block_k: flash-kernel tile overrides, forwarded to
+            ops.flash_attention (None = its measured auto sizes).
     Returns:
         (B, S, H, head_dim), same layout as q.
     """
@@ -258,6 +266,8 @@ def ulysses_attention(
         seq_axis=seq_axis,
         causal=causal,
         use_flash=use_flash,
+        block_q=block_q,
+        block_k=block_k,
     )
     # check_vma=False: the embedded pallas call's out_shape carries no
     # varying-mesh-axes annotation (same caveat as ops.flash_attention)

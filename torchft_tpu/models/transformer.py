@@ -59,6 +59,12 @@ class TransformerConfig:
     # attention runs through the kernel. Ignored by cp_strategy="ring"
     # (that path fuses its own online-softmax loop).
     use_flash: bool = False
+    # Flash-kernel VMEM tile overrides (None = the kernel's v5e-measured
+    # auto sizes, ops/flash_attention.py); in-model winners can differ
+    # from standalone sweeps (fusion/VMEM interactions), so the bench
+    # tunes these against whole-step throughput.
+    flash_block_q: Any = None
+    flash_block_k: Any = None
     # Sliding-window (local) attention width; requires use_flash (the
     # kernel skips out-of-window tiles). None = full causal attention.
     attn_window: Any = None
@@ -277,6 +283,8 @@ def _attention_impl(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> 
                 batch_axis=cfg.cp_batch_axis,
                 head_axis=cfg.cp_head_axis,
                 use_flash=cfg.use_flash,
+                block_q=cfg.flash_block_q,
+                block_k=cfg.flash_block_k,
             ).reshape(B, S, D)
         else:
             out = ring_attention(
@@ -297,6 +305,8 @@ def _attention_impl(cfg: TransformerConfig, p: Dict[str, Any], x: jax.Array) -> 
             batch_axis=cfg.cp_batch_axis if cfg.cp_mesh is not None else None,
             head_axis=cfg.cp_head_axis,
             window=cfg.attn_window,
+            block_q=cfg.flash_block_q,
+            block_k=cfg.flash_block_k,
         ).reshape(B, S, D)
         return out @ p["wo"].astype(cfg.dtype)
 
