@@ -25,9 +25,13 @@ from datetime import timedelta
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from torchft_tpu.platform import apply_jax_platform_env  # noqa: E402
+from torchft_tpu.platform import (  # noqa: E402
+    apply_compilation_cache_env,
+    apply_jax_platform_env,
+)
 
 apply_jax_platform_env()
+apply_compilation_cache_env()  # restarted groups skip the re-jit (heal path)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

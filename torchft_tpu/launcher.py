@@ -43,6 +43,17 @@ def replica_group_spec(
         "TORCHFT_LIGHTHOUSE": lighthouse_addr,
         "REPLICA_GROUP_ID": str(replica_group),
         "NUM_REPLICA_GROUPS": str(num_replica_groups),
+        # Shared persistent jit cache: a RESTARTED group reloads the
+        # executables compiled before it died instead of re-jitting, the
+        # main lever on heal latency (platform.apply_compilation_cache_env;
+        # entry scripts opt in by calling it). Overridable; "0" disables.
+        "TORCHFT_COMPILE_CACHE": os.environ.get(
+            "TORCHFT_COMPILE_CACHE",
+            os.path.join(
+                os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+                "torchft_tpu", "jax_cache",
+            ),
+        ),
         **(env or {}),
     }
     return {
