@@ -169,6 +169,62 @@ class TestXLACollectives:
         for out in outs:
             assert "OK" in out
 
+    def test_reconfigure_state_survival(self):
+        # The automated form of the snapshot-to-host discipline the module
+        # docstring prescribes (xla_collectives.py:19-31): an FTTrainState
+        # registered via register_state() is host-round-tripped across the
+        # distributed-runtime teardown that reconfigure performs, and
+        # training continues from exactly the pre-reconfigure state.
+        outs = _run_workers(
+            """
+            import optax
+            from torchft_tpu import FTTrainState
+
+            state = FTTrainState({"w": jnp.ones((4,)) * 2.0},
+                                 optax.sgd(0.1))
+            xc.register_state(state)
+            xc.configure(store_addr + "/q0", rank, 2)
+
+            def train_step():
+                # rank-dependent grads, shared average: both ranks apply
+                # the same update to the same initial state
+                grads = {"w": state.params["w"] * (0.5 * (rank + 1))}
+                avg = xc.allreduce(grads, ReduceOp.AVG).wait()
+                state.apply_gradients(avg)
+
+            for _ in range(3):
+                train_step()
+            before = np.asarray(state.params["w"]).copy()
+            opt_before = jax.tree_util.tree_map(
+                np.asarray, state.opt_state
+            )
+
+            xc.configure(store_addr + "/q1", rank, 2)  # membership change
+
+            after = np.asarray(state.params["w"])
+            assert np.array_equal(before, after), (before, after)
+            # opt_state survived too (momentum etc. restored bitwise)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(opt_before),
+                jax.tree_util.tree_leaves(
+                    jax.tree_util.tree_map(np.asarray, state.opt_state)
+                ),
+            ):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+
+            for _ in range(2):
+                train_step()  # continues on the new backend
+            final = np.asarray(state.params["w"])
+            assert not np.array_equal(before, final)
+            print("OK", final.tolist())
+            xc.shutdown()
+            """
+        )
+        # Both ranks applied identical averaged updates throughout, so
+        # their trained states agree.
+        finals = [out.splitlines()[-1] for out in outs]
+        assert finals[0] == finals[1], finals
+
     def test_reconfigure_new_membership(self):
         # Quorum change: same cohort re-rendezvous on a new prefix; the
         # runtime is rebuilt and collectives still agree. Pre-reconfigure

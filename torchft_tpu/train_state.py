@@ -76,6 +76,28 @@ class FTTrainState:
         self.params = _to_device_tree(state_dict["params"])
         self.opt_state = _to_device_tree(state_dict["opt_state"])
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Host copy of the full state (numpy leaves, fresh buffers).
+
+        Unlike ``state_dict`` (which aliases live device buffers), the
+        snapshot survives the device backend being torn down — the
+        round-trip ``XLACollectives`` reconfiguration needs: a membership
+        change rebuilds the XLA distributed runtime, orphaning every live
+        jax array (torchft_tpu/xla_collectives.py:19-31)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda l: np.asarray(l).copy() if hasattr(l, "dtype") else l,
+            {"params": self.params, "opt_state": self.opt_state},
+        )
+
+    def restore(self, snapshot: Dict[str, Any]) -> None:
+        """Re-uploads a :meth:`snapshot` onto the (possibly new) backend.
+        Drops the cached apply jit: its executable belongs to the old
+        backend after a distributed-runtime rebuild."""
+        self.load_state_dict(snapshot)
+        self._apply_jit = None
+
     def apply_gradients(self, grads: Any) -> None:
         """One optimizer update, in place (holder-level).
 

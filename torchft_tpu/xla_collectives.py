@@ -111,6 +111,19 @@ class XLACollectives(Collectives):
         self._shutdown_flag = False
         self._aborted = False
         self._jit_cache: dict = {}
+        self._protected: List[Any] = []
+
+    def register_state(self, state: Any) -> None:
+        """Registers a state holder (anything with ``snapshot()`` /
+        ``restore(snap)``, e.g. :class:`~torchft_tpu.train_state.FTTrainState`)
+        to be round-tripped through the host across every reconfigure:
+        ``configure()`` onto a new membership tears down the XLA
+        distributed runtime and orphans live jax arrays (module
+        docstring), so protected holders are snapshotted to host before
+        the teardown and restored onto the new backend after it. This is
+        the automated form of the manual snapshot discipline the hazard
+        note prescribes."""
+        self._protected.append(state)
 
     # -- lifecycle --
 
@@ -140,9 +153,18 @@ class XLACollectives(Collectives):
 
             from jax.extend import backend as jax_backend
 
+            snapshots: List[Any] = []
+            tore_down = False
+
             def teardown_backends() -> None:
-                # Orphans live jax arrays (see module docstring) —
-                # snapshot state to host first.
+                # Orphans live jax arrays (see module docstring), so
+                # registered state holders are snapshotted to host first
+                # — lazily, right before the clear, so a no-teardown
+                # configure never pays the d2h state copy.
+                nonlocal tore_down
+                if not tore_down:
+                    snapshots.extend(s.snapshot() for s in self._protected)
+                tore_down = True
                 jax.clear_caches()
                 jax_backend.clear_backends()
                 self._jit_cache.clear()
@@ -196,6 +218,12 @@ class XLACollectives(Collectives):
             )
             self._rank = rank
             self._world_size = world_size
+            if tore_down:
+                # Only a teardown orphans device arrays; a no-teardown
+                # configure must not pay the host round-trip (or drop the
+                # holders' cached executables).
+                for holder, snap in zip(self._protected, snapshots):
+                    holder.restore(snap)
             self._aborted = False
 
         # Bounded wait: if a wedged in-flight collective is holding the op
