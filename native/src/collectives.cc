@@ -27,7 +27,17 @@ size_t dtype_size(Dtype d) {
 
 namespace {
 
-constexpr uint32_t kHelloMagic = 0x74667463; // "tftc"
+// Hello magic, versioned: the low byte is the ring wire-protocol revision.
+// History: the original "tftc" magic (0x74667463) spanned BOTH the
+// pre-op-header wire and the build that added check_op_header, so the
+// magic alone could not distinguish them; a ring mixing those desyncs
+// mid-op (the old side consumes the 24-byte op header as payload). This
+// versioned magic makes any mix of revisions — including byte-compatible
+// "tftc" builds that already spoke op headers — fail AT CONNECT with a
+// clear error; that over-rejection is the price of screening out the
+// truly incompatible older builds sharing the old magic. Bump the low
+// byte on any future wire change.
+constexpr uint32_t kHelloMagic = 0x74667402; // "tft" + proto rev 2
 // "tftp": per-op header magic (part of the wire protocol).
 constexpr uint32_t kOpMagic = 0x74667470;
 
@@ -195,9 +205,12 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   uint32_t peer_hello[2];
   prev_sock.recv_all(peer_hello, sizeof(peer_hello), deadline);
   int64_t prev_rank = (rank - 1 + world_size) % world_size;
-  if (peer_hello[0] != kHelloMagic ||
-      peer_hello[1] != static_cast<uint32_t>(prev_rank))
-    throw SocketError("ring handshake mismatch");
+  if (peer_hello[0] != kHelloMagic)
+    throw SocketError(
+        "ring handshake: wire-protocol mismatch (peer binary speaks a "
+        "different ring protocol revision)");
+  if (peer_hello[1] != static_cast<uint32_t>(prev_rank))
+    throw SocketError("ring handshake: unexpected peer rank");
 
   // Phase 3: publish the new ring unless an abort raced in.
   std::lock_guard<std::mutex> lock(cfg_mu_);
