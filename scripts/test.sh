@@ -1,0 +1,13 @@
+#!/bin/bash
+# One-command build + test, the role of the reference's scripts/test.sh
+# (reference scripts/test.sh:10-12: `cargo test && pytest`). Builds the
+# native control plane, then runs the Python suite (which exercises the
+# native lighthouse/manager/store/ring through ctypes — the C++ has no
+# separate test runner; its behavior is covered end-to-end by
+# tests/test_control_plane.py, test_quorum.py, test_collectives.py).
+set -ex
+
+cd "$(dirname "$0")/.."
+
+make -C native -j"$(nproc)"
+python -m pytest tests/ -x -q

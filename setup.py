@@ -1,0 +1,31 @@
+"""Build hook compiling the native control plane into the package.
+
+The reference compiles its Rust crate via maturin + build.rs
+(reference pyproject.toml:1-3, build.rs:7-11); here the C++ control plane
+(lighthouse, manager, store, ring collectives — native/src/) is built by
+the Makefile and lands in the package as ``torchft_tpu/_libtorchft.so``,
+loaded through ctypes (torchft_tpu/_native.py). Requires g++ (C++17),
+protoc and libprotobuf.
+
+Offline install (no index access)::
+
+    pip install -e . --no-deps --no-build-isolation
+"""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        repo = os.path.dirname(os.path.abspath(__file__))
+        subprocess.check_call(
+            ["make", "-C", os.path.join(repo, "native"), "-j"]
+        )
+        super().run()
+
+
+setup(cmdclass={"build_py": build_py_with_native})
