@@ -88,14 +88,12 @@ def _model_setup(size: str = None):
     forced_layers = os.environ.get("BENCH_FORCE_LAYERS")
     if size == "big":
         # MXU-saturating: d_model >= 1024 matmuls, seq 2048, bf16-sized
-        # payloads. ~110M params -> ~5.4 TFLOP/step at batch 8 x 2048.
-        # Config choice is MEASURED on v5e (8-step raw loop, this exact
-        # shape): dense+no-remat 7.61 steps/s > flash+no-remat 6.65 >
-        # dense+remat 6.27 > flash+remat 5.10. At S=2048/B=4 the fused
-        # XLA dense attention (bf16 probs) fits HBM and wins; the pallas
-        # flash kernel takes over at longer sequences (3.9x at S=8192,
-        # see ops/flash_attention.py) or bigger batches where the S^2
-        # scores no longer fit.
+        # payloads. ~110M params at batch 16 x 2048 -> ~21.9 TFLOP/step.
+        # Batch choice is MEASURED on v5e (fused train step, flash
+        # (512,512) tiles): B16 70.0 param-TFLOP/s > B8 64.6 > B4 58.0;
+        # XLA dense peaks at 47.5 (B8) and fails to compile at B16, so
+        # the bench's dense-vs-flash selection (in _bench_big) lands on
+        # the pallas kernel at this shape.
         cfg = TransformerConfig(
             vocab_size=8192,
             d_model=1024,
@@ -104,7 +102,7 @@ def _model_setup(size: str = None):
             d_ff=4096,
             max_seq_len=2048,
         )
-        batch_size, seq_len = 4, 2048
+        batch_size, seq_len = 16, 2048
     else:
         cfg = TransformerConfig(
             vocab_size=8192,
@@ -145,21 +143,22 @@ def _barrier(tree) -> None:
     np.asarray(leaf.ravel()[0:1])
 
 
-def _time_raw_loop(grad_fn, apply_fn, init_fn, tx, batch, warm: int, n: int) -> float:
+def _time_raw_loop(step_fn, init_fn, tx, batch, warm: int, n: int) -> float:
     """The one warm+timed raw-loop discipline every phase shares (fresh
-    state per call; _barrier drains before both clock edges). Keeping a
-    single copy means a change to the timing/drain semantics cannot make
-    phases silently measure differently."""
+    state per call; _barrier drains before both clock edges; step_fn is
+    the FUSED one-program train step, models.make_train_step — measured
+    ~8% faster than split grad/apply programs on v5e, so it is the honest
+    raw baseline). Keeping a single copy means a change to the
+    timing/drain semantics cannot make phases silently measure
+    differently."""
     params = init_fn()
     opt_state = tx.init(params)
     for _ in range(warm):
-        loss, grads = grad_fn(params, batch)
-        params, opt_state = apply_fn(params, opt_state, grads)
+        params, opt_state, loss = step_fn(params, opt_state, batch)
     _barrier(params)
     t0 = time.perf_counter()
     for _ in range(n):
-        loss, grads = grad_fn(params, batch)
-        params, opt_state = apply_fn(params, opt_state, grads)
+        params, opt_state, loss = step_fn(params, opt_state, batch)
     _barrier(params)
     return n / (time.perf_counter() - t0)
 
@@ -289,7 +288,7 @@ def _bench_big() -> dict:
     from datetime import timedelta as td
 
     from torchft_tpu import AsyncDiLoCo, FTTrainState, HostCollectives, Manager
-    from torchft_tpu.models import init_params, loss_fn
+    from torchft_tpu.models import init_params
 
     import dataclasses
 
@@ -308,50 +307,51 @@ def _bench_big() -> dict:
     )
 
     _fns_cache: dict = {}
-    # The optimizer apply doesn't depend on the attention config: ONE
-    # executable serves every variant (a per-config copy would recompile
-    # the 110M-param adamw program per candidate on the tunneled runtime).
-    _apply_jit = jax.jit(
-        lambda p, o, gr: (
-            lambda u, no: (optax.apply_updates(p, u), no)
-        )(*tx.update(gr, o, p)),
-        donate_argnums=(0, 1),
-    )
 
-    def make_step_fns(c):
+    def step_fn_for(c):
         # Memoized per config: a fresh jit wrapper would retrace+recompile
         # the big model (minutes on the tunneled runtime) on every timing
         # helper call, burning the phase's time budget.
         if c not in _fns_cache:
-            _fns_cache[c] = (
-                jax.jit(jax.value_and_grad(lambda p, b: loss_fn(c, p, b))),
-                _apply_jit,
-            )
+            from torchft_tpu.models import make_train_step
+
+            _fns_cache[c] = make_train_step(c, tx)
         return _fns_cache[c]
 
-    def time_raw_variant(c, warm: int, raw_steps: int = 8) -> float:
-        g, a = make_step_fns(c)
-        return _time_raw_loop(
-            g, a, lambda: init_params(c, jax.random.PRNGKey(0)), tx, batch,
-            warm, raw_steps,
-        )
+    def time_raw_variant(c, warm: int, raw_steps: int = 8):
+        """steps/s, or None when the variant fails (e.g. XLA dense at
+        batch sizes whose S^2 score tensors break the compiler — observed
+        at B16 on v5e; the selection then simply takes the survivor)."""
+        try:
+            return _time_raw_loop(
+                step_fn_for(c),
+                lambda: init_params(c, jax.random.PRNGKey(0)), tx, batch,
+                warm, raw_steps,
+            )
+        except Exception as e:  # noqa: BLE001 - selection is best-effort
+            _mark(f"big: variant failed: {type(e).__name__}: {str(e)[:120]}")
+            return None
 
     _mark("big: attention-path selection (dense vs flash)")
     dense_cfg = dataclasses.replace(cfg, use_flash=False)
     flash_cfg = dataclasses.replace(cfg, use_flash=True)
     dense_sps = time_raw_variant(dense_cfg, 2)
     flash_sps = time_raw_variant(flash_cfg, 2)
-    cfg = flash_cfg if flash_sps >= dense_sps else dense_cfg
+    if dense_sps is None and flash_sps is None:
+        raise RuntimeError("both attention variants failed to run")
+    cfg = flash_cfg if (flash_sps or 0) >= (dense_sps or 0) else dense_cfg
     _mark(
-        f"big: dense {dense_sps:.2f} vs flash {flash_sps:.2f} steps/s -> "
+        f"big: dense {dense_sps} vs flash {flash_sps} steps/s -> "
         f"{'flash' if cfg.use_flash else 'dense'}"
     )
-    grad_fn, apply_jit = make_step_fns(cfg)
+    train_step = step_fn_for(cfg)
 
     def time_raw_big(warm: int) -> float:
-        return time_raw_variant(cfg, warm)
+        sps = time_raw_variant(cfg, warm)
+        assert sps is not None, "selected variant stopped running"
+        return sps
 
-    raw_sps = max(dense_sps, flash_sps)
+    raw_sps = max(s for s in (dense_sps, flash_sps) if s is not None)
     step_s = 1.0 / raw_sps
 
     # Window sizing: sync ships n_params bf16 bytes each way; size H so
@@ -399,8 +399,10 @@ def _bench_big() -> dict:
         # auto-sync in the warm loop would spend a peer round and
         # desynchronize the 2-round accounting.
         for i in range(min(65, sync_every - 1)):
-            loss, grads = grad_fn(state.params, batch)
-            diloco.step(grads)
+            state.params, state.opt_state, loss = train_step(
+                state.params, state.opt_state, batch
+            )
+            diloco.step_applied()
             if i % 64 == 63:
                 np.asarray(loss)  # real drain (see _barrier note)
         diloco.sync()
@@ -421,8 +423,10 @@ def _bench_big() -> dict:
             _mark(f"big: timed window {w} (sync_every={sync_every})")
             t0 = time.perf_counter()
             for i in range(sync_every):
-                loss, grads = grad_fn(state.params, batch)
-                diloco.step(grads)
+                state.params, state.opt_state, loss = train_step(
+                    state.params, state.opt_state, batch
+                )
+                diloco.step_applied()
                 if i % 512 == 511:
                     np.asarray(loss)  # real drain (see _barrier note)
             diloco.flush()
@@ -463,8 +467,8 @@ def _bench_big() -> dict:
         "tflop_per_step": round(6 * n_params * batch.size / 1e12, 2),
         "attention": "flash" if cfg.use_flash else "dense",
         "attention_raw_steps_per_sec": {
-            "dense": round(dense_sps, 3),
-            "flash": round(flash_sps, 3),
+            "dense": None if dense_sps is None else round(dense_sps, 3),
+            "flash": None if flash_sps is None else round(flash_sps, 3),
         },
         "raw_steps_per_sec": round(raw_sps, 3),
         "raw_tflops": round(6 * n_params * batch.size * raw_sps / 1e12, 1),
@@ -544,27 +548,24 @@ def main() -> None:
         Manager,
         OptimizerWrapper,
     )
-    from torchft_tpu.models import init_params, loss_fn
+    from torchft_tpu.models import init_params, loss_fn, make_train_step
 
     cfg, batch, on_tpu = _model_setup()
     # ring peers (spawned with inherited env) must pack identical trees
     os.environ["BENCH_FORCE_LAYERS"] = str(cfg.n_layers)
     warmup, steps = 5, 30 if on_tpu else 15
     tx = optax.adamw(1e-3)
-    grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
-
-    def apply_fn_raw(params, opt_state, grads):
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
-
-    apply_jit = jax.jit(apply_fn_raw, donate_argnums=(0, 1))
+    # The fused one-program step (grad+apply, donated) is the raw baseline
+    # AND the diloco inner step; per-step DDP necessarily splits the
+    # programs (the ring needs the gradients on the host between them).
+    train_step = make_train_step(cfg, tx)
 
     detail = {"host": {"cpus": os.cpu_count(), "platform": jax.devices()[0].platform}}
 
     # -- raw loop --
     def time_raw(warm: int) -> float:
         return _time_raw_loop(
-            grad_fn, apply_jit,
+            train_step,
             lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
             warm, steps,
         )
@@ -623,21 +624,16 @@ def main() -> None:
         # bf16 wire, so the forced artifact stays bounded.
         degraded = on_tpu and d2h_MBps < 100
         ddp_batch = batch if on_tpu else jnp.concatenate([batch] * 4, axis=0)
-        # Same shapes on TPU -> reuse the already-compiled programs.
-        ddp_grad_fn = (
-            grad_fn
-            if on_tpu
-            else jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
-        )
-        ddp_apply = (
-            apply_jit
-            if on_tpu
-            else jax.jit(apply_fn_raw, donate_argnums=(0, 1))
+        # The DDP step MUST split grad and apply (the ring runs between
+        # them); its raw baseline stays the FUSED step at the same batch,
+        # so the ratio honestly charges the split to the transport.
+        ddp_grad_fn = jax.jit(
+            jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b))
         )
 
         def time_ddp_raw(warm: int, n: int) -> float:
             return _time_raw_loop(
-                ddp_grad_fn, ddp_apply,
+                train_step,
                 lambda: init_params(cfg, jax.random.PRNGKey(0)), tx,
                 ddp_batch, warm, n,
             )
@@ -831,12 +827,14 @@ def main() -> None:
     # tunneled device runtime an unbounded multi-thousand-op queue can
     # wedge the session (observed reproducibly at 6k+ queued steps).
     _mark("diloco: warm inner steps")
-    # min() guard: warm steps must stay below sync_every or diloco.step
-    # auto-syncs here, consuming the peer's first of windows+1 rounds
-    # (same guard as _bench_big, whose floor is lower)
+    # min() guard: warm steps must stay below sync_every or the window
+    # accounting auto-syncs here, consuming the peer's first of windows+1
+    # rounds (same guard as _bench_big, whose floor is lower)
     for i in range(min(65, sync_every - 1)):
-        loss, grads = grad_fn(state.params, batch)
-        diloco.step(grads)
+        state.params, state.opt_state, loss = train_step(
+            state.params, state.opt_state, batch
+        )
+        diloco.step_applied()
         if i % 64 == 63:
             np.asarray(loss)  # real drain: block_until_ready returns
             # before remote execution finishes on this tunnel (_barrier)
@@ -851,8 +849,10 @@ def main() -> None:
         _mark(f"diloco: timed window {w} (sync_every={sync_every})")
         t0 = time.perf_counter()
         for i in range(sync_every):
-            loss, grads = grad_fn(state.params, batch)
-            diloco.step(grads)
+            state.params, state.opt_state, loss = train_step(
+                state.params, state.opt_state, batch
+            )
+            diloco.step_applied()
             if i % 512 == 511:
                 np.asarray(loss)  # real drain: bounded queue; sparse because each
                 # drain costs a full tunnel RTT (seconds when degraded)

@@ -23,7 +23,8 @@ class build_py_with_native(build_py):
     def run(self):
         repo = os.path.dirname(os.path.abspath(__file__))
         subprocess.check_call(
-            ["make", "-C", os.path.join(repo, "native"), "-j"]
+            ["make", "-C", os.path.join(repo, "native"),
+             f"-j{os.cpu_count() or 1}"]
         )
         super().run()
 

@@ -54,6 +54,65 @@ class TestLocalSGDUnit:
         local.step(grads)
         assert manager.start_quorum.call_count == 2
 
+    def test_step_applied_counts_and_syncs(self):
+        # The fused-train-step integration: the caller applies the inner
+        # update itself (models.make_train_step); step_applied only does
+        # window accounting — params must NOT be touched by it.
+        manager = _mock_manager()
+        st = _state(2.0)
+        local = LocalSGD(manager, st, sync_every=2)
+        before = np.asarray(st.params["w"]).copy()
+        local.step_applied()
+        assert manager.start_quorum.call_count == 0
+        assert np.array_equal(np.asarray(st.params["w"]), before)
+        local.step_applied()
+        assert manager.start_quorum.call_count == 1  # boundary sync
+
+    def test_make_train_step_matches_split_programs(self):
+        # One fused program == grad then apply semantically; XLA fuses
+        # differently across the program boundary, so float accumulation
+        # order (and thus low-order bits) legitimately differs. SGD keeps
+        # the update LINEAR in the gradients so that noise stays at float
+        # scale (adam's sign normalization would amplify near-zero-grad
+        # noise to +-lr).
+        from torchft_tpu.models import (
+            init_params,
+            loss_fn,
+            make_train_step,
+            tiny_config,
+        )
+
+        cfg = tiny_config()
+        tx = optax.sgd(0.1)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt_state = tx.init(params)
+        batch = jnp.zeros((2, 16), jnp.int32)
+
+        fused = make_train_step(cfg, tx)
+        p1, o1, loss1 = fused(
+            jax.tree_util.tree_map(jnp.copy, params),
+            jax.tree_util.tree_map(jnp.copy, opt_state),
+            batch,
+        )
+
+        loss2, grads = jax.value_and_grad(lambda p: loss_fn(cfg, p, batch))(
+            params
+        )
+        updates, o2 = tx.update(grads, opt_state, params)
+        p2 = optax.apply_updates(params, updates)
+
+        # Tolerances at bf16 scale: the model's activations (and thus the
+        # grads) are bfloat16, whose rounding differs across fusion
+        # orders; the test still catches wiring bugs (wrong optimizer,
+        # missing apply, sign errors), which produce O(update) errors.
+        assert float(loss1) == pytest.approx(float(loss2), rel=1e-2)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-2, atol=1e-3
+            )
+
     def test_commit_saves_backup(self):
         manager = _mock_manager(commit=True)
         st = _state(1.0)

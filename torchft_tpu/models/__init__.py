@@ -6,6 +6,7 @@ from torchft_tpu.models.transformer import (
     forward,
     init_params,
     loss_fn,
+    make_train_step,
     param_sharding_rules,
     tiny_config,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "forward",
     "init_params",
     "loss_fn",
+    "make_train_step",
     "moe",
     "param_sharding_rules",
     "tiny_config",

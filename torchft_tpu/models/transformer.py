@@ -350,3 +350,29 @@ def loss_fn(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array) -
     """Next-token cross entropy over (B, S) int32 tokens."""
     logits = forward(cfg, params, tokens[:, :-1])
     return next_token_loss(logits, tokens[:, 1:])
+
+
+def make_train_step(cfg: TransformerConfig, tx: Any) -> Any:
+    """ONE-program train step: loss, grad, and optimizer apply fused into
+    a single jitted executable with buffer donation.
+
+    Measured on v5e (111M-param big config, B8 S2048): 216 ms/step fused
+    vs 235 ms as separate grad and apply programs; a device-side
+    ``lax.scan`` over steps gains nothing further, so the win is the
+    program-boundary cost, not host dispatch. Use with
+    ``LocalSGD.step_applied``-style window accounting — per-step
+    cross-group work (the DDP ring) inherently needs the split programs.
+
+    Returns ``step(params, opt_state, tokens) -> (params, opt_state,
+    loss)``.
+    """
+    import optax
+
+    def one_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens)
+        )(params)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state, loss
+
+    return jax.jit(one_step, donate_argnums=(0, 1))
