@@ -28,6 +28,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from torchft_tpu.platform import (  # noqa: E402
     apply_compilation_cache_env,
     apply_jax_platform_env,
+    standby_gate,
 )
 
 apply_jax_platform_env()
@@ -154,6 +155,16 @@ def main() -> None:
         ckpt_box["loader"] = dict(sd["loader"])
         ckpt_box["healed"] = True
 
+    grad_fn = jax.jit(jax.value_and_grad(model_loss_fn))
+    # Warm the jit, then park if we are a hot-spare standby (launcher
+    # --hot-spare): a promoted standby joins the quorum in milliseconds
+    # instead of paying interpreter+import+compile.
+    warm_idx = next(iter(StatefulDataLoader(sampler, batch_size)))
+    jax.block_until_ready(
+        grad_fn(state.params, jnp.asarray(x[warm_idx]), jnp.asarray(y[warm_idx]))
+    )
+    standby_gate()
+
     collectives = HostCollectives(timeout=timedelta(seconds=30))
     manager = Manager(
         collectives=collectives,
@@ -163,7 +174,6 @@ def main() -> None:
         replica_id=f"train_ddp_{replica_group}",
     )
     optimizer = OptimizerWrapper(manager, state)
-    grad_fn = jax.jit(jax.value_and_grad(model_loss_fn))
 
     while manager.current_step() < num_steps:
         step = manager.current_step()

@@ -111,6 +111,39 @@ class TestLauncher:
         assert (tmp_path / "marker_0").exists()
         assert (tmp_path / "marker_1").exists()
 
+    def test_launch_hot_spare_promotion(self, tmp_path):
+        # --hot-spare policy: the dead primary is replaced by PROMOTING
+        # the pre-warmed standby (which was parked in standby_gate), not
+        # by a cold restart. The promoted process proves it came through
+        # the gate by writing a marker only standbys write.
+        import os
+
+        script = tmp_path / "spare.py"
+        script.write_text(
+            "import os, sys\n"
+            f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+            "from torchft_tpu.platform import standby_gate\n"
+            "d = os.path.dirname(os.path.abspath(__file__))\n"
+            "if os.environ.get('TORCHFT_STANDBY_FILE'):\n"
+            "    standby_gate()\n"
+            "    open(os.path.join(d, 'promoted'), 'w').close()\n"
+            "    sys.exit(0)\n"
+            "if not os.path.exists(os.path.join(d, 'died')):\n"
+            "    open(os.path.join(d, 'died'), 'w').close()\n"
+            "    sys.exit(1)\n"
+            "sys.exit(0)\n"
+        )
+        rc = launch(
+            [sys.executable, str(script)],
+            num_replica_groups=1,
+            lighthouse_addr="http://unused:1",
+            max_restarts=2,
+            hot_spare=True,
+        )
+        assert rc == 0
+        assert (tmp_path / "died").exists()
+        assert (tmp_path / "promoted").exists()
+
     def test_launch_gives_up_after_max_restarts(self, tmp_path):
         script = tmp_path / "fail.py"
         script.write_text("import sys; sys.exit(3)\n")

@@ -70,6 +70,8 @@ class _Supervised:
     proc: Optional[subprocess.Popen] = None
     restarts: int = 0
     returncode: Optional[int] = None
+    standby: Optional[subprocess.Popen] = None
+    standby_file: Optional[str] = None
 
 
 def launch(
@@ -78,10 +80,28 @@ def launch(
     lighthouse_addr: str,
     max_restarts: int = 10,
     env: Optional[Dict[str, str]] = None,
+    hot_spare: bool = False,
 ) -> int:
     """Runs one process per replica group locally, restarting any that exit
     non-zero up to ``max_restarts`` times (torchelastic's role in the
-    reference stack). Returns 0 iff every group eventually exited cleanly."""
+    reference stack). Returns 0 iff every group eventually exited cleanly.
+
+    ``hot_spare=True`` keeps one pre-warmed STANDBY process per group: the
+    standby runs the same command with ``TORCHFT_STANDBY_FILE`` set and
+    parks at :func:`torchft_tpu.platform.standby_gate` after its imports
+    and jit warm-up; on a primary death the supervisor activates it by
+    creating the file (promotion is one poll interval, vs ~14 s of
+    interpreter+import+compile for a cold restart — CHURN_BENCH.json heal
+    breakdown) and spawns a fresh standby in the background. The command
+    must call ``standby_gate()`` before creating its Manager. Constraint:
+    the standby warms on the SAME host as its primary, so this local
+    launcher's hot-spare mode suits CPU workloads and multi-chip hosts;
+    on a single-chip accelerator host the standby cannot warm the chip
+    the primary owns (see standby_gate's deployment note)."""
+    import tempfile
+    import uuid as _uuid
+
+    standby_dir = tempfile.mkdtemp(prefix="torchft_standby_") if hot_spare else None
     groups = [
         _Supervised(
             replica_group_spec(
@@ -91,13 +111,40 @@ def launch(
         for g in range(num_replica_groups)
     ]
 
-    def spawn(s: _Supervised) -> None:
+    def spawn(s: _Supervised, as_standby: bool = False) -> subprocess.Popen:
         full_env = {**os.environ, **s.spec["env"]}  # type: ignore[arg-type]
-        s.proc = subprocess.Popen(list(s.spec["cmd"]), env=full_env)  # type: ignore[arg-type]
-        logger.info(f"{s.spec['name']}: started pid {s.proc.pid}")
+        if as_standby:
+            assert standby_dir is not None
+            s.standby_file = os.path.join(standby_dir, _uuid.uuid4().hex)
+            full_env["TORCHFT_STANDBY_FILE"] = s.standby_file
+        else:
+            full_env.pop("TORCHFT_STANDBY_FILE", None)
+        proc = subprocess.Popen(list(s.spec["cmd"]), env=full_env)  # type: ignore[arg-type]
+        role = "standby" if as_standby else "primary"
+        logger.info(f"{s.spec['name']}: started {role} pid {proc.pid}")
+        if as_standby:
+            s.standby = proc
+        else:
+            s.proc = proc
+        return proc
+
+    def promote_or_spawn(s: _Supervised) -> None:
+        """Restart path: activate the warm standby when one is ready,
+        else fall back to a cold spawn."""
+        if s.standby is not None and s.standby.poll() is None:
+            assert s.standby_file is not None
+            open(s.standby_file, "w").close()  # releases standby_gate()
+            s.proc = s.standby
+            s.standby = None
+            logger.info(f"{s.spec['name']}: promoted standby pid {s.proc.pid}")
+            spawn(s, as_standby=True)  # re-arm
+        else:
+            spawn(s)
 
     for s in groups:
         spawn(s)
+        if hot_spare:
+            spawn(s, as_standby=True)
 
     try:
         while True:
@@ -117,7 +164,7 @@ def launch(
                         f"{s.spec['name']}: exited rc={rc}, restart "
                         f"{s.restarts}/{s.spec['max_restarts']}"
                     )
-                    spawn(s)
+                    promote_or_spawn(s)
                     running += 1
                 else:
                     s.returncode = rc
@@ -139,6 +186,16 @@ def launch(
             if s.proc is not None and s.proc.poll() is None:
                 s.proc.terminate()
         raise
+    finally:
+        # Parked standbys never exit on their own, and the activation-file
+        # directory is this invocation's to clean up.
+        for s in groups:
+            if s.standby is not None and s.standby.poll() is None:
+                s.standby.kill()
+        if standby_dir is not None:
+            import shutil
+
+            shutil.rmtree(standby_dir, ignore_errors=True)
     return 0 if all(s.returncode == 0 for s in groups) else 1
 
 
@@ -154,6 +211,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="lighthouse address; spawns an in-process one when omitted",
     )
     parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument(
+        "--hot-spare",
+        action="store_true",
+        help="keep a pre-warmed standby per group; a dead primary is "
+        "replaced by promotion (sub-second) instead of a cold restart. "
+        "The command must call torchft_tpu.platform.standby_gate() after "
+        "warm-up, before creating its Manager.",
+    )
     parser.add_argument("cmd", nargs="+", help="command to run per group")
     args = parser.parse_args(argv)
 
@@ -172,6 +237,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             num_replica_groups=args.num_replica_groups,
             lighthouse_addr=lighthouse_addr,
             max_restarts=args.max_restarts,
+            hot_spare=args.hot_spare,
         )
     finally:
         if lighthouse is not None:

@@ -25,6 +25,41 @@ def apply_jax_platform_env() -> None:
     jax.config.update("jax_platforms", platforms)
 
 
+def standby_gate() -> None:
+    """Hot-spare start line. When ``TORCHFT_STANDBY_FILE`` is set, the
+    process is a pre-warmed STANDBY: call this after imports and jit
+    warm-up but BEFORE creating the Manager (a standby must not join
+    quorums or heartbeat), and it blocks until the supervisor activates
+    the process by creating the file. No-op for normal processes.
+
+    This is the process-level analog of ``WorldSizeMode.FIXED_WITH_SPARES``:
+    a cold restart pays interpreter + library import + compile before it
+    can heal (~14 s measured under 4-way CPU contention, CHURN_BENCH.json
+    heal breakdown); a promoted standby pays none of it. The launcher's
+    ``--hot-spare`` mode manages the standby lifecycle
+    (torchft_tpu.launcher).
+
+    Deployment constraint: the standby warms up on ITS OWN resources.
+    On a host whose accelerator is exclusively owned by the primary
+    (single-chip TPU hosts), a standby cannot warm the same chip — run
+    standbys on separate hosts (the per-host-per-group topology this
+    framework targets) or accept cold restarts there.
+
+    If the supervisor dies without activating us (hard kill: its cleanup
+    never runs), exit instead of leaking a fully-warmed parked process."""
+    path = os.environ.get("TORCHFT_STANDBY_FILE")
+    if not path:
+        return
+    import sys
+    import time
+
+    supervisor = os.getppid()
+    while not os.path.exists(path):
+        if os.getppid() != supervisor:
+            sys.exit(0)  # orphaned: supervisor is gone, nobody can promote us
+        time.sleep(0.05)
+
+
 def apply_compilation_cache_env(default_dir: str = "") -> None:
     """Enables JAX's persistent compilation cache from the
     ``TORCHFT_COMPILE_CACHE`` env var (falling back to ``default_dir``).
