@@ -58,6 +58,7 @@ def worker() -> None:
     from torchft_tpu.platform import (
         apply_compilation_cache_env,
         apply_jax_platform_env,
+        standby_gate,
     )
 
     apply_jax_platform_env()
@@ -106,11 +107,18 @@ def worker() -> None:
     # (t_setup was stamped after the import block: spawn->enter is the
     # interpreter + sitecustomize-preloaded jax; enter->setup is the
     # remaining library imports + model init; setup->compiled is the jit.)
-    go_path = os.environ["BENCH_GO"]
-    open(log_path + ".ready", "w").close()
-    while not os.path.exists(go_path):
-        time.sleep(0.05)
+    # Hot-spare standbys park HERE, fully warmed, until promoted; for
+    # them activated_t is the promotion instant, for cold starts it
+    # coincides with compile completion.
+    standby_gate()
+    t_activated = time.time()
 
+    # Manager BEFORE the start line: heartbeats flow while the groups
+    # gather at the go-gate, so the first quorum's join gate sees every
+    # group as healthy and holds the door for all of them — otherwise the
+    # first group to request forms an instant solo quorum (it is the only
+    # HEARTBEATING replica at that moment) and membership flaps from
+    # there.
     collectives = HostCollectives(timeout=timedelta(seconds=30))
     manager = Manager(
         collectives=collectives,
@@ -121,6 +129,11 @@ def worker() -> None:
         replica_id=f"bench_{group}",
     )
     optimizer = OptimizerWrapper(manager, state)
+
+    go_path = os.environ["BENCH_GO"]
+    open(log_path + ".ready", "w").close()
+    while not os.path.exists(go_path):
+        time.sleep(0.05)
 
     with open(log_path, "a", buffering=1) as log:
         # Boot record first: the parent joins it with its kill/spawn
@@ -134,6 +147,7 @@ def worker() -> None:
                         "enter_t": t_enter,
                         "setup_t": t_setup,
                         "compiled_t": t_compiled,
+                        "activated_t": t_activated,
                         "manager_t": time.time(),
                     }
                 }
@@ -178,14 +192,20 @@ def worker() -> None:
 
 
 class _Group:
-    def __init__(self, gid: int, log_path: str, env: Dict[str, str]) -> None:
+    def __init__(
+        self, gid: int, log_path: str, env: Dict[str, str],
+        hot_spare: bool = False,
+    ) -> None:
         self.gid = gid
         self.log_path = log_path
         self.env = env
+        self.hot_spare = hot_spare
         self.proc: Optional[subprocess.Popen] = None
+        self.standby: Optional[subprocess.Popen] = None
+        self.standby_file: Optional[str] = None
 
-    def spawn(self) -> None:
-        env = {**os.environ, "BENCH_SPAWN_T": str(time.time())}
+    def _popen(self, extra_env: Dict[str, str]) -> subprocess.Popen:
+        env = {**os.environ, "BENCH_SPAWN_T": str(time.time()), **extra_env}
         # In the GROUP SPEC only, an empty value means "unset" (e.g.
         # JAX_PLATFORMS="" lets the host's default accelerator platform
         # win for the TPU group); inherited empty-string env vars pass
@@ -195,11 +215,37 @@ class _Group:
                 env.pop(k, None)
             else:
                 env[k] = v
-        self.proc = subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker"],
             env=env,
             cwd=REPO,
         )
+
+    def spawn(self) -> None:
+        self.proc = self._popen({})
+        if self.hot_spare:
+            self.arm_standby()
+
+    def arm_standby(self) -> None:
+        self.standby_file = self.log_path + f".standby_{time.time():.3f}"
+        self.standby = self._popen({"TORCHFT_STANDBY_FILE": self.standby_file})
+
+    def restart(self) -> None:
+        """Cold respawn, or sub-second promotion of the warm standby
+        (the launcher's --hot-spare policy, torchft_tpu.launcher)."""
+        if self.standby is not None and self.standby.poll() is None:
+            open(self.standby_file, "w").close()
+            self.proc = self.standby
+            self.standby = None
+            self.arm_standby()
+        else:
+            self.proc = self._popen({})
+            if self.hot_spare:
+                self.arm_standby()
+
+    def reap(self) -> None:
+        if self.standby is not None and self.standby.poll() is None:
+            self.standby.kill()
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -240,6 +286,7 @@ def _run_phase(
     out_dir: str,
     lighthouse_addr: str,
     tpu_group0: bool = False,
+    hot_spare: bool = False,
 ) -> dict:
     go_path = os.path.join(out_dir, f"{name}.go")
     gs: List[_Group] = []
@@ -270,6 +317,12 @@ def _run_phase(
                     # cost in round 2's 31 s p50).
                     "TORCHFT_COMPILE_CACHE": os.path.join(out_dir, "jax_cache"),
                 },
+                # Standbys only for killable groups: kills rotate over
+                # 1..N-1, so a group-0 standby would be pure import+compile
+                # contention against the measurement group (and on
+                # --tpu-group0 it could not warm the primary-owned chip
+                # anyway).
+                hot_spare=hot_spare and g != 0,
             )
         )
     for g in gs:
@@ -291,13 +344,18 @@ def _run_phase(
     # truncate slow runs back to the under-powered measurement). Truncation
     # is detected and reported either way.
     deadline = time.time() + max(1200, steps * 4)
+    timed_out = False
     try:
-        while any(g.alive() for g in gs) and time.time() < deadline:
+        while any(g.alive() for g in gs):
+            if time.time() >= deadline:
+                timed_out = True
+                break
             time.sleep(0.25)
-            # Restart any dead group (supervisor role, launcher semantics).
+            # Restart any dead group (supervisor role, launcher semantics;
+            # promotes the warm standby under --hot-spare).
             for g in gs:
                 if g.proc is not None and g.proc.poll() not in (None, 0):
-                    g.spawn()
+                    g.restart()
             if next_kill is not None:
                 lead = len(_committed(_read_log(gs[0].log_path)))
                 if lead >= next_kill and lead < steps - 5:
@@ -311,6 +369,7 @@ def _run_phase(
                     next_kill += kill_every
     finally:
         for g in gs:
+            g.reap()  # parked standbys never exit on their own
             if g.alive():
                 g.proc.terminate()
         for g in gs:
@@ -350,27 +409,41 @@ def _run_phase(
         ]
         if after:
             heal_s.append(after[0] - k["t"])
+        # Match boots by ACTIVATION time: a promoted hot-spare standby was
+        # spawned (and imported/compiled) long before the kill, so only
+        # its activation falls in this kill's window.
         boots = [
             r["boot"]
             for r in log
-            if "boot" in r and k["t"] < r["boot"]["spawn_t"] < next_kill_t
+            if "boot" in r
+            and k["t"] < r["boot"].get("activated_t", r["boot"]["spawn_t"])
+            < next_kill_t
         ]
         if boots and after:
             b = boots[0]
-            breakdowns.append(
-                {
-                    "respawn": b["spawn_t"] - k["t"],
-                    "import": b["enter_t"] - b["spawn_t"],
-                    "setup": b["setup_t"] - b["enter_t"],
-                    "compile": b["compiled_t"] - b["setup_t"],
-                    "join": b["manager_t"] - b["compiled_t"],
-                    "first_commit": after[0] - b["manager_t"],
-                }
-            )
+            entry = {
+                # kill -> warmed process past its gate (cold: respawn +
+                # import + setup + compile; promoted standby: just the
+                # supervisor poll + gate poll)
+                "activation": b["activated_t"] - k["t"],
+                "join": b["manager_t"] - b["activated_t"],
+                "first_commit": after[0] - b["manager_t"],
+            }
+            if b["spawn_t"] > k["t"]:
+                # Cold restart: the process-boot phases belong to this kill.
+                entry.update(
+                    {
+                        "respawn": b["spawn_t"] - k["t"],
+                        "import": b["enter_t"] - b["spawn_t"],
+                        "setup": b["setup_t"] - b["enter_t"],
+                        "compile": b["compiled_t"] - b["setup_t"],
+                    }
+                )
+            breakdowns.append(entry)
     heal_s.sort()
 
     def _phase_median(name: str) -> Optional[float]:
-        vals = sorted(b[name] for b in breakdowns)
+        vals = sorted(b[name] for b in breakdowns if name in b)
         return round(vals[len(vals) // 2], 2) if vals else None
 
     # Throughput spread: group 0's committed-step rate over time quarters —
@@ -388,16 +461,20 @@ def _run_phase(
     return {
         "steps_per_sec": round(_steps_per_sec(_read_log(gs[0].log_path)), 3),
         "steps_per_sec_quarters": quarter_sps,
-        # Deadline truncation: the phase ended before group 0 reached the
-        # step target — the measurement is under-powered, not just noisy.
-        "truncated": committed_g0 < steps,
+        # Deadline truncation (the phase was cut off mid-run, so the
+        # measurement is under-powered). A near-target committed count
+        # without a timeout is normal: the first group to finish exits,
+        # which can abort one in-flight step on the others.
+        "truncated": bool(timed_out),
+        "committed_vs_target": f"{committed_g0}/{steps}",
         "kills": len(kills),
         "heal_s": [round(h, 2) for h in heal_s],
         "heal_p50_s": round(heal_s[len(heal_s) // 2], 2) if heal_s else None,
         "heal_breakdown_median_s": {
             name: _phase_median(name)
             for name in (
-                "respawn", "import", "setup", "compile", "join", "first_commit"
+                "activation", "respawn", "import", "setup", "compile",
+                "join", "first_commit"
             )
         }
         if breakdowns
@@ -420,6 +497,13 @@ def main() -> None:
         help="run group 0 on the host's default (TPU) platform; kills "
         "still only hit the CPU peer groups",
     )
+    parser.add_argument(
+        "--hot-spare",
+        action="store_true",
+        help="also run a churn phase where restarts promote a pre-warmed "
+        "standby (the launcher's --hot-spare policy) instead of cold-"
+        "restarting",
+    )
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
     if args.out is None:
@@ -438,14 +522,25 @@ def main() -> None:
     out_dir = os.path.join(REPO, ".bench_churn_logs")
     os.makedirs(out_dir, exist_ok=True)
     for f in os.listdir(out_dir):
-        os.unlink(os.path.join(out_dir, f))
+        path = os.path.join(out_dir, f)
+        if os.path.isdir(path):
+            # Keep the persistent jit cache WARM across runs: restarted
+            # workers (and whole re-runs) skip the compile.
+            continue
+        os.unlink(path)
 
-    # Fast failure detection so a kill costs survivors ~join_timeout, not
-    # the CLI-default 60 s (reference defaults: src/lighthouse.rs:77-102).
+    # Failure detection speed comes from heartbeat_timeout (a dead member
+    # leaves the healthy set after 500 ms and the join gate does not apply
+    # to it). join_timeout must exceed a STEP TIME: the gate holds quorum
+    # formation for healthy-but-not-yet-requesting members, and members
+    # re-request once per step — a 200 ms gate under >200 ms steps lets
+    # sub-quorums form between paced requests, flapping membership and
+    # starving a joiner (observed: the TPU group excluded for 43 s while
+    # two CPU groups fast-quorumed as a stable pair).
     lighthouse = Lighthouse(
         bind="[::]:0",
         min_replicas=1,
-        join_timeout_ms=200,
+        join_timeout_ms=2000,
         quorum_tick_ms=50,
         heartbeat_timeout_ms=500,
     )
@@ -458,6 +553,15 @@ def main() -> None:
         "churn", args.groups, args.steps, args.kill_every, out_dir,
         lighthouse.address(), tpu_group0=args.tpu_group0,
     )
+    churn_hot = None
+    if args.hot_spare:
+        # Third phase: same kill schedule, restarts by standby PROMOTION
+        # (launcher --hot-spare). The cold phase above stays in the
+        # artifact so both restart policies' heal latencies are on record.
+        churn_hot = _run_phase(
+            "churn_hot", args.groups, args.steps, args.kill_every, out_dir,
+            lighthouse.address(), tpu_group0=args.tpu_group0, hot_spare=True,
+        )
     lighthouse.shutdown()
 
     ratio = (
@@ -485,7 +589,13 @@ def main() -> None:
         },
         "healthy": healthy,
         "churn": churn,
+        "churn_hot_spare": churn_hot,
         "ratio": ratio,
+        "ratio_hot_spare": (
+            round(churn_hot["steps_per_sec"] / healthy["steps_per_sec"], 3)
+            if churn_hot and healthy["steps_per_sec"]
+            else None
+        ),
         "healthy_quarter_spread": spread,
         "measurement_ok": bool(
             ratio <= 1.05
@@ -493,6 +603,14 @@ def main() -> None:
             and not churn.get("truncated")
         ),
         "target": 0.90,
+        "note": "all groups share one host, so throughput ratios carry "
+        "contention artifacts the target deployment (one host per group) "
+        "does not have: during a COLD heal the victim's ~14 s of "
+        "import+compile runs while it is out of the cohort (survivors "
+        "speed up), while the hot-spare phase re-arms a fresh standby "
+        "(same import work) while all groups train — deflating "
+        "ratio_hot_spare even though its kill->commit latency is the "
+        "deployment-relevant number",
     }
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
