@@ -421,13 +421,15 @@ def flash_attention(
             Requires ``causal``.
         sm_scale: score scale; default ``head_dim ** -0.5``.
         block_q, block_k: VMEM tile sizes; clamped to S. Default auto:
-            (512, 512) for S >= 2048, measured IN-MODEL on v5e (8-layer
-            111M-param LM, fused train step, head_dim 64): at B8 the
-            (512, 512) kernel runs the step at 64.6 param-TFLOP/s vs
-            47.5 dense and 38.3 for (128, 128); at B4 58.0 vs 40.8
-            dense; at B16 70.0 (dense fails to compile). Standalone
-            kernel sweeps rank tiles differently (fusion/VMEM
-            interactions dominate) — trust whole-step timings.
+            (512, 512) when the sublane-padded sequence length reaches
+            2048, else (128, 128). Measured IN-MODEL on v5e (8-layer
+            111M-param LM at padded S 2048, fused train step, head_dim
+            64; FLASH_ABLATION.json): at B8 the (512, 512) kernel runs
+            the step at 64.6 param-TFLOP/s vs 47.5 dense and 38.3 for
+            (128, 128); at B4 58.0 vs 40.8 dense; at B16 70.0 (dense
+            fails to compile). Standalone kernel sweeps rank tiles
+            differently (fusion/VMEM interactions dominate) — trust
+            whole-step timings.
         interpret: force pallas interpret mode; default: on iff the backend
             is not TPU (CPU tests / virtual-device dryruns).
         mesh/batch_axis/head_axis: when ``mesh`` is given the kernel runs
@@ -467,11 +469,19 @@ def flash_attention(
     # handled by zero-padding the sequence up to the block multiple —
     # padded keys are masked in-kernel, padded queries carry zero
     # cotangents, so numerics are exact.
-    if S >= 2048:
+    # Tile choice keys on the PADDED sublane length, not raw S:
+    # language-model training slices the last token off (tokens[:, :-1]),
+    # so the flagship in-model sequence is 2047 — a raw-S `>= 2048` test
+    # once dropped it onto the 128-tile path and cost 1.7x whole-step
+    # throughput, while sequences just over a power of two would pay ~50%
+    # padding on the large-tile path. s8 >= 2048 admits exactly the
+    # 2048-class shapes the measurements cover (FLASH_ABLATION.json at
+    # padded S 2048; standalone 512-tile win at S 8192).
+    s8 = _cdiv(S, 8) * 8  # Mosaic sublane floor
+    if s8 >= 2048:
         auto_q, auto_k = 512, 512
     else:
         auto_q, auto_k = 128, 128
-    s8 = _cdiv(S, 8) * 8  # Mosaic sublane floor
     block_q = min(block_q or auto_q, s8)
     block_k = min(block_k or auto_k, s8)
     base = block_q * block_k // math.gcd(block_q, block_k)
