@@ -555,9 +555,16 @@ def main() -> None:
 
     # Honor JAX_PLATFORMS when the caller sets it (CPU smoke tests); the
     # driver's TPU run leaves it unset and lands on the real chip.
-    from torchft_tpu.platform import apply_jax_platform_env
+    from torchft_tpu.platform import (
+        apply_compilation_cache_env,
+        apply_jax_platform_env,
+    )
 
     apply_jax_platform_env()
+    # Persistent jit cache (repo-local): the big-model compiles cost
+    # minutes each through the tunneled remote-compile service, and a
+    # prior run's cache spends the attempt budget on measurement instead.
+    apply_compilation_cache_env(os.path.join(REPO, ".bench_jax_cache"))
 
     import jax
     import numpy as np
