@@ -1,0 +1,182 @@
+"""TPU cost ablation at the big shape: where does the non-matmul time go?
+Run ALONE on the chip. Modes via EXP_ABL env:
+  layers  — n_layers in {0,2,4,8} dense B8: slope = per-layer, intercept =
+            embed+readout+loss+optimizer
+  blocks  — in-model flash block sweep at B8 + dense baseline
+"""
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchft_tpu.models import TransformerConfig, init_params, loss_fn
+
+B = int(os.environ.get("EXP_B", "8"))
+MODE = os.environ.get("EXP_ABL", "layers")
+
+
+def drain(x):
+    jax.block_until_ready(x)
+    np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0:1])
+
+
+def run(cfg, batch, label, warm=2, iters=8):
+    tx = optax.adamw(1e-3)
+    try:
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(params))
+        opt_state = tx.init(params)
+
+        def one_step(p, o, b):
+            loss, grads = jax.value_and_grad(
+                lambda pp: loss_fn(cfg, pp, b)
+            )(p)
+            u, o2 = tx.update(grads, o, p)
+            return optax.apply_updates(p, u), o2, loss
+
+        step = jax.jit(one_step, donate_argnums=(0, 1))
+        for _ in range(warm):
+            params, opt_state, loss = step(params, opt_state, batch)
+        drain(params)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, batch)
+        drain(params)
+        dt = (time.perf_counter() - t0) / iters
+        tf = 6 * n_params * batch.size / 1e12
+        print(f"{label:28s} {dt*1000:8.1f} ms/step  "
+              f"{tf/dt:6.1f} param-TFLOP/s", flush=True)
+        del params, opt_state
+        return dt
+    except Exception as e:
+        print(f"{label}: FAIL {type(e).__name__}: {str(e)[:150]}", flush=True)
+        return None
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu"
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(rng.integers(0, 8192, size=(B, 2048), dtype=np.int32))
+    base = dict(vocab_size=8192, d_model=1024, n_heads=16, d_ff=4096,
+                max_seq_len=2048)
+
+    if MODE == "layers":
+        for L in (0, 2, 4, 8):
+            run(TransformerConfig(n_layers=L, use_flash=True, **base), batch,
+                f"flash L={L} B={B}")
+    elif MODE == "fused":
+        # dispatch-overhead probe: same compute, fewer program launches
+        import optax as _ox
+        from torchft_tpu.models import init_params as ip, loss_fn as lf
+
+        for L in (0, 8):
+            cfg = TransformerConfig(n_layers=L, **base)
+            tx = _ox.adamw(1e-3)
+            params = ip(cfg, jax.random.PRNGKey(0))
+            n_params = sum(int(np.prod(l.shape))
+                           for l in jax.tree_util.tree_leaves(params))
+            opt_state = tx.init(params)
+
+            def one_step(p, o, b):
+                loss, grads = jax.value_and_grad(
+                    lambda pp: lf(cfg, pp, b)
+                )(p)
+                u, o2 = tx.update(grads, o, p)
+                return _ox.apply_updates(p, u), o2, loss
+
+            merged = jax.jit(one_step, donate_argnums=(0, 1))
+
+            def scan8(p, o, b):
+                def body(carry, _):
+                    p, o = carry
+                    p2, o2, loss = one_step(p, o, b)
+                    return (p2, o2), loss
+                (p, o), losses = jax.lax.scan(
+                    body, (p, o), None, length=8
+                )
+                return p, o, losses
+            scanned = jax.jit(scan8, donate_argnums=(0, 1))
+
+            for label, fn, per_call in (
+                (f"merged L={L}", merged, 1),
+                (f"scan8 L={L}", scanned, 8),
+            ):
+                for _ in range(2):
+                    out = fn(params, opt_state, batch)
+                    params, opt_state = out[0], out[1]
+                drain(params)
+                t0 = time.perf_counter()
+                iters = 16 if per_call == 1 else 2
+                for _ in range(iters):
+                    out = fn(params, opt_state, batch)
+                    params, opt_state = out[0], out[1]
+                drain(params)
+                dt = (time.perf_counter() - t0) / (iters * per_call)
+                tf = 6 * n_params * batch.size / 1e12
+                print(f"{label:20s} {dt*1000:8.1f} ms/step  "
+                      f"{tf/dt:6.1f} param-TFLOP/s", flush=True)
+            del params, opt_state
+    elif MODE == "loss":
+        # isolate the head: L=0 model, loss variants
+        from torchft_tpu.models import transformer as T
+
+        def loss_v(variant):
+            def nt_loss(logits, targets):
+                if variant == "take":
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    ll = jnp.take_along_axis(
+                        logp, targets[..., None], axis=-1
+                    )[..., 0]
+                    return -jnp.mean(ll)
+                if variant == "mask":
+                    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+                    V = logits.shape[-1]
+                    tgt = jax.lax.broadcasted_iota(
+                        jnp.int32, logits.shape, logits.ndim - 1
+                    ) == targets[..., None]
+                    picked = jnp.sum(
+                        jnp.where(tgt, logits, 0.0), axis=-1
+                    )
+                    return jnp.mean(logz - picked)
+                if variant == "onehot":
+                    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+                    oh = jax.nn.one_hot(
+                        targets, logits.shape[-1], dtype=logits.dtype
+                    )
+                    picked = jnp.einsum("bsv,bsv->bs", logits, oh)
+                    return jnp.mean(logz - picked)
+                raise ValueError(variant)
+            return nt_loss
+
+        for variant in ("take", "mask", "onehot"):
+            cfg0 = TransformerConfig(n_layers=0, **base)
+            orig = T.next_token_loss
+            T.next_token_loss = loss_v(variant)
+            try:
+                run(cfg0, batch, f"head loss={variant} B={B}")
+            finally:
+                T.next_token_loss = orig
+    else:
+        cfg8 = TransformerConfig(n_layers=8, **base)
+        run(cfg8, batch, f"dense B={B}")
+        blocks = [(128, 128), (256, 256), (512, 256), (512, 512),
+                  (1024, 1024), (2048, 512), (256, 2048)]
+        if os.environ.get("EXP_BLOCKS_SHORT"):
+            blocks = [(512, 512), (1024, 1024)]
+        for bq, bk in blocks:
+            c = dataclasses.replace(
+                cfg8, use_flash=True, flash_block_q=bq, flash_block_k=bk
+            )
+            run(c, batch, f"flash B={B} bq={bq} bk={bk}")
+
+
+if __name__ == "__main__":
+    main()

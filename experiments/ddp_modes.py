@@ -1,0 +1,139 @@
+"""Experiment: decompose CPU ft_ddp overhead; compare blocking vs pipelined
+vs pipelined+bf16. Not part of the repo deliverables."""
+import json
+import os
+import sys
+import time
+from datetime import timedelta
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from torchft_tpu.platform import apply_jax_platform_env
+
+apply_jax_platform_env()  # sitecustomize pins the axon backend otherwise
+
+import bench  # reuse _model_setup, _spawn_peer, _barrier
+
+import jax
+import numpy as np
+import optax
+
+from torchft_tpu import (
+    FTTrainState,
+    HostCollectives,
+    Lighthouse,
+    Manager,
+    OptimizerWrapper,
+    PipelinedDDP,
+)
+from torchft_tpu.models import init_params, loss_fn
+
+cfg, batch, on_tpu = bench._model_setup()
+os.environ["BENCH_FORCE_LAYERS"] = str(cfg.n_layers)
+tx = optax.adamw(1e-3)
+grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
+
+params0 = init_params(cfg, jax.random.PRNGKey(0))
+n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params0))
+print(f"n_params={n_params/1e6:.1f}M  ({n_params*4/1e6:.0f} MB f32)")
+
+# raw
+state_p = params0
+opt_state = tx.init(state_p)
+apply_jit = jax.jit(
+    lambda p, o, g: (lambda u, no: (optax.apply_updates(p, u), no))(
+        *tx.update(g, o, p)
+    ),
+    donate_argnums=(0, 1),
+)
+for _ in range(3):
+    loss, grads = grad_fn(state_p, batch)
+    state_p, opt_state = apply_jit(state_p, opt_state, grads)
+bench._barrier(state_p)
+N = 10
+t0 = time.perf_counter()
+for _ in range(N):
+    loss, grads = grad_fn(state_p, batch)
+    state_p, opt_state = apply_jit(state_p, opt_state, grads)
+bench._barrier(state_p)
+raw_sps = N / (time.perf_counter() - t0)
+print(f"raw: {raw_sps:.3f} steps/s ({1/raw_sps*1000:.0f} ms/step)")
+
+def run_mode(mode: str, steps: int = 10, warm: int = 2) -> float:
+    # Fresh lighthouse per mode: back-to-back modes on one lighthouse leave
+    # <5s-old ghost heartbeats from the previous mode's members, and the new
+    # step-0 manager heals from a dead ghost at step N (urlopen timeout).
+    lighthouse = Lighthouse(bind="[::]:0", min_replicas=1,
+                            join_timeout_ms=5000, quorum_tick_ms=50)
+    wire = "bf16" if mode == "pipelined_bf16" else "f32"
+    peer = bench._spawn_peer(lighthouse.address(), warm + steps, wire)
+    state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
+    collectives = HostCollectives(timeout=timedelta(seconds=600))
+    manager = Manager(
+        collectives=collectives,
+        load_state_dict=state.load_state_dict,
+        state_dict=state.state_dict,
+        min_replica_size=1,
+        timeout=timedelta(seconds=300),
+        quorum_timeout=timedelta(seconds=300),
+        rank=0,
+        world_size=1,
+        lighthouse_addr=lighthouse.address(),
+        # MUST sort before "bench_peer": the step-0 primary is the
+        # first-sorted replica id, and the peer (allow_heal=False) never
+        # serves checkpoints — a main process sorting second would try to
+        # heal from it and block until timeout.
+        replica_id=f"bench_main_{mode}",
+    )
+    if mode == "blocking":
+        optimizer = OptimizerWrapper(manager, state)
+
+        def one():
+            optimizer.zero_grad()
+            loss, grads = grad_fn(state.params, batch)
+            avg = manager.allreduce(grads).wait()
+            optimizer.step(avg)
+
+        for _ in range(warm):
+            one()
+        bench._barrier(state.params)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            one()
+        bench._barrier(state.params)
+        dt = time.perf_counter() - t0
+    else:
+        compress = "bf16" if mode == "pipelined_bf16" else None
+        ddp = PipelinedDDP(manager, state,
+                           lambda p, b: grad_fn(p, b), compress=compress)
+        for _ in range(warm - 1):
+            ddp.step(batch)
+        # warm boundary: settle so the timed region starts clean
+        ddp.step(batch)
+        bench._barrier(state.params)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            ddp.step(batch)
+        # the final in-flight settle belongs to the timed steps
+        ddp.flush()
+        bench._barrier(state.params)
+        dt = time.perf_counter() - t0
+    sps = steps / dt
+    snap = manager.metrics().snapshot()
+    assert collectives.size() == 2, "peer did not join"
+    peer.wait(timeout=120)
+    manager.shutdown()
+    collectives.shutdown()
+    lighthouse.shutdown()
+    keep = {k: v for k, v in snap.items()
+            if any(s in k for s in ("quorum", "allreduce", "commit", "reconf"))}
+    print(f"{mode}: {sps:.3f} steps/s (ratio {sps/raw_sps:.3f})")
+    print("   metrics:", json.dumps(keep, default=str)[:600])
+    return sps
+
+
+for m in ("blocking", "pipelined", "pipelined_bf16"):
+    run_mode(m)
+print("done")

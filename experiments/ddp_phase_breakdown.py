@@ -1,0 +1,99 @@
+"""Phase-level breakdown of one CPU ft_ddp step (2-process ring)."""
+import json
+import os
+import sys
+import time
+from datetime import timedelta
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from torchft_tpu.platform import apply_jax_platform_env
+
+apply_jax_platform_env()
+
+import bench
+
+import jax
+import numpy as np
+import optax
+
+from torchft_tpu import (
+    FTTrainState,
+    HostCollectives,
+    Lighthouse,
+    Manager,
+    OptimizerWrapper,
+)
+from torchft_tpu.models import init_params, loss_fn
+
+cfg, batch, _ = bench._model_setup()
+os.environ["BENCH_FORCE_LAYERS"] = str(cfg.n_layers)
+tx = optax.adamw(1e-3)
+grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
+
+lighthouse = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=5000,
+                        quorum_tick_ms=50)
+steps, warm = 8, 2
+peer = bench._spawn_peer(lighthouse.address(), warm + steps, "f32")
+state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
+collectives = HostCollectives(timeout=timedelta(seconds=600))
+manager = Manager(
+    collectives=collectives,
+    load_state_dict=state.load_state_dict,
+    state_dict=state.state_dict,
+    min_replica_size=1,
+    timeout=timedelta(seconds=300),
+    quorum_timeout=timedelta(seconds=300),
+    rank=0,
+    world_size=1,
+    lighthouse_addr=lighthouse.address(),
+    replica_id="bench_main_probe",
+)
+optimizer = OptimizerWrapper(manager, state)
+
+
+def one(record=None):
+    t0 = time.perf_counter()
+    optimizer.zero_grad()
+    t1 = time.perf_counter()
+    loss, grads = grad_fn(state.params, batch)
+    jax.block_until_ready(grads)
+    t2 = time.perf_counter()
+    work = manager.allreduce(grads)
+    t3 = time.perf_counter()
+    avg = work.wait()
+    t4 = time.perf_counter()
+    jax.block_until_ready(avg)
+    t5 = time.perf_counter()
+    optimizer.step(avg)
+    jax.block_until_ready(state.params)
+    t6 = time.perf_counter()
+    if record is not None:
+        record.append({
+            "zero_grad": t1 - t0,
+            "grad": t2 - t1,
+            "dispatch": t3 - t2,
+            "ring_wait": t4 - t3,
+            "avg_ready": t5 - t4,
+            "apply": t6 - t5,
+            "total": t6 - t0,
+        })
+
+
+for _ in range(warm):
+    one()
+recs = []
+for _ in range(steps):
+    one(recs)
+med = {k: round(sorted(r[k] for r in recs)[len(recs) // 2] * 1000, 1)
+       for k in recs[0]}
+print("median ms per phase:", json.dumps(med))
+snap = manager.metrics().snapshot()
+print("metrics:", json.dumps(snap, default=str))
+assert collectives.size() == 2
+peer.wait(timeout=120)
+manager.shutdown()
+collectives.shutdown()
+lighthouse.shutdown()

@@ -1,0 +1,163 @@
+"""TPU experiment: attention fwd+bwd at the big bench shape — dense vs our
+flash (block sweep) vs jax's built-in pallas flash; then whole-model check.
+Run ALONE on the chip (memory: concurrent TPU work wrecks timings)."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, S, H, D = 4, 2048, 16, 64
+MODE = os.environ.get("EXP_MODE", "attn")  # attn | model
+
+
+def drain(x):
+    jax.block_until_ready(x)
+    np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0:1])
+
+
+def bench(fn, args, warm=2, iters=8, label=""):
+    for _ in range(warm):
+        out = fn(*args)
+    drain(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    drain(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:40s} {dt*1000:8.2f} ms", flush=True)
+    return dt
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu", "needs the real chip"
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+
+    if MODE == "attn":
+        def dense(q, k, v):
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+            causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+        def fwdbwd(attn_fn):
+            def loss(q, k, v):
+                return jnp.sum(attn_fn(q, k, v).astype(jnp.float32)) / (B * S * H * D)
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        bench(fwdbwd(dense), (q, k, v), label="dense")
+
+        from torchft_tpu.ops import flash_attention
+        for bq, bk in [(128, 128), (256, 256), (512, 512), (256, 512),
+                       (512, 256), (128, 512), (512, 128), (1024, 512),
+                       (512, 1024), (1024, 1024)]:
+            fn = functools.partial(
+                flash_attention, causal=True, block_q=bq, block_k=bk
+            )
+            try:
+                bench(fwdbwd(fn), (q, k, v), label=f"ours bq={bq} bk={bk}")
+            except Exception as e:
+                print(f"ours bq={bq} bk={bk}: FAIL {type(e).__name__}: {str(e)[:120]}",
+                      flush=True)
+
+        # builtin flash wants (B, H, S, D)
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jflash,
+        )
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+
+        def builtin(qt, kt, vt):
+            return jflash(qt, kt, vt, causal=True, sm_scale=D ** -0.5)
+
+        def fwdbwd_t(fn):
+            def loss(a, b, c):
+                return jnp.sum(fn(a, b, c).astype(jnp.float32)) / (B * S * H * D)
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        try:
+            bench(fwdbwd_t(builtin), (qt, kt, vt), label="jax builtin flash")
+        except Exception as e:
+            print(f"builtin: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
+    else:
+        # whole-model comparison at the big config: batch x attention sweep
+        import optax
+        from torchft_tpu.models import TransformerConfig, init_params, loss_fn
+
+        rng = np.random.default_rng(0)
+        tx = optax.adamw(1e-3)
+        variants = [
+            ("dense_B4", 4, {}),
+            ("flash_B4", 4, {"use_flash": True}),
+            ("dense_B8", 8, {}),
+            ("flash_B8", 8, {"use_flash": True}),
+            ("dense_B16", 16, {}),
+            ("flash_B16", 16, {"use_flash": True}),
+        ]
+        only = os.environ.get("EXP_ONLY")
+        for name, bsz, kw in variants:
+            if only and only not in name:
+                continue
+            batch = jnp.asarray(
+                rng.integers(0, 8192, size=(bsz, 2048), dtype=np.int32)
+            )
+            cfg = TransformerConfig(
+                vocab_size=8192, d_model=1024, n_heads=16, n_layers=8,
+                d_ff=4096, max_seq_len=2048, **kw,
+            )
+            n_params = None
+            try:
+                params = init_params(cfg, jax.random.PRNGKey(0))
+                n_params = sum(
+                    int(np.prod(l.shape))
+                    for l in jax.tree_util.tree_leaves(params)
+                )
+                opt_state = tx.init(params)
+                grad_fn = jax.jit(
+                    jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b))
+                )
+                apply_jit = jax.jit(
+                    lambda p, o, g: (
+                        lambda u, no: (optax.apply_updates(p, u), no)
+                    )(*tx.update(g, o, p)),
+                    donate_argnums=(0, 1),
+                )
+
+                def step(params, opt_state):
+                    loss, grads = grad_fn(params, batch)
+                    return apply_jit(params, opt_state, grads)
+
+                for _ in range(2):
+                    params, opt_state = step(params, opt_state)
+                drain(params)
+                t0 = time.perf_counter()
+                N = 8
+                for _ in range(N):
+                    params, opt_state = step(params, opt_state)
+                drain(params)
+                sps = N / (time.perf_counter() - t0)
+                tflops = 6 * n_params * batch.size * sps / 1e12
+                print(
+                    f"model {name:12s} {sps:6.3f} steps/s "
+                    f"{tflops:6.1f} param-TFLOP/s",
+                    flush=True,
+                )
+                del params, opt_state
+            except Exception as e:
+                print(f"model {name}: FAIL {type(e).__name__}: {str(e)[:150]}",
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()

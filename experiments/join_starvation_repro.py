@@ -1,0 +1,76 @@
+"""Repro: two groups form a cohort; a third joins 2 s late. How long until
+it participates? (TPU churn showed a 43 s starvation.)"""
+import os
+import sys
+import threading
+import time
+from datetime import timedelta
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from torchft_tpu.platform import apply_jax_platform_env
+
+apply_jax_platform_env()
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchft_tpu import (
+    FTTrainState,
+    HostCollectives,
+    Lighthouse,
+    Manager,
+    OptimizerWrapper,
+)
+
+logdir = "/tmp/exp_join"
+os.makedirs(logdir, exist_ok=True)
+
+lighthouse = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+                        quorum_tick_ms=50, heartbeat_timeout_ms=500)
+
+
+def worker(gid: int, delay: float, steps: int, out: dict) -> None:
+    time.sleep(delay)
+    state = FTTrainState({"w": jnp.ones((4,), jnp.float32)}, optax.sgd(0.1))
+    collectives = HostCollectives(timeout=timedelta(seconds=30))
+    manager = Manager(
+        collectives=collectives,
+        load_state_dict=state.load_state_dict,
+        state_dict=state.state_dict,
+        min_replica_size=1,
+        heartbeat_interval=timedelta(milliseconds=50),
+        replica_id=f"join_{gid}",
+        lighthouse_addr=lighthouse.address(),
+    )
+    optimizer = OptimizerWrapper(manager, state)
+    t_mgr = time.time()
+    first_multi = None
+    grads = {"w": jnp.ones((4,), jnp.float32)}
+    while manager.current_step() < steps:
+        optimizer.zero_grad()
+        avg = manager.allreduce(grads).wait()
+        optimizer.step(avg)
+        n = manager.num_participants()
+        if n >= 3 and first_multi is None:
+            first_multi = time.time() - t_mgr
+        time.sleep(0.05)  # ~20 steps/s pace
+    out[gid] = {"first_3party_s": first_multi, "final_step": manager.current_step()}
+    manager.shutdown()
+    collectives.shutdown()
+
+
+out: dict = {}
+ts = [
+    threading.Thread(target=worker, args=(0, 0.0, 200, out)),
+    threading.Thread(target=worker, args=(1, 0.0, 200, out)),
+    threading.Thread(target=worker, args=(2, 2.0, 200, out)),
+]
+t0 = time.time()
+for t in ts:
+    t.start()
+for t in ts:
+    t.join(timeout=120)
+print("elapsed", round(time.time() - t0, 1), out)
+lighthouse.shutdown()

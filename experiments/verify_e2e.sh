@@ -1,0 +1,48 @@
+#!/bin/bash
+# E2E verify: lighthouse CLI + dashboard + 2-group train_ddp with a
+# mid-run SIGKILL and live heal. CPU JAX.
+set -ex
+cd "$(dirname "$0")"
+# The demo MLP runs ~hundreds of steps/s: the step count must be large
+# enough that the kill, the ~8 s restart (interpreter + jax import), and
+# the heal all land while the survivor is still training.
+export JAX_PLATFORMS=cpu NUM_STEPS=20000 NUM_REPLICA_GROUPS=2
+export TORCHFT_COMPILE_CACHE=/tmp/verify_jax_cache
+
+pkill -f '[t]orchft_tpu.lighthouse' || true
+python -m torchft_tpu.lighthouse --bind '[::]:29511' --min_replicas 1 \
+    --join_timeout_ms 2000 --quorum_tick_ms 50 --heartbeat_timeout_ms 1000 \
+    > /tmp/verify_lh.log 2>&1 &
+LH_PID=$!
+sleep 2
+export TORCHFT_LIGHTHOUSE=http://localhost:29511
+
+curl -sf http://localhost:29511/ | grep -qi torchft
+curl -sf http://localhost:29511/status > /tmp/verify_status0.html
+
+REPLICA_GROUP_ID=0 python examples/train_ddp.py > /tmp/verify_g0.log 2>&1 &
+G0=$!
+REPLICA_GROUP_ID=1 python examples/train_ddp.py > /tmp/verify_g1.log 2>&1 &
+G1=$!
+
+# wait until group 1 is actually training, then SIGKILL it and restart
+for i in $(seq 1 120); do
+    grep -q "step=200" /tmp/verify_g1.log && break
+    sleep 1
+done
+grep -q "step=200" /tmp/verify_g1.log
+kill -9 $G1 || true
+REPLICA_GROUP_ID=1 python examples/train_ddp.py > /tmp/verify_g1b.log 2>&1 &
+G1B=$!
+
+wait $G0; RC0=$?
+wait $G1B; RC1=$?
+kill $LH_PID || true
+
+test $RC0 -eq 0
+test $RC1 -eq 0
+grep -q "done: step=20000" /tmp/verify_g0.log
+grep -q "done: step=20000" /tmp/verify_g1b.log
+# the restarted group healed live from the surviving peer
+grep -qi "healing required, fetching checkpoint" /tmp/verify_g1b.log
+echo "E2E VERIFY OK"
