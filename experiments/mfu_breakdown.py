@@ -1,0 +1,161 @@
+"""TPU experiment: where does the big-config step time go?
+
+Component attribution for the flagship 111M-param LM (d_model 1024, H16,
+L8, d_ff 4096, S 2048, B 16, flash attention) — the round-3 verdict's #1
+ask is MFU 37% -> >=50%, so before pulling levers we measure:
+
+  matmul_roofline   what a plain big bf16 matmul sustains on THIS chip
+                    through THIS tunnel (the real ceiling; v5e paper peak
+                    is 197 TFLOP/s bf16)
+  step_full         the fused train step (the bench's measured number)
+  grad_only         value_and_grad without the optimizer apply
+  fwd_only          forward loss only
+  step_no_attn      train step with attention replaced by an identity
+                    projection (attention cost by subtraction)
+  step_mean_loss    train step with cross-entropy replaced by mean(logits)
+                    (xent + log_softmax cost by subtraction)
+  attn_standalone   the flash kernel fwd+bwd at the in-model shape
+                    (B*layers calls folded into one timing)
+
+Run ALONE on the chip (one tunneled v5e; concurrent TPU work wrecks both
+timings). Queue-and-drain discipline per the repo's benchmarking notes.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchft_tpu.models import TransformerConfig, init_params, loss_fn, make_train_step
+from torchft_tpu.models import transformer as T
+
+B, S = 16, 2048
+CFG = dict(vocab_size=8192, d_model=1024, n_heads=16, n_layers=8,
+           d_ff=4096, max_seq_len=2048)
+
+
+def drain(x):
+    jax.block_until_ready(x)
+    np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0:1])
+
+
+def bench(fn, make_args, warm=2, iters=8, label="", chain=False):
+    """chain=True: fn(state) -> state, threaded through iterations (train
+    steps with donation); else fn(*args) re-called on the same args."""
+    args = make_args()
+    if chain:
+        state = args
+        for _ in range(warm):
+            state = fn(state)
+        drain(state)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state = fn(state)
+        drain(state)
+    else:
+        for _ in range(warm):
+            out = fn(*args)
+        drain(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        drain(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:20s} {dt * 1000:9.2f} ms", flush=True)
+    return dt
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu", "needs the real chip"
+    from torchft_tpu.platform import apply_compilation_cache_env
+
+    apply_compilation_cache_env(
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".bench_jax_cache")
+    )
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(rng.integers(0, 8192, size=(B, S), dtype=np.int32))
+    tx = optax.adamw(1e-3)
+
+    # -- roofline probe: plain big bf16 matmul, MXU-shaped --
+    M = 8192
+    a = jax.random.normal(jax.random.PRNGKey(1), (M, M), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(2), (M, M), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: a @ b)
+    dt = bench(mm, lambda: (a, b), iters=32, label="matmul 8192^3")
+    print(f"  -> {2 * M**3 / dt / 1e12:.1f} TFLOP/s sustained", flush=True)
+    del a, b
+
+    flash_cfg = TransformerConfig(use_flash=True, **CFG)
+    n_params = sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(
+                       init_params(flash_cfg, jax.random.PRNGKey(0))))
+    ptf = 6 * n_params * B * S / 1e12
+    print(f"params {n_params / 1e6:.1f}M  param-TFLOP/step {ptf:.2f}",
+          flush=True)
+
+    def fresh_state():
+        p = init_params(flash_cfg, jax.random.PRNGKey(0))
+        return (p, tx.init(p))
+
+    # -- full fused step --
+    step = make_train_step(flash_cfg, tx)
+    dt = bench(lambda st: step(st[0], st[1], batch)[:2], fresh_state,
+               label="step_full", chain=True)
+    print(f"  -> {ptf / dt:.1f} param-TFLOP/s", flush=True)
+
+    # -- grad only (no apply; non-donating) --
+    gf = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(flash_cfg, p, b)))
+    p0 = init_params(flash_cfg, jax.random.PRNGKey(0))
+    bench(gf, lambda: (p0, batch), label="grad_only")
+
+    # -- forward only --
+    ff = jax.jit(lambda p, b: loss_fn(flash_cfg, p, b))
+    bench(ff, lambda: (p0, batch), label="fwd_only")
+
+    # -- attention cost by subtraction: identity-attention model --
+    real_attn = T._attention_impl
+    try:
+        T._attention_impl = lambda cfg, p, x: x @ p["wo"].astype(cfg.dtype)
+        step_na = make_train_step(flash_cfg, tx)
+        bench(lambda st: step_na(st[0], st[1], batch)[:2], fresh_state,
+              label="step_no_attn", chain=True)
+    finally:
+        T._attention_impl = real_attn
+
+    # -- xent cost by subtraction: mean-logit loss --
+    real_loss = T.next_token_loss
+    try:
+        T.next_token_loss = lambda logits, targets: jnp.mean(logits)
+        step_ml = make_train_step(flash_cfg, tx)
+        bench(lambda st: step_ml(st[0], st[1], batch)[:2], fresh_state,
+              label="step_mean_loss", chain=True)
+    finally:
+        T.next_token_loss = real_loss
+
+    # -- standalone flash fwd+bwd at the in-model shape (S-1 = 2047) --
+    from torchft_tpu.ops import flash_attention
+
+    Sm = S - 1
+    q = jax.random.normal(jax.random.PRNGKey(3), (B, Sm, 16, 64), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(4), (B, Sm, 16, 64), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(5), (B, Sm, 16, 64), jnp.bfloat16)
+
+    def aloss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+
+    af = jax.jit(jax.grad(aloss, argnums=(0, 1, 2)))
+    dt = bench(af, lambda: (q, k, v), label="attn_standalone")
+    attn_flop = 4 * B * 16 * Sm * Sm * 64 / 2 * 3.5  # causal, fwd+2.5x bwd
+    print(f"  -> x8 layers = {dt * 8 * 1000:.1f} ms/step; "
+          f"{attn_flop / dt / 1e12:.1f} TFLOP/s eff (causal-counted)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

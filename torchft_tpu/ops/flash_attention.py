@@ -8,10 +8,37 @@ recurrence (the same one ``context_parallel.ring_attention`` uses across
 devices, here across VMEM tiles within one device), and writes only the
 (S, D) output plus an (S,) logsumexp residual for the backward pass.
 
-Backward is the standard two-kernel FlashAttention-2 split: one kernel
-accumulates dq over key blocks, one accumulates dk/dv over query blocks,
-both recomputing probabilities from the saved logsumexp instead of storing
-the S×S matrix.
+Backward is ONE fused kernel (not the two-kernel FlashAttention-2 split):
+the grid walks key blocks; dk/dv accumulate in VMEM per key block, and dq
+accumulates into a full-row f32 output block that pallas keeps resident
+across the sequential TPU grid (revisited index map — grid steps on TPU
+execute in order, so read-modify-write accumulation is deterministic).
+Fusing matters because this shape is VPU-bound, not MXU-bound (head_dim
+64: each S×S exp pass costs more than the matmuls it feeds): the split
+design recomputes probabilities twice per tile pair — once for dq, once
+for dk/dv — and the fused kernel computes them once, cutting the
+dominant exp/elementwise work ~in half and the matmul count 7→5 per
+tile. The softmax scale is folded into q OUTSIDE the kernel (exact for
+power-of-two scales, e.g. head_dim 64 → 0.125), removing the per-tile
+S×S scale multiplies; autodiff of the fold rescales dq automatically.
+
+The causal path splits every tile loop into UNMASKED interior tiles plus
+one masked diagonal tile (requires block_q == block_k, the auto default):
+strictly-below-diagonal tiles are fully live, so the interior body skips
+the iota/compare/select mask passes entirely — measured 57% of the
+flagship step was attention, and the mask/guard VPU passes were a third
+of the kernel (experiments/mfu_breakdown.py). The fast path also uses a
+finite -1e30 mask value instead of -inf, which removes every
+``isfinite`` guard from the online-softmax recurrence: with at least one
+live key per query row (guaranteed on the causal path — every row
+attends at least its own position; padded query rows attend earlier live
+keys), ``exp(-1e30 - m)`` underflows to exactly 0 and the recurrence
+needs no special cases. The backward kernels apply NO padding mask at
+all: padded k/v rows are zeros, so padded-column score/probability
+garbage contributes exactly 0 to dq (``ds @ k`` hits zero rows) and only
+to dk/dv rows that are sliced off; padded query rows carry zero
+cotangents. The general path (sliding window, unequal blocks,
+non-causal) keeps per-tile masks.
 
 Design notes (pallas_guide.md):
 - all matmuls request ``preferred_element_type=float32`` so the MXU
@@ -48,6 +75,10 @@ from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
 _NEG_INF = float("-inf")
+# Finite mask value for the fast (split-diagonal) path: large enough that
+# exp(_NEG_LARGE - m) underflows to exactly 0 for any live row max m
+# (|m| <= ~1e4 in practice), small enough to stay exact in f32.
+_NEG_LARGE = -1e30
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -60,6 +91,22 @@ def _dot_f32(a: jax.Array, b: jax.Array) -> jax.Array:
     on v5e)."""
     return jax.lax.dot_general(
         a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_nt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a @ b.T via dot_general dimension numbers — Mosaic contracts the
+    shared minor dim directly instead of materializing b.T (an explicit
+    .T is a per-tile VMEM relayout pass)."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_tn(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a.T @ b without materializing a.T (contract the major dims)."""
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
 
 
@@ -93,32 +140,56 @@ def _tile_mask(
 # ---------------------------------------------------------------------------
 
 
+def _split_diag(causal: bool, window, block_q: int, block_k: int) -> bool:
+    """True when the tile loops may run as unmasked-interior + one masked
+    diagonal tile (see module docstring). Requires equal blocks so the
+    diagonal tile of query block qi is exactly key block qi."""
+    return causal and window is None and block_q == block_k
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-    sm_scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
+    causal: bool, block_q: int, block_k: int, num_k: int,
     kv_len: int, window,
 ):
+    # q arrives PRE-SCALED by sm_scale (folded outside the kernel), so
+    # s = q @ k.T is the final score with no per-tile S x S multiply.
     qi = pl.program_id(1)
     q = q_ref[0]  # (block_q, D), input dtype
     D = q.shape[-1]
     padded = kv_len < num_k * block_k
+    fast = _split_diag(causal, window, block_q, block_k)
+    neg = _NEG_LARGE if fast else _NEG_INF
 
-    def body(j, carry):
+    def tile(j, carry, masked: bool):
         m, l, acc = carry
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = _dot_f32(q, k_blk.T) * sm_scale  # (block_q, block_k) f32
-        ok = _tile_mask(
-            qi * block_q, j * block_k, block_q, block_k, kv_len,
-            causal, padded, window,
-        )
-        if ok is not None:
-            s = jnp.where(ok, s, _NEG_INF)
+        s = _dot_nt(q, k_blk)  # (block_q, block_k) f32
+        if masked:
+            ok = _tile_mask(
+                qi * block_q, j * block_k, block_q, block_k, kv_len,
+                causal, padded, window,
+            )
+            if ok is not None:
+                s = jnp.where(ok, s, neg)
         m_new = jnp.maximum(m, s.max(axis=-1))
-        # rows with every key masked keep m = -inf; guard exp(-inf - -inf)
-        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe_m[:, None]), 0.0)
-        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        if fast:
+            # every query row has >= 1 live key (causal: its own position,
+            # or for zero-padded query rows any earlier live key), so
+            # m_new is finite after the first processed tile and the
+            # -inf/isfinite guards of the general path are dead weight:
+            # exp(_NEG_LARGE - m_new) underflows to exactly 0.
+            p = jnp.exp(s - m_new[:, None])
+            corr = jnp.exp(m - m_new)
+        else:
+            # rows with every key masked keep m = -inf; guard
+            # exp(-inf - -inf)
+            safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.where(
+                jnp.isfinite(s), jnp.exp(s - safe_m[:, None]), 0.0
+            )
+            corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
         l_new = l * corr + p.sum(axis=-1)
         acc_new = acc * corr[:, None] + _dot_f32(
             p.astype(v_blk.dtype), v_blk
@@ -126,46 +197,62 @@ def _fwd_kernel(
         return m_new, l_new, acc_new
 
     init = (
-        jnp.full((block_q,), _NEG_INF, jnp.float32),
+        jnp.full((block_q,), neg, jnp.float32),
         jnp.zeros((block_q,), jnp.float32),
         jnp.zeros((block_q, D), jnp.float32),
     )
-    num_k_live = _cdiv(kv_len, block_k)  # skip fully-padded key blocks
-    if causal:
-        # key blocks strictly above the block diagonal are fully masked
-        hi = jnp.minimum(
-            num_k_live, ((qi + 1) * block_q + block_k - 1) // block_k
+    if fast:
+        # interior tiles j < qi are fully below the causal diagonal (and
+        # never reach padded key columns: cols < qi*block_k < kv_len), so
+        # they run with no mask at all; the diagonal tile j == qi carries
+        # the causal mask and (in the last row block) the padding mask.
+        m, l, acc = jax.lax.fori_loop(
+            0, qi, lambda j, c: tile(j, c, False), init
         )
+        m, l, acc = tile(qi, (m, l, acc), True)
     else:
-        hi = num_k_live
-    lo = 0
-    if window is not None:
-        # key blocks fully left of the sliding window are masked for
-        # every query row in this block
-        lo = jnp.maximum(0, (qi * block_q - window + 1) // block_k)
-    m, l, acc = jax.lax.fori_loop(lo, hi, body, init)
+        num_k_live = _cdiv(kv_len, block_k)  # skip fully-padded key blocks
+        if causal:
+            # key blocks strictly above the block diagonal are fully masked
+            hi = jnp.minimum(
+                num_k_live, ((qi + 1) * block_q + block_k - 1) // block_k
+            )
+        else:
+            hi = num_k_live
+        lo = 0
+        if window is not None:
+            # key blocks fully left of the sliding window are masked for
+            # every query row in this block
+            lo = jnp.maximum(0, (qi * block_q - window + 1) // block_k)
+        m, l, acc = jax.lax.fori_loop(
+            lo, hi, lambda j, c: tile(j, c, True), init
+        )
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     # lse rides a full-row (1, 1, S) block revisited across the sequential
     # qi grid dim (a (1, block_q) 2D block violates Mosaic's (8, 128) tile
     # floor); each step writes its slice
-    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = jnp.where(
-        jnp.isfinite(m), m + jnp.log(l_safe), _NEG_INF
-    )
+    if fast:
+        # m is finite for every row (see tile()); no -inf bookkeeping
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = m + jnp.log(l_safe)
+    else:
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = jnp.where(
+            jnp.isfinite(m), m + jnp.log(l_safe), _NEG_INF
+        )
 
 
 def _flash_fwd_call(
     q: jax.Array, k: jax.Array, v: jax.Array, *,
-    sm_scale: float, causal: bool, block_q: int, block_k: int,
+    causal: bool, block_q: int, block_k: int,
     interpret: bool, kv_len: int, window,
 ):
-    """q/k/v: (BH, S_pad, D) -> out (BH, S_pad, D), lse (BH, 1, S_pad)
-    f32. Positions >= kv_len are zero padding, masked out of every
-    softmax."""
+    """q (pre-scaled)/k/v: (BH, S_pad, D) -> out (BH, S_pad, D),
+    lse (BH, 1, S_pad) f32. Positions >= kv_len are zero padding, masked
+    out of every softmax."""
     BH, S, D = q.shape
     num_q, num_k = _cdiv(S, block_q), _cdiv(S, block_k)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        _fwd_kernel, causal=causal,
         block_q=block_q, block_k=block_k, num_k=num_k, kv_len=kv_len,
         window=window,
     )
@@ -192,53 +279,10 @@ def _flash_fwd_call(
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-    sm_scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
-    kv_len: int, window,
-):
-    qi = pl.program_id(1)
-    q = q_ref[0]  # (block_q, D), input dtype
-    do = do_ref[0]
-    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]  # (block_q,)
-    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
-    D = q.shape[-1]
-    padded = kv_len < num_k * block_k
-
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = _dot_f32(q, k_blk.T) * sm_scale
-        p = jnp.exp(s - lse[:, None])  # exp(-inf) = 0 for fully-masked rows
-        ok = _tile_mask(
-            qi * block_q, j * block_k, block_q, block_k, kv_len,
-            causal, padded, window,
-        )
-        if ok is not None:
-            p = jnp.where(ok, p, 0.0)
-        dp = _dot_f32(do, v_blk.T)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + _dot_f32(ds.astype(k_blk.dtype), k_blk)
-
-    num_k_live = _cdiv(kv_len, block_k)
-    if causal:
-        hi = jnp.minimum(
-            num_k_live, ((qi + 1) * block_q + block_k - 1) // block_k
-        )
-    else:
-        hi = num_k_live
-    lo = 0
-    if window is not None:
-        lo = jnp.maximum(0, (qi * block_q - window + 1) // block_k)
-    dq = jax.lax.fori_loop(
-        lo, hi, body, jnp.zeros((block_q, D), jnp.float32)
-    )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-    sm_scale: float, causal: bool, block_q: int, block_k: int, num_q: int,
+def _bwd_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref, *,
+    causal: bool, block_q: int, block_k: int, num_q: int,
     kv_len: int, window,
 ):
     ki = pl.program_id(1)
@@ -246,58 +290,81 @@ def _bwd_dkv_kernel(
     v_blk = v_ref[0]
     D = k_blk.shape[-1]
     # Padded QUERY rows need no mask here: their cotangent (do) and delta
-    # are zero, so ds and p.T @ do vanish. Padded KEY columns do: their
-    # scores are finite (zero), and without masking they would scatter
-    # real-query probability mass into dk/dv of positions that are then
-    # sliced off — and, worse, steal none from real keys since p is
-    # recomputed, not renormalized.
+    # are zero, so ds and p.T @ do vanish (their lse is finite on both
+    # paths — causal padded query rows attend earlier live keys — so p
+    # stays finite and 0 * p cannot produce NaN). On the general path,
+    # padded KEY columns are masked; the fast path drops that mask too:
+    # p/ds garbage in padded columns lands only in dk/dv ROWS that the
+    # caller slices off (each dk/dv row is a column-wise independent sum),
+    # so masking them buys nothing.
     padded = kv_len < q_ref.shape[1]  # static: S_pad > kv_len
+    fast = _split_diag(causal, window, block_q, block_k)
 
-    def body(i, carry):
+    # dq accumulates into a REVISITED full-row f32 output block: the TPU
+    # grid is sequential, so every ki step of one bh row sees the same
+    # resident VMEM block; zero it on the first step.
+    @pl.when(ki == 0)
+    def _init_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    def tile(i, carry, masked: bool):
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
-        s = _dot_f32(q_blk, k_blk.T) * sm_scale
+        s = _dot_nt(q_blk, k_blk)  # q pre-scaled by sm_scale
         p = jnp.exp(s - lse[:, None])
-        ok = _tile_mask(
-            i * block_q, ki * block_k, block_q, block_k, kv_len,
-            causal, padded, window,
-        )
-        if ok is not None:
-            p = jnp.where(ok, p, 0.0)
-        dv_new = dv + _dot_f32(p.T.astype(do_blk.dtype), do_blk)
-        dp = _dot_f32(do_blk, v_blk.T)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        dk_new = dk + _dot_f32(ds.T.astype(q_blk.dtype), q_blk)
+        if masked:
+            ok = _tile_mask(
+                i * block_q, ki * block_k, block_q, block_k, kv_len,
+                causal, padded and not fast, window,
+            )
+            if ok is not None:
+                p = jnp.where(ok, p, 0.0)
+        dv_new = dv + _dot_tn(p.astype(do_blk.dtype), do_blk)
+        dp = _dot_nt(do_blk, v_blk)
+        ds = (p * (dp - delta[:, None])).astype(q_blk.dtype)  # one cast,
+        dk_new = dk + _dot_tn(ds, q_blk)                      # used twice
+        dq_ref[0, pl.ds(i * block_q, block_q), :] += _dot_f32(ds, k_blk)
         return dk_new, dv_new
 
-    if causal:
-        # query blocks strictly below the block diagonal see none of this
-        # key block
-        lo = (ki * block_k) // block_q
-    else:
-        lo = 0
-    hi = num_q
-    if window is not None:
-        # query blocks fully right of the window (q_min - k_max >= w)
-        # see none of this key block
-        hi = jnp.minimum(
-            num_q, ((ki + 1) * block_k - 1 + window) // block_q + 1
-        )
-    dk, dv = jax.lax.fori_loop(
-        lo, hi, body,
-        (jnp.zeros((block_k, D), jnp.float32),
-         jnp.zeros((block_k, D), jnp.float32)),
+    init = (
+        jnp.zeros((block_k, D), jnp.float32),
+        jnp.zeros((block_k, D), jnp.float32),
     )
+    if fast:
+        # diagonal tile i == ki carries the causal mask; query blocks
+        # i > ki are fully below the diagonal (every q_pos >= every
+        # k_pos), so they run unmasked.
+        dk, dv = tile(ki, init, True)
+        dk, dv = jax.lax.fori_loop(
+            ki + 1, num_q, lambda i, c: tile(i, c, False), (dk, dv)
+        )
+    else:
+        if causal:
+            # query blocks strictly below the block diagonal see none of
+            # this key block
+            lo = (ki * block_k) // block_q
+        else:
+            lo = 0
+        hi = num_q
+        if window is not None:
+            # query blocks fully right of the window (q_min - k_max >= w)
+            # see none of this key block
+            hi = jnp.minimum(
+                num_q, ((ki + 1) * block_k - 1 + window) // block_q + 1
+            )
+        dk, dv = jax.lax.fori_loop(
+            lo, hi, lambda i, c: tile(i, c, True), init
+        )
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_call(
     q, k, v, o, lse, do, *,
-    sm_scale: float, causal: bool, block_q: int, block_k: int,
+    causal: bool, block_q: int, block_k: int,
     interpret: bool, kv_len: int, window,
 ):
     BH, S, D = q.shape
@@ -310,38 +377,26 @@ def _flash_bwd_call(
 
     row3 = pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0))
     row2 = pl.BlockSpec((1, 1, S), lambda bh, i: (bh, 0, 0))
-    qblk3 = pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0))
     kblk3 = pl.BlockSpec((1, block_k, D), lambda bh, i: (bh, i, 0))
 
-    dq = pl.pallas_call(
+    dq, dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_k=num_k, kv_len=kv_len,
-            window=window,
-        ),
-        grid=(BH, num_q),
-        in_specs=[qblk3, row3, row3, qblk3, row2, row2],
-        out_specs=qblk3,
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            _bwd_kernel, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q, kv_len=kv_len,
             window=window,
         ),
         grid=(BH, num_k),
         in_specs=[row3, kblk3, kblk3, row3, row2, row2],
-        out_specs=[kblk3, kblk3],
+        out_specs=[row3, kblk3, kblk3],
         out_shape=[
+            # dq is the revisited f32 accumulator (cast to q.dtype below)
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
             jax.ShapeDtypeStruct((BH, S, D), k.dtype),
             jax.ShapeDtypeStruct((BH, S, D), v.dtype),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    return dq.astype(q.dtype), dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -356,9 +411,9 @@ def _flash(cfg, q, k, v):
 
 
 def _flash_fwd_res(cfg, q, k, v):
-    sm_scale, causal, block_q, block_k, interpret, kv_len, window = cfg
+    causal, block_q, block_k, interpret, kv_len, window = cfg
     out, lse = _flash_fwd_call(
-        q, k, v, sm_scale=sm_scale, causal=causal,
+        q, k, v, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
         kv_len=kv_len, window=window,
     )
@@ -375,10 +430,10 @@ def _flash_fwd_res(cfg, q, k, v):
 
 
 def _flash_bwd_res(cfg, res, g):
-    sm_scale, causal, block_q, block_k, interpret, kv_len, window = cfg
+    causal, block_q, block_k, interpret, kv_len, window = cfg
     q, k, v, out, lse = res
     return _flash_bwd_call(
-        q, k, v, out, lse, g, sm_scale=sm_scale, causal=causal,
+        q, k, v, out, lse, g, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
         kv_len=kv_len, window=window,
     )
@@ -420,7 +475,10 @@ def flash_attention(
             S^2/2 (wall-clock gains show once S/window is large).
             Requires ``causal``.
         sm_scale: score scale; default ``head_dim ** -0.5``.
-        block_q, block_k: VMEM tile sizes; clamped to S. Default auto:
+        block_q, block_k: VMEM tile sizes; clamped to S, and on real TPU
+            rounded UP to 128-multiples (Mosaic's lane-aligned store
+            requirement — a requested 64 runs as 128 on hardware;
+            interpret mode honors small blocks exactly). Default auto:
             (512, 512) when the sublane-padded sequence length reaches
             2048, else (128, 128). Measured IN-MODEL on v5e (8-layer
             111M-param LM at padded S 2048, fused train step, head_dim
@@ -477,13 +535,21 @@ def flash_attention(
     # padding on the large-tile path. s8 >= 2048 admits exactly the
     # 2048-class shapes the measurements cover (FLASH_ABLATION.json at
     # padded S 2048; standalone 512-tile win at S 8192).
-    s8 = _cdiv(S, 8) * 8  # Mosaic sublane floor
+    # On hardware the lse row is sliced along the LANE dim in block_q-wide
+    # stores, so blocks must be 128-multiples (Mosaic rejects misaligned
+    # vector stores — observed at S=99 on v5e); interpret mode only needs
+    # the 8-sublane floor, and the CPU tests use small blocks.
+    unit = 8 if interp else 128
+    s8 = _cdiv(S, unit) * unit
     if s8 >= 2048:
         auto_q, auto_k = 512, 512
     else:
         auto_q, auto_k = 128, 128
     block_q = min(block_q or auto_q, s8)
     block_k = min(block_k or auto_k, s8)
+    if not interp:
+        block_q = _cdiv(block_q, 128) * 128
+        block_k = _cdiv(block_k, 128) * 128
     base = block_q * block_k // math.gcd(block_q, block_k)
     S_pad = _cdiv(S, base) * base
 
@@ -498,8 +564,15 @@ def flash_attention(
         return x
 
     cfg = (
-        float(sm_scale), bool(causal), block_q, block_k, interp, S,
+        bool(causal), block_q, block_k, interp, S,
         None if window is None else int(window),
     )
-    out = _flash(cfg, to_rows(q), to_rows(k), to_rows(v))
+    # sm_scale folded into q OUTSIDE the custom_vjp: one cheap (S, D)
+    # multiply replaces a per-tile (S_q, S_k) multiply in every kernel,
+    # and autodiff of this fold rescales dq automatically (exact for
+    # power-of-two scales — head_dim 64 gives 0.125). The product is
+    # computed with an f32 scalar so the scale itself is never quantized
+    # to bf16; only the single product rounding remains.
+    q_scaled = (q * jnp.float32(sm_scale)).astype(q.dtype)
+    out = _flash(cfg, to_rows(q_scaled), to_rows(k), to_rows(v))
     return out[:, :S].reshape(B, H, S, D).transpose(0, 2, 1, 3)
