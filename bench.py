@@ -8,39 +8,44 @@ unpacked (no world-size-1 identity shortcut).
 
 Configurations measured (details in BENCH_DETAIL.json):
 
-  raw         jitted loss/grad/apply loop, no FT machinery.
-  ft_ddp      per-step gradient allreduce through the ring (the reference
-              train_ddp mode), measured at representative arithmetic
-              intensity against a same-batch raw baseline; both the
-              blocking loop and PipelinedDDP (ring overlapped with the
-              next step's grads) are recorded. On a degraded device<->host
-              link it is skipped (per-step shipping is link-bound
-              regardless of framework) unless BENCH_FORCE_DDP=1, which
-              records the link-bound pipelined+bf16 number explicitly.
-  ft_diloco   AsyncDiLoCo — the bandwidth-appropriate cross-group mode this
-              framework ships for DCN-class links: inner steps stay on-chip
-              and the compressed pseudogradient sync runs once per window
-              (bf16 ring allreduce on healthy links; int8+error-feedback
-              allgather on degraded ones, 4x fewer bytes than f32). The
-              window is sized from the measured link so the sync stays a
-              small fraction of wall-clock, and the sync is overlapped with
-              the next window's compute on healthy links / run serially at
-              the boundary on degraded ones (where in-flight transfers
-              starve under the async dispatch flood). Full FT machinery
-              (quorum + commit vote) every window; best of 2 timed windows
-              reported (transient tunnel stalls recorded, not averaged in).
-              THIS is the headline.
-
-On TPU a fourth configuration runs an MXU-SATURATING model (d_model 1024,
-8 layers, seq 2048 — large batched bf16-friendly matmuls) so FT overhead is
-also measured at realistic arithmetic intensity, with the DiLoCo window
-sized from the measured transfer bandwidth so the sync can hide behind
-compute (results in BENCH_DETAIL.json "big"; set BENCH_SKIP_BIG=1 to skip).
+  raw           jitted loss/grad/apply loop, no FT machinery.
+  ft_diloco     AsyncDiLoCo on the smoke model — the bandwidth-appropriate
+                cross-group mode for DCN-class links: inner steps stay
+                on-chip and the compressed pseudogradient sync runs once
+                per window (bf16 ring allreduce on healthy links;
+                int8+error-feedback allgather on degraded ones). Window
+                sized from the measured link; full FT machinery (quorum +
+                commit vote) every window; best of 2 timed windows. Lands
+                the PROVISIONAL headline early so later phases can't lose
+                the round's metric.
+  ft_ddp_small  per-step DDP at a LINK-SIZED scale — runs on TPU every
+                round unconditionally: a ~0.72M-param S-2048 flash LM
+                whose int8/bf16 gradient ship fits the measured link, with
+                PipelinedDDP hiding the ring behind the next step's
+                compute. The per-step product's number on this hardware.
+  ft_ddp        flagship-scale per-step gradient allreduce (the reference
+                train_ddp mode) against a same-batch raw baseline;
+                blocking and PipelinedDDP both recorded. On a degraded
+                device<->host link it is skipped (per-step shipping of the
+                93 MB gradient is link-bound regardless of framework)
+                unless BENCH_FORCE_DDP=1. On CPU, BOTH the reference-like
+                small batch and the 4x-token batch land in the artifact
+                (the ratio is an arithmetic-intensity story).
+  big           the MXU-saturating model (111M params, d_model 1024, 8
+                layers, seq 2048, bf16 compute + f32 master): raw vs
+                AsyncDiLoCo with the window sized so the sync hides behind
+                compute. Its FT/raw ratio is THE HEADLINE (printed last;
+                the driver takes the last metric line) — FT cost at
+                deployment-class arithmetic intensity, with MFU accounting
+                against the v5e peak. Sub-results persist incrementally;
+                BENCH_SKIP_BIG=1 skips.
 
 The reference publishes no absolute numbers (BASELINE.md); the driver-set
 north star is >= 90% of healthy-state throughput. The printed line reports
-``vs_baseline = (ft_diloco_steps_per_sec / raw_steps_per_sec) / 0.90`` — 1.0
-means exactly the 90% bar, > 1.0 beats it. Throughput *under churn* is
+``vs_baseline = (ft_steps_per_sec / raw_steps_per_sec) / 0.90`` — 1.0
+means exactly the 90% bar, > 1.0 beats it; the FINAL line (the one the
+driver records) is the big phase's ratio when that phase completes, else
+the provisional small-model ft_diloco ratio. Throughput *under churn* is
 measured separately by bench_churn.py (CHURN_BENCH.json).
 
 Prints ONE JSON line, e.g.:
@@ -86,7 +91,26 @@ def _model_setup(size: str = None):
     # (before the ring grew its header check) deadlocked silently with
     # the peer's recv queue full.
     forced_layers = os.environ.get("BENCH_FORCE_LAYERS")
-    if size == "big":
+    if size == "ddp_small":
+        # Link-sized per-step DDP config (round-3 verdict #2): ~0.72M
+        # params -> 0.73 MB int8 / 1.45 MB bf16 wire, but LOTS of compute
+        # per param (S 2048 attention through the flash kernel), so the
+        # per-step gradient ship can hide behind the next step's compute
+        # (PipelinedDDP) even on a weak device<->host link. head_dim 64
+        # keeps the kernel on its fast path. Batch is chosen per-link in
+        # _bench_ddp_small.
+        cfg = TransformerConfig(
+            vocab_size=512,
+            d_model=128,
+            n_heads=2,
+            n_layers=2,
+            d_ff=512,
+            max_seq_len=2048,
+            use_flash=on_tpu,
+        )
+        batch_size = int(os.environ.get("BENCH_DDP_SMALL_BATCH", 64))
+        seq_len = 2048
+    elif size == "big":
         # MXU-saturating: d_model >= 1024 matmuls, seq 2048, bf16-sized
         # payloads. ~110M params at batch 16 x 2048 -> ~21.9 TFLOP/step.
         # Batch choice is MEASURED on v5e (fused train step, flash
@@ -180,17 +204,12 @@ def peer() -> None:
     params = init_params(cfg, jax.random.PRNGKey(0))
     peer_dtype = os.environ.get("BENCH_PEER_DTYPE")
     if peer_dtype == "int8":
-        # int8 windows travel as a managed ALLGATHER of
-        # {q: int8 leaves, scale: f32 scalars} (see AsyncDiLoCo); the
-        # peer's zero contribution is all-zero q with zero scales.
-        zeros = {
-            "q": jax.tree_util.tree_map(
-                lambda l: jnp.zeros(l.shape, jnp.int8), params
-            ),
-            "scale": jax.tree_util.tree_map(
-                lambda l: jnp.zeros((), jnp.float32), params
-            ),
-        }
+        # int8 payloads ride the ring's quantized wire (wire="q8"): the
+        # peer contributes the param-shaped f32 zero tree and the ring
+        # quantizes per chunk — same op header on both members.
+        zeros = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, jnp.float32), params
+        )
     else:
         wire_dtype = jnp.bfloat16 if peer_dtype == "bf16" else None
         zeros = jax.tree_util.tree_map(
@@ -240,7 +259,7 @@ def peer() -> None:
         if i > 0:
             manager.start_quorum(allow_heal=False)
         if peer_dtype == "int8":
-            manager.allgather(zeros).wait()  # paced by the main side
+            manager.allreduce(zeros, wire="q8").wait()  # paced by main
         else:
             manager.allreduce(zeros).wait()  # paced by the main side
         print(f"peer: round {i} done participants="
@@ -262,6 +281,9 @@ def _spawn_peer(lighthouse_addr: str, rounds: int, dtype: str) -> subprocess.Pop
         "BENCH_PEER_READY": ready,
         "TORCHFT_TPU_LOG": "info",
     }
+    # CPU peers skip the sitecustomize TPU-backend preload (interpreter-
+    # start PJRT init against the tunnel — seconds of dead weight).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     log = open(os.path.join(REPO, f".bench_peer_{dtype}.log"), "w")
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--peer"],
@@ -277,11 +299,13 @@ def _spawn_peer(lighthouse_addr: str, rounds: int, dtype: str) -> subprocess.Pop
     return proc
 
 
-def _bench_big() -> dict:
+def _bench_big(save=lambda partial: None) -> dict:
     """Raw vs AsyncDiLoCo throughput on the MXU-saturating config, with the
     window sized so the (bf16, pipelined) sync can hide behind compute —
     the deployment-tuning rule DiLoCo practice prescribes (H in the
-    hundreds)."""
+    hundreds). ``save`` receives partial result dicts as sub-phases land,
+    so a supervisor kill mid-phase keeps everything measured so far
+    (round-3 verdict #3: the driver's artifact lost the whole phase)."""
     import jax
     import numpy as np
     import optax
@@ -294,6 +318,7 @@ def _bench_big() -> dict:
 
     cfg, batch, _ = _model_setup("big")
     tx = optax.adamw(1e-3)
+    BF16_PARAMS = True  # f32 master + bf16 compute copy (measured +2.3%)
 
     # Attention-path selection is MEASURED per run, not assumed: time a
     # short raw loop with XLA dense attention and with the pallas flash
@@ -315,7 +340,7 @@ def _bench_big() -> dict:
         if c not in _fns_cache:
             from torchft_tpu.models import make_train_step
 
-            _fns_cache[c] = make_train_step(c, tx)
+            _fns_cache[c] = make_train_step(c, tx, bf16_params=BF16_PARAMS)
         return _fns_cache[c]
 
     def time_raw_variant(c, warm: int, raw_steps: int = 24):
@@ -347,6 +372,15 @@ def _bench_big() -> dict:
         f"big: dense {dense_sps} vs flash {flash_sps} steps/s -> "
         f"{'flash' if cfg.use_flash else 'dense'}"
     )
+    save({
+        "params_M": round(n_params / 1e6, 1),
+        "bf16_params": BF16_PARAMS,
+        "attention": "flash" if cfg.use_flash else "dense",
+        "attention_raw_steps_per_sec": {
+            "dense": None if dense_sps is None else round(dense_sps, 3),
+            "flash": None if flash_sps is None else round(flash_sps, 3),
+        },
+    })
     train_step = step_fn_for(cfg)
 
     def time_raw_big(warm: int) -> float:
@@ -443,6 +477,11 @@ def _bench_big() -> dict:
             _barrier(state.params)
             window_sps.append(sync_every / (time.perf_counter() - t0))
             _mark(f"big: window {w} done ({window_sps[-1]:.2f} steps/s)")
+            save({
+                "window_steps_per_sec": [round(s, 3) for s in window_sps],
+                "sync_every": sync_every,
+                "raw_steps_per_sec": round(raw_sps, 3),
+            })
         ft_sps = max(window_sps)
         raw_remeasured = False
         if time.monotonic() - _T0 < 900:
@@ -472,20 +511,47 @@ def _bench_big() -> dict:
     # raw re-measure, compare FIRST window vs the single raw sample
     # (best-of-1 vs best-of-1) instead of biasing the ratio FT-ward.
     ft_for_ratio = ft_sps if raw_remeasured else window_sps[0]
-    return {
+    # MFU accounting (round-3 verdict 1d): param-FLOPs (6 N tokens) AND
+    # total FLOPs including causal attention (fwd 4*B*S^2*d/2 per layer,
+    # backward ~2.5x fwd -> x3.5), against the v5e bf16 paper peak.
+    S_in = batch.shape[1] - 1  # LM slices the last token off
+    attn_tflop = (
+        cfg.n_layers * 3.5 * 4 * batch.shape[0] * S_in * S_in
+        * cfg.d_model / 2 / 1e12
+    )
+    param_tflop = 6 * n_params * batch.size / 1e12
+    result = {
         "params_M": round(n_params / 1e6, 1),
-        "tflop_per_step": round(6 * n_params * batch.size / 1e12, 2),
+        "bf16_params": BF16_PARAMS,
+        "tflop_per_step": round(param_tflop, 2),
         "attention": "flash" if cfg.use_flash else "dense",
         "attention_raw_steps_per_sec": {
             "dense": None if dense_sps is None else round(dense_sps, 3),
             "flash": None if flash_sps is None else round(flash_sps, 3),
         },
         "raw_steps_per_sec": round(raw_sps, 3),
-        "raw_tflops": round(6 * n_params * batch.size * raw_sps / 1e12, 1),
+        "raw_tflops": round(param_tflop * raw_sps, 1),
         "ft_diloco_steps_per_sec": round(ft_sps, 3),
         "window_steps_per_sec": [round(s, 3) for s in window_sps],
         "ratio_vs_raw": round(ft_for_ratio / raw_sps, 3),
-        "ratio_symmetric": raw_remeasured,
+        # "symmetric" = raw re-measured AND both FT windows ran; a
+        # budget-skipped second window is best-of-1 FT vs best-of-2 raw
+        # (conservative, but not symmetric — round-3 advisor finding)
+        "ratio_symmetric": raw_remeasured and not skipped,
+        "windows_measured": len(window_sps),
+        "mfu": {
+            "attn_tflop_per_step": round(attn_tflop, 2),
+            "total_tflop_per_step": round(param_tflop + attn_tflop, 2),
+            "raw_total_tflops": round(
+                (param_tflop + attn_tflop) * raw_sps, 1
+            ),
+            "pct_of_v5e_bf16_peak": round(
+                (param_tflop + attn_tflop) * raw_sps / 197.0 * 100, 1
+            ),
+            "note": "total = param matmuls + causal attention (x3.5 "
+            "fwd+bwd); peak = 197 TFLOP/s v5e bf16; see ROOFLINE.md for "
+            "the measured per-component ceilings on this tunneled chip",
+        },
         "sync_every": sync_every,
         "window_capped": bool(sync_every >= 1536),
         "note": "MXU-saturating config; attention path chosen by "
@@ -499,6 +565,135 @@ def _bench_big() -> dict:
             "compares first-window FT vs the single raw sample"
         ),
     }
+    save(result)
+    return result
+
+
+def _bench_ddp_small(d2h_MBps: float, h2d_MBps: float) -> dict:
+    """Per-step fault-tolerant DDP at a LINK-SIZED scale, run on TPU every
+    round unconditionally (round-3 verdict #2: the reference's product is
+    per-step FT, and the flagship ft_ddp phase is link-bound on degraded
+    tunnels — this phase sizes the MODEL to the link instead of skipping).
+
+    ~0.72M params (0.73 MB int8 wire) with S-2048 flash attention: compute
+    per step is large relative to the gradient ship, and PipelinedDDP
+    overlaps step i's ring with step i+1's grads, so the achievable ratio
+    is C/max(C, R) rather than C/(C+R). The batch is chosen so estimated
+    compute ~= 1.2x the estimated ring time on the MEASURED link (bigger
+    batches on worse links), capped at 256.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from torchft_tpu import (
+        FTTrainState, HostCollectives, Manager, PipelinedDDP,
+    )
+    from torchft_tpu.models import init_params, loss_fn, make_train_step
+
+    degraded = d2h_MBps < 100
+    wire = "int8" if degraded else "bf16"
+    os.environ["BENCH_MODEL"] = "ddp_small"
+    try:
+        cfg, batch, _ = _model_setup("ddp_small")
+        tx = optax.adamw(1e-3)
+        n_params = sum(
+            int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(
+                init_params(cfg, jax.random.PRNGKey(0))
+            )
+        )
+        wire_mb = n_params * (1 if wire == "int8" else 2) / 1e6
+        # ring time estimate: payload d2h + cohort payloads h2d + slack
+        r_est = wire_mb / max(d2h_MBps, 0.1) + \
+            2 * wire_mb / max(h2d_MBps, 0.1) + 0.15
+        train_step = make_train_step(cfg, tx)
+        _mark(f"ddp_small: raw probe (wire={wire}, est ring {r_est:.2f}s)")
+        base_B = batch.shape[0]
+        raw_sps = _time_raw_loop(
+            train_step,
+            lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
+            2, 12,
+        )
+        c_base = 1.0 / raw_sps
+        # scale batch so compute ~= 1.2x ring estimate (compute ~linear in B)
+        want_B = int(base_B * max(1.2 * r_est / c_base, 1.0))
+        B = min(max(32, (want_B // 32) * 32), 256)
+        if B != base_B:
+            os.environ["BENCH_DDP_SMALL_BATCH"] = str(B)
+            cfg, batch, _ = _model_setup("ddp_small")
+            raw_sps = _time_raw_loop(
+                train_step,
+                lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
+                1, 8,
+            )
+        _mark(f"ddp_small: B={B} raw {raw_sps:.2f} steps/s")
+
+        ddp_grad_fn = jax.jit(
+            jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b))
+        )
+        steps = 4
+        lh = peer_proc = manager = collectives = None
+        try:
+            lh = _fresh_lighthouse()
+            peer_proc = _spawn_peer(lh.address(), 1 + steps, wire)
+            state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
+            collectives = HostCollectives(timeout=timedelta(seconds=1800))
+            manager = Manager(
+                collectives=collectives,
+                load_state_dict=state.load_state_dict,
+                state_dict=state.state_dict,
+                min_replica_size=1,
+                timeout=timedelta(seconds=600),
+                quorum_timeout=timedelta(seconds=600),
+                rank=0,
+                world_size=1,
+                lighthouse_addr=lh.address(),
+                replica_id="bench_main_ddp_small",  # sorts before bench_peer
+            )
+            ddp = PipelinedDDP(
+                manager, state, lambda p, b: ddp_grad_fn(p, b),
+                compress=wire,
+            )
+            ddp.step(batch)  # warm: compile + peer round 0
+            _barrier(state.params)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                ddp.step(batch)
+            t_end = time.perf_counter()
+            ddp.flush()
+            _barrier(state.params)
+            ft_sps = steps / (t_end - t0)
+            assert collectives.size() == 2, "peer did not join the ring"
+            peer_proc.wait(timeout=600)
+        finally:
+            if peer_proc is not None and peer_proc.poll() is None:
+                peer_proc.kill()
+            if manager is not None:
+                manager.shutdown()
+            if collectives is not None:
+                collectives.shutdown()
+            if lh is not None:
+                lh.shutdown()
+        return {
+            "steps_per_sec": round(ft_sps, 3),
+            "raw_steps_per_sec": round(raw_sps, 3),
+            "ratio_vs_raw": round(ft_sps / raw_sps, 3),
+            "params_M": round(n_params / 1e6, 2),
+            "wire": wire,
+            "wire_MB": round(wire_mb, 2),
+            "batch": B,
+            "tokens_per_step": int(batch.size),
+            "est_ring_s": round(r_est, 3),
+            "note": "link-sized per-step DDP (PipelinedDDP, full quorum + "
+            "commit vote every step) over a live 2-member ring; model "
+            "sized so the gradient ship fits the measured link and the "
+            "ring hides behind the next step's compute; raw baseline is "
+            "the fused one-program step at the same batch",
+        }
+    finally:
+        os.environ.pop("BENCH_MODEL", None)
+        os.environ.pop("BENCH_DDP_SMALL_BATCH", None)
 
 
 def _budget_window_steps(windows: int, steps_per_sec: float, margin: float) -> int:
@@ -627,18 +822,6 @@ def main() -> None:
     }
     del probe, host_probe
 
-    # -- ft_ddp: per-step gradient allreduce over a real 2-group ring --
-    # The reference's product mode (per-step allreduce hidden behind
-    # backward, reference ddp.py:47-71). Measured at REPRESENTATIVE
-    # arithmetic intensity: the smoke config's 512 tokens/step against a
-    # full gradient ship is a compute:comm balance no DDP deployment has
-    # (measured breakdown on 1 CPU core: grad 546 ms vs ring 127 ms +
-    # unpack 66 ms — fixed ring WORK that neither overlap nor bf16 can
-    # remove on a single core). The DDP phase therefore scales the batch
-    # (4x tokens) and measures its OWN raw baseline at the same config;
-    # blocking and pipelined (PipelinedDDP: step i's ring overlapped with
-    # step i+1's grads — the torch bucket-hook overlap, restructured for
-    # JAX's one-pytree gradients) are both recorded.
     n_params = sum(
         int(np.prod(l.shape))
         for l in jax.tree_util.tree_leaves(init_params(cfg, jax.random.PRNGKey(0)))
@@ -649,36 +832,44 @@ def main() -> None:
     force_ddp = os.environ.get("BENCH_FORCE_DDP") == "1" or (
         os.environ.get("BENCH_WIRE") == "ddp"
     )
-    _mark(f"phase: ft_ddp (d2h={d2h_MBps:.1f} MB/s)")
-    if not on_tpu or d2h_MBps >= 100 or force_ddp:
+
+    # -- ft_ddp (flagship-scale): per-step gradient allreduce over a real
+    # 2-group ring -- run AFTER the headline lands (see phase order below).
+    # The reference's product mode (per-step allreduce hidden behind
+    # backward, reference ddp.py:47-71). Measured at REPRESENTATIVE
+    # arithmetic intensity: the smoke config's 512 tokens/step against a
+    # full gradient ship is a compute:comm balance no DDP deployment has
+    # (measured breakdown on 1 CPU core: grad 546 ms vs ring 127 ms +
+    # unpack 66 ms — fixed ring WORK that neither overlap nor bf16 can
+    # remove on a single core). The DDP phase therefore scales the batch
+    # and measures its OWN raw baseline at the same config; blocking and
+    # pipelined (PipelinedDDP: step i's ring overlapped with step i+1's
+    # grads — the torch bucket-hook overlap, restructured for JAX's
+    # one-pytree gradients) are both recorded. On CPU BOTH batch points
+    # land in the artifact (round-3 verdict #6): the reference-like small
+    # batch where fixed ring work dominates, and the 4x-token batch where
+    # compute amortizes it — the ratio is an arithmetic-intensity story,
+    # and recording one point hides that.
+    def run_ft_ddp_phase() -> dict:
         from torchft_tpu import PipelinedDDP
 
-        # TPU with a degraded link under BENCH_FORCE_DDP: fewer steps
-        # (each ships the full gradient through the tunnel) and the
-        # bf16 wire, so the forced artifact stays bounded.
         degraded = on_tpu and d2h_MBps < 100
-        ddp_batch = batch if on_tpu else jnp.concatenate([batch] * 4, axis=0)
         # The DDP step MUST split grad and apply (the ring runs between
         # them); its raw baseline stays the FUSED step at the same batch,
         # so the ratio honestly charges the split to the transport.
         ddp_grad_fn = jax.jit(
             jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b))
         )
+        ddp_steps = 2 if degraded else (4 if on_tpu else 5)
 
-        def time_ddp_raw(warm: int, n: int) -> float:
+        def time_ddp_raw(ddp_batch, warm: int, n: int) -> float:
             return _time_raw_loop(
                 train_step,
                 lambda: init_params(cfg, jax.random.PRNGKey(0)), tx,
                 ddp_batch, warm, n,
             )
 
-        ddp_steps = 2 if degraded else (4 if on_tpu else 5)
-        # On TPU ddp_batch == batch, so the long-window raw measurement is
-        # the baseline (a 2-step re-measure would under-measure raw by the
-        # end-of-window drain RTT and flatter the FT ratio).
-        ddp_raw_sps = raw_sps if on_tpu else time_ddp_raw(1, ddp_steps)
-
-        def run_ddp(mode: str, wire: str) -> float:
+        def run_ddp(mode: str, wire: str, ddp_batch) -> float:
             # Fresh lighthouse per session (_fresh_lighthouse) and every
             # resource constructed INSIDE the try: a constructor failure
             # must not leak a heartbeating "bench_peer" into later phases.
@@ -756,39 +947,72 @@ def main() -> None:
             return sps
 
         wire = "bf16" if degraded else "f32"
-        # Degraded-link forced mode runs only the pipelined+bf16 variant:
-        # the blocking variant's f32 tree would mismatch the peer's bf16
-        # zeros on the ring, and each extra step ships the full gradient
-        # through the crippled tunnel.
-        ddp_sps = None if degraded else run_ddp("blocking", wire)
-        pipe_sps = run_ddp("pipelined", wire)
-        best = max(s for s in (ddp_sps, pipe_sps) if s is not None)
-        detail["ft_ddp"] = {
-            "steps_per_sec": round(best, 3),
-            "ratio_vs_raw": round(best / ddp_raw_sps, 3),
-            "raw_steps_per_sec": round(ddp_raw_sps, 3),
-            "blocking_steps_per_sec": (
-                None if ddp_sps is None else round(ddp_sps, 3)
-            ),
-            "pipelined_steps_per_sec": round(pipe_sps, 3),
-            "wire": wire,
-            "tokens_per_step": int(ddp_batch.size),
-            "note": "per-step full-gradient shipping over a live 2-member "
-            "ring; raw baseline measured at the same batch"
+
+        def measure_point(ddp_batch) -> dict:
+            # Degraded-link forced mode runs only the pipelined+bf16
+            # variant: the blocking variant's f32 tree would mismatch the
+            # peer's bf16 zeros on the ring, and each extra step ships the
+            # full gradient through the crippled tunnel.
+            # On TPU ddp_batch == batch, so the long-window raw
+            # measurement is the baseline (a short re-measure would
+            # under-measure raw by the end-of-window drain RTT and
+            # flatter the FT ratio). On CPU, best-of-2 short windows: a
+            # single window on the loaded 1-core host under-measures raw
+            # enough to produce nonsense FT/raw > 1.
+            ddp_raw = raw_sps if on_tpu else max(
+                time_ddp_raw(ddp_batch, 1, ddp_steps),
+                time_ddp_raw(ddp_batch, 0, ddp_steps),
+            )
+            blocking = (
+                None if degraded else run_ddp("blocking", wire, ddp_batch)
+            )
+            pipe = run_ddp("pipelined", wire, ddp_batch)
+            best = max(s for s in (blocking, pipe) if s is not None)
+            return {
+                "steps_per_sec": round(best, 3),
+                "ratio_vs_raw": round(best / ddp_raw, 3),
+                "raw_steps_per_sec": round(ddp_raw, 3),
+                "blocking_steps_per_sec": (
+                    None if blocking is None else round(blocking, 3)
+                ),
+                "pipelined_steps_per_sec": round(pipe, 3),
+                "tokens_per_step": int(ddp_batch.size),
+            }
+
+        big_batch = batch if on_tpu else jnp.concatenate([batch] * 4, axis=0)
+        out = measure_point(big_batch)
+        out["wire"] = wire
+        out["note"] = (
+            "per-step full-gradient shipping over a live 2-member ring; "
+            "raw baseline measured at the same batch"
             + (
                 "; FORCED run on a degraded device<->host link — the "
                 "absolute rate is link-bound, not framework-bound"
                 if degraded
                 else ""
-            ),
-        }
-    else:
-        detail["ft_ddp"] = {
+            )
+        )
+        if not on_tpu:
+            # reference-like small batch: fixed ring work is ~30% of the
+            # 1-core step there, so the ratio is structurally lower — the
+            # amortization rule (compute >= 9x overhead for >= 0.9
+            # blocking) made explicit by recording both points
+            out["small_batch"] = measure_point(batch)
+            out["note"] += (
+                "; small_batch = the reference-like batch where ring "
+                "work is not amortized (ratio >= 0.9 needs compute >= 9x "
+                "overhead in blocking mode, ~1.1x in pipelined)"
+            )
+        return out
+
+    def run_ft_ddp_skip_note() -> dict:
+        return {
             "skipped": f"device<->host link degraded ({d2h_MBps} MB/s d2h); "
             f"per-step shipping of {grad_mb:.0f} MB grads is link-bound "
             f"(>= {grad_mb / d2h_MBps:.0f} s/step floor) regardless of "
-            "framework — use the windowed mode (ft_diloco) on such links, "
-            "or set BENCH_FORCE_DDP=1 to record the link-bound number",
+            "framework — the link-sized phase (ft_ddp_small) carries the "
+            "per-step story on this link; set BENCH_FORCE_DDP=1 to record "
+            "the link-bound flagship number",
         }
 
     # -- ft_diloco: AsyncDiLoCo over the same real ring (headline) --
@@ -962,14 +1186,63 @@ def main() -> None:
     # not rewritten here.)
     land_headline()
 
-    # -- big: FT overhead at MXU-saturating arithmetic intensity --
-    if on_tpu and not os.environ.get("BENCH_SKIP_BIG"):
+    # -- per-step FT: the link-sized phase runs on TPU EVERY round (the
+    # per-step product must have a number on this hardware); the
+    # flagship-scale point runs when the link can carry it (or forced) --
+    if on_tpu:
+        _mark("phase: ft_ddp_small")
         try:
-            detail["big"] = _bench_big()
+            detail["ft_ddp_small"] = _bench_ddp_small(d2h_MBps, h2d_MBps)
+        except Exception as e:  # noqa: BLE001 - keep the headline
+            detail["ft_ddp_small"] = {"error": f"{type(e).__name__}: {e}"}
+        land_headline()
+    _mark(f"phase: ft_ddp flagship (d2h={d2h_MBps:.1f} MB/s)")
+    if not on_tpu or d2h_MBps >= 100 or force_ddp:
+        try:
+            detail["ft_ddp"] = run_ft_ddp_phase()
+        except Exception as e:  # noqa: BLE001 - keep the headline
+            detail["ft_ddp"] = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        detail["ft_ddp"] = run_ft_ddp_skip_note()
+    land_headline()
+
+    # -- big: FT overhead at MXU-saturating arithmetic intensity; its
+    # ratio is THE headline (round-3 verdict #3: the small-model window
+    # dilutes FT cost — the big phase measures it at deployment-class
+    # arithmetic intensity). Sub-results persist incrementally via
+    # save_partial so a supervisor kill can never erase the phase. --
+    if on_tpu and not os.environ.get("BENCH_SKIP_BIG"):
+
+        def save_partial(partial: dict) -> None:
+            cur = dict(detail.get("big") or {})
+            cur.update(partial)
+            detail["big"] = cur
+            with open(os.path.join(REPO, detail_name), "w") as f:
+                json.dump(detail, f, indent=2)
+
+        try:
+            _bench_big(save_partial)
         except Exception as e:  # noqa: BLE001 - best effort, keep headline
-            detail["big"] = {"error": f"{type(e).__name__}: {e}"}
-        with open(os.path.join(REPO, detail_name), "w") as f:
-            json.dump(detail, f, indent=2)
+            save_partial({"error": f"{type(e).__name__}: {e}"})
+        big = detail.get("big") or {}
+        if big.get("ft_diloco_steps_per_sec") and big.get("ratio_vs_raw"):
+            # Promote the big phase to the printed headline (the driver
+            # takes the LAST metric line; the small-model line above stays
+            # as the provisional fallback if this phase died).
+            detail["headline"] = "big"
+            with open(os.path.join(REPO, detail_name), "w") as f:
+                json.dump(detail, f, indent=2)
+            print(
+                json.dumps(
+                    {
+                        "metric": "steps_per_sec_ft",
+                        "value": big["ft_diloco_steps_per_sec"],
+                        "unit": "steps/s",
+                        "vs_baseline": round(big["ratio_vs_raw"] / 0.90, 3),
+                    }
+                ),
+                flush=True,
+            )
 
 
 def _supervised() -> None:

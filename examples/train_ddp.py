@@ -61,6 +61,55 @@ def make_synthetic_dataset(n: int = 4096, dim: int = 32, classes: int = 10):
     return x.astype(np.float32), labels.astype(np.int32)
 
 
+def make_image_dataset():
+    """Real-image datasets for MODEL=cnn (reference train_ddp.py:40-61
+    trains CIFAR-10; this environment has no network, so the bundled real
+    dataset is the default and CIFAR-10 loads from local files):
+
+    - ``DATA=digits``: scikit-learn's bundled handwritten-digit images
+      (1797 real 8x8 grayscale scans, 10 classes) — always available.
+    - ``DATA=cifar10``: the standard ``cifar-10-batches-py`` pickle
+      batches from ``CIFAR_DIR`` (default ``~/.cache/cifar-10-batches-py``
+      — place an already-downloaded copy there; 32x32x3, 10 classes).
+
+    Returns (images NHWC f32 in [0, 1]-ish, labels i32, (H, C, classes)).
+    """
+    data = os.environ.get("DATA", "synthetic")
+    if data == "digits":
+        from sklearn.datasets import load_digits
+
+        d = load_digits()
+        x = (d.images.astype(np.float32) / 16.0)[..., None]  # (N, 8, 8, 1)
+        return x, d.target.astype(np.int32), (8, 1, 10)
+    if data == "cifar10":
+        import pickle
+
+        cifar_dir = os.environ.get(
+            "CIFAR_DIR",
+            os.path.expanduser("~/.cache/cifar-10-batches-py"),
+        )
+        xs, ys = [], []
+        for i in range(1, 6):
+            path = os.path.join(cifar_dir, f"data_batch_{i}")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"{path} not found — DATA=cifar10 needs the standard "
+                    "cifar-10-batches-py files in CIFAR_DIR (no network "
+                    "in this environment; use DATA=digits for the bundled "
+                    "real dataset)"
+                )
+            with open(path, "rb") as f:
+                b = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(b[b"data"], np.uint8))
+            ys.append(np.asarray(b[b"labels"], np.int64))
+        x = (
+            np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            .astype(np.float32) / 255.0
+        )
+        return x, np.concatenate(ys).astype(np.int32), (32, 3, 10)
+    return None  # synthetic (the caller generates)
+
+
 def init_params(dim: int = 32, hidden: int = 128, classes: int = 10):
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
     scale = 1.0 / np.sqrt(dim)
@@ -79,10 +128,11 @@ def loss_fn(params, x, y):
 
 
 def build_model():
-    """MODEL=mlp (default, synthetic blobs), MODEL=cnn (synthetic
-    CIFAR-shaped images through models.cnn — the reference demo's model
-    family, reference train_ddp.py:64-72), or MODEL=moe (tiny
-    mixture-of-experts LM on synthetic tokens)."""
+    """MODEL=mlp (default, synthetic blobs), MODEL=cnn (images through
+    models.cnn — the reference demo's model family, reference
+    train_ddp.py:64-72; pick the dataset with DATA=digits|cifar10|synthetic,
+    see make_image_dataset), or MODEL=moe (tiny mixture-of-experts LM on
+    synthetic tokens)."""
     model = os.environ.get("MODEL", "mlp")
     if model == "moe":
         from torchft_tpu.models import moe, tiny_moe_config
@@ -103,13 +153,25 @@ def build_model():
     if model == "cnn":
         from torchft_tpu.models import cnn, tiny_cnn_config
 
-        cfg = tiny_cnn_config()
-        rng = np.random.default_rng(0)
-        n = 2048
-        x = rng.standard_normal(
-            (n, cfg.image_size, cfg.image_size, cfg.channels)
-        ).astype(np.float32)
-        y = rng.integers(0, cfg.classes, n).astype(np.int32)
+        real = make_image_dataset()
+        if real is not None:
+            x, y, (size, channels, classes) = real
+            cfg = cnn.CNNConfig(
+                image_size=size,
+                channels=channels,
+                classes=classes,
+                widths=(16, 32) if size <= 8 else (32, 64, 128),
+                groups=4,
+                dense_width=64,
+            )
+        else:
+            cfg = tiny_cnn_config()
+            rng = np.random.default_rng(0)
+            n = 2048
+            x = rng.standard_normal(
+                (n, cfg.image_size, cfg.image_size, cfg.channels)
+            ).astype(np.float32)
+            y = rng.integers(0, cfg.classes, n).astype(np.int32)
         params = cnn.init_params(cfg, jax.random.PRNGKey(0))
 
         def loss(params, xb, yb):
@@ -175,6 +237,32 @@ def main() -> None:
     )
     optimizer = OptimizerWrapper(manager, state)
 
+    # Durable tier (CKPT_DIR set): periodic whole-job checkpoints pairing
+    # the user state with the manager's {step, batches_committed} AND the
+    # loader position; restore BEFORE the first quorum so the replica
+    # rejoins at its step instead of 0 (reference train_ddp.py:141-148 +
+    # the manager state_dict contract, reference manager.py:83-85).
+    ckpt = None
+    if os.environ.get("CKPT_DIR"):
+        from torchft_tpu import DurableCheckpointer
+
+        class _UserState:
+            state_dict = staticmethod(full_state_dict)
+            load_state_dict = staticmethod(load_full_state_dict)
+
+        ckpt = DurableCheckpointer(
+            os.environ["CKPT_DIR"],
+            manager,
+            _UserState(),
+            every=int(os.environ.get("CKPT_EVERY", 50)),
+        )
+        restored = ckpt.restore_latest()
+        if restored is not None:
+            logger.info(
+                f"[group {replica_group}] restored durable ckpt at "
+                f"step {restored}"
+            )
+
     while manager.current_step() < num_steps:
         step = manager.current_step()
         ckpt_box["healed"] = False
@@ -196,6 +284,8 @@ def main() -> None:
                 # synchronized across replica groups.
                 next(loader)
             ckpt_box["loader"] = loader.state_dict()
+            if ckpt is not None:
+                ckpt.maybe_save()
         elif not ckpt_box["healed"]:
             # Replay the same batch on the retry: an uncommitted step must
             # not advance the durable data position, or the stream drifts

@@ -260,6 +260,14 @@ int tft_hc_allreduce(void* handle, void* data, size_t count, int dtype, int op,
   });
 }
 
+int tft_hc_allreduce_q8(void* handle, float* data, size_t count,
+                        int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->allreduce_q8(data, count,
+                                                        timeout_ms);
+  });
+}
+
 int tft_hc_allgather(void* handle, const void* in, void* out, size_t nbytes,
                      int64_t timeout_ms) {
   return guarded([&] {

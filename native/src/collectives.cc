@@ -4,6 +4,7 @@
 #include <string.h>
 
 #include <algorithm>
+#include <cmath>
 #include <vector>
 
 #include "log.h"
@@ -341,6 +342,90 @@ void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
       auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
       duplex(bytes + s_start * esize, s_len * esize, bytes + r_start * esize,
              r_len * esize, deadline);
+    }
+  });
+}
+
+namespace {
+
+// One chunk on the q8 wire: 4-byte f32 scale, then `len` int8 codes.
+void q8_encode(const float* src, size_t len, char* wire) {
+  float absmax = 0.f;
+  for (size_t i = 0; i < len; i++) absmax = std::max(absmax, std::fabs(src[i]));
+  float scale = absmax > 0.f ? absmax / 127.f : 1.f;
+  memcpy(wire, &scale, sizeof(float));
+  int8_t* q = reinterpret_cast<int8_t*>(wire + sizeof(float));
+  for (size_t i = 0; i < len; i++) {
+    float v = std::nearbyint(src[i] / scale);
+    q[i] = static_cast<int8_t>(std::max(-127.f, std::min(127.f, v)));
+  }
+}
+
+// dst[i] (+)= scale * q[i]
+void q8_decode(const char* wire, size_t len, float* dst, bool accumulate) {
+  float scale;
+  memcpy(&scale, wire, sizeof(float));
+  const int8_t* q = reinterpret_cast<const int8_t*>(wire + sizeof(float));
+  if (accumulate) {
+    for (size_t i = 0; i < len; i++) dst[i] += scale * static_cast<float>(q[i]);
+  } else {
+    for (size_t i = 0; i < len; i++) dst[i] = scale * static_cast<float>(q[i]);
+  }
+}
+
+}  // namespace
+
+void HostCollectives::allreduce_q8(float* data, size_t count,
+                                   int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  if (world_size_ == 1) return;
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // distinct kind: a q8 op meeting a plain allreduce must error, not
+    // desync (their wire framings differ even at equal counts)
+    check_op_header(4, count, /*dtype=*/100, /*op=*/0, deadline);
+    if (count == 0) return;
+    size_t max_chunk = count / world_size_ + 1;
+    size_t max_wire = sizeof(float) + max_chunk;
+    std::vector<char> send_wire(max_wire), recv_wire(max_wire);
+
+    // Reduce-scatter: each hop quantizes its CURRENT partial sum of the
+    // outgoing chunk and dequant-accumulates the incoming one in f32.
+    for (int64_t s = 0; s < world_size_ - 1; s++) {
+      int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+      int64_t recv_c =
+          ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
+      auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+      q8_encode(data + s_start, s_len, send_wire.data());
+      duplex(send_wire.data(), sizeof(float) + s_len, recv_wire.data(),
+             sizeof(float) + r_len, deadline);
+      q8_decode(recv_wire.data(), r_len, data + r_start, /*accumulate=*/true);
+    }
+    // Allgather: the OWNER quantizes its fully-reduced chunk exactly once
+    // (first send); every later hop forwards the received wire bytes
+    // verbatim, so all members decode identical codes — the reduced
+    // values stay bit-identical across ranks (the determinism oracle).
+    std::vector<std::vector<char>> stored(world_size_);
+    {
+      int64_t own_c = (rank_ + 1) % world_size_;
+      auto [o_start, o_len] = chunk_range(count, world_size_, own_c);
+      stored[own_c].resize(sizeof(float) + o_len);
+      q8_encode(data + o_start, o_len, stored[own_c].data());
+      // decode own chunk too: every member must hold the DECODED codes,
+      // not its higher-precision f32 partial (bit-identity across ranks)
+      q8_decode(stored[own_c].data(), o_len, data + o_start, false);
+    }
+    for (int64_t s = 0; s < world_size_ - 1; s++) {
+      int64_t send_c =
+          ((rank_ + 1 - s) % world_size_ + world_size_) % world_size_;
+      int64_t recv_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
+      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+      stored[recv_c].resize(sizeof(float) + r_len);
+      duplex(stored[send_c].data(), stored[send_c].size(),
+             stored[recv_c].data(), stored[recv_c].size(), deadline);
+      q8_decode(stored[recv_c].data(), r_len, data + r_start, false);
     }
   });
 }

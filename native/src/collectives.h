@@ -65,6 +65,17 @@ class HostCollectives {
   // In-place ring allreduce over `count` elements of `data`.
   void allreduce(void* data, size_t count, Dtype dtype, ReduceOp op,
                  int64_t timeout_ms);
+
+  // In-place QUANTIZED ring SUM over `count` f32 elements: every hop
+  // ships each chunk as [f32 absmax/127 scale][int8 payload] and the
+  // receiver dequantize-accumulates into its f32 buffer (the same
+  // f32-accumulator discipline the bf16 path uses). Phase 2 circulates
+  // the owner-quantized reduced chunks verbatim, so wire bytes per
+  // member are ~2x the int8 payload REGARDLESS of world size — unlike a
+  // quantized allgather, whose traffic grows O(world). Per-hop
+  // requantization of partial sums keeps relative error at the int8
+  // quantization class (~1/127 of each chunk's absmax).
+  void allreduce_q8(float* data, size_t count, int64_t timeout_ms);
   // Gathers `nbytes` from every rank into `out` (world_size * nbytes), in
   // rank order.
   void allgather(const void* in, void* out, size_t nbytes, int64_t timeout_ms);

@@ -238,3 +238,43 @@ def test_register_safe_modules_extends_allowlist():
         assert deserialize_state_dict(payload) == fractions.Fraction(1, 3)
     finally:
         _SAFE_MODULE_ROOTS.discard("fractions")
+
+
+def test_streaming_no_full_payload_buffer(server, monkeypatch):
+    """The HTTP path must STREAM (reference checkpointing.py:139-170):
+    chunked transfer on the wire, no serialize_state_dict() full-bytes
+    buffer on the server, incremental unpickle on the receiver. The state
+    is several times larger than any internal chunk, so a buffering
+    implementation would materialize tens of MB here."""
+    import urllib.request
+    from datetime import timedelta
+
+    import numpy as np
+
+    from torchft_tpu import checkpointing as C
+
+    def boom(_):
+        raise AssertionError(
+            "serialize_state_dict (full-payload buffer) used on the "
+            "HTTP serving path"
+        )
+
+    monkeypatch.setattr(C, "serialize_state_dict", boom)
+    big = {
+        f"w{i}": np.random.default_rng(i).standard_normal((1 << 20,))
+        for i in range(8)  # 8 x 8 MB leaves
+    }
+    server.send_checkpoint([1], step=3, state_dict=big,
+                           timeout=timedelta(seconds=10))
+    # wire-level check: chunked, no Content-Length
+    with urllib.request.urlopen(f"{server.address()}3", timeout=10) as f:
+        assert f.headers.get("Content-Length") is None
+        assert f.headers.get("Transfer-Encoding") == "chunked"
+        out = C.load_state_dict_stream(f)
+    for k, v in big.items():
+        np.testing.assert_array_equal(out[k], v)
+    # the normal client path streams too
+    out2 = server.recv_checkpoint(
+        0, server.address(), 3, timeout=timedelta(seconds=10)
+    )
+    np.testing.assert_array_equal(out2["w0"], big["w0"])

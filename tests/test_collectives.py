@@ -259,6 +259,97 @@ class TestHostCollectives:
         for c in cols:
             c.shutdown()
 
+    def test_allreduce_q8_quantized_ring(self, store):
+        # wire="q8": int8 chunks + per-chunk scales, dequant-accumulated
+        # per hop; bytes constant in world size (round-3 verdict #9).
+        # Results must be (a) within int8 quantization error of the exact
+        # sum and (b) BIT-IDENTICAL across ranks (phase-2 circulates
+        # owner-quantized codes verbatim).
+        import jax.numpy as jnp
+
+        cols = _make_ring(store, 3)
+        rng = np.random.default_rng(7)
+        base = {
+            "w": rng.standard_normal((300,)).astype(np.float32),
+            "b": rng.standard_normal((5, 7)).astype(np.float32) * 10.0,
+        }
+
+        def op(r, c):
+            tree = {
+                "w": jnp.asarray(base["w"] * (r + 1)),
+                "b": jnp.asarray(base["b"] * (r + 1)),
+            }
+            return c.allreduce(tree, ReduceOp.AVG, wire="q8").wait()
+
+        results = _run_all(cols, op)
+        exact = {k: v * (1 + 2 + 3) / 3 for k, v in base.items()}
+        for out in results:
+            for k in base:
+                got = np.asarray(out[k])
+                assert got.dtype == np.float32
+                # error bound: per-hop requantization at absmax/127 per
+                # chunk; 3 ranks -> a few quantization steps of slack
+                tol = 6 * np.abs(exact[k]).max() / 127
+                np.testing.assert_allclose(got, exact[k], atol=tol)
+        for a, b in zip(results[0:1] * 2, results[1:]):
+            for k in base:
+                np.testing.assert_array_equal(
+                    np.asarray(a[k]), np.asarray(b[k])
+                )
+        # SUM with divisor composes; MIN/MAX must be rejected
+        with pytest.raises(ValueError, match="SUM/AVG"):
+            cols[0].allreduce(base, ReduceOp.MAX, wire="q8")
+        for c in cols:
+            c.shutdown()
+
+    def test_allgather_device_packed_jax_leaves(self, store):
+        # All-jax-leaf trees take the device-packed path (one transfer per
+        # exact dtype, byte-preserving): without it a quantized {q, scale}
+        # payload costs one device round-trip PER LEAF — measured 3.5 s/op
+        # on the tunneled TPU. int8 must NOT be upcast on the wire.
+        import jax.numpy as jnp
+
+        cols = _make_ring(store, 3)
+
+        def op(r, c):
+            payload = {
+                "q": {
+                    "a": jnp.full((6,), r - 1, jnp.int8),
+                    "b": jnp.full((2, 3), 2 * r, jnp.int8),
+                },
+                "scale": {
+                    "a": jnp.float32(0.5 + r),
+                    "b": jnp.float32(1.5 * r),
+                },
+                "extra_bf16": jnp.full((4,), r, jnp.bfloat16),
+            }
+            return c.allgather(payload).wait()
+
+        results = _run_all(cols, op)
+        for out in results:
+            assert len(out) == 3
+            for r, tree in enumerate(out):
+                assert tree["q"]["a"].dtype == jnp.int8
+                np.testing.assert_array_equal(
+                    np.asarray(tree["q"]["a"]), np.full((6,), r - 1)
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(tree["q"]["b"]), np.full((2, 3), 2 * r)
+                )
+                np.testing.assert_allclose(
+                    float(tree["scale"]["a"]), 0.5 + r
+                )
+                np.testing.assert_allclose(
+                    float(tree["scale"]["b"]), 1.5 * r
+                )
+                assert tree["extra_bf16"].dtype == jnp.bfloat16
+                np.testing.assert_array_equal(
+                    np.asarray(tree["extra_bf16"].astype(jnp.float32)),
+                    np.full((4,), r, np.float32),
+                )
+        for c in cols:
+            c.shutdown()
+
     def test_broadcast(self, store):
         cols = _make_ring(store, 3)
         data = [np.full(8, r, np.float32) for r in range(3)]

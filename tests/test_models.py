@@ -255,3 +255,53 @@ def test_remat_policy_save_attn_matches_plain():
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
                 err_msg=f"use_flash={use_flash}",
             )
+
+
+def test_bf16_params_master_copy_train_step():
+    """make_train_step(bf16_params=True): the gradient pass reads a bf16
+    working copy, the optimizer updates the f32 master — params stay f32,
+    the loss trajectory tracks the f32 path closely, and training makes
+    progress. VERDICT r3 item 1a (mixed precision with master weights)."""
+    import numpy as np
+    import optax
+
+    from torchft_tpu.models import init_params, make_train_step, tiny_config
+
+    cfg = tiny_config()
+    tx = optax.adamw(1e-2)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 33)),
+        jnp.int32,
+    )
+    losses = {}
+    for bf16 in (False, True):
+        step = make_train_step(cfg, tx, bf16_params=bf16)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt_state = tx.init(params)
+        ls = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            ls.append(float(loss))
+        losses[bf16] = ls
+        # master stays f32 under the mixed path
+        for leaf in jax.tree_util.tree_leaves(params):
+            assert leaf.dtype == jnp.float32
+        assert ls[-1] < ls[0]
+    # same trajectory up to bf16 gradient-accumulation noise
+    np.testing.assert_allclose(losses[True], losses[False], rtol=0.05)
+
+
+def test_train_state_accepts_bf16_wire_grads():
+    """FTTrainState.apply_gradients harmonizes lower-precision (wire)
+    gradient dtypes with the f32 master before the optax update."""
+    import numpy as np
+    import optax
+
+    from torchft_tpu.train_state import FTTrainState
+
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    state = FTTrainState(params, optax.sgd(0.5))
+    grads = {"w": jnp.full((4, 4), 0.5, jnp.bfloat16)}
+    state.apply_gradients(grads)
+    assert state.params["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 0.75)

@@ -1,0 +1,137 @@
+# Typed stub for the ctypes bridge over native/src/capi.cc — the stable
+# public surface of the native control plane (reference role:
+# torchft/torchft.pyi:1-61 for the pyo3 module). The implementation module
+# carries full inline annotations too; this stub pins the API for type
+# checkers without importing the shared library.
+from datetime import timedelta
+from typing import List, Optional, Union
+
+# Error mapping (no custom exception classes): native failures raise
+# RuntimeError; deadline-class failures raise TimeoutError, mirroring the
+# reference's DeadlineExceeded/Cancelled -> TimeoutError mapping
+# (reference src/lib.rs:321-333).
+
+
+class QuorumResult:
+    quorum_id: int
+    replica_rank: int
+    replica_world_size: int
+    recover_src_manager_address: str
+    recover_src_rank: Optional[int]
+    recover_dst_ranks: List[int]
+    store_address: str
+    max_step: int
+    max_rank: Optional[int]
+    max_world_size: int
+    heal: bool
+
+    def __init__(
+        self,
+        quorum_id: int = ...,
+        replica_rank: int = ...,
+        replica_world_size: int = ...,
+        recover_src_manager_address: str = ...,
+        recover_src_rank: Optional[int] = ...,
+        recover_dst_ranks: List[int] = ...,
+        store_address: str = ...,
+        max_step: int = ...,
+        max_rank: Optional[int] = ...,
+        max_world_size: int = ...,
+        heal: bool = ...,
+    ) -> None: ...
+
+
+class Lighthouse:
+    def __init__(
+        self,
+        bind: str = ...,
+        min_replicas: int = ...,
+        join_timeout_ms: int = ...,
+        quorum_tick_ms: int = ...,
+        heartbeat_timeout_ms: int = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+    def __enter__(self) -> "Lighthouse": ...
+    def __exit__(self, *exc: object) -> None: ...
+
+
+def lighthouse_heartbeat(
+    lighthouse_addr: str,
+    replica_id: str,
+    timeout: Union[timedelta, float, int] = ...,
+) -> None: ...
+
+
+class Manager:
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: str,
+        bind: str,
+        store_addr: str,
+        world_size: int,
+        heartbeat_interval: timedelta = ...,
+        connect_timeout: timedelta = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+
+class ManagerClient:
+    def __init__(
+        self, addr: str, connect_timeout: timedelta = ...
+    ) -> None: ...
+    def quorum(
+        self,
+        rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool = ...,
+        force_reconfigure: bool = ...,
+        timeout: timedelta = ...,
+    ) -> QuorumResult: ...
+    def checkpoint_metadata(
+        self, rank: int, timeout: timedelta = ...
+    ) -> str: ...
+    def should_commit(
+        self,
+        rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: timedelta = ...,
+    ) -> bool: ...
+    def kill(self, msg: str = ...) -> None: ...
+
+
+class Store:
+    def __init__(self, bind: str = ...) -> None: ...
+    def address(self) -> str: ...
+    @property
+    def port(self) -> int: ...
+    def shutdown(self) -> None: ...
+
+
+class StoreClient:
+    def __init__(
+        self,
+        addr: str,
+        prefix: str = ...,
+        connect_timeout: timedelta = ...,
+    ) -> None: ...
+    def set(
+        self, key: str, value: bytes, timeout: timedelta = ...
+    ) -> None: ...
+    def get(self, key: str, timeout: timedelta = ...) -> bytes: ...
+    def add(
+        self, key: str, delta: int, timeout: timedelta = ...
+    ) -> int: ...
+
+
+def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict: ...
+
+
+def compute_quorum_results(
+    replica_id: str, rank: int, quorum: dict
+) -> QuorumResult: ...

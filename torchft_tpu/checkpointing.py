@@ -9,9 +9,13 @@ exactly one step and allows reads; ``disallow_checkpoint`` (called from
 can never be read mid-mutation. A request for any other step gets a 400.
 
 Serialization is pytree-native: leaves are pulled to host (numpy) and the
-whole tree is pickled. jax arrays are reconstructed as numpy on the receiver;
-the caller decides device placement/sharding (``jax.device_put``) — the
-transport never touches devices.
+tree is pickled STREAMING in both directions — chunked transfer encoding
+into the socket on send, incremental unpickle off the response on receive —
+so neither end ever holds the serialized payload as one buffer (peak extra
+memory is one leaf, matching the reference's streamed torch.save,
+reference checkpointing.py:139-170). jax arrays are reconstructed as numpy
+on the receiver; the caller decides device placement/sharding
+(``jax.device_put``) — the transport never touches devices.
 
 Security model: deserialization uses a SAFELISTED unpickler — only CLASSES
 from the scientific-stack modules state dicts are actually made of (numpy,
@@ -91,6 +95,47 @@ def serialize_state_dict(state_dict: Any) -> bytes:
     buf = io.BytesIO()
     pickle.dump(_to_host(state_dict), buf, protocol=pickle.HIGHEST_PROTOCOL)
     return buf.getvalue()
+
+
+def dump_state_dict_stream(state_dict: Any, fileobj: Any) -> None:
+    """Streams the pickled pytree straight into ``fileobj`` (a socket
+    wrapper): pickle emits incrementally, so peak extra memory is one
+    leaf's buffer, not the whole payload — the reference streams
+    torch.save into the HTTP response the same way (reference
+    checkpointing.py:139-170)."""
+    pickle.dump(_to_host(state_dict), fileobj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_state_dict_stream(fileobj: Any) -> Any:
+    """Safelisted unpickle reading incrementally from ``fileobj`` (e.g. an
+    HTTP response): bounded-memory inverse of
+    :func:`dump_state_dict_stream` — the full payload is never held as one
+    bytes object. The safelist applies unchanged (it gates global lookups,
+    not framing)."""
+    return _SafeUnpickler(fileobj).load()
+
+
+class _ChunkedWriter:
+    """Minimal HTTP/1.1 chunked transfer encoder over the handler's
+    ``wfile``; lets the server stream a response whose length is unknown
+    up front (the streamed pickle)."""
+
+    def __init__(self, wfile: Any) -> None:
+        self._wfile = wfile
+
+    def write(self, data: Any) -> int:
+        # protocol-5 pickle passes PickleBuffer objects, not just bytes;
+        # go through a flat memoryview so any buffer-protocol payload
+        # (numpy array data included) streams without a copy
+        mv = memoryview(data).cast("B")
+        if mv.nbytes:
+            self._wfile.write(f"{mv.nbytes:x}\r\n".encode("ascii"))
+            self._wfile.write(mv)
+            self._wfile.write(b"\r\n")
+        return mv.nbytes
+
+    def close(self) -> None:
+        self._wfile.write(b"0\r\n\r\n")
 
 
 # Module roots whose CLASSES state dicts are really made of. Extendable for
@@ -217,14 +262,30 @@ class CheckpointServer(CheckpointTransport[T]):
                                 f"but got {requested}",
                             )
                             return
-                        payload = serialize_state_dict(ckpt_server._state_dict)
+                        # STREAMED response (chunked): the pickle goes
+                        # straight to the socket as it is produced — no
+                        # full-payload buffer on the server, so multi-GB
+                        # states don't spike host RAM inside the lock
+                        # window (reference checkpointing.py:139-170
+                        # streams torch.save the same way). The
+                        # device->host pull happens BEFORE the 200 is
+                        # committed: a wedged d2h (the dominant failure
+                        # class) still gets a clean 500, and only a
+                        # pickling error can corrupt an in-flight chunk
+                        # stream (the peer then fails loudly on framing).
+                        host_tree = _to_host(ckpt_server._state_dict)
                         self.send_response(200)
                         self.send_header(
                             "Content-Type", "application/octet-stream"
                         )
-                        self.send_header("Content-Length", str(len(payload)))
+                        self.send_header("Transfer-Encoding", "chunked")
                         self.end_headers()
-                        self.wfile.write(payload)
+                        out = _ChunkedWriter(self.wfile)
+                        pickle.dump(
+                            host_tree, out,
+                            protocol=pickle.HIGHEST_PROTOCOL,
+                        )
+                        out.close()
                 except Exception as e:  # noqa: BLE001 - report to the peer
                     logger.exception("checkpoint server error")
                     try:
@@ -256,8 +317,9 @@ class CheckpointServer(CheckpointTransport[T]):
         with urllib.request.urlopen(
             address, timeout=timeout.total_seconds()
         ) as f:
-            data = f.read()
-        return deserialize_state_dict(data)
+            # incremental unpickle off the response stream (http.client
+            # de-chunks transparently): bounded memory on the receiver too
+            return load_state_dict_stream(f)
 
     def address(self) -> str:
         """URL prefix of this server; append the step to fetch."""

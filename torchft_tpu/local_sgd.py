@@ -272,10 +272,13 @@ class AsyncDiLoCo(DiLoCo):
         with a per-leaf f32 scale and ERROR FEEDBACK (the quantization
         residual is added to the next window's delta, so rounding error
         never accumulates) — 4x fewer bytes than f32, 2x fewer than bf16.
-        The wire op becomes a managed ALLGATHER with member-wise
-        dequantize-and-average (an int8 SUM on the wire would overflow),
-        so per-member traffic scales with cohort size; intended for the
-        small replica-group counts DiLoCo targets.
+        The dequantized delta then rides the native ring's QUANTIZED wire
+        (``wire="q8"``: int8 chunks with per-chunk scales,
+        dequant-accumulated per hop), so sync bytes are CONSTANT in
+        cohort size — the pre-round-4 allgather form grew O(world). The
+        ring's per-chunk regrid of the already-int8-gridded values adds
+        at most one quantization step of noise, which the next window's
+        error feedback does not see (documented lossy wire).
 
         ``overlap=False`` completes the sync AT the boundary instead of one
         window later (the reconciliation degenerates to θ = G', i.e. exact
@@ -296,7 +299,6 @@ class AsyncDiLoCo(DiLoCo):
         self._commit_fn: Any = None  # jitted delayed outer update + reconcile
         self._abort_fn: Any = None  # jitted window rollback
         self._quant_fn: Any = None       # int8: jitted quantize + EF update
-        self._combine_fns: Dict[int, Any] = {}  # int8: per-cohort-size avg
         self._residual: Any = None       # int8: error-feedback carry
 
     def sync(self) -> None:
@@ -373,8 +375,13 @@ class AsyncDiLoCo(DiLoCo):
                 old_global, self._state.params, prev_residual
             )
             self._residual = out["res"]  # EF carry (restored on abort)
-            work = self._manager.allgather(
-                {"q": out["q"], "scale": out["scale"]}
+            # ship the DEQUANTIZED delta over the ring's quantized wire:
+            # the values are already on the int8 grid leaf-wise (EF
+            # accounts for that rounding); the ring re-grids per chunk and
+            # returns the averaged f32 tree directly — constant wire bytes
+            # in cohort size, no member-wise combine needed
+            work = self._manager.allreduce(
+                out["dq"], op=ReduceOp.AVG, wire="q8"
             )
             # reconcile against what we actually SHIPPED (the dequantized
             # local delta), same role as the bf16-rounded delta below
@@ -419,38 +426,9 @@ class AsyncDiLoCo(DiLoCo):
         result = work.wait()
         logger.debug("sync ring wait %.2fs", time.perf_counter() - t0)
         t0 = time.perf_counter()
-        if self._compress == "int8":
-            # member-wise dequantize, then average over PARTICIPANTS:
-            # non-participating (healing/spare) entries arrive zeroed
-            # (Manager.allgather) and must not dilute the divisor
-            import jax.numpy as jnp
-
-            cohort = len(result)
-            combine = self._combine_fns.get(cohort)
-            if combine is None:
-
-                def combine_fn(entries, n_participants):
-                    acc = None
-                    for e in entries:
-                        dq = jax.tree_util.tree_map(
-                            lambda q, s: q.astype(jnp.float32) * s,
-                            e["q"], e["scale"],
-                        )
-                        acc = (
-                            dq if acc is None
-                            else jax.tree_util.tree_map(jnp.add, acc, dq)
-                        )
-                    return jax.tree_util.tree_map(
-                        lambda a: a / n_participants, acc
-                    )
-
-                combine = self._combine_fns[cohort] = jax.jit(combine_fn)
-            averaged = combine(
-                result,
-                jnp.float32(max(self._manager.num_participants(), 1)),
-            )
-        else:
-            averaged = result
+        # every compress mode (incl. int8's q8 ring) returns the averaged
+        # delta tree directly
+        averaged = result
         old_global = _to_device_tree(self._backup_params)
 
         if self._commit_fn is None:

@@ -395,7 +395,12 @@ class Manager:
 
     # -- data plane --
 
-    def allreduce(self, tree: Any, op: ReduceOp = ReduceOp.AVG) -> Work:
+    def allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.AVG,
+        wire: Optional[str] = None,
+    ) -> Work:
         """Fault-tolerantly averages a gradient pytree across replica groups.
 
         Data-plane errors never raise: on a collective failure the returned
@@ -408,7 +413,9 @@ class Manager:
         at all, matching reference manager.py:265. Non-participating
         (healing/spare) replicas contribute zeros. ``op`` must be AVG
         (divide by ``num_participants``, the live divisor, reference
-        :279-291) or SUM.
+        :279-291) or SUM. ``wire`` forwards to the collectives backend
+        (``"q8"`` = int8-quantized ring chunks, constant wire bytes in
+        world size — see Collectives.allreduce).
         """
         def dispatch(zeroed_tree: Any) -> Work:
             if op == ReduceOp.AVG:
@@ -426,7 +433,7 @@ class Manager:
             else:
                 raise ValueError(f"unsupported managed allreduce op: {op}")
             return self._collectives.allreduce(
-                zeroed_tree, ReduceOp.SUM, divisor=divisor
+                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire
             )
 
         return self._managed_dispatch("allreduce", tree, dispatch, lambda t: t)
@@ -460,11 +467,16 @@ class Manager:
     ) -> Work:
         """The shared managed-collective discipline: errored short-circuit,
         quorum join, participant zeroing, profiler span + metrics timer,
-        timeout + error-latching wrap; failures latch and resolve to
-        ``default_factory`` applied to the tree AS DISPATCHED — for a
-        non-participating (healing/spare) replica that is the zeroed tree,
-        preserving the zero-contribution discipline even on the error
-        fallback (reference manager.py:242-303, 326-363)."""
+        timeout + error-latching wrap; failures AFTER the quorum join
+        latch and resolve to ``default_factory`` applied to the tree AS
+        DISPATCHED — for a non-participating (healing/spare) replica that
+        is the zeroed tree, preserving the zero-contribution discipline on
+        that fallback (reference manager.py:242-303, 326-363). The
+        PRE-quorum short-circuit (an error already latched when the op is
+        issued) returns the INPUT tree unzeroed: participation isn't
+        knowable without the quorum, and the step is unconditionally
+        discarded by ``should_commit`` — consumers must not treat that
+        early fallback as a zero contribution."""
         if self.errored() is not None:
             return _completed(default_factory(tree))
         self.wait_quorum()

@@ -352,7 +352,9 @@ def loss_fn(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array) -
     return next_token_loss(logits, tokens[:, 1:])
 
 
-def make_train_step(cfg: TransformerConfig, tx: Any) -> Any:
+def make_train_step(
+    cfg: TransformerConfig, tx: Any, bf16_params: bool = False
+) -> Any:
     """ONE-program train step: loss, grad, and optimizer apply fused into
     a single jitted executable with buffer donation.
 
@@ -363,15 +365,38 @@ def make_train_step(cfg: TransformerConfig, tx: Any) -> Any:
     ``LocalSGD.step_applied``-style window accounting — per-step
     cross-group work (the DDP ring) inherently needs the split programs.
 
+    ``bf16_params``: classic mixed precision with a master copy — the
+    gradient pass reads a bf16 working copy of the f32 params (one cast
+    pass instead of a per-use cast; halves param/embed HBM read traffic
+    and the gradient pytree), while the optimizer updates the f32 master,
+    which ``params`` remains throughout. Forward numerics are identical
+    to the default (the model casts weights to ``cfg.dtype`` at use
+    anyway); what changes is gradient ACCUMULATION precision — multi-use
+    cotangent sums run in bf16 — the standard mixed-precision trade.
+
     Returns ``step(params, opt_state, tokens) -> (params, opt_state,
     loss)``.
     """
     import optax
 
     def one_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(cfg, p, tokens)
-        )(params)
+        if bf16_params:
+            compute_params = jax.tree_util.tree_map(
+                lambda l: l.astype(jnp.bfloat16)
+                if l.dtype == jnp.float32 else l,
+                params,
+            )
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, tokens)
+            )(compute_params)
+            # master update in f32 regardless of wire/grad dtype
+            grads = jax.tree_util.tree_map(
+                lambda g, m: g.astype(m.dtype), grads, params
+            )
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, tokens)
+            )(params)
         updates, new_opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt_state, loss
 

@@ -37,6 +37,13 @@ def make_apply_fn(tx: Any) -> Any:
     import optax
 
     def apply(params: Any, opt_state: Any, grads: Any):
+        # Mixed-precision-friendly: grads may arrive in a lower wire/compute
+        # dtype (bf16 ring payloads, models.make_train_step(bf16_params=True));
+        # the master update always runs in the params' own (f32) dtype.
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype) if g.dtype != p.dtype else g,
+            grads, params,
+        )
         updates, new_opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt_state
 

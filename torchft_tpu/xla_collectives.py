@@ -112,6 +112,10 @@ class XLACollectives(Collectives):
         self._aborted = False
         self._jit_cache: dict = {}
         self._protected: List[Any] = []
+        # Host snapshots of _protected taken at teardown, restored by the
+        # next SUCCESSFUL configure (survives an initialize() failure
+        # in between — see teardown_backends in configure()).
+        self._pending_snapshots: Optional[List[Any]] = None
 
     def register_state(self, state: Any) -> None:
         """Registers a state holder (anything with ``snapshot()`` /
@@ -153,18 +157,22 @@ class XLACollectives(Collectives):
 
             from jax.extend import backend as jax_backend
 
-            snapshots: List[Any] = []
-            tore_down = False
-
             def teardown_backends() -> None:
                 # Orphans live jax arrays (see module docstring), so
                 # registered state holders are snapshotted to host first
                 # — lazily, right before the clear, so a no-teardown
-                # configure never pays the d2h state copy.
-                nonlocal tore_down
-                if not tore_down:
-                    snapshots.extend(s.snapshot() for s in self._protected)
-                tore_down = True
+                # configure never pays the d2h state copy. Snapshots live
+                # on SELF, not a local: if initialize() fails after a
+                # teardown, the next configure attempt must still restore
+                # the holders (whose arrays are already orphaned) — a
+                # local list would leak them and silently hand training
+                # stale-backend arrays. Never overwrite pending snapshots:
+                # after a failed attempt the holders' current arrays are
+                # orphans, and re-snapshotting them would capture garbage.
+                if self._pending_snapshots is None:
+                    self._pending_snapshots = [
+                        s.snapshot() for s in self._protected
+                    ]
                 jax.clear_caches()
                 jax_backend.clear_backends()
                 self._jit_cache.clear()
@@ -218,12 +226,18 @@ class XLACollectives(Collectives):
             )
             self._rank = rank
             self._world_size = world_size
-            if tore_down:
+            if self._pending_snapshots is not None:
                 # Only a teardown orphans device arrays; a no-teardown
                 # configure must not pay the host round-trip (or drop the
-                # holders' cached executables).
-                for holder, snap in zip(self._protected, snapshots):
+                # holders' cached executables). Pending snapshots may also
+                # be carried over from a PREVIOUS configure whose
+                # initialize() failed post-teardown — restored here on the
+                # first attempt that succeeds.
+                for holder, snap in zip(
+                    self._protected, self._pending_snapshots
+                ):
                     holder.restore(snap)
+                self._pending_snapshots = None
             self._aborted = False
 
         # Bounded wait: if a wedged in-flight collective is holding the op
@@ -382,7 +396,11 @@ class XLACollectives(Collectives):
         tree: Any,
         op: ReduceOp = ReduceOp.SUM,
         divisor: Optional[float] = None,
+        wire: Optional[str] = None,
     ) -> Work:
+        # wire="q8" is accepted and served LOSSLESSLY: XLA collectives ride
+        # ICI/DCN where the f32 psum is the native (and cheaper) path; the
+        # quantized wire exists for the host ring's TCP links.
         return self._submit(lambda: self._allreduce_sync(tree, op, divisor))
 
     def _allreduce_sync(
