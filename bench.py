@@ -204,8 +204,20 @@ def peer() -> None:
     params = init_params(cfg, jax.random.PRNGKey(0))
     peer_dtype = os.environ.get("BENCH_PEER_DTYPE")
     if peer_dtype == "int8":
-        # int8 payloads ride the ring's quantized wire (wire="q8"): the
-        # peer contributes the param-shaped f32 zero tree and the ring
+        # int8 windows travel as a managed (device-packed) ALLGATHER of
+        # {q: int8 leaves, scale: f32 scalars} (AsyncDiLoCo/PipelinedDDP
+        # compress="int8"); the peer's zero contribution is all-zero q
+        # with zero scales.
+        zeros = {
+            "q": jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, jnp.int8), params
+            ),
+            "scale": jax.tree_util.tree_map(
+                lambda l: jnp.zeros((), jnp.float32), params
+            ),
+        }
+    elif peer_dtype == "q8":
+        # quantized RING wire: param-shaped f32 zero tree; the ring
         # quantizes per chunk — same op header on both members.
         zeros = jax.tree_util.tree_map(
             lambda l: jnp.zeros(l.shape, jnp.float32), params
@@ -259,6 +271,8 @@ def peer() -> None:
         if i > 0:
             manager.start_quorum(allow_heal=False)
         if peer_dtype == "int8":
+            manager.allgather(zeros).wait()  # paced by the main side
+        elif peer_dtype == "q8":
             manager.allreduce(zeros, wire="q8").wait()  # paced by main
         else:
             manager.allreduce(zeros).wait()  # paced by the main side
@@ -580,7 +594,7 @@ def _bench_ddp_small(d2h_MBps: float, h2d_MBps: float) -> dict:
     overlaps step i's ring with step i+1's grads, so the achievable ratio
     is C/max(C, R) rather than C/(C+R). The batch is chosen so estimated
     compute ~= 1.2x the estimated ring time on the MEASURED link (bigger
-    batches on worse links), capped at 256.
+    batches on worse links), capped at 512.
     """
     import jax
     import numpy as np
@@ -616,9 +630,12 @@ def _bench_ddp_small(d2h_MBps: float, h2d_MBps: float) -> dict:
             2, 12,
         )
         c_base = 1.0 / raw_sps
-        # scale batch so compute ~= 1.2x ring estimate (compute ~linear in B)
+        # scale batch so compute ~= 1.2x ring estimate (compute ~linear
+        # in B; pipelined ratio ~ C/max(C, R), so C >= ~1.1R is the 0.9
+        # bar). Cap 512: ~1M tokens/step of the 0.72M-param model still
+        # fits HBM comfortably.
         want_B = int(base_B * max(1.2 * r_est / c_base, 1.0))
-        B = min(max(32, (want_B // 32) * 32), 256)
+        B = min(max(32, (want_B // 32) * 32), 512)
         if B != base_B:
             os.environ["BENCH_DDP_SMALL_BATCH"] = str(B)
             cfg, batch, _ = _model_setup("ddp_small")
@@ -1036,14 +1053,17 @@ def main() -> None:
         2.5 * (sync_mb / max(d2h_MBps, 0.1) + sync_mb / max(h2d_MBps, 0.1))
         + 1.0  # ring + dispatch slack
     )
-    # Cap 12288 (not 4096): the deployment rule sizes the window so the
-    # sync stays <= ~10% of wall-clock; on a badly degraded link the old
-    # cap forced a window whose ~13 s boundary sync was 25% of it — a
-    # link artifact measured as framework cost. The supervisor budget then
-    # clamps the window so both timed windows (plus margin) still fit the
-    # attempt: a window the supervisor kills measures nothing.
+    # Cap 4096: this phase's ratio is the PROVISIONAL headline only (the
+    # big phase's ratio is the real one), so it no longer buys precision
+    # with giant windows — and the tunnel's throughput can degrade 5x+
+    # MID-WINDOW, turning a 12288-step window sized at the healthy rate
+    # into a supervisor-budget killer (observed: a ~164 s window crawling
+    # past 40 min). A capped window under-amortizes the boundary sync on
+    # degraded links; the big phase measures the honest ratio. The
+    # supervisor budget then clamps further so both timed windows (plus
+    # margin) fit the attempt: a killed window measures nothing.
     sync_every = int(
-        min(max(12 * sync_est_s * raw_sps, SYNC_EVERY), 12288) // 128 * 128
+        min(max(12 * sync_est_s * raw_sps, SYNC_EVERY), 4096) // 128 * 128
     ) or SYNC_EVERY
     sync_every = min(sync_every, _budget_window_steps(2, raw_sps, margin=180))
     # Two timed windows, best-of reported: the tunneled device runtime has

@@ -397,8 +397,38 @@ class TestLocalSGDInteg:
 class TestInt8Compression:
     def _manager(self, commit=True, participants=1):
         manager = _mock_manager(commit=commit)
+        manager.allgather.side_effect = lambda tree: _completed([tree])
         manager.num_participants.return_value = participants
         return manager
+
+    def test_int8_ships_quantized_payload_via_allgather(self):
+        # compress="int8": the DEVICE link carries int8 bytes — the wire
+        # payload is {q: int8 leaves, scale: f32} over a managed
+        # allgather, dequantize-averaged member-wise on finish.
+        import jax
+
+        manager = self._manager()
+        seen = []
+        manager.allgather.side_effect = lambda tree: (
+            seen.append(tree), _completed([tree])
+        )[1]
+        st = _state(1.0)
+        ad = AsyncDiLoCo(
+            manager, st, optax.sgd(1.0), sync_every=2, compress="int8"
+        )
+        grads = {"w": jnp.ones((4,))}
+        for _ in range(4):
+            ad.step(grads)
+        ad.flush()
+        assert seen and all(
+            str(l.dtype) == "int8"
+            for e in seen
+            for l in jax.tree_util.tree_leaves(e["q"])
+        )
+        assert all("scale" in e for e in seen)
+        np.testing.assert_allclose(
+            np.asarray(st.params["w"]), 0.6, atol=0.01
+        )
 
     def test_ships_quantized_grid_over_q8_wire(self):
         import jax
@@ -413,7 +443,7 @@ class TestInt8Compression:
         manager.allreduce.side_effect = capture
         st = _state(1.0)
         ad = AsyncDiLoCo(
-            manager, st, optax.sgd(1.0), sync_every=2, compress="int8"
+            manager, st, optax.sgd(1.0), sync_every=2, compress="q8"
         )
         grads = {"w": jnp.ones((4,))}
         for _ in range(4):
@@ -498,7 +528,7 @@ class TestInt8Compression:
         manager.allreduce.side_effect = halved
         st = _state(1.0)
         ad = AsyncDiLoCo(
-            manager, st, optax.sgd(1.0), sync_every=1, compress="int8"
+            manager, st, optax.sgd(1.0), sync_every=1, compress="q8"
         )
         ad.step({"w": jnp.ones((4,))})  # inner lr 0.1 -> own delta 0.1
         ad.flush()

@@ -83,16 +83,24 @@ class PipelinedDDP:
     the original dtypes on return — the JAX analog of torch DDP's
     ``bf16_compress_hook``.
 
-    ``compress="int8"`` quantizes each gradient leaf to int8 with a
-    per-leaf f32 scale and ERROR FEEDBACK (the per-step quantization error
-    carries into the next step's gradients — the standard EF-SGD recipe,
-    reset on heal along with the rest of the local trajectory); the
-    dequantized gradients then ride the native ring's quantized wire
-    (``wire="q8"``: int8 chunks + per-chunk scales, dequant-accumulated
-    per hop), so wire bytes are ~4x below f32 AND constant in cohort
-    size, mirroring :class:`~torchft_tpu.local_sgd.AsyncDiLoCo`'s int8
-    mode. The per-step mode for links where the gradient ship is the
-    bottleneck — the analog of torch DDP's compressed comm hooks.
+    Quantized modes (both: per-leaf int8 quantization with ERROR
+    FEEDBACK — the per-step quantization error carries into the next
+    step's gradients, the standard EF-SGD recipe, reset on heal along
+    with the rest of the local trajectory; the analog of torch DDP's
+    compressed comm hooks). Two transports for two bottlenecks:
+
+    - ``compress="int8"``: the int8 payload itself ({q, scale} leaves)
+      rides a managed device-packed ALLGATHER and is dequantize-averaged
+      on settle. The DEVICE<->HOST link carries int8 bytes — the mode for
+      hosts where that link (PCIe / a tunneled runtime) is the
+      bottleneck. Allgather traffic grows with cohort size; intended for
+      small cohorts.
+    - ``compress="q8"``: the dequantized (f32, int8-gridded) gradients
+      ride the native ring's quantized wire (int8 chunks + per-chunk
+      scales, dequant-accumulated per hop): TCP bytes are ~4x below f32
+      and CONSTANT in cohort size, but the device link carries f32 — the
+      mode for real DCN deployments where the network is the bottleneck
+      and cohorts are larger.
 
     Usage::
 
@@ -109,19 +117,20 @@ class PipelinedDDP:
         grad_fn: Callable[..., Tuple[Any, Any]],
         compress: Optional[str] = None,
     ) -> None:
-        if compress not in (None, "bf16", "int8"):
+        if compress not in (None, "bf16", "int8", "q8"):
             raise ValueError(f"unsupported compress: {compress!r}")
         self._manager = manager
         self._state = state
         self._grad_fn = grad_fn
         self._compress_mode = compress
         self._inflight: Optional[Work] = None
-        self._inflight_dtypes: Any = None  # grad dtypes AT dispatch (may
-        #                                    change across restores)
+        self._inflight_dtypes: Any = None  # grad dtype TUPLE at dispatch
+        #                                    (may change across restores)
         self._compress_jit: Optional[Any] = None
         self._decompress_jit: Optional[Any] = None
         self._quant_jit: Optional[Any] = None
-        self._residual: Any = None       # int8: error-feedback carry
+        self._combine_fns: dict = {}     # int8: per-cohort dequant-avg
+        self._residual: Any = None       # int8/q8: error-feedback carry
         self._prev_residual: Any = None  # pre-dispatch carry (non-commit
         #                                  settles roll back to it)
 
@@ -131,48 +140,33 @@ class PipelinedDDP:
         a restore can change the gradient pytree's dtypes mid-run)."""
         import jax
 
-        self._inflight_dtypes = jax.tree_util.tree_map(
-            lambda l: l.dtype, grads
+        # hashable tuple (leaf order = tree_flatten order): doubles as
+        # the static arg of the jitted decompress cast
+        self._inflight_dtypes = tuple(
+            l.dtype for l in jax.tree_util.tree_leaves(grads)
         )
         if self._compress_mode is None:
             return grads
         import jax.numpy as jnp
 
-        if self._compress_mode == "int8":
+        if self._compress_mode in ("int8", "q8"):
             if self._quant_jit is None:
+                from .quantize import quantize_with_feedback
 
-                def quant(g, residual):
-                    def leaf(l, r):
-                        d = l.astype(jnp.float32) + r
-                        scale = jnp.maximum(
-                            jnp.max(jnp.abs(d)) / 127.0, 1e-12
-                        )
-                        q = jnp.clip(
-                            jnp.round(d / scale), -127, 127
-                        ).astype(jnp.int8)
-                        dq = q.astype(jnp.float32) * scale
-                        return {"dq": dq, "res": d - dq}
-
-                    # dict-keyed transpose (the local_sgd.py quant_fn
-                    # shape): structure-driven, so a gradient pytree that
-                    # itself contains tuples can never be mis-split the
-                    # way an isinstance(tuple) is_leaf sniff would
-                    packed = jax.tree_util.tree_map(leaf, g, residual)
-                    out = jax.tree_util.tree_transpose(
-                        jax.tree_util.tree_structure(g),
-                        jax.tree_util.tree_structure({"dq": 0, "res": 0}),
-                        packed,
-                    )
-                    return out["dq"], out["res"]
-
-                self._quant_jit = jax.jit(quant)
+                self._quant_jit = jax.jit(quantize_with_feedback)
             if self._residual is None:
                 self._residual = jax.tree_util.tree_map(
                     lambda l: jnp.zeros(l.shape, jnp.float32), grads
                 )
             self._prev_residual = self._residual  # restored on non-commit
-            dq, self._residual = self._quant_jit(grads, self._residual)
-            return dq
+            out = self._quant_jit(grads, self._residual)
+            self._residual = out["res"]
+            if self._compress_mode == "int8":
+                # int8 BYTES cross the device link (device-packed
+                # allgather); settle dequantize-averages
+                return {"q": out["q"], "scale": out["scale"]}
+            # q8: f32 on the device link, int8 on the TCP ring
+            return out["dq"]
 
         if self._compress_jit is None:
 
@@ -188,19 +182,31 @@ class PipelinedDDP:
         return self._compress_jit(grads)
 
     def _decompress(self, avg: Any) -> Any:
-        if self._compress_mode in (None, "int8"):
+        if self._compress_mode in (None, "int8", "q8"):
             return avg
         import jax
 
         # restore the dtypes recorded AT dispatch (not a forever-cached
-        # tree: a restore may legitimately change grad dtypes mid-run)
-        return jax.tree_util.tree_map(
-            lambda l, dt: l.astype(dt), avg, self._inflight_dtypes
-        )
+        # tree: a restore may legitimately change grad dtypes mid-run).
+        # Jitted with the dtype tuple STATIC: one fused cast program per
+        # distinct dtype signature instead of per-leaf eager dispatches
+        # on the per-step hot path.
+        if self._decompress_jit is None:
+
+            def up(t: Any, dts: Any) -> Any:
+                leaves, treedef = jax.tree_util.tree_flatten(t)
+                return jax.tree_util.tree_unflatten(
+                    treedef, [l.astype(d) for l, d in zip(leaves, dts)]
+                )
+
+            self._decompress_jit = jax.jit(up, static_argnums=(1,))
+        return self._decompress_jit(avg, self._inflight_dtypes)
 
     def _dispatch(self, grads: Any) -> Work:
         payload = self._compress(grads)
         if self._compress_mode == "int8":
+            return self._manager.allgather(payload)
+        if self._compress_mode == "q8":
             # the quantized ring returns the averaged f32 tree directly
             # (FTTrainState harmonizes dtypes against the master params)
             return self._manager.allreduce(payload, wire="q8")
@@ -213,8 +219,28 @@ class PipelinedDDP:
         self._inflight = None
         committed = self._manager.should_commit()
         if committed:
-            self._state.apply_gradients(self._decompress(result))
-        elif self._compress_mode == "int8":
+            if self._compress_mode == "int8":
+                # member-wise dequantize, average over PARTICIPANTS
+                # (healing/spare entries arrive zeroed and must not
+                # dilute the divisor — Manager.allgather discipline)
+                import jax
+                import jax.numpy as jnp
+
+                cohort = len(result)
+                combine = self._combine_fns.get(cohort)
+                if combine is None:
+                    from .quantize import make_dequant_average
+
+                    combine = self._combine_fns[cohort] = \
+                        make_dequant_average()
+                avg = combine(
+                    result,
+                    float(max(self._manager.num_participants(), 1)),
+                )
+            else:
+                avg = self._decompress(result)
+            self._state.apply_gradients(avg)
+        elif self._compress_mode in ("int8", "q8"):
             # The step was discarded: its gradients were never applied, so
             # carrying ITS quantization error forward would inject signal
             # from an abandoned payload into the next step — roll the EF

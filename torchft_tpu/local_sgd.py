@@ -268,17 +268,25 @@ class AsyncDiLoCo(DiLoCo):
         optimizer sees bf16-rounded pseudogradients, the f32 master params
         are untouched.
 
-        ``compress="int8"`` quantizes each pseudogradient leaf to int8
-        with a per-leaf f32 scale and ERROR FEEDBACK (the quantization
-        residual is added to the next window's delta, so rounding error
-        never accumulates) — 4x fewer bytes than f32, 2x fewer than bf16.
-        The dequantized delta then rides the native ring's QUANTIZED wire
-        (``wire="q8"``: int8 chunks with per-chunk scales,
-        dequant-accumulated per hop), so sync bytes are CONSTANT in
-        cohort size — the pre-round-4 allgather form grew O(world). The
-        ring's per-chunk regrid of the already-int8-gridded values adds
-        at most one quantization step of noise, which the next window's
-        error feedback does not see (documented lossy wire).
+        Quantized modes (both: per-leaf int8 with a f32 scale and ERROR
+        FEEDBACK — the quantization residual is added to the next
+        window's delta, so rounding error never accumulates). Two
+        transports for two bottlenecks:
+
+        ``compress="int8"``: the int8 payload itself ({q, scale} leaves)
+        rides a managed device-packed ALLGATHER and is dequantize-averaged
+        member-wise — the DEVICE<->HOST link carries int8 bytes (4x fewer
+        than f32, 2x fewer than bf16), for hosts where that link is the
+        bottleneck. Allgather traffic grows with cohort size; intended
+        for small cohorts.
+
+        ``compress="q8"``: the dequantized (int8-gridded f32) delta rides
+        the native ring's quantized wire (int8 chunks with per-chunk
+        scales, dequant-accumulated per hop): TCP sync bytes are CONSTANT
+        in cohort size, for DCN deployments where the network is the
+        bottleneck and cohorts are larger. The ring's per-chunk regrid
+        adds at most one quantization step of noise, which the next
+        window's error feedback does not see (documented lossy wire).
 
         ``overlap=False`` completes the sync AT the boundary instead of one
         window later (the reconciliation degenerates to θ = G', i.e. exact
@@ -288,7 +296,7 @@ class AsyncDiLoCo(DiLoCo):
         transfer under a stream of async dispatches can starve for far
         longer than its serial wall time, and a blocking boundary sync is
         strictly faster."""
-        if compress not in (None, "bf16", "int8"):
+        if compress not in (None, "bf16", "int8", "q8"):
             raise ValueError(f"unsupported compress mode: {compress}")
         super().__init__(manager, state, outer_tx, sync_every)
         self._compress = compress
@@ -298,8 +306,9 @@ class AsyncDiLoCo(DiLoCo):
         self._delta_fn: Any = None  # jitted Δ = B − θ (with optional cast)
         self._commit_fn: Any = None  # jitted delayed outer update + reconcile
         self._abort_fn: Any = None  # jitted window rollback
-        self._quant_fn: Any = None       # int8: jitted quantize + EF update
-        self._residual: Any = None       # int8: error-feedback carry
+        self._quant_fn: Any = None    # int8/q8: jitted quantize + EF update
+        self._combine_fns: Dict[int, Any] = {}  # int8: per-cohort avg
+        self._residual: Any = None    # int8/q8: error-feedback carry
 
     def sync(self) -> None:
         self._finish_pending()
@@ -336,37 +345,20 @@ class AsyncDiLoCo(DiLoCo):
         t0 = time.perf_counter()
         old_global = _to_device_tree(self._backup_params)
 
-        if self._compress == "int8":
+        if self._compress in ("int8", "q8"):
             if self._residual is None:
                 self._residual = jax.tree_util.tree_map(
                     lambda l: jnp.zeros(l.shape, jnp.float32),
                     self._state.params,
                 )
             if self._quant_fn is None:
+                from .quantize import quantize_with_feedback
 
                 def quant_fn(old, new, residual):
-                    def leaf(o, n, r):
-                        d = (o - n).astype(jnp.float32) + r
-                        scale = jnp.maximum(
-                            jnp.max(jnp.abs(d)) / 127.0, 1e-12
-                        )
-                        q = jnp.clip(
-                            jnp.round(d / scale), -127, 127
-                        ).astype(jnp.int8)
-                        dq = q.astype(jnp.float32) * scale
-                        return {"q": q, "scale": scale, "dq": dq,
-                                "res": d - dq}
-
-                    packed = jax.tree_util.tree_map(
-                        leaf, old, new, residual
+                    delta = jax.tree_util.tree_map(
+                        lambda o, n: o - n, old, new
                     )
-                    return jax.tree_util.tree_transpose(
-                        jax.tree_util.tree_structure(old),
-                        jax.tree_util.tree_structure(
-                            {"q": 0, "scale": 0, "dq": 0, "res": 0}
-                        ),
-                        packed,
-                    )
+                    return quantize_with_feedback(delta, residual)
 
                 self._quant_fn = jax.jit(quant_fn)
 
@@ -375,14 +367,21 @@ class AsyncDiLoCo(DiLoCo):
                 old_global, self._state.params, prev_residual
             )
             self._residual = out["res"]  # EF carry (restored on abort)
-            # ship the DEQUANTIZED delta over the ring's quantized wire:
-            # the values are already on the int8 grid leaf-wise (EF
-            # accounts for that rounding); the ring re-grids per chunk and
-            # returns the averaged f32 tree directly — constant wire bytes
-            # in cohort size, no member-wise combine needed
-            work = self._manager.allreduce(
-                out["dq"], op=ReduceOp.AVG, wire="q8"
-            )
+            if self._compress == "int8":
+                # int8 BYTES cross the device link (device-packed
+                # allgather); the finish side dequantize-averages
+                work = self._manager.allgather(
+                    {"q": out["q"], "scale": out["scale"]}
+                )
+            else:
+                # q8: ship the DEQUANTIZED delta over the ring's
+                # quantized wire — the values are already on the int8
+                # grid leaf-wise (EF accounts for that rounding); the
+                # ring re-grids per chunk and returns the averaged f32
+                # tree directly, constant TCP bytes in cohort size
+                work = self._manager.allreduce(
+                    out["dq"], op=ReduceOp.AVG, wire="q8"
+                )
             # reconcile against what we actually SHIPPED (the dequantized
             # local delta), same role as the bf16-rounded delta below
             self._pending = (work, out["dq"], prev_residual)
@@ -426,9 +425,26 @@ class AsyncDiLoCo(DiLoCo):
         result = work.wait()
         logger.debug("sync ring wait %.2fs", time.perf_counter() - t0)
         t0 = time.perf_counter()
-        # every compress mode (incl. int8's q8 ring) returns the averaged
-        # delta tree directly
-        averaged = result
+        if self._compress == "int8":
+            # member-wise dequantize, then average over PARTICIPANTS:
+            # non-participating (healing/spare) entries arrive zeroed
+            # (Manager.allgather) and must not dilute the divisor
+            import jax.numpy as jnp
+
+            cohort = len(result)
+            combine = self._combine_fns.get(cohort)
+            if combine is None:
+                from .quantize import make_dequant_average
+
+                combine = self._combine_fns[cohort] = \
+                    make_dequant_average()
+            averaged = combine(
+                result,
+                jnp.float32(max(self._manager.num_participants(), 1)),
+            )
+        else:
+            # bf16 / q8 / plain: the wire returns the averaged delta tree
+            averaged = result
         old_global = _to_device_tree(self._backup_params)
 
         if self._commit_fn is None:
