@@ -131,9 +131,33 @@ def build_model():
     """MODEL=mlp (default, synthetic blobs), MODEL=cnn (images through
     models.cnn — the reference demo's model family, reference
     train_ddp.py:64-72; pick the dataset with DATA=digits|cifar10|synthetic,
-    see make_image_dataset), or MODEL=moe (tiny mixture-of-experts LM on
-    synthetic tokens)."""
+    see make_image_dataset), MODEL=lm (the flagship decoder-only
+    transformer, tiny config), or MODEL=moe (tiny mixture-of-experts LM
+    on synthetic tokens)."""
     model = os.environ.get("MODEL", "mlp")
+    if model == "lm":
+        # the flagship decoder-only transformer family (tiny config for
+        # the CPU demo; the TPU-scale configs live in bench.py)
+        from torchft_tpu.models import (
+            TransformerConfig,
+            init_params as lm_init,
+            loss_fn as lm_loss,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=64,
+        )
+        rng = np.random.default_rng(0)
+        n, seq = 2048, 33
+        x = rng.integers(0, cfg.vocab_size, (n, seq)).astype(np.int32)
+        y = np.zeros((n,), np.int32)  # unused: LM loss reads the tokens
+        params = lm_init(cfg, jax.random.PRNGKey(0))
+
+        def loss(params, xb, yb):
+            return lm_loss(cfg, params, xb)
+
+        return params, loss, x, y
     if model == "moe":
         from torchft_tpu.models import moe, tiny_moe_config
 
