@@ -1,0 +1,205 @@
+"""Per-phase breakdown of the link-sized per-step DDP loop on the REAL TPU.
+
+Round-4 verdict #2: ft_ddp_small measured 0.288 steps/s vs 6.586 raw
+(ratio 0.044) with ~3.3 s/step of unexplained overhead against a 0.246 s
+ring estimate — and only 4 timed steps, no breakdown. This experiment runs
+the SAME setup (2-member ring, int8 wire, CPU zero-peer) two ways:
+
+  A. serialized: every phase drained (`_barrier`) so each timer isolates
+     one phase — grad / quant / quorum / dispatch / ring_wait (split
+     further by HostCollectives.pop_op_stats into pack/d2h/ring/h2d) /
+     vote / combine / apply. Inflated total (each drain costs a tunnel
+     RTT) but the DISTRIBUTION is the diagnosis.
+  B. pipelined: PipelinedDDP steady state, >=20 steps, no intermediate
+     drains — the honest rate, with the per-op collectives stats
+     aggregated alongside.
+
+Usage (serialize against any other TPU work — one chip):
+    python experiments/ddp_small_tpu_breakdown.py
+Env: BENCH_DDP_SMALL_BATCH (default 256, the round-4 artifact's point).
+"""
+
+import json
+import os
+import sys
+import time
+from datetime import timedelta
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from torchft_tpu.platform import (  # noqa: E402
+    apply_compilation_cache_env,
+    apply_jax_platform_env,
+)
+
+apply_jax_platform_env()
+apply_compilation_cache_env(os.path.join(REPO, ".bench_jax_cache"))
+
+import bench  # noqa: E402
+
+import jax  # noqa: E402
+import optax  # noqa: E402
+
+from torchft_tpu import (  # noqa: E402
+    FTTrainState,
+    HostCollectives,
+    Manager,
+    PipelinedDDP,
+)
+from torchft_tpu.models import init_params, loss_fn  # noqa: E402
+from torchft_tpu.quantize import (  # noqa: E402
+    make_dequant_average,
+    quantize_with_feedback,
+)
+
+WARM, FINE, PIPE = 2, 6, 20
+
+
+def _round(v):
+    return round(v, 4) if isinstance(v, float) else v
+
+
+def run(state, manager, collectives, cfg, batch) -> None:
+    import jax.numpy as jnp
+
+    grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
+    quant = jax.jit(quantize_with_feedback)
+    combine = make_dequant_average()
+    residual = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, jnp.float32), state.params
+    )
+
+    def one(rec=None):
+        nonlocal residual
+        t0 = time.perf_counter()
+        loss, grads = grad_fn(state.params, batch)
+        bench._barrier(grads)
+        t1 = time.perf_counter()
+        out = quant(grads, residual)
+        residual = out["res"]
+        payload = {"q": out["q"], "scale": out["scale"]}
+        bench._barrier(payload)
+        t2 = time.perf_counter()
+        manager.start_quorum()
+        manager.wait_quorum()
+        t3 = time.perf_counter()
+        work = manager.allgather(payload)
+        t4 = time.perf_counter()
+        res = work.wait()
+        t5 = time.perf_counter()
+        committed = manager.should_commit()
+        t6 = time.perf_counter()
+        avg = combine(res, float(max(manager.num_participants(), 1)))
+        bench._barrier(avg)
+        t7 = time.perf_counter()
+        if committed:
+            state.apply_gradients(avg)
+        bench._barrier(state.params)
+        t8 = time.perf_counter()
+        if rec is not None:
+            rec.append({
+                "grad": t1 - t0, "quant": t2 - t1, "quorum": t3 - t2,
+                "dispatch": t4 - t3, "ring_wait": t5 - t4, "vote": t6 - t5,
+                "combine": t7 - t6, "apply": t8 - t7, "total": t8 - t0,
+            })
+
+    print("== A: serialized phases ==", flush=True)
+    for _ in range(WARM):
+        one()
+    collectives.pop_op_stats()
+    recs = []
+    for i in range(FINE):
+        one(recs)
+        print(f"  fine step {i}: {recs[-1]['total']:.3f}s", flush=True)
+    med = {
+        k: round(sorted(r[k] for r in recs)[len(recs) // 2], 4)
+        for k in recs[0]
+    }
+    fine_ops = collectives.pop_op_stats()
+    print("median s/phase:", json.dumps(med), flush=True)
+    print("op stats:", json.dumps(
+        [{k: _round(v) for k, v in s.items()} for s in fine_ops]), flush=True)
+
+    print("== B: pipelined steady state ==", flush=True)
+    ddp = PipelinedDDP(
+        manager, state, lambda p, b: grad_fn(p, b), compress="int8"
+    )
+    ddp.step(batch)  # warm
+    bench._barrier(state.params)
+    t0 = time.perf_counter()
+    step_times = []
+    for i in range(PIPE):
+        ts = time.perf_counter()
+        ddp.step(batch)
+        step_times.append(time.perf_counter() - ts)
+    t_end = time.perf_counter()
+    ddp.flush()
+    bench._barrier(state.params)
+    # The warm step's allgather may settle after the pop above (it is
+    # only waited inside the first timed step) — keep the LAST ``PIPE``
+    # entries so a late warm-round stat can't bias the medians.
+    pipe_ops = collectives.pop_op_stats()[-PIPE:]
+    sps = PIPE / (t_end - t0)
+    agg = {}
+    for s in pipe_ops:
+        for k in ("pack", "d2h", "ring", "h2d"):
+            if k in s:
+                agg.setdefault(k, []).append(s[k])
+    print("pipelined steps/s:", round(sps, 3), flush=True)
+    print("per-step host time: median",
+          round(sorted(step_times)[len(step_times) // 2], 4),
+          "max", round(max(step_times), 4), flush=True)
+    print("op medians:", json.dumps({
+        k: round(sorted(v)[len(v) // 2], 4) for k, v in agg.items()}),
+        flush=True)
+    print("metrics:", json.dumps(manager.metrics().snapshot(), default=str),
+          flush=True)
+    assert collectives.size() == 2
+
+
+def main() -> None:
+    os.environ["BENCH_MODEL"] = "ddp_small"
+    os.environ.setdefault("BENCH_DDP_SMALL_BATCH", "256")
+    os.environ.setdefault("TORCHFT_HC_PIPELINE_CHUNKS", "1")
+
+    cfg, batch, _ = bench._model_setup("ddp_small")
+    print(f"platform={jax.devices()[0].platform} batch={batch.shape}",
+          flush=True)
+    tx = optax.adamw(1e-3)
+    rounds = WARM + FINE + 1 + PIPE  # serialized + pipelined warm + steps
+
+    lh = peer = manager = collectives = None
+    try:
+        lh = bench._fresh_lighthouse()
+        peer = bench._spawn_peer(lh.address(), rounds, "int8")
+        state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
+        collectives = HostCollectives(timeout=timedelta(seconds=600))
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=state.load_state_dict,
+            state_dict=state.state_dict,
+            min_replica_size=1,
+            timeout=timedelta(seconds=600),
+            quorum_timeout=timedelta(seconds=600),
+            rank=0,
+            world_size=1,
+            lighthouse_addr=lh.address(),
+            replica_id="bench_main_ddp_probe",  # sorts before bench_peer
+        )
+        run(state, manager, collectives, cfg, batch)
+        peer.wait(timeout=300)
+    finally:
+        if peer is not None and peer.poll() is None:
+            peer.kill()
+        if manager is not None:
+            manager.shutdown()
+        if collectives is not None:
+            collectives.shutdown()
+        if lh is not None:
+            lh.shutdown()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 from abc import ABC, abstractmethod
 from concurrent.futures import Future, ThreadPoolExecutor
 from datetime import timedelta
@@ -382,6 +383,29 @@ class HostCollectives(Collectives):
         )
         self._shutdown = False
         self._packers: dict = {}
+        # Per-op phase timings recorded by the device-packed paths (see
+        # pop_op_stats): on tunneled device runtimes the d2h leg can cost
+        # 10x the ring leg, and nothing else distinguishes them.
+        self._op_stats: List[dict] = []
+
+    def _record_op_stats(self, stats: dict) -> None:
+        self._op_stats.append(stats)
+        del self._op_stats[:-64]  # bounded: diagnostics, not a log
+
+    def pop_op_stats(self) -> List[dict]:
+        """Drains the per-op phase timings (seconds) the device-packed
+        paths recorded: ``pack`` (jitted concat dispatch), ``d2h`` (the
+        blocking device→host read), ``ring`` (the native TCP op), ``h2d``
+        (result upload + unpack DISPATCH — jax uploads asynchronously, so
+        the actual transfer completes under the caller's next use/drain
+        and is charged there, not here), plus ``bytes`` = the bytes that
+        crossed the DEVICE link (``wire_bytes`` additionally, where the
+        TCP wire ships a different encoding — the q8 ring sends ~1/4 of
+        its f32 device payload). The numbers that tell a slow
+        collective's transfer cost from its wire cost — per-step DDP on a
+        degraded device link is diagnosable only with this split."""
+        out, self._op_stats = self._op_stats, []
+        return out
 
     # -- lifecycle --
 
@@ -520,14 +544,17 @@ class HostCollectives(Collectives):
                 packer = self._packers[key] = _DevicePacker(
                     leaves, force_f32=True
                 )
+            t0 = time.perf_counter()
             buf = np.asarray(packer.pack(leaves)[str(np.dtype(np.float32))])
             if not buf.flags.writeable or not buf.flags.c_contiguous:
                 buf = np.array(buf)
+            d2h_s = time.perf_counter() - t0
         else:
             arrays = [_as_numpy(l) for l in leaves]
             buf = np.concatenate(
                 [a.astype(np.float32, copy=False).ravel() for a in arrays]
             )
+        t1 = time.perf_counter()
         _check(
             _lib.tft_hc_allreduce_q8(
                 self._handle,
@@ -538,13 +565,23 @@ class HostCollectives(Collectives):
         )
         if divisor is not None:
             buf /= divisor
+        ring_s = time.perf_counter() - t1
         if all_jax:
             import jax.numpy as jnp
 
-            return _unflatten(
+            out = _unflatten(
                 treedef,
                 packer.unpack({str(np.dtype(np.float32)): jnp.asarray(buf)}),
             )
+            self._record_op_stats({
+                "op": "allreduce_q8", "bytes": buf.nbytes,
+                # TCP wire ships int8 chunks + per-chunk f32 scales, not
+                # the f32 device payload
+                "wire_bytes": buf.size,
+                "d2h": d2h_s, "ring": ring_s,
+                "h2d": time.perf_counter() - t1 - ring_s,
+            })
+            return out
         out_leaves = []
         offset = 0
         for a in arrays:
@@ -700,30 +737,51 @@ class HostCollectives(Collectives):
         n = dev.size
         k = self._pipeline_chunks
         if k <= 1 or n * itemsize < self._pipeline_min_bytes:
+            t0 = time.perf_counter()
             arr = np.asarray(dev)  # one transfer per group
             if not arr.flags.writeable or not arr.flags.c_contiguous:
                 arr = np.array(arr)  # ring reduces in place
+            t1 = time.perf_counter()
             self._ring_chunk(arr, native_op, timeout_ms)
             if divisor is not None:
                 arr = self._apply_divisor(arr, divisor)
-            return jnp.asarray(arr)
+            t2 = time.perf_counter()
+            out = jnp.asarray(arr)
+            self._record_op_stats({
+                "op": "allreduce", "bytes": n * itemsize,
+                "d2h": t1 - t0, "ring": t2 - t1,
+                "h2d": time.perf_counter() - t2,
+            })
+            return out
 
         bounds = [n * i // k for i in range(k + 1)]
         chunks = [dev[a:b] for a, b in zip(bounds, bounds[1:])]
         for c in chunks:
             c.copy_to_host_async()  # queue every DMA up front
         out_chunks = []
+        d2h_s = ring_s = h2d_s = 0.0
         for c in chunks:
+            t0 = time.perf_counter()
             arr = np.asarray(c)  # completes when THIS chunk's DMA lands
             if not arr.flags.writeable or not arr.flags.c_contiguous:
                 arr = np.array(arr)
+            t1 = time.perf_counter()
             self._ring_chunk(arr, native_op, timeout_ms)
             if divisor is not None:
                 arr = self._apply_divisor(arr, divisor)
+            t2 = time.perf_counter()
             # Async dispatch: the upload starts now and overlaps the next
             # chunk's ring pass.
             out_chunks.append(jnp.asarray(arr))
-        return jnp.concatenate(out_chunks)
+            d2h_s += t1 - t0
+            ring_s += t2 - t1
+            h2d_s += time.perf_counter() - t2
+        result = jnp.concatenate(out_chunks)
+        self._record_op_stats({
+            "op": "allreduce", "bytes": n * itemsize, "chunks": k,
+            "d2h": d2h_s, "ring": ring_s, "h2d": h2d_s,
+        })
+        return result
 
     def allgather(self, tree: Any) -> Work:
         timeout_ms = _ms(self._timeout)
@@ -793,12 +851,15 @@ class HostCollectives(Collectives):
             packer = self._packers[key] = _DevicePacker(
                 leaves, exact_dtypes=True
             )
+        t0 = time.perf_counter()
         bufs = packer.pack(leaves)
         names = sorted(bufs)  # deterministic group order on the wire
         for name in names:  # queue every DMA before blocking on the first
             bufs[name].copy_to_host_async()
+        t1 = time.perf_counter()
         host = {name: np.ascontiguousarray(np.asarray(bufs[name]))
                 for name in names}
+        t2 = time.perf_counter()
         packed = b"".join(host[name].tobytes() for name in names)
         nbytes = len(packed)
         inbuf = ctypes.create_string_buffer(packed, nbytes) if nbytes else None
@@ -812,6 +873,7 @@ class HostCollectives(Collectives):
                 timeout_ms,
             )
         )
+        t3 = time.perf_counter()
         results: List[Any] = []
         for r in range(self._world_size):
             offset = r * nbytes
@@ -823,6 +885,11 @@ class HostCollectives(Collectives):
                 )
                 offset += a.nbytes
             results.append(_unflatten(treedef, packer.unpack(member_bufs)))
+        self._record_op_stats({
+            "op": "allgather", "bytes": nbytes,
+            "pack": t1 - t0, "d2h": t2 - t1, "ring": t3 - t2,
+            "h2d": time.perf_counter() - t3,
+        })
         return results
 
     def broadcast(self, tree: Any, root: int = 0) -> Work:
