@@ -6,46 +6,51 @@ second replica group (peer process on host CPU) joins the quorum and the
 host TCP ring, so every cross-group byte is actually packed, shipped, and
 unpacked (no world-size-1 identity shortcut).
 
+UN-LOSEABLE BY CONSTRUCTION (round-4 verdict #1 — that round's driver run
+wedged past its budget and produced no number): every measurement window
+is WALL-CLOCK boxed (run for T seconds, count the steps that completed,
+re-checking the clock at drain boundaries), window lengths derive from the
+MEASURED warm sync of this run — not from a start-of-run rate the tunnel
+can invalidate mid-window — the provisional headline lands right after the
+FIRST short FT window (~5 minutes in), every later phase checks the
+remaining budget before starting (a skipped phase is recorded, a wedged
+one loses the round), and the supervisor runs ONE attempt that fits the
+driver's budget.
+
 Configurations measured (details in BENCH_DETAIL.json):
 
   raw           jitted loss/grad/apply loop, no FT machinery.
   ft_diloco     AsyncDiLoCo on the smoke model — the bandwidth-appropriate
                 cross-group mode for DCN-class links: inner steps stay
                 on-chip and the compressed pseudogradient sync runs once
-                per window (bf16 ring allreduce on healthy links;
-                int8+error-feedback allgather on degraded ones). Window
-                sized from the measured link; full FT machinery (quorum +
-                commit vote) every window; best of 2 timed windows. Lands
-                the PROVISIONAL headline early so later phases can't lose
-                the round's metric.
+                per window. Two time-boxed windows, best-of reported; the
+                PROVISIONAL headline lands after the first.
   ft_ddp_small  per-step DDP at a LINK-SIZED scale — runs on TPU every
                 round unconditionally: a ~0.72M-param S-2048 flash LM
-                whose int8/bf16 gradient ship fits the measured link, with
-                PipelinedDDP hiding the ring behind the next step's
-                compute. The per-step product's number on this hardware.
-  ft_ddp        flagship-scale per-step gradient allreduce (the reference
-                train_ddp mode) against a same-batch raw baseline;
-                blocking and PipelinedDDP both recorded. On a degraded
-                device<->host link it is skipped (per-step shipping of the
-                93 MB gradient is link-bound regardless of framework)
-                unless BENCH_FORCE_DDP=1. On CPU, BOTH the reference-like
-                small batch and the 4x-token batch land in the artifact
-                (the ratio is an arithmetic-intensity story).
+                whose int8 gradient ship fits the measured link, batch
+                sized so compute covers the MEASURED per-step FT overhead
+                (probed live, not estimated), >= 20 timed steps, with the
+                per-phase breakdown (grad / quant+pack / d2h / ring / h2d
+                / quorum / vote) recorded in the artifact.
+  ft_ddp        flagship-scale per-step gradient allreduce against a
+                same-batch raw baseline. On a degraded device<->host link
+                it is skipped (per-step shipping of the 93 MB gradient is
+                link-bound regardless of framework) unless
+                BENCH_FORCE_DDP=1. On CPU, BOTH the reference-like small
+                batch and the 4x-token batch land in the artifact.
   big           the MXU-saturating model (111M params, d_model 1024, 8
                 layers, seq 2048, bf16 compute + f32 master): raw vs
-                AsyncDiLoCo with the window sized so the sync hides behind
-                compute. Its FT/raw ratio is THE HEADLINE (printed last;
-                the driver takes the last metric line) — FT cost at
-                deployment-class arithmetic intensity, with MFU accounting
-                against the v5e peak. Sub-results persist incrementally;
-                BENCH_SKIP_BIG=1 skips.
+                AsyncDiLoCo, SYMMETRIC best-of-2 on both sides. Its
+                FT/raw ratio is THE HEADLINE (printed last; the driver
+                takes the last metric line).
+  big2          one raw MFU point at d_model 2048 / head_dim 128 —
+                ROOFLINE.md predicts the same kernels score higher MFU at
+                larger arithmetic intensity; this measures it.
 
 The reference publishes no absolute numbers (BASELINE.md); the driver-set
 north star is >= 90% of healthy-state throughput. The printed line reports
 ``vs_baseline = (ft_steps_per_sec / raw_steps_per_sec) / 0.90`` — 1.0
-means exactly the 90% bar, > 1.0 beats it; the FINAL line (the one the
-driver records) is the big phase's ratio when that phase completes, else
-the provisional small-model ft_diloco ratio. Throughput *under churn* is
+means exactly the 90% bar, > 1.0 beats it. Throughput *under churn* is
 measured separately by bench_churn.py (CHURN_BENCH.json).
 
 Prints ONE JSON line, e.g.:
@@ -63,8 +68,15 @@ from datetime import timedelta
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-SYNC_EVERY = 128  # AsyncDiLoCo window (inner steps per cross-group sync)
 _T0 = time.monotonic()  # process start, for supervisor-budget guards
+# The child process plans its phases to FINISH inside the supervisor's
+# deadline; _remaining() is the planning primitive (margin covers the
+# final writes + teardown).
+_BUDGET_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 1000))
+
+
+def _remaining(margin: float = 30.0) -> float:
+    return _BUDGET_S - margin - (time.monotonic() - _T0)
 
 
 def _env_wire():
@@ -93,12 +105,12 @@ def _model_setup(size: str = None):
     forced_layers = os.environ.get("BENCH_FORCE_LAYERS")
     if size == "ddp_small":
         # Link-sized per-step DDP config (round-3 verdict #2): ~0.72M
-        # params -> 0.73 MB int8 / 1.45 MB bf16 wire, but LOTS of compute
-        # per param (S 2048 attention through the flash kernel), so the
-        # per-step gradient ship can hide behind the next step's compute
+        # params -> 0.73 MB int8 wire, but LOTS of compute per param
+        # (S 2048 attention through the flash kernel), so the per-step
+        # gradient ship can hide behind the next step's compute
         # (PipelinedDDP) even on a weak device<->host link. head_dim 64
         # keeps the kernel on its fast path. Batch is chosen per-link in
-        # _bench_ddp_small.
+        # _bench_ddp_small from a MEASURED probe step.
         cfg = TransformerConfig(
             vocab_size=512,
             d_model=128,
@@ -127,6 +139,21 @@ def _model_setup(size: str = None):
             max_seq_len=2048,
         )
         batch_size, seq_len = 16, 2048
+    elif size == "big2":
+        # The ROOFLINE.md extrapolation point (round-4 verdict #7):
+        # d_model 2048, head_dim 128 — higher arithmetic intensity per
+        # byte, predicted >= 55% MFU. ~302M params; batch 8 keeps
+        # activations + f32 master + adam moments inside v5e HBM.
+        cfg = TransformerConfig(
+            vocab_size=8192,
+            d_model=2048,
+            n_heads=16,
+            n_layers=6,
+            d_ff=8192,
+            max_seq_len=2048,
+            use_flash=True,
+        )
+        batch_size, seq_len = 8, 2048
     else:
         cfg = TransformerConfig(
             vocab_size=8192,
@@ -167,24 +194,78 @@ def _barrier(tree) -> None:
     np.asarray(leaf.ravel()[0:1])
 
 
-def _time_raw_loop(step_fn, init_fn, tx, batch, warm: int, n: int) -> float:
-    """The one warm+timed raw-loop discipline every phase shares (fresh
-    state per call; _barrier drains before both clock edges; step_fn is
-    the FUSED one-program train step, models.make_train_step — measured
-    ~8% faster than split grad/apply programs on v5e, so it is the honest
-    raw baseline). Keeping a single copy means a change to the
-    timing/drain semantics cannot make phases silently measure
-    differently."""
-    params = init_fn()
-    opt_state = tx.init(params)
-    for _ in range(warm):
-        params, opt_state, loss = step_fn(params, opt_state, batch)
-    _barrier(params)
+def _timed_window(run_step, drain, budget_s, max_steps=1 << 30,
+                  rate_hint=None) -> tuple:
+    """The one wall-clock-boxed stepping discipline every phase shares.
+
+    Runs ``run_step()`` (async dispatch of one training step) until
+    ``budget_s`` seconds elapse or ``max_steps`` complete. The clock is
+    checked at drain boundaries (``drain()`` must force the dispatch
+    queue empty — each costs ~1 tunnel RTT, so the interval adapts to
+    ~6 s of work at the OBSERVED rate, bounded [16, 512]). A tunnel that
+    degrades mid-window therefore shortens the window instead of blowing
+    the supervisor budget (round-4 failure mode: windows sized in steps
+    at the healthy start-of-run rate wedged both driver attempts).
+    Returns ``(steps, elapsed_s)`` with the final drain inside the clock
+    — raw and FT windows amortize drains identically, so neither side of
+    a ratio is charged an extra RTT (the source of earlier rounds'
+    nonsense FT/raw > 1).
+    """
+
+    def clamp_interval(rate: float) -> int:
+        # ~6 s per drain at the current rate; floor 1 so second-scale
+        # steps (per-step DDP, degraded tunnels) still honor the budget
+        # with at most one burst of overrun.
+        return max(1, min(512, int(rate * 6.0)))
+
+    interval = clamp_interval(rate_hint or 40.0)
     t0 = time.perf_counter()
-    for _ in range(n):
-        params, opt_state, loss = step_fn(params, opt_state, batch)
-    _barrier(params)
-    return n / (time.perf_counter() - t0)
+    n = 0
+    while n < max_steps:
+        burst = min(interval, max_steps - n)
+        for _ in range(burst):
+            run_step()
+        n += burst
+        drain()
+        el = time.perf_counter() - t0
+        if el >= budget_s:
+            break
+        interval = clamp_interval(n / el)
+    return n, time.perf_counter() - t0
+
+
+def _time_raw_loop(step_fn, init_fn, tx, batch, warm: int, budget_s: float,
+                   rate_hint=None, max_steps=1 << 30) -> float:
+    """Warm + time-boxed raw loop (fresh state per call; _barrier drains
+    before the clock starts; step_fn is the FUSED one-program train step,
+    models.make_train_step — measured ~8% faster than split grad/apply
+    programs on v5e, so it is the honest raw baseline). One shared copy
+    so a change to timing/drain semantics cannot make phases silently
+    measure differently."""
+    import numpy as np
+
+    box = {"p": init_fn(), "o": None, "l": None}
+    box["o"] = tx.init(box["p"])
+
+    def run_step():
+        box["p"], box["o"], box["l"] = step_fn(box["p"], box["o"], batch)
+
+    t_warm = time.perf_counter()
+    for _ in range(warm):
+        run_step()
+    _barrier(box["p"])
+    if rate_hint is None and warm:
+        # No prior rate known: derive the hint from the warm loop itself.
+        # Compile time inflates it, so this UNDERestimates the rate —
+        # which only means an extra early drain, never a runaway first
+        # burst (a 40-steps/s default hint on a 1-step/s host made the
+        # first burst overrun a 35 s window 6x).
+        rate_hint = warm / max(time.perf_counter() - t_warm, 1e-6)
+    n, el = _timed_window(
+        run_step, lambda: np.asarray(box["l"]), budget_s,
+        max_steps=max_steps, rate_hint=rate_hint,
+    )
+    return n / el
 
 
 def peer() -> None:
@@ -266,8 +347,12 @@ def peer() -> None:
     # out-step a (transiently failing) main side and become its recovery
     # source, and it drops out of the max-step cohort after round 0 — the
     # main side's gradient divisor reflects real contributors only.
+    # rounds == 0 means "paced entirely by the main side, until killed":
+    # phases whose round count is decided DURING the phase (time-boxed
+    # step loops) use it; the supervisor/finally reaps the process.
     rounds = int(os.environ["BENCH_PEER_ROUNDS"])
-    for i in range(rounds):
+    i = 0
+    while rounds == 0 or i < rounds:
         if i > 0:
             manager.start_quorum(allow_heal=False)
         if peer_dtype == "int8":
@@ -278,6 +363,7 @@ def peer() -> None:
             manager.allreduce(zeros).wait()  # paced by the main side
         print(f"peer: round {i} done participants="
               f"{manager.num_participants()}", flush=True)
+        i += 1
     manager.shutdown()
     collectives.shutdown()
 
@@ -313,31 +399,184 @@ def _spawn_peer(lighthouse_addr: str, rounds: int, dtype: str) -> subprocess.Pop
     return proc
 
 
-def _bench_big(save=lambda partial: None) -> dict:
-    """Raw vs AsyncDiLoCo throughput on the MXU-saturating config, with the
-    window sized so the (bf16, pipelined) sync can hide behind compute —
-    the deployment-tuning rule DiLoCo practice prescribes (H in the
-    hundreds). ``save`` receives partial result dicts as sub-phases land,
-    so a supervisor kill mid-phase keeps everything measured so far
-    (round-3 verdict #3: the driver's artifact lost the whole phase)."""
+def _fresh_lighthouse():
+    """One lighthouse PER bench phase. Phases reusing a lighthouse within
+    the heartbeat window (~5 s) of the previous phase's members see their
+    ghost heartbeats; the new step-0 manager can then elect a dead ghost
+    as its recovery primary and wedge healing from it until timeout
+    (observed on this harness; the ghost stays a quorum participant until
+    its heartbeat ages out)."""
+    from torchft_tpu import Lighthouse
+
+    return Lighthouse(
+        bind="[::]:0", min_replicas=1, join_timeout_ms=5000, quorum_tick_ms=50
+    )
+
+
+def _measure_transfer(size_mb: int = 16) -> tuple:
+    """(d2h_MBps, h2d_MBps) with a bounded probe — on a degraded tunnel a
+    64 MB probe alone can eat a minute of the attempt budget."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    probe = jnp.ones((size_mb << 18,), jnp.float32) + 0
+    jax.block_until_ready(probe)
+    t0 = time.perf_counter()
+    host = np.asarray(probe)
+    d2h_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(jnp.asarray(host))
+    h2d_s = time.perf_counter() - t0
+    return size_mb / d2h_s, size_mb / h2d_s
+
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def _ring_session(tag: str, wire: str, state=None, timeout_s: int = 600,
+                  **manager_kwargs):
+    """The one 2-member-ring measurement lifecycle every phase shares:
+    fresh lighthouse (no ghost members), paced zero-peer (rounds=0 — the
+    peer runs until reaped, so time-boxed loops need not know their step
+    count up front), HostCollectives, Manager — torn down in reverse with
+    the peer reaped FIRST. Every resource is constructed inside the
+    try, so a constructor failure can never leak a heartbeating
+    "bench_peer" into later phases. Yields (manager, collectives)."""
+    from torchft_tpu import HostCollectives, Manager
+
+    lh = peer_proc = manager = collectives = None
+    try:
+        lh = _fresh_lighthouse()
+        peer_proc = _spawn_peer(lh.address(), 0, wire)
+        collectives = HostCollectives(timeout=timedelta(seconds=timeout_s))
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=state.load_state_dict if state else None,
+            state_dict=state.state_dict if state else None,
+            min_replica_size=1,
+            timeout=timedelta(seconds=timeout_s),
+            quorum_timeout=timedelta(seconds=timeout_s),
+            rank=0,
+            world_size=1,
+            lighthouse_addr=lh.address(),
+            replica_id=f"bench_main_{tag}",  # sorts before bench_peer
+            **manager_kwargs,
+        )
+        yield manager, collectives
+    finally:
+        if peer_proc is not None and peer_proc.poll() is None:
+            peer_proc.kill()
+        if manager is not None:
+            manager.shutdown()
+        if collectives is not None:
+            collectives.shutdown()
+        if lh is not None:
+            lh.shutdown()
+
+
+class _DilocoHarness:
+    """Shared AsyncDiLoCo measurement harness for the small (headline) and
+    big phases: fresh lighthouse + zero-peer + manager, MANUAL wall-clock
+    windows (sync_every is set unreachably high; ``window()`` runs
+    time-boxed inner steps and closes with an explicit sync), and the
+    window length derived from the MEASURED warm sync of THIS run."""
+
+    def __init__(self, state, train_step, batch, wire: str, overlap: bool,
+                 tag: str):
+        from contextlib import ExitStack
+
+        import optax
+
+        from torchft_tpu import AsyncDiLoCo
+
+        self.state = state
+        self.train_step = train_step
+        self.batch = batch
+        self.loss = None
+        self._stack = ExitStack()
+        try:
+            self.manager, self.collectives = self._stack.enter_context(
+                _ring_session(tag, wire, use_async_quorum=False)
+            )
+            self.diloco = AsyncDiLoCo(
+                self.manager, state,
+                optax.sgd(0.7, momentum=0.9, nesterov=True),
+                sync_every=1 << 30,  # wall-clock-boxed windows; see sync()
+                compress=wire,
+                overlap=overlap,
+            )
+            self.manager._load_state_dict = self.diloco.load_state_dict
+            self.manager._user_state_dict = self.diloco.state_dict
+        except BaseException:
+            self._stack.close()  # never leak the paced peer
+            raise
+
+    def _run_step(self):
+        self.state.params, self.state.opt_state, self.loss = self.train_step(
+            self.state.params, self.state.opt_state, self.batch
+        )
+        self.diloco.step_applied()
+
+    def _drain(self):
+        import numpy as np
+
+        np.asarray(self.loss)
+
+    def warm(self, steps: int = 17) -> float:
+        """Compiles the inner step, then runs ONE timed sync — the
+        measured sync cost that sizes the windows. Returns sync seconds
+        (launch + finish: in overlap mode the flush exposes it fully,
+        which is the conservative sizing input)."""
+        for i in range(steps):
+            self._run_step()
+            if i % 16 == 15:
+                self._drain()
+        _barrier(self.state.params)
+        t0 = time.perf_counter()
+        self.diloco.sync()
+        self.diloco.flush()
+        _barrier(self.state.params)
+        return time.perf_counter() - t0
+
+    def window(self, budget_s: float, rate_hint=None) -> dict:
+        """One timed window: inner steps for ~budget_s, then the boundary
+        sync — all inside the clock. Returns steps/elapsed/rate."""
+        t0 = time.perf_counter()
+        n, _ = _timed_window(
+            self._run_step, self._drain, budget_s, rate_hint=rate_hint
+        )
+        self.diloco.sync()  # finishes any pending window first
+        self.diloco.flush()
+        _barrier(self.state.params)
+        el = time.perf_counter() - t0
+        return {"steps": n, "elapsed_s": el, "steps_per_sec": n / el}
+
+    def close(self):
+        self._stack.close()
+
+
+def _bench_big(save, d2h_MBps: float) -> dict:
+    """Raw vs AsyncDiLoCo throughput on the MXU-saturating config —
+    SYMMETRIC best-of-2 on both sides (round-4 verdict #5), time-boxed
+    windows sized from the measured warm sync. ``save`` receives partial
+    result dicts as sub-phases land, so a budget kill mid-phase keeps
+    everything measured so far."""
+    import dataclasses
+
     import jax
     import numpy as np
     import optax
-    from datetime import timedelta as td
 
-    from torchft_tpu import AsyncDiLoCo, FTTrainState, HostCollectives, Manager
+    from torchft_tpu import FTTrainState
     from torchft_tpu.models import init_params
-
-    import dataclasses
 
     cfg, batch, _ = _model_setup("big")
     tx = optax.adamw(1e-3)
     BF16_PARAMS = True  # f32 master + bf16 compute copy (measured +2.3%)
 
-    # Attention-path selection is MEASURED per run, not assumed: time a
-    # short raw loop with XLA dense attention and with the pallas flash
-    # kernel (v5e-tuned tiles, ops/flash_attention.py), run the FT phase
-    # on the winner, and record both timings (the round-2 verdict's ask).
     n_params = sum(
         int(np.prod(l.shape))
         for l in jax.tree_util.tree_leaves(
@@ -357,10 +596,7 @@ def _bench_big(save=lambda partial: None) -> dict:
             _fns_cache[c] = make_train_step(c, tx, bf16_params=BF16_PARAMS)
         return _fns_cache[c]
 
-    def time_raw_variant(c, warm: int, raw_steps: int = 24):
-        # 24 steps (not 8): the end-of-window drain costs a tunnel RTT;
-        # a too-short window charges it against raw but not against the
-        # long FT windows (same rationale as the headline raw window).
+    def time_raw_variant(c, warm: int, budget_s: float = 25.0):
         """steps/s, or None when the variant fails (e.g. XLA dense at
         batch sizes whose S^2 score tensors break the compiler — observed
         at B16 on v5e; the selection then simply takes the survivor)."""
@@ -368,20 +604,30 @@ def _bench_big(save=lambda partial: None) -> dict:
             return _time_raw_loop(
                 step_fn_for(c),
                 lambda: init_params(c, jax.random.PRNGKey(0)), tx, batch,
-                warm, raw_steps,
+                warm, budget_s, rate_hint=4.0,
             )
         except Exception as e:  # noqa: BLE001 - selection is best-effort
             _mark(f"big: variant failed: {type(e).__name__}: {str(e)[:120]}")
             return None
 
-    _mark("big: attention-path selection (dense vs flash)")
-    dense_cfg = dataclasses.replace(cfg, use_flash=False)
+    # Attention-path selection is MEASURED per run when the budget allows:
+    # flash first (it wins at this shape on v5e and its cache is warm);
+    # the dense variant is informational and only probed with ample
+    # remaining budget (its compile FAILS at B16 on v5e — a failure that
+    # costs real remote-compile time).
+    _mark("big: flash raw probe")
     flash_cfg = dataclasses.replace(cfg, use_flash=True)
-    dense_sps = time_raw_variant(dense_cfg, 2)
     flash_sps = time_raw_variant(flash_cfg, 2)
+    dense_sps = None
+    if flash_sps is None or (
+        _remaining(420) > 0 and not os.environ.get("BENCH_SKIP_DENSE")
+    ):
+        dense_cfg = dataclasses.replace(cfg, use_flash=False)
+        _mark("big: dense raw probe")
+        dense_sps = time_raw_variant(dense_cfg, 2)
     if dense_sps is None and flash_sps is None:
         raise RuntimeError("both attention variants failed to run")
-    cfg = flash_cfg if (flash_sps or 0) >= (dense_sps or 0) else dense_cfg
+    cfg = flash_cfg if (flash_sps or 0) >= (dense_sps or 0) else dataclasses.replace(cfg, use_flash=False)
     _mark(
         f"big: dense {dense_sps} vs flash {flash_sps} steps/s -> "
         f"{'flash' if cfg.use_flash else 'dense'}"
@@ -396,138 +642,65 @@ def _bench_big(save=lambda partial: None) -> dict:
         },
     })
     train_step = step_fn_for(cfg)
-
-    def time_raw_big(warm: int) -> float:
-        sps = time_raw_variant(cfg, warm)
-        assert sps is not None, "selected variant stopped running"
-        return sps
-
     raw_sps = max(s for s in (dense_sps, flash_sps) if s is not None)
-    step_s = 1.0 / raw_sps
-
-    # Window sizing: sync ships n_params bf16 bytes each way; size H so
-    # the sync is a small fraction of window compute (capped to keep the
-    # bench bounded — the cap is reported so a capped ratio is read as a
-    # link artifact, not a framework cost).
-    d2h_MBps = _measure_d2h_MBps()
-    sync_s_est = 2 * (n_params * 2 / 1e6) / max(d2h_MBps, 0.1)
-    sync_every = int(min(max(12 * sync_s_est / step_s, 64), 1536))
-    windows = 2  # best-of, matching the headline phase
-    # Supervisor-budget clamp (same rationale as the headline phase): at
-    # batch 16 a 1536-step window can exceed the remaining attempt budget
-    # outright; a clamped window is a worse sync amortization but a
-    # RECORDED one.
-    sync_every = min(
-        sync_every, _budget_window_steps(windows, raw_sps, margin=240)
-    )  # (the budget helper floors at 128 steps)
 
     os.environ["BENCH_MODEL"] = "big"
-    lighthouse = peer_proc = manager = collectives = None
+    harness = None
+    window_sps = []
+    windows_steps = []
+    raw_remeasured = False
+    skipped = None
     try:
-        lighthouse = _fresh_lighthouse()  # own instance: no ghost members
         wire = _env_wire() or ("bf16" if d2h_MBps >= 100 else "int8")
-        peer_proc = _spawn_peer(lighthouse.address(), windows + 1, wire)
-        state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
-        collectives = HostCollectives(timeout=td(seconds=600))
-        manager = Manager(
-            collectives=collectives,
-            load_state_dict=None,
-            state_dict=None,
-            min_replica_size=1,
-            use_async_quorum=False,
-            timeout=td(seconds=600),
-            quorum_timeout=td(seconds=600),
-            rank=0,
-            world_size=1,
-            lighthouse_addr=lighthouse.address(),
-            replica_id="bench_big",
+        harness = _DilocoHarness(
+            FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx),
+            train_step, batch, wire, overlap=d2h_MBps >= 100, tag="big",
         )
-        diloco = AsyncDiLoCo(
-            manager, state, optax.sgd(0.7, momentum=0.9, nesterov=True),
-            sync_every, compress=wire,
-            overlap=d2h_MBps >= 100,  # serial sync on degraded links
-        )
-        manager._load_state_dict = diloco.load_state_dict
-        manager._user_state_dict = diloco.state_dict
-
-        # Short warmup: compile the inner step, then force ONE early
-        # boundary sync (the peer's first of windows+1 rounds) instead of
-        # crawling a full window to the boundary (see main()'s note).
-        # Must stay BELOW sync_every (floor-clamped to 64): hitting the
-        # auto-sync in the warm loop would spend a peer round and
-        # desynchronize the 2-round accounting.
-        for i in range(min(65, sync_every - 1)):
-            state.params, state.opt_state, loss = train_step(
-                state.params, state.opt_state, batch
-            )
-            diloco.step_applied()
-            if i % 64 == 63:
-                np.asarray(loss)  # real drain (see _barrier note)
-        diloco.sync()
-        diloco.flush()
-        _barrier(state.params)
-        # Best-of-N windows, same noise treatment as the headline phase:
-        # a single tunnel stall must not masquerade as framework cost.
-        window_sps = []
-        skipped = False
-        for w in range(windows):
-            if w > 0 and time.monotonic() - _T0 > 800:
-                skipped = True
-                # The supervisor kills the run at BENCH_ATTEMPT_TIMEOUT_S
-                # (default 1200); a second window on a badly degraded
-                # tunnel could push past it and lose this whole section.
-                _mark(f"big: skipping window {w} (time budget)")
+        _mark("big: warm + timed sync")
+        sync_s = harness.warm()
+        win_s = min(max(14.0 * sync_s, 40.0), 120.0)
+        _mark(f"big: sync {sync_s:.1f}s -> window {win_s:.0f}s")
+        for w in range(2):
+            need = win_s + 2 * sync_s + 10
+            if _remaining(90) < need:
+                skipped = f"window {w} skipped (time budget)"
+                _mark(f"big: {skipped}")
                 break
-            _mark(f"big: timed window {w} (sync_every={sync_every})")
-            t0 = time.perf_counter()
-            for i in range(sync_every):
-                state.params, state.opt_state, loss = train_step(
-                    state.params, state.opt_state, batch
-                )
-                diloco.step_applied()
-                if i % 512 == 511:
-                    np.asarray(loss)  # real drain (see _barrier note)
-            diloco.flush()
-            _barrier(state.params)
-            window_sps.append(sync_every / (time.perf_counter() - t0))
-            _mark(f"big: window {w} done ({window_sps[-1]:.2f} steps/s)")
+            res = harness.window(win_s, rate_hint=raw_sps)
+            window_sps.append(res["steps_per_sec"])
+            windows_steps.append(res["steps"])
+            _mark(f"big: window {w}: {res['steps']} steps "
+                  f"{res['steps_per_sec']:.2f}/s")
             save({
                 "window_steps_per_sec": [round(s, 3) for s in window_sps],
-                "sync_every": sync_every,
+                "window_steps": windows_steps,
+                "sync_s": round(sync_s, 2),
                 "raw_steps_per_sec": round(raw_sps, 3),
             })
-        ft_sps = max(window_sps)
-        raw_remeasured = False
-        if time.monotonic() - _T0 < 900:
-            # symmetric noise treatment (same rule as the headline phase)
+        if not window_sps:
+            raise RuntimeError("no big FT window fit the budget")
+        assert harness.collectives.size() == 2, \
+            "big-bench peer did not join the ring"
+        if _remaining(60) > 30:
+            # symmetric noise treatment: FT best-of-2 vs raw best-of-2
             _mark("big: raw re-measure")
-            raw_sps = max(raw_sps, time_raw_big(1))
-            raw_remeasured = True
-        assert collectives.size() == 2, "big-bench peer did not join the ring"
-        if not skipped:
-            peer_proc.wait(timeout=600)
-        # else: the peer still expects the skipped window's sync round;
-        # the finally below kills it rather than deadlocking here
+            raw2 = time_raw_variant(cfg, 1)
+            if raw2 is not None:
+                raw_sps = max(raw_sps, raw2)
+                raw_remeasured = True
     finally:
-        # main() swallows exceptions from this phase; never leak the peer
-        # process, the op thread, the manager server, or the env override.
         os.environ.pop("BENCH_MODEL", None)
-        if peer_proc is not None and peer_proc.poll() is None:
-            peer_proc.kill()
-        if manager is not None:
-            manager.shutdown()
-        if collectives is not None:
-            collectives.shutdown()
-        if lighthouse is not None:
-            lighthouse.shutdown()
-    # Symmetric comparison discipline: FT is best-of-N windows, so the raw
-    # denominator must be best-of-N too. When the time budget skipped the
-    # raw re-measure, compare FIRST window vs the single raw sample
-    # (best-of-1 vs best-of-1) instead of biasing the ratio FT-ward.
+        if harness is not None:
+            harness.close()
+    ft_sps = max(window_sps)
+    # Symmetric comparison discipline: best-of-N vs best-of-N. When the
+    # budget cut a side short, compare first-vs-first instead of biasing
+    # the ratio FT-ward.
+    symmetric = raw_remeasured and len(window_sps) == 2
     ft_for_ratio = ft_sps if raw_remeasured else window_sps[0]
-    # MFU accounting (round-3 verdict 1d): param-FLOPs (6 N tokens) AND
-    # total FLOPs including causal attention (fwd 4*B*S^2*d/2 per layer,
-    # backward ~2.5x fwd -> x3.5), against the v5e bf16 paper peak.
+    # MFU accounting: param-FLOPs (6 N tokens) AND total FLOPs including
+    # causal attention (fwd 4*B*S^2*d/2 per layer, backward ~2.5x fwd ->
+    # x3.5), against the v5e bf16 paper peak.
     S_in = batch.shape[1] - 1  # LM slices the last token off
     attn_tflop = (
         cfg.n_layers * 3.5 * 4 * batch.shape[0] * S_in * S_in
@@ -547,11 +720,11 @@ def _bench_big(save=lambda partial: None) -> dict:
         "raw_tflops": round(param_tflop * raw_sps, 1),
         "ft_diloco_steps_per_sec": round(ft_sps, 3),
         "window_steps_per_sec": [round(s, 3) for s in window_sps],
-        "ratio_vs_raw": round(ft_for_ratio / raw_sps, 3),
-        # "symmetric" = raw re-measured AND both FT windows ran; a
-        # budget-skipped second window is best-of-1 FT vs best-of-2 raw
-        # (conservative, but not symmetric — round-3 advisor finding)
-        "ratio_symmetric": raw_remeasured and not skipped,
+        "window_steps": windows_steps,
+        "sync_s": round(sync_s, 2),
+        "ratio_vs_raw": round(min(ft_for_ratio / raw_sps, 1.0), 3),
+        "ratio_raw_measurement": round(ft_for_ratio / raw_sps, 3),
+        "ratio_symmetric": symmetric,
         "windows_measured": len(window_sps),
         "mfu": {
             "attn_tflop_per_step": round(attn_tflop, 2),
@@ -566,35 +739,73 @@ def _bench_big(save=lambda partial: None) -> dict:
             "fwd+bwd); peak = 197 TFLOP/s v5e bf16; see ROOFLINE.md for "
             "the measured per-component ceilings on this tunneled chip",
         },
-        "sync_every": sync_every,
-        "window_capped": bool(sync_every >= 1536),
-        "note": "MXU-saturating config; attention path chosen by "
-        "measurement this run (both timings recorded); window sized so "
-        "the sync stays a small fraction of compute, capped at 1536 to "
-        "bound bench time"
-        + (
-            ""
-            if raw_remeasured
-            else "; raw re-measure skipped (time budget) so the ratio "
-            "compares first-window FT vs the single raw sample"
-        ),
+        "note": "MXU-saturating config; wall-clock-boxed windows sized "
+        "from this run's measured warm sync (14x), boundary sync inside "
+        "the window clock"
+        + (f"; {skipped}" if skipped else ""),
     }
     save(result)
     return result
 
 
-def _bench_ddp_small(d2h_MBps: float, h2d_MBps: float) -> dict:
-    """Per-step fault-tolerant DDP at a LINK-SIZED scale, run on TPU every
-    round unconditionally (round-3 verdict #2: the reference's product is
-    per-step FT, and the flagship ft_ddp phase is link-bound on degraded
-    tunnels — this phase sizes the MODEL to the link instead of skipping).
+def _bench_big2() -> dict:
+    """One RAW MFU point at higher arithmetic intensity (d_model 2048,
+    head_dim 128) — the ROOFLINE.md extrapolation, measured (round-4
+    verdict #7). No FT machinery: the claim under test is kernel/MXU
+    utilization, and the big phase already measures FT cost."""
+    import jax
+    import numpy as np
+    import optax
 
-    ~0.72M params (0.73 MB int8 wire) with S-2048 flash attention: compute
-    per step is large relative to the gradient ship, and PipelinedDDP
-    overlaps step i's ring with step i+1's grads, so the achievable ratio
-    is C/max(C, R) rather than C/(C+R). The batch is chosen so estimated
-    compute ~= 1.2x the estimated ring time on the MEASURED link (bigger
-    batches on worse links), capped at 512.
+    from torchft_tpu.models import init_params, make_train_step
+
+    cfg, batch, _ = _model_setup("big2")
+    tx = optax.adamw(1e-3)
+    n_params = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(
+            init_params(cfg, jax.random.PRNGKey(0))
+        )
+    )
+    train_step = make_train_step(cfg, tx, bf16_params=True)
+    sps = _time_raw_loop(
+        train_step, lambda: init_params(cfg, jax.random.PRNGKey(0)), tx,
+        batch, 2, 45.0, rate_hint=1.5,
+    )
+    S_in = batch.shape[1] - 1
+    attn_tflop = (
+        cfg.n_layers * 3.5 * 4 * batch.shape[0] * S_in * S_in
+        * cfg.d_model / 2 / 1e12
+    )
+    param_tflop = 6 * n_params * batch.size / 1e12
+    return {
+        "params_M": round(n_params / 1e6, 1),
+        "d_model": cfg.d_model,
+        "head_dim": cfg.d_model // cfg.n_heads,
+        "batch": int(batch.shape[0]),
+        "raw_steps_per_sec": round(sps, 3),
+        "param_tflop_per_step": round(param_tflop, 2),
+        "raw_param_tflops": round(param_tflop * sps, 1),
+        "mfu_pct_of_v5e_bf16_peak": round(
+            (param_tflop + attn_tflop) * sps / 197.0 * 100, 1
+        ),
+        "note": "raw-only MFU point at ROOFLINE.md's extrapolated shape "
+        "(higher arithmetic intensity; prediction was >= 55%)",
+    }
+
+
+def _bench_ddp_small(raw_hint: float) -> dict:
+    """Per-step fault-tolerant DDP at a LINK-SIZED scale, run on TPU every
+    round unconditionally — the reference's product mode must have a
+    number on this hardware.
+
+    Round-4 shipped ratio 0.044 from 4 timed steps with no breakdown.
+    This version (a) MEASURES the per-step FT overhead with a live probe
+    instead of estimating the ring from link bandwidth, (b) sizes the
+    batch so compute covers ~1.3x that measured overhead, (c) runs >= 20
+    timed steps (time-boxed), and (d) records the per-phase breakdown
+    (collectives pack/d2h/ring/h2d + manager quorum/vote timers) in the
+    artifact so a sub-0.9 ratio is diagnosable, not just reported.
     """
     import jax
     import numpy as np
@@ -605,8 +816,7 @@ def _bench_ddp_small(d2h_MBps: float, h2d_MBps: float) -> dict:
     )
     from torchft_tpu.models import init_params, loss_fn, make_train_step
 
-    degraded = d2h_MBps < 100
-    wire = "int8" if degraded else "bf16"
+    wire = "int8"
     os.environ["BENCH_MODEL"] = "ddp_small"
     try:
         cfg, batch, _ = _model_setup("ddp_small")
@@ -617,138 +827,120 @@ def _bench_ddp_small(d2h_MBps: float, h2d_MBps: float) -> dict:
                 init_params(cfg, jax.random.PRNGKey(0))
             )
         )
-        wire_mb = n_params * (1 if wire == "int8" else 2) / 1e6
-        # ring time estimate: payload d2h + cohort payloads h2d + slack
-        r_est = wire_mb / max(d2h_MBps, 0.1) + \
-            2 * wire_mb / max(h2d_MBps, 0.1) + 0.15
+        wire_mb = n_params / 1e6  # int8: 1 byte/param
         train_step = make_train_step(cfg, tx)
-        _mark(f"ddp_small: raw probe (wire={wire}, est ring {r_est:.2f}s)")
-        base_B = batch.shape[0]
-        raw_sps = _time_raw_loop(
-            train_step,
-            lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
-            2, 12,
-        )
-        c_base = 1.0 / raw_sps
-        # scale batch so compute ~= 1.2x ring estimate (compute ~linear
-        # in B; pipelined ratio ~ C/max(C, R), so C >= ~1.1R is the 0.9
-        # bar). Cap 512: ~1M tokens/step of the 0.72M-param model still
-        # fits HBM comfortably.
-        want_B = int(base_B * max(1.2 * r_est / c_base, 1.0))
-        B = min(max(32, (want_B // 32) * 32), 512)
-        if B != base_B:
-            os.environ["BENCH_DDP_SMALL_BATCH"] = str(B)
-            cfg, batch, _ = _model_setup("ddp_small")
-            raw_sps = _time_raw_loop(
-                train_step,
-                lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
-                1, 8,
-            )
-        _mark(f"ddp_small: B={B} raw {raw_sps:.2f} steps/s")
-
         ddp_grad_fn = jax.jit(
             jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b))
         )
-        steps = 4
-        lh = peer_proc = manager = collectives = None
-        try:
-            lh = _fresh_lighthouse()
-            peer_proc = _spawn_peer(lh.address(), 1 + steps, wire)
+        base_B = batch.shape[0]
+        _mark("ddp_small: raw probe")
+        raw_sps = _time_raw_loop(
+            train_step,
+            lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
+            2, 12.0, rate_hint=raw_hint,
+        )
+        c_base = 1.0 / raw_sps
+
+        def run_session(ddp_batch, steps_budget_s, max_steps, tag):
+            """One live 2-member ring session; returns (steps, elapsed,
+            op stats, manager metrics). The peer is paced (rounds=0, see
+            _ring_session) — a time-boxed loop's step count isn't known
+            at spawn time."""
             state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
-            collectives = HostCollectives(timeout=timedelta(seconds=1800))
-            manager = Manager(
-                collectives=collectives,
-                load_state_dict=state.load_state_dict,
-                state_dict=state.state_dict,
-                min_replica_size=1,
-                timeout=timedelta(seconds=600),
-                quorum_timeout=timedelta(seconds=600),
-                rank=0,
-                world_size=1,
-                lighthouse_addr=lh.address(),
-                replica_id="bench_main_ddp_small",  # sorts before bench_peer
+            with _ring_session(tag, wire, state) as (manager, collectives):
+                ddp = PipelinedDDP(
+                    manager, state, lambda p, b: ddp_grad_fn(p, b),
+                    compress=wire,
+                )
+                ddp.step(ddp_batch)  # warm: compile + peer round 0
+                _barrier(state.params)
+                collectives.pop_op_stats()
+                t0 = time.perf_counter()
+                n, _ = _timed_window(
+                    lambda: ddp.step(ddp_batch),
+                    lambda: None,  # ddp.step is host-blocking per settle
+                    steps_budget_s, max_steps=max_steps,
+                    rate_hint=0.5,  # second-scale steps: clock per step
+                )
+                ddp.flush()
+                _barrier(state.params)
+                el = time.perf_counter() - t0
+                ops = collectives.pop_op_stats()[-max_steps:]
+                snap = manager.metrics().snapshot()
+                assert collectives.size() == 2, "peer did not join the ring"
+                return n, el, ops, snap
+
+        # Live probe: a few pipelined steps at the base batch measure the
+        # REAL per-step FT cost on this link right now (round-4's
+        # bandwidth-derived estimate was 13x off).
+        _mark("ddp_small: live FT probe")
+        pn, pel, pops, _ = run_session(batch, 20.0, 6, "ddp_probe")
+        t_ft_probe = pel / max(pn, 1)
+        overhead = max(t_ft_probe - c_base, 0.0)
+        # Size the batch so compute ~= 1.3x the measured overhead
+        # (pipelined ratio ~ C/max(C, R): C >= ~1.1R is the 0.9 bar;
+        # 1.3x leaves margin for the probe's noise). Cap 512.
+        want_B = int(base_B * max(1.3 * overhead / c_base, 1.0))
+        B = min(max(32, (want_B // 32) * 32), 512)
+        _mark(f"ddp_small: probe {t_ft_probe:.2f}s/step (compute "
+              f"{c_base:.2f}s, overhead {overhead:.2f}s) -> B={B}")
+        if B != base_B:
+            os.environ["BENCH_DDP_SMALL_BATCH"] = str(B)
+            _, batch, _ = _model_setup("ddp_small")
+            raw_sps = _time_raw_loop(
+                train_step,
+                lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
+                1, 12.0, rate_hint=raw_sps * base_B / B,
             )
-            ddp = PipelinedDDP(
-                manager, state, lambda p, b: ddp_grad_fn(p, b),
-                compress=wire,
-            )
-            ddp.step(batch)  # warm: compile + peer round 0
-            _barrier(state.params)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                ddp.step(batch)
-            t_end = time.perf_counter()
-            ddp.flush()
-            _barrier(state.params)
-            ft_sps = steps / (t_end - t0)
-            assert collectives.size() == 2, "peer did not join the ring"
-            peer_proc.wait(timeout=600)
-        finally:
-            if peer_proc is not None and peer_proc.poll() is None:
-                peer_proc.kill()
-            if manager is not None:
-                manager.shutdown()
-            if collectives is not None:
-                collectives.shutdown()
-            if lh is not None:
-                lh.shutdown()
+        # The measured run: >= 20 steps (time permitting), time-boxed.
+        # Per-step estimate at the RESIZED batch: compute scales with B,
+        # the (transfer-dominated) overhead does not.
+        t_step_est = c_base * B / base_B + overhead
+        budget = min(max(40.0, 24 * t_step_est), 110.0)
+        budget = min(budget, max(_remaining(120), 30.0))
+        _mark(f"ddp_small: timed run (B={B}, budget {budget:.0f}s)")
+        n, el, ops, snap = run_session(batch, budget, 64, "ddp_small")
+        ft_sps = n / el
+        agg: dict = {}
+        for s in ops:
+            for k in ("pack", "d2h", "ring", "h2d"):
+                if k in s:
+                    agg.setdefault(k, []).append(s[k])
+        med = {
+            k: round(sorted(v)[len(v) // 2], 4) for k, v in agg.items()
+        }
+        timers = snap.get("timers_s", {})
+        breakdown = {
+            "compute_s_per_step": round(1.0 / raw_sps, 4),
+            "collectives_median_s": med,
+            "quorum_p50_s": timers.get("quorum", {}).get("p50"),
+            "vote_p50_s": timers.get("commit_vote", {}).get("p50"),
+            "allgather_p50_s": timers.get("allgather", {}).get("p50"),
+            "probe_s_per_step": round(t_ft_probe, 4),
+        }
         return {
             "steps_per_sec": round(ft_sps, 3),
             "raw_steps_per_sec": round(raw_sps, 3),
-            "ratio_vs_raw": round(ft_sps / raw_sps, 3),
+            "ratio_vs_raw": round(min(ft_sps / raw_sps, 1.0), 3),
+            "ratio_raw_measurement": round(ft_sps / raw_sps, 3),
+            "timed_steps": n,
             "params_M": round(n_params / 1e6, 2),
             "wire": wire,
             "wire_MB": round(wire_mb, 2),
-            "batch": B,
+            "batch": int(batch.shape[0]),
             "tokens_per_step": int(batch.size),
-            "est_ring_s": round(r_est, 3),
+            "measured_overhead_s": round(overhead, 3),
+            "breakdown": breakdown,
             "note": "link-sized per-step DDP (PipelinedDDP, full quorum + "
-            "commit vote every step) over a live 2-member ring; model "
-            "sized so the gradient ship fits the measured link and the "
-            "ring hides behind the next step's compute; raw baseline is "
-            "the fused one-program step at the same batch",
+            "commit vote every step) over a live 2-member ring; batch "
+            "sized so compute covers 1.3x the MEASURED per-step FT "
+            "overhead (live probe, not a bandwidth estimate); raw "
+            "baseline is the fused one-program step at the same batch; "
+            "breakdown = per-phase medians over the timed steps",
         }
     finally:
         os.environ.pop("BENCH_MODEL", None)
         os.environ.pop("BENCH_DDP_SMALL_BATCH", None)
-
-
-def _budget_window_steps(windows: int, steps_per_sec: float, margin: float) -> int:
-    """Largest per-window step count (multiple of 128, floor 128) such
-    that ``windows`` timed windows plus ``margin`` seconds (compiles,
-    warm sync, re-measures) fit the supervisor's remaining attempt
-    budget. A window the supervisor kills mid-flight measures nothing,
-    so fitting beats the ideal sync-amortization size."""
-    budget = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 1200))
-    remain = budget - (time.monotonic() - _T0) - margin
-    per_window_s = max(remain / max(windows, 1), 10.0)
-    return max(int(per_window_s * steps_per_sec) // 128 * 128, 128)
-
-
-def _fresh_lighthouse():
-    """One lighthouse PER bench phase. Phases reusing a lighthouse within
-    the heartbeat window (~5 s) of the previous phase's members see their
-    ghost heartbeats; the new step-0 manager can then elect a dead ghost
-    as its recovery primary and wedge healing from it until timeout
-    (observed on this harness; the ghost stays a quorum participant until
-    its heartbeat ages out)."""
-    from torchft_tpu import Lighthouse
-
-    return Lighthouse(
-        bind="[::]:0", min_replicas=1, join_timeout_ms=5000, quorum_tick_ms=50
-    )
-
-
-def _measure_d2h_MBps() -> float:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    probe = jnp.ones((8 << 20,), jnp.float32) + 0  # 32 MB
-    jax.block_until_ready(probe)
-    t0 = time.perf_counter()
-    np.asarray(probe)
-    return 32 / (time.perf_counter() - t0)
 
 
 def main() -> None:
@@ -782,24 +974,12 @@ def main() -> None:
     import numpy as np
     import optax
 
-    from torchft_tpu import (
-        AsyncDiLoCo,
-        FTTrainState,
-        HostCollectives,
-        Manager,
-        OptimizerWrapper,
-    )
-    from torchft_tpu.models import init_params, loss_fn, make_train_step
+    from torchft_tpu import FTTrainState
+    from torchft_tpu.models import init_params, make_train_step
 
     cfg, batch, on_tpu = _model_setup()
     # ring peers (spawned with inherited env) must pack identical trees
     os.environ["BENCH_FORCE_LAYERS"] = str(cfg.n_layers)
-    # The raw window must amortize the drain the same way the FT windows
-    # do: on the tunneled runtime the end-of-window readback costs a full
-    # RTT (up to seconds), so a 30-step raw window under-measures raw by
-    # tens of percent against a 4096-step FT window — the source of the
-    # absurd >1 FT/raw ratios in earlier rounds.
-    warmup, steps = 5, 512 if on_tpu else 15
     tx = optax.adamw(1e-3)
     # The fused one-program step (grad+apply, donated) is the raw baseline
     # AND the diloco inner step; per-step DDP necessarily splits the
@@ -807,238 +987,43 @@ def main() -> None:
     train_step = make_train_step(cfg, tx)
 
     detail = {"host": {"cpus": os.cpu_count(), "platform": jax.devices()[0].platform}}
+    detail_name = (
+        "BENCH_DETAIL.json" if on_tpu else "BENCH_DETAIL_cpu.json"
+    )
 
-    # -- raw loop --
-    def time_raw(warm: int) -> float:
+    # -- raw loop (time-boxed) --
+    def time_raw(warm: int, budget_s: float = 35.0, hint=None) -> float:
         return _time_raw_loop(
             train_step,
             lambda: init_params(cfg, jax.random.PRNGKey(0)), tx, batch,
-            warm, steps,
+            warm, budget_s, rate_hint=hint,
         )
 
     _mark("phase: raw (compile + timed loop)")
-    raw_sps = time_raw(warmup)
+    raw_sps = time_raw(5)
     detail["raw"] = {"steps_per_sec": round(raw_sps, 3)}
     _mark(f"phase: transfer probe (raw={raw_sps:.1f} steps/s)")
 
-    # Device<->host bandwidth of the gradient-sized payload: the number that
+    # Device<->host bandwidth of a gradient-scale payload: the number that
     # decides whether per-step DDP or windowed DiLoCo fits this host.
-    import jax.numpy as jnp
-
-    probe = jnp.ones((16 << 20,), jnp.float32) + 0  # 64 MB
-    jax.block_until_ready(probe)
-    t0 = time.perf_counter()
-    host_probe = np.asarray(probe)
-    d2h_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    jax.block_until_ready(jnp.asarray(host_probe))
-    h2d_s = time.perf_counter() - t0
+    d2h_MBps, h2d_MBps = _measure_transfer(16)
     detail["transfer"] = {
-        "d2h_MBps": round(64 / d2h_s, 1),
-        "h2d_MBps": round(64 / h2d_s, 1),
+        "d2h_MBps": round(d2h_MBps, 1),
+        "h2d_MBps": round(h2d_MBps, 1),
     }
-    del probe, host_probe
 
     n_params = sum(
         int(np.prod(l.shape))
         for l in jax.tree_util.tree_leaves(init_params(cfg, jax.random.PRNGKey(0)))
     )
     grad_mb = n_params * 4 / 1e6
-    d2h_MBps = detail["transfer"]["d2h_MBps"]
-    h2d_MBps = detail["transfer"]["h2d_MBps"]
     force_ddp = os.environ.get("BENCH_FORCE_DDP") == "1" or (
         os.environ.get("BENCH_WIRE") == "ddp"
     )
 
-    # -- ft_ddp (flagship-scale): per-step gradient allreduce over a real
-    # 2-group ring -- run AFTER the headline lands (see phase order below).
-    # The reference's product mode (per-step allreduce hidden behind
-    # backward, reference ddp.py:47-71). Measured at REPRESENTATIVE
-    # arithmetic intensity: the smoke config's 512 tokens/step against a
-    # full gradient ship is a compute:comm balance no DDP deployment has
-    # (measured breakdown on 1 CPU core: grad 546 ms vs ring 127 ms +
-    # unpack 66 ms — fixed ring WORK that neither overlap nor bf16 can
-    # remove on a single core). The DDP phase therefore scales the batch
-    # and measures its OWN raw baseline at the same config; blocking and
-    # pipelined (PipelinedDDP: step i's ring overlapped with step i+1's
-    # grads — the torch bucket-hook overlap, restructured for JAX's
-    # one-pytree gradients) are both recorded. On CPU BOTH batch points
-    # land in the artifact (round-3 verdict #6): the reference-like small
-    # batch where fixed ring work dominates, and the 4x-token batch where
-    # compute amortizes it — the ratio is an arithmetic-intensity story,
-    # and recording one point hides that.
-    def run_ft_ddp_phase() -> dict:
-        from torchft_tpu import PipelinedDDP
-
-        degraded = on_tpu and d2h_MBps < 100
-        # The DDP step MUST split grad and apply (the ring runs between
-        # them); its raw baseline stays the FUSED step at the same batch,
-        # so the ratio honestly charges the split to the transport.
-        ddp_grad_fn = jax.jit(
-            jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b))
-        )
-        ddp_steps = 2 if degraded else (4 if on_tpu else 5)
-
-        def time_ddp_raw(ddp_batch, warm: int, n: int) -> float:
-            return _time_raw_loop(
-                train_step,
-                lambda: init_params(cfg, jax.random.PRNGKey(0)), tx,
-                ddp_batch, warm, n,
-            )
-
-        def run_ddp(mode: str, wire: str, ddp_batch) -> float:
-            # Fresh lighthouse per session (_fresh_lighthouse) and every
-            # resource constructed INSIDE the try: a constructor failure
-            # must not leak a heartbeating "bench_peer" into later phases.
-            lh = peer_proc = manager = collectives = None
-            try:
-                lh = _fresh_lighthouse()
-                peer_proc = _spawn_peer(lh.address(), 1 + ddp_steps, wire)
-                state = FTTrainState(
-                    init_params(cfg, jax.random.PRNGKey(0)), tx
-                )
-                collectives = HostCollectives(timeout=timedelta(seconds=1800))
-                manager = Manager(
-                    collectives=collectives,
-                    load_state_dict=state.load_state_dict,
-                    state_dict=state.state_dict,
-                    min_replica_size=1,
-                    timeout=timedelta(seconds=600),  # 1st step rides a compile
-                    quorum_timeout=timedelta(seconds=600),
-                    rank=0,
-                    world_size=1,
-                    lighthouse_addr=lh.address(),
-                    # sorts before "bench_peer": the step-0 primary is the
-                    # first-sorted id and the peer never serves checkpoints
-                    replica_id=f"bench_main_ddp_{mode}",
-                )
-                if mode == "blocking":
-                    optimizer = OptimizerWrapper(manager, state)
-
-                    def ft_step():
-                        optimizer.zero_grad()
-                        loss, grads = ddp_grad_fn(state.params, ddp_batch)
-                        avg = manager.allreduce(grads).wait()
-                        optimizer.step(avg)
-
-                    ft_step()  # warm (peer round 0)
-                    _barrier(state.params)
-                    t0 = time.perf_counter()
-                    for _ in range(ddp_steps):
-                        ft_step()
-                    _barrier(state.params)
-                    t_end = time.perf_counter()
-                else:
-                    ddp = PipelinedDDP(
-                        manager, state,
-                        lambda p, b: ddp_grad_fn(p, b),
-                        compress="bf16" if wire == "bf16" else None,
-                    )
-                    ddp.step(ddp_batch)  # warm dispatch (peer round 0)
-                    _barrier(state.params)
-                    # Steady-state rate: each timed step settles exactly
-                    # one prior transaction and dispatches one ring (one
-                    # in-flight at entry, one left at exit); the fully-
-                    # exposed flush stays OUTSIDE the window so the
-                    # blocking-vs-pipelined comparison is unbiased.
-                    t0 = time.perf_counter()
-                    for _ in range(ddp_steps):
-                        ddp.step(ddp_batch)
-                    t_end = time.perf_counter()
-                    ddp.flush()
-                    _barrier(state.params)
-                sps = ddp_steps / (t_end - t0)
-                # A real 2-member ring carried every byte (no world-size-1
-                # identity shortcut).
-                assert collectives.size() == 2, "peer did not join the ring"
-                peer_proc.wait(timeout=600)
-            finally:
-                if peer_proc is not None and peer_proc.poll() is None:
-                    peer_proc.kill()
-                if manager is not None:
-                    manager.shutdown()
-                if collectives is not None:
-                    collectives.shutdown()
-                if lh is not None:
-                    lh.shutdown()
-            return sps
-
-        wire = "bf16" if degraded else "f32"
-
-        def measure_point(ddp_batch) -> dict:
-            # Degraded-link forced mode runs only the pipelined+bf16
-            # variant: the blocking variant's f32 tree would mismatch the
-            # peer's bf16 zeros on the ring, and each extra step ships the
-            # full gradient through the crippled tunnel.
-            # On TPU ddp_batch == batch, so the long-window raw
-            # measurement is the baseline (a short re-measure would
-            # under-measure raw by the end-of-window drain RTT and
-            # flatter the FT ratio). On CPU, best-of-2 short windows: a
-            # single window on the loaded 1-core host under-measures raw
-            # enough to produce nonsense FT/raw > 1.
-            ddp_raw = raw_sps if on_tpu else max(
-                time_ddp_raw(ddp_batch, 1, ddp_steps),
-                time_ddp_raw(ddp_batch, 0, ddp_steps),
-            )
-            blocking = (
-                None if degraded else run_ddp("blocking", wire, ddp_batch)
-            )
-            pipe = run_ddp("pipelined", wire, ddp_batch)
-            best = max(s for s in (blocking, pipe) if s is not None)
-            return {
-                "steps_per_sec": round(best, 3),
-                "ratio_vs_raw": round(best / ddp_raw, 3),
-                "raw_steps_per_sec": round(ddp_raw, 3),
-                "blocking_steps_per_sec": (
-                    None if blocking is None else round(blocking, 3)
-                ),
-                "pipelined_steps_per_sec": round(pipe, 3),
-                "tokens_per_step": int(ddp_batch.size),
-            }
-
-        big_batch = batch if on_tpu else jnp.concatenate([batch] * 4, axis=0)
-        out = measure_point(big_batch)
-        out["wire"] = wire
-        out["note"] = (
-            "per-step full-gradient shipping over a live 2-member ring; "
-            "raw baseline measured at the same batch"
-            + (
-                "; FORCED run on a degraded device<->host link — the "
-                "absolute rate is link-bound, not framework-bound"
-                if degraded
-                else ""
-            )
-        )
-        if not on_tpu:
-            # reference-like small batch: fixed ring work is ~30% of the
-            # 1-core step there, so the ratio is structurally lower — the
-            # amortization rule (compute >= 9x overhead for >= 0.9
-            # blocking) made explicit by recording both points
-            out["small_batch"] = measure_point(batch)
-            out["note"] += (
-                "; small_batch = the reference-like batch where ring "
-                "work is not amortized (ratio >= 0.9 needs compute >= 9x "
-                "overhead in blocking mode, ~1.1x in pipelined)"
-            )
-        return out
-
-    def run_ft_ddp_skip_note() -> dict:
-        return {
-            "skipped": f"device<->host link degraded ({d2h_MBps} MB/s d2h); "
-            f"per-step shipping of {grad_mb:.0f} MB grads is link-bound "
-            f"(>= {grad_mb / d2h_MBps:.0f} s/step floor) regardless of "
-            "framework — the link-sized phase (ft_ddp_small) carries the "
-            "per-step story on this link; set BENCH_FORCE_DDP=1 to record "
-            "the link-bound flagship number",
-        }
-
-    # -- ft_diloco: AsyncDiLoCo over the same real ring (headline) --
-    # Tuned to the measured link, the H-tuning every DiLoCo deployment does
-    # (H in the hundreds-to-thousands per the paper):
-    #  - window sized so the bf16 sync stays ~<=10% of wall-clock;
-    #  - on degraded links (tunneled device runtime) the sync runs
-    #    serially at the boundary: an in-flight transfer starves under the
-    #    async dispatch flood there, so overlap is strictly worse.
+    # -- ft_diloco: AsyncDiLoCo over a real 2-member ring. The PROVISIONAL
+    # headline: lands after the FIRST time-boxed window so nothing later
+    # can lose the round's metric. --
     _mark("phase: ft_diloco")
     overlap = d2h_MBps >= 100
     if not overlap:
@@ -1048,234 +1033,364 @@ def main() -> None:
         # dispatch — observed reproducibly on this host). Serialize the
         # ring transfers on BOTH members (env flows to the peer).
         os.environ["TORCHFT_HC_PIPELINE_CHUNKS"] = "1"
-    sync_mb = n_params * 2 / 1e6  # bf16-compressed pseudogradient
-    sync_est_s = (
-        2.5 * (sync_mb / max(d2h_MBps, 0.1) + sync_mb / max(h2d_MBps, 0.1))
-        + 1.0  # ring + dispatch slack
-    )
-    # Cap 4096: this phase's ratio is the PROVISIONAL headline only (the
-    # big phase's ratio is the real one), so it no longer buys precision
-    # with giant windows — and the tunnel's throughput can degrade 5x+
-    # MID-WINDOW, turning a 12288-step window sized at the healthy rate
-    # into a supervisor-budget killer (observed: a ~164 s window crawling
-    # past 40 min). A capped window under-amortizes the boundary sync on
-    # degraded links; the big phase measures the honest ratio. The
-    # supervisor budget then clamps further so both timed windows (plus
-    # margin) fit the attempt: a killed window measures nothing.
-    sync_every = int(
-        min(max(12 * sync_est_s * raw_sps, SYNC_EVERY), 4096) // 128 * 128
-    ) or SYNC_EVERY
-    sync_every = min(sync_every, _budget_window_steps(2, raw_sps, margin=180))
-    # Two timed windows, best-of reported: the tunneled device runtime has
-    # minute-scale throughput swings (transient stalls halve a single
-    # window's rate), and the best window is the steady-state capability
-    # the metric is after. Both rates land in the detail file.
-    diloco_windows = 2
     # int8+error-feedback on degraded links: the window sync is the cost
-    # being measured there, and int8 ships 4x fewer bytes than f32 (2x
-    # fewer than bf16); healthy links keep bf16 (sync hides behind
-    # compute anyway, and allgather traffic grows with cohort size).
+    # being measured there, and int8 ships 4x fewer bytes than f32;
+    # healthy links keep bf16 (sync hides behind compute anyway).
     wire = _env_wire() or ("bf16" if overlap else "int8")
-    lighthouse = _fresh_lighthouse()  # own instance: no ghost members
-    peer_proc = _spawn_peer(lighthouse.address(), diloco_windows + 1, wire)
-    state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
-    collectives = HostCollectives(timeout=timedelta(seconds=1800))
-    manager = Manager(
-        collectives=collectives,
-        load_state_dict=None,  # set below via diloco
-        state_dict=None,
-        min_replica_size=1,
-        use_async_quorum=False,
-        timeout=timedelta(seconds=1800),
-        quorum_timeout=timedelta(seconds=1800),
-        rank=0,
-        world_size=1,
-        lighthouse_addr=lighthouse.address(),
-        replica_id="bench_main_diloco",
+    harness = _DilocoHarness(
+        FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx),
+        train_step, batch, wire, overlap, tag="diloco",
     )
-    diloco = AsyncDiLoCo(
-        manager,
-        state,
-        optax.sgd(0.7, momentum=0.9, nesterov=True),
-        sync_every,
-        compress=wire,
-        overlap=overlap,
-    )
-    manager._load_state_dict = diloco.load_state_dict
-    manager._user_state_dict = diloco.state_dict
-
-    # Warmup: compile the inner step, then force ONE early boundary sync
-    # (compiles the quorum + both sync-side jits; in serial mode it runs
-    # launch+finish end to end) — the measurement semantics don't need a
-    # full sync_every-step crawl to the first boundary, and skipping it
-    # cuts several minutes of warmup at sync_every in the thousands.
-    # The periodic drain bounds the in-flight dispatch queue: on the
-    # tunneled device runtime an unbounded multi-thousand-op queue can
-    # wedge the session (observed reproducibly at 6k+ queued steps).
-    _mark("diloco: warm inner steps")
-    # min() guard: warm steps must stay below sync_every or the window
-    # accounting auto-syncs here, consuming the peer's first of windows+1
-    # rounds (same guard as _bench_big, whose floor is lower)
-    for i in range(min(65, sync_every - 1)):
-        state.params, state.opt_state, loss = train_step(
-            state.params, state.opt_state, batch
-        )
-        diloco.step_applied()
-        if i % 64 == 63:
-            np.asarray(loss)  # real drain: block_until_ready returns
-            # before remote execution finishes on this tunnel (_barrier)
-    _mark("diloco: warm sync")
-    diloco.sync()  # early warm sync = the peer's first of windows+1 rounds
-    _mark("diloco: warm sync launched")
-    if overlap:
-        diloco.flush()  # pull the warm sync out of the timed region
-    _barrier(state.params)
-    window_sps = []
-    for w in range(diloco_windows):
-        _mark(f"diloco: timed window {w} (sync_every={sync_every})")
-        t0 = time.perf_counter()
-        for i in range(sync_every):
-            state.params, state.opt_state, loss = train_step(
-                state.params, state.opt_state, batch
-            )
-            diloco.step_applied()
-            if i % 512 == 511:
-                np.asarray(loss)  # real drain: bounded queue; sparse because each
-                # drain costs a full tunnel RTT (seconds when degraded)
-        diloco.flush()  # window boundary: sync complete before the clock stops
-        _barrier(state.params)
-        window_sps.append(sync_every / (time.perf_counter() - t0))
-        _mark(f"diloco: window {w} done ({window_sps[-1]:.1f} steps/s)")
-    ft_sps = max(window_sps)
-    detail["ft_diloco"] = {
-        "steps_per_sec": round(ft_sps, 3),
-        "window_steps_per_sec": [round(s, 3) for s in window_sps],
-        "ratio_vs_raw": round(ft_sps / raw_sps, 3),
-        "sync_every": sync_every,
-        "compress": wire,
-        "overlap": overlap,
-        "note": f"{wire} pseudogradient window sync (AsyncDiLoCo); best of "
-        f"{diloco_windows} windows (the tunneled runtime has transient "
-        "stalls; both rates recorded); overlapped with inner compute on "
-        "healthy links, serial-at-boundary on degraded ones (see "
-        "local_sgd.AsyncDiLoCo overlap flag)",
-    }
-    peer_proc.wait(timeout=300)
-    manager.shutdown()
-    collectives.shutdown()
-    lighthouse.shutdown()
-
-    # Headline line + detail land BEFORE any further device phases (the
-    # raw re-measure, the big model) so a tunnel wedge there can never
-    # lose the round's primary metric; the supervisor takes the LAST
-    # metric line, so a refined headline can safely overwrite this one.
-    # CPU smoke runs write a separate file so they can never clobber the
-    # committed TPU artifact.
-    detail_name = (
-        "BENCH_DETAIL.json" if on_tpu else "BENCH_DETAIL_cpu.json"
-    )
-
-    def land_headline() -> None:
-        with open(os.path.join(REPO, detail_name), "w") as f:
-            json.dump(detail, f, indent=2)
-        print(
-            json.dumps(
-                {
-                    "metric": "steps_per_sec_ft",
-                    "value": round(ft_sps, 3),
-                    "unit": "steps/s",
-                    "vs_baseline": round((ft_sps / raw_sps) / 0.90, 3),
+    windows = []
+    try:
+        _mark("diloco: warm + timed sync")
+        sync_s = harness.warm()
+        win_s = min(max(14.0 * sync_s, 30.0), 120.0)
+        _mark(f"diloco: sync {sync_s:.1f}s -> window {win_s:.0f}s")
+        # Margin reserves the REMAINING phases' floor: on TPU that is
+        # ft_ddp_small + big (the real headline); on CPU only the ft_ddp
+        # points follow.
+        window2_margin = 240 if on_tpu else 150
+        for w in range(2):
+            if w and _remaining(window2_margin) < win_s + 2 * sync_s:
+                _mark("diloco: window 1 skipped (time budget)")
+                break
+            res = harness.window(win_s, rate_hint=raw_sps)
+            windows.append(res)
+            _mark(f"diloco: window {w}: {res['steps']} steps "
+                  f"{res['steps_per_sec']:.1f}/s")
+            if w == 0:
+                ft_sps = res["steps_per_sec"]
+                detail["ft_diloco"] = {
+                    "steps_per_sec": round(ft_sps, 3),
+                    "window_steps_per_sec": [round(ft_sps, 3)],
+                    "window_steps": [res["steps"]],
+                    "sync_s": round(sync_s, 2),
+                    "ratio_vs_raw": round(ft_sps / raw_sps, 3),
+                    "compress": wire,
+                    "overlap": overlap,
                 }
-            ),
-            flush=True,
-        )
+                _land_headline(detail, detail_name, ft_sps, raw_sps)
+        assert harness.collectives.size() == 2, "peer did not join the ring"
+    finally:
+        harness.close()
+    ft_sps = max(r["steps_per_sec"] for r in windows)
+    detail["ft_diloco"].update({
+        "steps_per_sec": round(ft_sps, 3),
+        "window_steps_per_sec": [
+            round(r["steps_per_sec"], 3) for r in windows
+        ],
+        "window_steps": [r["steps"] for r in windows],
+        "note": f"{wire} pseudogradient window sync (AsyncDiLoCo); "
+        "wall-clock-boxed windows sized at 14x this run's measured warm "
+        "sync; best of the measured windows; boundary sync inside every "
+        "window's clock",
+    })
 
-    land_headline()
-
-    # Symmetric noise treatment: the numerator is best-of-2 windows, so
-    # the denominator is best-of-2 raw measurements too (re-timed here,
-    # minutes after the first — tunnel stalls are minute-scale). The
-    # provisional headline above already landed in case this wedges.
-    _mark("phase: raw re-measure")
-    raw_again = time_raw(1)
-    detail["raw"]["steps_per_sec_2nd"] = round(raw_again, 3)
-    raw_sps = max(raw_sps, raw_again)
+    # Symmetric noise treatment: numerator is best-of-N windows, so the
+    # denominator is best-of-2 raw measurements too; when the budget
+    # skips the re-measure, fall back to first-window-vs-single-sample
+    # rather than biasing the ratio FT-ward (same rule as _bench_big).
+    raw_remeasured = False
+    if _remaining(240) > 35 or not on_tpu:
+        _mark("phase: raw re-measure")
+        raw_again = time_raw(1, hint=raw_sps)
+        detail["raw"]["steps_per_sec_2nd"] = round(raw_again, 3)
+        raw_sps = max(raw_sps, raw_again)
+        raw_remeasured = True
     detail["raw"]["best"] = round(raw_sps, 3)
-    detail["ft_diloco"]["ratio_vs_raw"] = round(ft_sps / raw_sps, 3)
-    # (ft_ddp's ratio is against its OWN same-batch raw baseline and is
-    # not rewritten here.)
-    land_headline()
+    ft_for_ratio = ft_sps if raw_remeasured else windows[0]["steps_per_sec"]
+    # FT-with-comm cannot beat same-model raw: a ratio > 1 is measurement
+    # noise (host contention between the two timing points) — publish the
+    # clamped ratio, record the raw measurement unclamped.
+    detail["ft_diloco"]["ratio_vs_raw"] = round(
+        min(ft_for_ratio / raw_sps, 1.0), 3
+    )
+    detail["ft_diloco"]["ratio_raw_measurement"] = round(
+        ft_for_ratio / raw_sps, 3
+    )
+    _land_headline(detail, detail_name, ft_for_ratio, raw_sps)
 
     # -- per-step FT: the link-sized phase runs on TPU EVERY round (the
-    # per-step product must have a number on this hardware); the
-    # flagship-scale point runs when the link can carry it (or forced) --
-    if on_tpu:
+    # per-step product must have a number on this hardware) --
+    if on_tpu and _remaining(150) > 60:
         _mark("phase: ft_ddp_small")
         try:
-            detail["ft_ddp_small"] = _bench_ddp_small(d2h_MBps, h2d_MBps)
+            detail["ft_ddp_small"] = _bench_ddp_small(raw_sps)
         except Exception as e:  # noqa: BLE001 - keep the headline
             detail["ft_ddp_small"] = {"error": f"{type(e).__name__}: {e}"}
-        land_headline()
+        _land_headline(detail, detail_name, ft_for_ratio, raw_sps)
+    elif on_tpu:
+        detail["ft_ddp_small"] = {"skipped": "time budget"}
+
+    # -- ft_ddp flagship-scale --
     _mark(f"phase: ft_ddp flagship (d2h={d2h_MBps:.1f} MB/s)")
-    if not on_tpu or d2h_MBps >= 100 or force_ddp:
+    if (not on_tpu and _remaining(30) > 150) or (
+        on_tpu and (d2h_MBps >= 100 or force_ddp) and _remaining(200) > 90
+    ):
         try:
-            detail["ft_ddp"] = run_ft_ddp_phase()
+            detail["ft_ddp"] = _run_ft_ddp_phase(
+                cfg, batch, tx, train_step, raw_sps, on_tpu, d2h_MBps
+            )
         except Exception as e:  # noqa: BLE001 - keep the headline
             detail["ft_ddp"] = {"error": f"{type(e).__name__}: {e}"}
+    elif d2h_MBps < 100 and not force_ddp:
+        detail["ft_ddp"] = {
+            "skipped": f"device<->host link degraded ({d2h_MBps:.1f} MB/s "
+            f"d2h); per-step shipping of {grad_mb:.0f} MB grads is "
+            f"link-bound (>= {grad_mb / d2h_MBps:.0f} s/step floor) "
+            "regardless of framework — the link-sized phase "
+            "(ft_ddp_small) carries the per-step story on this link; set "
+            "BENCH_FORCE_DDP=1 to record the link-bound flagship number",
+        }
     else:
-        detail["ft_ddp"] = run_ft_ddp_skip_note()
-    land_headline()
+        detail["ft_ddp"] = {"skipped": "time budget"}
+    _land_headline(detail, detail_name, ft_for_ratio, raw_sps)
 
     # -- big: FT overhead at MXU-saturating arithmetic intensity; its
-    # ratio is THE headline (round-3 verdict #3: the small-model window
-    # dilutes FT cost — the big phase measures it at deployment-class
-    # arithmetic intensity). Sub-results persist incrementally via
-    # save_partial so a supervisor kill can never erase the phase. --
+    # ratio is THE headline. Sub-results persist incrementally via
+    # save_partial so a budget kill can never erase the phase. --
     if on_tpu and not os.environ.get("BENCH_SKIP_BIG"):
+        if _remaining(120) < 260:
+            detail["big"] = {"skipped": "time budget (provisional "
+                             "small-model headline stands)"}
+        else:
 
-        def save_partial(partial: dict) -> None:
-            cur = dict(detail.get("big") or {})
-            cur.update(partial)
-            detail["big"] = cur
-            with open(os.path.join(REPO, detail_name), "w") as f:
-                json.dump(detail, f, indent=2)
+            def save_partial(partial: dict) -> None:
+                cur = dict(detail.get("big") or {})
+                cur.update(partial)
+                detail["big"] = cur
+                with open(os.path.join(REPO, detail_name), "w") as f:
+                    json.dump(detail, f, indent=2)
 
-        try:
-            _bench_big(save_partial)
-        except Exception as e:  # noqa: BLE001 - best effort, keep headline
-            save_partial({"error": f"{type(e).__name__}: {e}"})
-        big = detail.get("big") or {}
-        if big.get("ft_diloco_steps_per_sec") and big.get("ratio_vs_raw"):
-            # Promote the big phase to the printed headline (the driver
-            # takes the LAST metric line; the small-model line above stays
-            # as the provisional fallback if this phase died).
-            detail["headline"] = "big"
-            with open(os.path.join(REPO, detail_name), "w") as f:
-                json.dump(detail, f, indent=2)
-            print(
-                json.dumps(
-                    {
+            _mark("phase: big")
+            try:
+                _bench_big(save_partial, d2h_MBps)
+            except Exception as e:  # noqa: BLE001 - keep headline
+                save_partial({"error": f"{type(e).__name__}: {e}"})
+            big = detail.get("big") or {}
+            if big.get("ft_diloco_steps_per_sec") and big.get("ratio_vs_raw"):
+                # Promote the big phase to the printed headline (the
+                # driver takes the LAST metric line; the small-model line
+                # above stays as the provisional fallback).
+                detail["headline"] = "big"
+                with open(os.path.join(REPO, detail_name), "w") as f:
+                    json.dump(detail, f, indent=2)
+                print(
+                    json.dumps({
                         "metric": "steps_per_sec_ft",
                         "value": big["ft_diloco_steps_per_sec"],
                         "unit": "steps/s",
                         "vs_baseline": round(big["ratio_vs_raw"] / 0.90, 3),
-                    }
-                ),
-                flush=True,
+                    }),
+                    flush=True,
+                )
+    # -- big2: the ROOFLINE extrapolation MFU point (independent of the
+    # big FT phase: BENCH_SKIP_BIG must not silently drop it) --
+    if on_tpu:
+        if _remaining(60) > 150 and not os.environ.get("BENCH_SKIP_BIG2"):
+            _mark("phase: big2 (MFU point)")
+            try:
+                detail["big2"] = _bench_big2()
+            except Exception as e:  # noqa: BLE001 - best effort
+                detail["big2"] = {"error": f"{type(e).__name__}: {e}"}
+        else:
+            detail.setdefault(
+                "big2", {"skipped": "time budget (raw-only MFU point)"}
             )
+        with open(os.path.join(REPO, detail_name), "w") as f:
+            json.dump(detail, f, indent=2)
+    _mark(f"bench done in {time.monotonic() - _T0:.0f}s")
+
+
+def _land_headline(detail, detail_name, ft_sps, raw_sps) -> None:
+    """Writes the detail artifact and prints a metric line NOW — the
+    supervisor takes the LAST metric line, so later refinements safely
+    overwrite, and a wedge after this point can no longer lose the
+    round's number. CPU smoke runs write a separate file so they never
+    clobber the committed TPU artifact."""
+    with open(os.path.join(REPO, detail_name), "w") as f:
+        json.dump(detail, f, indent=2)
+    print(
+        json.dumps({
+            "metric": "steps_per_sec_ft",
+            "value": round(ft_sps, 3),
+            "unit": "steps/s",
+            "vs_baseline": round(min(ft_sps / raw_sps, 1.0) / 0.90, 3),
+        }),
+        flush=True,
+    )
+
+
+def _run_ft_ddp_phase(cfg, batch, tx, train_step, raw_sps, on_tpu,
+                      d2h_MBps) -> dict:
+    """Flagship-scale per-step gradient allreduce over a real 2-group
+    ring — the reference's product mode (per-step allreduce hidden behind
+    backward, reference ddp.py:47-71). Measured at REPRESENTATIVE
+    arithmetic intensity: the smoke config's 512 tokens/step against a
+    full gradient ship is a compute:comm balance no DDP deployment has
+    (measured breakdown on 1 CPU core: grad 546 ms vs ring 127 ms +
+    unpack 66 ms — fixed ring WORK that neither overlap nor bf16 can
+    remove on a single core). The phase therefore scales the batch and
+    measures its OWN raw baseline at the same config; blocking and
+    pipelined are both recorded. On CPU BOTH batch points land in the
+    artifact: the reference-like small batch where fixed ring work
+    dominates, and the 4x-token batch where compute amortizes it — the
+    ratio is an arithmetic-intensity story, and recording one point
+    hides that. Raw and FT loops share the SAME time-boxed windows and
+    drain discipline (_timed_window), so the CPU ratio can no longer
+    exceed 1.0 by construction of unequal windows (round-4 verdict #6).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu import (
+        FTTrainState, HostCollectives, Manager, OptimizerWrapper,
+        PipelinedDDP,
+    )
+    from torchft_tpu.models import init_params, loss_fn
+
+    tx_local = tx
+    degraded = on_tpu and d2h_MBps < 100
+    ddp_grad_fn = jax.jit(
+        jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b))
+    )
+    # Window budget shared by the raw baseline and every DDP variant at a
+    # given batch point: identical drain amortization on both sides.
+    win_s = 12.0 if not on_tpu else (20.0 if degraded else 15.0)
+
+    def time_ddp_raw(ddp_batch, warm: int) -> float:
+        return _time_raw_loop(
+            train_step,
+            lambda: init_params(cfg, jax.random.PRNGKey(0)), tx_local,
+            ddp_batch, warm, win_s, rate_hint=raw_sps,
+        )
+
+    def run_ddp(mode: str, wire: str, ddp_batch) -> float:
+        state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx_local)
+        with _ring_session(f"ddp_{mode}", wire, state) as (
+            manager, collectives,
+        ):
+            if mode == "blocking":
+                optimizer = OptimizerWrapper(manager, state)
+
+                def ft_step():
+                    optimizer.zero_grad()
+                    loss, grads = ddp_grad_fn(state.params, ddp_batch)
+                    avg = manager.allreduce(grads).wait()
+                    optimizer.step(avg)
+
+                ft_step()  # warm (peer round 0)
+                _barrier(state.params)
+                t0 = time.perf_counter()
+                n, _ = _timed_window(
+                    ft_step, lambda: _barrier(state.params), win_s,
+                    # each ft_step blocks on a full-gradient ring pass:
+                    # seconds-scale — start with a short burst and let
+                    # the observed rate recalibrate
+                    rate_hint=1.0,
+                )
+                _barrier(state.params)
+                el = time.perf_counter() - t0
+            else:
+                ddp = PipelinedDDP(
+                    manager, state,
+                    lambda p, b: ddp_grad_fn(p, b),
+                    compress="bf16" if wire == "bf16" else None,
+                )
+                ddp.step(ddp_batch)  # warm dispatch (peer round 0)
+                _barrier(state.params)
+                # Steady-state rate over N steps = N grad programs + N
+                # settled transactions: the flush (which settles step
+                # N's ring) is INSIDE the clock — excluding it charges
+                # the window one settle short, which at the short
+                # time-boxed windows here is a >10% FT-ward bias (the
+                # round-4 CPU ratio > 1).
+                t0 = time.perf_counter()
+                n, _ = _timed_window(
+                    lambda: ddp.step(ddp_batch), lambda: None, win_s,
+                    rate_hint=1.0,  # settle blocks per step: short bursts
+                )
+                ddp.flush()
+                _barrier(state.params)
+                el = time.perf_counter() - t0
+            sps = n / el
+            # A real 2-member ring carried every byte (no world-size-1
+            # identity shortcut).
+            assert collectives.size() == 2, "peer did not join the ring"
+            return sps
+
+    wire = "bf16" if degraded else "f32"
+
+    def measure_point(ddp_batch) -> dict:
+        # Symmetric windows: best-of-2 raw vs best-of-{variants}, every
+        # loop time-boxed to the same win_s with the same drain
+        # discipline. On the loaded 1-core CPU host a single raw window
+        # under-measures raw enough to produce nonsense FT/raw > 1.
+        ddp_raw = max(
+            time_ddp_raw(ddp_batch, 1),
+            time_ddp_raw(ddp_batch, 0),
+        )
+        blocking = (
+            None if degraded else run_ddp("blocking", wire, ddp_batch)
+        )
+        pipe = run_ddp("pipelined", wire, ddp_batch)
+        best = max(s for s in (blocking, pipe) if s is not None)
+        return {
+            "steps_per_sec": round(best, 3),
+            "ratio_vs_raw": round(min(best / ddp_raw, 1.0), 3),
+            "ratio_raw_measurement": round(best / ddp_raw, 3),
+            "raw_steps_per_sec": round(ddp_raw, 3),
+            "blocking_steps_per_sec": (
+                None if blocking is None else round(blocking, 3)
+            ),
+            "pipelined_steps_per_sec": round(pipe, 3),
+            "tokens_per_step": int(ddp_batch.size),
+        }
+
+    big_batch = batch if on_tpu else jnp.concatenate([batch] * 4, axis=0)
+    out = measure_point(big_batch)
+    out["wire"] = wire
+    out["note"] = (
+        "per-step full-gradient shipping over a live 2-member ring; raw "
+        "baseline best-of-2 at the same batch with identical time-boxed "
+        "windows and drain amortization (ratio clamped at 1.0; the raw "
+        "measurement ratio is recorded unclamped)"
+        + (
+            "; FORCED run on a degraded device<->host link — the "
+            "absolute rate is link-bound, not framework-bound"
+            if degraded
+            else ""
+        )
+    )
+    if not on_tpu:
+        # reference-like small batch: fixed ring work is ~30% of the
+        # 1-core step there, so the ratio is structurally lower — the
+        # amortization rule (compute >= 9x overhead for >= 0.9
+        # blocking) made explicit by recording both points
+        out["small_batch"] = measure_point(batch)
+        out["note"] += (
+            "; small_batch = the reference-like batch where ring "
+            "work is not amortized (ratio >= 0.9 needs compute >= 9x "
+            "overhead in blocking mode, ~1.1x in pipelined)"
+        )
+    return out
 
 
 def _supervised() -> None:
-    """Wedge-resilient outer layer: the measurement runs in a child with a
-    deadline and ONE retry. The device runtime on this host (tunneled)
-    occasionally wedges a session's in-flight call forever while fresh
-    sessions keep working — an orchestrator that never touches the device
-    can kill the stuck child and re-roll, instead of losing the round's
-    metric. The child's final JSON line is re-printed verbatim."""
-    deadline_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 1200))
+    """Wedge-resilient outer layer: ONE measurement attempt in a child
+    with a deadline that fits the driver's budget (round 4: two 1200 s
+    attempts blew past the driver's outer timeout — rc=124, no number).
+    A retry happens ONLY when the first attempt died fast (early tunnel
+    failure) with most of the budget left, and runs on the remaining
+    time. The child's final JSON line is re-printed verbatim."""
+    deadline_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 1000))
+    start = time.monotonic()
     env = dict(os.environ, BENCH_INNER="1")
     last_output = ""
-    for attempt in range(2):
+
+    def attempt(budget: float) -> str:
+        env["BENCH_ATTEMPT_TIMEOUT_S"] = str(int(budget))
         proc = subprocess.Popen(
             [sys.executable, "-u", os.path.abspath(__file__)],
             env=env,
@@ -1284,26 +1399,29 @@ def _supervised() -> None:
             text=True,
         )
         try:
-            last_output, _ = proc.communicate(timeout=deadline_s)
-            if proc.returncode == 0:
-                break
-            note = f"failed rc={proc.returncode}"
+            out, _ = proc.communicate(timeout=budget + 30)
         except subprocess.TimeoutExpired:
             proc.kill()
-            last_output, _ = proc.communicate()
+            out, _ = proc.communicate()
             subprocess.run(["pkill", "-9", "-f", "bench.py --peer"],
                            check=False)
-            note = f"wedged past {deadline_s}s"
-        if any(l.startswith('{"metric"') for l in last_output.splitlines()):
-            # The headline landed before the (best-effort) big phase died;
-            # keep it rather than re-rolling a finished measurement.
-            break
-        print(
-            f"bench attempt {attempt} {note}; "
-            + ("retrying" if attempt == 0 else "giving up"),
-            file=sys.stderr,
-            flush=True,
-        )
+            print(f"bench attempt wedged past {int(budget)}s",
+                  file=sys.stderr, flush=True)
+        return out
+
+    last_output = attempt(deadline_s)
+    if not any(
+        l.startswith('{"metric"') for l in last_output.splitlines()
+    ):
+        remaining = deadline_s - (time.monotonic() - start) - 30
+        if remaining > 0.5 * deadline_s:
+            print("bench attempt produced no metric early; retrying on "
+                  f"the remaining {int(remaining)}s", file=sys.stderr,
+                  flush=True)
+            last_output = attempt(remaining)
+        else:
+            print("bench attempt produced no metric; no budget to retry",
+                  file=sys.stderr, flush=True)
     metric_lines = [
         l for l in last_output.splitlines() if l.startswith('{"metric"')
     ]

@@ -23,7 +23,6 @@ import json
 import os
 import sys
 import time
-from datetime import timedelta
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -41,12 +40,7 @@ import bench  # noqa: E402
 import jax  # noqa: E402
 import optax  # noqa: E402
 
-from torchft_tpu import (  # noqa: E402
-    FTTrainState,
-    HostCollectives,
-    Manager,
-    PipelinedDDP,
-)
+from torchft_tpu import FTTrainState, PipelinedDDP  # noqa: E402
 from torchft_tpu.models import init_params, loss_fn  # noqa: E402
 from torchft_tpu.quantize import (  # noqa: E402
     make_dequant_average,
@@ -167,37 +161,12 @@ def main() -> None:
     print(f"platform={jax.devices()[0].platform} batch={batch.shape}",
           flush=True)
     tx = optax.adamw(1e-3)
-    rounds = WARM + FINE + 1 + PIPE  # serialized + pipelined warm + steps
-
-    lh = peer = manager = collectives = None
-    try:
-        lh = bench._fresh_lighthouse()
-        peer = bench._spawn_peer(lh.address(), rounds, "int8")
-        state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
-        collectives = HostCollectives(timeout=timedelta(seconds=600))
-        manager = Manager(
-            collectives=collectives,
-            load_state_dict=state.load_state_dict,
-            state_dict=state.state_dict,
-            min_replica_size=1,
-            timeout=timedelta(seconds=600),
-            quorum_timeout=timedelta(seconds=600),
-            rank=0,
-            world_size=1,
-            lighthouse_addr=lh.address(),
-            replica_id="bench_main_ddp_probe",  # sorts before bench_peer
-        )
+    state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), tx)
+    # bench's shared lifecycle: paced peer (rounds=0), reaped on exit.
+    with bench._ring_session("ddp_probe", "int8", state) as (
+        manager, collectives,
+    ):
         run(state, manager, collectives, cfg, batch)
-        peer.wait(timeout=300)
-    finally:
-        if peer is not None and peer.poll() is None:
-            peer.kill()
-        if manager is not None:
-            manager.shutdown()
-        if collectives is not None:
-            collectives.shutdown()
-        if lh is not None:
-            lh.shutdown()
     print("DONE", flush=True)
 
 

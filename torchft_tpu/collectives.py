@@ -864,6 +864,7 @@ class HostCollectives(Collectives):
         nbytes = len(packed)
         inbuf = ctypes.create_string_buffer(packed, nbytes) if nbytes else None
         out = np.empty(max(nbytes * self._world_size, 1), dtype=np.uint8)
+        t2b = time.perf_counter()  # host staging copies are not the wire
         _check(
             _lib.tft_hc_allgather(
                 self._handle,
@@ -887,8 +888,8 @@ class HostCollectives(Collectives):
             results.append(_unflatten(treedef, packer.unpack(member_bufs)))
         self._record_op_stats({
             "op": "allgather", "bytes": nbytes,
-            "pack": t1 - t0, "d2h": t2 - t1, "ring": t3 - t2,
-            "h2d": time.perf_counter() - t3,
+            "pack": t1 - t0, "d2h": t2 - t1, "host_copy": t2b - t2,
+            "ring": t3 - t2b, "h2d": time.perf_counter() - t3,
         })
         return results
 
