@@ -5,6 +5,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <limits>
 #include <vector>
 
 #include "log.h"
@@ -351,7 +352,23 @@ namespace {
 // One chunk on the q8 wire: 4-byte f32 scale, then `len` int8 codes.
 void q8_encode(const float* src, size_t len, char* wire) {
   float absmax = 0.f;
-  for (size_t i = 0; i < len; i++) absmax = std::max(absmax, std::fabs(src[i]));
+  bool finite = true;
+  for (size_t i = 0; i < len; i++) {
+    float a = std::fabs(src[i]);
+    if (!std::isfinite(a)) finite = false;
+    absmax = std::max(absmax, a);
+  }
+  if (!finite) {
+    // Non-finite gradients must poison the result the way the f32/bf16
+    // wires do: std::max/min drop NaN (they return the other operand),
+    // so a diverged model would otherwise be encoded as clamped finite
+    // codes and the blow-up silently hidden. A NaN scale makes every
+    // decoded element NaN on all ranks.
+    float nan = std::numeric_limits<float>::quiet_NaN();
+    memcpy(wire, &nan, sizeof(float));
+    memset(wire + sizeof(float), 0, len);
+    return;
+  }
   float scale = absmax > 0.f ? absmax / 127.f : 1.f;
   memcpy(wire, &scale, sizeof(float));
   int8_t* q = reinterpret_cast<int8_t*>(wire + sizeof(float));
