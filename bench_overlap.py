@@ -1,14 +1,31 @@
-"""Measures the data-plane overlap pipeline (VERDICT round 1 item 3).
+"""Measures the data-plane overlap pipeline and the striped-connection ring.
 
-Times a gradient-sized allreduce through a real 2-member host ring with the
-chunked pipeline ON (d2h DMA / TCP ring / h2d upload overlapped) vs OFF
-(sequential single-shot per dtype group), from this host's accelerator.
-The payload is sized at ~10x the flagship bench model's gradients, where
-the transfer+ring cost is the dominant fault-tolerance overhead.
+Two CPU-loopback-measurable modes (no TPU required), both over a real
+2-member host ring with a gradient-sized payload (~10x the flagship bench
+model's gradients — where transfer+ring cost is the dominant
+fault-tolerance overhead):
 
-Writes OVERLAP_BENCH.json and prints one summary line per config.
+  default          chunked-pipeline ON vs OFF at a single connection
+                   (d2h DMA / TCP ring / h2d upload overlap) ->
+                   OVERLAP_BENCH.json
+  --stripe-sweep   ring striped over N parallel TCP connections per
+                   neighbor, N swept over STRIPE_COUNTS at the pipelined
+                   chunk config -> STRIPE_BENCH.json. Two passes:
+                   (a) raw loopback — a CONTROL: loopback under this
+                   sandbox is CPU-bound (a raw-socket probe here tops out
+                   ~700 MB/s at 1 connection and gets SLOWER with more),
+                   so stripes can only show parity; (b) per-connection
+                   send cap (TORCHFT_HC_WIRE_CAP_MBPS) — emulates the
+                   window/BDP-limited paths the striping exists for (the
+                   TPU-tunnel link behind OVERLAP_BENCH.json delivered
+                   4.5-13.4 MB/s on one connection), where aggregate
+                   throughput scaling with N is a real end-to-end property
+                   of the transport: serialized stripes, lock contention,
+                   or a desynced schedule would all fail it.
 
-Usage: python bench_overlap.py [--peer <store_addr>]
+Writes the JSON artifact and prints one summary line per config.
+
+Usage: python bench_overlap.py [--stripe-sweep] [--peer <store_addr> <mode>]
 """
 
 import json
@@ -33,84 +50,207 @@ def _tree(fill: float):
     return {f"g{i}": jnp.full((n,), fill, jnp.float32) for i in range(N_LEAVES)}
 
 
+# (name, pipeline_chunks) at a single ring connection — isolates the
+# intra-buffer overlap pipeline from connection striping.
 PHASES = (("single_shot", 1), ("pipelined", 8))
 
+# Ring connections per neighbor edge for the stripe sweep; chunk config held
+# at the pipelined setting so the sweep isolates the transport.
+STRIPE_COUNTS = (1, 2, 4, 8)
+STRIPE_CHUNKS = 8
+# Per-connection send cap (MB/s) for the BDP-emulated pass — the order of
+# the per-connection rates measured through real tunneled links here
+# (OVERLAP_BENCH.json), generous by ~4x.
+WIRE_CAP_MBPS = 50
 
-def peer(store_addr: str) -> None:
+
+def _configs(mode):
+    """(prefix, pipeline_chunks, stripes) per phase — IDENTICAL on both ring
+    members (the chunk/stripe schedule is part of the wire contract;
+    configure() validates it through the store)."""
+    if mode in ("stripes", "stripes_capped"):
+        pre = "cap_" if mode == "stripes_capped" else ""
+        return [(f"{pre}stripe{s}", STRIPE_CHUNKS, s) for s in STRIPE_COUNTS]
+    return [(name, chunks, 1) for name, chunks in PHASES]
+
+
+def _apply_cap(mode) -> None:
+    # The cap is pure send pacing (no wire-format effect), read by the
+    # native layer at configure(); set it identically in both processes so
+    # each DIRECTION of the ring is capped.
+    if mode == "stripes_capped":
+        os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(WIRE_CAP_MBPS)
+    else:
+        os.environ.pop("TORCHFT_HC_WIRE_CAP_MBPS", None)
+
+
+def peer(store_addr: str, mode: str) -> None:
     from torchft_tpu.platform import apply_jax_platform_env
 
+    _apply_cap(mode)
     apply_jax_platform_env()
     from torchft_tpu.collectives import HostCollectives, ReduceOp
 
     zeros = _tree(0.0)
-    for phase, (_, chunks) in enumerate(PHASES):
-        # One ring + one HostCollectives per phase, chunk config matching
-        # the main side exactly — the chunk schedule is part of the wire
-        # contract (configure() validates it).
+    for prefix, chunks, stripes in _configs(mode):
         hc = HostCollectives(timeout=timedelta(seconds=600),
                              connect_timeout=timedelta(seconds=600),
-                             pipeline_chunks=chunks)
-        hc.configure(f"{store_addr}/overlap{phase}", 1, 2)
+                             pipeline_chunks=chunks,
+                             stripes=stripes)
+        hc.configure(f"{store_addr}/{prefix}", 1, 2)
         for _ in range(1 + ITERS):  # warm + timed
             hc.allreduce(zeros, ReduceOp.SUM).wait()
         hc.shutdown()
 
 
-def main() -> None:
-    if len(sys.argv) > 2 and sys.argv[1] == "--peer":
-        peer(sys.argv[2])
-        return
+def _measure(store, tree, mode):
+    """Times every config of `mode` against the already-running peer;
+    returns {config_name: {"s", "MBps"}}."""
+    import jax
 
+    from torchft_tpu.collectives import HostCollectives, ReduceOp
+
+    _apply_cap(mode)
+    out = {}
+    for prefix, chunks, stripes in _configs(mode):
+        hc = HostCollectives(
+            timeout=timedelta(seconds=600),
+            connect_timeout=timedelta(seconds=600),
+            pipeline_chunks=chunks,
+            stripes=stripes,
+        )
+        hc.configure(f"{store.address()}/{prefix}", 0, 2)
+        res = hc.allreduce(tree, ReduceOp.SUM).wait()  # warm (jit pack)
+        jax.block_until_ready(res)
+        hc.pop_op_stats()  # drop the warm iter's timings
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            res = hc.allreduce(tree, ReduceOp.SUM).wait()
+            jax.block_until_ready(res)
+        dt = (time.perf_counter() - t0) / ITERS
+        # Ring-leg transport wall from the op stats: per-chunk slowest-
+        # stripe maxima, excluding the d2h/h2d memcpy legs and the
+        # peer-skew wait at the op-header sync — the number the stripe
+        # count actually moves.  End-to-end `s` stays the headline for
+        # the overlap mode, where the pipeline overlap is the story.
+        ring_wall = 0.0
+        for st in hc.pop_op_stats():
+            for b in st.get("buckets", {}).values():
+                ring_wall += b.get("stripe_wall") or b["ring"]
+        ring_s = ring_wall / ITERS
+        out[prefix] = {"s": round(dt, 3), "MBps": round(TOTAL_MB / dt, 1),
+                       "ring_s": round(ring_s, 3),
+                       "ring_MBps": round(TOTAL_MB / ring_s, 1)}
+        label = (f"stripes={stripes}" if mode.startswith("stripes")
+                 else f"chunks={chunks}")
+        print(f"{prefix} ({label}): {dt:.3f}s {TOTAL_MB / dt:.1f} MB/s "
+              f"end-to-end, ring {ring_s:.3f}s {TOTAL_MB / ring_s:.1f} MB/s",
+              flush=True)
+        hc.shutdown()
+    return out
+
+
+def _run_mode(mode):
     import jax
 
     from torchft_tpu import Store
-    from torchft_tpu.collectives import HostCollectives, ReduceOp
 
     store = Store()
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     peer_proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--peer", store.address()],
+        [sys.executable, os.path.abspath(__file__), "--peer",
+         store.address(), mode],
         env=env,
     )
-
     tree = _tree(1.0)
     jax.block_until_ready(tree)
+    try:
+        results = _measure(store, tree, mode)
+        assert peer_proc.wait(timeout=600) == 0
+    finally:
+        if peer_proc.poll() is None:
+            peer_proc.kill()
+        store.shutdown()
+    return results
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--peer":
+        peer(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "overlap")
+        return
+
+    import jax
+
+    if "--stripe-sweep" in sys.argv:
+        capped = _run_mode("stripes_capped")
+        raw = _run_mode("stripes")
+        base = capped["cap_stripe1"]
+        # Headline = the capped pass, ranked on the ring leg: striping is a
+        # transport optimization for per-connection-limited paths, and the
+        # capped pass is the loopback-measurable stand-in for them. The
+        # raw pass stays in the artifact as the control (CPU-bound here:
+        # parity is the expected result, see module docstring).
+        best_s = max(STRIPE_COUNTS,
+                     key=lambda s: capped[f"cap_stripe{s}"]["ring_MBps"])
+        best = capped[f"cap_stripe{best_s}"]
+        report = {
+            "platform": jax.devices()[0].platform,
+            "payload_MB": TOTAL_MB,
+            "leaves": N_LEAVES,
+            "iters": ITERS,
+            "pipeline_chunks": STRIPE_CHUNKS,
+            "bdp_emulated": {
+                "per_connection_cap_MBps": WIRE_CAP_MBPS,
+                "how": "TORCHFT_HC_WIRE_CAP_MBPS send pacing per ring "
+                       "connection, both directions — models the "
+                       "window/BDP-limited DCN and tunneled links the "
+                       "striped transport targets",
+                "stripes": {
+                    str(s): capped[f"cap_stripe{s}"] for s in STRIPE_COUNTS
+                },
+            },
+            "raw_loopback_control": {
+                "note": "this sandbox's loopback is CPU-bound (~700 MB/s "
+                        "at 1 raw connection, slower with more), so "
+                        "stripe parity — not speedup — is the honest "
+                        "expectation here",
+                "stripes": {
+                    str(s): raw[f"stripe{s}"] for s in STRIPE_COUNTS
+                },
+            },
+            "single_connection_MBps": base["MBps"],
+            "single_connection_ring_MBps": base["ring_MBps"],
+            "best_stripes": best_s,
+            "best_MBps": best["MBps"],
+            "best_ring_MBps": best["ring_MBps"],
+            "speedup_vs_single_connection": round(
+                best["MBps"] / base["MBps"], 3
+            ),
+            "ring_speedup_vs_single_connection": round(
+                best["ring_MBps"] / base["ring_MBps"], 3
+            ),
+        }
+        with open(os.path.join(REPO, "STRIPE_BENCH.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps({
+            "stripe_speedup": report["speedup_vs_single_connection"],
+            "ring_speedup": report["ring_speedup_vs_single_connection"],
+            "best_stripes": best_s,
+        }))
+        return
+
+    results = _run_mode("overlap")
     report = {
         "platform": jax.devices()[0].platform,
         "payload_MB": TOTAL_MB,
         "leaves": N_LEAVES,
         "iters": ITERS,
     }
-    try:
-        for phase, (name, chunks) in enumerate(PHASES):
-            hc = HostCollectives(
-                timeout=timedelta(seconds=600),
-                connect_timeout=timedelta(seconds=600),
-                pipeline_chunks=chunks,
-            )
-            hc.configure(f"{store.address()}/overlap{phase}", 0, 2)
-            out = hc.allreduce(tree, ReduceOp.SUM).wait()  # warm (jit pack)
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            for _ in range(ITERS):
-                out = hc.allreduce(tree, ReduceOp.SUM).wait()
-                jax.block_until_ready(out)
-            dt = (time.perf_counter() - t0) / ITERS
-            report[name] = {"s": round(dt, 3),
-                            "MBps": round(TOTAL_MB / dt, 1)}
-            print(f"{name} (chunks={chunks}): {dt:.3f}s "
-                  f"{TOTAL_MB / dt:.1f} MB/s", flush=True)
-            hc.shutdown()
-        report["speedup"] = round(
-            report["single_shot"]["s"] / report["pipelined"]["s"], 3
-        )
-        assert peer_proc.wait(timeout=600) == 0
-    finally:
-        if peer_proc.poll() is None:
-            peer_proc.kill()
-        store.shutdown()
-
+    report.update(results)
+    report["speedup"] = round(
+        report["single_shot"]["s"] / report["pipelined"]["s"], 3
+    )
     with open(os.path.join(REPO, "OVERLAP_BENCH.json"), "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps({"overlap_speedup": report["speedup"]}))

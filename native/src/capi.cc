@@ -244,10 +244,10 @@ void* tft_hc_create() { return new HostCollectives(); }
 void tft_hc_destroy(void* handle) { delete static_cast<HostCollectives*>(handle); }
 
 int tft_hc_configure(void* handle, const char* store_addr, int64_t rank,
-                     int64_t world_size, int64_t timeout_ms) {
+                     int64_t world_size, int64_t timeout_ms, int64_t stripes) {
   return guarded([&] {
     static_cast<HostCollectives*>(handle)->configure(store_addr, rank, world_size,
-                                                     timeout_ms);
+                                                     timeout_ms, stripes);
   });
 }
 
@@ -292,6 +292,21 @@ void tft_hc_abort(void* handle) { static_cast<HostCollectives*>(handle)->abort()
 
 int64_t tft_hc_world_size(void* handle) {
   return static_cast<HostCollectives*>(handle)->world_size();
+}
+
+int64_t tft_hc_stripes(void* handle) {
+  return static_cast<HostCollectives*>(handle)->stripes();
+}
+
+// Copies up to `cap` per-stripe wall times (ns) of the last bulk op into
+// `out`; returns how many stripes the op actually ran. Must be called from
+// the thread that issued the op (the Python single-op executor), which is
+// the only thread that reads these between ops.
+int64_t tft_hc_last_stripe_ns(void* handle, int64_t* out, int64_t cap) {
+  const auto& ns = static_cast<HostCollectives*>(handle)->last_stripe_ns();
+  int64_t n = static_cast<int64_t>(ns.size());
+  for (int64_t i = 0; i < n && i < cap; i++) out[i] = ns[i];
+  return n;
 }
 
 // ---- pure functions (test entry points) ----

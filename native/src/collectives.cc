@@ -4,8 +4,12 @@
 #include <string.h>
 
 #include <algorithm>
+#include <cstdlib>
+#include <chrono>
 #include <cmath>
+#include <exception>
 #include <limits>
+#include <thread>
 #include <vector>
 
 #include "log.h"
@@ -39,9 +43,23 @@ namespace {
 // clear error; that over-rejection is the price of screening out the
 // truly incompatible older builds sharing the old magic. Bump the low
 // byte on any future wire change.
-constexpr uint32_t kHelloMagic = 0x74667402; // "tft" + proto rev 2
+// rev 3: hello grew from {magic, rank} to {magic, rank, stripe, nstripes}
+// for the striped multi-connection ring.
+constexpr uint32_t kHelloMagic = 0x74667403; // "tft" + proto rev 3
 // "tftp": per-op header magic (part of the wire protocol).
 constexpr uint32_t kOpMagic = 0x74667470;
+
+// Floor on bytes a stripe must carry before an extra connection/thread is
+// worth waking: below this, per-op thread dispatch costs more than the
+// wire. The effective stripe count derived from it depends only on
+// (payload, configured stripes) — identical on every member, preserving
+// the schedule agreement.
+constexpr size_t kMinStripeBytes = 64 << 10;
+
+int64_t effective_stripes(size_t payload_bytes, int64_t configured) {
+  int64_t by_size = static_cast<int64_t>(payload_bytes / kMinStripeBytes);
+  return std::max<int64_t>(1, std::min(configured, std::max<int64_t>(by_size, 1)));
+}
 
 template <typename T>
 void reduce_typed(T* dst, const T* src, size_t n, ReduceOp op) {
@@ -130,17 +148,36 @@ std::pair<size_t, size_t> chunk_range(size_t count, int64_t ws, int64_t c) {
   return {start, len};
 }
 
-} // namespace
+}  // namespace
 
-HostCollectives::~HostCollectives() { abort(); }
+std::pair<size_t, size_t> HostCollectives::stripe_range(size_t count,
+                                                        int64_t n, int64_t s) {
+  return chunk_range(count, n, s);
+}
+
+HostCollectives::~HostCollectives() {
+  abort();
+  {
+    std::lock_guard<std::mutex> lock(pool_mu_);
+    pool_stop_ = true;
+  }
+  pool_cv_.notify_all();
+  for (auto& w : pool_) w.join();
+}
 
 void HostCollectives::abort() {
   std::lock_guard<std::mutex> lock(cfg_mu_);
   aborted_ = true;
   abort_epoch_++;
   if (listener_) listener_->close();
-  next_.shutdown_rdwr();
-  prev_.shutdown_rdwr();
+  for (auto& s : next_) s.shutdown_rdwr();
+  for (auto& s : prev_) s.shutdown_rdwr();
+}
+
+void HostCollectives::shutdown_sockets() {
+  std::lock_guard<std::mutex> lock(cfg_mu_);
+  for (auto& s : next_) s.shutdown_rdwr();
+  for (auto& s : prev_) s.shutdown_rdwr();
 }
 
 namespace {
@@ -157,9 +194,13 @@ int64_t remain_or_throw(int64_t deadline) {
 } // namespace
 
 void HostCollectives::configure(const std::string& store_addr, int64_t rank,
-                                int64_t world_size, int64_t timeout_ms) {
+                                int64_t world_size, int64_t timeout_ms,
+                                int64_t stripes) {
   if (rank < 0 || world_size <= 0 || rank >= world_size)
     throw SocketError("bad rank/world_size");
+  if (stripes < 1 || stripes > kMaxStripes)
+    throw SocketError("bad stripe count (want 1.." +
+                      std::to_string(kMaxStripes) + ")");
   abort(); // unblock any op stuck on the old ring
   std::lock_guard<std::mutex> op_lock(op_mu_); // wait for it to drain
 
@@ -168,11 +209,16 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   int64_t epoch;
   {
     std::lock_guard<std::mutex> lock(cfg_mu_);
-    next_.close();
-    prev_.close();
+    next_.clear();
+    prev_.clear();
     listener_.reset();
     rank_ = rank;
     world_size_ = world_size;
+    stripes_ = stripes;
+    const char* cap = std::getenv("TORCHFT_HC_WIRE_CAP_MBPS");
+    wire_cap_bps_ =
+        cap ? static_cast<int64_t>(std::atof(cap) * (1 << 20)) : 0;
+    scratch_.assign(stripes, StripeScratch{});  // fresh pace state per ring
     aborted_ = true;
     epoch = abort_epoch_;
     if (world_size == 1) {
@@ -198,62 +244,121 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   std::string next_addr =
       store.get(prefix + "/hc_addr_" + std::to_string(next_rank),
                 remain_or_throw(deadline));
-  Socket next_sock = connect_with_retry(next_addr, remain_or_throw(deadline));
-  uint32_t hello[2] = {kHelloMagic, static_cast<uint32_t>(rank)};
-  next_sock.send_all(hello, sizeof(hello), deadline);
 
-  Socket prev_sock = listener_->accept(deadline);
-  if (!prev_sock.valid()) throw SocketError("listener closed during configure");
-  uint32_t peer_hello[2];
-  prev_sock.recv_all(peer_hello, sizeof(peer_hello), deadline);
+  // Dial the next rank once per stripe; the hello names the stripe slot so
+  // the peer can place accepted connections regardless of arrival order,
+  // and carries the stripe COUNT so a config mismatch that slipped past the
+  // store-level negotiation still fails at connect, not mid-op.
+  std::vector<Socket> next_socks(stripes);
+  for (int64_t s = 0; s < stripes; s++) {
+    next_socks[s] = connect_with_retry(next_addr, remain_or_throw(deadline));
+    uint32_t hello[4] = {kHelloMagic, static_cast<uint32_t>(rank),
+                         static_cast<uint32_t>(s),
+                         static_cast<uint32_t>(stripes)};
+    next_socks[s].send_all(hello, sizeof(hello), deadline);
+  }
+
+  std::vector<Socket> prev_socks(stripes);
   int64_t prev_rank = (rank - 1 + world_size) % world_size;
-  if (peer_hello[0] != kHelloMagic)
-    throw SocketError(
-        "ring handshake: wire-protocol mismatch (peer binary speaks a "
-        "different ring protocol revision)");
-  if (peer_hello[1] != static_cast<uint32_t>(prev_rank))
-    throw SocketError("ring handshake: unexpected peer rank");
+  for (int64_t i = 0; i < stripes; i++) {
+    Socket sock = listener_->accept(deadline);
+    if (!sock.valid()) throw SocketError("listener closed during configure");
+    uint32_t peer_hello[4];
+    sock.recv_all(peer_hello, sizeof(peer_hello), deadline);
+    if (peer_hello[0] != kHelloMagic)
+      throw SocketError(
+          "ring handshake: wire-protocol mismatch (peer binary speaks a "
+          "different ring protocol revision)");
+    if (peer_hello[1] != static_cast<uint32_t>(prev_rank))
+      throw SocketError("ring handshake: unexpected peer rank");
+    if (peer_hello[3] != static_cast<uint32_t>(stripes))
+      throw SocketError(
+          "ring handshake: stripe-count mismatch (this rank " +
+          std::to_string(stripes) + ", prev rank " +
+          std::to_string(peer_hello[3]) +
+          " — all members must configure the same stripes)");
+    uint32_t slot = peer_hello[2];
+    if (slot >= static_cast<uint32_t>(stripes) || prev_socks[slot].valid())
+      throw SocketError("ring handshake: bad or duplicate stripe index");
+    prev_socks[slot] = std::move(sock);
+  }
 
   // Phase 3: publish the new ring unless an abort raced in.
   std::lock_guard<std::mutex> lock(cfg_mu_);
   if (abort_epoch_ != epoch) throw SocketError("aborted during configure");
-  next_ = std::move(next_sock);
-  prev_ = std::move(prev_sock);
+  next_ = std::move(next_socks);
+  prev_ = std::move(prev_socks);
   aborted_ = false;
 }
 
-void HostCollectives::duplex(const char* send_buf, size_t send_len,
-                             char* recv_buf, size_t recv_len,
-                             int64_t deadline_ms) {
+void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
+                             size_t send_len, char* recv_buf, size_t recv_len,
+                             int64_t deadline_ms, PaceState* pace) {
+  const double bps = static_cast<double>(wire_cap_bps_);
+  // Burst = 20 ms of credit (floor 64 KB): small enough that the realized
+  // rate tracks the cap within any measurement window, large enough that a
+  // chunk-sized write needs one send call.
+  const double burst = std::max(65536.0, bps / 50.0);
   size_t sent = 0, got = 0;
   while (sent < send_len || got < recv_len) {
+    // Refill the token bucket and decide whether this pass may send; when
+    // token-dry, the send fd leaves the poll set and the poll timeout
+    // shrinks to the refill time, so receives still drain at full speed.
+    int64_t pace_wait_ms = -1;
+    bool may_send = sent < send_len;
+    if (may_send && pace && bps > 0) {
+      auto now = std::chrono::steady_clock::now();
+      if (!pace->init) {
+        pace->init = true;
+        pace->tokens = burst;
+      } else {
+        pace->tokens +=
+            std::chrono::duration<double>(now - pace->last).count() * bps;
+        if (pace->tokens > burst) pace->tokens = burst;
+      }
+      pace->last = now;
+      if (pace->tokens < 1.0) {
+        may_send = false;
+        pace_wait_ms =
+            static_cast<int64_t>((1.0 - pace->tokens) / bps * 1000.0) + 1;
+      }
+    }
     struct pollfd pfds[2];
     int n = 0;
     int send_idx = -1, recv_idx = -1;
-    if (sent < send_len) {
+    if (may_send) {
       send_idx = n;
-      pfds[n].fd = next_.fd();
+      pfds[n].fd = next.fd();
       pfds[n].events = POLLOUT;
       n++;
     }
     if (got < recv_len) {
       recv_idx = n;
-      pfds[n].fd = prev_.fd();
+      pfds[n].fd = prev.fd();
       pfds[n].events = POLLIN;
       n++;
     }
     int timeout = poll_timeout_or_throw(deadline_ms, "collective timed out");
+    if (pace_wait_ms >= 0 && (timeout < 0 || pace_wait_ms < timeout))
+      timeout = static_cast<int>(pace_wait_ms);
     int prc = ::poll(pfds, n, timeout);
-    if (prc == 0) throw TimeoutError("collective timed out");
+    if (prc == 0) {
+      if (pace_wait_ms >= 0) continue;  // token refill elapsed, not a stall
+      throw TimeoutError("collective timed out");
+    }
     if (prc < 0) {
       if (errno == EINTR) continue;
       throw SocketError(std::string("poll: ") + strerror(errno));
     }
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t w = ::send(next_.fd(), send_buf + sent, send_len - sent,
+      size_t allow = send_len - sent;
+      if (pace && bps > 0 && static_cast<double>(allow) > pace->tokens)
+        allow = static_cast<size_t>(pace->tokens);
+      ssize_t w = ::send(next.fd(), send_buf + sent, allow,
                          MSG_NOSIGNAL | MSG_DONTWAIT);
       if (w > 0) {
         sent += static_cast<size_t>(w);
+        if (pace && bps > 0) pace->tokens -= static_cast<double>(w);
       } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
                  errno != EINTR) {
         throw SocketError(std::string("ring send: ") + strerror(errno));
@@ -261,7 +366,7 @@ void HostCollectives::duplex(const char* send_buf, size_t send_len,
     }
     if (recv_idx >= 0 &&
         (pfds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t r = ::recv(prev_.fd(), recv_buf + got, recv_len - got, MSG_DONTWAIT);
+      ssize_t r = ::recv(prev.fd(), recv_buf + got, recv_len - got, MSG_DONTWAIT);
       if (r > 0) {
         got += static_cast<size_t>(r);
       } else if (r == 0) {
@@ -281,14 +386,17 @@ void HostCollectives::check_op_header(uint32_t kind, uint64_t count,
   // different members) otherwise DEADLOCKS silently: the small member
   // finishes, stops reading, and the large member blocks forever once
   // kernel buffers fill. ~20 bytes per collective — noise next to any
-  // payload — converts that into an immediate, descriptive error.
+  // payload — converts that into an immediate, descriptive error. Runs on
+  // stripe 0 (the stripe COUNT is already pinned at connect time by the
+  // hello, so one stripe's agreement covers the schedule).
   struct Header {
     uint32_t magic, kind;
     uint64_t count;
     uint32_t dtype, op;
   } mine{kOpMagic, kind, count, dtype, op}, theirs{};
-  duplex(reinterpret_cast<const char*>(&mine), sizeof(mine),
-         reinterpret_cast<char*>(&theirs), sizeof(theirs), deadline_ms);
+  duplex(next_[0], prev_[0], reinterpret_cast<const char*>(&mine),
+         sizeof(mine), reinterpret_cast<char*>(&theirs), sizeof(theirs),
+         deadline_ms);
   if (theirs.magic != kOpMagic)
     throw SocketError("ring op header corrupt (protocol desync)");
   if (theirs.kind != mine.kind || theirs.count != mine.count ||
@@ -301,6 +409,125 @@ void HostCollectives::check_op_header(uint32_t kind, uint64_t count,
         std::to_string(theirs.count) + " dtype=" +
         std::to_string(theirs.dtype) + " op=" + std::to_string(theirs.op) +
         " (members must reduce identical trees)");
+}
+
+void HostCollectives::run_striped(const std::function<void(int64_t)>& fn) {
+  int64_t n = static_cast<int64_t>(last_stripe_ns_.size());
+  std::vector<std::exception_ptr> errs(n);
+
+  auto body = [&](int64_t s) {
+    auto t0 = std::chrono::steady_clock::now();
+    try {
+      fn(s);
+    } catch (...) {
+      errs[s] = std::current_exception();
+      // Wake every sibling stripe immediately: they share the op's fate,
+      // and letting them block until their timeout would stall the abort
+      // path the whole design exists to keep fast.
+      shutdown_sockets();
+    }
+    last_stripe_ns_[s] =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0)
+            .count();
+  };
+
+  if (n <= 1) {
+    body(0);
+  } else {
+    // Publish the job to the persistent workers (a thread per stripe per
+    // native op would cost more than the stripe's transport at pipelined
+    // chunk sizes), run stripe 0 here, then wait for the drain. The drain
+    // wait is unconditional-bounded: failing stripes shut down every
+    // socket, so no sibling can block past its IO wakeup.
+    std::function<void(int64_t)> body_fn = body;
+    ensure_pool(n - 1);
+    {
+      std::lock_guard<std::mutex> lock(pool_mu_);
+      pool_body_ = &body_fn;
+      pool_n_ = n;
+      pool_pending_ = n - 1;
+      pool_gen_++;
+    }
+    pool_cv_.notify_all();
+    body(0);
+    {
+      std::unique_lock<std::mutex> lock(pool_mu_);
+      pool_done_cv_.wait(lock, [&] { return pool_pending_ == 0; });
+      pool_body_ = nullptr;
+    }
+  }
+  for (auto& e : errs)
+    if (e) std::rethrow_exception(e);  // ONE error: lowest stripe wins
+}
+
+void HostCollectives::ensure_pool(int64_t workers) {
+  std::lock_guard<std::mutex> lock(pool_mu_);
+  while (static_cast<int64_t>(pool_.size()) < workers) {
+    // Seed each worker with the CURRENT generation (stable under pool_mu_):
+    // a fresh thread must not mistake an already-running or past job for
+    // its first wakeup.
+    pool_.emplace_back(&HostCollectives::pool_main, this,
+                       static_cast<int64_t>(pool_.size()), pool_gen_);
+  }
+}
+
+void HostCollectives::pool_main(int64_t idx, int64_t start_gen) {
+  int64_t seen_gen = start_gen;
+  for (;;) {
+    const std::function<void(int64_t)>* body;
+    int64_t n;
+    {
+      std::unique_lock<std::mutex> lock(pool_mu_);
+      pool_cv_.wait(lock,
+                    [&] { return pool_stop_ || pool_gen_ != seen_gen; });
+      if (pool_stop_) return;
+      seen_gen = pool_gen_;
+      body = pool_body_;
+      n = pool_n_;
+    }
+    // Worker idx owns stripe idx+1; jobs narrower than the pool (fewer
+    // effective stripes) don't count the spare workers in pool_pending_.
+    if (idx + 1 < n) {
+      (*body)(idx + 1);
+      std::lock_guard<std::mutex> lock(pool_mu_);
+      if (--pool_pending_ == 0) pool_done_cv_.notify_all();
+    }
+  }
+}
+
+void HostCollectives::allreduce_stripe(int64_t s, char* bytes, size_t count,
+                                       size_t esize, Dtype dtype, ReduceOp op,
+                                       int64_t deadline) {
+  size_t max_chunk = count / world_size_ + 1;
+  std::vector<char>& recv_tmp = scratch_[s].recv;
+  if (recv_tmp.size() < max_chunk * esize) recv_tmp.resize(max_chunk * esize);
+
+  // Reduce-scatter: after step t, chunk (rank - t) has accumulated the
+  // values of ranks rank-t..rank. After ws-1 steps chunk (rank+1) holds the
+  // full reduction at this rank — computed in the identical rank order
+  // everywhere.
+  for (int64_t t = 0; t < world_size_ - 1; t++) {
+    int64_t send_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
+    int64_t recv_c =
+        ((rank_ - t - 1) % world_size_ + world_size_) % world_size_;
+    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+    duplex(next_[s], prev_[s], bytes + s_start * esize, s_len * esize,
+           recv_tmp.data(), r_len * esize, deadline, &scratch_[s].pace);
+    reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
+  }
+  // Allgather: circulate the fully-reduced chunks.
+  for (int64_t t = 0; t < world_size_ - 1; t++) {
+    int64_t send_c =
+        ((rank_ + 1 - t) % world_size_ + world_size_) % world_size_;
+    int64_t recv_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
+    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+    duplex(next_[s], prev_[s], bytes + s_start * esize, s_len * esize,
+           bytes + r_start * esize, r_len * esize, deadline,
+           &scratch_[s].pace);
+  }
 }
 
 void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
@@ -317,33 +544,14 @@ void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
     if (count == 0) return;
     char* bytes = static_cast<char*>(data);
     size_t esize = dtype_size(dtype);
-    size_t max_chunk = count / world_size_ + 1;
-    std::vector<char> recv_tmp(max_chunk * esize);
-
-    // Reduce-scatter: after step s, chunk (rank - s) has accumulated the
-    // values of ranks rank-s..rank. After ws-1 steps chunk (rank+1) holds the
-    // full reduction at this rank — computed in the identical rank order
-    // everywhere.
-    for (int64_t s = 0; s < world_size_ - 1; s++) {
-      int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-      int64_t recv_c =
-          ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
-      auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-      duplex(bytes + s_start * esize, s_len * esize, recv_tmp.data(),
-             r_len * esize, deadline);
-      reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
-    }
-    // Allgather: circulate the fully-reduced chunks.
-    for (int64_t s = 0; s < world_size_ - 1; s++) {
-      int64_t send_c =
-          ((rank_ + 1 - s) % world_size_ + world_size_) % world_size_;
-      int64_t recv_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-      auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-      duplex(bytes + s_start * esize, s_len * esize, bytes + r_start * esize,
-             r_len * esize, deadline);
-    }
+    int64_t eff = effective_stripes(count * esize, stripes_);
+    last_stripe_ns_.assign(eff, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff, s);
+      if (len == 0) return;
+      allreduce_stripe(s, bytes + start * esize, len, esize, dtype, op,
+                       deadline);
+    });
   });
 }
 
@@ -392,6 +600,57 @@ void q8_decode(const char* wire, size_t len, float* dst, bool accumulate) {
 
 }  // namespace
 
+void HostCollectives::allreduce_q8_stripe(int64_t s, float* data, size_t count,
+                                          int64_t deadline) {
+  size_t max_chunk = count / world_size_ + 1;
+  size_t max_wire = sizeof(float) + max_chunk;
+  std::vector<char>& send_wire = scratch_[s].send;
+  std::vector<char>& recv_wire = scratch_[s].recv;
+  if (send_wire.size() < max_wire) send_wire.resize(max_wire);
+  if (recv_wire.size() < max_wire) recv_wire.resize(max_wire);
+
+  // Reduce-scatter: each hop quantizes its CURRENT partial sum of the
+  // outgoing chunk and dequant-accumulates the incoming one in f32.
+  for (int64_t t = 0; t < world_size_ - 1; t++) {
+    int64_t send_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
+    int64_t recv_c =
+        ((rank_ - t - 1) % world_size_ + world_size_) % world_size_;
+    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
+    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+    q8_encode(data + s_start, s_len, send_wire.data());
+    duplex(next_[s], prev_[s], send_wire.data(), sizeof(float) + s_len,
+           recv_wire.data(), sizeof(float) + r_len, deadline,
+           &scratch_[s].pace);
+    q8_decode(recv_wire.data(), r_len, data + r_start, /*accumulate=*/true);
+  }
+  // Allgather: the OWNER quantizes its fully-reduced chunk exactly once
+  // (first send); every later hop forwards the received wire bytes
+  // verbatim, so all members decode identical codes — the reduced
+  // values stay bit-identical across ranks (the determinism oracle).
+  std::vector<std::vector<char>>& stored = scratch_[s].stored;
+  stored.resize(world_size_);
+  {
+    int64_t own_c = (rank_ + 1) % world_size_;
+    auto [o_start, o_len] = chunk_range(count, world_size_, own_c);
+    stored[own_c].resize(sizeof(float) + o_len);
+    q8_encode(data + o_start, o_len, stored[own_c].data());
+    // decode own chunk too: every member must hold the DECODED codes,
+    // not its higher-precision f32 partial (bit-identity across ranks)
+    q8_decode(stored[own_c].data(), o_len, data + o_start, false);
+  }
+  for (int64_t t = 0; t < world_size_ - 1; t++) {
+    int64_t send_c =
+        ((rank_ + 1 - t) % world_size_ + world_size_) % world_size_;
+    int64_t recv_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
+    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+    stored[recv_c].resize(sizeof(float) + r_len);
+    duplex(next_[s], prev_[s], stored[send_c].data(), stored[send_c].size(),
+           stored[recv_c].data(), stored[recv_c].size(), deadline,
+           &scratch_[s].pace);
+    q8_decode(stored[recv_c].data(), r_len, data + r_start, false);
+  }
+}
+
 void HostCollectives::allreduce_q8(float* data, size_t count,
                                    int64_t timeout_ms) {
   std::lock_guard<std::mutex> lock(op_mu_);
@@ -403,47 +662,14 @@ void HostCollectives::allreduce_q8(float* data, size_t count,
     // desync (their wire framings differ even at equal counts)
     check_op_header(4, count, /*dtype=*/100, /*op=*/0, deadline);
     if (count == 0) return;
-    size_t max_chunk = count / world_size_ + 1;
-    size_t max_wire = sizeof(float) + max_chunk;
-    std::vector<char> send_wire(max_wire), recv_wire(max_wire);
-
-    // Reduce-scatter: each hop quantizes its CURRENT partial sum of the
-    // outgoing chunk and dequant-accumulates the incoming one in f32.
-    for (int64_t s = 0; s < world_size_ - 1; s++) {
-      int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-      int64_t recv_c =
-          ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
-      auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-      q8_encode(data + s_start, s_len, send_wire.data());
-      duplex(send_wire.data(), sizeof(float) + s_len, recv_wire.data(),
-             sizeof(float) + r_len, deadline);
-      q8_decode(recv_wire.data(), r_len, data + r_start, /*accumulate=*/true);
-    }
-    // Allgather: the OWNER quantizes its fully-reduced chunk exactly once
-    // (first send); every later hop forwards the received wire bytes
-    // verbatim, so all members decode identical codes — the reduced
-    // values stay bit-identical across ranks (the determinism oracle).
-    std::vector<std::vector<char>> stored(world_size_);
-    {
-      int64_t own_c = (rank_ + 1) % world_size_;
-      auto [o_start, o_len] = chunk_range(count, world_size_, own_c);
-      stored[own_c].resize(sizeof(float) + o_len);
-      q8_encode(data + o_start, o_len, stored[own_c].data());
-      // decode own chunk too: every member must hold the DECODED codes,
-      // not its higher-precision f32 partial (bit-identity across ranks)
-      q8_decode(stored[own_c].data(), o_len, data + o_start, false);
-    }
-    for (int64_t s = 0; s < world_size_ - 1; s++) {
-      int64_t send_c =
-          ((rank_ + 1 - s) % world_size_ + world_size_) % world_size_;
-      int64_t recv_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-      auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-      stored[recv_c].resize(sizeof(float) + r_len);
-      duplex(stored[send_c].data(), stored[send_c].size(),
-             stored[recv_c].data(), stored[recv_c].size(), deadline);
-      q8_decode(stored[recv_c].data(), r_len, data + r_start, false);
-    }
+    // ~1 wire byte per f32 element (int8 codes + per-chunk scales)
+    int64_t eff = effective_stripes(count, stripes_);
+    last_stripe_ns_.assign(eff, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff, s);
+      if (len == 0) return;
+      allreduce_q8_stripe(s, data + start, len, deadline);
+    });
   });
 }
 
@@ -458,13 +684,20 @@ void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
     check_op_header(1, nbytes, 0, 0, deadline);
     if (nbytes == 0) return;
-    for (int64_t s = 0; s < world_size_ - 1; s++) {
-      int64_t send_c = ((rank_ - s) % world_size_ + world_size_) % world_size_;
-      int64_t recv_c =
-          ((rank_ - s - 1) % world_size_ + world_size_) % world_size_;
-      duplex(slots + send_c * nbytes, nbytes, slots + recv_c * nbytes, nbytes,
-             deadline);
-    }
+    int64_t eff = effective_stripes(nbytes, stripes_);
+    last_stripe_ns_.assign(eff, 0);
+    run_striped([&](int64_t st) {
+      auto [off, len] = stripe_range(nbytes, eff, st);
+      if (len == 0) return;
+      for (int64_t t = 0; t < world_size_ - 1; t++) {
+        int64_t send_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
+        int64_t recv_c =
+            ((rank_ - t - 1) % world_size_ + world_size_) % world_size_;
+        duplex(next_[st], prev_[st], slots + send_c * nbytes + off, len,
+               slots + recv_c * nbytes + off, len, deadline,
+               &scratch_[st].pace);
+      }
+    });
   });
 }
 
@@ -479,16 +712,24 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
     check_op_header(2, nbytes, static_cast<uint32_t>(root), 0, deadline);
     if (nbytes == 0) return;
     char* bytes = static_cast<char*>(data);
+    int64_t eff = effective_stripes(nbytes, stripes_);
+    last_stripe_ns_.assign(eff, 0);
     // Forward around the ring, root first; the last hop before root does not
     // send. recv-then-send per hop (latency is fine at control-plane sizes;
     // bulk weight transfer goes through the checkpoint transport instead).
-    if (rank_ == root) {
-      duplex(bytes, nbytes, nullptr, 0, deadline);
-    } else {
-      duplex(nullptr, 0, bytes, nbytes, deadline);
-      if ((rank_ + 1) % world_size_ != root)
-        duplex(bytes, nbytes, nullptr, 0, deadline);
-    }
+    run_striped([&](int64_t st) {
+      auto [off, len] = stripe_range(nbytes, eff, st);
+      if (len == 0) return;
+      if (rank_ == root) {
+        duplex(next_[st], prev_[st], bytes + off, len, nullptr, 0, deadline,
+               &scratch_[st].pace);
+      } else {
+        duplex(next_[st], prev_[st], nullptr, 0, bytes + off, len, deadline);
+        if ((rank_ + 1) % world_size_ != root)
+          duplex(next_[st], prev_[st], bytes + off, len, nullptr, 0,
+                 deadline, &scratch_[st].pace);
+      }
+    });
   });
 }
 
@@ -499,16 +740,16 @@ void HostCollectives::barrier(int64_t timeout_ms) {
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
     check_op_header(3, 0, 0, 0, deadline);
-    // Two full ring passes: after the first, rank 0 knows everyone arrived;
-    // the second releases everyone.
+    // Two full ring passes on stripe 0: after the first, rank 0 knows
+    // everyone arrived; the second releases everyone.
     char token = 1;
     for (int round = 0; round < 2; round++) {
       if (rank_ == 0) {
-        duplex(&token, 1, nullptr, 0, deadline);
-        duplex(nullptr, 0, &token, 1, deadline);
+        duplex(next_[0], prev_[0], &token, 1, nullptr, 0, deadline);
+        duplex(next_[0], prev_[0], nullptr, 0, &token, 1, deadline);
       } else {
-        duplex(nullptr, 0, &token, 1, deadline);
-        duplex(&token, 1, nullptr, 0, deadline);
+        duplex(next_[0], prev_[0], nullptr, 0, &token, 1, deadline);
+        duplex(next_[0], prev_[0], &token, 1, nullptr, 0, deadline);
       }
     }
   });

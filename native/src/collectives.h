@@ -9,21 +9,37 @@
 // process_group.py:551-1064). Intra-group collectives are XLA's job (pjit
 // over the slice mesh); this class only ever spans replica groups.
 //
-// Topology: a ring. configure() rendezvouses through the Store (the caller
-// passes "host:port/prefix" where prefix is unique per quorum, mirroring
-// manager.py:470-477), each rank listens on an ephemeral port, connects to
-// rank+1 and accepts from rank-1. Ring allreduce = reduce-scatter +
-// allgather; each chunk is reduced in the same rank order on every
-// participant, so results are bit-identical across ranks and across runs —
-// the determinism oracle the reference tests demand
+// Topology: a ring, STRIPED over N parallel TCP connections per neighbor
+// edge. configure() rendezvouses through the Store (the caller passes
+// "host:port/prefix" where prefix is unique per quorum, mirroring
+// manager.py:470-477), each rank listens on an ephemeral port, dials rank+1
+// `stripes` times and accepts `stripes` connections from rank-1 (the hello
+// carries the stripe index, so accept order never matters). Every bulk op
+// splits its payload into `stripes` contiguous sub-ranges; stripe s runs the
+// full ring schedule over its own sub-range on its own connection pair, on
+// its own thread. A single TCP connection is window-limited on
+// high-bandwidth-delay paths (the DCN/tunneled links these collectives
+// actually cross), so striping multiplies achievable throughput the way
+// NCCL channels or multi-stream object fetches do.
+//
+// Ring allreduce = reduce-scatter + allgather; within each stripe every
+// chunk is reduced in the same rank order on every participant, and stripe
+// boundaries depend only on (count, stripes, world_size) — all negotiated —
+// so results are bit-identical across ranks and across runs: the
+// determinism oracle the reference tests demand
 // (manager_integ_test.py:279-282).
 #pragma once
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
+#include <functional>
 #include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
+#include <vector>
 
 #include "net.h"
 
@@ -49,6 +65,11 @@ enum class Dtype : int {
 
 size_t dtype_size(Dtype d);
 
+// Upper bound on ring stripes (sockets + threads per neighbor edge); far
+// above the knee of any measured sweep, low enough that a bad config can't
+// fork-bomb the host.
+constexpr int64_t kMaxStripes = 64;
+
 class HostCollectives {
  public:
   HostCollectives() = default;
@@ -58,9 +79,13 @@ class HostCollectives {
   // "host:port/prefix"; the prefix must be unique per quorum — stale members
   // of an old quorum never see the new keys, so they cannot cross-talk
   // (reference manager.py:470-477 store-prefix discipline). Aborts any
-  // in-flight op first.
+  // in-flight op first. `stripes` is the parallel-connection count per
+  // neighbor edge; every member must pass the same value (the hello
+  // handshake rejects mismatches, and the Python layer additionally
+  // negotiates it through the store so mismatched ranks fail fast with a
+  // descriptive error before any socket work).
   void configure(const std::string& store_addr, int64_t rank, int64_t world_size,
-                 int64_t timeout_ms);
+                 int64_t timeout_ms, int64_t stripes = 1);
 
   // In-place ring allreduce over `count` elements of `data`.
   void allreduce(void* data, size_t count, Dtype dtype, ReduceOp op,
@@ -85,25 +110,85 @@ class HostCollectives {
 
   int64_t rank() const { return rank_; }
   int64_t world_size() const { return world_size_; }
+  int64_t stripes() const { return stripes_; }
+
+  // Wall-clock nanoseconds each stripe spent inside the last bulk op
+  // (index = stripe). Written under op_mu_; callers read it from the same
+  // thread that issued the op (the Python executor), so no extra locking.
+  const std::vector<int64_t>& last_stripe_ns() const { return last_stripe_ns_; }
 
   // Wakes any thread blocked inside an op with a SocketError; the instance
   // stays usable via a subsequent configure(). Safe to call from any thread.
   void abort();
 
  private:
-  // Sends send_len bytes to next_ while concurrently receiving recv_len
-  // bytes from prev_ (full-duplex pump; one-directional blocking would
-  // deadlock once kernel buffers fill on a large ring step).
-  void duplex(const char* send_buf, size_t send_len, char* recv_buf,
-              size_t recv_len, int64_t deadline_ms);
+  // Token bucket for per-connection send pacing (TORCHFT_HC_WIRE_CAP_MBPS).
+  // Two uses: QoS — cap the gradient ring's per-connection rate so it
+  // cannot starve heal/checkpoint traffic on a shared NIC — and transport
+  // validation, emulating a per-connection-limited path (TCP window / BDP
+  // cap, tunnel throttling) on loopback so the stripe sweep can measure
+  // aggregation where the real win lives. Pure pacing: no wire-format or
+  // schedule effect, so members need NOT agree on it.
+  struct PaceState {
+    double tokens = 0;  // bytes available to send now
+    std::chrono::steady_clock::time_point last{};
+    bool init = false;
+  };
+
+  // Per-stripe persistent staging (grow-only, reused across ops): per-op
+  // allocation of a world-size chunk — up to payload/world_size bytes —
+  // costs an mmap + demand-zero page faults EVERY op at gradient scale.
+  struct StripeScratch {
+    std::vector<char> recv;           // allreduce recv / q8 recv wire
+    std::vector<char> send;           // q8 send wire
+    std::vector<std::vector<char>> stored;  // q8 phase-2 circulating codes
+    PaceState pace;                   // this connection's send pacing
+  };
+
+  // Sends send_len bytes to next while concurrently receiving recv_len
+  // bytes from prev (full-duplex pump; one-directional blocking would
+  // deadlock once kernel buffers fill on a large ring step). `pace`
+  // (nullable) applies the per-connection send cap; receives are never
+  // paced, and a token-dry sender keeps draining its receive side.
+  void duplex(Socket& next, Socket& prev, const char* send_buf,
+              size_t send_len, char* recv_buf, size_t recv_len,
+              int64_t deadline_ms, PaceState* pace = nullptr);
 
   // Exchanges a tiny (kind, count, dtype, op) header with both neighbors
-  // before a collective and throws on mismatch — a size/dtype-mismatched
-  // op would otherwise deadlock silently once kernel buffers fill.
+  // on stripe 0 before a collective and throws on mismatch — a
+  // size/dtype-mismatched op would otherwise deadlock silently once kernel
+  // buffers fill.
   void check_op_header(uint32_t kind, uint64_t count, uint32_t dtype,
                        uint32_t op, int64_t deadline_ms);
 
-  // Runs an op body; on ANY failure shuts down both ring sockets before
+  // Runs fn(stripe) for every stripe concurrently: stripe 0 on the calling
+  // thread, the rest on PERSISTENT pool workers. The FIRST failing stripe
+  // shuts down every stripe's sockets (waking its siblings within
+  // milliseconds — the same abort-propagation discipline run_op applies
+  // ring-wide), the job is fully drained, and the lowest-stripe error is
+  // rethrown. Also records per-stripe wall time into last_stripe_ns_.
+  void run_striped(const std::function<void(int64_t)>& fn);
+
+  // Grows the stripe worker pool to at least `workers` threads (grow-only;
+  // workers outlive reconfigures and die with the instance). Spawning a
+  // thread per stripe per native op costs ~0.1 ms each under sandboxed
+  // runtimes, and one chunk-pipelined gradient allreduce issues hundreds
+  // of native ring ops — the pool turns each op's fan-out into a condvar
+  // wake. Between jobs workers block on pool_cv_, never inside socket IO,
+  // so abort() needs no extra wakeup path for an idle pool.
+  void ensure_pool(int64_t workers);
+  void pool_main(int64_t idx, int64_t start_gen);
+
+  // Per-stripe ring bodies over an element/byte sub-range.
+  void allreduce_stripe(int64_t s, char* bytes, size_t count, size_t esize,
+                        Dtype dtype, ReduceOp op, int64_t deadline);
+  void allreduce_q8_stripe(int64_t s, float* data, size_t count,
+                           int64_t deadline);
+
+  // Shuts down every ring socket (all stripes); cfg_mu_ must NOT be held.
+  void shutdown_sockets();
+
+  // Runs an op body; on ANY failure shuts down all ring sockets before
   // rethrowing. The FIN propagates the failure around the ring: every
   // member's in-flight op fails within milliseconds instead of blocking on
   // its timeout while a majority of survivors can't reach the next quorum —
@@ -114,13 +199,20 @@ class HostCollectives {
     try {
       fn();
     } catch (...) {
-      std::lock_guard<std::mutex> lock(cfg_mu_);
-      next_.shutdown_rdwr();
-      prev_.shutdown_rdwr();
-      aborted_ = true;
+      {
+        std::lock_guard<std::mutex> lock(cfg_mu_);
+        for (auto& s : next_) s.shutdown_rdwr();
+        for (auto& s : prev_) s.shutdown_rdwr();
+        aborted_ = true;
+      }
       throw;
     }
   }
+
+  // Element range [start, len) of stripe `s` when `count` elements are
+  // split into `n` near-equal contiguous stripes.
+  static std::pair<size_t, size_t> stripe_range(size_t count, int64_t n,
+                                                int64_t s);
 
   // Guards socket object identity (swap/close) against concurrent abort.
   // Never held across blocking IO, so abort() always runs promptly.
@@ -131,13 +223,34 @@ class HostCollectives {
 
   int64_t rank_ = -1;
   int64_t world_size_ = 0;
+  int64_t stripes_ = 1;
+  // Per-connection send cap in bytes/s (0 = unpaced). Snapshotted from
+  // TORCHFT_HC_WIRE_CAP_MBPS at configure() so the knob is stable for the
+  // lifetime of a ring.
+  int64_t wire_cap_bps_ = 0;
   std::unique_ptr<Listener> listener_;
-  Socket next_;
-  Socket prev_;
+  std::vector<Socket> next_;  // one per stripe
+  std::vector<Socket> prev_;  // one per stripe
+  std::vector<StripeScratch> scratch_;     // persistent staging, per stripe
+  std::vector<int64_t> last_stripe_ns_;    // per-stripe time of the last op
   std::atomic<bool> aborted_{true}; // not configured yet
   // Bumped by every abort(); configure() uses it to detect an abort that
   // raced with its (lock-free) rendezvous phase.
   std::atomic<int64_t> abort_epoch_{0};
+
+  // Stripe worker pool state (all under pool_mu_). Worker `idx` runs stripe
+  // `idx + 1` of the current job when that stripe exists (ops can use fewer
+  // effective stripes than configured); stripe 0 always runs on the op
+  // thread. op_mu_ guarantees at most one job is in flight.
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_;       // workers: wait for a new job
+  std::condition_variable pool_done_cv_;  // run_striped: wait for drain
+  const std::function<void(int64_t)>* pool_body_ = nullptr;
+  int64_t pool_gen_ = 0;      // bumped once per job
+  int64_t pool_n_ = 0;        // stripe count of the current job
+  int64_t pool_pending_ = 0;  // participating workers not yet done
+  bool pool_stop_ = false;
+  std::vector<std::thread> pool_;
 };
 
 } // namespace tft

@@ -198,6 +198,19 @@ void set_nonblocking(int fd) {
   fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
+// Large kernel buffers: the bulk ring crosses high-bandwidth-delay paths
+// (DCN, tunneled links) where a default-window TCP connection caps
+// throughput at window/RTT, and on any path a deeper buffer halves the
+// poll/send wakeup count per MB. Must run BEFORE the handshake (before
+// ::connect on the client, on the listening fd for accepted sockets) —
+// the window-scale factor is fixed at SYN from the buffer size then in
+// effect. Best-effort — the kernel clamps to net.core.{r,w}mem_max.
+void set_bulk_buffers(int fd) {
+  int buf = 4 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
 void set_common_opts(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -235,6 +248,7 @@ Listener::Listener(const std::string& bind_addr) {
     }
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    set_bulk_buffers(fd); // accepted sockets inherit; scale is fixed at SYN
     if (ai->ai_family == AF_INET6) {
       int zero = 0; // dual-stack
       setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
@@ -257,15 +271,41 @@ Listener::Listener(const std::string& bind_addr) {
   freeaddrinfo(res);
   if (fd_ < 0)
     throw SocketError("bind " + bind_addr + ": " + strerror(last_errno));
+  int wake[2];
+  if (::pipe(wake) == 0) {
+    for (int wfd : wake) {
+      int flags = fcntl(wfd, F_GETFL, 0);
+      fcntl(wfd, F_SETFL, flags | O_NONBLOCK);
+      fcntl(wfd, F_SETFD, FD_CLOEXEC);
+    }
+    wake_rd_ = wake[0];
+    wake_wr_ = wake[1];
+  }
 }
 
-Listener::~Listener() { close(); }
+Listener::~Listener() {
+  close();
+  // Pipe fds outlive close(): a racing accept() may still be inside poll()
+  // on wake_rd_ for an instant after close() returns, but every caller
+  // joins/serializes its accept threads before destroying the Listener.
+  if (wake_rd_ >= 0) ::close(wake_rd_);
+  if (wake_wr_ >= 0) ::close(wake_wr_);
+}
 
 void Listener::close() {
-  if (fd_ >= 0) {
-    ::shutdown(fd_, SHUT_RDWR);
-    ::close(fd_);
-    fd_ = -1;
+  if (closed_.exchange(true)) return;
+  // Order matters: signal the pipe BEFORE touching the listen fd, so a
+  // thread blocked in poll() wakes via the pipe even though closing the
+  // fd under it would not (Linux<4.5 / gVisor never wake such a poller).
+  if (wake_wr_ >= 0) {
+    char b = 1;
+    [[maybe_unused]] ssize_t rc = ::write(wake_wr_, &b, 1);
+  }
+  int fd = fd_;
+  fd_ = -1;
+  if (fd >= 0) {
+    ::shutdown(fd, SHUT_RDWR);
+    ::close(fd);
   }
 }
 
@@ -275,18 +315,22 @@ Socket Listener::accept(int64_t deadline_ms) {
   while (true) {
     // close() from another thread sets fd_ = -1; poll() would silently skip
     // a negative fd and sleep the whole timeout, so bail out first.
-    if (fd_ < 0) return Socket();
-    struct pollfd pfd;
-    pfd.fd = fd_;
-    pfd.events = POLLIN;
+    if (closed_ || fd_ < 0) return Socket();
+    struct pollfd pfds[2];
+    pfds[0].fd = fd_;
+    pfds[0].events = POLLIN;
+    pfds[1].fd = wake_rd_; // -1 (pipe creation failed) is skipped by poll
+    pfds[1].events = POLLIN;
     int timeout = poll_timeout_or_throw(deadline_ms, "accept timed out");
-    int prc = ::poll(&pfd, 1, timeout);
+    int prc = ::poll(pfds, 2, timeout);
     if (prc == 0) throw TimeoutError("accept timed out");
     if (prc < 0) {
       if (errno == EINTR) continue;
       throw SocketError(std::string("poll: ") + strerror(errno));
     }
-    if (pfd.revents & POLLNVAL) return Socket(); // fd closed under us
+    if (pfds[1].revents & (POLLIN | POLLHUP | POLLERR)) return Socket();
+    if (pfds[0].revents & POLLNVAL) return Socket(); // fd closed under us
+    if (!(pfds[0].revents & (POLLIN | POLLHUP | POLLERR))) continue;
     int fd = ::accept(fd_, nullptr, nullptr);
     if (fd >= 0) {
       set_common_opts(fd);
@@ -327,6 +371,7 @@ Socket connect_once(const Addr& addr, int64_t deadline_ms) {
       last_err = strerror(errno);
       continue;
     }
+    set_bulk_buffers(fd); // before ::connect: window scale is fixed at SYN
     set_nonblocking(fd);
     int crc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
     if (crc != 0 && errno != EINPROGRESS) {

@@ -3,6 +3,7 @@
 // and blocking send/recv helpers with deadlines.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <optional>
 #include <stdexcept>
@@ -89,6 +90,14 @@ class Listener {
  private:
   int fd_ = -1;
   uint16_t port_ = 0;
+  // Self-pipe close() writes to so accept() always wakes: neither
+  // shutdown() nor close() of a LISTENING fd interrupts a sibling thread
+  // already blocked in poll() on it (POSIX leaves it undefined; Linux<4.5
+  // and gVisor both leave the poller asleep forever) — the accept loop
+  // polls the pipe's read end alongside the listen fd instead.
+  int wake_rd_ = -1;
+  int wake_wr_ = -1;
+  std::atomic<bool> closed_{false};
 };
 
 // Single connect attempt with deadline (non-blocking connect + poll).

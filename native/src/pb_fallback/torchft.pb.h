@@ -1,0 +1,765 @@
+// Hand-rolled, proto3-wire-compatible stand-in for the protoc-generated
+// torchft.pb.{h,cc}. The Makefile selects this header (and drops
+// -lprotobuf) when protoc or the libprotobuf headers are missing from the
+// build host; when the real toolchain is present, protoc output is used
+// instead, so the two must stay field-for-field in sync with
+// native/torchft.proto.
+//
+// Wire compatibility notes:
+//  - scalar fields serialize only when non-default (proto3 implicit
+//    presence), `optional` fields serialize whenever set_ was called, and
+//    message fields whenever present — matching protoc's encoder, so
+//    either implementation can parse the other's frames.
+//  - repeated int64 encodes packed (proto3 default) and the parser accepts
+//    both packed and unpacked forms.
+//  - unknown fields are skipped, not preserved (nothing here round-trips
+//    foreign messages).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tft_pb {
+
+inline void put_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<char>(v | 0x80));
+    v >>= 7;
+  }
+  out.push_back(static_cast<char>(v));
+}
+
+inline void put_tag(std::string& out, uint32_t field, uint32_t wire) {
+  put_varint(out, (static_cast<uint64_t>(field) << 3) | wire);
+}
+
+// proto3 implicit presence: default values stay off the wire.
+inline void put_int64(std::string& out, uint32_t field, int64_t v) {
+  if (v == 0) return;
+  put_tag(out, field, 0);
+  put_varint(out, static_cast<uint64_t>(v));
+}
+
+inline void put_int64_always(std::string& out, uint32_t field, int64_t v) {
+  put_tag(out, field, 0);
+  put_varint(out, static_cast<uint64_t>(v));
+}
+
+inline void put_bool(std::string& out, uint32_t field, bool v) {
+  if (!v) return;
+  put_tag(out, field, 0);
+  put_varint(out, 1);
+}
+
+inline void put_str(std::string& out, uint32_t field, const std::string& s) {
+  if (s.empty()) return;
+  put_tag(out, field, 2);
+  put_varint(out, s.size());
+  out += s;
+}
+
+inline void put_len_prefixed(std::string& out, uint32_t field,
+                             const std::string& body) {
+  put_tag(out, field, 2);
+  put_varint(out, body.size());
+  out += body;
+}
+
+inline void put_packed_i64(std::string& out, uint32_t field,
+                           const std::vector<int64_t>& v) {
+  if (v.empty()) return;
+  std::string body;
+  for (int64_t x : v) put_varint(body, static_cast<uint64_t>(x));
+  put_len_prefixed(out, field, body);
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  Reader(const std::string& raw)
+      : p(reinterpret_cast<const uint8_t*>(raw.data())),
+        end(p + raw.size()) {}
+  Reader(const uint8_t* begin, const uint8_t* stop) : p(begin), end(stop) {}
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift >= 64) break;
+    }
+    fail = true;
+    return 0;
+  }
+
+  bool next(uint32_t& field, uint32_t& wire) {
+    if (fail || p >= end) return false;
+    uint64_t tag = varint();
+    if (fail) return false;
+    field = static_cast<uint32_t>(tag >> 3);
+    wire = static_cast<uint32_t>(tag & 7);
+    return field != 0;
+  }
+
+  std::string bytes() {
+    uint64_t n = varint();
+    if (fail || static_cast<uint64_t>(end - p) < n) {
+      fail = true;
+      return {};
+    }
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+
+  void skip(uint32_t wire) {
+    switch (wire) {
+      case 0:
+        varint();
+        return;
+      case 1:
+        if (end - p < 8) fail = true; else p += 8;
+        return;
+      case 2: {
+        uint64_t n = varint();
+        if (fail || static_cast<uint64_t>(end - p) < n) fail = true; else p += n;
+        return;
+      }
+      case 5:
+        if (end - p < 4) fail = true; else p += 4;
+        return;
+      default:
+        fail = true;
+    }
+  }
+
+  // Packed-or-not repeated varint field.
+  void rep_i64(uint32_t wire, std::vector<int64_t>& out) {
+    if (wire == 0) {
+      out.push_back(static_cast<int64_t>(varint()));
+      return;
+    }
+    if (wire != 2) {
+      fail = true;
+      return;
+    }
+    uint64_t n = varint();
+    if (fail || static_cast<uint64_t>(end - p) < n) {
+      fail = true;
+      return;
+    }
+    Reader sub(p, p + n);
+    while (sub.p < sub.end && !sub.fail)
+      out.push_back(static_cast<int64_t>(sub.varint()));
+    fail = fail || sub.fail;
+    p += n;
+  }
+};
+
+}  // namespace tft_pb
+
+namespace torchft_tpu {
+
+#define TFT_PB_COMMON()                                   \
+  std::string SerializeAsString() const {                 \
+    std::string out;                                      \
+    AppendTo(out);                                        \
+    return out;                                           \
+  }                                                       \
+  bool ParseFromString(const std::string& raw) {          \
+    *this = {};                                           \
+    tft_pb::Reader r(raw);                                \
+    uint32_t f, w;                                        \
+    while (r.next(f, w)) {                                \
+      if (!Field(r, f, w)) r.skip(w);                     \
+      if (r.fail) return false;                           \
+    }                                                     \
+    return !r.fail;                                       \
+  }
+
+class QuorumMember {
+ public:
+  const std::string& replica_id() const { return replica_id_; }
+  void set_replica_id(const std::string& v) { replica_id_ = v; }
+  const std::string& address() const { return address_; }
+  void set_address(const std::string& v) { address_ = v; }
+  const std::string& store_address() const { return store_address_; }
+  void set_store_address(const std::string& v) { store_address_ = v; }
+  int64_t step() const { return step_; }
+  void set_step(int64_t v) { step_ = v; }
+  uint64_t world_size() const { return world_size_; }
+  void set_world_size(uint64_t v) { world_size_ = v; }
+  bool shrink_only() const { return shrink_only_; }
+  void set_shrink_only(bool v) { shrink_only_ = v; }
+  bool force_reconfigure() const { return force_reconfigure_; }
+  void set_force_reconfigure(bool v) { force_reconfigure_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, replica_id_);
+    tft_pb::put_str(out, 2, address_);
+    tft_pb::put_str(out, 3, store_address_);
+    tft_pb::put_int64(out, 4, step_);
+    tft_pb::put_int64(out, 5, static_cast<int64_t>(world_size_));
+    tft_pb::put_bool(out, 6, shrink_only_);
+    tft_pb::put_bool(out, 7, force_reconfigure_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { replica_id_ = r.bytes(); return true; } break;
+      case 2: if (w == 2) { address_ = r.bytes(); return true; } break;
+      case 3: if (w == 2) { store_address_ = r.bytes(); return true; } break;
+      case 4: if (w == 0) { step_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 5: if (w == 0) { world_size_ = r.varint(); return true; } break;
+      case 6: if (w == 0) { shrink_only_ = r.varint() != 0; return true; } break;
+      case 7: if (w == 0) { force_reconfigure_ = r.varint() != 0; return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string replica_id_, address_, store_address_;
+  int64_t step_ = 0;
+  uint64_t world_size_ = 0;
+  bool shrink_only_ = false;
+  bool force_reconfigure_ = false;
+};
+
+class Quorum {
+ public:
+  int64_t quorum_id() const { return quorum_id_; }
+  void set_quorum_id(int64_t v) { quorum_id_ = v; }
+  int64_t created_ms() const { return created_ms_; }
+  void set_created_ms(int64_t v) { created_ms_ = v; }
+  const std::vector<QuorumMember>& participants() const { return participants_; }
+  int participants_size() const { return static_cast<int>(participants_.size()); }
+  QuorumMember* add_participants() {
+    participants_.emplace_back();
+    return &participants_.back();
+  }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, quorum_id_);
+    for (const auto& p : participants_)
+      tft_pb::put_len_prefixed(out, 2, p.SerializeAsString());
+    tft_pb::put_int64(out, 3, created_ms_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { quorum_id_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2:
+        if (w == 2) {
+          QuorumMember m;
+          if (!m.ParseFromString(r.bytes())) { r.fail = true; return true; }
+          participants_.push_back(std::move(m));
+          return true;
+        }
+        break;
+      case 3: if (w == 0) { created_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t quorum_id_ = 0;
+  int64_t created_ms_ = 0;
+  std::vector<QuorumMember> participants_;
+};
+
+class LighthouseQuorumRequest {
+ public:
+  bool has_requester() const { return has_requester_; }
+  const QuorumMember& requester() const { return requester_; }
+  QuorumMember* mutable_requester() {
+    has_requester_ = true;
+    return &requester_;
+  }
+  int64_t timeout_ms() const { return timeout_ms_; }
+  void set_timeout_ms(int64_t v) { timeout_ms_ = v; }
+
+  void AppendTo(std::string& out) const {
+    if (has_requester_)
+      tft_pb::put_len_prefixed(out, 1, requester_.SerializeAsString());
+    tft_pb::put_int64(out, 2, timeout_ms_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1:
+        if (w == 2) {
+          has_requester_ = true;
+          if (!requester_.ParseFromString(r.bytes())) r.fail = true;
+          return true;
+        }
+        break;
+      case 2: if (w == 0) { timeout_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  QuorumMember requester_;
+  bool has_requester_ = false;
+  int64_t timeout_ms_ = 0;
+};
+
+class LighthouseQuorumResponse {
+ public:
+  bool has_quorum() const { return has_quorum_; }
+  const Quorum& quorum() const { return quorum_; }
+  Quorum* mutable_quorum() {
+    has_quorum_ = true;
+    return &quorum_;
+  }
+
+  void AppendTo(std::string& out) const {
+    if (has_quorum_)
+      tft_pb::put_len_prefixed(out, 1, quorum_.SerializeAsString());
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 2) {
+      has_quorum_ = true;
+      if (!quorum_.ParseFromString(r.bytes())) r.fail = true;
+      return true;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  Quorum quorum_;
+  bool has_quorum_ = false;
+};
+
+class LighthouseHeartbeatRequest {
+ public:
+  const std::string& replica_id() const { return replica_id_; }
+  void set_replica_id(const std::string& v) { replica_id_ = v; }
+
+  void AppendTo(std::string& out) const { tft_pb::put_str(out, 1, replica_id_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 2) { replica_id_ = r.bytes(); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string replica_id_;
+};
+
+class LighthouseHeartbeatResponse {
+ public:
+  void AppendTo(std::string&) const {}
+  bool Field(tft_pb::Reader&, uint32_t, uint32_t) { return false; }
+  TFT_PB_COMMON()
+};
+
+class ManagerQuorumRequest {
+ public:
+  int64_t rank() const { return rank_; }
+  void set_rank(int64_t v) { rank_ = v; }
+  int64_t step() const { return step_; }
+  void set_step(int64_t v) { step_ = v; }
+  const std::string& checkpoint_metadata() const { return checkpoint_metadata_; }
+  void set_checkpoint_metadata(const std::string& v) { checkpoint_metadata_ = v; }
+  bool shrink_only() const { return shrink_only_; }
+  void set_shrink_only(bool v) { shrink_only_ = v; }
+  int64_t timeout_ms() const { return timeout_ms_; }
+  void set_timeout_ms(int64_t v) { timeout_ms_ = v; }
+  bool force_reconfigure() const { return force_reconfigure_; }
+  void set_force_reconfigure(bool v) { force_reconfigure_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, rank_);
+    tft_pb::put_int64(out, 2, step_);
+    tft_pb::put_str(out, 3, checkpoint_metadata_);
+    tft_pb::put_bool(out, 4, shrink_only_);
+    tft_pb::put_int64(out, 5, timeout_ms_);
+    tft_pb::put_bool(out, 6, force_reconfigure_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { rank_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2: if (w == 0) { step_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 3: if (w == 2) { checkpoint_metadata_ = r.bytes(); return true; } break;
+      case 4: if (w == 0) { shrink_only_ = r.varint() != 0; return true; } break;
+      case 5: if (w == 0) { timeout_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 6: if (w == 0) { force_reconfigure_ = r.varint() != 0; return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t rank_ = 0, step_ = 0, timeout_ms_ = 0;
+  std::string checkpoint_metadata_;
+  bool shrink_only_ = false, force_reconfigure_ = false;
+};
+
+class ManagerQuorumResponse {
+ public:
+  int64_t quorum_id() const { return quorum_id_; }
+  void set_quorum_id(int64_t v) { quorum_id_ = v; }
+  const std::string& recover_src_manager_address() const {
+    return recover_src_manager_address_;
+  }
+  void set_recover_src_manager_address(const std::string& v) {
+    recover_src_manager_address_ = v;
+  }
+  bool has_recover_src_rank() const { return has_recover_src_rank_; }
+  int64_t recover_src_rank() const { return recover_src_rank_; }
+  void set_recover_src_rank(int64_t v) {
+    has_recover_src_rank_ = true;
+    recover_src_rank_ = v;
+  }
+  const std::vector<int64_t>& recover_dst_ranks() const {
+    return recover_dst_ranks_;
+  }
+  void add_recover_dst_ranks(int64_t v) { recover_dst_ranks_.push_back(v); }
+  const std::string& store_address() const { return store_address_; }
+  void set_store_address(const std::string& v) { store_address_ = v; }
+  int64_t max_step() const { return max_step_; }
+  void set_max_step(int64_t v) { max_step_ = v; }
+  bool has_max_rank() const { return has_max_rank_; }
+  int64_t max_rank() const { return max_rank_; }
+  void set_max_rank(int64_t v) {
+    has_max_rank_ = true;
+    max_rank_ = v;
+  }
+  int64_t max_world_size() const { return max_world_size_; }
+  void set_max_world_size(int64_t v) { max_world_size_ = v; }
+  int64_t replica_rank() const { return replica_rank_; }
+  void set_replica_rank(int64_t v) { replica_rank_ = v; }
+  int64_t replica_world_size() const { return replica_world_size_; }
+  void set_replica_world_size(int64_t v) { replica_world_size_ = v; }
+  bool heal() const { return heal_; }
+  void set_heal(bool v) { heal_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, quorum_id_);
+    tft_pb::put_str(out, 2, recover_src_manager_address_);
+    if (has_recover_src_rank_)
+      tft_pb::put_int64_always(out, 3, recover_src_rank_);
+    tft_pb::put_packed_i64(out, 4, recover_dst_ranks_);
+    tft_pb::put_str(out, 5, store_address_);
+    tft_pb::put_int64(out, 6, max_step_);
+    if (has_max_rank_) tft_pb::put_int64_always(out, 7, max_rank_);
+    tft_pb::put_int64(out, 8, max_world_size_);
+    tft_pb::put_int64(out, 9, replica_rank_);
+    tft_pb::put_int64(out, 10, replica_world_size_);
+    tft_pb::put_bool(out, 11, heal_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { quorum_id_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2: if (w == 2) { recover_src_manager_address_ = r.bytes(); return true; } break;
+      case 3:
+        if (w == 0) {
+          has_recover_src_rank_ = true;
+          recover_src_rank_ = static_cast<int64_t>(r.varint());
+          return true;
+        }
+        break;
+      case 4: r.rep_i64(w, recover_dst_ranks_); return true;
+      case 5: if (w == 2) { store_address_ = r.bytes(); return true; } break;
+      case 6: if (w == 0) { max_step_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 7:
+        if (w == 0) {
+          has_max_rank_ = true;
+          max_rank_ = static_cast<int64_t>(r.varint());
+          return true;
+        }
+        break;
+      case 8: if (w == 0) { max_world_size_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 9: if (w == 0) { replica_rank_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 10: if (w == 0) { replica_world_size_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 11: if (w == 0) { heal_ = r.varint() != 0; return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t quorum_id_ = 0, recover_src_rank_ = 0, max_step_ = 0, max_rank_ = 0;
+  int64_t max_world_size_ = 0, replica_rank_ = 0, replica_world_size_ = 0;
+  std::string recover_src_manager_address_, store_address_;
+  std::vector<int64_t> recover_dst_ranks_;
+  bool has_recover_src_rank_ = false, has_max_rank_ = false, heal_ = false;
+};
+
+class CheckpointMetadataRequest {
+ public:
+  int64_t rank() const { return rank_; }
+  void set_rank(int64_t v) { rank_ = v; }
+  int64_t timeout_ms() const { return timeout_ms_; }
+  void set_timeout_ms(int64_t v) { timeout_ms_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, rank_);
+    tft_pb::put_int64(out, 2, timeout_ms_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { rank_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2: if (w == 0) { timeout_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t rank_ = 0, timeout_ms_ = 0;
+};
+
+class CheckpointMetadataResponse {
+ public:
+  const std::string& checkpoint_metadata() const { return checkpoint_metadata_; }
+  void set_checkpoint_metadata(const std::string& v) { checkpoint_metadata_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, checkpoint_metadata_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 2) { checkpoint_metadata_ = r.bytes(); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string checkpoint_metadata_;
+};
+
+class ShouldCommitRequest {
+ public:
+  int64_t rank() const { return rank_; }
+  void set_rank(int64_t v) { rank_ = v; }
+  int64_t step() const { return step_; }
+  void set_step(int64_t v) { step_ = v; }
+  bool should_commit() const { return should_commit_; }
+  void set_should_commit(bool v) { should_commit_ = v; }
+  int64_t timeout_ms() const { return timeout_ms_; }
+  void set_timeout_ms(int64_t v) { timeout_ms_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, rank_);
+    tft_pb::put_int64(out, 2, step_);
+    tft_pb::put_bool(out, 3, should_commit_);
+    tft_pb::put_int64(out, 4, timeout_ms_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { rank_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2: if (w == 0) { step_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 3: if (w == 0) { should_commit_ = r.varint() != 0; return true; } break;
+      case 4: if (w == 0) { timeout_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t rank_ = 0, step_ = 0, timeout_ms_ = 0;
+  bool should_commit_ = false;
+};
+
+class ShouldCommitResponse {
+ public:
+  bool should_commit() const { return should_commit_; }
+  void set_should_commit(bool v) { should_commit_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_bool(out, 1, should_commit_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 0) { should_commit_ = r.varint() != 0; return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  bool should_commit_ = false;
+};
+
+class KillRequest {
+ public:
+  const std::string& msg() const { return msg_; }
+  void set_msg(const std::string& v) { msg_ = v; }
+
+  void AppendTo(std::string& out) const { tft_pb::put_str(out, 1, msg_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 2) { msg_ = r.bytes(); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string msg_;
+};
+
+class KillResponse {
+ public:
+  void AppendTo(std::string&) const {}
+  bool Field(tft_pb::Reader&, uint32_t, uint32_t) { return false; }
+  TFT_PB_COMMON()
+};
+
+class StoreSetRequest {
+ public:
+  const std::string& key() const { return key_; }
+  void set_key(const std::string& v) { key_ = v; }
+  const std::string& value() const { return value_; }
+  void set_value(const std::string& v) { value_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, key_);
+    tft_pb::put_str(out, 2, value_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { key_ = r.bytes(); return true; } break;
+      case 2: if (w == 2) { value_ = r.bytes(); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string key_, value_;
+};
+
+class StoreSetResponse {
+ public:
+  void AppendTo(std::string&) const {}
+  bool Field(tft_pb::Reader&, uint32_t, uint32_t) { return false; }
+  TFT_PB_COMMON()
+};
+
+class StoreGetRequest {
+ public:
+  const std::string& key() const { return key_; }
+  void set_key(const std::string& v) { key_ = v; }
+  int64_t timeout_ms() const { return timeout_ms_; }
+  void set_timeout_ms(int64_t v) { timeout_ms_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, key_);
+    tft_pb::put_int64(out, 2, timeout_ms_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { key_ = r.bytes(); return true; } break;
+      case 2: if (w == 0) { timeout_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string key_;
+  int64_t timeout_ms_ = 0;
+};
+
+class StoreGetResponse {
+ public:
+  const std::string& value() const { return value_; }
+  void set_value(const std::string& v) { value_ = v; }
+
+  void AppendTo(std::string& out) const { tft_pb::put_str(out, 1, value_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 2) { value_ = r.bytes(); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string value_;
+};
+
+class StoreAddRequest {
+ public:
+  const std::string& key() const { return key_; }
+  void set_key(const std::string& v) { key_ = v; }
+  int64_t delta() const { return delta_; }
+  void set_delta(int64_t v) { delta_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, key_);
+    tft_pb::put_int64(out, 2, delta_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { key_ = r.bytes(); return true; } break;
+      case 2: if (w == 0) { delta_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string key_;
+  int64_t delta_ = 0;
+};
+
+class StoreAddResponse {
+ public:
+  int64_t value() const { return value_; }
+  void set_value(int64_t v) { value_ = v; }
+
+  void AppendTo(std::string& out) const { tft_pb::put_int64(out, 1, value_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 0) { value_ = static_cast<int64_t>(r.varint()); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t value_ = 0;
+};
+
+class ErrorResponse {
+ public:
+  enum Code {
+    UNKNOWN = 0,
+    DEADLINE_EXCEEDED = 1,
+    CANCELLED = 2,
+    INVALID_ARGUMENT = 3,
+    NOT_FOUND = 4,
+    UNAVAILABLE = 5,
+    INTERNAL = 6,
+  };
+
+  Code code() const { return code_; }
+  void set_code(Code v) { code_ = v; }
+  const std::string& message() const { return message_; }
+  void set_message(const std::string& v) { message_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, static_cast<int64_t>(code_));
+    tft_pb::put_str(out, 2, message_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1:
+        if (w == 0) { code_ = static_cast<Code>(r.varint()); return true; }
+        break;
+      case 2: if (w == 2) { message_ = r.bytes(); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  Code code_ = UNKNOWN;
+  std::string message_;
+};
+
+#undef TFT_PB_COMMON
+
+}  // namespace torchft_tpu

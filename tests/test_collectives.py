@@ -302,6 +302,150 @@ class TestHostCollectives:
         for c in cols:
             c.shutdown()
 
+    def test_allreduce_q8_nonfinite_poisons_all_members(self, store):
+        # A NaN/Inf leaf entering the quantized wire must come out NaN on
+        # EVERY member: q8_encode ships a NaN scale for a chunk holding any
+        # non-finite value (native/src/collectives.cc), because clamping to
+        # int8 range would otherwise encode a diverged model as healthy
+        # finite codes and hide the blow-up from every peer.
+        import jax.numpy as jnp
+
+        cols = _make_ring(store, 3)
+        rng = np.random.default_rng(11)
+        base = rng.standard_normal(400).astype(np.float32)
+
+        def op(r, c):
+            arr = base * (r + 1)
+            if r == 0:
+                arr = arr.copy()
+                arr[7] = np.nan    # lands in ring chunk 0
+                arr[250] = np.inf  # lands in a different ring chunk
+            return c.allreduce(
+                {"w": jnp.asarray(arr)}, ReduceOp.SUM, wire="q8"
+            ).wait()
+
+        results = _run_all(cols, op)
+        for out in results:
+            got = np.asarray(out["w"])
+            assert np.isnan(got[7]), "NaN leaf must poison its chunk"
+            assert np.isnan(got[250]), "Inf leaf must poison its chunk"
+        # poisoned results stay bit-identical across ranks (NaN included)
+        for other in results[1:]:
+            assert np.asarray(results[0]["w"]).tobytes() == np.asarray(
+                other["w"]
+            ).tobytes()
+        for c in cols:
+            c.shutdown()
+
+    def test_op_schedule_pipeline_bit_identical_across_buckets(self, store):
+        # The CROSS-BUFFER op-schedule pipeline (bucket i+1's d2h streams
+        # while bucket i rides the ring) must be bit-identical to the
+        # non-pipelined path for a mixed-dtype tree, and must record the
+        # per-bucket phase breakdown in pop_op_stats.
+        import jax.numpy as jnp
+
+        import ml_dtypes
+
+        rng = np.random.default_rng(9)
+        base_f32 = rng.standard_normal(5003).astype(np.float32)
+        # bf16-exact values so the analytic cross-path comparison is exact
+        base_bf16 = (rng.integers(-16, 16, 1001) * 0.125).astype(
+            ml_dtypes.bfloat16
+        )
+        base_i32 = rng.integers(-100, 100, 777, dtype=np.int32)
+
+        def tree(r):
+            return {
+                "w": jnp.asarray(base_f32 * (r + 1)),
+                "b": jnp.asarray(base_bf16) * (r + 1),
+                "n": jnp.asarray(base_i32 * (r + 1)),
+            }
+
+        outs = {}
+        for chunks in (1, 4):
+            cols = [
+                HostCollectives(
+                    timeout=timedelta(seconds=10),
+                    pipeline_chunks=chunks,
+                    pipeline_min_bytes=0,  # force the pipeline even when tiny
+                )
+                for _ in range(2)
+            ]
+            addr = f"{store.address()}/sched{chunks}"
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                for f in [
+                    ex.submit(cols[r].configure, addr, r, 2) for r in range(2)
+                ]:
+                    f.result()
+            results = _run_all(cols, lambda r, c: c.allreduce(tree(r)).wait())
+            for k in ("w", "b", "n"):
+                assert np.asarray(results[0][k]).tobytes() == np.asarray(
+                    results[1][k]
+                ).tobytes()
+            if chunks == 4:
+                stats = [
+                    st for st in cols[0].pop_op_stats()
+                    if st["op"] == "allreduce"
+                ]
+                assert stats, "device-packed allreduce must record op stats"
+                buckets = stats[-1]["buckets"]
+                assert len(buckets) == 3  # one per dtype bucket (f32/f64/i32)
+                assert stats[-1]["chunks"] == 3 * 4  # every bucket chunked
+            outs[chunks] = results[0]
+            for c in cols:
+                c.shutdown()
+        for k in ("w", "b", "n"):
+            assert np.asarray(outs[1][k]).tobytes() == np.asarray(
+                outs[4][k]
+            ).tobytes()
+
+    def test_abort_under_striping_wakes_all_stripes(self, store):
+        # Killing a peer mid-op with stripes > 1 must wake EVERY stripe
+        # thread (one surfaced error, within seconds, not one timeout per
+        # stripe), and the instance must reconfigure cleanly afterward.
+        cols = [
+            HostCollectives(timeout=timedelta(seconds=30), stripes=4)
+            for _ in range(2)
+        ]
+        addr = f"{store.address()}/striped"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            for f in [
+                ex.submit(cols[r].configure, addr, r, 2) for r in range(2)
+            ]:
+                f.result()
+        big = np.ones(1 << 20, np.float32)  # 4 MB -> 4 effective stripes
+        w = cols[0].allreduce(big.copy())
+        threading.Timer(0.3, cols[1].shutdown).start()  # peer dies mid-op
+        start = time.monotonic()
+        with pytest.raises(RuntimeError):
+            w.wait(timeout=timedelta(seconds=20))
+        elapsed = time.monotonic() - start
+        assert elapsed < 5.0, (
+            f"striped abort took {elapsed:.1f}s — a stripe thread sat out "
+            "its own timeout instead of being woken"
+        )
+        # A fresh configure against a new partner restores service, and the
+        # op after it runs all 4 stripes (per-stripe timings prove it).
+        fresh = HostCollectives(timeout=timedelta(seconds=30), stripes=4)
+        addr2 = f"{store.address()}/striped2"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [
+                ex.submit(cols[0].configure, addr2, 0, 2),
+                ex.submit(fresh.configure, addr2, 1, 2),
+            ]
+            for f in futs:
+                f.result()
+        pair = [cols[0], fresh]
+        outs = _run_all(
+            pair,
+            lambda r, c: c.allreduce(np.ones(1 << 18, np.float32)).wait(),
+        )
+        for o in outs:
+            np.testing.assert_array_equal(o, np.full(1 << 18, 2.0))
+        assert len(cols[0]._last_stripe_seconds()) == 4
+        for c in pair:
+            c.shutdown()
+
     def test_allgather_device_packed_jax_leaves(self, store):
         # All-jax-leaf trees take the device-packed path (one transfer per
         # exact dtype, byte-preserving): without it a quantized {q, scale}

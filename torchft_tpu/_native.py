@@ -151,6 +151,65 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_void_p),
     ]
+
+    # HostCollectives (the striped TCP ring; consumed by
+    # torchft_tpu.collectives.HostCollectives).
+    lib.tft_hc_create.restype = ctypes.c_void_p
+    lib.tft_hc_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_configure.restype = ctypes.c_int
+    lib.tft_hc_configure.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,  # stripes: parallel ring connections per neighbor
+    ]
+    lib.tft_hc_allreduce.restype = ctypes.c_int
+    lib.tft_hc_allreduce.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_allreduce_q8.restype = ctypes.c_int
+    lib.tft_hc_allreduce_q8.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_allgather.restype = ctypes.c_int
+    lib.tft_hc_allgather.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_broadcast.restype = ctypes.c_int
+    lib.tft_hc_broadcast.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_barrier.restype = ctypes.c_int
+    lib.tft_hc_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tft_hc_abort.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_world_size.restype = ctypes.c_int64
+    lib.tft_hc_world_size.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_stripes.restype = ctypes.c_int64
+    lib.tft_hc_stripes.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_last_stripe_ns.restype = ctypes.c_int64
+    lib.tft_hc_last_stripe_ns.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
     return lib
 
 

@@ -129,6 +129,13 @@ class StoreClient:
     ) -> int: ...
 
 
+# The tft_hc_* HostCollectives entry points (striped TCP ring: create /
+# configure(store_addr, rank, world_size, timeout_ms, stripes) / allreduce /
+# allreduce_q8 / allgather / broadcast / barrier / abort / world_size /
+# stripes / last_stripe_ns) are declared on the loaded CDLL in _load_lib and
+# consumed by torchft_tpu.collectives.HostCollectives, the typed wrapper.
+
+
 def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict: ...
 
 

@@ -17,6 +17,17 @@ reference checkpointing.py:139-170). jax arrays are reconstructed as numpy
 on the receiver; the caller decides device placement/sharding
 (``jax.device_put``) — the transport never touches devices.
 
+Transport striping: by default the receiver fetches the payload as N byte
+ranges over N PARALLEL connections (``TORCHFT_CKPT_STRIPES``, default 4;
+the server serves ``/checkpoint/{step}/part/{i}/{n}`` from a per-step
+pickle cache). A single TCP stream is window-limited on the
+high-bandwidth-delay links heal traffic actually crosses — the same
+bottleneck the collectives ring escapes with striped connections — and
+heal time is dominated by this transfer. Striped mode trades the streamed
+path's bounded memory for bandwidth (one full serialized copy on each
+end); ``stripes=1`` or a pre-striping peer falls back to the streamed
+single-connection path.
+
 Security model: deserialization uses a SAFELISTED unpickler — only CLASSES
 from the scientific-stack modules state dicts are actually made of (numpy,
 optax, jax, collections, ml_dtypes), the two numpy array reconstructors,
@@ -36,15 +47,17 @@ from __future__ import annotations
 
 import io
 import logging
+import os
 import pickle
 import socket
 import threading
 import urllib.error
 import urllib.request
 from abc import ABC, abstractmethod
+from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Generic, List, TypeVar
+from typing import Any, Generic, List, Optional, TypeVar
 
 import numpy as np
 
@@ -235,6 +248,9 @@ class CheckpointServer(CheckpointTransport[T]):
         self._step = -1
         self._timeout = timeout
         self._state_dict: Any = None
+        # One-shot pickle cache backing the striped /part/ endpoint
+        self._serialized: Any = None
+        self._serialized_step = -1
 
         # Gate starts held: nothing readable until the first send_checkpoint.
         self.disallow_checkpoint()
@@ -246,15 +262,25 @@ class CheckpointServer(CheckpointTransport[T]):
 
             def do_GET(self) -> None:
                 try:
+                    prefix = "/checkpoint/"
+                    if not self.path.startswith(prefix):
+                        self.send_error(404, "unknown path")
+                        return
+                    rest = self.path[len(prefix):].split("/")
+                    if len(rest) == 4 and rest[1] == "part":
+                        # striped fetch: /checkpoint/{step}/part/{i}/{n}
+                        self._serve_part(
+                            int(rest[0]), int(rest[2]), int(rest[3])
+                        )
+                        return
+                    if len(rest) != 1:
+                        self.send_error(404, "unknown path")
+                        return
                     with _TimedAcquire(
                         ckpt_server._checkpoint_lock, ckpt_server._timeout
                     ):
                         step = ckpt_server._step
-                        prefix = "/checkpoint/"
-                        if not self.path.startswith(prefix):
-                            self.send_error(404, "unknown path")
-                            return
-                        requested = int(self.path[len(prefix) :])
+                        requested = int(rest[0])
                         if requested != step:
                             self.send_error(
                                 400,
@@ -293,6 +319,49 @@ class CheckpointServer(CheckpointTransport[T]):
                     except Exception:
                         pass
 
+            def _serve_part(self, requested: int, i: int, n: int) -> None:
+                """One byte-range of the serialized checkpoint, for the
+                striped (parallel-connection) fetch. The gate lock is held
+                only to validate the step and build/fetch the serialized
+                cache — NOT while the body streams, or the N part requests
+                would serialize and the parallel fetch would be a no-op.
+                The cache is an immutable bytes object, so a concurrent
+                disallow_checkpoint (which drops the server's reference)
+                cannot mutate an in-flight response."""
+                if n < 1 or not (0 <= i < n):
+                    self.send_error(404, f"bad part {i}/{n}")
+                    return
+                with _TimedAcquire(
+                    ckpt_server._checkpoint_lock, ckpt_server._timeout
+                ):
+                    step = ckpt_server._step
+                    if requested != step:
+                        self.send_error(
+                            400,
+                            f"invalid checkpoint requested: serving {step} "
+                            f"but got {requested}",
+                        )
+                        return
+                    payload = ckpt_server._serialized
+                    if payload is None or ckpt_server._serialized_step != step:
+                        # Serialized exactly once per published step, shared
+                        # by every part of every striped reader. Memory cost
+                        # (one full pickle) is the striped transport's
+                        # bandwidth-for-memory trade; the single-stream
+                        # endpoint above stays allocation-free.
+                        payload = serialize_state_dict(
+                            ckpt_server._state_dict
+                        )
+                        ckpt_server._serialized = payload
+                        ckpt_server._serialized_step = step
+                start = len(payload) * i // n
+                end = len(payload) * (i + 1) // n
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(end - start))
+                self.end_headers()
+                self.wfile.write(payload[start:end])
+
             def log_message(self, format: str, *args: object) -> None:
                 logger.debug(f"checkpoint server: {format % args}")
 
@@ -310,16 +379,80 @@ class CheckpointServer(CheckpointTransport[T]):
         self._thread.start()
 
     @classmethod
-    def load_from_address(cls, address: str, timeout: timedelta) -> T:
+    def load_from_address(
+        cls, address: str, timeout: timedelta, stripes: Optional[int] = None
+    ) -> T:
         """Fetches a checkpoint from a step-qualified URL.
-        Reference checkpointing.py:187-203."""
-        logger.info(f"fetching checkpoint from {address}")
+        Reference checkpointing.py:187-203.
+
+        ``stripes`` > 1 (default: env ``TORCHFT_CKPT_STRIPES``, else 4)
+        fetches the payload as that many byte ranges over PARALLEL HTTP
+        connections — the same window-limit escape the collectives ring
+        uses, and the lever that moves heal-time checkpoint transfer off a
+        single TCP stream's throughput ceiling. Falls back to the
+        single-stream (bounded-memory) fetch against servers without the
+        ``/part/`` endpoint; ``stripes=1`` selects it directly."""
+        if stripes is None:
+            stripes = int(os.environ.get("TORCHFT_CKPT_STRIPES", "4"))
+        stripes = max(1, min(int(stripes), 64))
+        logger.info(f"fetching checkpoint from {address} (stripes={stripes})")
+        if stripes > 1:
+            try:
+                return cls._load_striped(address, timeout, stripes)
+            except urllib.error.HTTPError as e:
+                if e.code not in (404, 500):
+                    raise
+                # 404/500: a pre-striping peer that can't parse the /part/
+                # path — heal must proceed at single-stream speed, not fail
+                logger.warning(
+                    "peer checkpoint server lacks the striped endpoint "
+                    f"(HTTP {e.code}); falling back to single-stream fetch"
+                )
+            except OSError as e:
+                # socket timeout / reset mid-stripe (e.g. the server is
+                # still serializing a large dict under the gate lock). The
+                # streamed path needs no up-front serialize, so the heal
+                # can still succeed there.
+                logger.warning(
+                    f"striped checkpoint fetch failed ({e!r}); "
+                    "falling back to single-stream fetch"
+                )
         with urllib.request.urlopen(
             address, timeout=timeout.total_seconds()
         ) as f:
             # incremental unpickle off the response stream (http.client
             # de-chunks transparently): bounded memory on the receiver too
             return load_state_dict_stream(f)
+
+    @classmethod
+    def _load_striped(cls, address: str, timeout: timedelta, stripes: int) -> T:
+        """Parallel byte-range fetch + one safelisted deserialize. Holds
+        the full serialized payload on the receiver (the striped
+        transport's bandwidth-for-memory trade)."""
+
+        def fetch(i: int) -> bytes:
+            # One retry on 500: the server builds its pickle cache lazily
+            # under the gate lock, so the FIRST part request of a large
+            # checkpoint can hold the lock past the server's lock timeout
+            # and 500 its siblings. By the retry the cache exists and
+            # parts stream immediately — without it, one slow serialize
+            # would kick the whole heal down to single-stream speed.
+            for attempt in (0, 1):
+                try:
+                    with urllib.request.urlopen(
+                        f"{address}/part/{i}/{stripes}",
+                        timeout=timeout.total_seconds(),
+                    ) as f:
+                        return f.read()
+                except urllib.error.HTTPError as e:
+                    if attempt or e.code != 500:
+                        raise
+
+        with ThreadPoolExecutor(
+            max_workers=stripes, thread_name_prefix="ckpt_stripe"
+        ) as ex:
+            parts = list(ex.map(fetch, range(stripes)))
+        return deserialize_state_dict(b"".join(parts))
 
     def address(self) -> str:
         """URL prefix of this server; append the step to fetch."""
@@ -338,6 +471,9 @@ class CheckpointServer(CheckpointTransport[T]):
         if not self._disallowed:
             self._disallowed = True
             self._checkpoint_lock.acquire()
+            # the dict may mutate now; the pickle cache is stale
+            self._serialized = None
+            self._serialized_step = -1
 
     # -- CheckpointTransport --
 
@@ -348,6 +484,8 @@ class CheckpointServer(CheckpointTransport[T]):
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: timedelta
     ) -> None:
         self._state_dict = state_dict
+        self._serialized = None  # new dict, even at an unchanged step
+        self._serialized_step = -1
         self.allow_checkpoint(step)
 
     def recv_checkpoint(

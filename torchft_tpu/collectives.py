@@ -207,57 +207,8 @@ class Collectives(ABC):
         ...
 
 
-def _declare_hc(lib: ctypes.CDLL) -> None:
-    if getattr(lib, "_hc_declared", False):
-        return
-    lib.tft_hc_create.restype = ctypes.c_void_p
-    lib.tft_hc_destroy.argtypes = [ctypes.c_void_p]
-    lib.tft_hc_configure.restype = ctypes.c_int
-    lib.tft_hc_configure.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_char_p,
-        ctypes.c_int64,
-        ctypes.c_int64,
-        ctypes.c_int64,
-    ]
-    lib.tft_hc_allreduce.restype = ctypes.c_int
-    lib.tft_hc_allreduce.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_void_p,
-        ctypes.c_size_t,
-        ctypes.c_int,
-        ctypes.c_int,
-        ctypes.c_int64,
-    ]
-    lib.tft_hc_allreduce_q8.restype = ctypes.c_int
-    lib.tft_hc_allreduce_q8.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_void_p,
-        ctypes.c_size_t,
-        ctypes.c_int64,
-    ]
-    lib.tft_hc_allgather.restype = ctypes.c_int
-    lib.tft_hc_allgather.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_void_p,
-        ctypes.c_void_p,
-        ctypes.c_size_t,
-        ctypes.c_int64,
-    ]
-    lib.tft_hc_broadcast.restype = ctypes.c_int
-    lib.tft_hc_broadcast.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_void_p,
-        ctypes.c_size_t,
-        ctypes.c_int64,
-        ctypes.c_int64,
-    ]
-    lib.tft_hc_barrier.restype = ctypes.c_int
-    lib.tft_hc_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-    lib.tft_hc_abort.argtypes = [ctypes.c_void_p]
-    lib.tft_hc_world_size.restype = ctypes.c_int64
-    lib.tft_hc_world_size.argtypes = [ctypes.c_void_p]
-    lib._hc_declared = True
+# Cap on the per-stripe timing readback; matches tft::kMaxStripes.
+_MAX_STRIPES = 64
 
 
 def _as_numpy(leaf: Any) -> np.ndarray:
@@ -352,20 +303,32 @@ class HostCollectives(Collectives):
         connect_timeout: timedelta = timedelta(seconds=60),
         pipeline_chunks: Optional[int] = None,
         pipeline_min_bytes: int = 4 << 20,
+        stripes: Optional[int] = None,
     ) -> None:
         """``pipeline_chunks`` > 1 splits large device-packed buffers so
         device->host DMA, the TCP ring, and host->device upload overlap
         (chunk i rides the ring while chunk i+1 is still downloading and
-        chunk i-1 re-uploads). Buffers under ``pipeline_min_bytes`` take
-        the single-shot path — per-transfer latency would beat the
-        overlap. Chunk boundaries depend only on size, so results stay
-        bit-identical across ranks and against the unchunked path.
+        chunk i-1 re-uploads — and the pipeline runs ACROSS dtype buckets,
+        not just within one packed buffer). Buffers under
+        ``pipeline_min_bytes`` take the single-shot path — per-transfer
+        latency would beat the overlap. Chunk boundaries depend only on
+        size, so results stay bit-identical across ranks and against the
+        unchunked path.
 
         Default: env ``TORCHFT_HC_PIPELINE_CHUNKS`` (else 4). Set it to 1
         on hosts whose device runtime wedges in-flight transfers under
         overlapping async dispatch (observed on tunneled/proxied device
-        sessions) — every member of a ring must use the same value."""
-        _declare_hc(_lib)
+        sessions) — every member of a ring must use the same value.
+
+        ``stripes`` > 1 spreads every ring op over that many parallel TCP
+        connections per neighbor (contiguous payload sub-ranges, one
+        reducer thread per stripe) — a single TCP connection is
+        window-limited on high-bandwidth-delay links, so striping
+        multiplies achievable cross-group throughput the way NCCL
+        channels do. Default: env ``TORCHFT_HC_STRIPES`` (else 4). Every
+        member of a ring must use the same value; configure() negotiates
+        it through the rendezvous store (exactly like the pipeline knobs)
+        and fails fast on a mismatch."""
         self._handle = _lib.tft_hc_create()
         self._timeout = timeout
         self._connect_timeout = connect_timeout
@@ -375,6 +338,9 @@ class HostCollectives(Collectives):
             )
         self._pipeline_chunks = max(int(pipeline_chunks), 1)
         self._pipeline_min_bytes = int(pipeline_min_bytes)
+        if stripes is None:
+            stripes = int(os.environ.get("TORCHFT_HC_STRIPES", "4"))
+        self._stripes = min(max(int(stripes), 1), _MAX_STRIPES)
         self._world_size = 0
         self._rank = -1
         # One thread: collectives must issue in submission order.
@@ -390,7 +356,18 @@ class HostCollectives(Collectives):
 
     def _record_op_stats(self, stats: dict) -> None:
         self._op_stats.append(stats)
-        del self._op_stats[:-64]  # bounded: diagnostics, not a log
+        # Bounded: diagnostics, not a log. 256 keeps a full per-step
+        # breakdown window alive — at one gradient op + a handful of
+        # control ops per step, 64 silently dropped the early entries
+        # before the caller's median ever saw them.
+        del self._op_stats[:-256]
+
+    def _last_stripe_seconds(self) -> List[float]:
+        """Per-stripe wall times (s) of the last native ring op; safe only
+        on the op-executor thread (which is where all ring calls run)."""
+        buf = (ctypes.c_int64 * _MAX_STRIPES)()
+        n = _lib.tft_hc_last_stripe_ns(self._handle, buf, _MAX_STRIPES)
+        return [buf[i] / 1e9 for i in range(min(n, _MAX_STRIPES))]
 
     def pop_op_stats(self) -> List[dict]:
         """Drains the per-op phase timings (seconds) the device-packed
@@ -401,7 +378,11 @@ class HostCollectives(Collectives):
         and is charged there, not here), plus ``bytes`` = the bytes that
         crossed the DEVICE link (``wire_bytes`` additionally, where the
         TCP wire ships a different encoding — the q8 ring sends ~1/4 of
-        its f32 device payload). The numbers that tell a slow
+        its f32 device payload). Bulk allreduce stats additionally carry
+        ``buckets`` — the per-dtype-bucket phase breakdown of the
+        cross-buffer op schedule, each with ``stripe_s``, the per-stripe
+        ring wall times (a skewed stripe means one of the parallel
+        connections is degraded). The numbers that tell a slow
         collective's transfer cost from its wire cost — per-step DDP on a
         degraded device link is diagnosable only with this split."""
         out, self._op_stats = self._op_stats, []
@@ -426,7 +407,10 @@ class HostCollectives(Collectives):
                 store = _native.StoreClient(
                     hostport, connect_timeout=self._connect_timeout
                 )
-                mine = f"{self._pipeline_chunks}:{self._pipeline_min_bytes}"
+                mine = (
+                    f"{self._pipeline_chunks}:{self._pipeline_min_bytes}"
+                    f":{self._stripes}"
+                )
                 key = f"{prefix}/pipecfg" if prefix else "pipecfg"
                 if rank == 0:
                     store.set(key, mine.encode())
@@ -439,7 +423,7 @@ class HostCollectives(Collectives):
                             f"pipeline config mismatch: rank {rank} has "
                             f"{mine}, rank 0 has {theirs} — all ring members "
                             "must construct HostCollectives with the same "
-                            "pipeline_chunks / pipeline_min_bytes"
+                            "pipeline_chunks / pipeline_min_bytes / stripes"
                         )
             _check(
                 _lib.tft_hc_configure(
@@ -448,6 +432,7 @@ class HostCollectives(Collectives):
                     rank,
                     world_size,
                     _ms(self._connect_timeout),
+                    self._stripes,
                 )
             )
             # Assign on the op thread: ops queued after this configure see
@@ -563,6 +548,7 @@ class HostCollectives(Collectives):
                 timeout_ms,
             )
         )
+        stripe_s = self._last_stripe_seconds()
         if divisor is not None:
             buf /= divisor
         ring_s = time.perf_counter() - t1
@@ -580,6 +566,7 @@ class HostCollectives(Collectives):
                 "wire_bytes": buf.size,
                 "d2h": d2h_s, "ring": ring_s,
                 "h2d": time.perf_counter() - t1 - ring_s,
+                "stripe_s": stripe_s,
             })
             return out
         out_leaves = []
@@ -683,20 +670,95 @@ class HostCollectives(Collectives):
     def _allreduce_device_packed(
         self, leaves, treedef, native_op: int, divisor, timeout_ms: int
     ) -> Any:
-        """All-jax-leaf fast path: pack on device, then (for large buffers)
-        a chunked pipeline where d2h DMA, the TCP ring, and h2d upload all
-        overlap; small buffers take one transfer per dtype group."""
+        """All-jax-leaf fast path: pack on device, then pipeline the WHOLE
+        op schedule — every dtype bucket's chunk DMAs are enqueued up
+        front, so bucket i+1's d2h streams while bucket i rides the ring
+        and bucket i-1's result re-uploads under jax's async dispatch. The
+        old per-buffer pipeline drained between dtype groups; a mixed
+        f32/bf16/int gradient tree paid a full pipeline fill+drain per
+        group."""
+        import jax.numpy as jnp
+
         key = (treedef, tuple((l.shape, np.dtype(l.dtype)) for l in leaves))
         packer = self._packers.get(key)
         if packer is None:
             packer = self._packers[key] = _DevicePacker(leaves)
+        t_pack = time.perf_counter()
         bufs = packer.pack(leaves)
-        dev_bufs = {
-            name: self._ring_reduce_device_buffer(
-                dev, native_op, divisor, timeout_ms
-            )
-            for name, dev in bufs.items()
+        names = sorted(bufs)  # deterministic bucket order = the op schedule
+
+        # Chunk schedule across ALL buckets. Chunk boundaries depend only
+        # on (size, pipeline config), both store-negotiated, so every rank
+        # issues the identical sequence of native ring ops.
+        schedule: List[Tuple[str, Any]] = []
+        for name in names:
+            dev = bufs[name]
+            itemsize = np.dtype(dev.dtype).itemsize
+            k = self._pipeline_chunks
+            if k <= 1 or dev.size * itemsize < self._pipeline_min_bytes:
+                schedule.append((name, dev))
+            else:
+                bounds = [dev.size * i // k for i in range(k + 1)]
+                schedule.extend(
+                    (name, dev[a:b]) for a, b in zip(bounds, bounds[1:])
+                )
+        for _, c in schedule:
+            c.copy_to_host_async()  # queue every DMA before the first block
+        pack_s = time.perf_counter() - t_pack
+
+        out_chunks: dict = {name: [] for name in names}
+        buckets: dict = {
+            name: {"bytes": 0, "d2h": 0.0, "ring": 0.0, "h2d": 0.0,
+                   "stripe_s": [], "stripe_wall": 0.0}
+            for name in names
         }
+        for name, c in schedule:
+            st = buckets[name]
+            t0 = time.perf_counter()
+            arr = np.asarray(c)  # completes when THIS chunk's DMA lands
+            if not arr.flags.writeable or not arr.flags.c_contiguous:
+                arr = np.array(arr)  # ring reduces in place
+            t1 = time.perf_counter()
+            self._ring_chunk(arr, native_op, timeout_ms)
+            stripe_s = self._last_stripe_seconds()
+            if divisor is not None:
+                arr = self._apply_divisor(arr, divisor)
+            t2 = time.perf_counter()
+            # Async dispatch: the upload starts now and overlaps the next
+            # chunk's (possibly next bucket's) ring pass.
+            out_chunks[name].append(jnp.asarray(arr))
+            st["bytes"] += arr.nbytes
+            st["d2h"] += t1 - t0
+            st["ring"] += t2 - t1
+            st["h2d"] += time.perf_counter() - t2
+            # elementwise-sum the per-stripe ring seconds over the
+            # bucket's chunks (chunks can use fewer effective stripes)
+            acc = st["stripe_s"]
+            for i, s in enumerate(stripe_s):
+                if i < len(acc):
+                    acc[i] += s
+                else:
+                    acc.append(s)
+            # pure transport wall: the slowest stripe bounds each chunk's
+            # ring pass; summing per-chunk maxima excludes the peer-skew
+            # wait the `ring` phase absorbs at the op-header sync, so this
+            # is the number a stripe-count sweep compares
+            if stripe_s:
+                st["stripe_wall"] += max(stripe_s)
+        dev_bufs = {
+            name: (chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks))
+            for name, chunks in out_chunks.items()
+        }
+        self._record_op_stats({
+            "op": "allreduce",
+            "bytes": sum(b["bytes"] for b in buckets.values()),
+            "chunks": len(schedule),
+            "pack": pack_s,
+            "d2h": sum(b["d2h"] for b in buckets.values()),
+            "ring": sum(b["ring"] for b in buckets.values()),
+            "h2d": sum(b["h2d"] for b in buckets.values()),
+            "buckets": buckets,
+        })
         return _unflatten(treedef, packer.unpack(dev_bufs))
 
     def _apply_divisor(self, arr: np.ndarray, divisor) -> np.ndarray:
@@ -719,69 +781,6 @@ class HostCollectives(Collectives):
                 timeout_ms,
             )
         )
-
-    def _ring_reduce_device_buffer(
-        self, dev, native_op: int, divisor, timeout_ms: int
-    ):
-        """Reduces one flat device buffer through the ring, pipelined.
-
-        The pipeline (reference analog: DDP bucket overlap intent,
-        torchft/ddp.py:47-71): all chunk DMAs are enqueued up front
-        (``copy_to_host_async``); while chunk i rides the TCP ring, chunks
-        i+1.. are still downloading and reduced chunks re-upload under
-        jax's async dispatch. End-to-end time approaches
-        max(d2h, ring, h2d) + one chunk instead of their sum."""
-        import jax.numpy as jnp
-
-        itemsize = np.dtype(dev.dtype).itemsize
-        n = dev.size
-        k = self._pipeline_chunks
-        if k <= 1 or n * itemsize < self._pipeline_min_bytes:
-            t0 = time.perf_counter()
-            arr = np.asarray(dev)  # one transfer per group
-            if not arr.flags.writeable or not arr.flags.c_contiguous:
-                arr = np.array(arr)  # ring reduces in place
-            t1 = time.perf_counter()
-            self._ring_chunk(arr, native_op, timeout_ms)
-            if divisor is not None:
-                arr = self._apply_divisor(arr, divisor)
-            t2 = time.perf_counter()
-            out = jnp.asarray(arr)
-            self._record_op_stats({
-                "op": "allreduce", "bytes": n * itemsize,
-                "d2h": t1 - t0, "ring": t2 - t1,
-                "h2d": time.perf_counter() - t2,
-            })
-            return out
-
-        bounds = [n * i // k for i in range(k + 1)]
-        chunks = [dev[a:b] for a, b in zip(bounds, bounds[1:])]
-        for c in chunks:
-            c.copy_to_host_async()  # queue every DMA up front
-        out_chunks = []
-        d2h_s = ring_s = h2d_s = 0.0
-        for c in chunks:
-            t0 = time.perf_counter()
-            arr = np.asarray(c)  # completes when THIS chunk's DMA lands
-            if not arr.flags.writeable or not arr.flags.c_contiguous:
-                arr = np.array(arr)
-            t1 = time.perf_counter()
-            self._ring_chunk(arr, native_op, timeout_ms)
-            if divisor is not None:
-                arr = self._apply_divisor(arr, divisor)
-            t2 = time.perf_counter()
-            # Async dispatch: the upload starts now and overlaps the next
-            # chunk's ring pass.
-            out_chunks.append(jnp.asarray(arr))
-            d2h_s += t1 - t0
-            ring_s += t2 - t1
-            h2d_s += time.perf_counter() - t2
-        result = jnp.concatenate(out_chunks)
-        self._record_op_stats({
-            "op": "allreduce", "bytes": n * itemsize, "chunks": k,
-            "d2h": d2h_s, "ring": ring_s, "h2d": h2d_s,
-        })
-        return result
 
     def allgather(self, tree: Any) -> Work:
         timeout_ms = _ms(self._timeout)
@@ -875,6 +874,7 @@ class HostCollectives(Collectives):
             )
         )
         t3 = time.perf_counter()
+        stripe_s = self._last_stripe_seconds()
         results: List[Any] = []
         for r in range(self._world_size):
             offset = r * nbytes
@@ -890,6 +890,7 @@ class HostCollectives(Collectives):
             "op": "allgather", "bytes": nbytes,
             "pack": t1 - t0, "d2h": t2 - t1, "host_copy": t2b - t2,
             "ring": t3 - t2b, "h2d": time.perf_counter() - t3,
+            "stripe_s": stripe_s,
         })
         return results
 
