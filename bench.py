@@ -262,9 +262,14 @@ def _timed_window(run_step, drain, budget_s, max_steps=1 << 30,
     """
 
     def clamp_interval(rate: float) -> int:
-        # ~6 s per drain at the current rate; floor 1 so second-scale
-        # steps (per-step DDP, degraded tunnels) still honor the budget
-        # with at most one burst of overrun.
+        # ~6 s per drain at the current rate. Second-scale steps (per-step
+        # DDP, degraded tunnels) get a PER-STEP clock check: whenever
+        # fewer than 2 steps fit the 6 s drain window the interval is
+        # pinned to 1, so a burst can never overrun the budget by multiple
+        # seconds-scale steps (ADVICE.md round 5; ddp_small passes a
+        # sub-1/3 rate_hint so its first burst takes this path too).
+        if rate * 6.0 < 2.0:
+            return 1
         return max(1, min(512, int(rate * 6.0)))
 
     interval = clamp_interval(rate_hint or 40.0)
@@ -914,7 +919,12 @@ def _bench_ddp_small(raw_hint: float) -> dict:
                     lambda: ddp.step(ddp_batch),
                     lambda: None,  # ddp.step is host-blocking per settle
                     steps_budget_s, max_steps=max_steps,
-                    rate_hint=0.5,  # second-scale steps: clock per step
+                    # Second-scale steps: clock per step. The hint must sit
+                    # below 1/3 step/s so clamp_interval's rate*6 < 2
+                    # special case fires (0.5 used to yield a 3-step burst
+                    # that could overrun the budget by ~2 seconds-scale
+                    # steps).
+                    rate_hint=0.15,
                 )
                 ddp.flush()
                 _barrier(state.params)

@@ -268,6 +268,55 @@ int tft_hc_allreduce_q8(void* handle, float* data, size_t count,
   });
 }
 
+int tft_hc_reduce_scatter(void* handle, void* data, size_t count, int dtype,
+                          int op, void* shard_out, int64_t layout_stripes,
+                          int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->reduce_scatter(
+        data, count, static_cast<Dtype>(dtype), static_cast<ReduceOp>(op),
+        shard_out, layout_stripes, timeout_ms);
+  });
+}
+
+int tft_hc_reduce_scatter_q8(void* handle, float* data, size_t count,
+                             float* shard_out, int grid_shard,
+                             int64_t layout_stripes, int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->reduce_scatter_q8(
+        data, count, shard_out, grid_shard != 0, layout_stripes, timeout_ms);
+  });
+}
+
+int tft_hc_allgather_into(void* handle, const void* shard, void* data,
+                          size_t count, int dtype, int64_t layout_stripes,
+                          int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->allgather_into(
+        shard, data, count, static_cast<Dtype>(dtype), layout_stripes,
+        timeout_ms);
+  });
+}
+
+// Writes up to `cap` (start, len) element pairs of rank `rank`'s shard into
+// `out` (flattened pairs); returns the number of pairs, or -1 on error
+// (tft_last_error set). Pure layout arithmetic once configured.
+int64_t tft_hc_shard_ranges(void* handle, size_t count, size_t esize,
+                            int64_t rank, int64_t layout_stripes, int64_t* out,
+                            int64_t cap) {
+  std::vector<std::pair<size_t, size_t>> ranges;
+  int rc = guarded([&] {
+    ranges = static_cast<HostCollectives*>(handle)->shard_ranges(
+        count, esize, rank, layout_stripes);
+  });
+  if (rc != kOk) return -1;
+  int64_t n = static_cast<int64_t>(ranges.size());
+  for (int64_t i = 0; i < n && i < cap; i++) {
+    out[2 * i] = static_cast<int64_t>(ranges[i].first);
+    out[2 * i + 1] = static_cast<int64_t>(ranges[i].second);
+  }
+  return n;
+}
+
 int tft_hc_allgather(void* handle, const void* in, void* out, size_t nbytes,
                      int64_t timeout_ms) {
   return guarded([&] {

@@ -496,9 +496,9 @@ void HostCollectives::pool_main(int64_t idx, int64_t start_gen) {
   }
 }
 
-void HostCollectives::allreduce_stripe(int64_t s, char* bytes, size_t count,
-                                       size_t esize, Dtype dtype, ReduceOp op,
-                                       int64_t deadline) {
+void HostCollectives::rs_phase_stripe(int64_t s, char* bytes, size_t count,
+                                      size_t esize, Dtype dtype, ReduceOp op,
+                                      int64_t deadline) {
   size_t max_chunk = count / world_size_ + 1;
   std::vector<char>& recv_tmp = scratch_[s].recv;
   if (recv_tmp.size() < max_chunk * esize) recv_tmp.resize(max_chunk * esize);
@@ -517,7 +517,12 @@ void HostCollectives::allreduce_stripe(int64_t s, char* bytes, size_t count,
            recv_tmp.data(), r_len * esize, deadline, &scratch_[s].pace);
     reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
   }
-  // Allgather: circulate the fully-reduced chunks.
+}
+
+void HostCollectives::ag_phase_stripe(int64_t s, char* bytes, size_t count,
+                                      size_t esize, int64_t deadline) {
+  // Allgather: circulate the owned chunks, starting from (rank + 1) —
+  // the chunk the reduce-scatter phase leaves fully reduced here.
   for (int64_t t = 0; t < world_size_ - 1; t++) {
     int64_t send_c =
         ((rank_ + 1 - t) % world_size_ + world_size_) % world_size_;
@@ -528,6 +533,13 @@ void HostCollectives::allreduce_stripe(int64_t s, char* bytes, size_t count,
            bytes + r_start * esize, r_len * esize, deadline,
            &scratch_[s].pace);
   }
+}
+
+void HostCollectives::allreduce_stripe(int64_t s, char* bytes, size_t count,
+                                       size_t esize, Dtype dtype, ReduceOp op,
+                                       int64_t deadline) {
+  rs_phase_stripe(s, bytes, count, esize, dtype, op, deadline);
+  ag_phase_stripe(s, bytes, count, esize, deadline);
 }
 
 void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
@@ -600,8 +612,8 @@ void q8_decode(const char* wire, size_t len, float* dst, bool accumulate) {
 
 }  // namespace
 
-void HostCollectives::allreduce_q8_stripe(int64_t s, float* data, size_t count,
-                                          int64_t deadline) {
+void HostCollectives::rs_q8_phase_stripe(int64_t s, float* data, size_t count,
+                                         int64_t deadline) {
   size_t max_chunk = count / world_size_ + 1;
   size_t max_wire = sizeof(float) + max_chunk;
   std::vector<char>& send_wire = scratch_[s].send;
@@ -623,6 +635,11 @@ void HostCollectives::allreduce_q8_stripe(int64_t s, float* data, size_t count,
            &scratch_[s].pace);
     q8_decode(recv_wire.data(), r_len, data + r_start, /*accumulate=*/true);
   }
+}
+
+void HostCollectives::allreduce_q8_stripe(int64_t s, float* data, size_t count,
+                                          int64_t deadline) {
+  rs_q8_phase_stripe(s, data, count, deadline);
   // Allgather: the OWNER quantizes its fully-reduced chunk exactly once
   // (first send); every later hop forwards the received wire bytes
   // verbatim, so all members decode identical codes — the reduced
@@ -697,6 +714,150 @@ void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
                slots + recv_c * nbytes + off, len, deadline,
                &scratch_[st].pace);
       }
+    });
+  });
+}
+
+std::vector<std::pair<size_t, size_t>> HostCollectives::shard_ranges(
+    size_t count, size_t esize, int64_t r, int64_t layout_stripes) const {
+  if (r < 0 || r >= world_size_) throw SocketError("bad shard rank");
+  int64_t eff = layout_stripes > 0
+                    ? std::min(layout_stripes, stripes_)
+                    : effective_stripes(count * esize, stripes_);
+  int64_t own_c = (r + 1) % world_size_;
+  std::vector<std::pair<size_t, size_t>> out;
+  for (int64_t s = 0; s < eff; s++) {
+    auto [st, sl] = stripe_range(count, eff, s);
+    if (sl == 0) continue;
+    auto [cs, cl] = chunk_range(sl, world_size_, own_c);
+    if (cl) out.emplace_back(st + cs, cl);
+  }
+  return out;
+}
+
+void HostCollectives::copy_shard(char* data, char* shard, size_t count,
+                                 size_t esize, int64_t eff,
+                                 bool to_shard) const {
+  // One source of truth for the layout: walk the same ranges Python gets
+  // from shard_ranges, so compaction can never disagree with them.
+  size_t off = 0;
+  for (auto [start, len] : shard_ranges(count, esize, rank_, eff)) {
+    if (to_shard)
+      memcpy(shard + off * esize, data + start * esize, len * esize);
+    else
+      memcpy(data + start * esize, shard + off * esize, len * esize);
+    off += len;
+  }
+}
+
+void HostCollectives::reduce_scatter(void* data, size_t count, Dtype dtype,
+                                     ReduceOp op, void* shard_out,
+                                     int64_t layout_stripes,
+                                     int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  size_t esize = dtype_size(dtype);
+  if (world_size_ == 1) {
+    memcpy(shard_out, data, count * esize);
+    return;
+  }
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    int64_t eff = layout_stripes > 0
+                      ? std::min(layout_stripes, stripes_)
+                      : effective_stripes(count * esize, stripes_);
+    // The layout rides the header's op slot: a reduce_scatter meeting a
+    // differently-partitioned one must error, not scatter to the wrong
+    // shard boundaries (ReduceOp fits in the low byte).
+    check_op_header(5, count, static_cast<uint32_t>(dtype),
+                    static_cast<uint32_t>(op) |
+                        (static_cast<uint32_t>(eff) << 8),
+                    deadline);
+    if (count == 0) return;
+    char* bytes = static_cast<char*>(data);
+    last_stripe_ns_.assign(eff, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff, s);
+      if (len == 0) return;
+      rs_phase_stripe(s, bytes + start * esize, len, esize, dtype, op,
+                      deadline);
+    });
+    copy_shard(bytes, static_cast<char*>(shard_out), count, esize, eff,
+               /*to_shard=*/true);
+  });
+}
+
+void HostCollectives::reduce_scatter_q8(float* data, size_t count,
+                                        float* shard_out, bool grid_shard,
+                                        int64_t layout_stripes,
+                                        int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  if (world_size_ == 1) {
+    memcpy(shard_out, data, count * sizeof(float));
+    return;
+  }
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // ~1 wire byte per f32 element, like the fused q8 op
+    int64_t eff = layout_stripes > 0
+                      ? std::min(layout_stripes, stripes_)
+                      : effective_stripes(count, stripes_);
+    check_op_header(7, count, /*dtype=*/100,
+                    static_cast<uint32_t>(eff) << 8, deadline);
+    if (count == 0) return;
+    last_stripe_ns_.assign(eff, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff, s);
+      if (len == 0) return;
+      rs_q8_phase_stripe(s, data + start, len, deadline);
+      if (grid_shard) {
+        // Reproduce the fused op's phase-2 owner quantize+decode so the
+        // shard sits on the same int8 grid the fused allreduce returns.
+        int64_t own_c = (rank_ + 1) % world_size_;
+        auto [cs, cl] = chunk_range(len, world_size_, own_c);
+        if (cl) {
+          std::vector<char>& wire = scratch_[s].send;
+          if (wire.size() < sizeof(float) + cl)
+            wire.resize(sizeof(float) + cl);
+          q8_encode(data + start + cs, cl, wire.data());
+          q8_decode(wire.data(), cl, data + start + cs, /*accumulate=*/false);
+        }
+      }
+    });
+    copy_shard(reinterpret_cast<char*>(data),
+               reinterpret_cast<char*>(shard_out), count, sizeof(float), eff,
+               /*to_shard=*/true);
+  });
+}
+
+void HostCollectives::allgather_into(const void* shard, void* data,
+                                     size_t count, Dtype dtype,
+                                     int64_t layout_stripes,
+                                     int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  size_t esize = dtype_size(dtype);
+  if (world_size_ == 1) {
+    memcpy(data, shard, count * esize);
+    return;
+  }
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    int64_t eff = layout_stripes > 0
+                      ? std::min(layout_stripes, stripes_)
+                      : effective_stripes(count * esize, stripes_);
+    check_op_header(6, count, static_cast<uint32_t>(dtype),
+                    static_cast<uint32_t>(eff) << 8, deadline);
+    if (count == 0) return;
+    char* bytes = static_cast<char*>(data);
+    copy_shard(bytes, const_cast<char*>(static_cast<const char*>(shard)),
+               count, esize, eff, /*to_shard=*/false);
+    last_stripe_ns_.assign(eff, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff, s);
+      if (len == 0) return;
+      ag_phase_stripe(s, bytes + start * esize, len, esize, deadline);
     });
   });
 }

@@ -101,6 +101,65 @@ class HostCollectives {
   // requantization of partial sums keeps relative error at the int8
   // quantization class (~1/127 of each chunk's absmax).
   void allreduce_q8(float* data, size_t count, int64_t timeout_ms);
+
+  // ---- sharded (split) collectives ----
+  //
+  // Ring allreduce is reduce-scatter + allgather; these expose the two
+  // phases as first-class ops so a caller can stop at the reduce-scatter
+  // boundary, update only the shard it owns, and allgather the *updated*
+  // values — the weight-update sharding of "Automatic Cross-Replica
+  // Sharding of Weight Update in Data-Parallel Training" (Xu et al.).
+  //
+  // Shard layout: payload striping partitions `count` elements into
+  // `layout_stripes` contiguous sub-ranges (stripe_range); within each
+  // sub-range the ring schedule leaves chunk (rank+1) % world_size fully
+  // reduced at this rank (the same chunk the fused op starts phase 2
+  // from). Rank r's SHARD is the union of those per-stripe owned chunks,
+  // compacted in stripe order. `layout_stripes` <= 0 means "derive from
+  // the payload size like the fused op" (effective_stripes over
+  // count * esize bytes — esize 1 for the q8 wire); a caller composing a
+  // reduce-scatter with a later allgather_into of a DIFFERENT element
+  // size (e.g. q8 reduce, bf16 gather) must pin the same explicit value
+  // on both ops or the two partitions disagree. The layout is pure
+  // arithmetic on (count, layout_stripes, world_size) — identical on
+  // every member — and the per-op header carries it, so a mismatch
+  // errors instead of desyncing.
+
+  // Element (start, len) ranges of rank r's shard for a `count`-element
+  // payload of `esize`-byte elements. Valid after configure().
+  std::vector<std::pair<size_t, size_t>> shard_ranges(
+      size_t count, size_t esize, int64_t r, int64_t layout_stripes = 0) const;
+
+  // Ring reduce-scatter: phase 1 of the fused allreduce (bit-identical
+  // arithmetic order), stopping at the reduce-scatter boundary. `data`
+  // (count elements, clobbered: non-owned regions hold partial sums on
+  // return) is reduced in place; the rank-owned shard is compacted into
+  // `shard_out` (shard_ranges-many elements).
+  void reduce_scatter(void* data, size_t count, Dtype dtype, ReduceOp op,
+                      void* shard_out, int64_t layout_stripes,
+                      int64_t timeout_ms);
+
+  // Quantized-wire reduce-scatter: phase 1 of allreduce_q8 (int8 chunks,
+  // per-hop dequant-accumulate in f32). The owned shard lands in FULL
+  // f32 precision — the fused op's lossy phase-2 owner quantization only
+  // existed to ship the chunk, and here it never ships. `grid_shard`
+  // true applies that owner quantize+decode anyway, reproducing the
+  // fused allreduce_q8's bits exactly (the determinism oracle for
+  // decomposed-vs-fused tests).
+  void reduce_scatter_q8(float* data, size_t count, float* shard_out,
+                         bool grid_shard, int64_t layout_stripes,
+                         int64_t timeout_ms);
+
+  // Ring allgather of per-rank shards into the full buffer: phase 2 of
+  // the fused allreduce. `shard` is this rank's shard (shard_ranges
+  // layout); `data` (count elements) is filled with every rank's shard
+  // at its owned positions. Composing reduce_scatter + allgather_into at
+  // the same (dtype, layout_stripes) is bit-identical to the fused
+  // allreduce on every rank.
+  void allgather_into(const void* shard, void* data, size_t count,
+                      Dtype dtype, int64_t layout_stripes,
+                      int64_t timeout_ms);
+
   // Gathers `nbytes` from every rank into `out` (world_size * nbytes), in
   // rank order.
   void allgather(const void* in, void* out, size_t nbytes, int64_t timeout_ms);
@@ -184,6 +243,21 @@ class HostCollectives {
                         Dtype dtype, ReduceOp op, int64_t deadline);
   void allreduce_q8_stripe(int64_t s, float* data, size_t count,
                            int64_t deadline);
+  // The two phases of the ring schedule, shared verbatim by the fused
+  // allreduce and the first-class reduce_scatter / allgather_into (the
+  // sharing is what makes decomposed-vs-fused bit-identity structural
+  // rather than coincidental).
+  void rs_phase_stripe(int64_t s, char* bytes, size_t count, size_t esize,
+                       Dtype dtype, ReduceOp op, int64_t deadline);
+  void ag_phase_stripe(int64_t s, char* bytes, size_t count, size_t esize,
+                       int64_t deadline);
+  void rs_q8_phase_stripe(int64_t s, float* data, size_t count,
+                          int64_t deadline);
+  // Copies the rank-owned chunk of every stripe between the full buffer
+  // and the compacted shard (to_shard=true: gather out of `data` into
+  // `shard`; false: scatter back).
+  void copy_shard(char* data, char* shard, size_t count, size_t esize,
+                  int64_t eff, bool to_shard) const;
 
   // Shuts down every ring socket (all stripes); cfg_mu_ must NOT be held.
   void shutdown_sockets();

@@ -28,3 +28,35 @@ if REPO_ROOT not in sys.path:
 _LIB = os.path.join(REPO_ROOT, "torchft_tpu", "_libtorchft.so")
 if not os.path.exists(_LIB):
     subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "native")], check=True)
+
+# -- environment capability gates ------------------------------------------
+# Tier-1 runs on heterogeneous boxes; these two capabilities are absent on
+# some of them and their absence is an ENVIRONMENT property, not a code
+# defect — the affected tests skip with a precise reason instead of
+# failing, so an unexpected failure always means a real regression.
+
+# New-style top-level `jax.shard_map` (varying-manual-axes typing, jax
+# >= 0.6). context_parallel / pipeline / flash_attention import it
+# directly; older jax only ships jax.experimental.shard_map, whose typing
+# semantics those modules do not target.
+HAS_SHARD_MAP = hasattr(jax, "shard_map")
+SHARD_MAP_SKIP = (
+    "this jax lacks top-level jax.shard_map (new-style shard_map with "
+    "varying-manual-axes typing) required by the sharded model-parallel "
+    "modules"
+)
+
+# Cross-process collectives on the CPU backend. jaxlib only wires a CPU
+# collectives implementation (gloo, selected via the
+# `jax_cpu_collectives_implementation` config / env) into the CPU client
+# from jax ~0.5 on; older builds raise "Multiprocess computations aren't
+# implemented on the CPU backend" at first cross-process dispatch, so the
+# config's absence is the capability probe.
+HAS_CPU_MULTIPROCESS = hasattr(
+    jax.config, "jax_cpu_collectives_implementation"
+)
+CPU_MULTIPROCESS_SKIP = (
+    "this jax/jaxlib has no CPU multiprocess collectives backend (no "
+    "jax_cpu_collectives_implementation config): cross-process CPU "
+    "computations raise at dispatch"
+)

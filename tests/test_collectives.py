@@ -685,3 +685,262 @@ class TestOpMismatchDetection:
                     f.result(timeout=15)
         for c in cols:
             c.shutdown()
+
+
+class TestShardedCollectives:
+    """First-class reduce_scatter / allgather_into: the decomposed pair
+    must be bit-identical to the fused allreduce (the determinism oracle
+    extended to the sharded-weight-update schedule), the shard layout must
+    tile the payload exactly, and abort must wake every stripe thread."""
+
+    def _make_ring(self, store, world_size, prefix, stripes=1):
+        cols = [
+            HostCollectives(timeout=timedelta(seconds=15), stripes=stripes)
+            for _ in range(world_size)
+        ]
+        addr = f"{store.address()}/{prefix}"
+        with ThreadPoolExecutor(max_workers=world_size) as ex:
+            for f in [
+                ex.submit(cols[r].configure, addr, r, world_size)
+                for r in range(world_size)
+            ]:
+                f.result()
+        return cols
+
+    def _trees(self, world_size, dtype=np.float32):
+        # Uneven leaf sizes: the flat count is NOT divisible by 2, 3, or 5
+        # (ring chunks and stripe sub-ranges both land on uneven
+        # boundaries, exercising the near-equal-chunk padding arithmetic).
+        rng = np.random.RandomState(7)
+        base = {
+            "a": rng.randn(4099).astype(dtype),
+            "b": rng.randn(13, 7).astype(dtype),
+        }
+        return [
+            {k: (v * (r + 1)).copy() for k, v in base.items()}
+            for r in range(world_size)
+        ]
+
+    @pytest.mark.parametrize("world_size", [2, 3, 5])
+    @pytest.mark.parametrize("stripes", [1, 4])
+    def test_bit_identical_to_fused_f32(self, store, world_size, stripes):
+        cols = self._make_ring(
+            store, world_size, f"shf32_{world_size}_{stripes}", stripes
+        )
+        trees = self._trees(world_size)
+        fused = _run_all(
+            cols, lambda r, c: c.allreduce(trees[r], ReduceOp.SUM).wait()
+        )
+
+        def decomposed(r, c):
+            sh = c.reduce_scatter(trees[r], ReduceOp.SUM).wait()
+            return c.allgather_into(sh).wait()
+
+        dec = _run_all(cols, decomposed)
+        for f, d in zip(fused, dec):
+            for k in f:
+                np.testing.assert_array_equal(np.asarray(f[k]), np.asarray(d[k]))
+        for c in cols:
+            c.shutdown()
+
+    @pytest.mark.parametrize("stripes", [1, 4])
+    def test_bit_identical_to_fused_bf16(self, store, stripes):
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        cols = self._make_ring(store, 3, f"shbf_{stripes}", stripes)
+        trees = self._trees(3, dtype=bf16)
+        fused = _run_all(
+            cols, lambda r, c: c.allreduce(trees[r], ReduceOp.SUM).wait()
+        )
+
+        def decomposed(r, c):
+            sh = c.reduce_scatter(trees[r], ReduceOp.SUM).wait()
+            return c.allgather_into(sh).wait()
+
+        dec = _run_all(cols, decomposed)
+        for f, d in zip(fused, dec):
+            for k in f:
+                np.testing.assert_array_equal(
+                    np.asarray(f[k]).view(np.uint16),
+                    np.asarray(d[k]).view(np.uint16),
+                )
+        for c in cols:
+            c.shutdown()
+
+    @pytest.mark.parametrize("world_size", [2, 3])
+    @pytest.mark.parametrize("stripes", [1, 4])
+    def test_bit_identical_to_fused_q8(self, store, world_size, stripes):
+        # grid_shard=True replays the fused op's phase-2 owner
+        # quantize+decode on the owned shard, so RS+AG must reproduce the
+        # fused q8 allreduce bit-for-bit, stripes or not.
+        cols = self._make_ring(
+            store, world_size, f"shq8_{world_size}_{stripes}", stripes
+        )
+        trees = self._trees(world_size)
+        fused = _run_all(
+            cols,
+            lambda r, c: c.allreduce(trees[r], ReduceOp.SUM, wire="q8").wait(),
+        )
+
+        def decomposed(r, c):
+            sh = c.reduce_scatter(
+                trees[r], ReduceOp.SUM, wire="q8", grid_shard=True
+            ).wait()
+            return c.allgather_into(sh).wait()
+
+        dec = _run_all(cols, decomposed)
+        for f, d in zip(fused, dec):
+            for k in f:
+                np.testing.assert_array_equal(np.asarray(f[k]), np.asarray(d[k]))
+        for c in cols:
+            c.shutdown()
+
+    def test_ungridded_q8_shard_beats_fused_loss(self, store):
+        # Production mode (grid_shard=False): the owned shard skips the
+        # lossy phase-2 quantization entirely, so its values must match
+        # the EXACT f32 reduction — strictly better than the fused op.
+        cols = self._make_ring(store, 2, "shq8exact")
+        trees = self._trees(2)
+        exact = _run_all(
+            cols, lambda r, c: c.allreduce(trees[r], ReduceOp.SUM).wait()
+        )
+
+        def rs(r, c):
+            return c.reduce_scatter(trees[r], ReduceOp.SUM, wire="q8").wait()
+
+        shards = _run_all(cols, rs)
+        for r, sh in enumerate(shards):
+            name = next(iter(sh.values))
+            flat_exact = np.concatenate(
+                [np.asarray(exact[r][k]).ravel() for k in ("a", "b")]
+            )
+            got = np.asarray(sh.values[name])
+            want = np.concatenate(
+                [flat_exact[s: s + l] for s, l in sh.ranges[name]]
+            )
+            # q8 wire is lossy in transit (per-hop requant of partials) but
+            # the owned chunk accumulates in f32: error stays at the int8
+            # class of each chunk, far under 1% of the dynamic range here
+            np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+        for c in cols:
+            c.shutdown()
+
+    @pytest.mark.parametrize("world_size", [2, 3, 5])
+    @pytest.mark.parametrize("stripes", [1, 4])
+    def test_shard_ranges_tile_payload(self, store, world_size, stripes):
+        # The per-rank owned ranges must partition [0, count) exactly:
+        # disjoint, complete, and consistent across uneven world sizes and
+        # stripe counts (the padding arithmetic of near-equal chunks).
+        cols = self._make_ring(
+            store, world_size, f"tile_{world_size}_{stripes}", stripes
+        )
+        count, esize = 4099 + 13 * 7, 4
+        from torchft_tpu.collectives import _effective_stripes
+
+        eff = _effective_stripes(count * esize, stripes)
+        cover = np.zeros(count, np.int32)
+        for r in range(world_size):
+            for s, ln in cols[r]._shard_ranges(count, esize, eff):
+                cover[s: s + ln] += 1
+        np.testing.assert_array_equal(cover, np.ones(count, np.int32))
+        for c in cols:
+            c.shutdown()
+
+    def test_bf16_param_wire_bit_identical_across_ranks(self, store):
+        # The sharded outer sync's parameter leg: f32 shards allgathered
+        # over a bf16 wire. Every member (shard owners included) must end
+        # with the identical decoded bf16 words.
+        cols = self._make_ring(store, 3, "bfwire", stripes=2)
+        trees = self._trees(3)
+
+        def sync(r, c):
+            sh = c.reduce_scatter(trees[r], ReduceOp.AVG).wait()
+            return c.allgather_into(sh, wire="bf16").wait()
+
+        outs = _run_all(cols, sync)
+        for o in outs[1:]:
+            for k in o:
+                np.testing.assert_array_equal(
+                    np.asarray(outs[0][k]), np.asarray(o[k])
+                )
+        # and the values are the bf16 rounding of the exact average
+        import ml_dtypes
+
+        exact = _run_all(
+            cols, lambda r, c: c.allreduce(trees[r], ReduceOp.AVG).wait()
+        )
+        for k in exact[0]:
+            want = (
+                np.asarray(exact[0][k])
+                .astype(ml_dtypes.bfloat16)
+                .astype(np.float32)
+            )
+            np.testing.assert_allclose(
+                np.asarray(outs[0][k]), want, rtol=1e-6, atol=1e-6
+            )
+        for c in cols:
+            c.shutdown()
+
+    def test_world_size_one_roundtrip(self):
+        col = HostCollectives()
+        col.configure("ignored", 0, 1)
+        tree = {"w": np.arange(10, dtype=np.float32)}
+        sh = col.reduce_scatter(tree, ReduceOp.AVG).wait()
+        name = next(iter(sh.values))
+        assert sh.counts[name] == 10 and sh.ranges[name] == [(0, 10)]
+        out = col.allgather_into(sh).wait()
+        np.testing.assert_array_equal(out["w"], tree["w"])
+        col.shutdown()
+
+    def test_abort_under_reduce_scatter_wakes_all_stripes(self, store):
+        # Mirror of test_abort_under_striping_wakes_all_stripes for the
+        # split op: peer death mid-reduce-scatter must wake every stripe
+        # thread promptly, and a fresh configure restores service.
+        cols = [
+            HostCollectives(timeout=timedelta(seconds=30), stripes=4)
+            for _ in range(2)
+        ]
+        addr = f"{store.address()}/rs_striped"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            for f in [
+                ex.submit(cols[r].configure, addr, r, 2) for r in range(2)
+            ]:
+                f.result()
+        big = {"g": np.ones(1 << 20, np.float32)}  # 4 MB -> 4 stripes
+        w = cols[0].reduce_scatter(big)
+        threading.Timer(0.3, cols[1].shutdown).start()
+        start = time.monotonic()
+        with pytest.raises(RuntimeError):
+            w.wait(timeout=timedelta(seconds=20))
+        elapsed = time.monotonic() - start
+        assert elapsed < 5.0, (
+            f"striped reduce_scatter abort took {elapsed:.1f}s — a stripe "
+            "thread sat out its own timeout instead of being woken"
+        )
+        fresh = HostCollectives(timeout=timedelta(seconds=30), stripes=4)
+        addr2 = f"{store.address()}/rs_striped2"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            for f in [
+                ex.submit(cols[0].configure, addr2, 0, 2),
+                ex.submit(fresh.configure, addr2, 1, 2),
+            ]:
+                f.result()
+        pair = [cols[0], fresh]
+
+        def roundtrip(r, c):
+            sh = c.reduce_scatter({"g": np.ones(1 << 18, np.float32)}).wait()
+            return c.allgather_into(sh).wait()
+
+        outs = _run_all(pair, roundtrip)
+        for o in outs:
+            np.testing.assert_array_equal(o["g"], np.full(1 << 18, 2.0))
+        for c in pair:
+            c.shutdown()
+
+    def test_dummy_roundtrip(self):
+        d = DummyCollectives()
+        tree = {"w": np.arange(6, dtype=np.float32)}
+        sh = d.reduce_scatter(tree, ReduceOp.SUM, divisor=2.0).wait()
+        out = d.allgather_into(sh).wait()
+        np.testing.assert_allclose(out["w"], tree["w"] / 2.0)

@@ -579,3 +579,112 @@ class TestMinReplicaVote:
         assert not m.should_commit()
         assert client.should_commit.call_args.args[2] is False
         m.shutdown()
+
+
+class FailingShardedCollectives(DummyCollectives):
+    """reduce_scatter / allgather_into fail (immediately or async)."""
+
+    def __init__(self, immediate: bool, fail_op: str = "reduce_scatter",
+                 **kwargs) -> None:
+        super().__init__(**kwargs)
+        self._immediate = immediate
+        self._fail_op = fail_op
+
+    def _fail(self) -> Work:
+        if self._immediate:
+            raise RuntimeError("injected immediate failure")
+        f: Future = Future()
+        f.set_exception(RuntimeError("injected async failure"))
+        return Work(f)
+
+    def reduce_scatter(self, tree, op=ReduceOp.SUM, divisor=None, wire=None):
+        self.op_count += 1
+        if self._fail_op == "reduce_scatter":
+            return self._fail()
+        return super().reduce_scatter(tree, op, divisor=divisor, wire=wire)
+
+    def allgather_into(self, shard, wire=None):
+        self.op_count += 1
+        if self._fail_op == "allgather_into":
+            return self._fail()
+        return super().allgather_into(shard, wire=wire)
+
+
+class TestShardedManagedDispatch:
+    """Manager.reduce_scatter / allgather_into: the managed error
+    discipline (latch, resolve to the None failure default, discard the
+    step through the commit vote) extended to the sharded split ops."""
+
+    @pytest.mark.parametrize("immediate", [True, False])
+    @pytest.mark.parametrize("fail_op", ["reduce_scatter", "allgather_into"])
+    def test_failure_latches_and_resolves_none(
+        self, store, immediate, fail_op
+    ):
+        col = FailingShardedCollectives(immediate=immediate, fail_op=fail_op)
+        m, client, _, _ = _create_manager(store, collectives=col)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = False
+        m.start_quorum()
+        grads = {"g": np.ones(4, np.float32)}
+        if fail_op == "reduce_scatter":
+            out = m.reduce_scatter(grads).wait()
+        else:
+            shard = m.reduce_scatter(grads).wait()
+            assert shard is not None
+            out = m.allgather_into(shard).wait()
+        assert out is None  # failure default: no meaningful partial shard
+        assert m.errored() is not None
+        assert not m.should_commit()  # step discarded, not half-applied
+        assert m.current_step() == 0
+        m.shutdown()
+
+    def test_happy_path_roundtrip(self, store):
+        m, client, col, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = True
+        m.start_quorum()
+        grads = {"g": np.full(4, 6.0, np.float32)}
+        shard = m.reduce_scatter(grads).wait()  # AVG over 2 participants
+        assert shard is not None
+        np.testing.assert_allclose(
+            np.asarray(next(iter(shard.values.values()))), np.full(4, 3.0)
+        )
+        out = m.allgather_into(shard).wait()
+        np.testing.assert_allclose(out["g"], np.full(4, 3.0))
+        assert m.errored() is None
+        assert m.should_commit()
+        m.shutdown()
+
+    def test_allgather_into_does_not_zero_non_participants(self, store):
+        # A healing/spare member's param shard is replicated state, not a
+        # contribution: zeroing it would corrupt every member's gathered
+        # params. The dispatch must pass the shard through untouched.
+        m, client, col, _ = _create_manager(store)
+        # max_rank=None => this replica is not participating
+        client.quorum.return_value = _quorum_result(max_rank=None)
+        client.should_commit.return_value = True
+        m.start_quorum()
+        assert not m.is_participating()
+        shard = col.reduce_scatter({"g": np.full(4, 8.0, np.float32)}).wait()
+        out = m.allgather_into(shard).wait()
+        np.testing.assert_allclose(out["g"], np.full(4, 8.0))
+        m.shutdown()
+
+    def test_quorum_id_accessor(self, store):
+        m, client, _, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result(quorum_id=7)
+        m.start_quorum()
+        assert m.quorum_id() == 7
+        m.shutdown()
+
+
+def test_reduce_scatter_bad_op_raises_eagerly(store):
+    # A static usage error must raise at the call site, not be latched as
+    # a cohort data-plane failure.
+    m, client, _, _ = _create_manager(store)
+    client.quorum.return_value = _quorum_result()
+    m.start_quorum()
+    with pytest.raises(ValueError, match="unsupported managed"):
+        m.reduce_scatter({"g": np.ones(2, np.float32)}, op=ReduceOp.MAX)
+    assert m.errored() is None
+    m.shutdown()

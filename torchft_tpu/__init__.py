@@ -20,6 +20,7 @@ from torchft_tpu.collectives import (
     DummyCollectives,
     HostCollectives,
     ReduceOp,
+    TreeShard,
     Work,
 )
 from torchft_tpu.data import DistributedSampler, StatefulDataLoader
@@ -61,6 +62,7 @@ __all__ = [
     "StatefulDataLoader",
     "Store",
     "StoreClient",
+    "TreeShard",
     "Work",
     "WorldSizeMode",
     "XLACollectives",

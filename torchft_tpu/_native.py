@@ -189,6 +189,50 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_size_t,
         ctypes.c_int64,
     ]
+    # Sharded (split) collectives: the two phases of the ring allreduce as
+    # first-class ops, plus the shard-layout query (consumed by
+    # torchft_tpu.collectives for the sharded outer sync).
+    lib.tft_hc_reduce_scatter.restype = ctypes.c_int
+    lib.tft_hc_reduce_scatter.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_void_p,  # shard_out
+        ctypes.c_int64,   # layout_stripes (<=0: auto from payload bytes)
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_reduce_scatter_q8.restype = ctypes.c_int
+    lib.tft_hc_reduce_scatter_q8.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_void_p,  # shard_out
+        ctypes.c_int,     # grid_shard: reproduce fused q8 bits exactly
+        ctypes.c_int64,   # layout_stripes
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_allgather_into.restype = ctypes.c_int
+    lib.tft_hc_allgather_into.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,  # shard (this rank's)
+        ctypes.c_void_p,  # full output buffer
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_int64,   # layout_stripes
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_shard_ranges.restype = ctypes.c_int64
+    lib.tft_hc_shard_ranges.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+        ctypes.c_int64,   # rank
+        ctypes.c_int64,   # layout_stripes
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
     lib.tft_hc_broadcast.restype = ctypes.c_int
     lib.tft_hc_broadcast.argtypes = [
         ctypes.c_void_p,

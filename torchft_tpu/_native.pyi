@@ -132,8 +132,13 @@ class StoreClient:
 # The tft_hc_* HostCollectives entry points (striped TCP ring: create /
 # configure(store_addr, rank, world_size, timeout_ms, stripes) / allreduce /
 # allreduce_q8 / allgather / broadcast / barrier / abort / world_size /
-# stripes / last_stripe_ns) are declared on the loaded CDLL in _load_lib and
-# consumed by torchft_tpu.collectives.HostCollectives, the typed wrapper.
+# stripes / last_stripe_ns, plus the sharded split ops
+# reduce_scatter(data, count, dtype, op, shard_out, layout_stripes) /
+# reduce_scatter_q8(data, count, shard_out, grid_shard, layout_stripes) /
+# allgather_into(shard, data, count, dtype, layout_stripes) /
+# shard_ranges(count, esize, rank, layout_stripes)) are declared on the
+# loaded CDLL in _load_lib and consumed by
+# torchft_tpu.collectives.HostCollectives, the typed wrapper.
 
 
 def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict: ...

@@ -29,9 +29,10 @@ import threading
 import time
 from abc import ABC, abstractmethod
 from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, replace
 from datetime import timedelta
 from enum import IntEnum
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -143,6 +144,54 @@ def _unflatten(treedef: Any, leaves: Sequence[Any]) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+@dataclass
+class TreeShard:
+    """This rank's shard of a flat-packed pytree, the unit the sharded
+    (split) collectives trade in.
+
+    ``reduce_scatter`` returns one; ``allgather_into`` consumes one. The
+    pytree is packed into one contiguous flat buffer per accumulation-dtype
+    group (the same grouping the fused allreduce uses, or a single f32
+    group on the q8 wire), and the shard is the union of the per-stripe
+    ring chunks this rank owns, compacted in stripe order. ``values`` is
+    what a caller updates in place of the full tree (the weight-update
+    sharding of PAPERS.md #1: outer-optimizer state and FLOPs scale with
+    the shard, not the model); everything else is layout bookkeeping that
+    must ride along unchanged so ``allgather_into`` can scatter the
+    updated shard back to the identical wire schedule on every member.
+    """
+
+    # group name -> this rank's flat shard (jax or numpy array)
+    values: Dict[str, Any]
+    # group name -> total flat elements of the group's full buffer
+    counts: Dict[str, int]
+    # group name -> [(start, len)] element ranges this rank owns, in
+    # compaction order (global positions within the group's flat buffer)
+    ranges: Dict[str, List[Tuple[int, int]]]
+    # group name -> the stripe partition pinned for this sync; an
+    # allgather_into of a DIFFERENT wire dtype must reuse it or the two
+    # ops would partition the payload differently (see native
+    # collectives.h shard-layout contract)
+    layout: Dict[str, int]
+    # group name -> numpy dtype of the group's packed buffer
+    dtypes: Dict[str, Any]
+    # group name -> leaf indices packed into that group (sig order)
+    groups: Dict[str, List[int]]
+    treedef: Any
+    sig: Any
+    rank: int
+    world_size: int
+    # packer used for the device-side pack/unpack (None on the host path)
+    packer: Any = None
+    # host path only: which leaves were jax arrays on input
+    was_jax: Any = None
+
+    def replace_values(self, values: Dict[str, Any]) -> "TreeShard":
+        """Same shard layout, new per-group values (e.g. the updated
+        parameter shard after an outer-optimizer step)."""
+        return replace(self, values=values)
+
+
 class Collectives(ABC):
     """Reconfigurable collectives over replica groups.
 
@@ -179,6 +228,44 @@ class Collectives(ABC):
         feedback should treat the RETURNED tree as what was shipped.
         Implementations without a quantized wire may raise for it."""
 
+    # Sharded split ops: not abstract — backends whose transport has no
+    # reduce-scatter boundary to expose (XLA's in-program psum is already
+    # bandwidth-optimal in-chip) keep working; callers feature-detect by
+    # catching NotImplementedError.
+    def reduce_scatter(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Reduces a pytree but stops at the reduce-scatter boundary: the
+        result is a :class:`TreeShard` holding only the ~1/world_size of
+        the flat-packed reduction this rank owns. Composing it with
+        :meth:`allgather_into` at the same wire dtype is bit-identical to
+        :meth:`allreduce`; updating the shard BEFORE the allgather is the
+        sharded-weight-update schedule (PAPERS.md #1) that skips the
+        redundant full-tree return traffic. ``divisor``/``op``/``wire``
+        as in :meth:`allreduce` (``wire="q8"`` reduces a single f32 group
+        over the quantized ring; the returned shard is full f32 — the
+        fused op's lossy phase-2 quantization never happens)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no sharded split ops"
+        )
+
+    def allgather_into(
+        self, shard: "TreeShard", wire: Optional[str] = None
+    ) -> Work:
+        """Gathers every rank's (possibly updated) :class:`TreeShard` back
+        into the full pytree — phase 2 of the ring, run on current values.
+        ``wire="bf16"`` ships f32 groups as bfloat16 (half the bytes; all
+        members decode identical bf16 words, so results stay bit-identical
+        across ranks). All ranks must pass shards from the same logical
+        reduce_scatter (same layout)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no sharded split ops"
+        )
+
     @abstractmethod
     def allgather(self, tree: Any) -> Work:
         """Gathers each rank's pytree; result is a list of pytrees in rank
@@ -209,6 +296,19 @@ class Collectives(ABC):
 
 # Cap on the per-stripe timing readback; matches tft::kMaxStripes.
 _MAX_STRIPES = 64
+
+# Mirrors native kMinStripeBytes / effective_stripes (collectives.cc): the
+# payload-derived stripe partition. Python computes it so a sharded sync
+# can PIN one partition across a q8 reduce-scatter (1 wire byte/element)
+# and a bf16 parameter allgather (2 bytes/element) — left to the native
+# auto-derivation, the two ops would partition the payload differently and
+# the shard would scatter to the wrong chunk boundaries. The
+# decomposed-vs-fused bit-identity tests pin this mirror against native.
+_MIN_STRIPE_BYTES = 64 << 10
+
+
+def _effective_stripes(payload_bytes: int, configured: int) -> int:
+    return max(1, min(configured, max(1, payload_bytes // _MIN_STRIPE_BYTES)))
 
 
 def _as_numpy(leaf: Any) -> np.ndarray:
@@ -894,6 +994,295 @@ class HostCollectives(Collectives):
         })
         return results
 
+    # -- sharded (split) ops --
+
+    def _shard_ranges(
+        self, count: int, esize: int, eff: int
+    ) -> List[Tuple[int, int]]:
+        """(start, len) element ranges this rank owns of a count-element
+        group at the pinned stripe partition (native layout arithmetic)."""
+        if self._world_size == 1:
+            return [(0, count)]
+        buf = (ctypes.c_int64 * (2 * _MAX_STRIPES))()
+        n = _lib.tft_hc_shard_ranges(
+            self._handle, count, esize, self._rank, eff, buf, _MAX_STRIPES
+        )
+        if n < 0:
+            _check(2)
+        return [(buf[2 * i], buf[2 * i + 1]) for i in range(n)]
+
+    def reduce_scatter(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+        grid_shard: bool = False,
+    ) -> Work:
+        """``grid_shard`` (q8 wire only) applies the fused op's phase-2
+        owner quantize+decode to the owned shard, so reduce_scatter +
+        allgather_into reproduces ``allreduce(wire='q8')`` bit-for-bit —
+        the determinism oracle for decomposed-vs-fused tests. Production
+        callers leave it False: the shard never rides the lossy phase-2
+        wire, so it keeps full f32 precision for free."""
+        timeout_ms = _ms(self._timeout)
+        if wire not in (None, "q8"):
+            raise ValueError(f"unsupported wire: {wire!r}")
+        if grid_shard and wire != "q8":
+            raise ValueError("grid_shard only applies to wire='q8'")
+        if op == ReduceOp.AVG:
+            divisor, op = float(self._world_size), ReduceOp.SUM
+        if op != ReduceOp.SUM and (divisor is not None or wire == "q8"):
+            raise ValueError(
+                "divisor / wire='q8' compose with ReduceOp.SUM/AVG only"
+            )
+        return self._submit(
+            lambda: self._reduce_scatter_sync(tree, op, divisor, wire,
+                                              grid_shard, timeout_ms)
+        )
+
+    def _reduce_scatter_sync(
+        self,
+        tree: Any,
+        op: ReduceOp,
+        divisor: Optional[float],
+        wire: Optional[str],
+        grid_shard: bool,
+        timeout_ms: int,
+    ) -> TreeShard:
+        """Phase 1 of the ring only: the full tree crosses d2h ONCE, the
+        ring reduces it in place, and only the ~1/world_size owned shard
+        re-uploads — the return leg and everything downstream of it scale
+        with the shard, not the model."""
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            raise ValueError("reduce_scatter of an empty tree")
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        all_jax = all(_is_jax_array(l) for l in leaves)
+        native_op = int(op)
+
+        t0 = time.perf_counter()
+        if all_jax:
+            key = ("rsq8" if wire == "q8" else "rs", treedef, sig)
+            packer = self._packers.get(key)
+            if packer is None:
+                packer = self._packers[key] = _DevicePacker(
+                    leaves, force_f32=(wire == "q8")
+                )
+            bufs = packer.pack(leaves)
+            names = sorted(bufs)
+            for name in names:  # queue every DMA before blocking on one
+                bufs[name].copy_to_host_async()
+            host = {}
+            for name in names:
+                arr = np.asarray(bufs[name])
+                if not arr.flags.writeable or not arr.flags.c_contiguous:
+                    arr = np.array(arr)  # ring reduces in place
+                host[name] = arr
+            groups = {str(acc): idxs for acc, idxs in packer.groups.items()}
+            was_jax = None
+        else:
+            packer = None
+            arrays = [_as_numpy(l) for l in leaves]
+            was_jax = [_is_jax_array(l) for l in leaves]
+            groups = {}
+            for i, a in enumerate(arrays):
+                if wire == "q8":
+                    acc = np.dtype(np.float32)
+                else:
+                    acc = (a.dtype if a.dtype in _NATIVE_DTYPES
+                           else np.dtype(np.float32))
+                groups.setdefault(str(acc), []).append(i)
+            host = {
+                name: np.concatenate(
+                    [arrays[i].astype(np.dtype(name), copy=False).ravel()
+                     for i in idxs]
+                )
+                for name, idxs in groups.items()
+            }
+            names = sorted(host)
+        d2h_s = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        values: Dict[str, Any] = {}
+        counts: Dict[str, int] = {}
+        ranges: Dict[str, List[Tuple[int, int]]] = {}
+        layout: Dict[str, int] = {}
+        dtypes: Dict[str, Any] = {}
+        stripe_s: List[float] = []
+        for name in names:
+            buf = host[name]
+            count = buf.size
+            esize = 1 if wire == "q8" else buf.itemsize
+            eff = _effective_stripes(count * esize, self._stripes)
+            counts[name] = count
+            layout[name] = eff
+            dtypes[name] = buf.dtype
+            rng = self._shard_ranges(count, esize, eff)
+            ranges[name] = rng
+            shard = np.empty(sum(l for _, l in rng), dtype=buf.dtype)
+            if self._world_size == 1:
+                shard[:] = buf
+            elif wire == "q8":
+                _check(
+                    _lib.tft_hc_reduce_scatter_q8(
+                        self._handle,
+                        buf.ctypes.data_as(ctypes.c_void_p),
+                        count,
+                        shard.ctypes.data_as(ctypes.c_void_p),
+                        1 if grid_shard else 0,
+                        eff,
+                        timeout_ms,
+                    )
+                )
+            else:
+                _check(
+                    _lib.tft_hc_reduce_scatter(
+                        self._handle,
+                        buf.ctypes.data_as(ctypes.c_void_p),
+                        count,
+                        _NATIVE_DTYPES[buf.dtype],
+                        native_op,
+                        shard.ctypes.data_as(ctypes.c_void_p),
+                        eff,
+                        timeout_ms,
+                    )
+                )
+            if self._world_size > 1:
+                stripe_s.extend(self._last_stripe_seconds())
+            if divisor is not None and divisor != 1:
+                shard = self._apply_divisor(shard, divisor)
+            values[name] = shard
+        ring_s = time.perf_counter() - t1
+
+        t2 = time.perf_counter()
+        if all_jax:
+            import jax.numpy as jnp
+
+            values = {name: jnp.asarray(v) for name, v in values.items()}
+        self._record_op_stats({
+            "op": "reduce_scatter",
+            "bytes": sum(host[n].nbytes for n in names),
+            "shard_bytes": sum(
+                np.asarray(v).nbytes for v in values.values()
+            ),
+            "wire_bytes": sum(
+                counts[n] * (1 if wire == "q8" else host[n].itemsize)
+                for n in names
+            ),
+            "d2h": d2h_s, "ring": ring_s,
+            "h2d": time.perf_counter() - t2,
+            "stripe_s": stripe_s,
+        })
+        return TreeShard(
+            values=values, counts=counts, ranges=ranges, layout=layout,
+            dtypes=dtypes, groups=groups, treedef=treedef, sig=sig,
+            rank=self._rank, world_size=self._world_size, packer=packer,
+            was_jax=was_jax,
+        )
+
+    def allgather_into(
+        self, shard: TreeShard, wire: Optional[str] = None
+    ) -> Work:
+        timeout_ms = _ms(self._timeout)
+        if wire not in (None, "bf16"):
+            raise ValueError(f"unsupported wire: {wire!r}")
+        return self._submit(
+            lambda: self._allgather_into_sync(shard, wire, timeout_ms)
+        )
+
+    def _allgather_into_sync(
+        self, shard: TreeShard, wire: Optional[str], timeout_ms: int
+    ) -> Any:
+        """Phase 2 of the ring on CURRENT shard values: each member ships
+        its (updated) shard, every member ends with the identical full
+        tree. ``wire="bf16"`` rounds f32 groups to bfloat16 on the wire —
+        half the bytes; every member (including the owner) adopts the
+        decoded bf16 words, so the gathered tree is still bit-identical
+        across ranks."""
+        t0 = time.perf_counter()
+        out_bufs: Dict[str, np.ndarray] = {}
+        stripe_s: List[float] = []
+        wire_bytes = 0
+        for name in sorted(shard.counts):
+            count = shard.counts[name]
+            gdtype = np.dtype(shard.dtypes[name])
+            eff = shard.layout[name]
+            vals = np.ascontiguousarray(np.asarray(shard.values[name]))
+            if vals.dtype != gdtype:
+                vals = vals.astype(gdtype)
+            expected = sum(l for _, l in shard.ranges[name])
+            if vals.size != expected:
+                raise ValueError(
+                    f"shard group {name!r} has {vals.size} elements, layout "
+                    f"expects {expected} — pass the TreeShard from "
+                    "reduce_scatter (values replaced, layout intact)"
+                )
+            wdtype = gdtype
+            if wire == "bf16":
+                if gdtype == np.dtype(np.float32):
+                    wdtype = _BF16
+                elif gdtype != _BF16:
+                    raise ValueError(
+                        "wire='bf16' applies to f32/bf16 groups only"
+                    )
+            wvals = np.ascontiguousarray(vals.astype(wdtype, copy=False))
+            full = np.empty(count, dtype=wdtype)
+            if self._world_size == 1:
+                full[:] = wvals
+            else:
+                _check(
+                    _lib.tft_hc_allgather_into(
+                        self._handle,
+                        wvals.ctypes.data_as(ctypes.c_void_p),
+                        full.ctypes.data_as(ctypes.c_void_p),
+                        count,
+                        _NATIVE_DTYPES[np.dtype(wdtype)],
+                        eff,
+                        timeout_ms,
+                    )
+                )
+                stripe_s.extend(self._last_stripe_seconds())
+            wire_bytes += count * np.dtype(wdtype).itemsize
+            if np.dtype(wdtype) != gdtype:
+                full = full.astype(gdtype)
+            out_bufs[name] = full
+        ring_s = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        if shard.packer is not None:
+            import jax.numpy as jnp
+
+            dev = {name: jnp.asarray(b) for name, b in out_bufs.items()}
+            out = _unflatten(shard.treedef, shard.packer.unpack(dev))
+        else:
+            out_leaves: List[Any] = [None] * len(shard.sig)
+            for name, idxs in shard.groups.items():
+                buf = out_bufs[name]
+                off = 0
+                for i in idxs:
+                    shape, dt = shard.sig[i]
+                    n = int(np.prod(shape)) if shape else 1
+                    leaf = buf[off:off + n].reshape(shape).astype(
+                        dt, copy=False
+                    )
+                    off += n
+                    if shard.was_jax is not None and shard.was_jax[i]:
+                        import jax.numpy as jnp
+
+                        leaf = jnp.asarray(leaf)
+                    out_leaves[i] = leaf
+            out = _unflatten(shard.treedef, out_leaves)
+        self._record_op_stats({
+            "op": "allgather_into",
+            "bytes": sum(b.nbytes for b in out_bufs.values()),
+            "wire_bytes": wire_bytes,
+            "ring": ring_s,
+            "h2d": time.perf_counter() - t1,
+            "stripe_s": stripe_s,
+        })
+        return out
+
     def broadcast(self, tree: Any, root: int = 0) -> Work:
         timeout_ms = _ms(self._timeout)
         return self._submit(lambda: self._broadcast_sync(tree, root, timeout_ms))
@@ -968,6 +1357,55 @@ class DummyCollectives(Collectives):
                 lambda l: _divide_leaf(l, divisor), tree
             )
         return _completed(tree)
+
+    def reduce_scatter(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Lossless fake: the 'shard' is the whole flat-packed tree (the
+        world-size-1 shard layout), so reduce_scatter → update →
+        allgather_into round-trips exactly."""
+        self.op_count += 1
+        leaves, treedef = _flatten(tree)
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        flat = np.concatenate(
+            [np.asarray(l).astype(np.float32, copy=False).ravel()
+             for l in leaves]
+        ) if leaves else np.zeros((0,), np.float32)
+        if divisor is not None and divisor != 1:
+            flat = flat / divisor
+        name = str(np.dtype(np.float32))
+        return _completed(TreeShard(
+            values={name: flat},
+            counts={name: flat.size},
+            ranges={name: [(0, flat.size)]},
+            layout={name: 1},
+            dtypes={name: np.dtype(np.float32)},
+            groups={name: list(range(len(leaves)))},
+            treedef=treedef, sig=sig,
+            rank=self._rank, world_size=self._world_size,
+        ))
+
+    def allgather_into(
+        self, shard: TreeShard, wire: Optional[str] = None
+    ) -> Work:
+        self.op_count += 1
+        name = str(np.dtype(np.float32))
+        buf = np.asarray(shard.values[name])
+        if wire == "bf16":
+            buf = buf.astype(_BF16).astype(np.float32)
+        out_leaves = []
+        off = 0
+        for shape, dt in shard.sig:
+            n = int(np.prod(shape)) if shape else 1
+            out_leaves.append(
+                buf[off:off + n].reshape(shape).astype(dt, copy=False)
+            )
+            off += n
+        return _completed(_unflatten(shard.treedef, out_leaves))
 
     def allgather(self, tree: Any) -> Work:
         self.op_count += 1

@@ -29,7 +29,7 @@ follows the paper; the reference snapshot computes ``p.data - backup``
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -38,6 +38,12 @@ from .manager import Manager
 from .train_state import FTTrainState, _to_device_tree
 
 logger: logging.Logger = logging.getLogger(__name__)
+
+
+def _tree_leaves(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
 
 
 _copy_jit: Any = None
@@ -168,7 +174,34 @@ class DiLoCo(LocalSGD):
 
     Requires sync quorum (``use_async_quorum=False``) so a recovering
     replica restores the checkpoint before its first inner step (reference
-    :195-199)."""
+    :195-199).
+
+    ``sharded=True`` replaces the outer sync's "full allreduce + W
+    redundant outer updates" with the weight-update-sharded schedule of
+    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training" (PAPERS.md #1): reduce-scatter the pseudogradient (stop the
+    collective at the reduce-scatter boundary), run the outer optimizer on
+    the ~1/W shard this replica owns, then allgather the *updated
+    parameters*. One logical sync, outer-optimizer FLOPs/memory shrunk ~W×
+    (the Nesterov momentum is sharded across the cohort), and the h2d
+    return leg of the reduction carries 1/W of the model. On a membership
+    change (join/leave/heal — detected via the manager's quorum id) the
+    sharded outer state is re-partitioned: every member scatters its old
+    shard into a full-size buffer, the cohort allgathers them, and each
+    member slices its new shard; slices owned by a departed replica
+    restart cold (zeros — one window of momentum, self-healing).
+    Constraints: the outer optimizer must be ELEMENTWISE (SGD/Nesterov —
+    the standard DiLoCo outer — is; a global-norm-clipping chain is not,
+    it would see per-shard norms), and master params should be f32.
+
+    ``shard_wire="q8"`` ships the reduce-scatter over the int8-quantized
+    ring wire with device-side error feedback (the quantization residual
+    joins the next window's delta); the averaged shard still lands in
+    full f32 — the fused q8 op's lossy allgather phase never runs.
+    ``param_wire="bf16"`` rounds the parameter allgather to bfloat16
+    (half its bytes; every member — including each shard's owner — adopts
+    the decoded bf16 words, so params stay bit-identical across the
+    cohort)."""
 
     def __init__(
         self,
@@ -176,28 +209,93 @@ class DiLoCo(LocalSGD):
         state: FTTrainState,
         outer_tx: Any,
         sync_every: int,
+        sharded: bool = False,
+        shard_wire: Optional[str] = None,
+        param_wire: Optional[str] = None,
     ) -> None:
         if manager._use_async_quorum:
             raise ValueError(
                 "DiLoCo requires synchronous quorum: construct the Manager "
                 "with use_async_quorum=False"
             )
+        if shard_wire not in (None, "q8"):
+            raise ValueError(f"unsupported shard_wire: {shard_wire!r}")
+        if param_wire not in (None, "bf16"):
+            raise ValueError(f"unsupported param_wire: {param_wire!r}")
+        if (shard_wire or param_wire) and not sharded:
+            raise ValueError("shard_wire/param_wire require sharded=True")
+        if sharded:
+            # The shard must pack into ONE flat group: the outer-state
+            # re-partition after a membership change identifies shard-
+            # shaped state leaves by size, which is only unambiguous for
+            # a single group. Mixed-dtype masters would split into
+            # per-dtype groups and stall the first post-change sync, so
+            # reject them at construction, not mid-run.
+            bad = {
+                str(np.dtype(l.dtype))
+                for l in _tree_leaves(state.params)
+                if np.dtype(l.dtype) != np.dtype(np.float32)
+            }
+            if bad:
+                raise ValueError(
+                    "sharded DiLoCo requires f32 master params (found "
+                    f"{sorted(bad)}); keep masters in f32 and use "
+                    "shard_wire/param_wire for wire compression"
+                )
         super().__init__(manager, state, sync_every)
         self._outer_tx = outer_tx
-        self._outer_state = outer_tx.init(state.params)
+        self._sharded = sharded
+        self._shard_wire = shard_wire
+        self._param_wire = param_wire
+        if sharded:
+            # Outer state is built lazily at the first sync, over the shard
+            # this replica owns under the quorum's partition (unknowable
+            # before the first quorum forms).
+            self._outer_state: Any = None
+            self._outer_shard_meta: Optional[Dict[str, Any]] = None
+        else:
+            self._outer_state = outer_tx.init(state.params)
+            self._outer_shard_meta = None
+        self._shard_residual: Any = None  # q8 wire error-feedback carry
+        self._quant_fn: Any = None
+        self._slice_fns: Dict[Any, Any] = {}
 
     def state_dict(self) -> Dict[str, Any]:
         sd = super().state_dict()
         sd["outer_state"] = self._outer_state
+        if self._sharded:
+            sd["outer_shard_meta"] = self._outer_shard_meta
         return sd
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         super().load_state_dict(sd)
-        self._outer_state = _to_device_tree(sd["outer_state"])
+        self._outer_state = (
+            _to_device_tree(sd["outer_state"])
+            if sd["outer_state"] is not None
+            else None
+        )
+        if self._sharded:
+            # The restored shard is the SOURCE replica's (a heal copies the
+            # peer's state verbatim); keep its meta so the next re-shard
+            # scatters it at the right positions, and force a re-partition
+            # by voiding the quorum id — this replica's join bumped it
+            # anyway.
+            meta = sd.get("outer_shard_meta")
+            if meta is not None:
+                meta = dict(meta, quorum_id=-1)
+            self._outer_shard_meta = meta
+        # Error-feedback carry is trajectory-local: after a heal/restore
+        # the replica is on another trajectory's params, so a stale
+        # residual would inject a fraction of a discarded correction.
+        self._shard_residual = None
 
     def _perform_sync(self) -> None:
-        """Average pseudogradients, outer-step from the restored global
-        params on commit (reference local_sgd.py:205-225)."""
+        """Sharded: RS → outer step on the owned shard → param allgather.
+        Unsharded: average pseudogradients, outer-step from the restored
+        global params on commit (reference local_sgd.py:205-225)."""
+        if self._sharded:
+            self._perform_sync_sharded()
+            return
         import jax
         import optax
 
@@ -222,6 +320,220 @@ class DiLoCo(LocalSGD):
                 self._state.params, updates
             )
             self._save_parameters()
+
+    # -- sharded outer sync --
+
+    def _perform_sync_sharded(self) -> None:
+        """reduce-scatter(Δ) → outer step on the owned shard → allgather
+        the updated params. All three legs ride the manager's error
+        discipline: any failure latches, the commit vote fails, and every
+        member rolls the window back — committed-or-discarded, same as the
+        fused path."""
+        import jax
+        import optax
+
+        old_global = _to_device_tree(self._backup_params)
+        if self._shard_wire == "q8":
+            ship, new_residual = self._quantized_delta(old_global)
+        else:
+            ship = jax.tree_util.tree_map(
+                lambda old, new: old - new, old_global, self._state.params
+            )
+            new_residual = None
+        rs_work = self._manager.reduce_scatter(
+            ship, op=ReduceOp.AVG, wire=self._shard_wire
+        )
+
+        # Restore to the last global state while the ring runs (copy:
+        # inner steps donate params buffers, old_global aliases the
+        # backup).
+        self._state.params = _detached_copy(old_global)
+
+        shard = rs_work.wait()  # TreeShard | None (failure default)
+        gathered = None
+        new_outer = None
+        new_meta = None
+        if shard is not None:
+            try:
+                qid = self._manager.quorum_id()
+                outer_state = self._outer_state_for(shard, qid, old_global)
+                g_shard = self._slice_params(old_global, shard)
+                updates, new_outer = self._outer_tx.update(
+                    shard.values, outer_state, g_shard
+                )
+                new_vals = optax.apply_updates(g_shard, updates)
+                gathered = self._manager.allgather_into(
+                    shard.replace_values(new_vals), wire=self._param_wire
+                ).wait()
+                new_meta = {
+                    "quorum_id": qid,
+                    "counts": dict(shard.counts),
+                    "ranges": {k: list(v) for k, v in shard.ranges.items()},
+                }
+            except Exception as e:  # noqa: BLE001 - latch, vote, roll back
+                logger.exception("sharded outer step failed: %s", e)
+                self._manager.report_error(e)
+                gathered = None
+
+        if self._manager.should_commit() and gathered is not None:
+            self._state.params = _to_device_tree(gathered)
+            self._outer_state = new_outer
+            self._outer_shard_meta = new_meta
+            if new_residual is not None:
+                self._shard_residual = new_residual
+            self._save_parameters()
+        # abort: params already restored; outer state, its meta, and the
+        # error-feedback carry keep their pre-window values.
+
+    def _quantized_delta(self, old_global: Any) -> Any:
+        """Δ = B − θ with int8-grid error feedback: the residual of the
+        grid rounding joins the next window's delta, so wire quantization
+        error never accumulates (the carry is committed only when the
+        window commits)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._shard_residual is None:
+            self._shard_residual = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, jnp.float32),
+                self._state.params,
+            )
+        if self._quant_fn is None:
+            from .quantize import quantize_with_feedback
+
+            def quant_fn(old, new, residual):
+                delta = jax.tree_util.tree_map(lambda o, n: o - n, old, new)
+                return quantize_with_feedback(delta, residual)
+
+            self._quant_fn = jax.jit(quant_fn)
+        out = self._quant_fn(
+            old_global, self._state.params, self._shard_residual
+        )
+        # Ship the leaf-gridded f32 delta: EF accounts for this grid; the
+        # ring's per-hop requantization noise stays at the int8 class.
+        return out["dq"], out["res"]
+
+    def _outer_state_for(self, shard: Any, qid: int, old_global: Any) -> Any:
+        """The outer-optimizer state matching ``shard``'s partition:
+        reused when the quorum (and so the partition) is unchanged,
+        initialized fresh at the first sync, re-partitioned through a
+        cohort allgather after a membership change."""
+        meta = self._outer_shard_meta
+        if (
+            self._outer_state is not None
+            and meta is not None
+            and meta["quorum_id"] == qid
+            and meta["counts"] == shard.counts
+            and {k: list(v) for k, v in shard.ranges.items()}
+            == {k: list(v) for k, v in meta["ranges"].items()}
+        ):
+            return self._outer_state
+        if self._outer_state is None:
+            # First sync of a fresh run: init over the owned param shard.
+            return self._outer_tx.init(self._slice_params(old_global, shard))
+        return self._reshard_outer_state(shard)
+
+    def _slice_params(self, tree: Any, shard: Any) -> Dict[str, Any]:
+        """Packs ``tree`` into the shard's flat layout and slices this
+        rank's owned ranges — on device for jax trees (the full params
+        never cross to host for this), host-side otherwise."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        all_jax = leaves and all(
+            isinstance(l, jax.Array) for l in leaves
+        )
+        out: Dict[str, Any] = {}
+        for name in sorted(shard.counts):
+            rng = tuple(tuple(r) for r in shard.ranges[name])
+            if all_jax and shard.packer is not None:
+                key = (name, rng)
+                fn = self._slice_fns.get(key)
+                if fn is None:
+                    import jax.numpy as jnp
+
+                    packer = shard.packer
+
+                    def slice_fn(ls, _name=name, _rng=rng, _packer=packer):
+                        flat = _packer.pack(ls)[_name]
+                        return jnp.concatenate(
+                            [flat[s: s + l] for s, l in _rng]
+                        )
+
+                    fn = self._slice_fns[key] = jax.jit(slice_fn)
+                out[name] = fn(leaves)
+            else:
+                idxs = shard.groups[name]
+                flat = np.concatenate(
+                    [
+                        np.asarray(leaves[i])
+                        .astype(np.dtype(shard.dtypes[name]), copy=False)
+                        .ravel()
+                        for i in idxs
+                    ]
+                )
+                out[name] = np.concatenate(
+                    [flat[s: s + l] for s, l in rng]
+                ) if len(rng) != 1 or rng[0] != (0, flat.size) else flat
+        return out
+
+    def _reshard_outer_state(self, shard: Any) -> Any:
+        """Re-partitions the sharded outer state after a membership
+        change: every member scatters its OLD shard of each param-shaped
+        state leaf into a full-size (vals, mask) pair, the cohort
+        allgathers them, and this member slices its NEW ranges out of the
+        first-owner-wins merge. Positions no surviving member owned (a
+        departed replica took its shard with it) restart at zero — a
+        one-window momentum cold start on 1/W_old of the model."""
+        import jax
+
+        meta = self._outer_shard_meta
+        assert meta is not None
+        (name,) = list(shard.counts)  # sharded mode packs ONE f32 group
+        count = shard.counts[name]
+        old_ranges = [tuple(r) for r in meta["ranges"][name]]
+        old_len = sum(l for _, l in old_ranges)
+
+        state_leaves, state_def = jax.tree_util.tree_flatten(
+            self._outer_state
+        )
+        shard_like = [
+            i
+            for i, l in enumerate(state_leaves)
+            if getattr(l, "ndim", None) == 1 and l.size == old_len
+        ]
+        mask = np.zeros(count, np.uint8)
+        scattered = []
+        for s, ln in old_ranges:
+            mask[s: s + ln] = 1
+        for i in shard_like:
+            arr = np.asarray(state_leaves[i]).astype(np.float32)
+            full = np.zeros(count, np.float32)
+            off = 0
+            for s, ln in old_ranges:
+                full[s: s + ln] = arr[off: off + ln]
+                off += ln
+            scattered.append(full)
+        payload = {"m": mask, "v": scattered}
+        members = self._manager.allgather(payload).wait()
+
+        import jax.numpy as jnp
+
+        new_leaves = list(state_leaves)
+        for j, i in enumerate(shard_like):
+            acc = np.zeros(count, np.float32)
+            seen = np.zeros(count, bool)
+            for m in members:
+                mm = np.asarray(m["m"]).astype(bool)
+                take = mm & ~seen
+                if take.any():
+                    acc[take] = np.asarray(m["v"][j], dtype=np.float32)[take]
+                    seen |= take
+            new_shard = np.concatenate(
+                [acc[s: s + ln] for s, ln in shard.ranges[name]]
+            )
+            new_leaves[i] = jnp.asarray(new_shard)
+        return jax.tree_util.tree_unflatten(state_def, new_leaves)
 
 
 class AsyncDiLoCo(DiLoCo):

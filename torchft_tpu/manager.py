@@ -438,6 +438,63 @@ class Manager:
 
         return self._managed_dispatch("allreduce", tree, dispatch, lambda t: t)
 
+    def reduce_scatter(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.AVG,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Fault-tolerantly reduces a pytree but stops at the
+        reduce-scatter boundary: the Work resolves to this rank's
+        :class:`~torchft_tpu.collectives.TreeShard` of the averaged
+        flat-packed tree (the sharded-weight-update schedule — update the
+        shard, then :meth:`allgather_into` the result). Same error
+        contract as :meth:`allreduce` except the failure default is
+        ``None`` — there is no meaningful "as contributed" shard, so a
+        mid-sync failure resolves to ``None``, the error latches, and
+        ``should_commit`` discards the step; callers must treat a ``None``
+        shard as an aborted sync, never as data. ``op`` must be AVG or
+        SUM; ``wire="q8"`` reduces over the quantized ring (the returned
+        shard is full f32 — the fused op's lossy allgather phase never
+        runs)."""
+        if op not in (ReduceOp.AVG, ReduceOp.SUM):
+            # Raise eagerly: a static usage error must not be swallowed by
+            # the managed error discipline and masquerade as a cohort
+            # data-plane failure.
+            raise ValueError(f"unsupported managed reduce_scatter op: {op}")
+
+        def dispatch(zeroed_tree: Any) -> Work:
+            if op == ReduceOp.AVG:
+                num_participants = self.num_participants()
+                assert num_participants >= 1
+                divisor: Optional[float] = float(num_participants)
+            else:
+                divisor = None
+            return self._collectives.reduce_scatter(
+                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire
+            )
+
+        return self._managed_dispatch(
+            "reduce_scatter", tree, dispatch, lambda t: None
+        )
+
+    def allgather_into(self, shard: Any, wire: Optional[str] = None) -> Work:
+        """Fault-tolerantly gathers every member's (updated) TreeShard
+        back into the full pytree — the parameter-allgather leg of the
+        sharded outer sync (``wire="bf16"`` halves its bytes). Failure
+        default is ``None`` (same contract as :meth:`reduce_scatter`).
+        Unlike the reduction ops, a non-participating (healing/spare)
+        member's shard is NOT zeroed: the gathered tree is replicated
+        state every ring member owns a slice of, not a contribution sum —
+        zeroing a spare's slice would corrupt every member's result."""
+        return self._managed_dispatch(
+            "allgather_into",
+            shard,
+            lambda s: self._collectives.allgather_into(s, wire=wire),
+            lambda s: None,
+            zero_nonparticipating=False,
+        )
+
     def allgather(self, tree: Any) -> Work:
         """Fault-tolerantly gathers ``tree`` from every cohort member.
 
@@ -464,6 +521,7 @@ class Manager:
         tree: Any,
         dispatch: Callable[[Any], Work],
         default_factory: Callable[[Any], Any],
+        zero_nonparticipating: bool = True,
     ) -> Work:
         """The shared managed-collective discipline: errored short-circuit,
         quorum join, participant zeroing, profiler span + metrics timer,
@@ -483,7 +541,7 @@ class Manager:
         try:
             import jax
 
-            if not self.is_participating():
+            if zero_nonparticipating and not self.is_participating():
                 tree = jax.tree_util.tree_map(
                     lambda l: l * 0 if hasattr(l, "__mul__") else l, tree
                 )
@@ -656,6 +714,18 @@ class Manager:
         assert self._quorum_future is not None, "quorum not started"
         self.wait_quorum()
         return self._participating_world_size
+
+    def quorum_id(self) -> int:
+        """Id of the current quorum (bumps exactly when membership — and
+        therefore the data plane — was reconfigured). Sharded consumers
+        key partition-dependent state on it: the DiLoCo sharded outer
+        sync re-shards its outer-optimizer state whenever the id moved
+        since the state was built (a join/leave/heal changed the ring, so
+        the old shard boundaries no longer tile the cohort). Settles the
+        quorum thread first — it is the writer."""
+        assert self._quorum_future is not None, "quorum not started"
+        self.wait_quorum()
+        return self._quorum_id
 
     def participating_rank(self) -> Optional[int]:
         """This group's rank among participants; None when healing/spare."""
