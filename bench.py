@@ -79,6 +79,20 @@ def _remaining(margin: float = 30.0) -> float:
     return _BUDGET_S - margin - (time.monotonic() - _T0)
 
 
+# The link-sized per-step DDP model (round-3 verdict #2): ~0.72M params,
+# lots of compute per param. ONE source of truth — bench_overlap.py's
+# plan sweep builds its gradient signature from this same dict, so
+# PLAN_BENCH always measures the signature this bench actually trains.
+DDP_SMALL_CONFIG = dict(
+    vocab_size=512,
+    d_model=128,
+    n_heads=2,
+    n_layers=2,
+    d_ff=512,
+    max_seq_len=2048,
+)
+
+
 def _env_wire():
     """BENCH_WIRE as a compress dtype; the special value "ddp" is a
     force-DDP trigger, not a wire dtype, and must not leak into the
@@ -111,15 +125,7 @@ def _model_setup(size: str = None):
         # (PipelinedDDP) even on a weak device<->host link. head_dim 64
         # keeps the kernel on its fast path. Batch is chosen per-link in
         # _bench_ddp_small from a MEASURED probe step.
-        cfg = TransformerConfig(
-            vocab_size=512,
-            d_model=128,
-            n_heads=2,
-            n_layers=2,
-            d_ff=512,
-            max_seq_len=2048,
-            use_flash=on_tpu,
-        )
+        cfg = TransformerConfig(**DDP_SMALL_CONFIG, use_flash=on_tpu)
         batch_size = int(os.environ.get("BENCH_DDP_SMALL_BATCH", 64))
         seq_len = 2048
     elif size == "big":

@@ -24,6 +24,20 @@ fault-tolerance overhead):
                    outer FLOPs/memory, not bytes, and the artifact says
                    which side won honestly. --dryrun shrinks the payload
                    and iterations to a smoke test (no artifact written).
+  --plan-sweep     legacy managed gradient sync vs the persistent native
+                   COMM PLAN on a ddp_small-shaped gradient tree (the
+                   real model's param signature: ~0.72M params over its
+                   actual leaf structure), per wire (f32 / bf16 / q8),
+                   under the BDP-emulated per-connection cap ->
+                   PLAN_BENCH.json. Legacy per wire = what PipelinedDDP
+                   ships today (device-packed allreduce; jitted bf16
+                   downcast; jitted int8 quantize+EF feeding the q8
+                   ring); planned = ONE native call per step (casts,
+                   EF, staging, ring, unpack all below Python). The
+                   artifact reports steps/s both ways, the ratio, and
+                   the plan path's per-step Python staging-allocation
+                   count (zero after warmup is the contract). --dryrun
+                   shrinks iterations to a smoke test (no artifact).
   --stripe-sweep   ring striped over N parallel TCP connections per
                    neighbor, N swept over STRIPE_COUNTS at the pipelined
                    chunk config -> STRIPE_BENCH.json. Two passes:
@@ -97,6 +111,89 @@ SHARD_ITERS = 3
 # Nesterov outer step, the standard DiLoCo outer optimizer.
 SHARD_OUTER_LR, SHARD_OUTER_MOM = 0.7, 0.9
 
+# Plan-sweep knobs: the ddp_small gradient signature under the same
+# measured-tunnel-rate cap the sharded sweep uses (the regime where
+# per-step DDP actually runs), plus enough iterations that the median
+# shakes off scheduler noise.
+PLAN_WIRES = ("f32", "bf16", "q8")
+PLAN_WIRE_CAP_MBPS = 12
+PLAN_STRIPES = 4
+PLAN_ITERS = 8
+
+
+def _plan_iters() -> int:
+    return 2 if "--dryrun" in sys.argv else PLAN_ITERS
+
+
+def _ddp_small_grad_tree(scale: float):
+    """A gradient pytree with the ddp_small model's EXACT parameter
+    signature (bench.py's link-sized per-step DDP config): the plan's
+    win is per-leaf Python overhead, so the leaf structure must be the
+    real model's, not a synthetic blob."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import DDP_SMALL_CONFIG
+    from torchft_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig(**DDP_SMALL_CONFIG, use_flash=False)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return jax.tree_util.tree_map(
+        lambda l: (jnp.ones(l.shape, jnp.float32) * scale), params
+    )
+
+
+def _plan_sync_legacy(hc, tree, wire, box):
+    """What PipelinedDDP ships per step today, per wire: the jitted
+    compress (bf16 downcast / int8 quantize with error feedback) plus the
+    managed device-packed allreduce."""
+    import jax
+
+    from torchft_tpu.collectives import ReduceOp
+
+    if wire == "f32":
+        res = hc.allreduce(tree, ReduceOp.SUM, divisor=2.0).wait()
+    elif wire == "bf16":
+        import jax.numpy as jnp
+
+        if box.get("down") is None:
+            box["down"] = jax.jit(lambda t: jax.tree_util.tree_map(
+                lambda l: l.astype(jnp.bfloat16), t))
+            box["up"] = jax.jit(lambda t: jax.tree_util.tree_map(
+                lambda l: l.astype(jnp.float32), t))
+        res = box["up"](
+            hc.allreduce(box["down"](tree), ReduceOp.SUM, divisor=2.0).wait()
+        )
+    else:  # q8: jitted EF quantize -> quantized ring
+        import jax.numpy as jnp
+
+        from torchft_tpu.quantize import quantize_with_feedback
+
+        if box.get("quant") is None:
+            box["quant"] = jax.jit(quantize_with_feedback)
+            box["res"] = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, jnp.float32), tree
+            )
+        out = box["quant"](tree, box["res"])
+        box["res"] = out["res"]
+        res = hc.allreduce(
+            out["dq"], ReduceOp.SUM, divisor=2.0, wire="q8"
+        ).wait()
+    jax.block_until_ready(res)
+    return res
+
+
+def _plan_sync_planned(hc, tree, wire):
+    """The same logical sync through the persistent comm plan: one
+    native call (pack/cast/EF + striped ring + unpack), no jitted
+    compress program, no per-step staging allocation."""
+    from torchft_tpu.collectives import ReduceOp
+
+    plan_wire = {"f32": None, "bf16": "bf16", "q8": "q8ef"}[wire]
+    return hc.plan_allreduce(
+        tree, ReduceOp.SUM, divisor=2.0, wire=plan_wire
+    ).wait()
+
 
 def _configs(mode):
     """(prefix, pipeline_chunks, stripes) per phase — IDENTICAL on both ring
@@ -108,6 +205,8 @@ def _configs(mode):
     if mode.startswith("sharded"):
         return [(f"{w}_s{s}", STRIPE_CHUNKS, s)
                 for w in SHARD_WIRES for s in SHARD_STRIPES]
+    if mode.startswith("plan"):
+        return [(w, STRIPE_CHUNKS, PLAN_STRIPES) for w in PLAN_WIRES]
     return [(name, chunks, 1) for name, chunks in PHASES]
 
 
@@ -119,6 +218,8 @@ def _apply_cap(mode) -> None:
         os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(WIRE_CAP_MBPS)
     elif mode == "sharded_capped":
         os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(SHARD_WIRE_CAP_MBPS)
+    elif mode == "plan_capped":
+        os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(PLAN_WIRE_CAP_MBPS)
     else:
         os.environ.pop("TORCHFT_HC_WIRE_CAP_MBPS", None)
 
@@ -219,6 +320,26 @@ def peer(store_addr: str, mode: str) -> None:
                 _sync_full(hc, zeros, wire, fbox)
             for _ in range(_shard_iters()):
                 _sync_sharded(hc, zeros, wire, sbox)
+            hc.shutdown()
+        return
+
+    if mode.startswith("plan"):
+        # Mirror the measuring side's op sequence exactly: warm legacy +
+        # warm planned, then iters of each, per wire config.
+        zeros = _ddp_small_grad_tree(0.0)
+        for prefix, chunks, stripes in _configs(mode):
+            hc = HostCollectives(timeout=timedelta(seconds=600),
+                                 connect_timeout=timedelta(seconds=600),
+                                 pipeline_chunks=chunks,
+                                 stripes=stripes)
+            hc.configure(f"{store_addr}/{prefix}", 1, 2)
+            box = {}
+            _plan_sync_legacy(hc, zeros, prefix, box)
+            _plan_sync_planned(hc, zeros, prefix)
+            for _ in range(_plan_iters()):
+                _plan_sync_legacy(hc, zeros, prefix, box)
+            for _ in range(_plan_iters()):
+                _plan_sync_planned(hc, zeros, prefix)
             hc.shutdown()
         return
 
@@ -326,6 +447,64 @@ def _measure_sharded(store, tree, mode):
     return out
 
 
+def _measure_plan(store, tree, mode):
+    """Times legacy vs planned gradient sync per wire against the
+    already-running peer; returns {wire: row}."""
+    from torchft_tpu.collectives import HostCollectives
+
+    _apply_cap(mode)
+    out = {}
+    iters = _plan_iters()
+    for prefix, chunks, stripes in _configs(mode):
+        hc = HostCollectives(
+            timeout=timedelta(seconds=600),
+            connect_timeout=timedelta(seconds=600),
+            pipeline_chunks=chunks,
+            stripes=stripes,
+        )
+        hc.configure(f"{store.address()}/{prefix}", 0, 2)
+        box = {}
+        _plan_sync_legacy(hc, tree, prefix, box)   # warm: jit programs
+        _plan_sync_planned(hc, tree, prefix)       # warm: plan build
+        hc.pop_op_stats()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _plan_sync_legacy(hc, tree, prefix, box)
+        legacy_s = (time.perf_counter() - t0) / iters
+        hc.pop_op_stats()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _plan_sync_planned(hc, tree, prefix)
+        planned_s = (time.perf_counter() - t0) / iters
+        plan_stats = [
+            s for s in hc.pop_op_stats() if s["op"] == "plan_allreduce"
+        ]
+        staging_allocs = max(
+            (s["py_staging_allocs"] for s in plan_stats), default=None
+        )
+        out[prefix] = {
+            "wire": prefix,
+            "stripes": stripes,
+            "legacy_s": round(legacy_s, 4),
+            "planned_s": round(planned_s, 4),
+            "legacy_steps_per_s": round(1.0 / legacy_s, 2),
+            "planned_steps_per_s": round(1.0 / planned_s, 2),
+            "speedup": round(legacy_s / planned_s, 3),
+            # The zero-allocation contract, measured not asserted: the
+            # max over every timed step's Python staging allocations.
+            "py_staging_allocs_after_warmup": staging_allocs,
+            "buckets": len(plan_stats[-1]["buckets"]) if plan_stats else 0,
+        }
+        print(
+            f"{prefix}: legacy {legacy_s:.4f}s, planned {planned_s:.4f}s "
+            f"-> {legacy_s / planned_s:.2f}x "
+            f"(py staging allocs {staging_allocs})",
+            flush=True,
+        )
+        hc.shutdown()
+    return out
+
+
 def _run_mode(mode):
     import jax
 
@@ -339,11 +518,18 @@ def _run_mode(mode):
     if "--dryrun" in sys.argv:
         peer_args.append("--dryrun")
     peer_proc = subprocess.Popen(peer_args, env=env)
-    tree = _shard_tree(1.0) if mode.startswith("sharded") else _tree(1.0)
+    if mode.startswith("sharded"):
+        tree = _shard_tree(1.0)
+    elif mode.startswith("plan"):
+        tree = _ddp_small_grad_tree(1.0)
+    else:
+        tree = _tree(1.0)
     jax.block_until_ready(tree)
     try:
         if mode.startswith("sharded"):
             results = _measure_sharded(store, tree, mode)
+        elif mode.startswith("plan"):
+            results = _measure_plan(store, tree, mode)
         else:
             results = _measure(store, tree, mode)
         assert peer_proc.wait(timeout=600) == 0
@@ -404,6 +590,67 @@ def main() -> None:
         print(json.dumps({
             "sharded_speedup": report["sharded_speedup"],
             "headline_config": best_key,
+        }))
+        return
+
+    if "--plan-sweep" in sys.argv:
+        results = _run_mode("plan_capped")
+        worst = min(results.values(), key=lambda r: r["speedup"])
+        best = max(results.values(), key=lambda r: r["speedup"])
+        report = {
+            "platform": jax.devices()[0].platform,
+            "model": "ddp_small gradient signature (~0.72M params, the "
+                     "real leaf structure of bench.py's link-sized "
+                     "per-step DDP config)",
+            "iters": _plan_iters(),
+            "world_size": 2,
+            "stripes": PLAN_STRIPES,
+            "bdp_emulated": {
+                "per_connection_cap_MBps": PLAN_WIRE_CAP_MBPS,
+                "how": "TORCHFT_HC_WIRE_CAP_MBPS send pacing per ring "
+                       "connection, both directions — the top of the "
+                       "per-connection rates measured through real "
+                       "tunneled links here (OVERLAP_BENCH.json)",
+            },
+            "sync": "legacy = what PipelinedDDP ships today per wire "
+                    "(device-packed managed allreduce; jitted bf16 "
+                    "downcast; jitted int8 quantize+EF into the q8 "
+                    "ring); planned = ONE native comm-plan call (cast/"
+                    "EF/staging/striped ring/unpack below Python), "
+                    "bit-identical results",
+            "adaptive_mode": {
+                "rule": "AdaptiveDDP probes blocking/plan/pipelined, "
+                        "allgathers cohort timings, locks the argmin; "
+                        "ties resolve to blocking, so the locked mode "
+                        "is never slower than blocking as measured "
+                        "(TORCHFT_DDP_MODE pins it explicitly)",
+            },
+            "configs": results,
+            "worst_wire": worst["wire"],
+            "worst_speedup": worst["speedup"],
+            "best_wire": best["wire"],
+            "best_speedup": best["speedup"],
+            "planned_not_slower": all(
+                r["speedup"] >= 0.98 for r in results.values()
+            ),
+            "zero_py_staging_allocs": all(
+                r["py_staging_allocs_after_warmup"] == 0
+                for r in results.values()
+            ),
+        }
+        if "--dryrun" in sys.argv:
+            print(json.dumps({
+                "dryrun": True,
+                "worst_speedup": report["worst_speedup"],
+                "zero_py_staging_allocs": report["zero_py_staging_allocs"],
+            }))
+            return
+        with open(os.path.join(REPO, "PLAN_BENCH.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps({
+            "plan_worst_speedup": report["worst_speedup"],
+            "plan_best_speedup": report["best_speedup"],
+            "zero_py_staging_allocs": report["zero_py_staging_allocs"],
         }))
         return
 

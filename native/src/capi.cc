@@ -317,6 +317,52 @@ int64_t tft_hc_shard_ranges(void* handle, size_t count, size_t esize,
   return n;
 }
 
+// ---- persistent comm plans ----
+
+// Builds a CommPlan for a leaf signature; returns the plan id (> 0) or -1
+// with tft_last_error set. wire: 0 native dtypes, 1 bf16, 2 q8, 3 q8+EF.
+int64_t tft_plan_build(void* handle, const int64_t* counts,
+                       const int32_t* dtypes, int64_t n_leaves, int wire) {
+  int64_t id = -1;
+  int rc = guarded([&] {
+    id = static_cast<HostCollectives*>(handle)->plan_build(
+        counts, dtypes, n_leaves, static_cast<PlanWire>(wire));
+  });
+  return rc == kOk ? id : -1;
+}
+
+// One gradient sync over the plan: a single GIL-released call that packs
+// leaf_in, rides the striped ring, and unpacks (dividing when
+// has_divisor) into leaf_out. Both pointer arrays are n_leaves long, in
+// signature order.
+int tft_plan_execute(void* handle, int64_t plan_id, const void* const* leaf_in,
+                     void* const* leaf_out, double divisor, int has_divisor,
+                     int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->plan_execute(
+        plan_id, leaf_in, leaf_out, divisor, has_divisor != 0, timeout_ms);
+  });
+}
+
+int tft_plan_free(void* handle, int64_t plan_id) {
+  return guarded(
+      [&] { static_cast<HostCollectives*>(handle)->plan_free(plan_id); });
+}
+
+int tft_plan_reset_feedback(void* handle, int64_t plan_id) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->plan_reset_feedback(plan_id);
+  });
+}
+
+// Per-bucket phase timings of the plan's last execute, as JSON.
+int tft_plan_stats_json(void* handle, int64_t plan_id, char** out) {
+  return guarded([&] {
+    *out = dup_string(
+        static_cast<HostCollectives*>(handle)->plan_stats_json(plan_id));
+  });
+}
+
 int tft_hc_allgather(void* handle, const void* in, void* out, size_t nbytes,
                      int64_t timeout_ms) {
   return guarded([&] {

@@ -12,6 +12,7 @@
 #include <thread>
 #include <vector>
 
+#include "json.h"
 #include "log.h"
 #include "store.h"
 
@@ -203,6 +204,16 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
                       std::to_string(kMaxStripes) + ")");
   abort(); // unblock any op stuck on the old ring
   std::lock_guard<std::mutex> op_lock(op_mu_); // wait for it to drain
+
+  {
+    // Comm plans bake in (world_size, stripes) layout arithmetic and
+    // persistent staging sized for the old ring: every one of them is
+    // stale the moment membership changes. Dropping them here (no
+    // execute can be in flight — op_mu_ is held) turns a stale plan id
+    // into a descriptive error instead of a desynced wire schedule.
+    std::lock_guard<std::mutex> plan_lock(plan_mu_);
+    plans_.clear();
+  }
 
   // Phase 1 (under cfg_mu_, non-blocking): retire the old ring, stand up the
   // new listener so a concurrent abort() can close it and wake phase 2.
@@ -860,6 +871,386 @@ void HostCollectives::allgather_into(const void* shard, void* data,
       ag_phase_stripe(s, bytes + start * esize, len, esize, deadline);
     });
   });
+}
+
+// ---- persistent comm plans ----
+
+namespace {
+
+int64_t ns_between(std::chrono::steady_clock::time_point a,
+                   std::chrono::steady_clock::time_point b) {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(b - a).count();
+}
+
+// Python-floor integer division (numpy's // semantics): C++ / truncates
+// toward zero, which would disagree with the legacy host path on
+// negative sums.
+template <typename T>
+T floor_div(T a, T d) {
+  T q = a / d;
+  if ((a % d != 0) && ((a < 0) != (d < 0))) q--;
+  return q;
+}
+
+}  // namespace
+
+int64_t HostCollectives::plan_build(const int64_t* counts,
+                                    const int32_t* dtypes, int64_t n_leaves,
+                                    PlanWire wire) {
+  if (world_size_ <= 0)
+    throw SocketError("plan_build before configure (layout needs the ring)");
+  if (n_leaves <= 0) throw SocketError("plan_build of an empty signature");
+  auto p = std::make_unique<CommPlan>();
+  p->wire = wire;
+  p->leaves.resize(n_leaves);
+  // FNV-1a over (wire, geometry, signature): exchanged in the execute
+  // header so mismatched plans error instead of desyncing the ring.
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&h](uint64_t v) {
+    for (int i = 0; i < 8; i++) {
+      h ^= (v >> (8 * i)) & 0xff;
+      h *= 1099511628211ull;
+    }
+  };
+  mix(static_cast<uint64_t>(wire));
+  mix(static_cast<uint64_t>(world_size_));
+  mix(static_cast<uint64_t>(stripes_));
+  const bool q8 = wire == PlanWire::kQ8 || wire == PlanWire::kQ8EF;
+  for (int64_t i = 0; i < n_leaves; i++) {
+    if (counts[i] < 0) throw SocketError("plan_build: negative leaf count");
+    Dtype dt = static_cast<Dtype>(dtypes[i]);
+    dtype_size(dt);  // validates the code
+    p->leaves[i] = {static_cast<size_t>(counts[i]), dt};
+    mix(static_cast<uint64_t>(counts[i]));
+    mix(static_cast<uint64_t>(dtypes[i]));
+    Dtype gdt;
+    if (q8) {
+      if (dt != Dtype::kF32 && dt != Dtype::kBF16)
+        throw SocketError(
+            "comm plan: q8 wires take f32/bf16 leaves only (callers fall "
+            "back to the legacy path for other dtypes)");
+      gdt = Dtype::kF32;
+    } else if (wire == PlanWire::kBF16) {
+      gdt = dt == Dtype::kF32 ? Dtype::kBF16 : dt;
+    } else {
+      gdt = dt;
+    }
+    // First-appearance group order — the legacy host path's dict order.
+    CommPlan::Group* g = nullptr;
+    for (auto& cand : p->groups)
+      if (cand.dtype == gdt) { g = &cand; break; }
+    if (g == nullptr) {
+      p->groups.emplace_back();
+      g = &p->groups.back();
+      g->dtype = gdt;
+    }
+    g->leaf_idx.push_back(i);
+    g->leaf_off.push_back(g->count);
+    g->count += static_cast<size_t>(counts[i]);
+  }
+  size_t total_f32 = 0;
+  for (auto& g : p->groups) {
+    size_t esize = dtype_size(g.dtype);
+    // The stripe partition IS the plan's bucket list, derived exactly
+    // like the fused op derives it (q8 wires: ~1 byte/element) so the
+    // ring arithmetic — chunk boundaries, q8 scales — matches the
+    // legacy single-op path bit for bit.
+    g.eff = effective_stripes(g.count * (q8 ? 1 : esize), stripes_);
+    g.staging.resize(g.count * esize);
+    total_f32 += g.count;
+  }
+  if (wire == PlanWire::kQ8EF) p->residual.assign(total_f32, 0.f);
+  p->sig = h;
+  std::lock_guard<std::mutex> lock(plan_mu_);
+  plans_[next_plan_id_] = std::move(p);
+  return next_plan_id_++;
+}
+
+CommPlan& HostCollectives::plan_get(int64_t plan_id) {
+  std::lock_guard<std::mutex> lock(plan_mu_);
+  auto it = plans_.find(plan_id);
+  if (it == plans_.end())
+    throw SocketError(
+        "unknown or invalidated comm plan (plans do not survive "
+        "reconfigure; rebuild after every quorum change)");
+  return *it->second;
+}
+
+void HostCollectives::plan_free(int64_t plan_id) {
+  std::lock_guard<std::mutex> op_lock(op_mu_);  // no execute in flight
+  std::lock_guard<std::mutex> lock(plan_mu_);
+  plans_.erase(plan_id);
+}
+
+void HostCollectives::plan_reset_feedback(int64_t plan_id) {
+  std::lock_guard<std::mutex> op_lock(op_mu_);
+  CommPlan& p = plan_get(plan_id);
+  std::fill(p.residual.begin(), p.residual.end(), 0.f);
+}
+
+std::string HostCollectives::plan_stats_json(int64_t plan_id) {
+  std::lock_guard<std::mutex> op_lock(op_mu_);
+  CommPlan& p = plan_get(plan_id);
+  JsonObject out;
+  out["execs"] = Json(p.execs);
+  out["wire"] = Json(static_cast<int64_t>(p.wire));
+  JsonArray buckets;
+  for (const auto& st : p.stats) {
+    JsonObject b;
+    b["group"] = Json(st.group);
+    b["stripe"] = Json(st.stripe);
+    b["bytes"] = Json(st.bytes);
+    b["pack_s"] = Json(st.pack_ns / 1e9);
+    b["ring_s"] = Json(st.ring_ns / 1e9);
+    b["unpack_s"] = Json(st.unpack_ns / 1e9);
+    buckets.push_back(Json(std::move(b)));
+  }
+  out["buckets"] = Json(std::move(buckets));
+  return Json(std::move(out)).dump();
+}
+
+void HostCollectives::plan_pack_range(CommPlan& p, CommPlan::Group& g,
+                                      const void* const* leaf_in,
+                                      size_t start, size_t len) const {
+  size_t end = start + len;
+  size_t gesize = dtype_size(g.dtype);
+  for (size_t k = 0; k < g.leaf_idx.size(); k++) {
+    int64_t li = g.leaf_idx[k];
+    const CommPlan::Leaf& leaf = p.leaves[li];
+    size_t off = g.leaf_off[k];
+    size_t lend = off + leaf.count;
+    if (lend <= start || off >= end) continue;
+    size_t a = std::max(off, start);
+    size_t b = std::min(lend, end);
+    size_t n = b - a;
+    const char* src = static_cast<const char*>(leaf_in[li]) +
+                      (a - off) * dtype_size(leaf.dtype);
+    char* dst = g.staging.data() + a * gesize;
+    if (leaf.dtype == g.dtype) {
+      memcpy(dst, src, n * gesize);
+    } else if (leaf.dtype == Dtype::kF32 && g.dtype == Dtype::kBF16) {
+      const float* s = reinterpret_cast<const float*>(src);
+      uint16_t* d = reinterpret_cast<uint16_t*>(dst);
+      for (size_t i = 0; i < n; i++) d[i] = f32_to_bf16(s[i]);
+    } else if (leaf.dtype == Dtype::kBF16 && g.dtype == Dtype::kF32) {
+      const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+      float* d = reinterpret_cast<float*>(dst);
+      for (size_t i = 0; i < n; i++) d[i] = bf16_to_f32(s[i]);
+    } else {
+      throw SocketError("comm plan: unsupported pack cast");
+    }
+  }
+}
+
+void HostCollectives::plan_unpack_range(const CommPlan& p,
+                                        const CommPlan::Group& g,
+                                        void* const* leaf_out, size_t start,
+                                        size_t len, double divisor,
+                                        bool has_divisor) const {
+  size_t end = start + len;
+  size_t gesize = dtype_size(g.dtype);
+  // Divisor semantics mirror the legacy host path exactly: f32 groups
+  // divide in f32 (numpy 2's in-place weak-scalar rule), f64 in f64,
+  // bf16 via f32 with round-to-nearest-even back (_apply_divisor), ints
+  // floor-divide.
+  const float div32 = static_cast<float>(divisor);
+  for (size_t k = 0; k < g.leaf_idx.size(); k++) {
+    int64_t li = g.leaf_idx[k];
+    const CommPlan::Leaf& leaf = p.leaves[li];
+    size_t off = g.leaf_off[k];
+    size_t lend = off + leaf.count;
+    if (lend <= start || off >= end) continue;
+    size_t a = std::max(off, start);
+    size_t b = std::min(lend, end);
+    size_t n = b - a;
+    const char* src = g.staging.data() + a * gesize;
+    char* dst = static_cast<char*>(leaf_out[li]) +
+                (a - off) * dtype_size(leaf.dtype);
+    switch (g.dtype) {
+      case Dtype::kF32: {
+        const float* s = reinterpret_cast<const float*>(src);
+        if (leaf.dtype == Dtype::kF32) {
+          float* d = reinterpret_cast<float*>(dst);
+          for (size_t i = 0; i < n; i++)
+            d[i] = has_divisor ? s[i] / div32 : s[i];
+        } else if (leaf.dtype == Dtype::kBF16) {
+          uint16_t* d = reinterpret_cast<uint16_t*>(dst);
+          for (size_t i = 0; i < n; i++)
+            d[i] = f32_to_bf16(has_divisor ? s[i] / div32 : s[i]);
+        } else {
+          throw SocketError("comm plan: unsupported unpack cast");
+        }
+        break;
+      }
+      case Dtype::kBF16: {
+        const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+        if (leaf.dtype == Dtype::kBF16 || leaf.dtype == Dtype::kF32) {
+          for (size_t i = 0; i < n; i++) {
+            uint16_t w = s[i];
+            if (has_divisor) w = f32_to_bf16(bf16_to_f32(w) / div32);
+            if (leaf.dtype == Dtype::kBF16)
+              reinterpret_cast<uint16_t*>(dst)[i] = w;
+            else
+              reinterpret_cast<float*>(dst)[i] = bf16_to_f32(w);
+          }
+        } else {
+          throw SocketError("comm plan: unsupported unpack cast");
+        }
+        break;
+      }
+      case Dtype::kF64: {
+        const double* s = reinterpret_cast<const double*>(src);
+        double* d = reinterpret_cast<double*>(dst);
+        for (size_t i = 0; i < n; i++)
+          d[i] = has_divisor ? s[i] / divisor : s[i];
+        break;
+      }
+      case Dtype::kI32: {
+        const int32_t* s = reinterpret_cast<const int32_t*>(src);
+        int32_t* d = reinterpret_cast<int32_t*>(dst);
+        int32_t dv = static_cast<int32_t>(divisor);
+        for (size_t i = 0; i < n; i++)
+          d[i] = has_divisor ? floor_div(s[i], dv) : s[i];
+        break;
+      }
+      case Dtype::kI64: {
+        const int64_t* s = reinterpret_cast<const int64_t*>(src);
+        int64_t* d = reinterpret_cast<int64_t*>(dst);
+        int64_t dv = static_cast<int64_t>(divisor);
+        for (size_t i = 0; i < n; i++)
+          d[i] = has_divisor ? floor_div(s[i], dv) : s[i];
+        break;
+      }
+    }
+  }
+}
+
+void HostCollectives::plan_pack_ef(CommPlan& p, CommPlan::Group& g,
+                                   const void* const* leaf_in) const {
+  // The native mirror of quantize.quantize_with_feedback, leaf by leaf:
+  // the per-leaf absmax spans stripe boundaries, so EF packs the whole
+  // group before the striped ring starts (the only plan phase that
+  // cannot stream per bucket). Arithmetic matches the jitted original
+  // op for op: f32 adds, absmax/127 in f32 floored at 1e-12,
+  // round-to-nearest-even, clip to [-127, 127], dq = q * scale,
+  // residual = d - dq.
+  float* stg = reinterpret_cast<float*>(g.staging.data());
+  for (size_t k = 0; k < g.leaf_idx.size(); k++) {
+    int64_t li = g.leaf_idx[k];
+    const CommPlan::Leaf& leaf = p.leaves[li];
+    size_t off = g.leaf_off[k];
+    size_t n = leaf.count;
+    float* d = stg + off;
+    float* res = p.residual.data() + off;
+    if (leaf.dtype == Dtype::kF32) {
+      const float* s = static_cast<const float*>(leaf_in[li]);
+      for (size_t i = 0; i < n; i++) d[i] = s[i] + res[i];
+    } else {  // kBF16, enforced at build
+      const uint16_t* s = static_cast<const uint16_t*>(leaf_in[li]);
+      for (size_t i = 0; i < n; i++) d[i] = bf16_to_f32(s[i]) + res[i];
+    }
+    float absmax = 0.f;
+    bool finite = true;
+    for (size_t i = 0; i < n; i++) {
+      float a = std::fabs(d[i]);
+      if (!std::isfinite(a)) finite = false;
+      absmax = std::max(absmax, a);
+    }
+    if (!finite) {
+      // A diverged leaf poisons its own payload AND its carry — the
+      // same NaN propagation the jitted path produces — and the q8
+      // wire's NaN-scale encode then poisons every member.
+      float nan = std::numeric_limits<float>::quiet_NaN();
+      for (size_t i = 0; i < n; i++) {
+        res[i] = nan;
+        d[i] = nan;
+      }
+      continue;
+    }
+    float scale = std::max(absmax / 127.0f, 1e-12f);
+    for (size_t i = 0; i < n; i++) {
+      float q = std::nearbyint(d[i] / scale);
+      q = std::max(-127.f, std::min(127.f, q));
+      float dq = q * scale;
+      res[i] = d[i] - dq;
+      d[i] = dq;
+    }
+  }
+}
+
+void HostCollectives::plan_execute(int64_t plan_id,
+                                   const void* const* leaf_in,
+                                   void* const* leaf_out, double divisor,
+                                   bool has_divisor, int64_t timeout_ms) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  CommPlan& p = plan_get(plan_id);
+  p.stats.clear();
+  const bool q8 = p.wire == PlanWire::kQ8 || p.wire == PlanWire::kQ8EF;
+  if (world_size_ == 1) {
+    // Solo: pack -> identity -> unpack, so divisor and (for kQ8EF) the
+    // error-feedback state evolve exactly as they would in a ring —
+    // a member that later joins a cohort carries coherent state.
+    for (auto& g : p.groups) {
+      if (p.wire == PlanWire::kQ8EF)
+        plan_pack_ef(p, g, leaf_in);
+      else
+        plan_pack_range(p, g, leaf_in, 0, g.count);
+      plan_unpack_range(p, g, leaf_out, 0, g.count, divisor, has_divisor);
+    }
+    p.execs++;
+    return;
+  }
+  if (aborted_) throw SocketError("collectives not configured");
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // The signature hash covers (wire, geometry, leaf counts, dtypes):
+    // two members executing different plans error here instead of
+    // deadlocking mid-payload.
+    check_op_header(8, p.sig, static_cast<uint32_t>(p.wire), 0, deadline);
+    for (size_t gi = 0; gi < p.groups.size(); gi++) {
+      CommPlan::Group& g = p.groups[gi];
+      if (g.count == 0) continue;
+      if (p.wire == PlanWire::kQ8EF) plan_pack_ef(p, g, leaf_in);
+      size_t esize = dtype_size(g.dtype);
+      size_t stat_base = p.stats.size();
+      p.stats.resize(stat_base + g.eff);
+      last_stripe_ns_.assign(g.eff, 0);
+      // The triple pipeline: every stripe sub-range is one bucket whose
+      // pack -> ring -> unpack runs end-to-end on its own pool worker,
+      // so bucket i+1 packs/casts while bucket i rides its connection
+      // and bucket i-1 unpacks — with NO cross-bucket barrier and no
+      // Python between phases. The ring body and stripe partition are
+      // the fused op's own, so results are bit-identical to the legacy
+      // path by construction.
+      run_striped([&](int64_t s) {
+        auto [start, len] = stripe_range(g.count, g.eff, s);
+        CommPlan::BucketStat& st = p.stats[stat_base + s];
+        st.group = static_cast<int64_t>(gi);
+        st.stripe = s;
+        st.bytes = static_cast<int64_t>(len * esize);
+        if (len == 0) return;
+        auto t0 = std::chrono::steady_clock::now();
+        if (p.wire != PlanWire::kQ8EF)
+          plan_pack_range(p, g, leaf_in, start, len);
+        auto t1 = std::chrono::steady_clock::now();
+        if (q8) {
+          allreduce_q8_stripe(
+              s, reinterpret_cast<float*>(g.staging.data()) + start, len,
+              deadline);
+        } else {
+          allreduce_stripe(s, g.staging.data() + start * esize, len, esize,
+                           g.dtype, ReduceOp::kSum, deadline);
+        }
+        auto t2 = std::chrono::steady_clock::now();
+        plan_unpack_range(p, g, leaf_out, start, len, divisor, has_divisor);
+        auto t3 = std::chrono::steady_clock::now();
+        st.pack_ns = ns_between(t0, t1);
+        st.ring_ns = ns_between(t1, t2);
+        st.unpack_ns = ns_between(t2, t3);
+      });
+    }
+  });
+  p.execs++;
 }
 
 void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,

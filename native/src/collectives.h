@@ -35,6 +35,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -69,6 +70,76 @@ size_t dtype_size(Dtype d);
 // above the knee of any measured sweep, low enough that a bad config can't
 // fork-bomb the host.
 constexpr int64_t kMaxStripes = 64;
+
+// Wire format of a CommPlan (see CommPlan below). Mirrored by the Python
+// layer's `wire=` strings: None -> kNative, "bf16" -> kBF16, "q8" -> kQ8,
+// "q8ef" -> kQ8EF.
+enum class PlanWire : int {
+  // Each leaf rides the ring in its own native dtype (f32/f64/i32/i64/
+  // bf16 groups) — the legacy managed path's accumulation-dtype grouping.
+  kNative = 0,
+  // f32 leaves are rounded (nearest-even) to bf16 at pack and ride a
+  // bf16 group; other dtypes group natively. Halves the f32 wire bytes,
+  // matching ddp's compress="bf16" (jax downcast + bf16 ring) exactly.
+  kBF16 = 1,
+  // Whole tree packs into ONE f32 group and rides the quantized ring
+  // (int8 chunks + per-chunk scales) — the legacy wire="q8" schedule.
+  kQ8 = 2,
+  // kQ8 plus per-leaf symmetric int8 quantization with ERROR FEEDBACK
+  // executed natively at pack time: d = leaf + residual; scale =
+  // max(|d|)/127 (floored 1e-12); dq = round(d/scale)*scale ships;
+  // residual = d - dq persists in the plan. The native mirror of
+  // quantize.quantize_with_feedback so the q8 DDP mode needs no jitted
+  // quantize program on the per-step hot path.
+  kQ8EF = 3,
+};
+
+// A persistent, precompiled description of one pytree's gradient sync:
+// leaf -> dtype-group assignment with per-leaf element offsets, the wire
+// format, the stripe partition (the plan's "buckets" — each stripe
+// sub-range is packed, ridden, and unpacked as one pipeline unit), and
+// persistent staging buffers sized once at build. Built once per
+// (signature, wire) by HostCollectives::plan_build and executed each step
+// as a single native call; Python's only per-step work is collecting leaf
+// pointers. Executing the ring over the IDENTICAL per-group stripe
+// partition the legacy single-op path uses (and through the same
+// *_stripe bodies) makes plan-vs-legacy bit-identity structural, not
+// coincidental. Plans are invalidated by configure(): the layout bakes in
+// (world_size, stripes) and a new ring means new geometry.
+struct CommPlan {
+  struct Leaf {
+    size_t count;   // flat elements
+    Dtype dtype;    // source (and result) dtype
+  };
+  // One contiguous staging buffer per ring dtype; leaves are packed at
+  // fixed offsets in signature order (the legacy concatenation layout).
+  struct Group {
+    Dtype dtype;                     // ring/staging dtype
+    std::vector<int64_t> leaf_idx;   // leaves packed into this group
+    std::vector<size_t> leaf_off;    // element offset of each leaf
+    size_t count = 0;                // total flat elements
+    int64_t eff = 1;                 // stripe partition (fixed at build)
+    std::vector<char> staging;       // persistent, count * esize bytes
+  };
+  // Per-bucket (= per stripe sub-range) phase timings of the last
+  // execute; the plan-path analog of the bulk path's bucket stats.
+  struct BucketStat {
+    int64_t group = 0;
+    int64_t stripe = 0;
+    int64_t bytes = 0;
+    int64_t pack_ns = 0, ring_ns = 0, unpack_ns = 0;
+  };
+
+  PlanWire wire = PlanWire::kNative;
+  std::vector<Leaf> leaves;
+  std::vector<Group> groups;
+  // kQ8EF: persistent error-feedback carry, laid out exactly like the
+  // single f32 group's staging (per-leaf offsets shared).
+  std::vector<float> residual;
+  uint64_t sig = 0;      // structure hash, exchanged in the op header
+  int64_t execs = 0;     // executes since build (0 = cold)
+  std::vector<BucketStat> stats;  // last execute, one entry per bucket
+};
 
 class HostCollectives {
  public:
@@ -159,6 +230,43 @@ class HostCollectives {
   void allgather_into(const void* shard, void* data, size_t count,
                       Dtype dtype, int64_t layout_stripes,
                       int64_t timeout_ms);
+
+  // ---- persistent comm plans ----
+  //
+  // plan_build compiles a CommPlan for a leaf signature (counts[i],
+  // dtypes[i]) and wire format; returns a plan id valid until the next
+  // configure() (which invalidates every plan — the layout bakes in the
+  // ring geometry) or plan_free. Build is pure layout arithmetic — no
+  // sockets touched — so ranks may build at different times; the id is
+  // local. All members of a ring must build plans from identical
+  // signatures (the execute header hashes the signature and errors on
+  // mismatch, like every other op).
+  int64_t plan_build(const int64_t* counts, const int32_t* dtypes,
+                     int64_t n_leaves, PlanWire wire);
+
+  // Executes one gradient sync over the plan: packs/casts leaf_in[i]
+  // into the persistent staging (kQ8EF additionally runs the native
+  // error-feedback quantization against the plan's residual), rides the
+  // ring, and unpacks (divisor applied, AVG-style) into leaf_out[i].
+  // Each stripe sub-range is one pipeline bucket running
+  // pack -> ring -> unpack on its own pool worker, so bucket i+1
+  // packs/casts while bucket i rides the ring and bucket i-1 unpacks.
+  // The ring arithmetic per group is bit-identical to the legacy
+  // single-op path (same stripe partition, same *_stripe bodies).
+  // Aborts/peer death wake every stripe exactly like the bulk ops.
+  void plan_execute(int64_t plan_id, const void* const* leaf_in,
+                    void* const* leaf_out, double divisor, bool has_divisor,
+                    int64_t timeout_ms);
+
+  void plan_free(int64_t plan_id);
+  // Zeroes a kQ8EF plan's error-feedback carry (no-op otherwise): the
+  // caller's heal/abort discipline — a recovered member must not carry a
+  // residual from its abandoned trajectory.
+  void plan_reset_feedback(int64_t plan_id);
+  // Per-bucket phase stats of the plan's last execute, as JSON:
+  // {"execs": n, "buckets": [{"group", "stripe", "bytes", "pack_s",
+  // "ring_s", "unpack_s"}, ...]}.
+  std::string plan_stats_json(int64_t plan_id);
 
   // Gathers `nbytes` from every rank into `out` (world_size * nbytes), in
   // rank order.
@@ -259,6 +367,20 @@ class HostCollectives {
   void copy_shard(char* data, char* shard, size_t count, size_t esize,
                   int64_t eff, bool to_shard) const;
 
+  // Plan internals: pack/unpack one element range of a group (casts per
+  // the plan wire; unpack applies the divisor), and the kQ8EF per-leaf
+  // error-feedback quantization (whole group — the per-leaf absmax spans
+  // stripe boundaries, so it cannot run per stripe).
+  void plan_pack_range(CommPlan& p, CommPlan::Group& g,
+                       const void* const* leaf_in, size_t start,
+                       size_t len) const;
+  void plan_unpack_range(const CommPlan& p, const CommPlan::Group& g,
+                         void* const* leaf_out, size_t start, size_t len,
+                         double divisor, bool has_divisor) const;
+  void plan_pack_ef(CommPlan& p, CommPlan::Group& g,
+                    const void* const* leaf_in) const;
+  CommPlan& plan_get(int64_t plan_id);
+
   // Shuts down every ring socket (all stripes); cfg_mu_ must NOT be held.
   void shutdown_sockets();
 
@@ -325,6 +447,14 @@ class HostCollectives {
   int64_t pool_pending_ = 0;  // participating workers not yet done
   bool pool_stop_ = false;
   std::vector<std::thread> pool_;
+
+  // Comm plans (guarded by plan_mu_ for map identity; a plan's buffers
+  // are only ever touched under op_mu_ during execute). Cleared by
+  // configure() — ids from an old ring error instead of running with a
+  // stale layout.
+  std::mutex plan_mu_;
+  std::map<int64_t, std::unique_ptr<CommPlan>> plans_;
+  int64_t next_plan_id_ = 1;
 };
 
 } // namespace tft

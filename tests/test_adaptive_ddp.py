@@ -1,0 +1,394 @@
+"""AdaptiveDDP tests: the probe/decision discipline.
+
+The contract VERDICT item 8 demanded: pipelined DDP can never again lose
+to blocking, because blocking is always a probed candidate and the
+cohort-agreed decision is the argmin with ties resolving to blocking.
+"""
+
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import DummyCollectives
+from torchft_tpu.ddp import AdaptiveDDP, PipelinedDDP
+from torchft_tpu.train_state import FTTrainState
+
+
+def _grad_fn(params, x):
+    import jax
+    import jax.numpy as jnp
+
+    def loss(p):
+        return jnp.mean((x @ p["w"]) ** 2)
+
+    value, grads = jax.value_and_grad(loss)(params)
+    return value, grads
+
+
+def _state():
+    import jax.numpy as jnp
+    import optax
+
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    return FTTrainState(params, optax.sgd(0.1))
+
+
+class _FakeManager:
+    """Just enough Manager surface for the decision-rule unit tests."""
+
+    def __init__(self, cohort_timings):
+        # cohort_timings: list (one per member) of per-candidate medians
+        self._cohort = cohort_timings
+        self._metrics_records = {}
+        self._qid = 7
+
+    def allgather(self, tree):
+        from torchft_tpu.collectives import _completed
+
+        return _completed(
+            [{"probe_t": np.asarray(t, np.float64)} for t in self._cohort]
+        )
+
+    def quorum_id(self):
+        return self._qid
+
+    def errored(self):
+        return None
+
+    def metrics(self):
+        class M:
+            def __init__(self, store):
+                self._s = store
+
+            def record(self, name, s):
+                self._s[name] = s
+
+            def incr(self, name, by=1):
+                self._s[name] = self._s.get(name, 0) + by
+
+        return M(self._metrics_records)
+
+
+def _adaptive_with_fake(cohort):
+    ddp = AdaptiveDDP.__new__(AdaptiveDDP)
+    ddp._manager = _FakeManager(cohort)
+    ddp._candidates = list(AdaptiveDDP._CANDIDATES)
+    ddp._probe_t = [[t] for t in cohort[0]]
+    ddp._auto = True
+    ddp._mode = None
+    ddp._probe_qid = 7
+    ddp._probe_idx = 6
+    ddp._decision_qid = None
+    ddp.decision = None
+    return ddp
+
+
+class TestDecisionRule:
+    def test_picks_cohort_fastest(self):
+        # member 0 prefers plan, member 1 prefers plan more strongly
+        ddp = _adaptive_with_fake(
+            [[0.10, 0.08, 0.09], [0.10, 0.05, 0.09]]
+        )
+        ddp._decide()
+        assert ddp.mode == "plan"
+        assert ddp.decision["mode"] == "plan"
+
+    def test_never_slower_than_blocking(self):
+        # every alternative measures worse somewhere: blocking wins
+        ddp = _adaptive_with_fake(
+            [[0.10, 0.12, 0.11], [0.10, 0.09, 0.15]]
+        )
+        ddp._decide()
+        assert ddp.mode == "blocking"
+
+    def test_tie_resolves_to_blocking(self):
+        ddp = _adaptive_with_fake([[0.10, 0.10, 0.10]])
+        ddp._decide()
+        assert ddp.mode == "blocking"
+
+    def test_decision_is_deterministic_across_members(self):
+        # identical gathered data -> identical argmin on every member
+        cohort = [[0.3, 0.2, 0.25], [0.31, 0.22, 0.24]]
+        modes = set()
+        for _ in range(2):
+            ddp = _adaptive_with_fake(cohort)
+            ddp._decide()
+            modes.add(ddp.mode)
+        assert modes == {"plan"}
+
+    def test_failed_candidate_cannot_win(self):
+        # A candidate that errored on ANY member carries the failure
+        # sentinel through the gather: even if it measured fastest
+        # elsewhere, it can never rank above a working candidate.
+        s = AdaptiveDDP._PROBE_FAILED_S
+        ddp = _adaptive_with_fake([[0.5, s, 0.4], [0.5, 0.001, 0.4]])
+        ddp._decide()
+        assert ddp.mode == "pipelined"
+
+    def test_all_failed_falls_back_to_blocking(self):
+        s = AdaptiveDDP._PROBE_FAILED_S
+        ddp = _adaptive_with_fake([[s, s, s]])
+        ddp._decide()
+        assert ddp.mode == "blocking"
+
+    def test_errored_gather_locks_blocking(self):
+        # When the decision allgather itself failed, this member's data
+        # is local-only and any argmin could disagree with the cohort:
+        # lock the safe default (a mismatch self-heals via the
+        # quorum-change re-probe).
+        ddp = _adaptive_with_fake([[0.5, 0.1, 0.2]])
+        ddp._manager.errored = lambda: RuntimeError("gather failed")
+        ddp._decide()
+        assert ddp.mode == "blocking"
+
+
+class TestConstruction:
+    def test_env_mode_pins_without_probe(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_DDP_MODE", "blocking")
+        ddp = AdaptiveDDP(
+            _ManagerStub(), _state(), _grad_fn
+        )
+        assert ddp.mode == "blocking"  # locked, no probe phase
+
+    def test_bad_env_mode_rejected(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_DDP_MODE", "warp")
+        with pytest.raises(ValueError, match="TORCHFT_DDP_MODE"):
+            AdaptiveDDP(_ManagerStub(), _state(), _grad_fn)
+
+    def test_int8_drops_plan_candidate(self):
+        ddp = AdaptiveDDP(
+            _ManagerStub(), _state(), _grad_fn, compress="int8", mode="auto"
+        )
+        assert "plan" not in ddp._candidates
+        with pytest.raises(ValueError, match="plan"):
+            AdaptiveDDP(
+                _ManagerStub(), _state(), _grad_fn, compress="int8",
+                mode="plan",
+            )
+
+    def test_pipelined_rejects_plan_with_int8(self):
+        with pytest.raises(ValueError, match="allgather"):
+            PipelinedDDP(
+                _ManagerStub(), _state(), _grad_fn, compress="int8",
+                transport="plan",
+            )
+
+
+class _ManagerStub:
+    """Constructor-only stand-in (never stepped)."""
+
+
+class TestEndToEnd:
+    def _manager(self):
+        from torchft_tpu import Lighthouse
+        from torchft_tpu._native import Store
+        from torchft_tpu.collectives import HostCollectives
+        from torchft_tpu.manager import Manager
+
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+            quorum_tick_ms=50, heartbeat_timeout_ms=2000,
+        )
+        store = Store()
+        manager = Manager(
+            collectives=HostCollectives(timeout=timedelta(seconds=10)),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {},
+            min_replica_size=1,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            timeout=timedelta(seconds=10),
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="adaptive_e2e",
+        )
+        return manager, store, lighthouse
+
+    def test_probe_locks_and_training_progresses(self):
+        import jax.numpy as jnp
+
+        manager, store, lighthouse = self._manager()
+        state = _state()
+        ddp = AdaptiveDDP(manager, state, _grad_fn, probe_steps=2)
+        x = jnp.ones((4, 8), jnp.float32)
+        try:
+            assert ddp.mode is None  # probing
+            for _ in range(8):
+                loss = ddp.step(x)
+            ddp.flush()
+            assert ddp.mode in ("blocking", "plan", "pipelined")
+            assert ddp.decision["mode"] == ddp.mode
+            assert set(ddp.decision["probe_s"]) == {
+                "blocking", "plan", "pipelined"
+            }
+            assert np.isfinite(float(loss))
+            assert manager.current_step() == 8
+            counters = manager.metrics().snapshot()["counters"]
+            assert counters.get(f"ddp_mode_{ddp.mode}") == 1
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+    def test_backend_without_plans_never_locks_plan(self):
+        # A backend whose plan_allreduce raises (base Collectives
+        # default) makes every plan probe step error: the managed latch
+        # resolves instantly, the step is discarded, and its
+        # meaninglessly-fast wall time must NOT let "plan" win — the
+        # failure sentinel keeps it out, and the probe must still
+        # terminate (an attempted-step clock; a committed-step clock
+        # would stall forever on the never-committing candidate).
+        import jax.numpy as jnp
+
+        from torchft_tpu.collectives import Collectives
+
+        class NoPlans(DummyCollectives):
+            plan_allreduce = Collectives.plan_allreduce  # raises
+
+        from torchft_tpu import Lighthouse
+        from torchft_tpu._native import Store
+        from torchft_tpu.manager import Manager
+
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+            quorum_tick_ms=50, heartbeat_timeout_ms=2000,
+        )
+        store = Store()
+        manager = Manager(
+            collectives=NoPlans(world_size=1),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {},
+            min_replica_size=1,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            timeout=timedelta(seconds=10),
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="noplan_e2e",
+        )
+        try:
+            state = _state()
+            ddp = AdaptiveDDP(manager, state, _grad_fn, probe_steps=2)
+            x = jnp.ones((4, 8), jnp.float32)
+            for _ in range(10):
+                ddp.step(x)
+            ddp.flush()
+            assert ddp.mode is not None, "probe must terminate"
+            assert ddp.mode != "plan", (
+                "a candidate whose every step errors must never win"
+            )
+            assert ddp.decision["probe_s"]["plan"] >= 1e8
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+    def test_plan_transport_trains_equivalently(self):
+        # PipelinedDDP(transport="plan") on a solo manager must produce
+        # the same committed parameters as the legacy transport: solo
+        # AVG is identity, so both settle to identical SGD trajectories.
+        import jax
+        import jax.numpy as jnp
+
+        manager, store, lighthouse = self._manager()
+        try:
+            x = jnp.ones((4, 8), jnp.float32)
+            results = {}
+            for transport in ("legacy", "plan"):
+                state = _state()
+                ddp = PipelinedDDP(
+                    manager, state, _grad_fn, transport=transport
+                )
+                for _ in range(3):
+                    ddp.step(x)
+                ddp.flush()
+                results[transport] = np.asarray(
+                    jax.tree_util.tree_leaves(state.params)[0]
+                )
+            np.testing.assert_array_equal(
+                results["legacy"], results["plan"]
+            )
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+
+class TestReprobeOnQuorumChange:
+    def test_quorum_move_restarts_probe(self):
+        # Drive AdaptiveDDP against a scripted manager: after lock-in, a
+        # quorum_id change at step N must unlock and restart the probe
+        # schedule, with the probe clock re-anchored at the cohort's
+        # committed-step count (the quorum synchronizes it, so every
+        # member restarts at the same origin).
+        import jax.numpy as jnp
+
+        class ScriptedManager:
+            def __init__(self):
+                self.collectives = DummyCollectives()
+                self.qid = 1
+                self.committed = 0
+                self._m = _FakeManager([[0.0, 0.0, 0.0]])
+
+            def start_quorum(self, **kw):
+                pass
+
+            def quorum_id(self):
+                return self.qid
+
+            def current_step(self):
+                return self.committed
+
+            def errored(self):
+                return None
+
+            def plan_allreduce(self, tree, op=None, wire=None):
+                from torchft_tpu.collectives import _completed
+
+                return _completed(tree)
+
+            def allreduce(self, tree, op=None, wire=None):
+                from torchft_tpu.collectives import _completed
+
+                return _completed(tree)
+
+            def allgather(self, tree):
+                from torchft_tpu.collectives import _completed
+
+                return _completed([tree])
+
+            def should_commit(self, **kw):
+                self.committed += 1
+                return True
+
+            def is_healing(self):
+                return False
+
+            def metrics(self):
+                return self._m.metrics()
+
+            def reset_plan_feedback(self):
+                pass
+
+        mgr = ScriptedManager()
+        state = _state()
+        ddp = AdaptiveDDP(mgr, state, _grad_fn, probe_steps=2)
+        x = jnp.ones((4, 8), jnp.float32)
+        # step 1 anchors the probe clock (first quorum-id observation,
+        # untimed); 3 candidates x 2 steps follow
+        for _ in range(7):
+            ddp.step(x)
+        assert ddp.mode is not None
+        locked = ddp.mode
+        ddp.step(x)  # steady state
+        assert ddp.mode == locked
+        mgr.qid = 2  # membership moves
+        ddp.step(x)  # observes the new id at this step's end
+        assert ddp.mode is None  # probing again, in lockstep
+        for _ in range(6):  # clock already anchored by the restart
+            ddp.step(x)
+        assert ddp.mode is not None
+        ddp.flush()

@@ -796,6 +796,53 @@ class TestShardedCollectives:
         for c in cols:
             c.shutdown()
 
+    def test_reduce_scatter_q8_nonfinite_poisons_shard(self, store):
+        # The split-op mirror of the fused q8 poisoning contract
+        # (ADVICE #4): a NaN/Inf leaf entering the quantized
+        # reduce-scatter wire must poison the reduced shard on every
+        # member — q8_encode ships a NaN scale for any non-finite chunk,
+        # and clamping instead would hide a diverged model behind
+        # healthy-looking int8 codes. Wire-crossing chunks decode to NaN
+        # (NaN scale); the POISONING member's own chunk keeps its raw
+        # Inf/NaN — it accumulates in f32 and never re-rides the lossy
+        # wire. Either way the divergence must surface as non-finite.
+        cols = self._make_ring(store, 3, "q8poison")
+        rng = np.random.default_rng(13)
+        base = rng.standard_normal(600).astype(np.float32)
+
+        def op(r, c):
+            arr = base * (r + 1)
+            if r == 1:
+                arr = arr.copy()
+                arr[5] = np.nan
+                arr[400] = np.inf
+            return c.reduce_scatter(
+                {"w": arr}, ReduceOp.SUM, wire="q8"
+            ).wait()
+
+        shards = _run_all(cols, op)
+        poisoned = [False] * 3
+        for r, sh in enumerate(shards):
+            name = next(iter(sh.values))
+            got = np.asarray(sh.values[name])
+            # reassemble this rank's global positions and check the ones
+            # covering the poisoned elements
+            for (start, ln), off in zip(
+                sh.ranges[name],
+                np.cumsum([0] + [l for _, l in sh.ranges[name]][:-1]),
+            ):
+                seg = got[off:off + ln]
+                for idx in (5, 400):
+                    if start <= idx < start + ln:
+                        assert not np.isfinite(seg[idx - start]), (
+                            f"rank {r}: poisoned element {idx} decoded "
+                            "finite from the q8 reduce-scatter wire"
+                        )
+                        poisoned[r] = True
+        assert any(poisoned), "test bug: no shard covered a poisoned index"
+        for c in cols:
+            c.shutdown()
+
     def test_ungridded_q8_shard_beats_fused_loss(self, store):
         # Production mode (grid_shard=False): the owned shard skips the
         # lossy phase-2 quantization entirely, so its values must match

@@ -154,3 +154,104 @@ class TestLauncher:
             max_restarts=1,
         )
         assert rc == 1
+
+
+class TestRenicePriorityProbe:
+    """Spawn-time setpriority capability probe (VERDICT item 4): standbys
+    only warm at nice 19 when the supervisor can lift a promoted one back
+    to 0 — never leave a promoted worker training at idle priority."""
+
+    def test_cap_sys_nice_in_capeff_allows(self):
+        from torchft_tpu.launcher import _can_lift_priority
+
+        # CAP_SYS_NICE is bit 23
+        assert _can_lift_priority(
+            status_text="Name:\tx\nCapEff:\t0000000000800000\n",
+            rlimit_nice=0,
+        )
+
+    def test_no_cap_no_rlimit_denies(self):
+        from torchft_tpu.launcher import _can_lift_priority
+
+        assert not _can_lift_priority(
+            status_text="Name:\tx\nCapEff:\t0000000000000000\n",
+            rlimit_nice=0,
+        )
+
+    def test_root_without_cap_sys_nice_denies(self, monkeypatch):
+        # The kernel's can_nice() is capability-based: root in a
+        # --cap-drop SYS_NICE container cannot lift a niced child, and
+        # euid 0 must NOT short-circuit the CapEff verdict.
+        import torchft_tpu.launcher as launcher_mod
+
+        monkeypatch.setattr(launcher_mod.os, "geteuid", lambda: 0)
+        assert not launcher_mod._can_lift_priority(
+            status_text="Name:\tx\nCapEff:\t0000000000000000\n",
+            rlimit_nice=0,
+        )
+        # euid 0 only decides when no capability info exists at all
+        assert launcher_mod._can_lift_priority(
+            status_text="Name:\tx\n", rlimit_nice=0
+        )
+
+    def test_rlimit_nice_allowance_allows(self):
+        from torchft_tpu.launcher import _can_lift_priority
+
+        # soft RLIMIT_NICE of 20 admits raising priority to nice 0
+        assert _can_lift_priority(
+            status_text="Name:\tx\nCapEff:\t0000000000000000\n",
+            rlimit_nice=20,
+        )
+        assert not _can_lift_priority(
+            status_text="Name:\tx\nCapEff:\t0000000000000000\n",
+            rlimit_nice=19,
+        )
+        # RLIM_INFINITY reads as -1: unlimited allowance, must allow
+        assert _can_lift_priority(
+            status_text="Name:\tx\nCapEff:\t0000000000000000\n",
+            rlimit_nice=-1,
+        )
+
+    def test_unprivileged_supervisor_never_nices_standby(
+        self, tmp_path, monkeypatch
+    ):
+        # With the probe forced to "cannot lift", the standby must warm
+        # at the supervisor's own niceness (NOT 19) so a promotion never
+        # yields a permanently-deprioritized primary.
+        import os
+
+        import torchft_tpu.launcher as launcher_mod
+
+        monkeypatch.setattr(launcher_mod, "_can_lift_priority", lambda: False)
+        script = tmp_path / "spare_nice.py"
+        script.write_text(
+            "import os, sys\n"
+            f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+            "from torchft_tpu.platform import standby_gate\n"
+            "d = os.path.dirname(os.path.abspath(__file__))\n"
+            "if os.environ.get('TORCHFT_STANDBY_FILE'):\n"
+            "    nice = os.nice(0)\n"
+            "    standby_gate()\n"
+            "    with open(os.path.join(d, 'promoted_nice'), 'w') as f:\n"
+            "        f.write(str(nice))\n"
+            "    sys.exit(0)\n"
+            "if not os.path.exists(os.path.join(d, 'died')):\n"
+            "    open(os.path.join(d, 'died'), 'w').close()\n"
+            "    sys.exit(1)\n"
+            "sys.exit(0)\n"
+        )
+        rc = launcher_mod.launch(
+            [sys.executable, str(script)],
+            num_replica_groups=1,
+            lighthouse_addr="http://unused:1",
+            max_restarts=2,
+            hot_spare=True,
+        )
+        assert rc == 0
+        base_nice = os.nice(0)
+        promoted_nice = int((tmp_path / "promoted_nice").read_text())
+        assert promoted_nice == base_nice, (
+            f"promoted standby ran at nice {promoted_nice} (supervisor "
+            f"{base_nice}): an unliftable supervisor must not warm "
+            "standbys at idle priority"
+        )

@@ -94,8 +94,13 @@ def test_ep_sharded_matches_unsharded():
     sharded = jax.jit(
         lambda p, t: moe.loss_fn(cfg_sh, p, t)
     )(sharded_params, tokens)
+    # Sharding changes the reduction order (per-device partial sums over
+    # the expert/model axes) and the model computes in bf16, so the two
+    # losses agree to bf16-class accuracy, not f32: observed relative
+    # drift ~7e-4 on CPU. 3e-3 keeps ~4x headroom while still catching a
+    # routing/sharding bug (those diverge at the 1e-1 scale).
     np.testing.assert_allclose(
-        float(sharded), float(base), atol=1e-4, rtol=1e-4
+        float(sharded), float(base), atol=3e-3, rtol=3e-3
     )
 
 

@@ -25,7 +25,7 @@ from torchft_tpu.collectives import (
 )
 from torchft_tpu.data import DistributedSampler, StatefulDataLoader
 from torchft_tpu.durable import DurableCheckpointer
-from torchft_tpu.ddp import DistributedDataParallel, PipelinedDDP
+from torchft_tpu.ddp import AdaptiveDDP, DistributedDataParallel, PipelinedDDP
 from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
@@ -36,6 +36,7 @@ from torchft_tpu.train_state import FTTrainState
 from torchft_tpu.xla_collectives import XLACollectives
 
 __all__ = [
+    "AdaptiveDDP",
     "AsyncDiLoCo",
     "CheckpointServer",
     "CheckpointTransport",

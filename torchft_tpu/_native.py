@@ -254,6 +254,37 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64,
     ]
+    # Persistent comm plans: a precompiled per-signature gradient sync
+    # executed each step as ONE GIL-released native call (consumed by
+    # torchft_tpu.collectives.HostCollectives.plan_allreduce).
+    lib.tft_plan_build.restype = ctypes.c_int64
+    lib.tft_plan_build.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),  # per-leaf flat element counts
+        ctypes.POINTER(ctypes.c_int32),  # per-leaf native dtype codes
+        ctypes.c_int64,                  # leaf count
+        ctypes.c_int,                    # wire: 0 native, 1 bf16, 2 q8, 3 q8+EF
+    ]
+    lib.tft_plan_execute.restype = ctypes.c_int
+    lib.tft_plan_execute.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,                  # plan id
+        ctypes.POINTER(ctypes.c_void_p),  # leaf input pointers
+        ctypes.POINTER(ctypes.c_void_p),  # leaf output pointers
+        ctypes.c_double,                 # divisor
+        ctypes.c_int,                    # has_divisor
+        ctypes.c_int64,
+    ]
+    lib.tft_plan_free.restype = ctypes.c_int
+    lib.tft_plan_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tft_plan_reset_feedback.restype = ctypes.c_int
+    lib.tft_plan_reset_feedback.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tft_plan_stats_json.restype = ctypes.c_int
+    lib.tft_plan_stats_json.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
     return lib
 
 

@@ -139,6 +139,15 @@ class StoreClient:
 # shard_ranges(count, esize, rank, layout_stripes)) are declared on the
 # loaded CDLL in _load_lib and consumed by
 # torchft_tpu.collectives.HostCollectives, the typed wrapper.
+#
+# Persistent comm plans ride the same CDLL surface:
+# tft_plan_build(handle, counts, dtypes, n_leaves, wire) -> plan_id,
+# tft_plan_execute(handle, plan_id, leaf_in_ptrs, leaf_out_ptrs, divisor,
+# has_divisor, timeout_ms), tft_plan_free(handle, plan_id),
+# tft_plan_reset_feedback(handle, plan_id) (zeroes a q8+EF plan's
+# error-feedback carry), tft_plan_stats_json(handle, plan_id, out) (the
+# last execute's per-bucket phase timings). Plans are invalidated by
+# tft_hc_configure; wire codes: 0 native dtypes, 1 bf16, 2 q8, 3 q8+EF.
 
 
 def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict: ...

@@ -24,6 +24,7 @@ the duration of each native call.
 from __future__ import annotations
 
 import ctypes
+import json
 import os
 import threading
 import time
@@ -228,6 +229,37 @@ class Collectives(ABC):
         feedback should treat the RETURNED tree as what was shipped.
         Implementations without a quantized wire may raise for it."""
 
+    # Planned ops: not abstract — backends without a persistent native
+    # plan keep working; callers feature-detect by catching
+    # NotImplementedError (the adaptive DDP mode does exactly that).
+    def plan_allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Like :meth:`allreduce` (SUM/AVG only) but through a persistent
+        precompiled comm plan: the leaf->bucket layout, dtype casts, wire
+        encoding and staging buffers are compiled once per tree signature
+        and each step is a single GIL-released native call — no per-step
+        ``tree_flatten -> astype -> concatenate -> tobytes`` Python work
+        on the gradient hot path. Results are bit-identical to the
+        legacy managed path. ``wire``: ``None`` ships native dtypes,
+        ``"bf16"`` rounds f32 leaves to bfloat16 on the wire, ``"q8"``
+        ships int8 ring chunks, ``"q8ef"`` adds the per-leaf int8
+        quantization with error feedback (the carry persists inside the
+        plan; see :meth:`plan_reset_feedback`)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no persistent comm plans"
+        )
+
+    def plan_reset_feedback(self) -> None:
+        """Zeroes the error-feedback carry of every cached ``q8ef`` plan
+        (no-op for backends without plans): call on heal/abort — a
+        recovered member must not carry a residual from its abandoned
+        trajectory."""
+
     # Sharded split ops: not abstract — backends whose transport has no
     # reduce-scatter boundary to expose (XLA's in-program psum is already
     # bandwidth-optimal in-chip) keep working; callers feature-detect by
@@ -387,6 +419,70 @@ class _DevicePacker:
         self.unpack = jax.jit(unpack)
 
 
+# Python wire names -> native PlanWire codes (collectives.h).
+_PLAN_WIRES = {None: 0, "bf16": 1, "q8": 2, "q8ef": 3}
+
+
+class _CommPlan:
+    """Python handle for one native CommPlan.
+
+    Everything a step needs is allocated HERE, once: the input pointer
+    array, and two alternating sets of output leaf arrays (a caller may
+    still hold step k's result while step k+1 executes — PipelinedDDP's
+    one-step overlap — so outputs double-buffer; a result older than two
+    executes is clobbered). Steady-state execute therefore performs zero
+    Python-side staging allocation: the only per-step Python work is
+    writing leaf pointers.
+    """
+
+    def __init__(self, handle: Any, sig: Sequence[Any], treedef: Any,
+                 wire: Optional[str]) -> None:
+        self.treedef = treedef
+        self.sig = tuple(sig)
+        self.wire = wire
+        n = len(self.sig)
+        counts = [int(np.prod(s)) if s else 1 for s, _ in self.sig]
+        # KeyError on a non-native dtype: the caller treats it as
+        # "unsupported signature" and falls back to the legacy path.
+        codes = [_NATIVE_DTYPES[dt] for _, dt in self.sig]
+        plan_id = _lib.tft_plan_build(
+            handle,
+            (ctypes.c_int64 * n)(*counts),
+            (ctypes.c_int32 * n)(*codes),
+            n,
+            _PLAN_WIRES[wire],
+        )
+        if plan_id < 0:
+            _check(2)
+        self.plan_id = plan_id
+        self._handle = handle
+        self.in_ptrs = (ctypes.c_void_p * n)()
+        self.out_sets: List[List[np.ndarray]] = []
+        self.out_ptrs: List[Any] = []
+        for _ in range(2):
+            outs = [np.empty(s, dt) for s, dt in self.sig]
+            self.out_sets.append(outs)
+            self.out_ptrs.append(
+                (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+            )
+        self.flip = 0
+        self.execs = 0
+        self.bytes = sum(
+            c * np.dtype(dt).itemsize for c, (_, dt) in zip(counts, self.sig)
+        )
+        if wire in ("q8", "q8ef"):
+            # int8 codes + per-chunk scales: ~1 wire byte per element
+            self.wire_bytes = sum(counts)
+        elif wire == "bf16":
+            self.wire_bytes = sum(
+                c * (2 if np.dtype(dt) == np.dtype(np.float32)
+                     else np.dtype(dt).itemsize)
+                for c, (_, dt) in zip(counts, self.sig)
+            )
+        else:
+            self.wire_bytes = self.bytes
+
+
 class HostCollectives(Collectives):
     """Deterministic TCP ring collectives (native C++), the Gloo role.
 
@@ -449,6 +545,11 @@ class HostCollectives(Collectives):
         )
         self._shutdown = False
         self._packers: dict = {}
+        # Persistent comm plans keyed by (wire, treedef, signature); a
+        # None value marks a signature the plan path cannot take (the
+        # legacy path serves it). Invalidated wholesale on configure() —
+        # the native layer drops its side at the same moment.
+        self._plans: dict = {}
         # Per-op phase timings recorded by the device-packed paths (see
         # pop_op_stats): on tunneled device runtimes the d2h leg can cost
         # 10x the ring leg, and nothing else distinguishes them.
@@ -486,6 +587,13 @@ class HostCollectives(Collectives):
         collective's transfer cost from its wire cost — per-step DDP on a
         degraded device link is diagnosable only with this split."""
         out, self._op_stats = self._op_stats, []
+        for st in out:
+            # Plan entries carry their native per-bucket stats as a raw
+            # JSON string (decoding per step would put a parse on the
+            # zero-Python hot path); decode at drain time.
+            raw = st.pop("_buckets_json", None)
+            if raw is not None:
+                st["buckets"] = json.loads(raw).get("buckets", [])
         return out
 
     # -- lifecycle --
@@ -539,6 +647,10 @@ class HostCollectives(Collectives):
             # the new size, earlier ones the old — never a mix.
             self._rank = rank
             self._world_size = world_size
+            # The native side just dropped every plan (their layout bakes
+            # in the old ring); drop the Python handles in the same
+            # ordered position so no queued op can execute a stale id.
+            self._plans = {}
 
         self._executor.submit(do_configure).result()
 
@@ -747,7 +859,10 @@ class HostCollectives(Collectives):
                 elif np.issubdtype(buf.dtype, np.floating):
                     buf /= divisor
                 else:
-                    buf //= divisor
+                    # int groups floor-divide by the integral divisor
+                    # (the _divide_leaf contract); ``//= float`` would
+                    # raise an unsafe-cast error in-place.
+                    buf //= int(divisor)
             offset = 0
             for i in idxs:
                 n = arrays[i].size
@@ -867,7 +982,7 @@ class HostCollectives(Collectives):
         if np.issubdtype(arr.dtype, np.floating):
             arr /= divisor
             return arr
-        arr //= divisor
+        arr //= int(divisor)
         return arr
 
     def _ring_chunk(self, arr: np.ndarray, native_op: int, timeout_ms: int) -> None:
@@ -881,6 +996,151 @@ class HostCollectives(Collectives):
                 timeout_ms,
             )
         )
+
+    # -- planned ops --
+
+    def plan_allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """The plan-path allreduce (see Collectives.plan_allreduce): one
+        native call per step over a cached, precompiled plan. Bit-identical
+        to the legacy managed path — the plan executes the identical
+        per-group stripe partition through the same native ring bodies.
+        Unsupported signatures (non-native leaf dtypes; q8 wires with
+        non-float leaves) silently take the legacy path with equivalent
+        semantics where one exists (``wire=None``), else raise."""
+        timeout_ms = _ms(self._timeout)
+        if wire not in _PLAN_WIRES:
+            raise ValueError(f"unsupported wire: {wire!r}")
+        if op == ReduceOp.AVG:
+            if divisor is not None:
+                # Mirror the legacy path's loud error — silently
+                # replacing a caller's participant divisor with
+                # world_size would corrupt the average whenever
+                # participants < world.
+                raise ValueError("divisor only composes with ReduceOp.SUM")
+            divisor, op = float(self._world_size), ReduceOp.SUM
+        if op != ReduceOp.SUM:
+            raise ValueError("plan_allreduce supports SUM/AVG only")
+        return self._submit(
+            lambda: self._plan_allreduce_sync(tree, divisor, wire, timeout_ms)
+        )
+
+    def _plan_for(
+        self, leaves: Sequence[Any], treedef: Any, wire: Optional[str]
+    ) -> Optional[_CommPlan]:
+        # The signature MUST stay in the key: executing a plan against a
+        # same-treedef tree with different shapes/dtypes would pack with
+        # the wrong per-leaf counts (reading past leaf buffers). It is
+        # computed once here and handed to the plan, never recomputed.
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        key = (wire, treedef, sig)
+        if key in self._plans:
+            return self._plans[key]
+        try:
+            plan: Optional[_CommPlan] = _CommPlan(
+                self._handle, sig, treedef, wire
+            )
+        except (KeyError, RuntimeError):
+            # Non-native leaf dtype, or a wire/dtype combination the
+            # native plan rejects: remember the verdict so the per-step
+            # path doesn't re-attempt the build.
+            plan = None
+        self._plans[key] = plan
+        return plan
+
+    def _plan_allreduce_sync(
+        self,
+        tree: Any,
+        divisor: Optional[float],
+        wire: Optional[str],
+        timeout_ms: int,
+    ) -> Any:
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return tree
+        plan = self._plan_for(leaves, treedef, wire)
+        if plan is None:
+            if wire is None:
+                return self._allreduce_sync(
+                    tree, ReduceOp.SUM, timeout_ms, divisor
+                )
+            if wire in ("q8", "q8ef"):
+                raise ValueError(
+                    "plan wire 'q8'/'q8ef' requires f32/bf16 leaves"
+                )
+            raise ValueError(
+                "plan wire 'bf16' requires native-dtype leaves"
+            )
+        t0 = time.perf_counter()
+        staging_allocs = 0
+        refs = []  # keep host views alive across the native call
+        in_ptrs = plan.in_ptrs
+        for i, l in enumerate(leaves):
+            a = np.asarray(l)  # zero-copy for numpy / CPU jax leaves
+            if not a.flags.c_contiguous:
+                a = np.ascontiguousarray(a)
+                staging_allocs += 1
+            refs.append(a)
+            in_ptrs[i] = a.ctypes.data
+        t1 = time.perf_counter()
+        outs = plan.out_sets[plan.flip]
+        out_ptrs = plan.out_ptrs[plan.flip]
+        plan.flip ^= 1
+        _check(
+            _lib.tft_plan_execute(
+                self._handle,
+                plan.plan_id,
+                in_ptrs,
+                out_ptrs,
+                float(divisor if divisor is not None else 1.0),
+                0 if divisor is None else 1,
+                timeout_ms,
+            )
+        )
+        ring_s = time.perf_counter() - t1
+        del refs
+        plan.execs += 1
+        self._record_op_stats({
+            "op": "plan_allreduce",
+            "wire": wire,
+            "bytes": plan.bytes,
+            "wire_bytes": plan.wire_bytes,
+            "d2h": t1 - t0,  # pointer gather; host leaves make it ~free
+            "ring": ring_s,  # the single native call: pack+ring+unpack
+            # Per-bucket phases, fetched raw here and decoded lazily at
+            # pop_op_stats: the JSON parse stays off the per-step path.
+            "_buckets_json": self._plan_stats_json(plan.plan_id),
+            # The zero-allocation contract: after warmup, no Python-side
+            # staging buffer is allocated on this path (only forced
+            # copies of non-contiguous inputs would count here).
+            "py_staging_allocs": staging_allocs,
+            "plan_execs": plan.execs,
+        })
+        return _unflatten(treedef, outs)
+
+    def _plan_stats_json(self, plan_id: int) -> str:
+        out = ctypes.c_void_p()
+        _check(_lib.tft_plan_stats_json(self._handle, plan_id, ctypes.byref(out)))
+        return _native._take_string(out)
+
+    def plan_reset_feedback(self) -> None:
+        """Zeroes the EF carry of every cached q8ef plan (heal/abort
+        discipline). Runs on the op thread so it cannot interleave with an
+        in-flight execute."""
+        def reset() -> None:
+            for plan in self._plans.values():
+                if plan is not None and plan.wire == "q8ef":
+                    _check(
+                        _lib.tft_plan_reset_feedback(
+                            self._handle, plan.plan_id
+                        )
+                    )
+        self._submit(reset).wait()
 
     def allgather(self, tree: Any) -> Work:
         timeout_ms = _ms(self._timeout)
@@ -1357,6 +1617,21 @@ class DummyCollectives(Collectives):
                 lambda l: _divide_leaf(l, divisor), tree
             )
         return _completed(tree)
+
+    def plan_allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,  # accepted, ignored (lossless fake)
+    ) -> Work:
+        """Same lossless semantics as the fake allreduce — wrapper tests
+        exercise the plan-path call shape without a ring."""
+        if op == ReduceOp.AVG:
+            if divisor is not None:
+                raise ValueError("divisor only composes with ReduceOp.SUM")
+            divisor = float(self._world_size)
+        return self.allreduce(tree, ReduceOp.SUM, divisor=divisor)
 
     def reduce_scatter(
         self,

@@ -64,6 +64,55 @@ def replica_group_spec(
     }
 
 
+def _can_lift_priority(
+    status_text: Optional[str] = None, rlimit_nice: Optional[int] = None
+) -> bool:
+    """Whether this supervisor can LOWER a child's nice value later
+    (promote a standby from nice 19 back to 0). Raising priority needs
+    CAP_SYS_NICE or an RLIMIT_NICE allowance; setting nice is always
+    allowed, which is exactly the trap: a supervisor that warms standbys
+    at nice 19 but cannot lift a promoted one leaves it training at
+    idle priority forever (VERDICT item 4). Probed once at spawn time so
+    the decision is made BEFORE any standby is niced.
+
+    The kernel's can_nice() check is CAPABILITY-based, so CapEff is the
+    authority: euid 0 alone is NOT sufficient (a root process in a
+    --cap-drop SYS_NICE container cannot lift either), and is only used
+    as a fallback when /proc is unreadable. Parameterized for tests."""
+    CAP_SYS_NICE = 23
+    capeff: Optional[int] = None
+    try:
+        if status_text is None:
+            with open("/proc/self/status") as f:
+                status_text = f.read()
+        for line in status_text.splitlines():
+            if line.startswith("CapEff:"):
+                capeff = int(line.split()[1], 16)
+                break
+    except (OSError, ValueError, IndexError):
+        capeff = None
+    if capeff is not None and capeff & (1 << CAP_SYS_NICE):
+        return True
+    try:
+        if rlimit_nice is None:
+            import resource
+
+            rlimit_nice = resource.getrlimit(resource.RLIMIT_NICE)[0]
+        # soft RLIMIT_NICE admits raising priority to 20 - rlim_cur;
+        # RLIM_INFINITY reads as -1, i.e. unlimited allowance
+        if rlimit_nice >= 20 or rlimit_nice < 0:
+            return True
+    except (ImportError, AttributeError, OSError, ValueError):
+        pass
+    if capeff is None:
+        # No capability information (no /proc): fall back to euid.
+        try:
+            return os.geteuid() == 0
+        except AttributeError:
+            return False
+    return False
+
+
 @dataclass
 class _Supervised:
     spec: Dict[str, object]
@@ -102,6 +151,19 @@ def launch(
     import uuid as _uuid
 
     standby_dir = tempfile.mkdtemp(prefix="torchft_standby_") if hot_spare else None
+    # Probe ONCE, at spawn time: standbys only warm at idle priority when
+    # the supervisor can lift them back at promotion. Without the
+    # capability, warming un-niced costs some contention during warm-up
+    # but a promoted worker trains at full priority — the reverse trade
+    # (a permanently nice-19 primary) is never acceptable.
+    lift_ok = _can_lift_priority() if hot_spare else False
+    if hot_spare and not lift_ok:
+        logger.warning(
+            "hot-spare standbys warm at NORMAL priority: this supervisor "
+            "cannot lift a niced child back to 0 (no CAP_SYS_NICE / root "
+            "/ RLIMIT_NICE allowance), and a promoted worker must never "
+            "keep training at nice 19"
+        )
     groups = [
         _Supervised(
             replica_group_spec(
@@ -119,17 +181,21 @@ def launch(
             s.standby_file = os.path.join(standby_dir, _uuid.uuid4().hex)
             full_env["TORCHFT_STANDBY_FILE"] = s.standby_file
 
-            def preexec() -> None:  # runs in the child pre-exec
-                # Standbys warm (imports + jit) at IDLE priority so
-                # re-arming after a promotion never steals cycles from
-                # live training — without this, the warm-up contends with
-                # every group on shared-CPU hosts and costs more
-                # throughput than the promotion saves (measured: churn
-                # ratio 0.742 vs 0.9+ with cold restarts).
-                try:
-                    os.nice(19)
-                except OSError:
-                    pass
+            if lift_ok:
+
+                def preexec() -> None:  # runs in the child pre-exec
+                    # Standbys warm (imports + jit) at IDLE priority so
+                    # re-arming after a promotion never steals cycles
+                    # from live training — without this, the warm-up
+                    # contends with every group on shared-CPU hosts and
+                    # costs more throughput than the promotion saves
+                    # (measured: churn ratio 0.742 vs 0.9+ with cold
+                    # restarts). Gated on lift_ok: nicing is only safe
+                    # when promotion can undo it.
+                    try:
+                        os.nice(19)
+                    except OSError:
+                        pass
         else:
             full_env.pop("TORCHFT_STANDBY_FILE", None)
         proc = subprocess.Popen(
@@ -151,17 +217,19 @@ def launch(
             open(s.standby_file, "w").close()  # releases standby_gate()
             s.proc = s.standby
             s.standby = None
-            try:
-                # Promotion lifts the idle priority the standby warmed at.
-                # Needs CAP_SYS_NICE (or root); if unavailable the promoted
-                # worker keeps nice 19 — run the supervisor with the
-                # capability in production hot-spare deployments.
-                os.setpriority(os.PRIO_PROCESS, s.proc.pid, 0)
-            except (OSError, AttributeError):
-                logger.warning(
-                    f"{s.spec['name']}: could not lift standby priority "
-                    "(needs CAP_SYS_NICE); promoted worker stays niced"
-                )
+            if lift_ok:
+                # Promotion lifts the idle priority the standby warmed
+                # at (the spawn-time probe guaranteed this works; when
+                # it doesn't, the standby never warmed niced and there
+                # is nothing to lift).
+                try:
+                    os.setpriority(os.PRIO_PROCESS, s.proc.pid, 0)
+                except (OSError, AttributeError):
+                    logger.warning(
+                        f"{s.spec['name']}: could not lift standby "
+                        "priority despite the spawn-time probe; promoted "
+                        "worker may stay niced"
+                    )
             logger.info(f"{s.spec['name']}: promoted standby pid {s.proc.pid}")
             spawn(s, as_standby=True)  # re-arm (idle priority again)
         else:

@@ -438,6 +438,52 @@ class Manager:
 
         return self._managed_dispatch("allreduce", tree, dispatch, lambda t: t)
 
+    def plan_allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.AVG,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Fault-tolerantly averages a gradient pytree through a
+        persistent precompiled comm plan (one GIL-released native call
+        per step — see Collectives.plan_allreduce). Same quorum and
+        latching discipline as :meth:`allreduce`, with one difference in
+        the failure default: a failed plan execute resolves to ``None``
+        (not the input tree) — the plan's persistent output buffers may
+        hold a partial unpack, so there is no meaningful "as contributed"
+        tree to return. The error latches and ``should_commit`` discards
+        the step; callers must treat a ``None`` result as an aborted
+        sync, never as data. Plans are invalidated (and transparently
+        rebuilt) whenever the quorum changes — configure() drops them
+        with the old ring. ``wire``: None | "bf16" | "q8" | "q8ef"
+        (native error feedback; reset the carry on heal via
+        :meth:`reset_plan_feedback`)."""
+        if op not in (ReduceOp.AVG, ReduceOp.SUM):
+            # Static usage error: raise eagerly, don't latch.
+            raise ValueError(f"unsupported managed plan_allreduce op: {op}")
+
+        def dispatch(zeroed_tree: Any) -> Work:
+            if op == ReduceOp.AVG:
+                num_participants = self.num_participants()
+                assert num_participants >= 1
+                divisor: Optional[float] = float(num_participants)
+            else:
+                divisor = None
+            return self._collectives.plan_allreduce(
+                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire
+            )
+
+        return self._managed_dispatch(
+            "plan_allreduce", tree, dispatch, lambda t: None
+        )
+
+    def reset_plan_feedback(self) -> None:
+        """Zeroes the error-feedback carry of every cached ``q8ef`` comm
+        plan (no-op for backends without plans): the heal/abort
+        discipline — a recovered or rolled-back member must not carry a
+        residual from its abandoned trajectory."""
+        self._collectives.plan_reset_feedback()
+
     def reduce_scatter(
         self,
         tree: Any,

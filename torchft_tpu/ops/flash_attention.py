@@ -474,7 +474,18 @@ def flash_attention(
             bounds, so computed tiles scale with S*window instead of
             S^2/2 (wall-clock gains show once S/window is large).
             Requires ``causal``.
-        sm_scale: score scale; default ``head_dim ** -0.5``.
+        sm_scale: score scale; default ``head_dim ** -0.5``. The scale
+            is folded into ``q`` OUTSIDE the kernel as one f32 multiply
+            rounded back to the input dtype (it removes a per-tile
+            (S_q, S_k) multiply from every kernel). For POWER-OF-TWO
+            scales — any power-of-two head_dim, e.g. 64 -> 0.125 — the
+            fold is exact in every float dtype. CAVEAT: a
+            non-power-of-two ``sm_scale`` with bf16/f16 inputs rounds
+            each scaled q element once (<= 1/2 ulp; ~0.4% relative at
+            bf16) BEFORE the scores are formed, so scores are not
+            bit-equal to an unfused baseline that scales the f32
+            logits. Numerically benign for training; pass f32 q/k/v or
+            a power-of-two scale when exactness matters.
         block_q, block_k: VMEM tile sizes; clamped to S, and on real TPU
             rounded UP to 128-multiples (Mosaic's lane-aligned store
             requirement — a requested 64 runs as 128 on hardware;
