@@ -158,16 +158,18 @@ std::pair<size_t, size_t> HostCollectives::stripe_range(size_t count,
 
 HostCollectives::~HostCollectives() {
   abort();
+  std::vector<std::thread> workers;
   {
-    std::lock_guard<std::mutex> lock(pool_mu_);
+    MutexLock lock(pool_mu_);
     pool_stop_ = true;
+    workers.swap(pool_);
   }
   pool_cv_.notify_all();
-  for (auto& w : pool_) w.join();
+  for (auto& w : workers) w.join();
 }
 
 void HostCollectives::abort() {
-  std::lock_guard<std::mutex> lock(cfg_mu_);
+  MutexLock lock(cfg_mu_);
   aborted_ = true;
   abort_epoch_++;
   if (listener_) listener_->close();
@@ -176,7 +178,7 @@ void HostCollectives::abort() {
 }
 
 void HostCollectives::shutdown_sockets() {
-  std::lock_guard<std::mutex> lock(cfg_mu_);
+  MutexLock lock(cfg_mu_);
   for (auto& s : next_) s.shutdown_rdwr();
   for (auto& s : prev_) s.shutdown_rdwr();
 }
@@ -203,7 +205,7 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     throw SocketError("bad stripe count (want 1.." +
                       std::to_string(kMaxStripes) + ")");
   abort(); // unblock any op stuck on the old ring
-  std::lock_guard<std::mutex> op_lock(op_mu_); // wait for it to drain
+  MutexLock op_lock(op_mu_); // wait for it to drain
 
   {
     // Comm plans bake in (world_size, stripes) layout arithmetic and
@@ -211,7 +213,7 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     // stale the moment membership changes. Dropping them here (no
     // execute can be in flight — op_mu_ is held) turns a stale plan id
     // into a descriptive error instead of a desynced wire schedule.
-    std::lock_guard<std::mutex> plan_lock(plan_mu_);
+    MutexLock plan_lock(plan_mu_);
     plans_.clear();
   }
 
@@ -219,7 +221,7 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   // new listener so a concurrent abort() can close it and wake phase 2.
   int64_t epoch;
   {
-    std::lock_guard<std::mutex> lock(cfg_mu_);
+    MutexLock lock(cfg_mu_);
     next_.clear();
     prev_.clear();
     listener_.reset();
@@ -295,7 +297,7 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   }
 
   // Phase 3: publish the new ring unless an abort raced in.
-  std::lock_guard<std::mutex> lock(cfg_mu_);
+  MutexLock lock(cfg_mu_);
   if (abort_epoch_ != epoch) throw SocketError("aborted during configure");
   next_ = std::move(next_socks);
   prev_ = std::move(prev_socks);
@@ -454,7 +456,7 @@ void HostCollectives::run_striped(const std::function<void(int64_t)>& fn) {
     std::function<void(int64_t)> body_fn = body;
     ensure_pool(n - 1);
     {
-      std::lock_guard<std::mutex> lock(pool_mu_);
+      MutexLock lock(pool_mu_);
       pool_body_ = &body_fn;
       pool_n_ = n;
       pool_pending_ = n - 1;
@@ -463,8 +465,8 @@ void HostCollectives::run_striped(const std::function<void(int64_t)>& fn) {
     pool_cv_.notify_all();
     body(0);
     {
-      std::unique_lock<std::mutex> lock(pool_mu_);
-      pool_done_cv_.wait(lock, [&] { return pool_pending_ == 0; });
+      UniqueMutexLock lock(pool_mu_);
+      while (pool_pending_ != 0) pool_done_cv_.wait(lock);
       pool_body_ = nullptr;
     }
   }
@@ -473,7 +475,7 @@ void HostCollectives::run_striped(const std::function<void(int64_t)>& fn) {
 }
 
 void HostCollectives::ensure_pool(int64_t workers) {
-  std::lock_guard<std::mutex> lock(pool_mu_);
+  MutexLock lock(pool_mu_);
   while (static_cast<int64_t>(pool_.size()) < workers) {
     // Seed each worker with the CURRENT generation (stable under pool_mu_):
     // a fresh thread must not mistake an already-running or past job for
@@ -489,9 +491,8 @@ void HostCollectives::pool_main(int64_t idx, int64_t start_gen) {
     const std::function<void(int64_t)>* body;
     int64_t n;
     {
-      std::unique_lock<std::mutex> lock(pool_mu_);
-      pool_cv_.wait(lock,
-                    [&] { return pool_stop_ || pool_gen_ != seen_gen; });
+      UniqueMutexLock lock(pool_mu_);
+      while (!pool_stop_ && pool_gen_ == seen_gen) pool_cv_.wait(lock);
       if (pool_stop_) return;
       seen_gen = pool_gen_;
       body = pool_body_;
@@ -501,7 +502,7 @@ void HostCollectives::pool_main(int64_t idx, int64_t start_gen) {
     // effective stripes) don't count the spare workers in pool_pending_.
     if (idx + 1 < n) {
       (*body)(idx + 1);
-      std::lock_guard<std::mutex> lock(pool_mu_);
+      MutexLock lock(pool_mu_);
       if (--pool_pending_ == 0) pool_done_cv_.notify_all();
     }
   }
@@ -555,7 +556,7 @@ void HostCollectives::allreduce_stripe(int64_t s, char* bytes, size_t count,
 
 void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
                                 ReduceOp op, int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   run_op([&] {
@@ -681,7 +682,7 @@ void HostCollectives::allreduce_q8_stripe(int64_t s, float* data, size_t count,
 
 void HostCollectives::allreduce_q8(float* data, size_t count,
                                    int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   run_op([&] {
@@ -703,7 +704,7 @@ void HostCollectives::allreduce_q8(float* data, size_t count,
 
 void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
                                 int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   char* slots = static_cast<char*>(out);
   memcpy(slots + rank_ * nbytes, in, nbytes);
@@ -765,7 +766,7 @@ void HostCollectives::reduce_scatter(void* data, size_t count, Dtype dtype,
                                      ReduceOp op, void* shard_out,
                                      int64_t layout_stripes,
                                      int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   size_t esize = dtype_size(dtype);
   if (world_size_ == 1) {
@@ -802,7 +803,7 @@ void HostCollectives::reduce_scatter_q8(float* data, size_t count,
                                         float* shard_out, bool grid_shard,
                                         int64_t layout_stripes,
                                         int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) {
     memcpy(shard_out, data, count * sizeof(float));
@@ -846,7 +847,7 @@ void HostCollectives::allgather_into(const void* shard, void* data,
                                      size_t count, Dtype dtype,
                                      int64_t layout_stripes,
                                      int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   size_t esize = dtype_size(dtype);
   if (world_size_ == 1) {
@@ -961,13 +962,13 @@ int64_t HostCollectives::plan_build(const int64_t* counts,
   }
   if (wire == PlanWire::kQ8EF) p->residual.assign(total_f32, 0.f);
   p->sig = h;
-  std::lock_guard<std::mutex> lock(plan_mu_);
+  MutexLock lock(plan_mu_);
   plans_[next_plan_id_] = std::move(p);
   return next_plan_id_++;
 }
 
 CommPlan& HostCollectives::plan_get(int64_t plan_id) {
-  std::lock_guard<std::mutex> lock(plan_mu_);
+  MutexLock lock(plan_mu_);
   auto it = plans_.find(plan_id);
   if (it == plans_.end())
     throw SocketError(
@@ -977,19 +978,19 @@ CommPlan& HostCollectives::plan_get(int64_t plan_id) {
 }
 
 void HostCollectives::plan_free(int64_t plan_id) {
-  std::lock_guard<std::mutex> op_lock(op_mu_);  // no execute in flight
-  std::lock_guard<std::mutex> lock(plan_mu_);
+  MutexLock op_lock(op_mu_);  // no execute in flight
+  MutexLock lock(plan_mu_);
   plans_.erase(plan_id);
 }
 
 void HostCollectives::plan_reset_feedback(int64_t plan_id) {
-  std::lock_guard<std::mutex> op_lock(op_mu_);
+  MutexLock op_lock(op_mu_);
   CommPlan& p = plan_get(plan_id);
   std::fill(p.residual.begin(), p.residual.end(), 0.f);
 }
 
 std::string HostCollectives::plan_stats_json(int64_t plan_id) {
-  std::lock_guard<std::mutex> op_lock(op_mu_);
+  MutexLock op_lock(op_mu_);
   CommPlan& p = plan_get(plan_id);
   JsonObject out;
   out["execs"] = Json(p.execs);
@@ -1182,7 +1183,7 @@ void HostCollectives::plan_execute(int64_t plan_id,
                                    const void* const* leaf_in,
                                    void* const* leaf_out, double divisor,
                                    bool has_divisor, int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   CommPlan& p = plan_get(plan_id);
   p.stats.clear();
   const bool q8 = p.wire == PlanWire::kQ8 || p.wire == PlanWire::kQ8EF;
@@ -1255,7 +1256,7 @@ void HostCollectives::plan_execute(int64_t plan_id,
 
 void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
                                 int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   if (root < 0 || root >= world_size_) throw SocketError("bad broadcast root");
@@ -1286,7 +1287,7 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
 }
 
 void HostCollectives::barrier(int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(op_mu_);
+  MutexLock lock(op_mu_);
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   run_op([&] {

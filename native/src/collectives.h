@@ -32,17 +32,16 @@
 
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "net.h"
+#include "thread_annotations.h"
 
 namespace tft {
 
@@ -396,7 +395,7 @@ class HostCollectives {
       fn();
     } catch (...) {
       {
-        std::lock_guard<std::mutex> lock(cfg_mu_);
+        MutexLock lock(cfg_mu_);
         for (auto& s : next_) s.shutdown_rdwr();
         for (auto& s : prev_) s.shutdown_rdwr();
         aborted_ = true;
@@ -412,11 +411,18 @@ class HostCollectives {
 
   // Guards socket object identity (swap/close) against concurrent abort.
   // Never held across blocking IO, so abort() always runs promptly.
-  std::mutex cfg_mu_;
+  Mutex cfg_mu_;
   // Serializes collective ops (they share the ring sockets and must issue in
   // the same order on every rank anyway).
-  std::mutex op_mu_;
+  Mutex op_mu_;
 
+  // Ring geometry and per-stripe state below ride a DUAL protocol no single
+  // capability can express (so no GUARDED_BY): identity writers (configure)
+  // hold op_mu_ AND cfg_mu_; the op thread reads under op_mu_; pool workers
+  // read with NO lock, synchronized by the pool_mu_ job handoff (the op
+  // thread publishes the job under pool_mu_ while itself holding op_mu_, so
+  // no write can overlap a worker's read). abort()/run_op touch only the
+  // sockets' fds, under cfg_mu_.
   int64_t rank_ = -1;
   int64_t world_size_ = 0;
   int64_t stripes_ = 1;
@@ -437,24 +443,28 @@ class HostCollectives {
   // Stripe worker pool state (all under pool_mu_). Worker `idx` runs stripe
   // `idx + 1` of the current job when that stripe exists (ops can use fewer
   // effective stripes than configured); stripe 0 always runs on the op
-  // thread. op_mu_ guarantees at most one job is in flight.
-  std::mutex pool_mu_;
-  std::condition_variable pool_cv_;       // workers: wait for a new job
-  std::condition_variable pool_done_cv_;  // run_striped: wait for drain
-  const std::function<void(int64_t)>* pool_body_ = nullptr;
-  int64_t pool_gen_ = 0;      // bumped once per job
-  int64_t pool_n_ = 0;        // stripe count of the current job
-  int64_t pool_pending_ = 0;  // participating workers not yet done
-  bool pool_stop_ = false;
-  std::vector<std::thread> pool_;
+  // thread. op_mu_ guarantees at most one job is in flight. The job BODY is
+  // invoked by workers after dropping pool_mu_ (it blocks in socket IO);
+  // its lifetime is the run_striped stack frame, pinned until the
+  // pool_pending_ drain completes.
+  Mutex pool_mu_;
+  CondVar pool_cv_;       // workers: wait for a new job
+  CondVar pool_done_cv_;  // run_striped: wait for drain
+  const std::function<void(int64_t)>* pool_body_ TFT_GUARDED_BY(pool_mu_) =
+      nullptr;
+  int64_t pool_gen_ TFT_GUARDED_BY(pool_mu_) = 0;  // bumped once per job
+  int64_t pool_n_ TFT_GUARDED_BY(pool_mu_) = 0;  // stripe count of the job
+  int64_t pool_pending_ TFT_GUARDED_BY(pool_mu_) = 0;  // workers not yet done
+  bool pool_stop_ TFT_GUARDED_BY(pool_mu_) = false;
+  std::vector<std::thread> pool_ TFT_GUARDED_BY(pool_mu_);
 
   // Comm plans (guarded by plan_mu_ for map identity; a plan's buffers
   // are only ever touched under op_mu_ during execute). Cleared by
   // configure() — ids from an old ring error instead of running with a
   // stale layout.
-  std::mutex plan_mu_;
-  std::map<int64_t, std::unique_ptr<CommPlan>> plans_;
-  int64_t next_plan_id_ = 1;
+  Mutex plan_mu_;
+  std::map<int64_t, std::unique_ptr<CommPlan>> plans_ TFT_GUARDED_BY(plan_mu_);
+  int64_t next_plan_id_ TFT_GUARDED_BY(plan_mu_) = 1;
 };
 
 } // namespace tft

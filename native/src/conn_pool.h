@@ -5,12 +5,12 @@
 // RPC (quorum, should_commit vote) held by one thread would deadlock another.
 #pragma once
 
-#include <mutex>
 #include <string>
 #include <utility>
 #include <vector>
 
 #include "net.h"
+#include "thread_annotations.h"
 
 namespace tft {
 
@@ -24,7 +24,7 @@ class ConnPool {
   // Returns an idle connection or dials a new one.
   Socket acquire() {
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       if (!idle_.empty()) {
         Socket s = std::move(idle_.back());
         idle_.pop_back();
@@ -39,7 +39,7 @@ class ConnPool {
   // simply be dropped by the caller instead.
   void release(Socket s) {
     if (!s.valid()) return;
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     if (idle_.size() < max_idle_) idle_.push_back(std::move(s));
   }
 
@@ -50,8 +50,8 @@ class ConnPool {
   std::string addr_;
   int64_t connect_timeout_ms_;
   size_t max_idle_;
-  std::mutex mu_;
-  std::vector<Socket> idle_;
+  Mutex mu_;
+  std::vector<Socket> idle_ TFT_GUARDED_BY(mu_);
 };
 
 } // namespace tft

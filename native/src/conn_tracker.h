@@ -3,14 +3,13 @@
 // long-lived servers don't accumulate zombie threads or stale fd numbers.
 #pragma once
 
-#include <condition_variable>
 #include <cstdint>
 #include <map>
-#include <mutex>
 #include <sys/socket.h>
 #include <thread>
 
 #include "net.h"
+#include "thread_annotations.h"
 
 namespace tft {
 
@@ -22,7 +21,7 @@ class ConnTracker {
   bool spawn(Socket sock, Fn fn) {
     uint64_t id;
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       if (shutting_down_) return false;
       id = next_id_++;
       fds_[id] = sock.fd();
@@ -30,7 +29,7 @@ class ConnTracker {
     }
     std::thread([this, id, s = std::move(sock), fn = std::move(fn)]() mutable {
       fn(s);
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       fds_.erase(id);
       active_--;
       cv_.notify_all();
@@ -42,19 +41,19 @@ class ConnTracker {
   // thread has finished. Callers must first unblock handlers waiting on
   // their own condition variables.
   void shutdown_all() {
-    std::unique_lock<std::mutex> lock(mu_);
+    UniqueMutexLock lock(mu_);
     shutting_down_ = true;
     for (const auto& [id, fd] : fds_) ::shutdown(fd, SHUT_RDWR);
-    cv_.wait(lock, [&] { return active_ == 0; });
+    while (active_ != 0) cv_.wait(lock);
   }
 
  private:
-  std::mutex mu_;
-  std::condition_variable cv_;
-  std::map<uint64_t, int> fds_;
-  uint64_t next_id_ = 0;
-  size_t active_ = 0;
-  bool shutting_down_ = false;
+  Mutex mu_;
+  CondVar cv_;
+  std::map<uint64_t, int> fds_ TFT_GUARDED_BY(mu_);
+  uint64_t next_id_ TFT_GUARDED_BY(mu_) = 0;
+  size_t active_ TFT_GUARDED_BY(mu_) = 0;
+  bool shutting_down_ TFT_GUARDED_BY(mu_) = false;
 };
 
 } // namespace tft

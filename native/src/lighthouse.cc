@@ -34,7 +34,7 @@ uint16_t Lighthouse::port() const { return listener_->port(); }
 void Lighthouse::shutdown() {
   {
     // Flag + notify under the cv's mutex so waiters can't miss the wakeup.
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     if (shutting_down_.exchange(true)) return;
     quorum_cv_.notify_all();
   }
@@ -55,7 +55,7 @@ void Lighthouse::accept_loop() {
 void Lighthouse::tick_loop() {
   while (!shutting_down_) {
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       quorum_tick_locked();
     }
     struct timespec ts;
@@ -157,7 +157,7 @@ void Lighthouse::handle_conn(Socket& sock) {
           torchft_tpu::LighthouseHeartbeatRequest req;
           req.ParseFromString(payload);
           {
-            std::lock_guard<std::mutex> lock(mu_);
+            MutexLock lock(mu_);
             state_.heartbeats[req.replica_id()] = now_ms();
           }
           send_msg(sock, MsgType::kLighthouseHeartbeatResp,
@@ -186,7 +186,7 @@ void Lighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
 
   int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
 
-  std::unique_lock<std::mutex> lock(mu_);
+  UniqueMutexLock lock(mu_);
   // Joining the quorum is an implicit heartbeat.
   state_.heartbeats[requester.replica_id()] = now_ms();
   state_.participants[requester.replica_id()] =
@@ -374,7 +374,7 @@ void Lighthouse::handle_http(Socket& sock, const std::string& head) {
   } else if (method == "GET" && path == "/status") {
     std::string body;
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       body = render_status_locked();
     }
     http_respond(sock, 200, "text/html", body);
@@ -383,7 +383,7 @@ void Lighthouse::handle_http(Socket& sock, const std::string& head) {
     std::string replica_id = path.substr(9, path.size() - 9 - 5);
     std::string addr;
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       if (state_.prev_quorum.has_value()) {
         for (const auto& p : state_.prev_quorum->participants()) {
           if (p.replica_id() == replica_id) {

@@ -5,9 +5,7 @@
 #pragma once
 
 #include <atomic>
-#include <condition_variable>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -15,6 +13,7 @@
 #include "conn_tracker.h"
 #include "net.h"
 #include "quorum.h"
+#include "thread_annotations.h"
 
 namespace tft {
 
@@ -37,20 +36,20 @@ class Lighthouse {
 
   // Runs one quorum check; called with mu_ held. On success publishes the new
   // quorum (bumping quorum_id only when membership changed) and wakes waiters.
-  void quorum_tick_locked();
+  void quorum_tick_locked() TFT_REQUIRES(mu_);
 
-  std::string render_status_locked();
+  std::string render_status_locked() TFT_REQUIRES(mu_);
 
   LighthouseOpt opt_;
   std::unique_ptr<Listener> listener_;
   std::string hostname_;
 
-  std::mutex mu_;
-  std::condition_variable quorum_cv_;
-  LighthouseState state_;
+  Mutex mu_;
+  CondVar quorum_cv_;
+  LighthouseState state_ TFT_GUARDED_BY(mu_);
   // Broadcast channel equivalent: monotone generation + latest value.
-  int64_t quorum_gen_ = 0;
-  torchft_tpu::Quorum latest_quorum_;
+  int64_t quorum_gen_ TFT_GUARDED_BY(mu_) = 0;
+  torchft_tpu::Quorum latest_quorum_ TFT_GUARDED_BY(mu_);
 
   std::atomic<bool> shutting_down_{false};
   std::thread accept_thread_;

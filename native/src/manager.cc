@@ -31,7 +31,7 @@ Quorum LighthouseClient::quorum(const QuorumMember& requester, int64_t timeout_m
 }
 
 void LighthouseClient::heartbeat(const std::string& replica_id, int64_t timeout_ms) {
-  std::lock_guard<std::mutex> lock(hb_mu_);
+  MutexLock lock(hb_mu_);
   torchft_tpu::LighthouseHeartbeatRequest req;
   req.set_replica_id(replica_id);
   int64_t deadline = now_ms() + timeout_ms;
@@ -81,7 +81,7 @@ std::string ManagerServer::address() const {
 void ManagerServer::shutdown() {
   {
     // Flag + notify under the cv's mutex so waiters can't miss the wakeup.
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     if (shutting_down_.exchange(true)) return;
     quorum_cv_.notify_all();
     commit_cv_.notify_all();
@@ -127,7 +127,7 @@ void ManagerServer::handle_conn(Socket& sock) {
           req.ParseFromString(payload);
           std::optional<std::string> metadata;
           {
-            std::lock_guard<std::mutex> lock(mu_);
+            MutexLock lock(mu_);
             auto it = checkpoint_metadata_.find(req.rank());
             if (it != checkpoint_metadata_.end()) metadata = it->second;
           }
@@ -171,7 +171,7 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
   LOG_INFO("got quorum request for rank " << req.rank());
   int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
 
-  std::unique_lock<std::mutex> lock(mu_);
+  UniqueMutexLock lock(mu_);
   // Stash checkpoint server info for the healing flow.
   checkpoint_metadata_[req.rank()] = req.checkpoint_metadata();
   participants_.insert(req.rank());
@@ -265,7 +265,7 @@ void ManagerServer::handle_should_commit(Socket& sock, const std::string& payloa
                                          << " should_commit=" << req.should_commit());
   int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
 
-  std::unique_lock<std::mutex> lock(mu_);
+  UniqueMutexLock lock(mu_);
   if (!req.should_commit()) should_commit_failures_.insert(req.rank());
   should_commit_count_.insert(req.rank());
   int64_t gen = commit_gen_;

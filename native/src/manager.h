@@ -5,10 +5,8 @@
 #pragma once
 
 #include <atomic>
-#include <condition_variable>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <optional>
 #include <set>
 #include <string>
@@ -19,6 +17,7 @@
 #include "conn_tracker.h"
 #include "net.h"
 #include "quorum.h"
+#include "thread_annotations.h"
 
 namespace tft {
 
@@ -35,8 +34,8 @@ class LighthouseClient {
   std::string addr_;
   int64_t connect_timeout_ms_;
   // Persistent heartbeat connection (re-established on error).
-  std::mutex hb_mu_;
-  Socket hb_sock_;
+  Mutex hb_mu_;
+  Socket hb_sock_ TFT_GUARDED_BY(hb_mu_);
 };
 
 class ManagerServer {
@@ -68,24 +67,25 @@ class ManagerServer {
   std::unique_ptr<Listener> listener_;
   std::unique_ptr<LighthouseClient> lighthouse_client_;
 
-  std::mutex mu_;
+  Mutex mu_;
   // Reference: src/manager.rs:40-48 (ManagerState).
-  std::map<int64_t, std::string> checkpoint_metadata_;
-  std::set<int64_t> participants_;
+  std::map<int64_t, std::string> checkpoint_metadata_ TFT_GUARDED_BY(mu_);
+  std::set<int64_t> participants_ TFT_GUARDED_BY(mu_);
   // OR of local ranks' force_reconfigure since the last lighthouse forward.
-  bool force_reconfigure_pending_ = false;
-  std::condition_variable quorum_cv_;
-  int64_t quorum_gen_ = 0;
-  torchft_tpu::Quorum latest_quorum_;
-  std::string quorum_error_; // set when the lighthouse call failed
-  torchft_tpu::ErrorResponse::Code quorum_error_code_ =
+  bool force_reconfigure_pending_ TFT_GUARDED_BY(mu_) = false;
+  CondVar quorum_cv_;
+  int64_t quorum_gen_ TFT_GUARDED_BY(mu_) = 0;
+  torchft_tpu::Quorum latest_quorum_ TFT_GUARDED_BY(mu_);
+  // set when the lighthouse call failed
+  std::string quorum_error_ TFT_GUARDED_BY(mu_);
+  torchft_tpu::ErrorResponse::Code quorum_error_code_ TFT_GUARDED_BY(mu_) =
       torchft_tpu::ErrorResponse::UNAVAILABLE;
 
-  std::set<int64_t> should_commit_count_;
-  std::set<int64_t> should_commit_failures_;
-  std::condition_variable commit_cv_;
-  int64_t commit_gen_ = 0;
-  bool latest_decision_ = false;
+  std::set<int64_t> should_commit_count_ TFT_GUARDED_BY(mu_);
+  std::set<int64_t> should_commit_failures_ TFT_GUARDED_BY(mu_);
+  CondVar commit_cv_;
+  int64_t commit_gen_ TFT_GUARDED_BY(mu_) = 0;
+  bool latest_decision_ TFT_GUARDED_BY(mu_) = false;
 
   std::atomic<bool> shutting_down_{false};
   std::thread accept_thread_;

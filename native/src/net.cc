@@ -285,9 +285,14 @@ Listener::Listener(const std::string& bind_addr) {
 
 Listener::~Listener() {
   close();
-  // Pipe fds outlive close(): a racing accept() may still be inside poll()
-  // on wake_rd_ for an instant after close() returns, but every caller
-  // joins/serializes its accept threads before destroying the Listener.
+  // The fd NUMBERS (the /dev/null placeholder close() left in the listen
+  // slot, and the pipe) are released only here: a racing accept() may
+  // still hold them for its poll/::accept pair for an instant after
+  // close() returns. Every caller joins/serializes its accept threads
+  // before destroying the Listener, so releasing the numbers here is
+  // race-free.
+  int fd = fd_.exchange(-1);
+  if (fd >= 0) ::close(fd);
   if (wake_rd_ >= 0) ::close(wake_rd_);
   if (wake_wr_ >= 0) ::close(wake_wr_);
 }
@@ -301,11 +306,29 @@ void Listener::close() {
     char b = 1;
     [[maybe_unused]] ssize_t rc = ::write(wake_wr_, &b, 1);
   }
-  int fd = fd_;
-  fd_ = -1;
+  // The listening SOCKET must die now — peers must get ECONNREFUSED and
+  // the port must free immediately (shutdown() alone is a no-op for a
+  // LISTENING fd on gVisor/Linux<4.5, which would leave dials landing in
+  // a backlog nobody drains). But plainly ::close()ing would let the
+  // kernel recycle the fd NUMBER into an unrelated socket that a racing
+  // accept() — which already loaded the number for its poll/::accept
+  // pair — could steal a connection from. dup2()ing /dev/null over the
+  // slot does both atomically: the socket closes (port freed, dials
+  // refused) while the number stays reserved until ~Listener, and the
+  // racing accept() gets ENOTSOCK and exits.
+  int fd = fd_.load();
   if (fd >= 0) {
-    ::shutdown(fd, SHUT_RDWR);
-    ::close(fd);
+    ::shutdown(fd, SHUT_RDWR);  // wakes pollers on kernels that honor it
+    int nul = ::open("/dev/null", O_RDONLY | O_CLOEXEC);
+    if (nul >= 0) {
+      ::dup2(nul, fd);
+      ::close(nul);
+    } else {
+      // No placeholder available: fall back to a plain close (the
+      // fd-reuse window returns, but a dead /dev/null is not an option).
+      fd_.store(-1);
+      ::close(fd);
+    }
   }
 }
 
@@ -313,11 +336,15 @@ Socket Listener::accept() { return accept(-1); }
 
 Socket Listener::accept(int64_t deadline_ms) {
   while (true) {
-    // close() from another thread sets fd_ = -1; poll() would silently skip
-    // a negative fd and sleep the whole timeout, so bail out first.
-    if (closed_ || fd_ < 0) return Socket();
+    // closed_ is the close() signal (the fd slot then holds a /dev/null
+    // placeholder, not the socket; fd_ goes -1 only in the destructor or
+    // the close() fallback path). Bail out before polling: poll() would
+    // silently skip a negative fd and sleep the whole timeout. One load
+    // per iteration: poll and ::accept below must see the same fd.
+    int lfd = fd_.load();
+    if (closed_ || lfd < 0) return Socket();
     struct pollfd pfds[2];
-    pfds[0].fd = fd_;
+    pfds[0].fd = lfd;
     pfds[0].events = POLLIN;
     pfds[1].fd = wake_rd_; // -1 (pipe creation failed) is skipped by poll
     pfds[1].events = POLLIN;
@@ -331,7 +358,7 @@ Socket Listener::accept(int64_t deadline_ms) {
     if (pfds[1].revents & (POLLIN | POLLHUP | POLLERR)) return Socket();
     if (pfds[0].revents & POLLNVAL) return Socket(); // fd closed under us
     if (!(pfds[0].revents & (POLLIN | POLLHUP | POLLERR))) continue;
-    int fd = ::accept(fd_, nullptr, nullptr);
+    int fd = ::accept(lfd, nullptr, nullptr);
     if (fd >= 0) {
       set_common_opts(fd);
       set_nonblocking(fd);
@@ -349,7 +376,7 @@ Socket Listener::accept(int64_t deadline_ms) {
       nanosleep(&ts, nullptr);
       continue;
     }
-    return Socket(); // listener closed (EBADF/EINVAL)
+    return Socket(); // listener closed (EBADF/EINVAL/ENOTSOCK)
   }
 }
 

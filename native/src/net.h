@@ -88,7 +88,11 @@ class Listener {
   void close();
 
  private:
-  int fd_ = -1;
+  // Atomic: close() publishes -1 from one thread while accept() loads the
+  // fd for its poll/accept calls from another (a plain int here is the
+  // data race TSan flags first in this file). Loaded once per accept-loop
+  // iteration so poll and ::accept see the same value.
+  std::atomic<int> fd_{-1};
   uint16_t port_ = 0;
   // Self-pipe close() writes to so accept() always wakes: neither
   // shutdown() nor close() of a LISTENING fd interrupts a sibling thread

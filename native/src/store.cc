@@ -27,7 +27,7 @@ std::string StoreServer::address() const {
 void StoreServer::shutdown() {
   {
     // Flag + notify under the cv's mutex so waiters can't miss the wakeup.
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     if (shutting_down_.exchange(true)) return;
     cv_.notify_all();
   }
@@ -53,7 +53,7 @@ void StoreServer::handle_conn(Socket& sock) {
           torchft_tpu::StoreSetRequest req;
           req.ParseFromString(payload);
           {
-            std::lock_guard<std::mutex> lock(mu_);
+            MutexLock lock(mu_);
             data_[req.key()] = req.value();
           }
           cv_.notify_all();
@@ -65,7 +65,7 @@ void StoreServer::handle_conn(Socket& sock) {
           req.ParseFromString(payload);
           int64_t deadline =
               req.timeout_ms() < 0 ? -1 : now_ms() + req.timeout_ms();
-          std::unique_lock<std::mutex> lock(mu_);
+          UniqueMutexLock lock(mu_);
           bool timed_out = false;
           while (!data_.count(req.key()) && !shutting_down_) {
             if (deadline < 0) {
@@ -101,7 +101,7 @@ void StoreServer::handle_conn(Socket& sock) {
           req.ParseFromString(payload);
           int64_t value;
           {
-            std::unique_lock<std::mutex> lock(mu_);
+            UniqueMutexLock lock(mu_);
             std::string& cur = data_[req.key()];
             int64_t v = 0;
             if (!cur.empty()) {

@@ -5,10 +5,8 @@
 #pragma once
 
 #include <atomic>
-#include <condition_variable>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -16,6 +14,7 @@
 #include "conn_pool.h"
 #include "conn_tracker.h"
 #include "net.h"
+#include "thread_annotations.h"
 
 namespace tft {
 
@@ -35,9 +34,9 @@ class StoreServer {
   std::unique_ptr<Listener> listener_;
   std::string hostname_;
 
-  std::mutex mu_;
-  std::condition_variable cv_;
-  std::map<std::string, std::string> data_;
+  Mutex mu_;
+  CondVar cv_;
+  std::map<std::string, std::string> data_ TFT_GUARDED_BY(mu_);
   std::atomic<bool> shutting_down_{false};
 
   std::thread accept_thread_;

@@ -1,0 +1,421 @@
+// Native concurrency stress harness, built to be run under TSan and
+// ASan+UBSan (make -C native stress SANITIZE=...; scripts/sanitize.sh).
+//
+// Provokes exactly the interleavings the striped ring's hot paths are
+// documented to survive but ordinary tests rarely hit:
+//   - W HostCollectives ranks on threads, reconfiguring every round on a
+//     fresh store prefix while a chaos thread abort()s random instances
+//     mid-op (the stripe-abort wake-all path);
+//   - persistent comm plans built/executed/freed each round, invalidated
+//     by the next configure (the plan-invalidation path);
+//   - a store client hammer thread running set/get/add against the same
+//     StoreServer the rings rendezvous through;
+//   - lighthouse + manager churn: long-poll quorums cancelled by shutdown
+//     (the ConnTracker shutdown_all / condvar-cancel paths).
+//
+// Chaos rounds only assert liveness (ops either succeed or throw; nothing
+// hangs, nothing trips a sanitizer). The final chaos-free rounds assert
+// CORRECTNESS: allreduce sums, plan averages and decomposed reduce-scatter
+// + allgather-into must produce exact expected values.
+//
+// Usage: stress_native [rounds] [world] [stripes] [elems]
+//   defaults: 12 rounds (last 3 chaos-free), world 3, stripes 2, 49152
+//   elems (~192 KB f32: big enough for 2 effective stripes, small enough
+//   that a TSan run stays in seconds).
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "collectives.h"
+#include "lighthouse.h"
+#include "manager.h"
+#include "net.h"
+#include "store.h"
+#include "thread_annotations.h"
+
+namespace {
+
+using namespace tft;
+
+struct Barrier {
+  explicit Barrier(int n) : n_(n) {}
+  void arrive_and_wait() {
+    UniqueMutexLock lock(mu_);
+    int64_t gen = gen_;
+    if (++count_ == n_) {
+      count_ = 0;
+      gen_++;
+      cv_.notify_all();
+      return;
+    }
+    while (gen_ == gen) cv_.wait(lock);
+  }
+
+ private:
+  Mutex mu_;
+  CondVar cv_;
+  const int n_;
+  int count_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t gen_ TFT_GUARDED_BY(mu_) = 0;
+};
+
+void sleep_ms(int64_t ms) {
+  std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
+
+std::atomic<long> g_ok{0}, g_failed{0}, g_checks{0};
+std::atomic<bool> g_bad{false};
+
+void expect(bool cond, const char* what) {
+  g_checks++;
+  if (!cond) {
+    fprintf(stderr, "CHECK FAILED: %s\n", what);
+    g_bad = true;
+  }
+}
+
+// One rank's round: configure on the round's prefix, then a fixed op
+// program. Any op failure (chaos abort, ring FIN from a sibling's abort)
+// kills the rest of the round — the ring is dead until the next configure,
+// which is exactly the production discipline.
+void run_rank_round(HostCollectives& hc, int64_t rank, int64_t world,
+                    size_t elems, bool chaos, int round) {
+  const int64_t timeout = 8000;
+  std::vector<float> data(elems);
+  std::vector<float> shard(elems);  // >= shard size
+  std::vector<float> gathered(elems);
+
+  try {
+    // allreduce f32: rank r contributes r+1 everywhere.
+    for (size_t i = 0; i < elems; i++) data[i] = static_cast<float>(rank + 1);
+    hc.allreduce(data.data(), elems, Dtype::kF32, ReduceOp::kSum, timeout);
+    if (!chaos) {
+      float want = static_cast<float>(world * (world + 1) / 2);
+      expect(data[0] == want && data[elems - 1] == want,
+             "allreduce f32 sum mismatch");
+    }
+    g_ok++;
+
+    // quantized ring.
+    for (size_t i = 0; i < elems; i++) data[i] = static_cast<float>(rank + 1);
+    hc.allreduce_q8(data.data(), elems, timeout);
+    if (!chaos)
+      expect(std::fabs(data[0] - world * (world + 1) / 2.0f) <
+                 0.2f * world,
+             "allreduce q8 sum out of quantization class");
+    g_ok++;
+
+    // decomposed reduce-scatter + allgather_into == fused allreduce.
+    for (size_t i = 0; i < elems; i++)
+      data[i] = static_cast<float>((i % 31) + rank);
+    hc.reduce_scatter(data.data(), elems, Dtype::kF32, ReduceOp::kSum,
+                      shard.data(), /*layout_stripes=*/0, timeout);
+    // The per-rank shards must tile the payload exactly (the invariant
+    // every sharded consumer leans on).
+    size_t tiled = 0;
+    for (int64_t r = 0; r < world; r++)
+      for (auto [st, len] : hc.shard_ranges(elems, sizeof(float), r, 0))
+        tiled += len, (void)st;
+    expect(tiled == elems, "shard_ranges do not tile the payload");
+    hc.allgather_into(shard.data(), gathered.data(), elems, Dtype::kF32,
+                      /*layout_stripes=*/0, timeout);
+    if (!chaos) {
+      bool same = true;
+      for (size_t i = 0; i < elems && same; i++) {
+        float want = 0;
+        for (int64_t r = 0; r < world; r++)
+          want += static_cast<float>((i % 31) + r);
+        same = gathered[i] == want;
+      }
+      expect(same, "reduce_scatter + allgather_into != expected sum");
+    }
+    g_ok++;
+
+    // Persistent comm plan: two leaves, wire rotating per round (native /
+    // bf16 / q8 / q8+EF), executed thrice so the q8ef residual carries.
+    int64_t counts[2] = {static_cast<int64_t>(elems / 2),
+                         static_cast<int64_t>(elems - elems / 2)};
+    int32_t dtypes[2] = {static_cast<int32_t>(Dtype::kF32),
+                         static_cast<int32_t>(Dtype::kF32)};
+    PlanWire wire = static_cast<PlanWire>(round % 4);
+    int64_t plan = hc.plan_build(counts, dtypes, 2, wire);
+    const void* ins[2] = {data.data(), data.data() + counts[0]};
+    void* outs[2] = {gathered.data(), gathered.data() + counts[0]};
+    for (int it = 0; it < 3; it++) {
+      for (size_t i = 0; i < elems; i++)
+        data[i] = static_cast<float>(rank + 1) * 0.5f;
+      hc.plan_execute(plan, ins, outs, static_cast<double>(world),
+                      /*has_divisor=*/true, timeout);
+      if (!chaos && wire == PlanWire::kNative)
+        expect(std::fabs(gathered[0] -
+                         0.5f * (world + 1) / 2.0f) < 1e-6,
+               "plan_execute native average mismatch");
+    }
+    (void)hc.plan_stats_json(plan);
+    hc.plan_reset_feedback(plan);
+    hc.plan_free(plan);
+    g_ok++;
+
+    // control-plane-sized ops.
+    int64_t token = rank;
+    std::vector<int64_t> all(world);
+    hc.allgather(&token, all.data(), sizeof(token), timeout);
+    if (!chaos)
+      expect(all[0] == 0 && all[world - 1] == world - 1,
+             "allgather rank order mismatch");
+    hc.broadcast(&token, sizeof(token), /*root=*/0, timeout);
+    if (!chaos) expect(token == 0, "broadcast root value mismatch");
+    hc.barrier(timeout);
+    g_ok++;
+  } catch (const std::exception&) {
+    // Chaos abort (or its ring-wide FIN) — expected; the ring stays dead
+    // until the next round's configure.
+    g_failed++;
+  }
+}
+
+void collectives_stress(int rounds, int world, int stripes, size_t elems) {
+  StoreServer store("[::]:0");
+  std::string store_addr =
+      "localhost:" + std::to_string(store.port());
+
+  std::vector<std::unique_ptr<HostCollectives>> hcs;
+  for (int r = 0; r < world; r++)
+    hcs.push_back(std::make_unique<HostCollectives>());
+
+  Barrier barrier(world);
+  std::atomic<bool> stop{false};
+  std::atomic<int> in_ops{0};
+  const int chaos_until = rounds > 3 ? rounds - 3 : 0;
+  std::atomic<int> cur_round{0};
+
+  // Chaos: abort a random instance only while every rank is inside the op
+  // phase — an abort landing in configure's rendezvous would stall the
+  // round on the store timeout instead of exercising the wake paths.
+  std::thread chaos([&] {
+    std::mt19937 rng(0xC0FFEE);
+    while (!stop) {
+      sleep_ms(2 + static_cast<int64_t>(rng() % 12));
+      if (cur_round.load() < chaos_until && in_ops.load() == world)
+        hcs[rng() % world]->abort();
+    }
+  });
+
+  // Store hammer: concurrent set/get/add against the rendezvous server.
+  std::thread hammer([&] {
+    try {
+      StoreClient c(store_addr, 5000);
+      int i = 0;
+      while (!stop) {
+        std::string k = "hammer/" + std::to_string(i % 8);
+        c.set(k, std::to_string(i), 5000);
+        expect(!c.get(k, 5000).empty(), "store get after set empty");
+        c.add("hammer/ctr", 1, 5000);
+        i++;
+        sleep_ms(1);
+      }
+    } catch (const std::exception& e) {
+      fprintf(stderr, "store hammer died: %s\n", e.what());
+      g_bad = true;
+    }
+  });
+
+  std::vector<std::thread> ranks;
+  for (int64_t r = 0; r < world; r++) {
+    ranks.emplace_back([&, r] {
+      for (int round = 0; round < rounds; round++) {
+        barrier.arrive_and_wait();
+        if (r == 0) cur_round = round;
+        bool chaos_round = round < chaos_until;
+        std::string prefix =
+            store_addr + "/stress/" + std::to_string(round);
+        bool configured = false;
+        for (int attempt = 0; attempt < 2 && !configured; attempt++) {
+          try {
+            hcs[r]->configure(prefix + "/" + std::to_string(attempt), r,
+                              world, 15000, stripes);
+            configured = true;
+          } catch (const std::exception&) {
+            g_failed++;
+          }
+        }
+        expect(configured, "configure failed twice in one round");
+        barrier.arrive_and_wait();
+        in_ops++;
+        if (configured)
+          run_rank_round(*hcs[r], r, world, elems, chaos_round, round);
+        in_ops--;
+        barrier.arrive_and_wait();
+      }
+    });
+  }
+  for (auto& t : ranks) t.join();
+  stop = true;
+  chaos.join();
+  hammer.join();
+
+  // Destructor order deliberately tears rings down while instances still
+  // exist (abort + pool drain under sanitizers).
+  hcs.clear();
+  store.shutdown();
+}
+
+void control_plane_churn(int iters) {
+  for (int i = 0; i < iters; i++) {
+    LighthouseOpt opt;
+    opt.min_replicas = 2;
+    opt.join_timeout_ms = 50;
+    opt.quorum_tick_ms = 10;
+    opt.heartbeat_timeout_ms = 500;
+    Lighthouse lh("[::]:0", opt);
+    std::string addr = lh.address();
+
+    // Two members long-poll a quorum that completes.
+    std::thread a([&] {
+      try {
+        torchft_tpu::QuorumMember m;
+        m.set_replica_id("A");
+        m.set_address("a:1");
+        m.set_store_address("a:2");
+        m.set_step(i);
+        m.set_world_size(1);
+        LighthouseClient(addr, 3000).quorum(m, 5000);
+        g_ok++;
+      } catch (const std::exception&) {
+        g_failed++;
+      }
+    });
+    std::thread b([&] {
+      try {
+        torchft_tpu::QuorumMember m;
+        m.set_replica_id("B");
+        m.set_address("b:1");
+        m.set_store_address("b:2");
+        m.set_step(i);
+        m.set_world_size(1);
+        LighthouseClient(addr, 3000).quorum(m, 5000);
+        g_ok++;
+      } catch (const std::exception&) {
+        g_failed++;
+      }
+    });
+    // Heartbeats ride the persistent-connection path concurrently.
+    std::thread hb([&] {
+      try {
+        LighthouseClient c(addr, 3000);
+        for (int k = 0; k < 5; k++) c.heartbeat("hb", 2000);
+      } catch (const std::exception&) {
+        g_failed++;
+      }
+    });
+    a.join();
+    b.join();
+    hb.join();
+
+    // A long-poll that can never complete (only one member of two),
+    // cancelled by shutdown: the handler must wake and the tracker drain.
+    std::thread lone([&] {
+      try {
+        torchft_tpu::QuorumMember m;
+        m.set_replica_id("lone");
+        m.set_address("l:1");
+        m.set_store_address("l:2");
+        m.set_step(0);
+        m.set_world_size(1);
+        LighthouseClient(addr, 3000).quorum(m, 10000);
+        g_failed++;  // should have been cancelled
+      } catch (const std::exception&) {
+        g_ok++;  // CANCELLED (or the connection died with the server)
+      }
+    });
+    sleep_ms(30);
+    lh.shutdown();
+    lone.join();
+  }
+
+  // Manager churn: world_size=2 local ranks vote, then a shutdown lands
+  // while a quorum long-poll is parked (rank 1 never arrives).
+  for (int i = 0; i < iters; i++) {
+    LighthouseOpt opt;
+    opt.min_replicas = 1;
+    opt.join_timeout_ms = 50;
+    opt.quorum_tick_ms = 10;
+    opt.heartbeat_timeout_ms = 2000;
+    Lighthouse lh("[::]:0", opt);
+    StoreServer store("[::]:0");
+    ManagerServer ms("stress", lh.address(), "localhost", "[::]:0",
+                     store.address(), /*world_size=*/2,
+                     /*heartbeat_interval_ms=*/20, /*connect_timeout_ms=*/3000);
+    std::string maddr = ms.address();
+
+    std::thread r0([&] {
+      try {
+        ManagerClient c(maddr, 3000);
+        auto resp = c.quorum(0, i, "meta0", false, false, 5000);
+        expect(resp.replica_world_size() >= 1, "manager quorum world empty");
+        expect(c.should_commit(0, i, true, 5000),
+               "unanimous should_commit returned false");
+        g_ok++;
+      } catch (const std::exception&) {
+        g_failed++;
+      }
+    });
+    std::thread r1([&] {
+      try {
+        ManagerClient c(maddr, 3000);
+        c.quorum(1, i, "meta1", false, false, 5000);
+        c.should_commit(1, i, true, 5000);
+        g_ok++;
+      } catch (const std::exception&) {
+        g_failed++;
+      }
+    });
+    r0.join();
+    r1.join();
+
+    std::thread parked([&] {
+      try {
+        ManagerClient c(maddr, 3000);
+        c.quorum(0, i + 1, "meta", false, false, 10000);
+        g_failed++;  // rank 1 never joins; only shutdown can end this
+      } catch (const std::exception&) {
+        g_ok++;
+      }
+    });
+    sleep_ms(30);
+    ms.shutdown();
+    parked.join();
+    store.shutdown();
+    lh.shutdown();
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int rounds = argc > 1 ? atoi(argv[1]) : 12;
+  int world = argc > 2 ? atoi(argv[2]) : 3;
+  int stripes = argc > 3 ? atoi(argv[3]) : 2;
+  size_t elems = argc > 4 ? static_cast<size_t>(atoll(argv[4])) : 49152;
+
+  collectives_stress(rounds, world, stripes, elems);
+  control_plane_churn(3);
+
+  fprintf(stderr,
+          "stress_native: ok_ops=%ld failed_ops=%ld checks=%ld%s\n",
+          g_ok.load(), g_failed.load(), g_checks.load(),
+          g_bad ? " CHECK-FAILURES" : "");
+  if (g_bad) return 1;
+  if (g_ok.load() == 0) {
+    fprintf(stderr, "stress_native: no op ever succeeded\n");
+    return 1;
+  }
+  return 0;
+}

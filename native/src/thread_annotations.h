@@ -1,0 +1,145 @@
+// Clang thread-safety capability macros + annotated mutex/condvar wrappers.
+//
+// Every mutex-guarded field in the native layer is annotated GUARDED_BY its
+// mutex and every lock acquisition goes through these wrappers, so
+//
+//     clang++ -Wthread-safety -Werror   (make -C native tsa-check)
+//
+// machine-checks the lock discipline the comments used to carry alone: a
+// field read without its mutex, a lock released twice, or a *_locked()
+// helper called without the lock is a compile ERROR, not a review hope.
+// Under g++ (which has no thread-safety analysis) the macros expand to
+// nothing and the wrappers are zero-cost shims over std::mutex /
+// std::condition_variable — identical codegen, no behavior change.
+//
+// Deliberately NOT annotatable (documented at the field instead):
+// dual-protocol state whose readers hold one of TWO mutexes (e.g. the ring
+// sockets in HostCollectives: identity changes hold cfg_mu_ AND op_mu_,
+// readers hold either) and cross-thread handoffs synchronized by a
+// condvar-generation protocol rather than a single capability (the stripe
+// pool's job body). Clang's analysis models exactly one capability per
+// field; forcing those under one mutex would make the annotations lie.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+
+// Capability attributes exist only under clang; __has_attribute keeps the
+// header honest if a future clang renames one.
+#if defined(__clang__) && defined(__has_attribute)
+#if __has_attribute(guarded_by)
+#define TFT_TSA(x) __attribute__((x))
+#endif
+#endif
+#ifndef TFT_TSA
+#define TFT_TSA(x)  // no-op outside clang
+#endif
+
+#define TFT_CAPABILITY(x) TFT_TSA(capability(x))
+#define TFT_SCOPED_CAPABILITY TFT_TSA(scoped_lockable)
+#define TFT_GUARDED_BY(x) TFT_TSA(guarded_by(x))
+#define TFT_PT_GUARDED_BY(x) TFT_TSA(pt_guarded_by(x))
+#define TFT_REQUIRES(...) TFT_TSA(requires_capability(__VA_ARGS__))
+#define TFT_ACQUIRE(...) TFT_TSA(acquire_capability(__VA_ARGS__))
+#define TFT_RELEASE(...) TFT_TSA(release_capability(__VA_ARGS__))
+#define TFT_TRY_ACQUIRE(...) TFT_TSA(try_acquire_capability(__VA_ARGS__))
+#define TFT_EXCLUDES(...) TFT_TSA(locks_excluded(__VA_ARGS__))
+#define TFT_NO_TSA TFT_TSA(no_thread_safety_analysis)
+
+namespace tft {
+
+// std::mutex with the capability attribute (std::mutex itself cannot carry
+// one under libstdc++). native() exists only for the condvar wrapper.
+class TFT_CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+
+  void lock() TFT_ACQUIRE() { mu_.lock(); }
+  void unlock() TFT_RELEASE() { mu_.unlock(); }
+  bool try_lock() TFT_TRY_ACQUIRE(true) { return mu_.try_lock(); }
+
+  std::mutex& native() { return mu_; }
+
+ private:
+  std::mutex mu_;
+};
+
+// std::lock_guard role: holds from construction to scope exit.
+class TFT_SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& mu) TFT_ACQUIRE(mu) : mu_(mu) { mu_.lock(); }
+  ~MutexLock() TFT_RELEASE() { mu_.unlock(); }
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+ private:
+  Mutex& mu_;
+};
+
+// std::unique_lock role: condvar-compatible and early-releasable (the
+// long-poll handlers unlock before writing their response to the socket).
+// Clang models the scoped capability's held/released state, so an early
+// unlock() followed by the destructor does not double-release.
+class TFT_SCOPED_CAPABILITY UniqueMutexLock {
+ public:
+  explicit UniqueMutexLock(Mutex& mu) TFT_ACQUIRE(mu) : lk_(mu.native()) {}
+  ~UniqueMutexLock() TFT_RELEASE() {}
+  UniqueMutexLock(const UniqueMutexLock&) = delete;
+  UniqueMutexLock& operator=(const UniqueMutexLock&) = delete;
+
+  void unlock() TFT_RELEASE() { lk_.unlock(); }
+
+  // For CondVar only: waiting temporarily releases and reacquires the
+  // native lock, which the analysis (correctly) treats as held across the
+  // wait — guarded state must be revalidated after every wake, which is
+  // what the explicit while-loops around every wait below already do.
+  std::unique_lock<std::mutex>& native() { return lk_; }
+
+ private:
+  std::unique_lock<std::mutex> lk_;
+};
+
+// std::condition_variable over UniqueMutexLock. No predicate overloads on
+// purpose: clang's analysis cannot see capabilities inside a lambda passed
+// as a wait predicate, so all call sites spell the while-loop out — which
+// keeps the guarded reads in the annotated function body.
+class CondVar {
+ public:
+  CondVar() = default;
+  CondVar(const CondVar&) = delete;
+  CondVar& operator=(const CondVar&) = delete;
+
+  void notify_one() { cv_.notify_one(); }
+  void notify_all() { cv_.notify_all(); }
+
+  void wait(UniqueMutexLock& lk) { cv_.wait(lk.native()); }
+
+  template <class Rep, class Period>
+  std::cv_status wait_for(UniqueMutexLock& lk,
+                          const std::chrono::duration<Rep, Period>& d) {
+#if defined(__SANITIZE_THREAD__)
+    // gcc's libtsan (through at least gcc 12) does not intercept
+    // pthread_cond_clockwait, which libstdc++'s steady-clock wait_for
+    // lowers to on glibc >= 2.30. TSan then misses the mutex
+    // release/reacquire inside every timed wait and reports phantom
+    // double-locks plus cascading false races for each long-poll server
+    // thread. Under TSan only, route through a system_clock wait_until,
+    // which lowers to the intercepted pthread_cond_timedwait. The timed
+    // wait here is a wake HINT (every caller loops rechecking its
+    // deadline against the steady now_ms()), so the wall-clock
+    // sensitivity is harmless; production builds keep the
+    // jump-immune steady-clock path.
+    return cv_.wait_until(lk.native(), std::chrono::system_clock::now() + d);
+#else
+    return cv_.wait_for(lk.native(), d);
+#endif
+  }
+
+ private:
+  std::condition_variable cv_;
+};
+
+}  // namespace tft

@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""graftlint CLI: repo-specific cross-language invariant checks.
+
+Usage:
+    python scripts/graftlint.py              # all rules against this repo
+    python scripts/graftlint.py capi_sync    # one rule
+    python scripts/graftlint.py --root PATH  # another checkout
+
+Exits 0 when clean, 1 with one `file:line: [rule] message` per violation
+otherwise. Rules live in tools/graftlint/ (see its package docstring for
+what each one enforces and how to add a new one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools import graftlint  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "rules",
+        nargs="*",
+        help="rule names to run (default: all)",
+    )
+    parser.add_argument(
+        "--root",
+        type=Path,
+        default=REPO_ROOT,
+        help="repository root to lint",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        violations = graftlint.run(args.root.resolve(), args.rules)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    for v in violations:
+        print(v)
+    if violations:
+        print(
+            f"graftlint: {len(violations)} violation(s)", file=sys.stderr
+        )
+        return 1
+    print("graftlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Builds the native concurrency stress harness under a sanitizer and runs
+# it. The race-hunting entry point for both CI and local bring-up:
+#
+#   scripts/sanitize.sh tsan            # ThreadSanitizer
+#   scripts/sanitize.sh asan            # AddressSanitizer + UBSan (+ LSan)
+#   scripts/sanitize.sh all             # both, in sequence (default)
+#
+# Extra arguments go to the stress binary: [rounds] [world] [stripes]
+# [elems] (see native/src/stress_native.cc). Each sanitizer builds into
+# its own native/build-san-* dir, so repeated runs are incremental and
+# never mix instrumented with plain objects. How to read the reports:
+# docs/DEVELOPING.md.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+MODE="${1:-all}"
+shift || true
+# ${arr[@]+...} expansion: an empty array under `set -u` is an unbound
+# variable on bash < 4.4.
+STRESS_ARGS=(${@+"$@"})
+
+run_tsan() {
+  echo "== TSan stress =="
+  make -C native stress SANITIZE=thread -j"$(nproc)"
+  # halt_on_error=0: collect every report in one run; the exit code still
+  # fails (66) if anything was reported.
+  TSAN_OPTIONS="${TSAN_OPTIONS:-halt_on_error=0 exitcode=66 second_deadlock_stack=1}" \
+    ./native/build-san-thread/stress_native ${STRESS_ARGS[@]+"${STRESS_ARGS[@]}"}
+}
+
+run_asan() {
+  echo "== ASan+UBSan stress =="
+  make -C native stress SANITIZE=address,undefined -j"$(nproc)"
+  ASAN_OPTIONS="${ASAN_OPTIONS:-detect_stack_use_after_return=1}" \
+  UBSAN_OPTIONS="${UBSAN_OPTIONS:-print_stacktrace=1 halt_on_error=1}" \
+    ./native/build-san-address+undefined/stress_native ${STRESS_ARGS[@]+"${STRESS_ARGS[@]}"}
+}
+
+case "$MODE" in
+  tsan) run_tsan ;;
+  asan) run_asan ;;
+  all)
+    run_tsan
+    run_asan
+    ;;
+  *)
+    echo "usage: $0 [tsan|asan|all] [stress args...]" >&2
+    exit 2
+    ;;
+esac
+echo "sanitize.sh: $MODE clean"

@@ -1,0 +1,27 @@
+# graftlint fixture: plan-cache mutations outside the invalidation
+# entry points.
+
+
+class HostCollectives:
+    def __init__(self):
+        self._plans = {}  # allowed
+
+    def configure(self, store_addr, rank, world_size):
+        self._plans = {}  # allowed (the invalidation entry point)
+
+    def _plan_for(self, key):
+        if key not in self._plans:
+            self._plans[key] = object()  # allowed (build-and-memoize)
+        return self._plans[key]
+
+    def sneaky_drop(self, key):
+        self._plans.pop(key, None)  # violation: mutating method call
+
+    def sneaky_insert(self, key, plan):
+        self._plans[key] = plan  # violation: item assignment
+
+    def sneaky_rebind(self):
+        self._plans = {}  # violation: rebound outside entry points
+
+    def read_only(self, key):
+        return self._plans.get(key)  # clean: reads are fine anywhere

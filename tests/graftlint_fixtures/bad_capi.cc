@@ -1,0 +1,21 @@
+// graftlint fixture: a C API with every flavor of bridge drift.
+#include <cstdint>
+
+extern "C" {
+
+// OK everywhere (control: must NOT be flagged).
+int tft_fix_ok(void* handle, int64_t a) { return 0; }
+
+// Declared in bad_native.py with the wrong argtypes length.
+int tft_fix_argcount(void* handle, int64_t a, int64_t b) { return 0; }
+
+// int64 return with no restype declaration (default c_int truncates).
+int64_t tft_fix_ret64(void* handle) { return 0; }
+
+// Never declared in bad_native.py at all.
+int tft_fix_undeclared(void* handle) { return 0; }
+
+// Missing from the pyi _NativeLib block.
+int tft_fix_unstubbed(void* handle) { return 0; }
+
+} // extern "C"

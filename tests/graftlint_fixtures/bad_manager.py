@@ -1,0 +1,34 @@
+# graftlint fixture: Manager methods that break the latch discipline.
+
+
+class Manager:
+    def __init__(self, collectives):
+        self._collectives = collectives
+
+    def allreduce(self, tree):
+        # Violation: touches a managed collective without routing through
+        # _managed_dispatch, and raises a non-ValueError on that path.
+        try:
+            return self._collectives.allreduce(tree)
+        except Exception as e:
+            raise RuntimeError("ring failed") from e
+
+    def reduce_scatter(self, tree):
+        # Violation: bare re-raise on the managed path.
+        try:
+            return self._managed_dispatch("reduce_scatter", tree)
+        except Exception:
+            raise
+        finally:
+            self._collectives.reduce_scatter  # managed-op reference
+
+    def _managed_dispatch(self, op_name, tree):
+        # Violation: the dispatch helper re-raises instead of latching.
+        try:
+            return tree
+        except Exception:
+            self.report_error(None)
+            raise
+
+    def report_error(self, e):
+        self._errored = e

@@ -1,0 +1,24 @@
+# graftlint fixture: ctypes declarations drifted from bad_capi.cc.
+import ctypes
+
+
+def _load_lib(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.tft_fix_ok.restype = ctypes.c_int
+    lib.tft_fix_ok.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+
+    # Wrong length: C side takes 3 parameters.
+    lib.tft_fix_argcount.restype = ctypes.c_int
+    lib.tft_fix_argcount.argtypes = [ctypes.c_void_p] * 2
+
+    # Missing restype for an int64_t return.
+    lib.tft_fix_ret64.argtypes = [ctypes.c_void_p]
+
+    # tft_fix_undeclared: intentionally absent.
+
+    lib.tft_fix_unstubbed.restype = ctypes.c_int
+    lib.tft_fix_unstubbed.argtypes = [ctypes.c_void_p]
+
+    # Stale: not exported by bad_capi.cc.
+    lib.tft_fix_stale.restype = ctypes.c_int
+    lib.tft_fix_stale.argtypes = [ctypes.c_void_p]
+    return lib

@@ -1,0 +1,27 @@
+# graftlint fixture: deadline-less sleep-poll loops (and bounded
+# controls that must NOT be flagged).
+import time
+
+
+def wait_forever(server):
+    # Violation: no visible deadline.
+    while not server.ready():
+        time.sleep(0.1)
+
+
+def wait_bounded_by_clock(server):
+    # Clean: compares against time.monotonic().
+    deadline = time.monotonic() + 5.0
+    while not server.ready():
+        if time.monotonic() > deadline:
+            raise TimeoutError("server never became ready")
+        time.sleep(0.05)
+
+
+def wait_bounded_by_range(server):
+    # Clean: for-range loops are inherently bounded.
+    for _ in range(100):
+        if server.ready():
+            return
+        time.sleep(0.05)
+    raise TimeoutError("server never became ready")

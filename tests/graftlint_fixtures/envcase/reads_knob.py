@@ -1,0 +1,6 @@
+# graftlint fixture: reads a TORCHFT_* knob the fixture docs don't
+# mention (and one they do, as the clean control).
+import os
+
+UNDOCUMENTED = os.environ.get("TORCHFT_FIXTURE_UNDOCUMENTED", "0")
+DOCUMENTED = os.getenv("TORCHFT_FIXTURE_DOCUMENTED")

@@ -1,0 +1,27 @@
+# graftlint fixture: the latch discipline done right (clean-pass control).
+
+
+class Manager:
+    def __init__(self, collectives):
+        self._collectives = collectives
+        self._errored = None
+
+    def allreduce(self, tree, op="avg"):
+        if op not in ("avg", "sum"):
+            # Eager static-usage error: allowed.
+            raise ValueError(f"unsupported op: {op}")
+
+        def dispatch(t):
+            return self._collectives.allreduce(t)
+
+        return self._managed_dispatch("allreduce", tree, dispatch)
+
+    def _managed_dispatch(self, op_name, tree, dispatch):
+        try:
+            return dispatch(tree)
+        except Exception as e:
+            self.report_error(e)
+            return None
+
+    def report_error(self, e):
+        self._errored = e

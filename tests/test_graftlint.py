@@ -1,0 +1,169 @@
+"""graftlint's own tests: every rule must fire on its seeded fixture and
+stay silent on the clean control — and the real repo must be clean.
+
+The fixtures under tests/graftlint_fixtures/ carry one deliberate
+violation per failure mode (C-API three-way drift, latch-discipline
+breach, undocumented env knob, deadline-less sleep loop, out-of-entry
+plan-cache mutation). If a rule's detector regresses, the seeded fixture
+stops firing and these tests — not a 2am bridge corruption — catch it.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "graftlint_fixtures"
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools import graftlint  # noqa: E402
+from tools.graftlint import (  # noqa: E402
+    cache_mutation,
+    capi_sync,
+    env_docs,
+    latch_discipline,
+    sleep_deadline,
+)
+
+
+def messages(violations):
+    return "\n".join(str(v) for v in violations)
+
+
+class TestCapiSync:
+    def fixture_violations(self):
+        return capi_sync.check(
+            REPO_ROOT,
+            capi_path=FIXTURES / "bad_capi.cc",
+            native_py_path=FIXTURES / "bad_native.py",
+            pyi_path=FIXTURES / "bad_native.pyi",
+        )
+
+    def test_detects_each_drift_flavor(self):
+        found = messages(self.fixture_violations())
+        assert "tft_fix_argcount argtypes length 2 != 3" in found
+        assert "tft_fix_ret64 returns 'int64_t' but declares no restype" in found
+        assert "tft_fix_undeclared exported by capi.cc but has no ctypes" in found
+        assert "tft_fix_stale declared in _native.py but not exported" in found
+        assert "tft_fix_unstubbed exported by capi.cc but missing" in found
+        assert "tft_fix_phantom stubbed in _NativeLib but not exported" in found
+        # pyi side of the argcount drift too.
+        assert "tft_fix_argcount stub takes 1 parameters but capi.cc takes 3" in found
+
+    def test_control_function_not_flagged(self):
+        assert not any(
+            "tft_fix_ok" in v.message for v in self.fixture_violations()
+        )
+
+    def test_real_bridge_is_clean(self):
+        assert capi_sync.check(REPO_ROOT) == []
+
+    def test_real_bridge_parses_nontrivially(self):
+        # Guards against a parser regression silently passing vacuously.
+        exports = capi_sync.parse_capi(
+            (REPO_ROOT / "native/src/capi.cc").read_text()
+        )
+        assert len(exports) >= 40
+        names = {e.name for e in exports}
+        assert {"tft_hc_configure", "tft_plan_execute", "tft_last_error"} <= names
+
+
+class TestLatchDiscipline:
+    def test_detects_breaches(self):
+        found = messages(
+            latch_discipline.check(
+                REPO_ROOT, manager_path=FIXTURES / "bad_manager.py"
+            )
+        )
+        assert "Manager.allreduce touches a managed collective" in found
+        assert "raises a non-ValueError on the managed path" in found
+        assert "bare re-raise on the managed path" in found
+        assert "_managed_dispatch exception handler re-raises" in found
+
+    def test_clean_fixture_passes(self):
+        assert (
+            latch_discipline.check(
+                REPO_ROOT, manager_path=FIXTURES / "good_manager.py"
+            )
+            == []
+        )
+
+    def test_real_manager_is_clean(self):
+        assert latch_discipline.check(REPO_ROOT) == []
+
+
+class TestEnvDocs:
+    def test_detects_undocumented_knob(self):
+        violations = env_docs.check(
+            REPO_ROOT,
+            docs_path=FIXTURES / "envcase" / "OPERATIONS.md",
+            scan_dirs=[Path("tests/graftlint_fixtures/envcase")],
+        )
+        found = messages(violations)
+        assert "TORCHFT_FIXTURE_UNDOCUMENTED" in found
+        assert "TORCHFT_FIXTURE_DOCUMENTED" not in found
+
+    def test_real_knobs_are_documented(self):
+        assert env_docs.check(REPO_ROOT) == []
+
+    def test_real_scan_sees_known_knobs(self):
+        reads = env_docs.collect_reads(REPO_ROOT, env_docs.SCAN_DIRS)
+        # Python- and C++-side reads must both be visible.
+        assert "TORCHFT_LIGHTHOUSE" in reads
+        assert "TORCHFT_HC_WIRE_CAP_MBPS" in reads
+
+
+class TestSleepDeadline:
+    def test_detects_deadline_less_loop(self):
+        violations = sleep_deadline.check(
+            REPO_ROOT, test_paths=[FIXTURES / "bad_sleeps.py"]
+        )
+        assert len(violations) == 1
+        assert violations[0].line == 8  # wait_forever's while, nothing else
+
+    def test_real_tests_are_clean(self):
+        assert sleep_deadline.check(REPO_ROOT) == []
+
+
+class TestCacheMutation:
+    def test_detects_out_of_entry_mutations(self):
+        violations = cache_mutation.check(
+            REPO_ROOT,
+            targets={
+                ("tests/graftlint_fixtures/bad_cache.py", "_plans"): (
+                    "__init__",
+                    "configure",
+                    "_plan_for",
+                )
+            },
+        )
+        kinds = {v.message.split(";")[0] for v in violations}
+        assert len(violations) == 3
+        assert any("sneaky_drop" in v.message for v in violations)
+        assert any("sneaky_insert" in v.message for v in violations)
+        assert any("sneaky_rebind" in v.message for v in violations)
+        assert kinds  # each message names its mutation kind
+
+    def test_real_plan_cache_is_clean(self):
+        assert cache_mutation.check(REPO_ROOT) == []
+
+
+class TestRunner:
+    def test_run_all_clean_on_repo(self):
+        assert graftlint.run(REPO_ROOT) == []
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(KeyError):
+            graftlint.run(REPO_ROOT, ["no_such_rule"])
+
+    def test_cli_exit_codes(self):
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "scripts/graftlint.py")],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean" in proc.stdout

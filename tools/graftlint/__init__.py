@@ -1,0 +1,81 @@
+"""graftlint: repo-specific static checks for torchft_tpu.
+
+Machine-checks the cross-language contracts the codebase relies on but no
+general-purpose linter can see:
+
+- ``capi_sync``: every ``tft_*`` export in ``native/src/capi.cc`` has a
+  matching ctypes declaration in ``torchft_tpu/_native.py`` (argument count
+  and restype) and a stub in the ``_NativeLib`` block of
+  ``torchft_tpu/_native.pyi`` — a three-way parse-and-diff of the bridge.
+- ``latch_discipline``: every managed ``Manager.*`` collective routes
+  through ``_managed_dispatch`` and never raises anything but an eager
+  ``ValueError`` (data-plane failures must latch for the commit vote, not
+  raise into the train loop).
+- ``env_docs``: every ``TORCHFT_*`` knob read by the product code
+  (``torchft_tpu/``, ``native/src/``) is documented in
+  ``docs/OPERATIONS.md``.
+- ``sleep_deadline``: no ``while``-loop in ``tests/`` polls with
+  ``time.sleep`` unless the loop is visibly deadline-bounded.
+- ``cache_mutation``: the plan cache (``HostCollectives._plans``) is only
+  mutated inside its invalidation entry points.
+
+Run via ``python scripts/graftlint.py`` (CI gates on it); extend by adding
+a module under ``tools/graftlint/`` and registering it in ``RULES``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, List
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    file: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+def relpath(root: Path, path: Path) -> str:
+    """Path as displayed in violations: root-relative when under the root
+    (the normal case), absolute otherwise (fixture files in tests)."""
+    return str(path.relative_to(root)) if path.is_relative_to(root) else str(
+        path
+    )
+
+
+def _load_rules() -> Dict[str, Callable[[Path], List[Violation]]]:
+    from . import (
+        cache_mutation,
+        capi_sync,
+        env_docs,
+        latch_discipline,
+        sleep_deadline,
+    )
+
+    return {
+        "capi_sync": capi_sync.check,
+        "latch_discipline": latch_discipline.check,
+        "env_docs": env_docs.check,
+        "sleep_deadline": sleep_deadline.check,
+        "cache_mutation": cache_mutation.check,
+    }
+
+
+def run(root: Path, rules: List[str] | None = None) -> List[Violation]:
+    """Runs the selected rules (default: all) against a repo root."""
+    registry = _load_rules()
+    selected = rules if rules else sorted(registry)
+    out: List[Violation] = []
+    for name in selected:
+        if name not in registry:
+            raise KeyError(
+                f"unknown graftlint rule {name!r} (have: {sorted(registry)})"
+            )
+        out.extend(registry[name](root))
+    return out

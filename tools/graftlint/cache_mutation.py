@@ -1,0 +1,125 @@
+"""Plan/cache state is only mutated inside its invalidation entry points.
+
+``HostCollectives._plans`` caches native plan ids whose layouts bake in
+the ring geometry; the native side drops every plan on configure(), so the
+Python cache MUST be rebuilt/invalidated only at the documented points —
+a mutation anywhere else desynchronizes the two sides (a stale Python
+handle would execute a freed or rebuilt native plan). The rule finds every
+mutation of the attribute (assignment, subscript store/delete, mutating
+method call) and requires its enclosing method to be on the allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import Violation
+
+RULE = "cache_mutation"
+
+# (file, attribute) -> methods allowed to mutate it. _plan_for is the
+# build-and-memoize entry; configure is the invalidation entry.
+DEFAULT_TARGETS: Dict[Tuple[str, str], Sequence[str]] = {
+    ("torchft_tpu/collectives.py", "_plans"): (
+        "__init__",
+        "configure",
+        "_plan_for",
+    ),
+}
+
+_MUTATORS = {"clear", "pop", "popitem", "update", "setdefault"}
+
+
+def _is_self_attr(node: ast.AST, attr: str) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == attr
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _mutations(tree: ast.Module, attr: str) -> List[Tuple[int, str]]:
+    """(line, kind) of every mutation of self.<attr> in the module."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in targets:
+                if _is_self_attr(tgt, attr):
+                    out.append((node.lineno, "rebound"))
+                elif isinstance(tgt, ast.Subscript) and _is_self_attr(
+                    tgt.value, attr
+                ):
+                    out.append((node.lineno, "item-assigned"))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and _is_self_attr(
+                    tgt.value, attr
+                ):
+                    out.append((node.lineno, "item-deleted"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATORS
+                and _is_self_attr(f.value, attr)
+            ):
+                out.append((node.lineno, f".{f.attr}()"))
+    return out
+
+
+def _method_spans(tree: ast.Module) -> List[Tuple[int, int, str]]:
+    """(start, end, qualified method name) for every top-level method of
+    every class; nested defs inherit the enclosing method's name."""
+    spans = []
+    for cls in tree.body:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                end = max(
+                    getattr(n, "end_lineno", fn.lineno)
+                    for n in ast.walk(fn)
+                )
+                spans.append((fn.lineno, end, fn.name))
+    return spans
+
+
+def check(
+    root: Path,
+    targets: Optional[Dict[Tuple[str, str], Sequence[str]]] = None,
+) -> List[Violation]:
+    out: List[Violation] = []
+    for (rel, attr), allowed in (targets or DEFAULT_TARGETS).items():
+        path = root / rel
+        tree = ast.parse(path.read_text())
+        spans = _method_spans(tree)
+        for line, kind in _mutations(tree, attr):
+            method = next(
+                (
+                    name
+                    for start, end, name in spans
+                    if start <= line <= end
+                ),
+                "<module>",
+            )
+            if method not in allowed:
+                out.append(
+                    Violation(
+                        RULE,
+                        rel,
+                        line,
+                        f"self.{attr} {kind} in {method}(); plan/cache "
+                        "state may only change in "
+                        f"{'/'.join(allowed)} (native plans drop on "
+                        "configure — anything else desyncs the bridge)",
+                    )
+                )
+    return out

@@ -1,0 +1,275 @@
+"""Three-way C-API bridge check: capi.cc <-> _native.py <-> _native.pyi.
+
+The ctypes bridge has no compiler between its three layers: a C export
+whose signature drifts from its ``argtypes`` declaration corrupts the call
+frame silently (wrong-width ints, missing pointers), and a stub file that
+drifts lies to every type-checked consumer. This rule parses all three and
+diffs them:
+
+- every ``tft_*`` function defined in ``native/src/capi.cc`` must have an
+  ``argtypes`` declaration in ``_load_lib`` with the same parameter count
+  (when it takes parameters) and a ``restype`` whenever the C return type
+  is not ``int``/``void`` (ctypes' default return of c_int silently
+  truncates an ``int64_t`` and mangles pointers);
+- every ``lib.tft_*`` declared in ``_native.py`` must exist in capi.cc
+  (stale bindings dangle);
+- every export must appear as a method of the ``_NativeLib`` class in
+  ``_native.pyi`` with the same parameter count (plus ``self``), and the
+  stub must not invent functions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from . import Violation, relpath
+
+RULE = "capi_sync"
+
+CAPI = Path("native/src/capi.cc")
+NATIVE_PY = Path("torchft_tpu/_native.py")
+NATIVE_PYI = Path("torchft_tpu/_native.pyi")
+
+
+class CExport(NamedTuple):
+    name: str
+    nparams: int
+    ret: str  # normalized return type text
+    line: int
+
+
+_FUNC_RE = re.compile(
+    # return type (may span words and '*'), name, params up to the first
+    # ')' (no function-pointer params in this API), then the body brace.
+    r"^([A-Za-z_][\w]*(?:\s+[\w]+)*[\s\*]+)(tft_\w+)\s*\(([^)]*)\)\s*\{",
+    re.M | re.S,
+)
+
+
+def parse_capi(text: str) -> List[CExport]:
+    m = re.search(r'extern\s+"C"\s*\{', text)
+    region = text[m.end():] if m else text
+    offset_line = text[: m.end()].count("\n") + 1 if m else 1
+    out = []
+    for fm in _FUNC_RE.finditer(region):
+        ret = " ".join(fm.group(1).replace("*", " * ").split())
+        params = fm.group(3).strip()
+        if params in ("", "void"):
+            n = 0
+        else:
+            n = params.count(",") + 1
+        line = offset_line + region[: fm.start()].count("\n")
+        out.append(CExport(fm.group(2), n, ret, line))
+    return out
+
+
+def _needs_restype(ret: str) -> bool:
+    return ret not in ("int", "void")
+
+
+def _list_len(node: ast.expr) -> int:
+    """Length of a ctypes argtypes list expression ([..], list+list,
+    list*N)."""
+    if isinstance(node, ast.List):
+        return len(node.elts)
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Add):
+            return _list_len(node.left) + _list_len(node.right)
+        if isinstance(node.op, ast.Mult):
+            if isinstance(node.right, ast.Constant) and isinstance(
+                node.right.value, int
+            ):
+                return _list_len(node.left) * node.right.value
+            if isinstance(node.left, ast.Constant) and isinstance(
+                node.left.value, int
+            ):
+                return node.left.value * _list_len(node.right)
+    raise ValueError("unsupported argtypes expression")
+
+
+class PyDecl(NamedTuple):
+    argtypes: Optional[int]  # parameter count, None if never declared
+    restype: bool
+    line: int
+
+
+def parse_native_py(text: str) -> Tuple[Dict[str, PyDecl], List[Violation]]:
+    tree = ast.parse(text)
+    decls: Dict[str, PyDecl] = {}
+    problems: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and tgt.attr in ("argtypes", "restype")
+            and isinstance(tgt.value, ast.Attribute)
+            and isinstance(tgt.value.value, ast.Name)
+            and tgt.value.value.id == "lib"
+            and tgt.value.attr.startswith("tft_")
+        ):
+            continue
+        name = tgt.value.attr
+        prev = decls.get(name, PyDecl(None, False, node.lineno))
+        if tgt.attr == "restype":
+            decls[name] = PyDecl(prev.argtypes, True, prev.line)
+        else:
+            try:
+                n = _list_len(node.value)
+            except ValueError:
+                problems.append(
+                    Violation(
+                        RULE,
+                        str(NATIVE_PY),
+                        node.lineno,
+                        f"{name}.argtypes is not a statically countable "
+                        "list expression",
+                    )
+                )
+                continue
+            decls[name] = PyDecl(n, prev.restype, node.lineno)
+    return decls, problems
+
+
+def parse_pyi(text: str) -> Optional[Dict[str, Tuple[int, int]]]:
+    """{name: (nparams excluding self, line)} of the _NativeLib class, or
+    None when the class is missing entirely."""
+    tree = ast.parse(text)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "_NativeLib":
+            out = {}
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    out[item.name] = (len(item.args.args) - 1, item.lineno)
+            return out
+    return None
+
+
+def check(
+    root: Path,
+    capi_path: Optional[Path] = None,
+    native_py_path: Optional[Path] = None,
+    pyi_path: Optional[Path] = None,
+) -> List[Violation]:
+    capi_path = capi_path or root / CAPI
+    native_py_path = native_py_path or root / NATIVE_PY
+    pyi_path = pyi_path or root / NATIVE_PYI
+
+    exports = parse_capi(capi_path.read_text())
+    decls, out = parse_native_py(native_py_path.read_text())
+    stubs = parse_pyi(pyi_path.read_text())
+
+    capi_rel = relpath(root, capi_path)
+    py_rel = relpath(root, native_py_path)
+    pyi_rel = relpath(root, pyi_path)
+
+    by_name = {e.name: e for e in exports}
+    if not exports:
+        out.append(Violation(RULE, capi_rel, 1, "no tft_* exports parsed"))
+
+    for e in exports:
+        d = decls.get(e.name)
+        if d is None:
+            out.append(
+                Violation(
+                    RULE,
+                    py_rel,
+                    1,
+                    f"{e.name} exported by capi.cc but has no ctypes "
+                    "declaration in _load_lib",
+                )
+            )
+            continue
+        if e.nparams > 0 and d.argtypes is None:
+            out.append(
+                Violation(
+                    RULE,
+                    py_rel,
+                    d.line,
+                    f"{e.name} takes {e.nparams} parameters but declares "
+                    "no argtypes",
+                )
+            )
+        elif d.argtypes is not None and d.argtypes != e.nparams:
+            out.append(
+                Violation(
+                    RULE,
+                    py_rel,
+                    d.line,
+                    f"{e.name} argtypes length {d.argtypes} != "
+                    f"{e.nparams} parameters in capi.cc",
+                )
+            )
+        if _needs_restype(e.ret) and not d.restype:
+            out.append(
+                Violation(
+                    RULE,
+                    py_rel,
+                    d.line,
+                    f"{e.name} returns {e.ret!r} but declares no restype "
+                    "(ctypes defaults to c_int: truncated int64 / mangled "
+                    "pointer)",
+                )
+            )
+
+    for name, d in decls.items():
+        if name not in by_name:
+            out.append(
+                Violation(
+                    RULE,
+                    py_rel,
+                    d.line,
+                    f"{name} declared in _native.py but not exported by "
+                    "capi.cc",
+                )
+            )
+
+    if stubs is None:
+        out.append(
+            Violation(
+                RULE,
+                pyi_rel,
+                1,
+                "_native.pyi has no _NativeLib class stubbing the raw "
+                "tft_* surface",
+            )
+        )
+        return out
+    for e in exports:
+        s = stubs.get(e.name)
+        if s is None:
+            out.append(
+                Violation(
+                    RULE,
+                    pyi_rel,
+                    1,
+                    f"{e.name} exported by capi.cc but missing from "
+                    "_NativeLib in _native.pyi",
+                )
+            )
+        elif s[0] != e.nparams:
+            out.append(
+                Violation(
+                    RULE,
+                    pyi_rel,
+                    s[1],
+                    f"{e.name} stub takes {s[0]} parameters but capi.cc "
+                    f"takes {e.nparams}",
+                )
+            )
+    for name, (_, line) in stubs.items():
+        if name not in by_name:
+            out.append(
+                Violation(
+                    RULE,
+                    pyi_rel,
+                    line,
+                    f"{name} stubbed in _NativeLib but not exported by "
+                    "capi.cc",
+                )
+            )
+    return out

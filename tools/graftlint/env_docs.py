@@ -1,0 +1,86 @@
+"""Every TORCHFT_* knob the product code reads is documented.
+
+An undocumented env knob is an operational trap: it changes ring behavior
+(and sometimes wire schedules every member must agree on) with no
+discoverable contract. The rule scans the shipped surfaces — Python under
+``torchft_tpu/`` and C++ under ``native/src/`` — for environment READS of
+``TORCHFT_*`` names and requires each to appear in ``docs/OPERATIONS.md``.
+Tests and benches that read a knob exercise the same documented surface,
+so only the product tree is scanned.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from . import Violation, relpath
+
+RULE = "env_docs"
+
+DOCS = Path("docs/OPERATIONS.md")
+SCAN_DIRS = (Path("torchft_tpu"), Path("native/src"))
+
+# Read forms only (setting an env var for a child process is the caller's
+# business): os.environ.get("X"), os.getenv("X"), os.environ["X"] in
+# Python; getenv("X") / std::getenv("X") in C++.
+_PY_READ = re.compile(
+    r"(?:os\.getenv\(|os\.environ\.get\(|os\.environ\[)\s*"
+    r"[\"'](TORCHFT_[A-Z0-9_]+)[\"']",
+    re.S,
+)
+_CC_READ = re.compile(r"getenv\(\s*\"(TORCHFT_[A-Z0-9_]+)\"")
+
+
+def collect_reads(root: Path, dirs: Sequence[Path]) -> Dict[str, List[str]]:
+    """{knob: ["file:line", ...]} across the scanned trees."""
+    reads: Dict[str, List[str]] = {}
+    for d in dirs:
+        base = root / d
+        if not base.exists():
+            continue
+        for path in sorted(base.rglob("*")):
+            if path.suffix == ".py":
+                pattern = _PY_READ
+            elif path.suffix in (".cc", ".h"):
+                pattern = _CC_READ
+            else:
+                continue
+            text = path.read_text()
+            for m in pattern.finditer(text):
+                line = text[: m.start()].count("\n") + 1
+                rel = str(path.relative_to(root))
+                reads.setdefault(m.group(1), []).append(f"{rel}:{line}")
+    return reads
+
+
+def check(
+    root: Path,
+    docs_path: Optional[Path] = None,
+    scan_dirs: Optional[Sequence[Path]] = None,
+) -> List[Violation]:
+    docs_path = docs_path or root / DOCS
+    documented = set(
+        re.findall(r"TORCHFT_[A-Z0-9_]+", docs_path.read_text())
+    )
+    docs_rel = relpath(root, docs_path)
+
+    out: List[Violation] = []
+    for knob, sites in sorted(
+        collect_reads(root, scan_dirs or SCAN_DIRS).items()
+    ):
+        if knob not in documented:
+            first = sites[0]
+            rel, _, line = first.rpartition(":")
+            out.append(
+                Violation(
+                    RULE,
+                    rel,
+                    int(line),
+                    f"{knob} is read here (and at "
+                    f"{len(sites) - 1} other site(s)) but not documented "
+                    f"in {docs_rel}",
+                )
+            )
+    return out

@@ -1,0 +1,187 @@
+"""Managed-collective latch discipline in torchft_tpu/manager.py.
+
+The per-step fault-tolerance contract (PAPER.md): data-plane errors must
+NEVER raise into the train loop — they latch, the op resolves to its
+documented default, and ``should_commit`` discards the step. The rule
+checks the two halves statically:
+
+- every ``Manager`` method that touches a managed collective op
+  (``self._collectives.allreduce`` etc.) must route through
+  ``_managed_dispatch`` and may only ``raise ValueError`` (the eager
+  static-usage errors the docstrings carve out) — no bare ``raise``, no
+  other exception types on the managed path;
+- ``_managed_dispatch`` itself must keep the latch: a ``try`` whose
+  handler calls ``self.report_error`` and contains no ``raise``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from . import Violation, relpath
+
+RULE = "latch_discipline"
+
+MANAGER_PY = Path("torchft_tpu/manager.py")
+
+# The managed data-plane surface. Anything new that dispatches to one of
+# these from Manager must adopt the same discipline (or extend this rule).
+MANAGED_OPS = {
+    "allreduce",
+    "plan_allreduce",
+    "reduce_scatter",
+    "allgather_into",
+    "allgather",
+}
+DISPATCH = "_managed_dispatch"
+LATCH = "report_error"
+
+
+def _touches_managed_op(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in MANAGED_OPS
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "_collectives"
+            and isinstance(node.value.value, ast.Name)
+            and node.value.value.id == "self"
+        ):
+            return True
+    return False
+
+
+def _calls_self_method(fn: ast.FunctionDef, method: str) -> bool:
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == method
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+        ):
+            return True
+    return False
+
+
+def _raise_is_value_error(node: ast.Raise) -> bool:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    return isinstance(exc, ast.Name) and exc.id == "ValueError"
+
+
+def _check_dispatch(fn: ast.FunctionDef, rel: str) -> List[Violation]:
+    out: List[Violation] = []
+    latching_handler = False
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            calls_latch = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == LATCH
+                for n in ast.walk(handler)
+            )
+            reraises = any(
+                isinstance(n, ast.Raise) for n in ast.walk(handler)
+            )
+            if calls_latch and not reraises:
+                latching_handler = True
+            elif reraises:
+                out.append(
+                    Violation(
+                        RULE,
+                        rel,
+                        handler.lineno,
+                        f"{DISPATCH} exception handler re-raises: managed "
+                        "failures must latch via report_error, not "
+                        "propagate",
+                    )
+                )
+    if not latching_handler:
+        out.append(
+            Violation(
+                RULE,
+                rel,
+                fn.lineno,
+                f"{DISPATCH} has no exception handler that latches via "
+                f"self.{LATCH}",
+            )
+        )
+    return out
+
+
+def check(root: Path, manager_path: Optional[Path] = None) -> List[Violation]:
+    path = manager_path or root / MANAGER_PY
+    rel = relpath(root, path)
+    tree = ast.parse(path.read_text())
+    out: List[Violation] = []
+
+    manager = next(
+        (
+            n
+            for n in tree.body
+            if isinstance(n, ast.ClassDef) and n.name == "Manager"
+        ),
+        None,
+    )
+    if manager is None:
+        return [Violation(RULE, rel, 1, "no Manager class found")]
+
+    saw_dispatch = False
+    for fn in manager.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if fn.name == DISPATCH:
+            saw_dispatch = True
+            out.extend(_check_dispatch(fn, rel))
+            continue
+        if not _touches_managed_op(fn):
+            continue
+        if not _calls_self_method(fn, DISPATCH):
+            out.append(
+                Violation(
+                    RULE,
+                    rel,
+                    fn.lineno,
+                    f"Manager.{fn.name} touches a managed collective but "
+                    f"does not route through {DISPATCH} (failure -> None/"
+                    "default + latch -> vote-discard)",
+                )
+            )
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Raise):
+                if node.exc is None:
+                    out.append(
+                        Violation(
+                            RULE,
+                            rel,
+                            node.lineno,
+                            f"Manager.{fn.name} bare re-raise on the "
+                            "managed path (errors must latch, not "
+                            "propagate)",
+                        )
+                    )
+                elif not _raise_is_value_error(node):
+                    out.append(
+                        Violation(
+                            RULE,
+                            rel,
+                            node.lineno,
+                            f"Manager.{fn.name} raises a non-ValueError "
+                            "on the managed path (only eager static-usage "
+                            "ValueErrors may raise; data-plane failures "
+                            "latch)",
+                        )
+                    )
+    if not saw_dispatch:
+        out.append(
+            Violation(
+                RULE, rel, manager.lineno, f"Manager has no {DISPATCH}"
+            )
+        )
+    return out

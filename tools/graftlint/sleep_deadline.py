@@ -1,0 +1,90 @@
+"""No deadline-less sleep-poll loops in tests/.
+
+A ``while ...: time.sleep(...)`` poll with no visible deadline turns a
+regression into a hung CI job (the tier-1 runner kills the whole suite on
+its global timeout, taking every other test's signal with it). The rule
+flags any ``while`` loop in ``tests/`` that calls ``time.sleep`` unless
+the loop's source carries a recognizable bound: a wall-clock comparison
+(``time.monotonic``/``time.time``/``perf_counter``), a name containing
+``deadline``, or an attempt counter in the condition. ``for``-loops over
+``range`` are inherently bounded and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from . import Violation, relpath
+
+RULE = "sleep_deadline"
+
+TESTS_DIR = Path("tests")
+
+_BOUND_MARKERS = (
+    "time.monotonic",
+    "time.time",
+    "perf_counter",
+    "deadline",
+    "now_ms",
+)
+
+
+def _calls_sleep(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr == "sleep":
+                if isinstance(f.value, ast.Name) and f.value.id == "time":
+                    return True
+            if isinstance(f, ast.Name) and f.id == "sleep":
+                return True
+    return False
+
+
+def _check_file(path: Path, rel: str) -> List[Violation]:
+    text = path.read_text()
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Violation(RULE, rel, e.lineno or 1, f"unparseable: {e.msg}")]
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        if not _calls_sleep(node):
+            continue
+        segment = ast.get_source_segment(text, node) or ""
+        if any(marker in segment for marker in _BOUND_MARKERS):
+            continue
+        out.append(
+            Violation(
+                RULE,
+                rel,
+                node.lineno,
+                "while-loop polls with time.sleep but shows no deadline "
+                "(compare against time.monotonic()/a deadline variable, "
+                "or use a bounded for-range)",
+            )
+        )
+    return out
+
+
+def check(
+    root: Path, test_paths: Optional[Sequence[Path]] = None
+) -> List[Violation]:
+    paths = (
+        list(test_paths)
+        if test_paths is not None
+        else sorted((root / TESTS_DIR).glob("**/*.py"))
+    )
+    out: List[Violation] = []
+    for path in paths:
+        rel = relpath(root, path)
+        # Fixture files seed deliberate violations for graftlint's own
+        # tests; they are linted only when passed explicitly.
+        if test_paths is None and "graftlint_fixtures" in rel:
+            continue
+        out.extend(_check_file(path, rel))
+    return out

@@ -4,7 +4,7 @@
 # carries full inline annotations too; this stub pins the API for type
 # checkers without importing the shared library.
 from datetime import timedelta
-from typing import List, Optional, Union
+from typing import Any, List, Optional, Union
 
 # Error mapping (no custom exception classes): native failures raise
 # RuntimeError; deadline-class failures raise TimeoutError, mirroring the
@@ -129,25 +129,253 @@ class StoreClient:
     ) -> int: ...
 
 
-# The tft_hc_* HostCollectives entry points (striped TCP ring: create /
-# configure(store_addr, rank, world_size, timeout_ms, stripes) / allreduce /
-# allreduce_q8 / allgather / broadcast / barrier / abort / world_size /
-# stripes / last_stripe_ns, plus the sharded split ops
-# reduce_scatter(data, count, dtype, op, shard_out, layout_stripes) /
-# reduce_scatter_q8(data, count, shard_out, grid_shard, layout_stripes) /
-# allgather_into(shard, data, count, dtype, layout_stripes) /
-# shard_ranges(count, esize, rank, layout_stripes)) are declared on the
-# loaded CDLL in _load_lib and consumed by
-# torchft_tpu.collectives.HostCollectives, the typed wrapper.
-#
-# Persistent comm plans ride the same CDLL surface:
-# tft_plan_build(handle, counts, dtypes, n_leaves, wire) -> plan_id,
-# tft_plan_execute(handle, plan_id, leaf_in_ptrs, leaf_out_ptrs, divisor,
-# has_divisor, timeout_ms), tft_plan_free(handle, plan_id),
-# tft_plan_reset_feedback(handle, plan_id) (zeroes a q8+EF plan's
-# error-feedback carry), tft_plan_stats_json(handle, plan_id, out) (the
-# last execute's per-bucket phase timings). Plans are invalidated by
-# tft_hc_configure; wire codes: 0 native dtypes, 1 bf16, 2 q8, 3 q8+EF.
+class _NativeLib:
+    """The raw ctypes surface over native/src/capi.cc, one method per
+    ``tft_*`` export — the checked contract between the three bridge
+    layers. graftlint's ``capi_sync`` rule diffs this class against the C
+    definitions and the ``_load_lib`` argtypes declarations (names AND
+    parameter counts), so bridge drift fails CI instead of corrupting a
+    call frame at 2am. ``Any`` stands for a ctypes pointer/buffer
+    argument; handles are ``void*``. Wire codes for tft_plan_build: 0
+    native dtypes, 1 bf16, 2 q8, 3 q8+EF; plans are invalidated by
+    tft_hc_configure."""
+
+    def tft_last_error(self) -> Any: ...
+    def tft_string_free(self, s: Any) -> None: ...
+    def tft_lighthouse_create(
+        self,
+        bind: bytes,
+        min_replicas: int,
+        join_timeout_ms: int,
+        quorum_tick_ms: int,
+        heartbeat_timeout_ms: int
+    ) -> Any: ...
+    def tft_lighthouse_address(self, handle: Any) -> Any: ...
+    def tft_lighthouse_shutdown(self, handle: Any) -> None: ...
+    def tft_lighthouse_destroy(self, handle: Any) -> None: ...
+    def tft_lighthouse_heartbeat(
+        self,
+        addr: bytes,
+        replica_id: bytes,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_manager_create(
+        self,
+        replica_id: bytes,
+        lighthouse_addr: bytes,
+        hostname: bytes,
+        bind: bytes,
+        store_addr: bytes,
+        world_size: int,
+        heartbeat_interval_ms: int,
+        connect_timeout_ms: int
+    ) -> Any: ...
+    def tft_manager_address(self, handle: Any) -> Any: ...
+    def tft_manager_shutdown(self, handle: Any) -> None: ...
+    def tft_manager_destroy(self, handle: Any) -> None: ...
+    def tft_client_create(
+        self,
+        addr: bytes,
+        connect_timeout_ms: int
+    ) -> Any: ...
+    def tft_client_destroy(self, handle: Any) -> None: ...
+    def tft_client_quorum(
+        self,
+        handle: Any,
+        rank: int,
+        step: int,
+        checkpoint_metadata: bytes,
+        shrink_only: int,
+        force_reconfigure: int,
+        timeout_ms: int,
+        result_json: Any
+    ) -> int: ...
+    def tft_client_checkpoint_metadata(
+        self,
+        handle: Any,
+        rank: int,
+        timeout_ms: int,
+        metadata_out: Any
+    ) -> int: ...
+    def tft_client_should_commit(
+        self,
+        handle: Any,
+        rank: int,
+        step: int,
+        should_commit: int,
+        timeout_ms: int,
+        result: Any
+    ) -> int: ...
+    def tft_client_kill(self, handle: Any, msg: bytes) -> int: ...
+    def tft_store_create(self, bind: bytes) -> Any: ...
+    def tft_store_address(self, handle: Any) -> Any: ...
+    def tft_store_port(self, handle: Any) -> int: ...
+    def tft_store_shutdown(self, handle: Any) -> None: ...
+    def tft_store_destroy(self, handle: Any) -> None: ...
+    def tft_store_client_create(
+        self,
+        addr: bytes,
+        connect_timeout_ms: int
+    ) -> Any: ...
+    def tft_store_client_destroy(self, handle: Any) -> None: ...
+    def tft_store_client_set(
+        self,
+        handle: Any,
+        key: bytes,
+        value: bytes,
+        value_len: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_store_client_get(
+        self,
+        handle: Any,
+        key: bytes,
+        timeout_ms: int,
+        value_out: Any,
+        value_len_out: Any
+    ) -> int: ...
+    def tft_store_client_add(
+        self,
+        handle: Any,
+        key: bytes,
+        delta: int,
+        timeout_ms: int,
+        value_out: Any
+    ) -> int: ...
+    def tft_hc_create(self) -> Any: ...
+    def tft_hc_destroy(self, handle: Any) -> None: ...
+    def tft_hc_configure(
+        self,
+        handle: Any,
+        store_addr: bytes,
+        rank: int,
+        world_size: int,
+        timeout_ms: int,
+        stripes: int
+    ) -> int: ...
+    def tft_hc_allreduce(
+        self,
+        handle: Any,
+        data: Any,
+        count: int,
+        dtype: int,
+        op: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_allreduce_q8(
+        self,
+        handle: Any,
+        data: Any,
+        count: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_reduce_scatter(
+        self,
+        handle: Any,
+        data: Any,
+        count: int,
+        dtype: int,
+        op: int,
+        shard_out: Any,
+        layout_stripes: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_reduce_scatter_q8(
+        self,
+        handle: Any,
+        data: Any,
+        count: int,
+        shard_out: Any,
+        grid_shard: int,
+        layout_stripes: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_allgather_into(
+        self,
+        handle: Any,
+        shard: Any,
+        data: Any,
+        count: int,
+        dtype: int,
+        layout_stripes: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_shard_ranges(
+        self,
+        handle: Any,
+        count: int,
+        esize: int,
+        rank: int,
+        layout_stripes: int,
+        out: Any,
+        cap: int
+    ) -> int: ...
+    def tft_plan_build(
+        self,
+        handle: Any,
+        counts: Any,
+        dtypes: Any,
+        n_leaves: int,
+        wire: int
+    ) -> int: ...
+    def tft_plan_execute(
+        self,
+        handle: Any,
+        plan_id: int,
+        leaf_in: Any,
+        leaf_out: Any,
+        divisor: float,
+        has_divisor: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_plan_free(self, handle: Any, plan_id: int) -> int: ...
+    def tft_plan_reset_feedback(self, handle: Any, plan_id: int) -> int: ...
+    def tft_plan_stats_json(
+        self,
+        handle: Any,
+        plan_id: int,
+        out: Any
+    ) -> int: ...
+    def tft_hc_allgather(
+        self,
+        handle: Any,
+        in_: Any,
+        out: Any,
+        nbytes: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_broadcast(
+        self,
+        handle: Any,
+        data: Any,
+        nbytes: int,
+        root: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_barrier(self, handle: Any, timeout_ms: int) -> int: ...
+    def tft_hc_abort(self, handle: Any) -> None: ...
+    def tft_hc_world_size(self, handle: Any) -> int: ...
+    def tft_hc_stripes(self, handle: Any) -> int: ...
+    def tft_hc_last_stripe_ns(
+        self,
+        handle: Any,
+        out: Any,
+        cap: int
+    ) -> int: ...
+    def tft_quorum_compute(
+        self,
+        now: int,
+        state_json: bytes,
+        opt_json: bytes,
+        result_json: Any
+    ) -> int: ...
+    def tft_compute_quorum_results(
+        self,
+        replica_id: bytes,
+        rank: int,
+        quorum_json: bytes,
+        result_json: Any
+    ) -> int: ...
 
 
 def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict: ...
