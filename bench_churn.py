@@ -35,9 +35,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -53,12 +55,14 @@ sys.path.insert(0, REPO)
 def worker() -> None:
     """Trains the flagship transformer (small config) with the full FT path,
     appending one JSONL record per attempted step (plus one "boot" record
-    timestamping the restart->rejoin phases for the heal breakdown)."""
+    timestamping the restart->rejoin phases for the heal breakdown, and one
+    "heal" record per live recovery carrying the streamed-fetch stats)."""
     t_enter = time.time()
     from torchft_tpu.platform import (
         apply_compilation_cache_env,
         apply_jax_platform_env,
         standby_gate,
+        standby_should_warm,
     )
 
     apply_jax_platform_env()
@@ -81,6 +85,13 @@ def worker() -> None:
     group = int(os.environ["REPLICA_GROUP_ID"])
     num_steps = int(os.environ["NUM_STEPS"])
     log_path = os.environ["BENCH_LOG"]
+    t_setup = time.time()  # library imports done
+
+    # Backend acquisition timed on its own: on tunneled accelerator hosts
+    # this is the phase that can eat tens of seconds (or hang), and the
+    # old breakdown buried it inside one opaque "setup" bucket.
+    jax.devices()
+    t_backend = time.time()
 
     cfg = TransformerConfig(
         vocab_size=2048, d_model=128, n_heads=4, n_layers=2, d_ff=256,
@@ -94,7 +105,7 @@ def worker() -> None:
 
     state = FTTrainState(init_params(cfg, jax.random.PRNGKey(0)), optax.adamw(1e-3))
     grad_fn = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(cfg, p, b)))
-    t_setup = time.time()
+    t_model = time.time()  # params + optimizer state live on device
 
     # Compile BEFORE joining the quorum, then hold at the start line until
     # every group is ready (parent touches the go file). Without this the
@@ -102,11 +113,24 @@ def worker() -> None:
     # while peers are still importing/compiling, polluting the measured
     # window. Restarted workers find the go file already present and rejoin
     # immediately through the normal heal path.
-    jax.block_until_ready(grad_fn(state.params, batch))
+    _, grads0 = jax.block_until_ready(grad_fn(state.params, batch))
+    # The collectives object exists BEFORE the gate (no network until
+    # configure), so promotion pays neither its thread start nor — after
+    # the AOT warm below — any packer/optimizer-update compile: promotion
+    # is quorum join + weight fetch only.
+    collectives = HostCollectives(timeout=timedelta(seconds=30))
+    is_standby = bool(os.environ.get("TORCHFT_STANDBY_FILE"))
+    if is_standby and standby_should_warm():
+        # Truly-warm STANDBY discipline (TORCHFT_STANDBY_WARM): run the
+        # optimizer update and the ring pack/unpack once AOT, so the jit
+        # cache is hot for every executable the first post-promotion step
+        # needs — not just the grad program. Cold restarts skip this on
+        # purpose: for them every pre-gate second delays the rejoin, and
+        # the apply/packer compiles are persistent-cache hits paid once
+        # inside the (already short) first committed step.
+        state.warm(grads0)
+        collectives.prewarm(grads0)
     t_compiled = time.time()
-    # (t_setup was stamped after the import block: spawn->enter is the
-    # interpreter + sitecustomize-preloaded jax; enter->setup is the
-    # remaining library imports + model init; setup->compiled is the jit.)
     # Hot-spare standbys park HERE, fully warmed, until promoted; for
     # them activated_t is the promotion instant, for cold starts it
     # coincides with compile completion.
@@ -119,7 +143,6 @@ def worker() -> None:
     # first group to request forms an instant solo quorum (it is the only
     # HEARTBEATING replica at that moment) and membership flaps from
     # there.
-    collectives = HostCollectives(timeout=timedelta(seconds=30))
     manager = Manager(
         collectives=collectives,
         load_state_dict=state.load_state_dict,
@@ -129,6 +152,7 @@ def worker() -> None:
         replica_id=f"bench_{group}",
     )
     optimizer = OptimizerWrapper(manager, state)
+    transport = manager.checkpoint_transport()
 
     go_path = os.environ["BENCH_GO"]
     open(log_path + ".ready", "w").close()
@@ -138,7 +162,7 @@ def worker() -> None:
     with open(log_path, "a", buffering=1) as log:
         # Boot record first: the parent joins it with its kill/spawn
         # timestamps to break heal latency into respawn / import / setup /
-        # compile / join phases.
+        # backend_init / mesh / compile / rendezvous phases.
         log.write(
             json.dumps(
                 {
@@ -146,6 +170,8 @@ def worker() -> None:
                         "spawn_t": float(os.environ.get("BENCH_SPAWN_T", 0)),
                         "enter_t": t_enter,
                         "setup_t": t_setup,
+                        "backend_t": t_backend,
+                        "model_t": t_model,
                         "compiled_t": t_compiled,
                         "activated_t": t_activated,
                         "manager_t": time.time(),
@@ -154,6 +180,7 @@ def worker() -> None:
             )
             + "\n"
         )
+        last_heal_stats = None
         while manager.current_step() < num_steps:
             t0 = time.perf_counter()
             optimizer.zero_grad()
@@ -182,8 +209,209 @@ def worker() -> None:
                 )
                 + "\n"
             )
+            # One "heal" record per live recovery: the transport's fetch
+            # stats (stream path, wire, fetch/h2d seconds) joined by the
+            # parent into the heal breakdown.
+            stats = getattr(transport, "last_fetch_stats", None)
+            if stats is not None and stats is not last_heal_stats:
+                last_heal_stats = stats
+                log.write(
+                    json.dumps({"heal": {"t": time.time(), **stats}}) + "\n"
+                )
     manager.shutdown()
     collectives.shutdown()
+
+
+# --------------------------------------------------------------------------
+# zygote: import-warm respawn server
+# --------------------------------------------------------------------------
+
+
+def zygote() -> None:
+    """Import-warm respawn server (``TORCHFT_ZYGOTE=0`` disables): pays
+    the worker's Python import bill ONCE, then forks a ready-to-run
+    worker per request. A cold restart's dominant cost on this bench is
+    re-importing jax/optax/torchft under survivor contention (~10 s of
+    the measured ~20 s heal at 4 groups on 2 CPUs — the breakdown's
+    ``setup`` bucket); priority levers can't fix it where nice is
+    unenforced (gVisor), but not re-doing the work can. The zygote stays
+    SINGLE-THREADED and never initializes the jax backend (XLA clients
+    spawn thread pools; forking a multithreaded process risks inherited
+    lock state) — each forked child acquires its own backend, so the
+    breakdown's backend_init / mesh / compile phases stay honest per
+    restart and only the pure re-import cost disappears.
+
+    Protocol (line-JSON): parent writes ``{"env": {...full child env},
+    "nice": N}`` on stdin; zygote forks, answers ``{"pid": P}``, and
+    reports reaped children as ``{"exit": P, "rc": RC}`` (kills surface
+    as negative signal codes, matching subprocess semantics)."""
+    import select
+
+    from torchft_tpu.platform import apply_jax_platform_env
+
+    apply_jax_platform_env()
+    import jax  # noqa: F401
+    import jax.numpy  # noqa: F401
+    import numpy  # noqa: F401
+    import optax  # noqa: F401
+
+    import torchft_tpu  # noqa: F401
+    import torchft_tpu.models  # noqa: F401
+
+    assert threading.active_count() == 1, (
+        "zygote must stay single-threaded to fork safely; an import "
+        "started a thread"
+    )
+    print(json.dumps({"ready": True}), flush=True)
+    children: Dict[int, bool] = {}
+    while True:
+        ready, _, _ = select.select([sys.stdin], [], [], 0.1)
+        if ready:
+            line = sys.stdin.readline()
+            if not line:
+                break  # parent is gone; any orphans are its to kill
+            req = json.loads(line)
+            pid = os.fork()
+            if pid == 0:
+                # -- child: become the worker --
+                try:
+                    devnull = os.open(os.devnull, os.O_RDONLY)
+                    os.dup2(devnull, 0)  # stdin is the PROTOCOL pipe
+                    os.dup2(2, 1)  # keep the protocol stdout clean too
+                    os.environ.clear()
+                    os.environ.update(req["env"])
+                    if req.get("nice"):
+                        try:
+                            os.nice(int(req["nice"]))
+                        except OSError:
+                            pass
+                    worker()
+                    os._exit(0)
+                except SystemExit as e:
+                    os._exit(int(e.code or 0))
+                except BaseException:
+                    import traceback
+
+                    traceback.print_exc()
+                    os._exit(1)
+            children[pid] = True
+            print(json.dumps({"pid": pid}), flush=True)
+        for pid in list(children):
+            wpid, status = os.waitpid(pid, os.WNOHANG)
+            if wpid:
+                del children[pid]
+                print(
+                    json.dumps(
+                        {"exit": wpid,
+                         "rc": os.waitstatus_to_exitcode(status)}
+                    ),
+                    flush=True,
+                )
+
+
+class _ZygoteProc:
+    """Popen-shaped handle for a zygote-forked worker (the supervisor
+    signals it directly by pid; exit codes arrive via the zygote's
+    reaper events)."""
+
+    def __init__(self, zyg: "_Zygote", pid: int) -> None:
+        self._zyg = zyg
+        self.pid = pid
+
+    def poll(self) -> Optional[int]:
+        rc = self._zyg.exit_codes.get(self.pid)
+        if rc is not None:
+            return rc
+        if not self._zyg.alive():
+            # Zygote gone (phase teardown): fall back to a liveness
+            # probe so the final wait loop can't spin on a dead child.
+            try:
+                os.kill(self.pid, 0)
+            except ProcessLookupError:
+                return -9
+        return None
+
+    def send_signal(self, sig: int) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        self.send_signal(signal.SIGKILL)
+
+    def terminate(self) -> None:
+        self.send_signal(signal.SIGTERM)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = time.time() + (timeout if timeout is not None else 3600)
+        while True:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            if time.time() >= deadline:
+                raise subprocess.TimeoutExpired("zygote-child", timeout)
+            time.sleep(0.05)
+
+
+class _Zygote:
+    """Parent-side handle: one import-warm respawn server per phase."""
+
+    def __init__(self, base_env: Dict[str, str]) -> None:
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--zygote"],
+            env=base_env,
+            cwd=REPO,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            text=True,
+            bufsize=1,
+        )
+        self.exit_codes: Dict[int, int] = {}
+        self._responses: "queue.Queue[dict]" = queue.Queue()
+        self._lock = threading.Lock()
+        threading.Thread(
+            target=self._read, daemon=True, name="zygote_reader"
+        ).start()
+        msg = self._responses.get(timeout=120)
+        if not msg.get("ready"):
+            raise RuntimeError(f"zygote failed to warm: {msg}")
+
+    def _read(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                msg = json.loads(line)
+                if "exit" in msg:
+                    self.exit_codes[msg["exit"]] = msg["rc"]
+                else:
+                    if "pid" in msg:
+                        # The kernel recycles pids: clear a stale exit
+                        # code from a previous worker IN PIPE ORDER, so
+                        # a fresh child never reads as already-dead (and
+                        # its own exit, which can only arrive later on
+                        # this pipe, is never erased).
+                        self.exit_codes.pop(msg["pid"], None)
+                    self._responses.put(msg)
+        except Exception:
+            pass  # zygote died; spawn() falls back to classic Popen
+
+    def spawn(self, env: Dict[str, str], nice: int = 0) -> _ZygoteProc:
+        with self._lock:
+            self.proc.stdin.write(
+                json.dumps({"env": env, "nice": nice}) + "\n"
+            )
+            self.proc.stdin.flush()
+            msg = self._responses.get(timeout=60)
+        return _ZygoteProc(self, msg["pid"])
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def shutdown(self) -> None:
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
 
 
 # --------------------------------------------------------------------------
@@ -194,15 +422,26 @@ def worker() -> None:
 class _Group:
     def __init__(
         self, gid: int, log_path: str, env: Dict[str, str],
-        hot_spare: bool = False,
+        hot_spare: bool = False, heal_boost: int = 0,
+        zygote: Optional[_Zygote] = None, lift_ok: bool = True,
     ) -> None:
         self.gid = gid
         self.log_path = log_path
         self.env = env
         self.hot_spare = hot_spare
+        self.heal_boost = heal_boost
+        self.zygote = zygote
+        # launcher.py discipline: standbys only warm NICED when the
+        # supervisor can lift them back — an unprivileged supervisor
+        # warms un-niced (bounded contention) rather than parking spares
+        # at a priority nobody can ever restore.
+        self.lift_ok = lift_ok
+        self.boost_active: Optional[float] = None
         self.proc: Optional[subprocess.Popen] = None
         self.standby: Optional[subprocess.Popen] = None
         self.standby_file: Optional[str] = None
+        self.standby_armed_t = 0.0
+        self.standby_lifted = False
 
     def _popen(
         self, extra_env: Dict[str, str], idle: bool = False
@@ -217,6 +456,20 @@ class _Group:
                 env.pop(k, None)
             else:
                 env[k] = v
+        # Import-warm respawn: fork from the phase zygote when the child
+        # would run the same interpreter profile the zygote warmed (CPU
+        # platform). The TPU group needs a REAL interpreter start (its
+        # sitecustomize backend preload runs at interpreter start), so it
+        # always takes the classic spawn.
+        if (
+            self.zygote is not None
+            and self.zygote.alive()
+            and env.get("JAX_PLATFORMS") == "cpu"
+        ):
+            try:
+                return self.zygote.spawn(env, nice=19 if idle else 0)
+            except Exception:
+                pass  # zygote wedged/died: classic spawn still heals
         preexec = None
         if idle:
 
@@ -242,11 +495,47 @@ class _Group:
         # Idle priority (launcher.py discipline): standby warm-up
         # (imports + jit) must not steal cycles from live training — the
         # round-3 hot-spare phase measured ratio 0.742 BECAUSE re-arming
-        # contended with every group on the single shared CPU.
+        # contended with every group on the single shared CPU. The
+        # idle-priority trade is bounded by the warm-deadline lift below:
+        # a spare that is STILL warming when the grace expires gets its
+        # priority restored so repeat kills find it parked at the gate
+        # fully warmed, not mid-import (the round-5 16 s hot-spare p50:
+        # on a saturated host an idle re-arm never finishes, so every
+        # promotion paid the whole warm-up at heal time).
         self.standby_file = self.log_path + f".standby_{time.time():.3f}"
         self.standby = self._popen(
-            {"TORCHFT_STANDBY_FILE": self.standby_file}, idle=True
+            {"TORCHFT_STANDBY_FILE": self.standby_file},
+            idle=self.lift_ok,
         )
+        self.standby_armed_t = time.monotonic()
+        self.standby_lifted = False
+
+    def standby_warm(self) -> bool:
+        """Whether the parked standby finished warming (standby_gate
+        touches ``<standby_file>.warm`` on arrival)."""
+        return bool(
+            self.standby_file and os.path.exists(self.standby_file + ".warm")
+        )
+
+    def lift_slow_warmup(self, deadline_s: float) -> None:
+        """Restores a still-warming standby to normal priority once the
+        grace window expires (torchft_tpu.launcher applies the same
+        policy): bounded contention once per re-arm instead of a cold
+        warm-up on every subsequent kill of this group."""
+        if (
+            not self.lift_ok  # standby was never niced; nothing to lift
+            or self.standby is None
+            or self.standby.poll() is not None
+            or self.standby_lifted
+            or self.standby_warm()
+            or time.monotonic() - self.standby_armed_t < deadline_s
+        ):
+            return
+        self.standby_lifted = True
+        try:
+            os.setpriority(os.PRIO_PROCESS, self.standby.pid, 0)
+        except (OSError, AttributeError):
+            pass
 
     def restart(self) -> None:
         """Cold respawn, or sub-second promotion of the warm standby
@@ -262,8 +551,60 @@ class _Group:
             self.arm_standby()
         else:
             self.proc = self._popen({})
+            if self.heal_boost:
+                # Heal-priority boost (platform.heal_boost_nice): the
+                # cold-restarting member is the cohort's degraded one —
+                # lend it survivor CPU while it heals; maybe_deboost
+                # returns it to parity at its first committed step.
+                try:
+                    os.setpriority(
+                        os.PRIO_PROCESS, self.proc.pid, -self.heal_boost
+                    )
+                    self.boost_active = time.time()
+                except (OSError, AttributeError):
+                    pass
             if self.hot_spare:
                 self.arm_standby()
+
+    def maybe_deboost(self) -> None:
+        """Ends an active heal boost once the restarted worker committed
+        a step (healed — it is a peer again), or after a 60 s hard cap
+        (a heal that slow has bigger problems than priority). Reads only
+        the log's TAIL, at a 1 s cadence: re-parsing a 1200-record JSONL
+        4×/s from the supervisor would load the very CPUs whose
+        contention the heal numbers measure."""
+        if self.boost_active is None or self.proc is None:
+            return
+        now = time.time()
+        if now < getattr(self, "_deboost_next_check", 0):
+            return
+        self._deboost_next_check = now + 1.0
+        healed = False
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                start = max(0, f.tell() - 8192)
+                f.seek(start)
+                tail = f.read().decode(errors="replace").splitlines()
+            if start > 0:
+                tail = tail[1:]  # first line torn by the mid-file seek
+            for line in tail:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if r.get("committed") and r.get("t", 0) > self.boost_active:
+                    healed = True
+                    break
+        except OSError:
+            pass
+        if healed or now - self.boost_active > 60:
+            self.boost_active = None
+            if self.proc.poll() is None:
+                try:
+                    os.setpriority(os.PRIO_PROCESS, self.proc.pid, 0)
+                except (OSError, AttributeError):
+                    pass
 
     def reap(self) -> None:
         if self.standby is not None and self.standby.poll() is None:
@@ -291,6 +632,111 @@ def _committed(records: List[dict]) -> List[dict]:
     return [r for r in records if r.get("committed")]
 
 
+# Every heal-breakdown phase the artifact can carry, in pipeline order.
+# Cold restarts populate all of them; promoted standbys only the ones a
+# promotion actually pays (activation / rendezvous / fetch / h2d /
+# first_commit) — the absent cold keys are the measurement that the warm
+# path skipped that work.
+HEAL_PHASES = (
+    "activation", "respawn", "import", "setup", "backend_init", "mesh",
+    "compile", "join", "rendezvous", "fetch", "h2d", "first_commit",
+)
+
+
+def compute_heal_stats(
+    kills: List[dict], logs_by_gid: Dict[int, List[dict]]
+) -> tuple:
+    """Joins the supervisor's kill timestamps with each victim's log
+    records into ``(heal_s, breakdowns)``.
+
+    heal_s: seconds from each SIGKILL to the restarted group's first
+    committed step (sorted). breakdowns: one dict of HEAL_PHASES seconds
+    per attributable kill — boot-record deltas (respawn / import / setup
+    / backend_init / mesh / compile for cold restarts; activation /
+    rendezvous for both paths) plus the in-band "heal" record's streamed
+    fetch / h2d split. Each kill's window is bounded at the SAME group's
+    next kill: if the victim dies again before its restart commits, the
+    later kill's commit/boot/heal records must not be attributed to this
+    one (that would silently fold an extra kill cycle into the medians).
+    Pure function of the logs — unit-testable without running a phase."""
+    heal_s = []
+    breakdowns = []
+    for k in kills:
+        next_kill_t = min(
+            (
+                k2["t"]
+                for k2 in kills
+                if k2["gid"] == k["gid"] and k2["t"] > k["t"]
+            ),
+            default=float("inf"),
+        )
+        log = logs_by_gid.get(k["gid"], [])
+        after = [
+            r["t"]
+            for r in _committed(log)
+            if k["t"] < r["t"] < next_kill_t
+        ]
+        if after:
+            heal_s.append(after[0] - k["t"])
+        # Match boots by ACTIVATION time: a promoted hot-spare standby was
+        # spawned (and imported/compiled) long before the kill, so only
+        # its activation falls in this kill's window.
+        boots = [
+            r["boot"]
+            for r in log
+            if "boot" in r
+            and k["t"] < r["boot"].get("activated_t", r["boot"]["spawn_t"])
+            < next_kill_t
+        ]
+        if boots and after:
+            b = boots[0]
+            entry = {
+                # kill -> warmed process past its gate (cold: respawn +
+                # import + setup + backend_init + mesh + compile;
+                # promoted standby: just the supervisor poll + gate poll)
+                "activation": b["activated_t"] - k["t"],
+                # manager/store/quorum-client bring-up ("join" is the
+                # same delta, kept for artifact continuity)
+                "rendezvous": b["manager_t"] - b["activated_t"],
+                "join": b["manager_t"] - b["activated_t"],
+                "first_commit": after[0] - b["manager_t"],
+            }
+            if b["spawn_t"] > k["t"]:
+                # Cold restart: the process-boot phases belong to this kill.
+                entry.update(
+                    {
+                        "respawn": b["spawn_t"] - k["t"],
+                        "import": b["enter_t"] - b["spawn_t"],
+                        "setup": b["setup_t"] - b["enter_t"],
+                    }
+                )
+                if "backend_t" in b and "model_t" in b:
+                    entry.update(
+                        {
+                            "backend_init": b["backend_t"] - b["setup_t"],
+                            "mesh": b["model_t"] - b["backend_t"],
+                            "compile": b["compiled_t"] - b["model_t"],
+                        }
+                    )
+                else:  # pre-split boot record: one opaque compile bucket
+                    entry["compile"] = b["compiled_t"] - b["setup_t"]
+            # The streamed-heal transfer split, recorded in-band by the
+            # worker when its manager healed from a live peer.
+            heals = [
+                r["heal"]
+                for r in log
+                if "heal" in r and k["t"] < r["heal"]["t"] < next_kill_t
+            ]
+            if heals:
+                entry["fetch"] = heals[0].get("fetch_s")
+                entry["h2d"] = heals[0].get("h2d_s")
+            breakdowns.append(
+                {n: v for n, v in entry.items() if v is not None}
+            )
+    heal_s.sort()
+    return heal_s, breakdowns
+
+
 def _steps_per_sec(records: List[dict], skip: int = 5) -> float:
     """Committed steps/sec, excluding the first ``skip`` commits (compile +
     ramp)."""
@@ -309,8 +755,34 @@ def _run_phase(
     lighthouse_addr: str,
     tpu_group0: bool = False,
     hot_spare: bool = False,
+    deadline_s: Optional[float] = None,
 ) -> dict:
     go_path = os.path.join(out_dir, f"{name}.go")
+    from torchft_tpu.launcher import _can_lift_priority
+    from torchft_tpu.platform import heal_boost_nice
+
+    # One capability probe gates every priority maneuver this phase: the
+    # heal boost (needs a negative nice) and standby IDLE warming (only
+    # safe when the lift back to 0 is possible — an unprivileged
+    # supervisor warms spares un-niced, the launcher.py discipline, or
+    # the warm-deadline fix would silently no-op and every repeat kill
+    # would promote a half-warmed spare again).
+    lift_ok = _can_lift_priority()
+    heal_boost = heal_boost_nice() if lift_ok else 0
+    # One import-warm respawn server per phase (see zygote()): restarts
+    # of CPU groups fork from it instead of re-importing jax/optax under
+    # survivor contention. Warmed with the CPU-worker interpreter
+    # profile; failure to start is non-fatal (classic spawns still work).
+    zyg: Optional[_Zygote] = None
+    if os.environ.get("TORCHFT_ZYGOTE", "1") != "0":
+        base_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        base_env.pop("PALLAS_AXON_POOL_IPS", None)
+        try:
+            zyg = _Zygote(base_env)
+        except Exception as e:  # noqa: BLE001 - degraded, not broken
+            print(f"zygote unavailable ({e!r}); classic spawns only",
+                  file=sys.stderr)
+            zyg = None
     gs: List[_Group] = []
     for g in range(groups):
         log_path = os.path.join(out_dir, f"{name}_g{g}.jsonl")
@@ -356,6 +828,9 @@ def _run_phase(
                 # --tpu-group0 it could not warm the primary-owned chip
                 # anyway).
                 hot_spare=hot_spare and g != 0,
+                heal_boost=heal_boost,
+                zygote=zyg,
+                lift_ok=lift_ok,
             )
         )
     for g in gs:
@@ -376,8 +851,13 @@ def _run_phase(
     # steps for kill-count power; a fixed 1200 s cap would silently
     # truncate slow runs back to the under-powered measurement). Truncation
     # is detected and reported either way.
-    deadline = time.time() + max(1200, steps * 4)
+    deadline = time.time() + (
+        deadline_s if deadline_s is not None else max(1200, steps * 4)
+    )
     timed_out = False
+    from torchft_tpu.platform import standby_warm_deadline_s
+
+    warm_deadline = standby_warm_deadline_s()
     try:
         while any(g.alive() for g in gs):
             if time.time() >= deadline:
@@ -385,8 +865,12 @@ def _run_phase(
                 break
             time.sleep(0.25)
             # Restart any dead group (supervisor role, launcher semantics;
-            # promotes the warm standby under --hot-spare).
+            # promotes the warm standby under --hot-spare). The warm-
+            # deadline lift keeps re-armed standbys from starving at idle
+            # priority past the next kill of their group.
             for g in gs:
+                g.lift_slow_warmup(warm_deadline)
+                g.maybe_deboost()
                 if g.proc is not None and g.proc.poll() not in (None, 0):
                     g.restart()
             if next_kill is not None:
@@ -411,69 +895,15 @@ def _run_phase(
                     g.proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     g.proc.kill()
+        if zyg is not None:
+            zyg.shutdown()
 
     # Heal latency: kill -> first commit recorded by the restarted process,
-    # broken into phases via the worker's boot record (respawn = supervisor
-    # poll; import = interpreter + sitecustomize-preloaded jax; setup =
-    # remaining library imports + model init; compile = jit, ~zero with
-    # the shared cache warm; join = go-gate + manager/quorum bring-up;
-    # first_commit = rejoin through the heal protocol to a committed step).
-    heal_s = []
-    breakdowns = []
-    for k in kills:
-        # Bound each kill's window at the SAME group's next kill: if the
-        # victim is killed again before its restart commits, the first
-        # commit/boot after the later kill must not be attributed to this
-        # one (it would silently fold an extra kill cycle into the
-        # breakdown medians).
-        next_kill_t = min(
-            (
-                k2["t"]
-                for k2 in kills
-                if k2["gid"] == k["gid"] and k2["t"] > k["t"]
-            ),
-            default=float("inf"),
-        )
-        log = _read_log(gs[k["gid"]].log_path)
-        after = [
-            r["t"]
-            for r in _committed(log)
-            if k["t"] < r["t"] < next_kill_t
-        ]
-        if after:
-            heal_s.append(after[0] - k["t"])
-        # Match boots by ACTIVATION time: a promoted hot-spare standby was
-        # spawned (and imported/compiled) long before the kill, so only
-        # its activation falls in this kill's window.
-        boots = [
-            r["boot"]
-            for r in log
-            if "boot" in r
-            and k["t"] < r["boot"].get("activated_t", r["boot"]["spawn_t"])
-            < next_kill_t
-        ]
-        if boots and after:
-            b = boots[0]
-            entry = {
-                # kill -> warmed process past its gate (cold: respawn +
-                # import + setup + compile; promoted standby: just the
-                # supervisor poll + gate poll)
-                "activation": b["activated_t"] - k["t"],
-                "join": b["manager_t"] - b["activated_t"],
-                "first_commit": after[0] - b["manager_t"],
-            }
-            if b["spawn_t"] > k["t"]:
-                # Cold restart: the process-boot phases belong to this kill.
-                entry.update(
-                    {
-                        "respawn": b["spawn_t"] - k["t"],
-                        "import": b["enter_t"] - b["spawn_t"],
-                        "setup": b["setup_t"] - b["enter_t"],
-                        "compile": b["compiled_t"] - b["setup_t"],
-                    }
-                )
-            breakdowns.append(entry)
-    heal_s.sort()
+    # broken into HEAL_PHASES via the worker's boot + heal records (see
+    # compute_heal_stats).
+    heal_s, breakdowns = compute_heal_stats(
+        kills, {g.gid: _read_log(g.log_path) for g in gs}
+    )
 
     def _phase_median(name: str) -> Optional[float]:
         vals = sorted(b[name] for b in breakdowns if name in b)
@@ -504,11 +934,7 @@ def _run_phase(
         "heal_s": [round(h, 2) for h in heal_s],
         "heal_p50_s": round(heal_s[len(heal_s) // 2], 2) if heal_s else None,
         "heal_breakdown_median_s": {
-            name: _phase_median(name)
-            for name in (
-                "activation", "respawn", "import", "setup", "compile",
-                "join", "first_commit"
-            )
+            name: _phase_median(name) for name in HEAL_PHASES
         }
         if breakdowns
         else None,
@@ -519,6 +945,7 @@ def _run_phase(
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--zygote", action="store_true")
     parser.add_argument("--groups", type=int, default=4)
     # >= 10 kills over >= 1000 steps: 2 kills over 300 steps (round 2)
     # left the effect smaller than the noise (ratio measured > 1).
@@ -537,8 +964,25 @@ def main() -> None:
         "standby (the launcher's --hot-spare policy) instead of cold-"
         "restarting",
     )
+    parser.add_argument(
+        "--dryrun",
+        action="store_true",
+        help="seconds-scale CI smoke: 2 groups, a few dozen steps, one "
+        "kill per churn phase (cold + hot-spare), tight deadlines, NO "
+        "artifact written — exercises the whole kill/heal/promotion "
+        "path so it can't silently rot between perf rounds",
+    )
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
+    if args.dryrun and not args.worker:
+        # Kill early in a window long enough that the donor is still
+        # alive and committing when the victim's restart comes up — a
+        # kill near the end lets survivors finish and exit first, and
+        # the restart then rejoins solo without a checkpoint heal.
+        args.groups = 2
+        args.steps = 48
+        args.kill_every = 10
+        args.hot_spare = True
     if args.out is None:
         args.out = os.path.join(
             REPO,
@@ -547,6 +991,9 @@ def main() -> None:
 
     if args.worker:
         worker()
+        return
+    if args.zygote:
+        zygote()
         return
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -578,13 +1025,15 @@ def main() -> None:
         heartbeat_timeout_ms=500,
     )
 
+    phase_deadline = 300.0 if args.dryrun else None
     healthy = _run_phase(
         "healthy", args.groups, args.steps, 0, out_dir, lighthouse.address(),
-        tpu_group0=args.tpu_group0,
+        tpu_group0=args.tpu_group0, deadline_s=phase_deadline,
     )
     churn = _run_phase(
         "churn", args.groups, args.steps, args.kill_every, out_dir,
         lighthouse.address(), tpu_group0=args.tpu_group0,
+        deadline_s=phase_deadline,
     )
     churn_hot = None
     if args.hot_spare:
@@ -594,6 +1043,7 @@ def main() -> None:
         churn_hot = _run_phase(
             "churn_hot", args.groups, args.steps, args.kill_every, out_dir,
             lighthouse.address(), tpu_group0=args.tpu_group0, hot_spare=True,
+            deadline_s=phase_deadline,
         )
     lighthouse.shutdown()
 
@@ -636,22 +1086,66 @@ def main() -> None:
             and not churn.get("truncated")
         ),
         "target": 0.90,
-        "note": "all groups share ONE host CPU, so the two hot-spare "
-        "metrics trade off in a way the target deployment (one host per "
-        "group) does not: standbys re-arm at IDLE priority (launcher "
-        "discipline) so warm-up never steals training cycles — "
-        "ratio_hot_spare is deployment-meaningful — but on a saturated "
-        "core an idle-priority re-arm may not finish before the same "
-        "group is killed again, so REPEAT kills promote a half-warmed "
-        "spare and heal_p50_hot_spare regresses toward a cold restart "
-        "(first-kill promotions are sub-second, see round-3 artifact's "
-        "1.38 s p50 measured with normal-priority re-arm, which instead "
-        "cost ratio 0.742). Per-group hosts get both numbers at once: "
-        "warm-up contends only with the group it will replace. Cold-heal "
-        "breakdown: jax import dominates (~14 s UNDER 4-way load; ~3-5 s "
-        "unloaded) — the interpreter-start TPU-backend preload is now "
-        "skipped for CPU workers, moving that cost out of spawn->enter.",
+        "note": "all host groups share this machine's CPUs, so heal "
+        "numbers carry contention the target deployment (one host per "
+        "group) does not have. Hot-spare policy: standbys re-arm at IDLE "
+        "priority so warm-up never steals training cycles, with a "
+        "bounded warm-deadline lift (TORCHFT_STANDBY_WARM_DEADLINE_S) "
+        "restoring a still-warming spare to normal priority so repeat "
+        "kills find it fully warmed — the fix for the round-3/5 "
+        "half-warmed-promotion regression (ratio 0.742 warm-at-full-"
+        "priority vs 16.85 s p50 warm-at-idle-forever). Promotion = "
+        "quorum join + streamed weight fetch only: the spare parks with "
+        "backend up, grad/optimizer-update/ring-packer executables "
+        "AOT-compiled, and collectives pre-created. Heal transfer rides "
+        "the streamed zero-copy checkpoint pipeline (fetch/h2d keys in "
+        "heal_breakdown_median_s; TORCHFT_HEAL_WIRE/TORCHFT_HEAL_STREAMS "
+        "tune it).",
     }
+    if args.dryrun:
+        # Smoke only: assert the paths ran (kills happened, heals
+        # completed, breakdown keys exist, AND at least one heal rode
+        # the zero-copy stream transport — a regression that silently
+        # falls back to the pickled fetch must fail CI, not stay green
+        # because heals still limp through), write NO artifact.
+        stream_heals = 0
+        for fname in os.listdir(out_dir):
+            if fname.endswith(".jsonl") and "churn" in fname:
+                stream_heals += sum(
+                    1
+                    for r in _read_log(os.path.join(out_dir, fname))
+                    if r.get("heal", {}).get("path") == "stream"
+                )
+        ok = (
+            churn["kills"] >= 1
+            and churn["heal_p50_s"] is not None
+            and churn_hot is not None
+            and churn_hot["kills"] >= 1
+            and churn_hot["heal_p50_s"] is not None
+            and stream_heals >= 1
+            # at least one KILL-window heal carried the streamed
+            # fetch/h2d split into the artifact keys
+            and any(
+                (p.get("heal_breakdown_median_s") or {}).get("fetch")
+                is not None
+                for p in (churn, churn_hot)
+            )
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "churn_dryrun_ok",
+                    "value": 1 if ok else 0,
+                    "unit": "bool",
+                    "heal_p50_s": churn["heal_p50_s"],
+                    "heal_p50_hot_s": (
+                        churn_hot["heal_p50_s"] if churn_hot else None
+                    ),
+                    "stream_heals": stream_heals,
+                }
+            )
+        )
+        sys.exit(0 if ok else 1)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     print(

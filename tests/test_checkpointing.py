@@ -315,8 +315,8 @@ def test_striped_parallel_fetch_roundtrip(server):
 
 
 def test_striped_fetch_falls_back_on_legacy_server(server, monkeypatch):
-    """Against a pre-striping peer (no /part/ endpoint -> 404/500) the
-    striped client must heal at single-stream speed, not fail."""
+    """Against a pre-striping peer (no /part/ nor /stream/ endpoint ->
+    404/500) the client must heal at single-stream speed, not fail."""
     import urllib.request
 
     state = {"w": np.arange(32, dtype=np.float32)}
@@ -325,8 +325,9 @@ def test_striped_fetch_falls_back_on_legacy_server(server, monkeypatch):
     real = urllib.request.urlopen
 
     def legacy(url, timeout=None):
-        if "/part/" in str(url):
-            raise urllib.error.HTTPError(str(url), 404, "no such path", {}, None)
+        u = str(url)
+        if "/part/" in u or "/stream" in u:
+            raise urllib.error.HTTPError(u, 404, "no such path", {}, None)
         return real(url, timeout=timeout)
 
     monkeypatch.setattr(urllib.request, "urlopen", legacy)
@@ -334,3 +335,214 @@ def test_striped_fetch_falls_back_on_legacy_server(server, monkeypatch):
         f"{server.address()}2", timeout=timedelta(seconds=10), stripes=4
     )
     np.testing.assert_array_equal(out["w"], state["w"])
+
+
+# -- streamed zero-copy heal pipeline ---------------------------------------
+
+
+def _donor_state():
+    """A realistic heal payload: f32 params, optax adamw state (f32
+    moments + int count), manager counters, and a non-array leaf mix."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    params = {
+        "dense": jnp.asarray(
+            np.random.default_rng(0).standard_normal((257, 31), np.float32)
+        ),
+        "bias": jnp.asarray(
+            np.random.default_rng(1).standard_normal((31,), np.float32)
+        ),
+    }
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+    # make the moments non-trivial so bf16 rounding is observable
+    opt_state = jax.tree_util.tree_map(
+        lambda l: l + 0.1234567 if hasattr(l, "dtype")
+        and l.dtype == jnp.float32 else l,
+        opt_state,
+    )
+    return {
+        "user": {
+            "params": params,
+            "opt_state": opt_state,
+            # f32 leaf OUTSIDE both params and opt_state: the bf16 wire
+            # must protect-by-default (ship raw), not round it
+            "ema_weights": jnp.asarray(
+                np.random.default_rng(2).standard_normal((19,), np.float32)
+            ),
+        },
+        "torchft": {"step": 17, "batches_committed": 51},
+    }
+
+
+@pytest.mark.parametrize("wire", [None, "bf16"])
+@pytest.mark.parametrize("streams", [1, 2, 4])
+def test_stream_heal_params_bit_identical(server, wire, streams):
+    """The acceptance oracle: across every wire x stream-count
+    combination, the healed replica's PARAMS are bit-identical to the
+    donor's f32 buffers. The bf16 wire may round ONLY f32 leaves under
+    an ``opt_state`` key (optimizer moments); everything else —
+    params, and any leaf the predicate doesn't recognize — ships raw
+    (protect-by-default)."""
+    import jax
+
+    state = _donor_state()
+    server.send_checkpoint([1], step=7, state_dict=state,
+                           timeout=timedelta(seconds=10))
+    out, stats = CheckpointServer._fetch(
+        f"{server.address()}7", timeout=timedelta(seconds=10),
+        wire=wire, streams=streams,
+    )
+    assert stats["path"] == "stream"
+    assert stats["streams"] == streams and stats["wire"] == wire
+    for key in ("dense", "bias"):
+        donor = np.asarray(state["user"]["params"][key])
+        healed = np.asarray(out["user"]["params"][key])
+        assert healed.dtype == donor.dtype
+        assert healed.tobytes() == donor.tobytes()  # BIT identity
+    # optimizer state: exact on the raw wire, bf16-rounded under bf16
+    donor_leaves = jax.tree_util.tree_leaves(state["user"]["opt_state"])
+    healed_leaves = jax.tree_util.tree_leaves(out["user"]["opt_state"])
+    assert len(donor_leaves) == len(healed_leaves)
+    import ml_dtypes
+
+    for d, h in zip(donor_leaves, healed_leaves):
+        d = np.asarray(d)
+        h = np.asarray(h)
+        assert h.dtype == d.dtype
+        if wire == "bf16" and d.dtype == np.dtype(np.float32):
+            expected = d.astype(ml_dtypes.bfloat16).astype(np.float32)
+            np.testing.assert_array_equal(h, expected)
+        else:
+            assert h.tobytes() == d.tobytes()
+    # a leaf outside params AND opt_state ships raw on EVERY wire:
+    # protect-by-default, never silent rounding of maybe-weights
+    assert (
+        np.asarray(out["user"]["ema_weights"]).tobytes()
+        == np.asarray(state["user"]["ema_weights"]).tobytes()
+    )
+    # skeleton-borne non-array leaves survive untouched
+    assert out["torchft"] == {"step": 17, "batches_committed": 51}
+
+
+def test_stream_heal_donor_never_pickles_bulk(server, monkeypatch):
+    """The zero-copy contract on the donor: serving a streamed heal must
+    not serialize the state dict (no per-request pickle, no full-payload
+    cache) — only the small skeleton meta is pickled."""
+    from torchft_tpu import checkpointing as C
+
+    def boom(_):
+        raise AssertionError(
+            "serialize_state_dict used on the streamed heal path"
+        )
+
+    monkeypatch.setattr(C, "serialize_state_dict", boom)
+    state = _donor_state()
+    server.send_checkpoint([1], step=4, state_dict=state,
+                           timeout=timedelta(seconds=10))
+    out = server.recv_checkpoint(
+        0, server.metadata(), 4, timeout=timedelta(seconds=10)
+    )
+    assert server.last_fetch_stats["path"] == "stream"
+    assert server.last_fetch_stats["bytes"] > 0
+    np.testing.assert_array_equal(
+        np.asarray(out["user"]["params"]["dense"]),
+        np.asarray(state["user"]["params"]["dense"]),
+    )
+
+
+def test_stream_heal_wrong_step_is_an_error(server):
+    server.send_checkpoint([1], step=3, state_dict=_donor_state(),
+                           timeout=timedelta(seconds=10))
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        CheckpointServer._fetch(
+            f"{server.address()}5", timeout=timedelta(seconds=10)
+        )
+    assert exc_info.value.code == 400
+
+
+def test_stream_heal_env_knobs(server, monkeypatch):
+    """TORCHFT_HEAL_WIRE / TORCHFT_HEAL_STREAMS select the default wire
+    and stream depth for recv_checkpoint (the manager heal path)."""
+    monkeypatch.setenv("TORCHFT_HEAL_WIRE", "bf16")
+    monkeypatch.setenv("TORCHFT_HEAL_STREAMS", "3")
+    state = _donor_state()
+    server.send_checkpoint([1], step=11, state_dict=state,
+                           timeout=timedelta(seconds=10))
+    out = server.recv_checkpoint(
+        0, server.metadata(), 11, timeout=timedelta(seconds=10)
+    )
+    stats = server.last_fetch_stats
+    assert stats["path"] == "stream"
+    assert stats["wire"] == "bf16" and stats["streams"] == 3
+    # params still bit-identical under the env-selected bf16 wire
+    assert (
+        np.asarray(out["user"]["params"]["bias"]).tobytes()
+        == np.asarray(state["user"]["params"]["bias"]).tobytes()
+    )
+
+
+def test_stream_stale_publish_rejected(server):
+    """A range request carrying the nonce of a SUPERSEDED publish must
+    400, even at the same step: serving it from the new staging would
+    hand a straggler-striped reader a torn mix of two checkpoints."""
+    import urllib.request
+
+    from torchft_tpu import checkpointing as C
+
+    s1 = {"w": np.ones(256, np.float32)}
+    server.send_checkpoint([1], step=6, state_dict=s1,
+                           timeout=timedelta(seconds=10))
+    with urllib.request.urlopen(
+        f"{server.address()}6/streammeta/none", timeout=10
+    ) as f:
+        seq = C._SafeUnpickler(f).load()["seq"]
+    # range with the live nonce serves
+    with urllib.request.urlopen(
+        f"{server.address()}6/stream/0/2/none/{seq}", timeout=10
+    ) as f:
+        assert len(f.read()) == 512  # half of 256 f32
+    # republish at the SAME step
+    server.disallow_checkpoint()
+    server.send_checkpoint([1], step=6,
+                           state_dict={"w": np.zeros(256, np.float32)},
+                           timeout=timedelta(seconds=10))
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(
+            f"{server.address()}6/stream/0/2/none/{seq}", timeout=10
+        )
+    assert exc_info.value.code == 400
+    # a fresh fetch (meta + ranges under the new nonce) heals fine
+    out = CheckpointServer.load_from_address(
+        f"{server.address()}6", timeout=timedelta(seconds=10)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]), np.zeros(256, np.float32)
+    )
+
+
+def test_stream_disallow_clears_staging_and_regates(server):
+    """disallow_checkpoint must invalidate the stream staging (it aliases
+    the live buffers) and re-gate the endpoints."""
+    state = _donor_state()
+    server.send_checkpoint([1], step=1, state_dict=state,
+                           timeout=timedelta(seconds=10))
+    CheckpointServer.load_from_address(
+        f"{server.address()}1", timeout=timedelta(seconds=10)
+    )
+    assert server._stagings  # staging was built
+    server.disallow_checkpoint()
+    assert not server._stagings
+    fast = CheckpointServer(timeout=timedelta(milliseconds=200))
+    try:
+        fast.send_checkpoint([1], 1, {"x": np.ones(4, np.float32)},
+                             timeout=timedelta(seconds=5))
+        fast.disallow_checkpoint()
+        with pytest.raises(Exception):
+            fast.recv_checkpoint(
+                0, fast.metadata(), 1, timeout=timedelta(seconds=5)
+            )
+    finally:
+        fast.shutdown()

@@ -144,6 +144,20 @@ class TestLauncher:
         assert (tmp_path / "died").exists()
         assert (tmp_path / "promoted").exists()
 
+    def test_supervised_standby_warm_marker(self, tmp_path):
+        # standby_warm keys off the <standby_file>.warm marker that
+        # standby_gate touches on arrival — the signal the warm-deadline
+        # re-arm policy (lift a starving warm-up back to normal priority)
+        # and promotion logging both read.
+        from torchft_tpu.launcher import _Supervised
+
+        s = _Supervised(spec={"name": "g0"})
+        assert s.standby_warm() is False  # no standby file yet
+        s.standby_file = str(tmp_path / "gate")
+        assert s.standby_warm() is False  # armed but still warming
+        (tmp_path / "gate.warm").write_text("")
+        assert s.standby_warm() is True
+
     def test_launch_gives_up_after_max_restarts(self, tmp_path):
         script = tmp_path / "fail.py"
         script.write_text("import sys; sys.exit(3)\n")

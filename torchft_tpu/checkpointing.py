@@ -28,6 +28,27 @@ path's bounded memory for bandwidth (one full serialized copy on each
 end); ``stripes=1`` or a pre-striping peer falls back to the streamed
 single-connection path.
 
+Streamed ZERO-COPY heal (the default when both ends speak it): the pickle
+paths above serialize the whole dict, ship it, then deserialize, then
+upload — three full-payload stop-the-world passes on the heal critical
+path. The stream endpoints apply the CommPlan discipline (persistent
+native comm plans, torchft_tpu/collectives.py) to the heal payload
+instead: the LAYOUT (skeleton tree + per-leaf byte offsets) is computed
+once per published step, the donor serves raw byte ranges straight out of
+the live host buffers (memoryview slices — no per-request pickle, no
+serialized copy), and the receiver ``readinto``s the ranges over
+``TORCHFT_HEAL_STREAMS`` parallel connections into ONE preallocated
+buffer, reconstructing each leaf as a zero-copy view the moment its bytes
+land and dispatching its (async) device upload while later stripes are
+still on the wire. Only the small skeleton rides pickle (through the same
+safelist); the bulk payload is pure bytes — never executable. An optional
+``wire="bf16"`` (``TORCHFT_HEAL_WIRE``) halves the bytes of f32 leaves
+under an ``"opt_state"`` key — optimizer moments tolerate bf16 rounding
+— while everything else (params included, whatever the caller named
+them) ships raw bytes, so the healed replica's weights are bit-identical
+to the donor's. Pre-stream peers 404 the endpoints and the client falls
+back to the pickle paths unchanged.
+
 Security model: deserialization uses a SAFELISTED unpickler — only CLASSES
 from the scientific-stack modules state dicts are actually made of (numpy,
 optax, jax, collections, ml_dtypes), the two numpy array reconstructors,
@@ -51,13 +72,15 @@ import os
 import pickle
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 from abc import ABC, abstractmethod
 from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Generic, List, Optional, TypeVar
+from typing import Any, Dict, Generic, List, Optional, Tuple, TypeVar
 
 import numpy as np
 
@@ -161,7 +184,11 @@ _SAFE_MODULE_ROOTS = {
 # Non-class globals required by the numpy array pickle format. Functions
 # are otherwise NEVER resolvable (a REDUCE on an arbitrary function is the
 # code-execution primitive); these two reconstructors only build arrays.
+# _ArraySlot is this module's own streamed-heal placeholder (a frozen
+# data-only dataclass) — the ONE torchft_tpu name a skeleton payload may
+# reference; everything else in this package stays unresolvable.
 _SAFE_EXACT = {
+    ("torchft_tpu.checkpointing", "_ArraySlot"),
     ("numpy.core.multiarray", "_reconstruct"),
     ("numpy._core.multiarray", "_reconstruct"),
     ("numpy.core.multiarray", "scalar"),
@@ -216,6 +243,165 @@ def deserialize_state_dict(raw: bytes) -> Any:
     return _SafeUnpickler(io.BytesIO(raw)).load()
 
 
+# -- streamed zero-copy heal transport --------------------------------------
+
+# readinto granularity on the receiver: also the grain at which completed
+# leaves become eligible for their h2d dispatch while later bytes are
+# still on the wire.
+_STREAM_CHUNK = 1 << 20
+
+
+@dataclass(frozen=True)
+class _ArraySlot:
+    """Placeholder for one array leaf in the streamed-heal skeleton: where
+    its bytes live in the packed stream and how to decode them. Pure data
+    — safe to reconstruct from an untrusted payload (safelisted exactly,
+    see ``_SAFE_EXACT``)."""
+
+    shape: Tuple[int, ...]
+    dtype: str       # original dtype name (what the receiver restores)
+    wire_dtype: str  # dtype as shipped (bf16 when downcast on the wire)
+    offset: int      # byte offset into the packed stream
+    nbytes: int
+
+
+def _dtype_by_name(name: str) -> np.dtype:
+    """np.dtype from its name, resolving ml_dtypes names (bfloat16) that
+    plain numpy only knows once ml_dtypes is imported."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _is_opt_state_path(path: Any) -> bool:
+    """True when a tree_flatten_with_path keypath passes through a
+    component named ``opt_state`` — the ONLY leaves the bf16 wire may
+    downcast. Protect-by-default: a layout this predicate doesn't
+    recognize ships raw f32 (no compression) rather than silently
+    rounding what might be weights — bit-identity of the healed
+    replica's parameters must hold for ARBITRARY user state dicts, not
+    just ones that happen to name their weights ``params``."""
+    for entry in path:
+        key = getattr(entry, "key", getattr(entry, "name", None))
+        if key is None:
+            key = getattr(entry, "idx", None)
+        if key == "opt_state":
+            return True
+    return False
+
+
+def _heal_wire_from_env() -> Optional[str]:
+    wire = os.environ.get("TORCHFT_HEAL_WIRE", "").strip().lower()
+    if wire in ("", "none", "f32", "raw"):
+        return None
+    if wire != "bf16":
+        raise ValueError(f"unsupported TORCHFT_HEAL_WIRE: {wire!r}")
+    return wire
+
+
+class _StreamStaging:
+    """The donor half of the streamed heal: the CommPlan discipline
+    applied to a published state dict. Built ONCE per (step, wire) —
+    layout = skeleton tree (array leaves replaced by :class:`_ArraySlot`)
+    + per-leaf byte offsets — and then every range request is served as
+    memoryview slices straight off the live host buffers: no per-request
+    pickle, no concatenated serialized copy. ``wire="bf16"`` casts f32
+    leaves INSIDE an ``opt_state`` subtree once at build (the only
+    copies the staging ever makes beyond non-contiguous inputs)."""
+
+    def __init__(
+        self, state_dict: Any, wire: Optional[str], seq: int = 0
+    ) -> None:
+        import jax
+
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+            state_dict
+        )
+        segments: List[memoryview] = []
+        starts: List[int] = []
+        skeleton_leaves: List[Any] = []
+        offset = 0
+        for path, leaf in leaves_with_path:
+            if not (isinstance(leaf, np.ndarray) or _is_jax_leaf(leaf)):
+                # scalars / strings / exotic leaves ride the skeleton
+                # pickle exactly as before
+                skeleton_leaves.append(leaf)
+                continue
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            odtype = arr.dtype
+            if (
+                wire == "bf16"
+                and odtype == np.dtype(np.float32)
+                and _is_opt_state_path(path)
+            ):
+                import ml_dtypes
+
+                arr = arr.astype(np.dtype(ml_dtypes.bfloat16))
+            # byte view (not a copy): numpy refuses buffer-protocol
+            # export of non-native dtypes (ml_dtypes bfloat16), so go
+            # through a uint8 reinterpret first
+            segments.append(
+                memoryview(arr.reshape(-1).view(np.uint8)).cast("B")
+            )
+            starts.append(offset)
+            skeleton_leaves.append(
+                _ArraySlot(
+                    shape=tuple(arr.shape),
+                    dtype=odtype.name,
+                    wire_dtype=arr.dtype.name,
+                    offset=offset,
+                    nbytes=arr.nbytes,
+                )
+            )
+            offset += arr.nbytes
+        self.total = offset
+        self._segments = segments
+        self._starts = starts
+        skeleton = jax.tree_util.tree_unflatten(treedef, skeleton_leaves)
+        buf = io.BytesIO()
+        pickle.dump(
+            {
+                "v": 1,
+                "wire": wire,
+                "total": offset,
+                "seq": seq,
+                "skeleton": skeleton,
+            },
+            buf,
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        self.meta = buf.getvalue()
+
+    def write_range(self, wfile: Any, begin: int, end: int) -> None:
+        """Streams bytes [begin, end) of the packed layout into ``wfile``
+        as zero-copy slices of the staged buffers."""
+        import bisect
+
+        if begin >= end:
+            return
+        i = bisect.bisect_right(self._starts, begin) - 1
+        pos = begin
+        while pos < end and i < len(self._segments):
+            seg = self._segments[i]
+            seg_start = self._starts[i]
+            lo = pos - seg_start
+            hi = min(len(seg), end - seg_start)
+            if lo < hi:
+                wfile.write(seg[lo:hi])
+                pos = seg_start + hi
+            i += 1
+
+
+def _is_jax_leaf(leaf: Any) -> bool:
+    import sys
+
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(leaf, jax.Array)
+
+
 class _TimedAcquire:
     """Lock acquire with timeout that raises instead of returning False.
     Reference checkpointing.py:91-107."""
@@ -251,6 +437,27 @@ class CheckpointServer(CheckpointTransport[T]):
         # One-shot pickle cache backing the striped /part/ endpoint
         self._serialized: Any = None
         self._serialized_step = -1
+        # Streamed-heal staging, one per wire encoding, built once per
+        # published step (the /streammeta/ + /stream/ endpoints)
+        self._stagings: Dict[Optional[str], _StreamStaging] = {}
+        self._stagings_step = -1
+        # Publish nonce: bumped on every allow_checkpoint. Range
+        # requests must echo the nonce their meta established — a
+        # republish AT THE SAME STEP between a client's meta fetch and a
+        # straggler range request would otherwise serve that range from
+        # the NEW dict (identical layout, so no framing error) and hand
+        # the healer a silently torn mix of two checkpoints.
+        self._publish_seq = 0
+        # In-flight /stream/ range responses: their bodies are zero-copy
+        # views of the LIVE state-dict buffers (unlike the /part/
+        # endpoint's immutable pickle cache), so disallow_checkpoint must
+        # drain them before the training loop may mutate the dict.
+        self._stream_inflight = 0
+        self._stream_cv = threading.Condition()
+        # What the last recv_checkpoint measured (path taken, fetch/h2d
+        # seconds, bytes, wire, streams) — benches fold this into their
+        # heal breakdowns.
+        self.last_fetch_stats: Optional[Dict[str, Any]] = None
 
         # Gate starts held: nothing readable until the first send_checkpoint.
         self.disallow_checkpoint()
@@ -271,6 +478,18 @@ class CheckpointServer(CheckpointTransport[T]):
                         # striped fetch: /checkpoint/{step}/part/{i}/{n}
                         self._serve_part(
                             int(rest[0]), int(rest[2]), int(rest[3])
+                        )
+                        return
+                    if len(rest) == 3 and rest[1] == "streammeta":
+                        # streamed-heal layout: /checkpoint/{step}/streammeta/{wire}
+                        self._serve_stream_meta(int(rest[0]), rest[2])
+                        return
+                    if len(rest) == 6 and rest[1] == "stream":
+                        # streamed-heal range:
+                        # /checkpoint/{step}/stream/{i}/{n}/{wire}/{seq}
+                        self._serve_stream_part(
+                            int(rest[0]), int(rest[2]), int(rest[3]),
+                            rest[4], int(rest[5]),
                         )
                         return
                     if len(rest) != 1:
@@ -362,6 +581,101 @@ class CheckpointServer(CheckpointTransport[T]):
                 self.end_headers()
                 self.wfile.write(payload[start:end])
 
+            def _staging_for(
+                self, requested: int, wire_tok: str, track: bool = False,
+                seq: Optional[int] = None,
+            ) -> Optional[_StreamStaging]:
+                """Validates the step and returns the (lazily built)
+                zero-copy staging for ``wire_tok`` under the gate lock;
+                the LAYOUT is immutable after build, so range bodies
+                stream OUTSIDE the lock (parallel range fetches would
+                otherwise serialize). ``track=True`` additionally
+                registers an in-flight reader WHILE the gate lock is
+                still held — range bodies alias the live state-dict
+                buffers, and disallow_checkpoint drains tracked readers
+                before the dict may mutate. Returns None after having
+                sent an error response."""
+                wire = None if wire_tok in ("none", "f32", "raw") else wire_tok
+                if wire not in (None, "bf16"):
+                    self.send_error(404, f"unknown heal wire {wire_tok!r}")
+                    return None
+                with _TimedAcquire(
+                    ckpt_server._checkpoint_lock, ckpt_server._timeout
+                ):
+                    step = ckpt_server._step
+                    if requested != step:
+                        self.send_error(
+                            400,
+                            f"invalid checkpoint requested: serving {step} "
+                            f"but got {requested}",
+                        )
+                        return None
+                    if seq is not None and seq != ckpt_server._publish_seq:
+                        # Stale publish: the dict was republished (same
+                        # step is possible) since this client's meta
+                        # fetch — serving the range would mix two
+                        # checkpoints. Fail loudly; the client's heal
+                        # errors and retries against the new publish.
+                        self.send_error(
+                            400,
+                            f"stale publish: serving seq "
+                            f"{ckpt_server._publish_seq}, range asked "
+                            f"for {seq}",
+                        )
+                        return None
+                    if ckpt_server._stagings_step != step:
+                        ckpt_server._stagings = {}
+                        ckpt_server._stagings_step = step
+                    staging = ckpt_server._stagings.get(wire)
+                    if staging is None:
+                        staging = _StreamStaging(
+                            ckpt_server._state_dict,
+                            wire,
+                            seq=ckpt_server._publish_seq,
+                        )
+                        ckpt_server._stagings[wire] = staging
+                    if track:
+                        with ckpt_server._stream_cv:
+                            ckpt_server._stream_inflight += 1
+                    return staging
+
+            def _serve_stream_meta(self, requested: int, wire_tok: str) -> None:
+                staging = self._staging_for(requested, wire_tok)
+                if staging is None:
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(staging.meta)))
+                self.end_headers()
+                self.wfile.write(staging.meta)
+
+            def _serve_stream_part(
+                self, requested: int, i: int, n: int, wire_tok: str,
+                seq: int,
+            ) -> None:
+                if n < 1 or not (0 <= i < n):
+                    self.send_error(404, f"bad stream part {i}/{n}")
+                    return
+                staging = self._staging_for(
+                    requested, wire_tok, track=True, seq=seq
+                )
+                if staging is None:
+                    return
+                try:
+                    begin = staging.total * i // n
+                    end = staging.total * (i + 1) // n
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header("Content-Length", str(end - begin))
+                    self.end_headers()
+                    staging.write_range(self.wfile, begin, end)
+                finally:
+                    with ckpt_server._stream_cv:
+                        ckpt_server._stream_inflight -= 1
+                        ckpt_server._stream_cv.notify_all()
+
             def log_message(self, format: str, *args: object) -> None:
                 logger.debug(f"checkpoint server: {format % args}")
 
@@ -380,25 +694,101 @@ class CheckpointServer(CheckpointTransport[T]):
 
     @classmethod
     def load_from_address(
-        cls, address: str, timeout: timedelta, stripes: Optional[int] = None
+        cls,
+        address: str,
+        timeout: timedelta,
+        stripes: Optional[int] = None,
+        wire: Optional[str] = "env",
+        streams: Optional[int] = None,
+        device_put: Optional[bool] = None,
     ) -> T:
         """Fetches a checkpoint from a step-qualified URL.
         Reference checkpointing.py:187-203.
 
-        ``stripes`` > 1 (default: env ``TORCHFT_CKPT_STRIPES``, else 4)
-        fetches the payload as that many byte ranges over PARALLEL HTTP
-        connections — the same window-limit escape the collectives ring
-        uses, and the lever that moves heal-time checkpoint transfer off a
-        single TCP stream's throughput ceiling. Falls back to the
-        single-stream (bounded-memory) fetch against servers without the
-        ``/part/`` endpoint; ``stripes=1`` selects it directly."""
+        The STREAMED zero-copy pipeline is tried first (see module
+        docstring): layout fetch, then ``streams`` parallel raw byte
+        ranges (default: env ``TORCHFT_HEAL_STREAMS``, else ``stripes``)
+        read straight into one preallocated buffer, each leaf's device
+        upload dispatched while later ranges are still on the wire.
+        ``wire`` selects the stream encoding (default: env
+        ``TORCHFT_HEAL_WIRE``; ``"bf16"`` halves non-param f32 bytes,
+        ``None`` ships everything raw). Pre-stream peers fall back to the
+        pickled paths: ``stripes`` > 1 (default: env
+        ``TORCHFT_CKPT_STRIPES``, else 4) fetches the pickle as parallel
+        byte ranges; a pre-striping peer or ``stripes=1`` takes the
+        single-connection streamed-pickle fetch."""
+        out, _stats = cls._fetch(
+            address, timeout, stripes, wire, streams, device_put
+        )
+        return out
+
+    @classmethod
+    def _fetch(
+        cls,
+        address: str,
+        timeout: timedelta,
+        stripes: Optional[int] = None,
+        wire: Optional[str] = "env",
+        streams: Optional[int] = None,
+        device_put: Optional[bool] = None,
+    ) -> Tuple[T, Dict[str, Any]]:
+        """load_from_address returning ``(tree, stats)`` — the stats dict
+        names the path taken and its fetch/h2d seconds for heal-latency
+        attribution."""
         if stripes is None:
             stripes = int(os.environ.get("TORCHFT_CKPT_STRIPES", "4"))
         stripes = max(1, min(int(stripes), 64))
-        logger.info(f"fetching checkpoint from {address} (stripes={stripes})")
+        if wire == "env":
+            wire = _heal_wire_from_env()
+        if streams is None:
+            streams = int(
+                os.environ.get("TORCHFT_HEAL_STREAMS", str(stripes))
+            )
+        streams = max(1, min(int(streams), 64))
+        logger.info(
+            f"fetching checkpoint from {address} "
+            f"(streams={streams}, wire={wire}, pickle stripes={stripes})"
+        )
+        t0 = time.perf_counter()
+        try:
+            return cls._load_stream(address, timeout, wire, streams, device_put)
+        except urllib.error.HTTPError as e:
+            if e.code not in (404, 500):
+                raise
+            # 404/500: a pre-stream peer (or a gate-timeout) — heal must
+            # proceed over the pickled paths, not fail
+            logger.warning(
+                "peer checkpoint server lacks the zero-copy stream "
+                f"endpoint (HTTP {e.code}); falling back to pickled fetch"
+            )
+        except TimeoutError:
+            # The stream burned the caller's whole timeout budget
+            # (TimeoutError is an OSError subclass — without this clause
+            # it would fall through below and each pickled fallback
+            # would start a FRESH full-timeout attempt against the same
+            # wedged donor, stretching a 30 s heal budget to ~90 s of
+            # no-redundancy window the quorum never agreed to).
+            raise
+        except OSError as e:
+            if isinstance(
+                getattr(e, "reason", None), TimeoutError
+            ):
+                # urllib wraps connect/read timeouts as
+                # URLError(reason=TimeoutError) — same budget-exhaustion
+                # case as the clause above, same verdict.
+                raise
+            logger.warning(
+                f"streamed checkpoint fetch failed ({e!r}); "
+                "falling back to pickled fetch"
+            )
         if stripes > 1:
             try:
-                return cls._load_striped(address, timeout, stripes)
+                out = cls._load_striped(address, timeout, stripes)
+                return out, {
+                    "path": "striped",
+                    "stripes": stripes,
+                    "fetch_s": time.perf_counter() - t0,
+                }
             except urllib.error.HTTPError as e:
                 if e.code not in (404, 500):
                     raise
@@ -422,7 +812,188 @@ class CheckpointServer(CheckpointTransport[T]):
         ) as f:
             # incremental unpickle off the response stream (http.client
             # de-chunks transparently): bounded memory on the receiver too
-            return load_state_dict_stream(f)
+            out = load_state_dict_stream(f)
+        return out, {"path": "single", "fetch_s": time.perf_counter() - t0}
+
+    @classmethod
+    def _load_stream(
+        cls,
+        address: str,
+        timeout: timedelta,
+        wire: Optional[str],
+        streams: int,
+        device_put: Optional[bool],
+    ) -> Tuple[T, Dict[str, Any]]:
+        """The zero-copy receiver: layout fetch, ``streams`` parallel
+        range readers ``readinto``-ing one preallocated buffer, and a
+        walker that reconstructs each leaf as a view (f32 path: zero
+        copies) the moment its bytes are covered — dispatching its async
+        device upload while later ranges are still on the wire. Raises
+        ``urllib.error.HTTPError(404)`` against pre-stream peers (the
+        caller falls back)."""
+        import jax
+
+        if device_put is None:
+            # Heal payloads feed straight into jitted code; uploading
+            # during the fetch costs nothing extra and removes a full
+            # payload pass after it. Host-only users pass False.
+            device_put = True
+        deadline = time.monotonic() + timeout.total_seconds()
+        wire_tok = wire if wire is not None else "none"
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(
+            f"{address}/streammeta/{wire_tok}",
+            timeout=timeout.total_seconds(),
+        ) as f:
+            meta = _SafeUnpickler(f).load()
+        total = int(meta["total"])
+        seq = int(meta.get("seq", 0))
+        skeleton = meta["skeleton"]
+        slots, treedef = jax.tree_util.tree_flatten(skeleton)
+        buf = bytearray(total)
+        view = memoryview(buf)
+        bounds = [total * i // streams for i in range(streams + 1)]
+        progress = list(bounds[:-1])
+        cond = threading.Condition()
+        errors: List[BaseException] = []
+        # Set when the walker gives up (error/timeout): surviving pull
+        # threads must stop downloading, or they'd compete with the
+        # pickled fallback fetch for the same link and pin the donor's
+        # in-flight reader count against its next disallow.
+        cancel = threading.Event()
+
+        def pull(i: int) -> None:
+            try:
+                begin, end = bounds[i], bounds[i + 1]
+                if begin >= end:
+                    return
+                with urllib.request.urlopen(
+                    # the publish nonce from the meta rides every range
+                    # request: a republish in between (same step
+                    # included) 400s instead of serving torn bytes
+                    f"{address}/stream/{i}/{streams}/{wire_tok}/{seq}",
+                    timeout=timeout.total_seconds(),
+                ) as resp:
+                    pos = begin
+                    while pos < end and not cancel.is_set():
+                        n = resp.readinto(
+                            view[pos:min(pos + _STREAM_CHUNK, end)]
+                        )
+                        if not n:
+                            raise OSError(
+                                f"heal stream {i} ended early at "
+                                f"{pos}/{end}"
+                            )
+                        pos += n
+                        with cond:
+                            progress[i] = pos
+                            cond.notify_all()
+            except BaseException as e:  # noqa: BLE001 - wake the walker
+                with cond:
+                    errors.append(e)
+                    cond.notify_all()
+
+        threads = [
+            threading.Thread(
+                target=pull, args=(i,), daemon=True,
+                name=f"heal_stream_{i}",
+            )
+            for i in range(streams)
+        ]
+        for t in threads:
+            t.start()
+
+        def wait_covered(begin: int, end: int) -> None:
+            with cond:
+                while True:
+                    if errors:
+                        raise errors[0]
+                    if all(
+                        progress[j] >= min(end, bounds[j + 1])
+                        for j in range(streams)
+                        if bounds[j] < end and bounds[j + 1] > begin
+                    ):
+                        return
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "streamed heal fetch timed out "
+                            f"(covered through ~{min(progress)}/{total} "
+                            "bytes)"
+                        )
+                    cond.wait(min(remaining, 1.0))
+
+        out_leaves: List[Any] = []
+        device_leaves: List[Any] = []
+        try:
+            for slot in slots:
+                if not isinstance(slot, _ArraySlot):
+                    out_leaves.append(slot)
+                    continue
+                wait_covered(slot.offset, slot.offset + slot.nbytes)
+                wdtype = _dtype_by_name(slot.wire_dtype)
+                arr = np.frombuffer(
+                    buf,
+                    dtype=wdtype,
+                    count=slot.nbytes // wdtype.itemsize,
+                    offset=slot.offset,
+                ).reshape(slot.shape)
+                odtype = _dtype_by_name(slot.dtype)
+                if wdtype != odtype:
+                    arr = arr.astype(odtype)
+                if (
+                    device_put
+                    # x64-off jax would silently narrow f64/i64 leaves
+                    # at upload; those stay host-side numpy (the
+                    # transport contract returns the donor's exact
+                    # dtypes — the caller owns any canonicalizing
+                    # placement)
+                    and jax.dtypes.canonicalize_dtype(odtype) == odtype
+                ):
+                    import jax.numpy as jnp
+
+                    # async h2d dispatch: the upload rides under the
+                    # remaining range reads
+                    leaf: Any = jnp.asarray(arr)
+                    device_leaves.append(leaf)
+                else:
+                    leaf = arr
+                out_leaves.append(leaf)
+            for t in threads:
+                t.join(max(0.0, deadline - time.monotonic()))
+            with cond:
+                if errors:
+                    raise errors[0]
+                if any(t.is_alive() for t in threads):
+                    raise TimeoutError(
+                        "streamed heal fetch timed out draining"
+                    )
+        except BaseException:
+            # Stop surviving pull threads before the caller falls back
+            # (or gives up): abandoned full-range downloads would race
+            # the fallback for the same link and hold the donor's
+            # in-flight reader count against its next disallow.
+            cancel.set()
+            raise
+        fetch_s = time.perf_counter() - t0
+        h2d_s = 0.0
+        if device_leaves:
+            # The residual upload drain AFTER the last byte arrived — the
+            # part of h2d the overlap could not hide.
+            t1 = time.perf_counter()
+            jax.block_until_ready(device_leaves)
+            h2d_s = time.perf_counter() - t1
+        return (
+            jax.tree_util.tree_unflatten(treedef, out_leaves),
+            {
+                "path": "stream",
+                "wire": wire,
+                "streams": streams,
+                "bytes": total,
+                "fetch_s": fetch_s,
+                "h2d_s": h2d_s,
+            },
+        )
 
     @classmethod
     def _load_striped(cls, address: str, timeout: timedelta, stripes: int) -> T:
@@ -462,18 +1033,48 @@ class CheckpointServer(CheckpointTransport[T]):
     def allow_checkpoint(self, step: int) -> None:
         """Publishes ``step``; unblocks readers. Reference :246-254."""
         self._step = step
+        self._publish_seq += 1
+        # A staging built under the previous publish carries that
+        # publish's nonce in its meta; serving it now would 400 every
+        # range. Rebuild lazily under the new nonce.
+        self._stagings = {}
+        self._stagings_step = -1
         if self._disallowed:
             self._disallowed = False
             self._checkpoint_lock.release()
 
     def disallow_checkpoint(self) -> None:
-        """Re-locks the gate so the dict can be mutated. Reference :256-259."""
+        """Re-locks the gate so the dict can be mutated. Reference :256-259.
+
+        Additionally drains in-flight /stream/ range responses before
+        returning: their bodies are zero-copy views of the live buffers,
+        and a mutation racing a tail of the stream would ship torn bytes
+        to a healing replica. New stream readers can't start once the
+        gate lock is held (they register under it); stragglers are waited
+        out up to the server timeout — a reader still writing past that
+        is itself beyond its deadline, and wedging the training loop on
+        it would be worse."""
         if not self._disallowed:
             self._disallowed = True
             self._checkpoint_lock.acquire()
-            # the dict may mutate now; the pickle cache is stale
+            # the dict may mutate now; the pickle + stream caches are stale
             self._serialized = None
             self._serialized_step = -1
+            self._stagings = {}
+            self._stagings_step = -1
+            deadline = time.monotonic() + self._timeout.total_seconds()
+            with self._stream_cv:
+                while self._stream_inflight > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        logger.warning(
+                            f"{self._stream_inflight} streamed heal "
+                            "reader(s) still in flight at disallow "
+                            "timeout; proceeding (their fetch already "
+                            "exceeded its deadline)"
+                        )
+                        break
+                    self._stream_cv.wait(remaining)
 
     # -- CheckpointTransport --
 
@@ -486,12 +1087,16 @@ class CheckpointServer(CheckpointTransport[T]):
         self._state_dict = state_dict
         self._serialized = None  # new dict, even at an unchanged step
         self._serialized_step = -1
+        self._stagings = {}
+        self._stagings_step = -1
         self.allow_checkpoint(step)
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: timedelta
     ) -> T:
-        return self.load_from_address(f"{metadata}{step}", timeout)
+        out, stats = self._fetch(f"{metadata}{step}", timeout)
+        self.last_fetch_stats = stats
+        return out
 
     def shutdown(self, wait: bool = True) -> None:
         """Stops serving. Requests in flight hold the gate lock until done."""

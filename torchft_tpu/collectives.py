@@ -657,6 +657,34 @@ class HostCollectives(Collectives):
     def abort(self) -> None:
         _lib.tft_hc_abort(self._handle)
 
+    def prewarm(self, tree: Any = None) -> None:
+        """Shadow-mode warm-up for hot-spare standbys: spins up the op
+        executor thread and, given a ``tree`` shaped like the payload the
+        promoted worker will sync (its gradient pytree), jits and runs
+        the device pack/unpack programs for that signature — so the first
+        post-promotion allreduce pays neither thread start nor packer
+        compile. NO network is touched (the ring only exists after
+        ``configure``), which is what makes it safe for a parked standby
+        that must not be visible to the quorum."""
+
+        def warm() -> None:
+            if tree is None:
+                return
+            leaves, treedef = _flatten(tree)
+            if not leaves or not all(_is_jax_array(l) for l in leaves):
+                return
+            import jax
+
+            key = (treedef, tuple((l.shape, np.dtype(l.dtype)) for l in leaves))
+            packer = self._packers.get(key)
+            if packer is None:
+                packer = self._packers[key] = _DevicePacker(leaves)
+            # Round-trip once: both executables compile (and land in the
+            # persistent cache), no ring op is issued.
+            jax.block_until_ready(packer.unpack(packer.pack(leaves)))
+
+        self._submit(warm).wait()
+
     def shutdown(self) -> None:
         if self._shutdown:
             return

@@ -121,6 +121,16 @@ class _Supervised:
     returncode: Optional[int] = None
     standby: Optional[subprocess.Popen] = None
     standby_file: Optional[str] = None
+    standby_armed_t: float = 0.0
+    standby_lifted: bool = False
+    boost_t: Optional[float] = None
+
+    def standby_warm(self) -> bool:
+        """Whether the parked standby finished its warm-up (it touches
+        ``<standby_file>.warm`` when it reaches the gate)."""
+        return bool(
+            self.standby_file and os.path.exists(self.standby_file + ".warm")
+        )
 
 
 def launch(
@@ -152,11 +162,13 @@ def launch(
 
     standby_dir = tempfile.mkdtemp(prefix="torchft_standby_") if hot_spare else None
     # Probe ONCE, at spawn time: standbys only warm at idle priority when
-    # the supervisor can lift them back at promotion. Without the
-    # capability, warming un-niced costs some contention during warm-up
-    # but a promoted worker trains at full priority — the reverse trade
-    # (a permanently nice-19 primary) is never acceptable.
-    lift_ok = _can_lift_priority() if hot_spare else False
+    # the supervisor can lift them back at promotion, and cold restarts
+    # only get the heal-priority boost when the supervisor can set a
+    # negative nice at all. Without the capability, warming un-niced
+    # costs some contention during warm-up but a promoted worker trains
+    # at full priority — the reverse trade (a permanently nice-19
+    # primary) is never acceptable.
+    lift_ok = _can_lift_priority()
     if hot_spare and not lift_ok:
         logger.warning(
             "hot-spare standbys warm at NORMAL priority: this supervisor "
@@ -205,6 +217,8 @@ def launch(
         logger.info(f"{s.spec['name']}: started {role} pid {proc.pid}")
         if as_standby:
             s.standby = proc
+            s.standby_armed_t = time.monotonic()
+            s.standby_lifted = False
         else:
             s.proc = proc
         return proc
@@ -214,6 +228,15 @@ def launch(
         else fall back to a cold spawn."""
         if s.standby is not None and s.standby.poll() is None:
             assert s.standby_file is not None
+            if not s.standby_warm():
+                # Promotion still beats a cold spawn (imports may be
+                # partially done), but this is the signal the
+                # warm-deadline policy below exists to eliminate.
+                logger.warning(
+                    f"{s.spec['name']}: promoting a standby that had NOT "
+                    "finished warming — heal pays the remaining "
+                    "import/compile at full priority"
+                )
             open(s.standby_file, "w").close()  # releases standby_gate()
             s.proc = s.standby
             s.standby = None
@@ -234,15 +257,86 @@ def launch(
             spawn(s, as_standby=True)  # re-arm (idle priority again)
         else:
             spawn(s)
+            if lift_ok and heal_boost:
+                # Heal-priority boost (platform.heal_boost_nice): a COLD
+                # restart is the cohort's degraded member — lend it
+                # survivor CPU through its import+compile+heal, returned
+                # by the timed de-boost in the supervise loop (the
+                # launcher has no commit visibility, so the window is
+                # time-bounded rather than commit-bounded).
+                try:
+                    os.setpriority(
+                        os.PRIO_PROCESS, s.proc.pid, -heal_boost
+                    )
+                    s.boost_t = time.monotonic()
+                except (OSError, AttributeError):
+                    pass
 
     for s in groups:
         spawn(s)
         if hot_spare:
             spawn(s, as_standby=True)
 
+    from .platform import heal_boost_nice, standby_warm_deadline_s
+
+    warm_deadline = standby_warm_deadline_s()
+    heal_boost = heal_boost_nice() if lift_ok else 0
+
+    def lift_slow_warmups() -> None:
+        """The re-arm fix: a niced standby that has not reached its warm
+        marker within the grace window gets its priority restored so it
+        FINISHES warming — otherwise, on a saturated host, every kill
+        after the first promotes a half-warmed spare and pays the full
+        import+compile on the heal critical path (round-3 root cause;
+        the idle re-arm was keeping throughput at the cost of making
+        repeat-kill heals cold). Bounded contention once per re-arm
+        beats an unwarmed spare on every subsequent kill."""
+        if not lift_ok:
+            return  # standbys were never niced; nothing to lift
+        now = time.monotonic()
+        for s in groups:
+            if (
+                s.standby is None
+                or s.standby.poll() is not None
+                or s.standby_lifted
+                or s.standby_warm()
+                or now - s.standby_armed_t < warm_deadline
+            ):
+                continue
+            s.standby_lifted = True
+            try:
+                os.setpriority(os.PRIO_PROCESS, s.standby.pid, 0)
+                logger.warning(
+                    f"{s.spec['name']}: standby still warming after "
+                    f"{warm_deadline:.0f}s at idle priority; lifting it "
+                    "so the next kill finds a fully-warmed spare"
+                )
+            except (OSError, AttributeError):
+                pass
+
+    def deboost_healed() -> None:
+        """Timed end of a heal boost: after the window a restarted worker
+        is (long since) a committed peer again and must compete at
+        parity. 60 s comfortably covers the measured cold heal; a worker
+        that slow has bigger problems than priority."""
+        now = time.monotonic()
+        for s in groups:
+            if s.boost_t is None or now - s.boost_t < 60:
+                continue
+            s.boost_t = None
+            if s.proc is not None and s.proc.poll() is None:
+                try:
+                    os.setpriority(os.PRIO_PROCESS, s.proc.pid, 0)
+                except (OSError, AttributeError):
+                    pass
+
     try:
         while True:
             running = 0
+            if hot_spare:
+                lift_slow_warmups()
+            if heal_boost:
+                deboost_healed()
             for s in groups:
                 if s.returncode is not None or s.proc is None:
                     continue

@@ -365,7 +365,9 @@ class Manager:
                     self._rank, timeout=self._timeout
                 )
                 assert result.recover_src_rank is not None
-                with span("torchft::recv_checkpoint"):
+                with self._metrics.timed("heal_fetch"), span(
+                    "torchft::recv_checkpoint"
+                ):
                     checkpoint = self._checkpoint_transport.recv_checkpoint(
                         src_rank=result.recover_src_rank,
                         metadata=checkpoint_metadata,
@@ -390,7 +392,8 @@ class Manager:
         ), "checkpoint was not fetched before apply"
         assert self._load_state_dict is not None, "no load_state_dict callback"
         self._logger.info("applying pending state dict")
-        self._load_state_dict(cast(T, self._pending_state_dict["user"]))
+        with self._metrics.timed("heal_apply"):
+            self._load_state_dict(cast(T, self._pending_state_dict["user"]))
         self._pending_state_dict = None
 
     # -- data plane --
@@ -738,6 +741,13 @@ class Manager:
         return {"step": self._step, "batches_committed": self._batches_committed}
 
     # -- introspection --
+
+    def checkpoint_transport(self) -> CheckpointTransport[Dict[str, T]]:
+        """The live-recovery transport this manager heals through.
+        Benches and diagnostics read its ``last_fetch_stats`` (streamed
+        heal path/wire/fetch/h2d breakdown) after a heal; swapping the
+        transport itself happens at construction."""
+        return self._checkpoint_transport
 
     def metrics(self) -> "Metrics":
         """Step-level counters and timers (commits/aborts/heals/errors,

@@ -46,18 +46,71 @@ def standby_gate() -> None:
     framework targets) or accept cold restarts there.
 
     If the supervisor dies without activating us (hard kill: its cleanup
-    never runs), exit instead of leaking a fully-warmed parked process."""
+    never runs), exit instead of leaking a fully-warmed parked process.
+
+    Reaching the gate means warm-up is COMPLETE, so a ``<path>.warm``
+    marker is touched on entry: the supervisor reads it to tell a
+    fully-warmed spare from one still importing/compiling — the
+    warm-deadline re-arm policy (a half-warmed spare on a saturated host
+    gets its idle priority lifted so the NEXT kill finds it parked here,
+    not mid-import) and promotion logging both key off it."""
     path = os.environ.get("TORCHFT_STANDBY_FILE")
     if not path:
         return
     import sys
     import time
 
+    try:
+        open(path + ".warm", "w").close()
+    except OSError:
+        pass  # marker is advisory; the gate still works without it
     supervisor = os.getppid()
     while not os.path.exists(path):
         if os.getppid() != supervisor:
             sys.exit(0)  # orphaned: supervisor is gone, nobody can promote us
         time.sleep(0.05)
+
+
+def standby_should_warm() -> bool:
+    """Whether a standby should run the full AOT warm-up before parking
+    (``FTTrainState.warm`` + ``HostCollectives.prewarm``): default yes —
+    promotion is then quorum join + weight fetch only. Set
+    ``TORCHFT_STANDBY_WARM=0`` to park right after imports instead (e.g.
+    when the warm-up itself would fight the primary for a single
+    accelerator)."""
+    return os.environ.get("TORCHFT_STANDBY_WARM", "1") != "0"
+
+
+def standby_warm_deadline_s() -> float:
+    """How long a supervisor lets a niced standby warm before lifting it
+    to normal priority (``TORCHFT_STANDBY_WARM_DEADLINE_S``, default 20).
+    On a saturated host an idle-priority warm-up can starve forever —
+    the round-3/round-5 hot-spare regression: every promotion found a
+    HALF-warmed spare and paid the full import+compile on the heal
+    critical path. Lifting after a bounded grace costs a few seconds of
+    measured contention once per re-arm; an unwarmed spare costs ~15 s on
+    EVERY subsequent kill of that group."""
+    try:
+        return float(os.environ.get("TORCHFT_STANDBY_WARM_DEADLINE_S", "20"))
+    except ValueError:
+        return 20.0
+
+
+def heal_boost_nice() -> int:
+    """Nice-level boost (``TORCHFT_HEAL_BOOST``, default 5; ``0``
+    disables) a PRIVILEGED supervisor gives a cold-restarting worker
+    while it heals, de-boosting at its first committed step (or a 60 s
+    hard cap). Rationale: on a shared host the restarting member is the
+    cohort's degraded one — survivors keep committing without it — so a
+    bounded slice of their CPU during the heal shortens the window the
+    cohort runs without redundancy; measured on a 2-CPU 4-group box it
+    roughly halves the cold import+compile path. Supervisors must gate
+    it on the same capability probe as standby renicing (boosting needs
+    CAP_SYS_NICE / root / RLIMIT_NICE)."""
+    try:
+        return max(0, int(os.environ.get("TORCHFT_HEAL_BOOST", "5")))
+    except ValueError:
+        return 5
 
 
 def apply_compilation_cache_env(default_dir: str = "") -> None:

@@ -105,6 +105,29 @@ class FTTrainState:
         self.load_state_dict(snapshot)
         self._apply_jit = None
 
+    def warm(self, grads_like: Any) -> None:
+        """AOT warm-up of the optimizer-update executable (standby
+        discipline): jits and RUNS the apply function once on throwaway
+        COPIES of the live state (zeros for gradients), so the first real
+        ``apply_gradients`` after a standby promotion pays no trace or
+        compile. Copies are required twice over: the jit donates its
+        inputs, and a zero-grad adamw step still moves params (weight
+        decay + bias correction) — the live state must stay untouched.
+        The executable lands in jax's jit cache AND the persistent
+        compilation cache, so it also pre-warms future cold restarts."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._apply_jit is None:
+            self._apply_jit = make_apply_fn(self.tx)
+        params = jax.tree_util.tree_map(jnp.copy, self.params)
+        opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)
+        zeros = jax.tree_util.tree_map(
+            lambda g: jnp.zeros_like(g) if hasattr(g, "dtype") else g,
+            grads_like,
+        )
+        jax.block_until_ready(self._apply_jit(params, opt_state, zeros))
+
     def apply_gradients(self, grads: Any) -> None:
         """One optimizer update, in place (holder-level).
 
