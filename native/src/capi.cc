@@ -344,6 +344,37 @@ int tft_plan_execute(void* handle, int64_t plan_id, const void* const* leaf_in,
   });
 }
 
+// Builds a PREPACKED CommPlan: execute takes per-GROUP wire buffers the
+// caller (the device-side Pallas pack) already encoded, so the pack stage
+// is a straight decode. Same wire contract as tft_plan_build — prepacked
+// and plain plans of one signature interoperate in one ring.
+int64_t tft_plan_build_pre(void* handle, const int64_t* counts,
+                           const int32_t* dtypes, int64_t n_leaves, int wire) {
+  int64_t id = -1;
+  int rc = guarded([&] {
+    id = static_cast<HostCollectives*>(handle)->plan_build(
+        counts, dtypes, n_leaves, static_cast<PlanWire>(wire),
+        /*prepacked=*/true);
+  });
+  return rc == kOk ? id : -1;
+}
+
+// One gradient sync over a prepacked plan: group_in[g] is group g's wire
+// payload (g.count staging-dtype elements — int8 codes for q8 wires),
+// group_aux[g] its per-leaf f32 scale sidecar (q8 only; may be null
+// otherwise). Both arrays are n_groups long in plan group order;
+// leaf_out is n_leaves long in signature order.
+int tft_plan_execute_pre(void* handle, int64_t plan_id,
+                         const void* const* group_in,
+                         const void* const* group_aux, void* const* leaf_out,
+                         double divisor, int has_divisor, int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->plan_execute_pre(
+        plan_id, group_in, group_aux, leaf_out, divisor, has_divisor != 0,
+        timeout_ms);
+  });
+}
+
 int tft_plan_free(void* handle, int64_t plan_id) {
   return guarded(
       [&] { static_cast<HostCollectives*>(handle)->plan_free(plan_id); });

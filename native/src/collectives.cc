@@ -897,12 +897,13 @@ T floor_div(T a, T d) {
 
 int64_t HostCollectives::plan_build(const int64_t* counts,
                                     const int32_t* dtypes, int64_t n_leaves,
-                                    PlanWire wire) {
+                                    PlanWire wire, bool prepacked) {
   if (world_size_ <= 0)
     throw SocketError("plan_build before configure (layout needs the ring)");
   if (n_leaves <= 0) throw SocketError("plan_build of an empty signature");
   auto p = std::make_unique<CommPlan>();
   p->wire = wire;
+  p->prepacked = prepacked;
   p->leaves.resize(n_leaves);
   // FNV-1a over (wire, geometry, signature): exchanged in the execute
   // header so mismatched plans error instead of desyncing the ring.
@@ -960,7 +961,14 @@ int64_t HostCollectives::plan_build(const int64_t* counts,
     g.staging.resize(g.count * esize);
     total_f32 += g.count;
   }
-  if (wire == PlanWire::kQ8EF) p->residual.assign(total_f32, 0.f);
+  // Prepacked kQ8EF: the error-feedback carry lives device-side in the
+  // packer (that is the point — the full-f32 residual never crosses the
+  // device link), so the plan allocates none.
+  if (wire == PlanWire::kQ8EF && !prepacked) p->residual.assign(total_f32, 0.f);
+  // NOTE: `prepacked` is NOT mixed into the hash — pack placement is a
+  // local choice, and a device-packing member must interoperate with a
+  // host-packing one (the device kernels mirror the native arithmetic
+  // bit for bit; tests/test_device_pack.py pins the mixed-ring case).
   p->sig = h;
   MutexLock lock(plan_mu_);
   plans_[next_plan_id_] = std::move(p);
@@ -995,6 +1003,7 @@ std::string HostCollectives::plan_stats_json(int64_t plan_id) {
   JsonObject out;
   out["execs"] = Json(p.execs);
   out["wire"] = Json(static_cast<int64_t>(p.wire));
+  out["prepacked"] = Json(static_cast<int64_t>(p.prepacked ? 1 : 0));
   JsonArray buckets;
   for (const auto& st : p.stats) {
     JsonObject b;
@@ -1179,12 +1188,122 @@ void HostCollectives::plan_pack_ef(CommPlan& p, CommPlan::Group& g,
   }
 }
 
+void HostCollectives::plan_pack_pre_range(const CommPlan& p,
+                                          CommPlan::Group& g,
+                                          const void* group_in,
+                                          const void* group_aux, size_t start,
+                                          size_t len) const {
+  size_t gesize = dtype_size(g.dtype);
+  const bool q8 = p.wire == PlanWire::kQ8 || p.wire == PlanWire::kQ8EF;
+  if (!q8) {
+    // The payload already IS the staging encoding (bf16/native words,
+    // cast on device): a straight copy into the ring's in-place buffer.
+    memcpy(g.staging.data() + start * gesize,
+           static_cast<const char*>(group_in) + start * gesize, len * gesize);
+    return;
+  }
+  // q8 wires: int8 codes + one f32 scale per leaf. dq = q * scale is the
+  // exact product the host EF writes into staging (same q, same scale —
+  // the device kernel's tested contract), so the ring sees identical
+  // bits. A NaN scale (the device kernel's non-finite signal) poisons
+  // every element of its leaf: 0 * NaN = NaN, the host EF's whole-leaf
+  // propagation.
+  if (group_aux == nullptr)
+    throw SocketError("prepacked q8 plan: missing per-leaf scale sidecar");
+  const int8_t* q = static_cast<const int8_t*>(group_in);
+  const float* scales = static_cast<const float*>(group_aux);
+  float* stg = reinterpret_cast<float*>(g.staging.data());
+  size_t end = start + len;
+  for (size_t k = 0; k < g.leaf_idx.size(); k++) {
+    const CommPlan::Leaf& leaf = p.leaves[g.leaf_idx[k]];
+    size_t off = g.leaf_off[k];
+    size_t lend = off + leaf.count;
+    if (lend <= start || off >= end) continue;
+    size_t a = std::max(off, start);
+    size_t b = std::min(lend, end);
+    float scale = scales[k];
+    for (size_t i = a; i < b; i++)
+      stg[i] = static_cast<float>(q[i]) * scale;
+  }
+}
+
+void HostCollectives::plan_execute_pre(int64_t plan_id,
+                                       const void* const* group_in,
+                                       const void* const* group_aux,
+                                       void* const* leaf_out, double divisor,
+                                       bool has_divisor, int64_t timeout_ms) {
+  MutexLock lock(op_mu_);
+  CommPlan& p = plan_get(plan_id);
+  if (!p.prepacked)
+    throw SocketError(
+        "plan_execute_pre on a plan built without prepacked leaves");
+  p.stats.clear();
+  const bool q8 = p.wire == PlanWire::kQ8 || p.wire == PlanWire::kQ8EF;
+  if (world_size_ == 1) {
+    for (size_t gi = 0; gi < p.groups.size(); gi++) {
+      CommPlan::Group& g = p.groups[gi];
+      plan_pack_pre_range(p, g, group_in[gi], group_aux[gi], 0, g.count);
+      plan_unpack_range(p, g, leaf_out, 0, g.count, divisor, has_divisor);
+    }
+    p.execs++;
+    return;
+  }
+  if (aborted_) throw SocketError("collectives not configured");
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // Same header as the host-pack execute (the hash excludes
+    // `prepacked`): a device-packing member and a host-packing member of
+    // one ring agree here and produce identical staging.
+    check_op_header(8, p.sig, static_cast<uint32_t>(p.wire), 0, deadline);
+    for (size_t gi = 0; gi < p.groups.size(); gi++) {
+      CommPlan::Group& g = p.groups[gi];
+      if (g.count == 0) continue;
+      size_t esize = dtype_size(g.dtype);
+      size_t stat_base = p.stats.size();
+      p.stats.resize(stat_base + g.eff);
+      last_stripe_ns_.assign(g.eff, 0);
+      // Unlike the host EF (whole-group absmax before any stripe may
+      // start), the prepacked decode is per-element and streams per
+      // bucket — the triple pipeline covers the q8 wires too.
+      run_striped([&](int64_t s) {
+        auto [start, len] = stripe_range(g.count, g.eff, s);
+        CommPlan::BucketStat& st = p.stats[stat_base + s];
+        st.group = static_cast<int64_t>(gi);
+        st.stripe = s;
+        st.bytes = static_cast<int64_t>(len * esize);
+        if (len == 0) return;
+        auto t0 = std::chrono::steady_clock::now();
+        plan_pack_pre_range(p, g, group_in[gi], group_aux[gi], start, len);
+        auto t1 = std::chrono::steady_clock::now();
+        if (q8) {
+          allreduce_q8_stripe(
+              s, reinterpret_cast<float*>(g.staging.data()) + start, len,
+              deadline);
+        } else {
+          allreduce_stripe(s, g.staging.data() + start * esize, len, esize,
+                           g.dtype, ReduceOp::kSum, deadline);
+        }
+        auto t2 = std::chrono::steady_clock::now();
+        plan_unpack_range(p, g, leaf_out, start, len, divisor, has_divisor);
+        auto t3 = std::chrono::steady_clock::now();
+        st.pack_ns = ns_between(t0, t1);
+        st.ring_ns = ns_between(t1, t2);
+        st.unpack_ns = ns_between(t2, t3);
+      });
+    }
+  });
+  p.execs++;
+}
+
 void HostCollectives::plan_execute(int64_t plan_id,
                                    const void* const* leaf_in,
                                    void* const* leaf_out, double divisor,
                                    bool has_divisor, int64_t timeout_ms) {
   MutexLock lock(op_mu_);
   CommPlan& p = plan_get(plan_id);
+  if (p.prepacked)
+    throw SocketError(
+        "plan_execute on a prepacked plan (use plan_execute_pre)");
   p.stats.clear();
   const bool q8 = p.wire == PlanWire::kQ8 || p.wire == PlanWire::kQ8EF;
   if (world_size_ == 1) {

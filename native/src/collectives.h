@@ -130,10 +130,22 @@ struct CommPlan {
   };
 
   PlanWire wire = PlanWire::kNative;
+  // Pre-packed leaves: the caller (a device-side Pallas pack) already
+  // emitted the WIRE encoding — one contiguous payload per group in the
+  // group's staging dtype (int8 codes for q8 wires, with a per-leaf f32
+  // scale sidecar), so execute's pack stage collapses to a straight
+  // decode/memcpy into staging. The ring and unpack phases are the
+  // host-pack plan's own, and `prepacked` is deliberately EXCLUDED from
+  // the signature hash: a device-packing member and a host-packing member
+  // produce bit-identical staging (the device kernels mirror the native
+  // EF/cast arithmetic), so mixed rings interoperate — pack placement is
+  // a local choice, not a wire-contract change.
+  bool prepacked = false;
   std::vector<Leaf> leaves;
   std::vector<Group> groups;
   // kQ8EF: persistent error-feedback carry, laid out exactly like the
-  // single f32 group's staging (per-leaf offsets shared).
+  // single f32 group's staging (per-leaf offsets shared). Prepacked q8
+  // plans leave it empty — the carry lives device-side in the packer.
   std::vector<float> residual;
   uint64_t sig = 0;      // structure hash, exchanged in the op header
   int64_t execs = 0;     // executes since build (0 = cold)
@@ -239,9 +251,13 @@ class HostCollectives {
   // sockets touched — so ranks may build at different times; the id is
   // local. All members of a ring must build plans from identical
   // signatures (the execute header hashes the signature and errors on
-  // mismatch, like every other op).
+  // mismatch, like every other op). `prepacked` builds a plan whose
+  // execute takes pre-packed per-GROUP wire buffers (plan_execute_pre)
+  // instead of per-leaf source pointers; it does not change the wire
+  // contract (see CommPlan::prepacked), so prepacked and plain plans of
+  // the same signature interoperate in one ring.
   int64_t plan_build(const int64_t* counts, const int32_t* dtypes,
-                     int64_t n_leaves, PlanWire wire);
+                     int64_t n_leaves, PlanWire wire, bool prepacked = false);
 
   // Executes one gradient sync over the plan: packs/casts leaf_in[i]
   // into the persistent staging (kQ8EF additionally runs the native
@@ -256,6 +272,21 @@ class HostCollectives {
   void plan_execute(int64_t plan_id, const void* const* leaf_in,
                     void* const* leaf_out, double divisor, bool has_divisor,
                     int64_t timeout_ms);
+
+  // Executes a PREPACKED plan: group_in[g] points at group g's wire
+  // payload (g.count elements of the group's staging dtype — int8 codes
+  // for q8 wires, bf16/native words otherwise) and group_aux[g] at its
+  // per-leaf f32 scale sidecar (q8 wires only; ignored — may be null —
+  // for other groups). The pack stage per stripe bucket is a straight
+  // decode (q8: staging[i] = q[i] * scale[leaf]; else memcpy) streamed
+  // per bucket like any other phase; ring and unpack are plan_execute's
+  // own, so device-packed results are bit-identical to host-packed ones
+  // whenever the device pack mirrors the native pack arithmetic (the
+  // Pallas kernels' tested contract). A NaN scale poisons its whole leaf
+  // (0 * NaN), reproducing the host EF's non-finite propagation.
+  void plan_execute_pre(int64_t plan_id, const void* const* group_in,
+                        const void* const* group_aux, void* const* leaf_out,
+                        double divisor, bool has_divisor, int64_t timeout_ms);
 
   void plan_free(int64_t plan_id);
   // Zeroes a kQ8EF plan's error-feedback carry (no-op otherwise): the
@@ -378,6 +409,12 @@ class HostCollectives {
                          double divisor, bool has_divisor) const;
   void plan_pack_ef(CommPlan& p, CommPlan::Group& g,
                     const void* const* leaf_in) const;
+  // Prepacked decode of one element range: q8 groups dequantize the int8
+  // codes against the per-leaf scale sidecar, everything else memcpys the
+  // already-wire-encoded words into staging.
+  void plan_pack_pre_range(const CommPlan& p, CommPlan::Group& g,
+                           const void* group_in, const void* group_aux,
+                           size_t start, size_t len) const;
   CommPlan& plan_get(int64_t plan_id);
 
   // Shuts down every ring socket (all stripes); cfg_mu_ must NOT be held.

@@ -211,7 +211,11 @@ class TestEndToEnd:
 
         manager, store, lighthouse = self._manager()
         state = _state()
-        ddp = AdaptiveDDP(manager, state, _grad_fn, probe_steps=2)
+        # device_pack="off" pins the classic 3-candidate probe (the
+        # devpack candidate has its own suite, test_device_pack.py)
+        ddp = AdaptiveDDP(
+            manager, state, _grad_fn, probe_steps=2, device_pack="off"
+        )
         x = jnp.ones((4, 8), jnp.float32)
         try:
             assert ddp.mode is None  # probing
@@ -345,7 +349,8 @@ class TestReprobeOnQuorumChange:
             def errored(self):
                 return None
 
-            def plan_allreduce(self, tree, op=None, wire=None):
+            def plan_allreduce(self, tree, op=None, wire=None,
+                               device_pack=None):
                 from torchft_tpu.collectives import _completed
 
                 return _completed(tree)
@@ -375,7 +380,9 @@ class TestReprobeOnQuorumChange:
 
         mgr = ScriptedManager()
         state = _state()
-        ddp = AdaptiveDDP(mgr, state, _grad_fn, probe_steps=2)
+        ddp = AdaptiveDDP(
+            mgr, state, _grad_fn, probe_steps=2, device_pack="off"
+        )
         x = jnp.ones((4, 8), jnp.float32)
         # step 1 anchors the probe clock (first quorum-id observation,
         # untimed); 3 candidates x 2 steps follow

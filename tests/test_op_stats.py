@@ -82,6 +82,9 @@ class TestDevicePackedStats:
         for key in ("pack", "d2h", "ring", "h2d"):
             assert key in st and st[key] >= 0.0
         assert st["bytes"] == 5003 * 4 + 777 * 4
+        # d2h_bytes is its own key on every path: native dtypes cross
+        # the device link at full width here
+        assert st["d2h_bytes"] == st["bytes"]
         assert set(st["buckets"]) == {"float32", "int32"}
         for name, b in st["buckets"].items():
             assert b["bytes"] > 0
@@ -106,6 +109,7 @@ class TestDevicePackedStats:
         assert st["chunks"] == 2 * 4  # both dtype buckets chunked 4-way
         # chunking must not double-count bytes: bucket sums == totals
         assert st["bytes"] == 10007 * 4 + 501 * 4
+        assert st["d2h_bytes"] == st["bytes"]  # chunk-pipelined path too
         assert (
             sum(b["bytes"] for b in st["buckets"].values()) == st["bytes"]
         )
@@ -120,6 +124,11 @@ class TestDevicePackedStats:
     def test_q8_wire_bytes_quarter_of_device_bytes(self, store):
         import jax.numpy as jnp
 
+        from torchft_tpu.collectives import (
+            _effective_stripes,
+            _q8_wire_overhead,
+        )
+
         cols = _ring(store, "st2")
         tree = {"w": jnp.ones(8192, jnp.float32)}
         _run_all(
@@ -129,7 +138,12 @@ class TestDevicePackedStats:
             s for s in cols[0].pop_op_stats() if s["op"] == "allreduce_q8"
         ][-1]
         assert st["bytes"] == 8192 * 4  # f32 crosses the device link
-        assert st["wire_bytes"] == 8192  # ~1 byte/elem rides TCP
+        assert st["d2h_bytes"] == 8192 * 4  # host pack: f32 d2h leg
+        # ~1 byte/elem rides TCP PLUS the honest overhead: one f32 scale
+        # per (stripe, ring chunk) per quantized phase + the op header
+        eff = _effective_stripes(8192, cols[0]._stripes)
+        assert st["wire_bytes"] == 8192 + _q8_wire_overhead(eff, 2)
+        assert st["wire_bytes"] > 8192  # the sidecar is not free
         for c in cols:
             c.shutdown()
 
@@ -159,9 +173,41 @@ class TestShardedStats:
         # the shard leg scales with 1/world: strictly smaller than full
         assert 0 < rs["shard_bytes"] < rs["bytes"]
         assert rs["wire_bytes"] == rs["bytes"]  # f32 wire
+        # numpy input: nothing crossed a device link on either op
+        assert rs["d2h_bytes"] == 0
+        assert ag["d2h_bytes"] == 0
         assert ag["bytes"] == 50021 * 4
         for st in (rs, ag):
             assert "ring" in st and "stripe_s" in st
+        for c in cols:
+            c.shutdown()
+
+    def test_sharded_d2h_bytes_with_jax_leaves(self, store):
+        import jax.numpy as jnp
+
+        from torchft_tpu.collectives import _q8_wire_overhead
+
+        cols = _ring(store, "st4j", world_size=2, stripes=2)
+        tree = {"g": jnp.ones(50021, jnp.float32)}
+
+        def sync(r, c):
+            sh = c.reduce_scatter(tree, ReduceOp.SUM, wire="q8").wait()
+            return c.allgather_into(sh).wait()
+
+        _run_all(cols, sync)
+        stats = cols[0].pop_op_stats()
+        rs = [s for s in stats if s["op"] == "reduce_scatter"][-1]
+        ag = [s for s in stats if s["op"] == "allgather_into"][-1]
+        # the full tree crosses down once; only the owned shard returns
+        assert rs["d2h_bytes"] == 50021 * 4
+        assert 0 < ag["d2h_bytes"] == rs["shard_bytes"]
+        # q8 reduce-scatter runs ONE quantized phase: sidecar + header
+        from torchft_tpu.collectives import _effective_stripes
+
+        eff = _effective_stripes(50021, 2)  # q8: 1 byte/element
+        assert rs["wire_bytes"] == 50021 + _q8_wire_overhead(
+            eff, 2, phases=1
+        )
         for c in cols:
             c.shutdown()
 
@@ -188,6 +234,9 @@ class TestPlanStats:
         ][-1]
         total = 150001 * 4 + 33 * 8
         assert st["bytes"] == total
+        # host pack: full-width leaves are what the device link reads
+        assert st["d2h_bytes"] == total
+        assert st["device_pack"] is False
         assert st["py_staging_allocs"] == 0  # the zero-allocation contract
         assert st["plan_execs"] == 2
         # per-bucket bytes tile the payload exactly — each bucket is one

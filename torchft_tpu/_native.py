@@ -275,6 +275,28 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int,                    # has_divisor
         ctypes.c_int64,
     ]
+    # Pre-packed plans: the device-side Pallas pack already emitted the
+    # wire encoding, so execute takes per-GROUP payload (+ q8 scale
+    # sidecar) pointers and the native pack stage is a straight decode.
+    lib.tft_plan_build_pre.restype = ctypes.c_int64
+    lib.tft_plan_build_pre.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),  # per-leaf flat element counts
+        ctypes.POINTER(ctypes.c_int32),  # per-leaf native dtype codes
+        ctypes.c_int64,                  # leaf count
+        ctypes.c_int,                    # wire: 0 native, 1 bf16, 2 q8, 3 q8+EF
+    ]
+    lib.tft_plan_execute_pre.restype = ctypes.c_int
+    lib.tft_plan_execute_pre.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,                  # plan id
+        ctypes.POINTER(ctypes.c_void_p),  # per-group wire payload pointers
+        ctypes.POINTER(ctypes.c_void_p),  # per-group scale sidecars (q8)
+        ctypes.POINTER(ctypes.c_void_p),  # leaf output pointers
+        ctypes.c_double,                 # divisor
+        ctypes.c_int,                    # has_divisor
+        ctypes.c_int64,
+    ]
     lib.tft_plan_free.restype = ctypes.c_int
     lib.tft_plan_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.tft_plan_reset_feedback.restype = ctypes.c_int

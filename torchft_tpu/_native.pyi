@@ -328,6 +328,25 @@ class _NativeLib:
         has_divisor: int,
         timeout_ms: int
     ) -> int: ...
+    def tft_plan_build_pre(
+        self,
+        handle: Any,
+        counts: Any,
+        dtypes: Any,
+        n_leaves: int,
+        wire: int
+    ) -> int: ...
+    def tft_plan_execute_pre(
+        self,
+        handle: Any,
+        plan_id: int,
+        group_in: Any,
+        group_aux: Any,
+        leaf_out: Any,
+        divisor: float,
+        has_divisor: int,
+        timeout_ms: int
+    ) -> int: ...
     def tft_plan_free(self, handle: Any, plan_id: int) -> int: ...
     def tft_plan_reset_feedback(self, handle: Any, plan_id: int) -> int: ...
     def tft_plan_stats_json(

@@ -238,6 +238,7 @@ class Collectives(ABC):
         op: ReduceOp = ReduceOp.SUM,
         divisor: Optional[float] = None,
         wire: Optional[str] = None,
+        device_pack: Optional[bool] = None,
     ) -> Work:
         """Like :meth:`allreduce` (SUM/AVG only) but through a persistent
         precompiled comm plan: the leaf->bucket layout, dtype casts, wire
@@ -249,7 +250,12 @@ class Collectives(ABC):
         ``"bf16"`` rounds f32 leaves to bfloat16 on the wire, ``"q8"``
         ships int8 ring chunks, ``"q8ef"`` adds the per-leaf int8
         quantization with error feedback (the carry persists inside the
-        plan; see :meth:`plan_reset_feedback`)."""
+        plan; see :meth:`plan_reset_feedback`). ``device_pack``
+        (True/False/None = ``TORCHFT_DEVICE_PACK``) moves the wire
+        encoding onto the accelerator where supported, so the
+        device->host leg costs wire bytes instead of f32 bytes —
+        results stay bit-identical, backends without the capability
+        host-pack."""
         raise NotImplementedError(
             f"{type(self).__name__} has no persistent comm plans"
         )
@@ -422,6 +428,168 @@ class _DevicePacker:
 # Python wire names -> native PlanWire codes (collectives.h).
 _PLAN_WIRES = {None: 0, "bf16": 1, "q8": 2, "q8ef": 3}
 
+# Wires the DEVICE pack (Pallas kernels emitting the wire encoding on the
+# accelerator) supports. Plain "q8" is deliberately absent: its host-pack
+# contract ships RAW f32 to the quantized ring, and quantizing at the
+# device boundary would change the numerics — callers wanting the device
+# quantize use "q8ef" (what the DDP q8 mode maps to anyway).
+_DEVICE_PACK_WIRES = (None, "bf16", "q8ef")
+
+# Bytes of the native per-op header exchange (check_op_header's struct:
+# magic, kind, count, dtype, op — collectives.cc).
+_OP_HEADER_BYTES = 24
+
+
+def _resolve_device_pack_setting(setting: Any) -> Optional[bool]:
+    """ONE parser for the TORCHFT_DEVICE_PACK knob, shared by every layer
+    (HostCollectives, PipelinedDDP, AdaptiveDDP): maps a ctor/env setting
+    to True (pack on device) / False (host) / None (backend auto).
+    ``None`` input reads the env; raises ValueError on junk — callers
+    invoke this EAGERLY so a typo'd knob fails loudly instead of latching
+    per step in the managed dispatch."""
+    if setting is None:
+        setting = os.environ.get("TORCHFT_DEVICE_PACK", "auto")
+    if isinstance(setting, str):
+        try:
+            return {"on": True, "off": False, "auto": None}[setting]
+        except KeyError:
+            raise ValueError(
+                f"TORCHFT_DEVICE_PACK={setting!r} (want auto|on|off)"
+            ) from None
+    return bool(setting)
+
+
+def _q8_wire_overhead(eff: int, world: int, phases: int = 2) -> int:
+    """Bytes the q8 wire ships beyond its int8 payload: one f32 scale per
+    (stripe, ring chunk) per quantized phase — the fused allreduce runs
+    two (reduce-scatter + allgather), reduce_scatter one — plus the
+    per-op header exchange. Counted so compression ratios are honest
+    (`wire_bytes: count` alone pretends the sidecar is free)."""
+    return 4 * eff * max(world, 1) * phases + _OP_HEADER_BYTES
+
+
+def _plan_groups(
+    sig: Sequence[Tuple[Any, Any]], wire: Optional[str]
+) -> List[Tuple[Any, List[int]]]:
+    """leaf -> group assignment of a comm plan, replicating native
+    plan_build EXACTLY (first-appearance order of the group dtype over
+    leaves in signature order) — the device packer and the prepacked
+    execute index groups positionally, so the two layouts must be one.
+    Returns [(group np.dtype, [leaf indices])]; raises KeyError on a
+    signature the plan path cannot take (the callers' fallback signal)."""
+    f32 = np.dtype(np.float32)
+    groups: List[Tuple[Any, List[int]]] = []
+    for i, (_, dt) in enumerate(sig):
+        if wire in ("q8", "q8ef"):
+            if dt not in (f32, _BF16):
+                raise KeyError(dt)
+            gdt = f32
+        else:
+            if dt not in _NATIVE_DTYPES:
+                raise KeyError(dt)
+            gdt = _BF16 if (wire == "bf16" and dt == f32) else dt
+        for g in groups:
+            if g[0] == gdt:
+                g[1].append(i)
+                break
+        else:
+            groups.append((gdt, [i]))
+    return groups
+
+
+class _DeviceWirePacker:
+    """Pallas-kernel pack of a fixed tree signature into the WIRE
+    encoding, ON DEVICE (torchft_tpu.ops.quantize_kernels), emitting the
+    pre-packed per-group buffers a prepacked CommPlan decodes:
+
+    - ``wire="q8ef"``: per-leaf int8 EF quantization — the codes
+      concatenate into the plan's single f32 group layout, the per-leaf
+      scales form the sidecar, and the error-feedback carry lives HERE as
+      device-resident f32 arrays that never cross the link. ~1 byte per
+      element crosses d2h instead of 4.
+    - ``wire="bf16"``: f32 leaves concatenate and cast to bf16 on device
+      (2 bytes/element d2h); other dtypes pack natively.
+    - ``wire=None``: the plain concat pack (native bytes — no byte win,
+      but one transfer per dtype group instead of one per leaf).
+
+    The group layout replicates native plan_build positionally
+    (_plan_groups), which is what lets plan_execute_pre skip its pack
+    stage. The quantization arithmetic is the FMA-free mirror of the
+    native EF (the kernels' tested contract), so device-packed staging is
+    bit-identical to host-packed staging and mixed rings interoperate."""
+
+    def __init__(self, leaves: Sequence[Any], wire: Optional[str]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from .ops import quantize_kernels as qk
+
+        if wire not in _DEVICE_PACK_WIRES:
+            raise KeyError(wire)
+        self.wire = wire
+        self.sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        self.groups = _plan_groups(self.sig, wire)  # KeyError -> no packer
+        sig = self.sig
+        groups = self.groups
+        f32 = np.dtype(np.float32)
+
+        if wire == "q8ef":
+            ((_, idxs),) = groups  # q8 plans are a single f32 group
+            self.residuals: Optional[List[Any]] = [
+                jnp.zeros(sig[i][0], jnp.float32) for i in idxs
+            ]
+
+            def pack(ls: Sequence[Any], residuals: Sequence[Any]):
+                qs, scales, new_res = [], [], []
+                for k, i in enumerate(idxs):
+                    q, s, r = qk.quantize_q8_ef(
+                        ls[i].astype(jnp.float32), residuals[k]
+                    )
+                    qs.append(q.ravel())
+                    scales.append(s.reshape(1))
+                    new_res.append(r)
+                return [jnp.concatenate(qs)], [jnp.concatenate(scales)], new_res
+        else:
+            self.residuals = None
+
+            def pack(ls: Sequence[Any], residuals: Sequence[Any]):
+                payloads = []
+                for gdt, idxs in groups:
+                    if gdt == _BF16 and any(sig[i][1] != _BF16 for i in idxs):
+                        # f32 (or mixed) sources: concat in f32, one cast
+                        # kernel per group (bf16->f32->bf16 round-trips
+                        # exactly, so native-bf16 leaves are unharmed)
+                        buf = jnp.concatenate(
+                            [ls[i].astype(f32).ravel() for i in idxs]
+                        )
+                        payloads.append(qk.cast_bf16(buf))
+                    else:
+                        payloads.append(jnp.concatenate(
+                            [ls[i].astype(gdt).ravel() for i in idxs]
+                        ))
+                return payloads, [], []
+
+        self._pack = jax.jit(pack)
+
+    def pack_step(self, leaves: Sequence[Any]):
+        """(payload arrays, scale arrays, residual rollover) — one entry
+        per plan group (scales empty off the q8 wires). Advances the
+        device-resident EF carry."""
+        payloads, scales, new_res = self._pack(
+            leaves, self.residuals if self.residuals is not None else []
+        )
+        if self.residuals is not None:
+            self.residuals = new_res
+        return payloads, scales
+
+    def reset_feedback(self) -> None:
+        """Zeroes the device-resident EF carry (the heal/abort
+        discipline, same contract as the native plan carry)."""
+        if self.residuals is not None:
+            import jax.numpy as jnp
+
+            self.residuals = [jnp.zeros_like(r) for r in self.residuals]
+
 
 class _CommPlan:
     """Python handle for one native CommPlan.
@@ -436,16 +604,19 @@ class _CommPlan:
     """
 
     def __init__(self, handle: Any, sig: Sequence[Any], treedef: Any,
-                 wire: Optional[str]) -> None:
+                 wire: Optional[str], stripes: int = 1, world: int = 1,
+                 prepacked: bool = False) -> None:
         self.treedef = treedef
         self.sig = tuple(sig)
         self.wire = wire
+        self.prepacked = prepacked
         n = len(self.sig)
         counts = [int(np.prod(s)) if s else 1 for s, _ in self.sig]
         # KeyError on a non-native dtype: the caller treats it as
         # "unsupported signature" and falls back to the legacy path.
         codes = [_NATIVE_DTYPES[dt] for _, dt in self.sig]
-        plan_id = _lib.tft_plan_build(
+        build = _lib.tft_plan_build_pre if prepacked else _lib.tft_plan_build
+        plan_id = build(
             handle,
             (ctypes.c_int64 * n)(*counts),
             (ctypes.c_int32 * n)(*codes),
@@ -457,6 +628,12 @@ class _CommPlan:
         self.plan_id = plan_id
         self._handle = handle
         self.in_ptrs = (ctypes.c_void_p * n)()
+        if prepacked:
+            # Per-GROUP wire payload + scale-sidecar pointer arrays, in
+            # the native plan's group order (_plan_groups replicates it).
+            ng = len(_plan_groups(self.sig, wire))
+            self.group_in = (ctypes.c_void_p * ng)()
+            self.group_aux = (ctypes.c_void_p * ng)()
         self.out_sets: List[List[np.ndarray]] = []
         self.out_ptrs: List[Any] = []
         for _ in range(2):
@@ -471,8 +648,12 @@ class _CommPlan:
             c * np.dtype(dt).itemsize for c, (_, dt) in zip(counts, self.sig)
         )
         if wire in ("q8", "q8ef"):
-            # int8 codes + per-chunk scales: ~1 wire byte per element
-            self.wire_bytes = sum(counts)
+            # int8 codes + the per-(stripe, ring chunk) scale sidecar and
+            # the op header — the honest quantized-wire bill (q8 plans
+            # pack ONE f32 group, so its stripe partition is the op's)
+            total = sum(counts)
+            eff = _effective_stripes(total, stripes)
+            self.wire_bytes = total + _q8_wire_overhead(eff, world)
         elif wire == "bf16":
             self.wire_bytes = sum(
                 c * (2 if np.dtype(dt) == np.dtype(np.float32)
@@ -545,6 +726,14 @@ class HostCollectives(Collectives):
         )
         self._shutdown = False
         self._packers: dict = {}
+        # Device WIRE packers (Pallas quantize/cast on the accelerator)
+        # keyed like plans; a None value marks a signature/wire the
+        # device pack cannot serve (host pack serves it instead). These
+        # hold the device-resident q8 EF carries, so plan_reset_feedback
+        # zeroes them alongside the native plan carries. Survive
+        # configure(): the pack is ring-geometry-free (pure per-leaf
+        # encoding), unlike the plans themselves.
+        self._dev_packers: dict = {}
         # Persistent comm plans keyed by (wire, treedef, signature); a
         # None value marks a signature the plan path cannot take (the
         # legacy path serves it). Invalidated wholesale on configure() —
@@ -651,6 +840,13 @@ class HostCollectives(Collectives):
             # in the old ring); drop the Python handles in the same
             # ordered position so no queued op can execute a stale id.
             self._plans = {}
+            # Device packers survive (their jitted encode is geometry-
+            # free) but their EF carries zero — a host-packed member's
+            # carry died with its plan just now, and the two modes must
+            # stay bit-identical across reconfigures.
+            for packer in self._dev_packers.values():
+                if packer is not None:
+                    packer.reset_feedback()
 
         self._executor.submit(do_configure).result()
 
@@ -801,9 +997,18 @@ class HostCollectives(Collectives):
             )
             self._record_op_stats({
                 "op": "allreduce_q8", "bytes": buf.nbytes,
-                # TCP wire ships int8 chunks + per-chunk f32 scales, not
-                # the f32 device payload
-                "wire_bytes": buf.size,
+                # TCP wire ships int8 chunks + per-chunk f32 scales + the
+                # op header, not the f32 device payload — the sidecar is
+                # counted (one scale per stripe x ring chunk x phase) so
+                # the compression ratio is honest.
+                "wire_bytes": buf.size + _q8_wire_overhead(
+                    _effective_stripes(buf.size, self._stripes),
+                    self._world_size,
+                ),
+                # Host-side quantization: the device link still carried
+                # the FULL f32 payload (the device-pack plan path is what
+                # shrinks this).
+                "d2h_bytes": buf.nbytes,
                 "d2h": d2h_s, "ring": ring_s,
                 "h2d": time.perf_counter() - t1 - ring_s,
                 "stripe_s": stripe_s,
@@ -992,9 +1197,12 @@ class HostCollectives(Collectives):
             name: (chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks))
             for name, chunks in out_chunks.items()
         }
+        total_bytes = sum(b["bytes"] for b in buckets.values())
         self._record_op_stats({
             "op": "allreduce",
-            "bytes": sum(b["bytes"] for b in buckets.values()),
+            "bytes": total_bytes,
+            # native dtypes ride both legs at full width
+            "d2h_bytes": total_bytes,
             "chunks": len(schedule),
             "pack": pack_s,
             "d2h": sum(b["d2h"] for b in buckets.values()),
@@ -1033,6 +1241,7 @@ class HostCollectives(Collectives):
         op: ReduceOp = ReduceOp.SUM,
         divisor: Optional[float] = None,
         wire: Optional[str] = None,
+        device_pack: Optional[bool] = None,
     ) -> Work:
         """The plan-path allreduce (see Collectives.plan_allreduce): one
         native call per step over a cached, precompiled plan. Bit-identical
@@ -1040,7 +1249,19 @@ class HostCollectives(Collectives):
         per-group stripe partition through the same native ring bodies.
         Unsupported signatures (non-native leaf dtypes; q8 wires with
         non-float leaves) silently take the legacy path with equivalent
-        semantics where one exists (``wire=None``), else raise."""
+        semantics where one exists (``wire=None``), else raise.
+
+        ``device_pack``: ``True`` packs the wire encoding ON DEVICE
+        (Pallas quantize/cast kernels + prepacked plan leaves) so the
+        device->host transfer costs wire bytes, not f32 bytes — supported
+        for wires ``None``/``"bf16"``/``"q8ef"`` on all-jax trees, with a
+        silent host-pack fallback where the capability is missing (CPU
+        rings without the kernels, non-jax leaves, plain ``"q8"``).
+        ``False`` pins host pack. ``None`` (default) resolves
+        ``TORCHFT_DEVICE_PACK``: ``on``/``off`` pin, ``auto`` (the
+        default) device-packs only where a real device link exists (the
+        TPU backend). Results are bit-identical either way — device- and
+        host-packing members may share one ring."""
         timeout_ms = _ms(self._timeout)
         if wire not in _PLAN_WIRES:
             raise ValueError(f"unsupported wire: {wire!r}")
@@ -1054,24 +1275,74 @@ class HostCollectives(Collectives):
             divisor, op = float(self._world_size), ReduceOp.SUM
         if op != ReduceOp.SUM:
             raise ValueError("plan_allreduce supports SUM/AVG only")
+        # Parse the knob EAGERLY (static usage errors raise here, before
+        # the submit, matching the wire/op validation above — an op-thread
+        # ValueError would be latched by Manager's dispatch and silently
+        # discard every step instead).
+        device_pack = _resolve_device_pack_setting(device_pack)
         return self._submit(
-            lambda: self._plan_allreduce_sync(tree, divisor, wire, timeout_ms)
+            lambda: self._plan_allreduce_sync(
+                tree, divisor, wire, timeout_ms, device_pack
+            )
         )
 
-    def _plan_for(
+    def _resolve_device_pack(
+        self, setting: Optional[bool], leaves: Sequence[Any],
+        wire: Optional[str],
+    ) -> bool:
+        """Whether this sync should ATTEMPT the device pack (a failed
+        packer build still falls back to host pack — the verdict caches).
+        ``setting`` is the already-parsed knob (True/False/None = auto);
+        auto engages only where the pack saves a real device-link leg."""
+        if setting is False:
+            return False
+        if wire not in _DEVICE_PACK_WIRES:
+            return False
+        if not leaves or not all(_is_jax_array(l) for l in leaves):
+            return False
+        if setting is True:
+            return True
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+    def _device_packer_for(
         self, leaves: Sequence[Any], treedef: Any, wire: Optional[str]
+    ) -> Optional[_DeviceWirePacker]:
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        key = (wire, treedef, sig)
+        if key in self._dev_packers:
+            return self._dev_packers[key]
+        try:
+            packer: Optional[_DeviceWirePacker] = _DeviceWirePacker(
+                leaves, wire
+            )
+        except Exception:  # noqa: BLE001 - unsupported signature, or the
+            # Pallas kernels are unavailable on this install: cache the
+            # verdict, host pack serves the identical contract.
+            packer = None
+        self._dev_packers[key] = packer
+        return packer
+
+    def _plan_for(
+        self, leaves: Sequence[Any], treedef: Any, wire: Optional[str],
+        prepacked: bool = False,
     ) -> Optional[_CommPlan]:
         # The signature MUST stay in the key: executing a plan against a
         # same-treedef tree with different shapes/dtypes would pack with
         # the wrong per-leaf counts (reading past leaf buffers). It is
         # computed once here and handed to the plan, never recomputed.
         sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
-        key = (wire, treedef, sig)
+        key = (wire, treedef, sig, prepacked) if prepacked else (
+            wire, treedef, sig
+        )
         if key in self._plans:
             return self._plans[key]
         try:
             plan: Optional[_CommPlan] = _CommPlan(
-                self._handle, sig, treedef, wire
+                self._handle, sig, treedef, wire,
+                stripes=self._stripes, world=self._world_size,
+                prepacked=prepacked,
             )
         except (KeyError, RuntimeError):
             # Non-native leaf dtype, or a wire/dtype combination the
@@ -1087,10 +1358,23 @@ class HostCollectives(Collectives):
         divisor: Optional[float],
         wire: Optional[str],
         timeout_ms: int,
+        device_pack: Optional[bool] = None,
     ) -> Any:
         leaves, treedef = _flatten(tree)
         if not leaves:
             return tree
+        if self._resolve_device_pack(device_pack, leaves, wire):
+            packer = self._device_packer_for(leaves, treedef, wire)
+            plan = (
+                self._plan_for(leaves, treedef, wire, prepacked=True)
+                if packer is not None else None
+            )
+            if packer is not None and plan is not None:
+                return self._plan_execute_device(
+                    plan, packer, leaves, treedef, divisor, wire, timeout_ms
+                )
+            # capability shortfall (kernels unavailable / unsupported
+            # signature): host pack serves the identical contract
         plan = self._plan_for(leaves, treedef, wire)
         if plan is None:
             if wire is None:
@@ -1136,8 +1420,12 @@ class HostCollectives(Collectives):
         self._record_op_stats({
             "op": "plan_allreduce",
             "wire": wire,
+            "device_pack": False,
             "bytes": plan.bytes,
             "wire_bytes": plan.wire_bytes,
+            # Host pack reads every leaf at full source width: the device
+            # link pays f32-size bytes regardless of the wire encoding.
+            "d2h_bytes": plan.bytes,
             "d2h": t1 - t0,  # pointer gather; host leaves make it ~free
             "ring": ring_s,  # the single native call: pack+ring+unpack
             # Per-bucket phases, fetched raw here and decoded lazily at
@@ -1151,15 +1439,95 @@ class HostCollectives(Collectives):
         })
         return _unflatten(treedef, outs)
 
+    def _plan_execute_device(
+        self,
+        plan: _CommPlan,
+        packer: _DeviceWirePacker,
+        leaves: Sequence[Any],
+        treedef: Any,
+        divisor: Optional[float],
+        wire: Optional[str],
+        timeout_ms: int,
+    ) -> Any:
+        """Device-packed plan execute: the Pallas kernels emit the wire
+        encoding on the accelerator (advancing the device-resident EF
+        carry on the q8ef wire), only WIRE-sized bytes cross d2h, and the
+        prepacked native plan decodes them straight into its staging —
+        ring and unpack are the host-pack plan's own, so results are
+        bit-identical to host packing."""
+        t0 = time.perf_counter()
+        payloads, scales = packer.pack_step(leaves)
+        for a in payloads:
+            a.copy_to_host_async()
+        for a in scales:
+            a.copy_to_host_async()
+        t1 = time.perf_counter()
+        staging_allocs = 0
+        host_payloads: List[np.ndarray] = []
+        for a in payloads:
+            h = np.asarray(a)
+            if not h.flags.c_contiguous:
+                h = np.ascontiguousarray(h)
+                staging_allocs += 1
+            host_payloads.append(h)
+        host_scales = [
+            np.ascontiguousarray(np.asarray(a)) for a in scales
+        ]
+        t2 = time.perf_counter()
+        gin, gaux = plan.group_in, plan.group_aux
+        q8 = wire in ("q8", "q8ef")
+        for gi, h in enumerate(host_payloads):
+            gin[gi] = h.ctypes.data
+            gaux[gi] = host_scales[gi].ctypes.data if q8 else None
+        outs = plan.out_sets[plan.flip]
+        out_ptrs = plan.out_ptrs[plan.flip]
+        plan.flip ^= 1
+        _check(
+            _lib.tft_plan_execute_pre(
+                self._handle,
+                plan.plan_id,
+                gin,
+                gaux,
+                out_ptrs,
+                float(divisor if divisor is not None else 1.0),
+                0 if divisor is None else 1,
+                timeout_ms,
+            )
+        )
+        ring_s = time.perf_counter() - t2
+        plan.execs += 1
+        d2h_bytes = sum(h.nbytes for h in host_payloads) + sum(
+            h.nbytes for h in host_scales
+        )
+        self._record_op_stats({
+            "op": "plan_allreduce",
+            "wire": wire,
+            "device_pack": True,
+            "bytes": plan.bytes,
+            "wire_bytes": plan.wire_bytes,
+            # The tentpole number: the device link carried the WIRE
+            # encoding (int8 codes + scale sidecar / bf16 words), not the
+            # full-width leaves.
+            "d2h_bytes": d2h_bytes,
+            "pack": t1 - t0,   # device kernel dispatch + DMA enqueue
+            "d2h": t2 - t1,    # blocking readback of the wire buffers
+            "ring": ring_s,    # the single native call: decode+ring+unpack
+            "_buckets_json": self._plan_stats_json(plan.plan_id),
+            "py_staging_allocs": staging_allocs,
+            "plan_execs": plan.execs,
+        })
+        return _unflatten(treedef, outs)
+
     def _plan_stats_json(self, plan_id: int) -> str:
         out = ctypes.c_void_p()
         _check(_lib.tft_plan_stats_json(self._handle, plan_id, ctypes.byref(out)))
         return _native._take_string(out)
 
     def plan_reset_feedback(self) -> None:
-        """Zeroes the EF carry of every cached q8ef plan (heal/abort
-        discipline). Runs on the op thread so it cannot interleave with an
-        in-flight execute."""
+        """Zeroes the EF carry of every cached q8ef plan — native AND
+        device-resident (the device packer owns the carry on the
+        device-pack path) — the heal/abort discipline. Runs on the op
+        thread so it cannot interleave with an in-flight execute."""
         def reset() -> None:
             for plan in self._plans.values():
                 if plan is not None and plan.wire == "q8ef":
@@ -1168,6 +1536,9 @@ class HostCollectives(Collectives):
                             self._handle, plan.plan_id
                         )
                     )
+            for packer in self._dev_packers.values():
+                if packer is not None:
+                    packer.reset_feedback()
         self._submit(reset).wait()
 
     def allgather(self, tree: Any) -> Work:
@@ -1276,6 +1647,9 @@ class HostCollectives(Collectives):
             results.append(_unflatten(treedef, packer.unpack(member_bufs)))
         self._record_op_stats({
             "op": "allgather", "bytes": nbytes,
+            # this rank's packed groups cross down once; the gathered
+            # members come back on the h2d leg
+            "d2h_bytes": nbytes,
             "pack": t1 - t0, "d2h": t2 - t1, "host_copy": t2b - t2,
             "ring": t3 - t2b, "h2d": time.perf_counter() - t3,
             "stripe_s": stripe_s,
@@ -1454,9 +1828,18 @@ class HostCollectives(Collectives):
             "shard_bytes": sum(
                 np.asarray(v).nbytes for v in values.values()
             ),
+            # q8 counts its scale sidecar (reduce-scatter runs ONE
+            # quantized phase) + the op header, like every q8 path
             "wire_bytes": sum(
-                counts[n] * (1 if wire == "q8" else host[n].itemsize)
+                counts[n] + _q8_wire_overhead(
+                    layout[n], self._world_size, phases=1
+                ) if wire == "q8" else counts[n] * host[n].itemsize
                 for n in names
+            ),
+            # the full tree crosses down once (when it started on
+            # device); only the shard returns
+            "d2h_bytes": (
+                sum(host[n].nbytes for n in names) if all_jax else 0
             ),
             "d2h": d2h_s, "ring": ring_s,
             "h2d": time.perf_counter() - t2,
@@ -1492,10 +1875,13 @@ class HostCollectives(Collectives):
         out_bufs: Dict[str, np.ndarray] = {}
         stripe_s: List[float] = []
         wire_bytes = 0
+        d2h_bytes = 0
         for name in sorted(shard.counts):
             count = shard.counts[name]
             gdtype = np.dtype(shard.dtypes[name])
             eff = shard.layout[name]
+            if _is_jax_array(shard.values[name]):
+                d2h_bytes += np.asarray(shard.values[name]).nbytes
             vals = np.ascontiguousarray(np.asarray(shard.values[name]))
             if vals.dtype != gdtype:
                 vals = vals.astype(gdtype)
@@ -1565,6 +1951,9 @@ class HostCollectives(Collectives):
             "op": "allgather_into",
             "bytes": sum(b.nbytes for b in out_bufs.values()),
             "wire_bytes": wire_bytes,
+            # only this rank's (updated) shard crosses down; the full
+            # gathered tree returns on the h2d leg
+            "d2h_bytes": d2h_bytes,
             "ring": ring_s,
             "h2d": time.perf_counter() - t1,
             "stripe_s": stripe_s,
@@ -1652,6 +2041,7 @@ class DummyCollectives(Collectives):
         op: ReduceOp = ReduceOp.SUM,
         divisor: Optional[float] = None,
         wire: Optional[str] = None,  # accepted, ignored (lossless fake)
+        device_pack: Optional[bool] = None,  # accepted, ignored
     ) -> Work:
         """Same lossless semantics as the fake allreduce — wrapper tests
         exercise the plan-path call shape without a ring."""

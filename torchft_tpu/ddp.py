@@ -18,9 +18,22 @@ import os
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
-from .collectives import Work
+# One parser for the TORCHFT_DEVICE_PACK knob across every layer —
+# duplicating the mapping here would let the two layers drift.
+from .collectives import Work, _resolve_device_pack_setting
 from .manager import Manager
 from .train_state import FTTrainState
+
+
+def _device_pack_available() -> bool:
+    """Whether the Pallas wire-compression kernels import here (the
+    capability gate for AdaptiveDDP's device-pack probe candidate)."""
+    try:
+        from .ops import quantize_q8_ef  # noqa: F401
+
+        return True
+    except Exception:  # noqa: BLE001 - any import failure = unavailable
+        return False
 
 
 class DistributedDataParallel:
@@ -119,6 +132,7 @@ class PipelinedDDP:
         grad_fn: Callable[..., Tuple[Any, Any]],
         compress: Optional[str] = None,
         transport: str = "legacy",
+        device_pack: Any = None,
     ) -> None:
         """``transport="plan"`` routes the gradient sync through
         ``Manager.plan_allreduce`` — the persistent native comm plan —
@@ -131,7 +145,16 @@ class PipelinedDDP:
         non-committed step the plan transport RESETS the native EF carry
         (the legacy transport rolls its jax carry back exactly; the
         plan's carry lives native-side, and dropping it only costs
-        signal on the already-discarded step)."""
+        signal on the already-discarded step).
+
+        ``device_pack`` (plan transport only): where the wire encoding
+        runs — ``True``/``"on"`` on the accelerator (Pallas kernels, d2h
+        bytes scale with the wire — the q8 EF carry then lives
+        device-resident and never crosses the link), ``False``/``"off"``
+        on the host, ``None`` (default) / ``"auto"`` the
+        ``TORCHFT_DEVICE_PACK`` env discipline (auto device-packs only
+        on a real device backend; every setting is bit-identical, so
+        members need not agree)."""
         if compress not in (None, "bf16", "int8", "q8"):
             raise ValueError(f"unsupported compress: {compress!r}")
         if transport not in ("legacy", "plan"):
@@ -146,6 +169,7 @@ class PipelinedDDP:
         self._grad_fn = grad_fn
         self._compress_mode = compress
         self._transport = transport
+        self._device_pack = _resolve_device_pack_setting(device_pack)
         self._inflight: Optional[Work] = None
         self._inflight_dtypes: Any = None  # grad dtype TUPLE at dispatch
         #                                    (may change across restores)
@@ -241,7 +265,9 @@ class PipelinedDDP:
             wire = {None: None, "bf16": "bf16", "q8": "q8ef"}[
                 self._compress_mode
             ]
-            return self._manager.plan_allreduce(grads, wire=wire)
+            return self._manager.plan_allreduce(
+                grads, wire=wire, device_pack=self._device_pack
+            )
         payload = self._compress(grads)
         if self._compress_mode == "int8":
             return self._manager.allgather(payload)
@@ -395,6 +421,13 @@ class AdaptiveDDP:
 
     # Probe order. "blocking" first: argmin ties resolve to the lowest
     # index, so equal-measuring candidates fall back to blocking.
+    # "plan_devpack" (the plan transport with the Pallas device-side wire
+    # pack) joins the list only under TORCHFT_DEVICE_PACK=auto with the
+    # kernels importable: the device-pack-vs-host-pack choice then rides
+    # the SAME lockstep-vote argmin as the schedule choice — on hosts
+    # where the interpret-mode kernels are slower than the host pack the
+    # probe measures it and host pack wins (the CPU fallback), on real
+    # device links the d2h saving wins.
     _CANDIDATES = ("blocking", "plan", "pipelined")
 
     # Recorded instead of wall time for a probe step whose transaction
@@ -411,6 +444,7 @@ class AdaptiveDDP:
         compress: Optional[str] = None,
         mode: Optional[str] = None,
         probe_steps: int = 3,
+        device_pack: Any = None,
     ) -> None:
         mode = mode or os.environ.get("TORCHFT_DDP_MODE", "auto")
         if mode not in ("auto", "blocking", "pipelined", "plan"):
@@ -418,10 +452,22 @@ class AdaptiveDDP:
         self._manager = manager
         # One underlying engine; mode switches flip (transport, overlap).
         self._ddp = PipelinedDDP(manager, state, grad_fn, compress)
+        self._devpack_setting = _resolve_device_pack_setting(device_pack)
         self._candidates = [
             c for c in self._CANDIDATES
             if not (c == "plan" and compress == "int8")
         ]
+        if (
+            self._devpack_setting is None  # TORCHFT_DEVICE_PACK=auto
+            and "plan" in self._candidates
+            and _device_pack_available()
+        ):
+            # Probe device pack against host pack with the same lockstep
+            # vote that picks the schedule; "plan" itself pins host pack
+            # while probing, so the two candidates actually contrast.
+            self._candidates.insert(
+                self._candidates.index("plan") + 1, "plan_devpack"
+            )
         if mode == "plan" and compress == "int8":
             raise ValueError("compress='int8' has no plan transport")
         self._probe_steps = max(int(probe_steps), 2)
@@ -453,6 +499,16 @@ class AdaptiveDDP:
         """The locked mode, or None while probing."""
         return self._mode
 
+    def _plan_device_pack(self) -> Optional[bool]:
+        """device_pack for the "plan" candidate: host pack is pinned ONLY
+        while a "plan_devpack" candidate is in the race (the auto probe
+        needs the contrast); otherwise the caller's resolved setting
+        applies — in particular TORCHFT_DEVICE_PACK=on under
+        TORCHFT_DDP_MODE=auto device-packs the plan candidate itself."""
+        if "plan_devpack" in self._candidates:
+            return False
+        return self._devpack_setting
+
     def _run_step(self, mode: str, *batch: Any) -> Any:
         d = self._ddp
         if mode == "pipelined":
@@ -466,7 +522,13 @@ class AdaptiveDDP:
         # Blocking schedule (settle in-step), legacy or plan transport.
         if d._inflight is not None:
             d._settle()  # leaving pipelined mode: drain the overlap
-        d._transport = "plan" if mode == "plan" else "legacy"
+        d._transport = (
+            "plan" if mode in ("plan", "plan_devpack") else "legacy"
+        )
+        if mode == "plan_devpack":
+            d._device_pack = True
+        elif mode == "plan":
+            d._device_pack = self._plan_device_pack()
         self._manager.start_quorum()
         loss, grads = d._grad_fn(d._state.params, *batch)
         d._inflight = d._dispatch(grads)
@@ -492,12 +554,17 @@ class AdaptiveDDP:
             [_candidate_s(t) for t in self._probe_t], np.float64
         )
         gathered = self._manager.allgather({"probe_t": mine}).wait()
-        if self._manager.errored() is not None:
-            # The decision gather itself failed: this member only has its
-            # own timings while the rest share the cohort's — any local
-            # argmin could disagree. Lock the safe default; if it differs
-            # from the cohort's choice, the mismatch errors, reconfigures,
-            # and the quorum-id bump re-probes every member in lockstep.
+        if self._manager.errored() is not None or any(
+            np.asarray(e["probe_t"], np.float64).shape != mine.shape
+            for e in gathered
+        ):
+            # The decision gather failed — OR the cohort's candidate
+            # lists disagree (mismatched TORCHFT_DEVICE_PACK under auto,
+            # or a member without the Pallas kernels: its probe vector
+            # has a different length). Either way no cohort-agreed argmin
+            # exists; lock the safe default. If it differs from another
+            # member's choice, the mismatch errors, reconfigures, and the
+            # quorum-id bump re-probes every member in lockstep.
             total = mine
             best = 0
         else:

@@ -446,6 +446,7 @@ class Manager:
         tree: Any,
         op: ReduceOp = ReduceOp.AVG,
         wire: Optional[str] = None,
+        device_pack: Optional[bool] = None,
     ) -> Work:
         """Fault-tolerantly averages a gradient pytree through a
         persistent precompiled comm plan (one GIL-released native call
@@ -460,7 +461,11 @@ class Manager:
         rebuilt) whenever the quorum changes — configure() drops them
         with the old ring. ``wire``: None | "bf16" | "q8" | "q8ef"
         (native error feedback; reset the carry on heal via
-        :meth:`reset_plan_feedback`)."""
+        :meth:`reset_plan_feedback`). ``device_pack`` forwards to the
+        backend (True/False/None = ``TORCHFT_DEVICE_PACK``): pack the
+        wire encoding on the accelerator so d2h bytes scale with the
+        wire, results bit-identical either way — see
+        Collectives.plan_allreduce."""
         if op not in (ReduceOp.AVG, ReduceOp.SUM):
             # Static usage error: raise eagerly, don't latch.
             raise ValueError(f"unsupported managed plan_allreduce op: {op}")
@@ -473,7 +478,8 @@ class Manager:
             else:
                 divisor = None
             return self._collectives.plan_allreduce(
-                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire
+                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire,
+                device_pack=device_pack,
             )
 
         return self._managed_dispatch(
@@ -482,9 +488,10 @@ class Manager:
 
     def reset_plan_feedback(self) -> None:
         """Zeroes the error-feedback carry of every cached ``q8ef`` comm
-        plan (no-op for backends without plans): the heal/abort
-        discipline — a recovered or rolled-back member must not carry a
-        residual from its abandoned trajectory."""
+        plan — native and device-resident alike (no-op for backends
+        without plans): the heal/abort discipline — a recovered or
+        rolled-back member must not carry a residual from its abandoned
+        trajectory."""
         self._collectives.plan_reset_feedback()
 
     def reduce_scatter(

@@ -11,6 +11,15 @@ one place.
 
 Reference parity: none — the reference ships gradients uncompressed
 (torch DDP's compressed comm hooks are the upstream analog).
+
+Hot-path siblings: the native comm plan executes this same arithmetic in
+C++ (``plan_pack_ef``, collectives.cc), and
+:mod:`torchft_tpu.ops.quantize_kernels` executes it as Pallas kernels ON
+DEVICE with a device-resident carry — so on the plan transport this
+jitted host implementation is off the per-step path entirely (it remains
+the wire contract's executable spec, and the int8 allgather transport
+still runs it). All three are pinned bit-identical to the FMA-free numpy
+oracle in tests/test_comm_plan.py and tests/test_device_pack.py.
 """
 
 from __future__ import annotations
