@@ -1,0 +1,567 @@
+"""Control-plane scale bench: flat vs hierarchical lighthouse under churn.
+
+Drives 1k-10k *simulated* replica groups — lightweight lease clients, no
+training — against the quorum service and measures what the control plane
+does as group count grows two orders of magnitude past a real job's:
+
+- **flat**: every group renews its own lease over its OWN persistent
+  connection straight into one lighthouse (today's per-group heartbeat
+  model: fan-in = G connections, G renewal RPCs per interval).
+- **hier**: groups renew in BATCHES into region lighthouses
+  (``TORCHFT_LEASE_RENEW_BATCH`` entries per frame) which aggregate into
+  the root via digests (fan-in at the root = 2 connections per region).
+
+Churn: every settled quorum, one random group is killed (silent lease
+expiry — the worst case; explicit departs are cheap) and the bench records
+**quorum convergence**: kill -> first observed quorum that excludes the
+dead group. Hier phases also kill a region lighthouse: its groups demote
+to direct-root renewal (the same failover managers run) and the bench
+records the failover window + whether any membership flapped.
+
+Observation rides the lighthouse's machine-readable ``/status.json``
+(torchft_tpu.lighthouse.fetch_status) — members, lease deadlines, quorum,
+root tick cost, open connections — never the HTML dashboard.
+
+Output: ``LIGHTHOUSE_BENCH.json`` with per-scale flat/hier convergence
+p50/p99, heartbeat fan-in, renewal RPC counts and root CPU per tick.
+``--dryrun`` runs a seconds-scale version (small group count, one group
+kill + one region kill) and asserts a convergence record and a
+region-failover record exist — the CI smoke.
+
+Usage::
+
+    python bench_lighthouse.py                     # full run, writes artifact
+    python bench_lighthouse.py --scales 1000,4000 --regions 8
+    python bench_lighthouse.py --dryrun            # CI smoke, no artifact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+from datetime import timedelta
+from typing import Dict, List, Optional
+
+from torchft_tpu import _native
+from torchft_tpu.lighthouse import fetch_status
+
+
+def member(replica_id: str, step: int = 1) -> dict:
+    return {
+        "replica_id": replica_id,
+        "address": f"addr_{replica_id}",
+        "store_address": f"store_{replica_id}",
+        "step": step,
+        "world_size": 1,
+        "shrink_only": False,
+        "force_reconfigure": False,
+    }
+
+
+def entry(replica_id: str, ttl_ms: int) -> dict:
+    return {
+        "replica_id": replica_id,
+        "ttl_ms": ttl_ms,
+        "participating": True,
+        "member": member(replica_id),
+    }
+
+
+def percentile(values: List[float], p: float) -> Optional[float]:
+    if not values:
+        return None
+    xs = sorted(values)
+    i = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[i]
+
+
+class Phase:
+    """One (mode, scale) run: renewal drivers + status watcher + churn."""
+
+    def __init__(
+        self,
+        mode: str,
+        n_groups: int,
+        n_regions: int,
+        ttl_ms: int,
+        renew_interval_ms: int,
+        batch: int,
+        threads: int = 4,
+    ) -> None:
+        assert mode in ("flat", "hier")
+        self.mode = mode
+        self.n_groups = n_groups
+        self.ttl_ms = ttl_ms
+        self.renew_interval_ms = renew_interval_ms
+        self.batch = batch
+        self.threads = threads
+
+        self.root = _native.Lighthouse(
+            bind="[::]:0",
+            min_replicas=1,
+            join_timeout_ms=1000,
+            quorum_tick_ms=50,
+            heartbeat_timeout_ms=ttl_ms,
+        )
+        self.root_addr = self.root.address()
+        self.regions: List[Optional[_native.RegionLighthouse]] = []
+        self.region_dead: List[bool] = []
+        if mode == "hier":
+            for i in range(n_regions):
+                self.regions.append(
+                    _native.RegionLighthouse(
+                        self.root_addr,
+                        f"region_{i}",
+                        digest_interval_ms=max(50, renew_interval_ms // 4),
+                        heartbeat_timeout_ms=ttl_ms,
+                    )
+                )
+                self.region_dead.append(False)
+
+        self.groups = [f"g{i:05d}" for i in range(n_groups)]
+        self.region_of = {g: i % max(1, len(self.regions)) for i, g in
+                          enumerate(self.groups)}
+        self.lock = threading.Lock()
+        self.alive = set(self.groups)
+        self.stop = threading.Event()
+        self.renew_rpcs = 0
+        self.renew_errors = 0
+        self.samples: List[dict] = []  # watcher snapshots
+        self._threads: List[threading.Thread] = []
+
+    # -- renewal drivers --------------------------------------------------
+
+    def _flat_driver(self, slice_groups: List[str], stagger_s: float) -> None:
+        clients: Dict[str, _native.LeaseClient] = {}
+        time.sleep(stagger_s)
+        while not self.stop.is_set():
+            t0 = time.monotonic()
+            for g in slice_groups:
+                if self.stop.is_set():
+                    return
+                with self.lock:
+                    if g not in self.alive:
+                        clients.pop(g, None)
+                        continue
+                try:
+                    # one connection PER GROUP — the per-group heartbeat
+                    # fan-in this mode exists to measure
+                    if g not in clients:
+                        clients[g] = _native.LeaseClient(
+                            self.root_addr, connect_timeout=timedelta(seconds=5)
+                        )
+                    clients[g].renew(
+                        [entry(g, self.ttl_ms)], timeout=timedelta(seconds=5)
+                    )
+                    with self.lock:
+                        self.renew_rpcs += 1
+                except Exception:  # noqa: BLE001
+                    clients.pop(g, None)
+                    with self.lock:
+                        self.renew_errors += 1
+            elapsed = time.monotonic() - t0
+            self.stop.wait(max(0.0, self.renew_interval_ms / 1000.0 - elapsed))
+
+    def _hier_driver(self, slice_groups: List[str], stagger_s: float) -> None:
+        region_clients: Dict[int, _native.LeaseClient] = {}
+        root_client: Optional[_native.LeaseClient] = None
+        time.sleep(stagger_s)
+        while not self.stop.is_set():
+            t0 = time.monotonic()
+            # bucket this slice's live groups by (current) target
+            by_target: Dict[int, List[str]] = {}
+            with self.lock:
+                for g in slice_groups:
+                    if g not in self.alive:
+                        continue
+                    r = self.region_of[g]
+                    by_target.setdefault(-1 if self.region_dead[r] else r,
+                                         []).append(g)
+            for target, gs in by_target.items():
+                for i in range(0, len(gs), self.batch):
+                    if self.stop.is_set():
+                        return
+                    chunk = [entry(g, self.ttl_ms) for g in gs[i:i + self.batch]]
+                    try:
+                        if target < 0:
+                            # demoted: direct-root registration (batched at
+                            # host granularity, same as the region batched)
+                            if root_client is None:
+                                root_client = _native.LeaseClient(
+                                    self.root_addr,
+                                    connect_timeout=timedelta(seconds=5),
+                                )
+                            root_client.renew(chunk, timeout=timedelta(seconds=5))
+                        else:
+                            if target not in region_clients:
+                                region_clients[target] = _native.LeaseClient(
+                                    self.regions[target].address(),  # type: ignore[union-attr]
+                                    connect_timeout=timedelta(seconds=5),
+                                )
+                            region_clients[target].renew(
+                                chunk, timeout=timedelta(seconds=5)
+                            )
+                        with self.lock:
+                            self.renew_rpcs += 1
+                    except Exception:  # noqa: BLE001
+                        with self.lock:
+                            self.renew_errors += 1
+                        if target >= 0:
+                            region_clients.pop(target, None)
+                            # region presumed dead: demote its groups until
+                            # it is revived (manager-failover semantics),
+                            # and retry THIS chunk at the root right away —
+                            # the manager's own failover re-registers within
+                            # a couple of heartbeat intervals, not a full
+                            # lease interval later
+                            with self.lock:
+                                self.region_dead[target] = True
+                            try:
+                                if root_client is None:
+                                    root_client = _native.LeaseClient(
+                                        self.root_addr,
+                                        connect_timeout=timedelta(seconds=5),
+                                    )
+                                root_client.renew(
+                                    chunk, timeout=timedelta(seconds=5)
+                                )
+                                with self.lock:
+                                    self.renew_rpcs += 1
+                            except Exception:  # noqa: BLE001
+                                with self.lock:
+                                    self.renew_errors += 1
+            elapsed = time.monotonic() - t0
+            self.stop.wait(max(0.0, self.renew_interval_ms / 1000.0 - elapsed))
+
+    def _watcher(self) -> None:
+        while not self.stop.is_set():
+            try:
+                st = fetch_status(self.root_addr, timeout=5.0)
+                q = st.get("quorum") or {}
+                self.samples.append(
+                    {
+                        "t": time.monotonic(),
+                        "quorum_id": st.get("quorum_id", 0),
+                        "participants": sorted(
+                            m["replica_id"] for m in q.get("participants", [])
+                        ),
+                        "members": {
+                            m["replica_id"]: m["lease_remaining_ms"]
+                            for m in st.get("members", [])
+                        },
+                        "open_conns": st.get("open_conns", 0),
+                        "tick": st.get("tick", {}),
+                    }
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            self.stop.wait(0.05)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        driver = self._flat_driver if self.mode == "flat" else self._hier_driver
+        per = max(1, (len(self.groups) + self.threads - 1) // self.threads)
+        for i in range(self.threads):
+            sl = self.groups[i * per:(i + 1) * per]
+            if not sl:
+                continue
+            t = threading.Thread(
+                target=driver,
+                args=(sl, i * self.renew_interval_ms / 1000.0 / self.threads),
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        w = threading.Thread(target=self._watcher, daemon=True)
+        w.start()
+        self._threads.append(w)
+
+    def shutdown(self) -> None:
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        for r in self.regions:
+            if r is not None:
+                r.shutdown()
+        self.root.shutdown()
+
+    # -- observation helpers ----------------------------------------------
+
+    def latest(self) -> Optional[dict]:
+        return self.samples[-1] if self.samples else None
+
+    def wait_for(self, pred, deadline_s: float) -> Optional[dict]:
+        """First watcher sample taken from NOW on satisfying pred (stale
+        samples must not satisfy a churn probe), or None on timeout."""
+        start = time.monotonic()
+        deadline = start + deadline_s
+        n = len(self.samples)
+        while time.monotonic() < deadline:
+            samples = self.samples
+            while n < len(samples):
+                s = samples[n]
+                n += 1
+                if s["t"] >= start and pred(s):
+                    return s
+            time.sleep(0.02)
+        return None
+
+    def wait_full_quorum(self, deadline_s: float) -> Optional[dict]:
+        with self.lock:
+            want = set(self.alive)
+        return self.wait_for(
+            lambda s: set(s["participants"]) == want, deadline_s
+        )
+
+    # -- churn ------------------------------------------------------------
+
+    def kill_group(self, rng: random.Random, deadline_s: float) -> Optional[float]:
+        """Silent-kills one group; returns convergence seconds or None."""
+        with self.lock:
+            victim = rng.choice(sorted(self.alive))
+            self.alive.discard(victim)
+        t0 = time.monotonic()
+        base = self.latest()
+        base_id = base["quorum_id"] if base else 0
+        s = self.wait_for(
+            lambda s: s["quorum_id"] > base_id
+            and victim not in s["participants"]
+            and s["participants"],
+            deadline_s,
+        )
+        conv = None if s is None else s["t"] - t0
+        # revive under the same id (constant scale) and wait to settle
+        with self.lock:
+            self.alive.add(victim)
+        self.wait_full_quorum(deadline_s)
+        return conv
+
+    def kill_region(self, idx: int, deadline_s: float) -> Optional[dict]:
+        """Kills a region lighthouse; returns a failover record or None.
+
+        Failover is complete when every one of the region's groups has a
+        FRESH direct-root lease (renewed after the kill). Membership flaps
+        (a lease expiring mid-failover) are recorded honestly.
+        """
+        region = self.regions[idx]
+        assert region is not None
+        affected = [g for g in self.groups if self.region_of[g] == idx]
+        t0 = time.monotonic()
+        base = self.latest()
+        base_id = base["quorum_id"] if base else 0
+        region.shutdown()
+        # drivers discover the death on their next renewal and demote
+
+        def recovered(s: dict) -> bool:
+            # A lease renewed at t_r shows remaining = ttl - (t_sample-t_r);
+            # requiring remaining > ttl - (t_sample - t_kill) + margin means
+            # t_r is provably AFTER the kill — i.e. the group's renewals are
+            # flowing over the direct-root path, not riding a stale lease.
+            elapsed_ms = (s["t"] - t0) * 1000.0
+            need = self.ttl_ms - elapsed_ms + 100.0
+            return all(s["members"].get(g, -1) > need for g in affected)
+
+        s = self.wait_for(recovered, deadline_s)
+        rec = None
+        if s is not None:
+            latest = self.latest() or s
+            rec = {
+                "region": idx,
+                "groups": len(affected),
+                "failover_s": s["t"] - t0,
+                # quorum_id moved iff some lease expired mid-failover
+                "membership_flapped": latest["quorum_id"] > base_id,
+            }
+        # revive: fresh region on a fresh port; drivers route back
+        self.regions[idx] = _native.RegionLighthouse(
+            self.root_addr,
+            f"region_{idx}",
+            digest_interval_ms=max(50, self.renew_interval_ms // 4),
+            heartbeat_timeout_ms=self.ttl_ms,
+        )
+        with self.lock:
+            self.region_dead[idx] = False
+        self.wait_full_quorum(deadline_s)
+        return rec
+
+
+def run_phase(
+    mode: str,
+    n_groups: int,
+    args: argparse.Namespace,
+    rng: random.Random,
+) -> dict:
+    phase = Phase(
+        mode,
+        n_groups,
+        args.regions,
+        args.ttl_ms,
+        args.renew_interval_ms,
+        args.batch,
+        threads=args.threads,
+    )
+    out: dict = {
+        "mode": mode,
+        "groups": n_groups,
+        "regions": args.regions if mode == "hier" else 0,
+        "converged": False,
+        "convergence_s": [],
+        "region_failovers": [],
+    }
+    deadline = max(30.0, 3 * args.ttl_ms / 1000.0 + 0.002 * n_groups)
+    try:
+        phase.start()
+        t_warm = time.monotonic()
+        warm = phase.wait_full_quorum(deadline_s=4 * deadline)
+        if warm is None:
+            # the scale this mode cannot sustain — itself a result; keep
+            # the load metrics as evidence of WHERE it collapsed
+            out["error"] = "never reached a full quorum (warmup)"
+            tail = phase.samples[-20:]
+            if tail:
+                out["fan_in_conns"] = max(s["open_conns"] for s in tail)
+                out["max_participants_seen"] = max(
+                    len(s["participants"]) for s in phase.samples
+                )
+                out["members_alive_last"] = sum(
+                    1 for v in tail[-1]["members"].values() if v > 0
+                )
+            with phase.lock:
+                out["renew_rpcs"] = phase.renew_rpcs
+                out["renew_errors"] = phase.renew_errors
+            return out
+        out["converged"] = True
+        out["warmup_s"] = round(warm["t"] - t_warm, 3)
+
+        for _ in range(args.kills):
+            conv = phase.kill_group(rng, deadline_s=2 * deadline)
+            if conv is not None:
+                out["convergence_s"].append(round(conv, 3))
+        if mode == "hier":
+            for _ in range(args.region_kills):
+                rec = phase.kill_region(
+                    rng.randrange(args.regions), deadline_s=2 * deadline
+                )
+                if rec is not None:
+                    rec["failover_s"] = round(rec["failover_s"], 3)
+                    out["region_failovers"].append(rec)
+
+        # steady-state + load metrics off the watcher tail
+        tail = phase.samples[-20:]
+        out["fan_in_conns"] = max(s["open_conns"] for s in tail)
+        ticks = [s["tick"] for s in tail if s["tick"]]
+        if ticks:
+            t0, t1 = ticks[0], ticks[-1]
+            computed = t1.get("computed", 0) - t0.get("computed", 0)
+            us = t1.get("total_compute_us", 0) - t0.get("total_compute_us", 0)
+            out["tick"] = {
+                "computed_per_s": round(
+                    computed / max(1e-9, tail[-1]["t"] - tail[0]["t"]), 2
+                ),
+                "mean_compute_us": round(us / computed, 1) if computed else 0.0,
+                "last_compute_us": t1.get("last_compute_us", 0),
+            }
+        with phase.lock:
+            out["renew_rpcs"] = phase.renew_rpcs
+            out["renew_errors"] = phase.renew_errors
+        cs = out["convergence_s"]
+        out["convergence_p50_s"] = percentile(cs, 50)
+        out["convergence_p99_s"] = percentile(cs, 99)
+    finally:
+        phase.shutdown()
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("--scales", default="1000,2000",
+                   help="comma-separated simulated group counts")
+    p.add_argument("--regions", type=int, default=8)
+    p.add_argument("--ttl-ms", type=int, default=3000)
+    p.add_argument("--renew-interval-ms", type=int, default=1000)
+    p.add_argument(
+        "--batch",
+        type=int,
+        default=int(os.environ.get("TORCHFT_LEASE_RENEW_BATCH", "64")),
+        help="lease entries per renewal frame in hier mode "
+        "(env TORCHFT_LEASE_RENEW_BATCH)",
+    )
+    p.add_argument("--threads", type=int, default=4)
+    p.add_argument("--kills", type=int, default=6)
+    p.add_argument("--region-kills", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="LIGHTHOUSE_BENCH.json")
+    p.add_argument(
+        "--dryrun",
+        action="store_true",
+        help="seconds-scale smoke: small group count, one group kill + one "
+        "region kill, asserts convergence + region-failover records, "
+        "writes NO artifact",
+    )
+    args = p.parse_args(argv)
+
+    if args.dryrun:
+        args.scales = "40"
+        args.regions = 2
+        args.ttl_ms = 1200
+        args.renew_interval_ms = 300
+        args.kills = 1
+        args.region_kills = 1
+        args.threads = 2
+
+    rng = random.Random(args.seed)
+    scales = [int(s) for s in args.scales.split(",") if s]
+    result = {
+        "bench": "lighthouse",
+        "host": {"cpus": os.cpu_count()},
+        "config": {
+            "regions": args.regions,
+            "ttl_ms": args.ttl_ms,
+            "renew_interval_ms": args.renew_interval_ms,
+            "batch": args.batch,
+            "kills": args.kills,
+            "region_kills": args.region_kills,
+            "threads": args.threads,
+            "seed": args.seed,
+        },
+        "scales": [],
+    }
+
+    for n in scales:
+        row: dict = {"groups": n}
+        for mode in ("flat", "hier"):
+            print(f"=== {mode} @ {n} groups ===", flush=True)
+            row[mode] = run_phase(mode, n, args, rng)
+            print(json.dumps(row[mode]), flush=True)
+        f, h = row["flat"], row["hier"]
+        if f.get("convergence_p99_s") is not None and h.get(
+            "convergence_p99_s"
+        ) is not None:
+            row["hier_p99_not_worse"] = (
+                h["convergence_p99_s"]
+                <= f["convergence_p99_s"] + 0.25 * f["convergence_p99_s"] + 0.2
+            )
+        result["scales"].append(row)
+
+    if args.dryrun:
+        row = result["scales"][0]
+        assert row["flat"]["convergence_s"], "no flat convergence record"
+        assert row["hier"]["convergence_s"], "no hier convergence record"
+        assert row["hier"]["region_failovers"], "no region-failover record"
+        print("dryrun OK: convergence + region-failover records present")
+        return 0
+
+    with open(args.out, "w") as fp:
+        json.dump(result, fp, indent=2, sort_keys=True)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

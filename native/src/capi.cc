@@ -12,6 +12,7 @@
 #include "manager.h"
 #include "net.h"
 #include "quorum.h"
+#include "region.h"
 #include "store.h"
 #include "wire.h"
 
@@ -110,18 +111,105 @@ int tft_lighthouse_heartbeat(const char* addr, const char* replica_id,
   });
 }
 
+int tft_lighthouse_status_json(void* handle, char** out) {
+  return guarded(
+      [&] { *out = dup_string(static_cast<Lighthouse*>(handle)->status_json()); });
+}
+
+// ---- RegionLighthouse ----
+
+void* tft_region_create(const char* bind, const char* root_addr,
+                        const char* region_id, int64_t digest_interval_ms,
+                        int64_t heartbeat_timeout_ms, int64_t connect_timeout_ms) {
+  RegionLighthouse* r = nullptr;
+  int rc = guarded([&] {
+    RegionOpt opt;
+    if (digest_interval_ms > 0) opt.digest_interval_ms = digest_interval_ms;
+    if (heartbeat_timeout_ms > 0) opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    if (connect_timeout_ms > 0) opt.connect_timeout_ms = connect_timeout_ms;
+    r = new RegionLighthouse(bind, root_addr, region_id, opt);
+  });
+  return rc == kOk ? r : nullptr;
+}
+
+char* tft_region_address(void* handle) {
+  return dup_string(static_cast<RegionLighthouse*>(handle)->address());
+}
+
+void tft_region_shutdown(void* handle) {
+  static_cast<RegionLighthouse*>(handle)->shutdown();
+}
+
+void tft_region_destroy(void* handle) {
+  delete static_cast<RegionLighthouse*>(handle);
+}
+
+int tft_region_status_json(void* handle, char** out) {
+  return guarded([&] {
+    *out = dup_string(static_cast<RegionLighthouse*>(handle)->status_json());
+  });
+}
+
+// ---- LeaseClient (persistent lighthouse-protocol client) ----
+
+// A LighthouseClient handle for batch lease renewal / heartbeat / depart
+// over ONE persistent connection — the wire surface bench_lighthouse's
+// simulated groups and host-level renewal batchers ride.
+
+void* tft_lease_client_create(const char* addr, int64_t connect_timeout_ms) {
+  return new LighthouseClient(addr, connect_timeout_ms);
+}
+
+void tft_lease_client_destroy(void* handle) {
+  delete static_cast<LighthouseClient*>(handle);
+}
+
+// entries_json: [{replica_id, ttl_ms, participating, member: {...}}, ...].
+// Writes the lighthouse's current quorum_id to *quorum_id_out.
+int tft_lease_client_renew(void* handle, const char* entries_json,
+                           int64_t timeout_ms, int64_t* quorum_id_out) {
+  return guarded([&] {
+    std::vector<LeaseEntry> entries =
+        lease_entries_from_json(Json::parse(entries_json));
+    *quorum_id_out =
+        static_cast<LighthouseClient*>(handle)->lease_renew(entries, timeout_ms);
+  });
+}
+
+int tft_lease_client_heartbeat(void* handle, const char* replica_id,
+                               int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<LighthouseClient*>(handle)->heartbeat(replica_id, timeout_ms);
+  });
+}
+
+int tft_lease_client_depart(void* handle, const char* replica_id,
+                            int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<LighthouseClient*>(handle)->depart(replica_id, timeout_ms);
+  });
+}
+
 // ---- ManagerServer ----
 
 void* tft_manager_create(const char* replica_id, const char* lighthouse_addr,
                          const char* hostname, const char* bind,
                          const char* store_addr, uint64_t world_size,
-                         int64_t heartbeat_interval_ms, int64_t connect_timeout_ms) {
+                         int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
+                         const char* root_addr, int64_t lease_ttl_ms) {
   ManagerServer* m = nullptr;
   int rc = guarded([&] {
     m = new ManagerServer(replica_id, lighthouse_addr, hostname, bind, store_addr,
-                          world_size, heartbeat_interval_ms, connect_timeout_ms);
+                          world_size, heartbeat_interval_ms, connect_timeout_ms,
+                          root_addr ? root_addr : "", lease_ttl_ms);
   });
   return rc == kOk ? m : nullptr;
+}
+
+// Whether the manager is currently demoted to direct-root registration
+// (region failover active).
+int tft_manager_using_root(void* handle) {
+  return static_cast<ManagerServer*>(handle)->using_root_fallback() ? 1 : 0;
 }
 
 char* tft_manager_address(void* handle) {
@@ -466,6 +554,81 @@ int tft_compute_quorum_results(const char* replica_id, int64_t rank,
     auto resp = compute_quorum_results(replica_id, rank, quorum);
     *result_json = dup_string(quorum_response_to_json(resp).dump());
   });
+}
+
+// One full quorum tick as a pure state transition (the exact function both
+// the flat lighthouse and the hierarchical root run per tick). Returns
+// {"state": ..., "quorum": {...}|null, "changed": bool, "reason": str} —
+// the entry point of the flat-vs-hierarchical equivalence property suite.
+int tft_quorum_step(int64_t now, int64_t unix_now, const char* state_json,
+                    const char* opt_json, char** result_json) {
+  return guarded([&] {
+    LighthouseState state = lighthouse_state_from_json(Json::parse(state_json));
+    LighthouseOpt opt = lighthouse_opt_from_json(Json::parse(opt_json));
+    QuorumStepResult res = quorum_step(now, unix_now, state, opt);
+    JsonObject out;
+    out["state"] = lighthouse_state_to_json(state);
+    out["quorum"] = res.quorum.has_value() ? quorum_to_json(*res.quorum) : Json();
+    out["changed"] = res.changed;
+    out["reason"] = res.reason;
+    *result_json = dup_string(Json(std::move(out)).dump());
+  });
+}
+
+// Applies a batched lease renewal to a state; returns the new state JSON.
+int tft_lease_apply(const char* state_json, const char* entries_json, int64_t now,
+                    char** result_json) {
+  return guarded([&] {
+    LighthouseState state = lighthouse_state_from_json(Json::parse(state_json));
+    apply_lease_batch(state, lease_entries_from_json(Json::parse(entries_json)),
+                      now);
+    *result_json = dup_string(lighthouse_state_to_json(state).dump());
+  });
+}
+
+// Explicit depart; returns the new state JSON.
+int tft_depart_apply(const char* state_json, const char* replica_id,
+                     char** result_json) {
+  return guarded([&] {
+    LighthouseState state = lighthouse_state_from_json(Json::parse(state_json));
+    apply_depart(state, replica_id);
+    *result_json = dup_string(lighthouse_state_to_json(state).dump());
+  });
+}
+
+// Region side of the digest protocol: compresses a region state to
+// age-relative entries at `now` on the region clock.
+int tft_digest_make(const char* state_json, int64_t now, const char* opt_json,
+                    char** result_json) {
+  return guarded([&] {
+    LighthouseState state = lighthouse_state_from_json(Json::parse(state_json));
+    LighthouseOpt opt = lighthouse_opt_from_json(Json::parse(opt_json));
+    *result_json = dup_string(digest_to_json(make_digest(state, now, opt)).dump());
+  });
+}
+
+// Root side: merges a digest into a state at `now` on the root clock;
+// returns the new state JSON.
+int tft_digest_apply(const char* state_json, const char* digest_json, int64_t now,
+                     char** result_json) {
+  return guarded([&] {
+    LighthouseState state = lighthouse_state_from_json(Json::parse(state_json));
+    apply_digest(state, digest_from_json(Json::parse(digest_json)), now);
+    *result_json = dup_string(lighthouse_state_to_json(state).dump());
+  });
+}
+
+// Deterministic jittered exponential backoff schedule (the manager renewal
+// loop's retry delays), exposed for the backoff-schedule unit tests.
+int64_t tft_backoff_ms(int failures, int64_t base_ms, int64_t max_ms,
+                       uint64_t seed) {
+  return backoff_ms(failures, base_ms, max_ms, seed);
+}
+
+// Deterministic jittered renewal interval (the healthy-path herd spread).
+int64_t tft_jittered_interval_ms(int64_t interval_ms, uint64_t seed,
+                                 uint64_t tick) {
+  return jittered_interval_ms(interval_ms, seed, tick);
 }
 
 } // extern "C"

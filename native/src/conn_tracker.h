@@ -37,6 +37,13 @@ class ConnTracker {
     return true;
   }
 
+  // Currently-open handler count (the control-plane fan-in metric the
+  // lighthouse status view reports).
+  size_t size() {
+    MutexLock lock(mu_);
+    return active_;
+  }
+
   // Wakes all handlers blocked in socket IO and waits until every handler
   // thread has finished. Callers must first unblock handlers waiting on
   // their own condition variables.

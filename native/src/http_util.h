@@ -1,0 +1,65 @@
+// Minimal HTTP/1.1 response + escaping helpers shared by the dashboard
+// endpoints of the flat/root lighthouse and the region tier. The servers
+// sniff HTTP apart from protocol frames on one port (see lighthouse.cc
+// handle_conn); everything here is response-side only.
+#pragma once
+
+#include <cstring>
+#include <sstream>
+#include <string>
+
+#include "net.h"
+
+namespace tft {
+
+// Sniffs whether the connection opens with an HTTP request (ASCII method)
+// instead of a protocol frame (whose first byte is the high byte of a u32
+// length — 0 for any sane payload). If HTTP, consumes the request head
+// through the blank line (64 KiB cap) into `head` and returns true; the
+// caller serves HTTP. Otherwise leaves the stream untouched (peek only).
+inline bool sniff_http(Socket& sock, std::string& head) {
+  char probe[4] = {0};
+  size_t n = sock.peek(probe, sizeof(probe));
+  if (n < 3 ||
+      (memcmp(probe, "GET", 3) != 0 && memcmp(probe, "POS", 3) != 0)) {
+    return false;
+  }
+  char buf[1024];
+  while (head.find("\r\n\r\n") == std::string::npos) {
+    size_t got = sock.peek(buf, sizeof(buf));
+    sock.recv_all(buf, got);
+    head.append(buf, got);
+    if (head.size() > 64 * 1024) break;
+  }
+  return true;
+}
+
+inline void http_respond(Socket& sock, int code, const std::string& content_type,
+                         const std::string& body) {
+  std::ostringstream os;
+  const char* reason = code == 200 ? "OK" : (code == 404 ? "Not Found" : "Error");
+  os << "HTTP/1.1 " << code << " " << reason << "\r\n"
+     << "Content-Type: " << content_type << "\r\n"
+     << "Content-Length: " << body.size() << "\r\n"
+     << "Connection: close\r\n\r\n"
+     << body;
+  std::string out = os.str();
+  sock.send_all(out.data(), out.size());
+}
+
+inline std::string html_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '<': out += "&lt;"; break;
+      case '>': out += "&gt;"; break;
+      case '&': out += "&amp;"; break;
+      case '"': out += "&quot;"; break;
+      case '\'': out += "&#39;"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+} // namespace tft

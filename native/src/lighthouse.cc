@@ -2,8 +2,10 @@
 
 #include <sys/socket.h>
 
+#include <chrono>
 #include <sstream>
 
+#include "http_util.h"
 #include "log.h"
 #include "manager.h"
 #include "wire.h"
@@ -66,41 +68,38 @@ void Lighthouse::tick_loop() {
 }
 
 void Lighthouse::quorum_tick_locked() {
-  auto [quorum_met, reason] = quorum_compute(now_ms(), state_, opt_);
-  LOG_DEBUG("Next quorum status: " << reason);
+  ticks_total_ += 1;
+  // Idle skip: with no registered participant no quorum can form (a lease
+  // expiring can only shrink the healthy set), so the O(groups) membership
+  // scan is pure waste. This is what keeps root CPU flat between quorum
+  // rounds at thousands-of-groups scale.
+  if (state_.participants.empty() && opt_.min_replicas > 0) return;
 
-  if (!quorum_met.has_value()) return;
-  std::vector<QuorumMember>& participants = *quorum_met;
+  auto t0 = std::chrono::steady_clock::now();
+  QuorumStepResult res = quorum_step(now_ms(), unix_ms(), state_, opt_);
+  last_compute_us_ = std::chrono::duration_cast<std::chrono::microseconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count();
+  ticks_computed_ += 1;
+  total_compute_us_ += last_compute_us_;
+  LOG_DEBUG("Next quorum status: " << res.reason);
 
-  bool changed = !state_.prev_quorum.has_value();
-  if (!changed) {
-    std::vector<QuorumMember> prev(state_.prev_quorum->participants().begin(),
-                                   state_.prev_quorum->participants().end());
-    changed = quorum_changed(participants, prev);
-  }
-  // A member with a failed data plane needs everyone to rebuild on a fresh
-  // rendezvous namespace, which only a quorum_id bump triggers.
-  for (const auto& p : participants) {
-    if (p.force_reconfigure()) {
-      changed = true;
-      LOG_INFO("Member " << p.replica_id() << " requested reconfigure");
-      break;
-    }
-  }
-  if (changed) {
-    state_.quorum_id += 1;
-    state_.quorum_formed_ms = now_ms();
+  if (!res.quorum.has_value()) return;
+  const Quorum& quorum = *res.quorum;
+
+  if (res.changed) {
     LOG_INFO("Detected quorum change, bumping quorum_id to " << state_.quorum_id);
 
     // Event log entry: membership + who is healing (step behind max).
     int64_t max_step = -1;
-    for (const auto& p : participants) max_step = std::max(max_step, p.step());
+    for (const auto& p : quorum.participants())
+      max_step = std::max(max_step, p.step());
     std::ostringstream ev;
     ev << "[" << format_unix_ms(unix_ms()) << "] quorum " << state_.quorum_id
-       << ": " << participants.size() << " member"
-       << (participants.size() == 1 ? "" : "s");
+       << ": " << quorum.participants_size() << " member"
+       << (quorum.participants_size() == 1 ? "" : "s");
     std::string healing;
-    for (const auto& p : participants) {
+    for (const auto& p : quorum.participants()) {
       if (p.step() != max_step) {
         if (!healing.empty()) healing += ", ";
         healing += p.replica_id();
@@ -112,37 +111,18 @@ void Lighthouse::quorum_tick_locked() {
     while (state_.events.size() > 20) state_.events.pop_back();
   }
 
-  Quorum quorum;
-  quorum.set_quorum_id(state_.quorum_id);
-  for (auto& p : participants) *quorum.add_participants() = std::move(p);
-  quorum.set_created_ms(unix_ms());
-
   LOG_INFO("Quorum! id=" << quorum.quorum_id()
                          << " participants=" << quorum.participants_size());
 
-  state_.prev_quorum = quorum;
-  state_.participants.clear();
-  latest_quorum_ = std::move(quorum);
+  latest_quorum_ = quorum;
   quorum_gen_ += 1;
   quorum_cv_.notify_all();
 }
 
 void Lighthouse::handle_conn(Socket& sock) {
   try {
-    // Sniff: HTTP dashboards start with an ASCII method; protocol frames start
-    // with a u32 length whose first byte is 0 for any sane payload size.
-    char head[4] = {0};
-    size_t n = sock.peek(head, sizeof(head));
-    if (n >= 3 && (memcmp(head, "GET", 3) == 0 || memcmp(head, "POS", 3) == 0)) {
-      std::string req_head;
-      char buf[1024];
-      // Read until end of headers.
-      while (req_head.find("\r\n\r\n") == std::string::npos) {
-        size_t got = sock.peek(buf, sizeof(buf));
-        sock.recv_all(buf, got);
-        req_head.append(buf, got);
-        if (req_head.size() > 64 * 1024) break;
-      }
+    std::string req_head;
+    if (sniff_http(sock, req_head)) {
       handle_http(sock, req_head);
       return;
     }
@@ -164,6 +144,18 @@ void Lighthouse::handle_conn(Socket& sock) {
                    torchft_tpu::LighthouseHeartbeatResponse());
           break;
         }
+        case MsgType::kLeaseRenewReq:
+          handle_lease_renew(sock, payload);
+          break;
+        case MsgType::kDepartReq:
+          handle_depart(sock, payload);
+          break;
+        case MsgType::kRegionDigestReq:
+          handle_region_digest(sock, payload);
+          break;
+        case MsgType::kRegionPollReq:
+          handle_region_poll(sock, payload);
+          break;
         default:
           send_error(sock, ErrorResponse::INVALID_ARGUMENT,
                      "unexpected message type");
@@ -239,6 +231,102 @@ void Lighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
   }
 }
 
+void Lighthouse::handle_lease_renew(Socket& sock, const std::string& payload) {
+  torchft_tpu::LeaseRenewRequest req;
+  if (!req.ParseFromString(payload)) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "bad lease renew request");
+    return;
+  }
+  std::vector<LeaseEntry> entries = lease_entries_from_pb(req);
+  torchft_tpu::LeaseRenewResponse resp;
+  {
+    MutexLock lock(mu_);
+    // A NEW registration is quorum intent worth resolving eagerly, the way
+    // a long-poll join does. Re-renewals of existing participants change
+    // nothing the periodic tick won't see — ticking for those would be
+    // O(groups) per renewal, O(groups^2)/interval aggregate while a join
+    // window holds the quorum open.
+    if (apply_lease_batch(state_, entries, now_ms())) quorum_tick_locked();
+    resp.set_quorum_id(state_.quorum_id);
+  }
+  send_msg(sock, MsgType::kLeaseRenewResp, resp);
+}
+
+void Lighthouse::handle_depart(Socket& sock, const std::string& payload) {
+  torchft_tpu::DepartRequest req;
+  if (!req.ParseFromString(payload) || req.replica_id().empty()) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "missing replica_id");
+    return;
+  }
+  {
+    MutexLock lock(mu_);
+    apply_depart(state_, req.replica_id());
+    // An explicit depart may complete a pending quorum (the departed member
+    // no longer counts against the straggler hold-the-door wait).
+    quorum_tick_locked();
+  }
+  LOG_INFO("replica " << req.replica_id() << " departed");
+  send_msg(sock, MsgType::kDepartResp, torchft_tpu::DepartResponse());
+}
+
+void Lighthouse::handle_region_digest(Socket& sock, const std::string& payload) {
+  torchft_tpu::RegionDigestRequest req;
+  if (!req.ParseFromString(payload) || req.region_id().empty()) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "missing region_id");
+    return;
+  }
+  std::vector<DigestEntry> entries = digest_from_pb(req);
+  torchft_tpu::RegionDigestResponse resp;
+  {
+    MutexLock lock(mu_);
+    // Departs FIRST: a re-queued depart (failed push) may be older than a
+    // rejoin carried in this digest's entries — entries must win.
+    for (const auto& d : req.departed()) apply_depart(state_, d);
+    apply_digest(state_, entries, now_ms());
+    regions_[req.region_id()] =
+        RegionInfo{now_ms(), static_cast<int64_t>(entries.size())};
+    // A digest can both register participants and remove stragglers.
+    quorum_tick_locked();
+    resp.set_quorum_gen(quorum_gen_);
+  }
+  send_msg(sock, MsgType::kRegionDigestResp, resp);
+}
+
+void Lighthouse::handle_region_poll(Socket& sock, const std::string& payload) {
+  torchft_tpu::RegionPollRequest req;
+  if (!req.ParseFromString(payload)) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "bad region poll request");
+    return;
+  }
+  int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
+
+  UniqueMutexLock lock(mu_);
+  while (quorum_gen_ <= req.min_gen() && !shutting_down_) {
+    if (deadline < 0) {
+      quorum_cv_.wait(lock);
+    } else {
+      int64_t remain = deadline - now_ms();
+      if (remain <= 0) {
+        lock.unlock();
+        send_error(sock, ErrorResponse::DEADLINE_EXCEEDED,
+                   "region poll timed out");
+        return;
+      }
+      quorum_cv_.wait_for(lock, std::chrono::milliseconds(remain));
+    }
+  }
+  if (shutting_down_) {
+    lock.unlock();
+    send_error(sock, ErrorResponse::CANCELLED, "lighthouse shutting down");
+    return;
+  }
+  torchft_tpu::RegionPollResponse resp;
+  *resp.mutable_quorum() = latest_quorum_;
+  resp.set_gen(quorum_gen_);
+  lock.unlock();
+  send_msg(sock, MsgType::kRegionPollResp, resp);
+}
+
 namespace {
 
 const char kIndexHtml[] = R"html(<!DOCTYPE html>
@@ -275,34 +363,6 @@ setInterval(refresh, 1000);
 </body>
 </html>
 )html";
-
-void http_respond(Socket& sock, int code, const std::string& content_type,
-                  const std::string& body) {
-  std::ostringstream os;
-  const char* reason = code == 200 ? "OK" : (code == 404 ? "Not Found" : "Error");
-  os << "HTTP/1.1 " << code << " " << reason << "\r\n"
-     << "Content-Type: " << content_type << "\r\n"
-     << "Content-Length: " << body.size() << "\r\n"
-     << "Connection: close\r\n\r\n"
-     << body;
-  std::string out = os.str();
-  sock.send_all(out.data(), out.size());
-}
-
-std::string html_escape(const std::string& s) {
-  std::string out;
-  for (char c : s) {
-    switch (c) {
-      case '<': out += "&lt;"; break;
-      case '>': out += "&gt;"; break;
-      case '&': out += "&amp;"; break;
-      case '"': out += "&quot;"; break;
-      case '\'': out += "&#39;"; break;
-      default: out += c;
-    }
-  }
-  return out;
-}
 
 } // namespace
 
@@ -364,6 +424,75 @@ std::string Lighthouse::render_status_locked() {
   return os.str();
 }
 
+Json Lighthouse::status_json_locked() {
+  int64_t now = now_ms();
+  JsonObject o;
+  o["role"] = std::string(regions_.empty() ? "flat" : "root");
+  o["quorum_id"] = state_.quorum_id;
+  o["quorum_gen"] = quorum_gen_;
+  if (state_.quorum_formed_ms >= 0) {
+    o["quorum_age_ms"] = now - state_.quorum_formed_ms;
+  } else {
+    o["quorum_age_ms"] = Json();
+  }
+  if (state_.prev_quorum.has_value()) {
+    o["quorum"] = quorum_to_json(*state_.prev_quorum);
+  } else {
+    o["quorum"] = Json();
+  }
+
+  JsonArray members;
+  for (const auto& [replica_id, last] : state_.heartbeats) {
+    JsonObject m;
+    m["replica_id"] = replica_id;
+    int64_t ttl = lease_ttl_for(state_, replica_id, opt_);
+    m["ttl_ms"] = ttl;
+    m["lease_remaining_ms"] = last + ttl - now;
+    m["participating"] = state_.participants.count(replica_id) > 0;
+    members.push_back(Json(std::move(m)));
+  }
+  o["members"] = Json(std::move(members));
+
+  JsonArray parts;
+  for (const auto& [replica_id, _] : state_.participants)
+    parts.push_back(Json(replica_id));
+  o["participants"] = Json(std::move(parts));
+
+  JsonObject tick;
+  tick["total"] = ticks_total_;
+  tick["computed"] = ticks_computed_;
+  tick["last_compute_us"] = last_compute_us_;
+  tick["total_compute_us"] = total_compute_us_;
+  o["tick"] = Json(std::move(tick));
+
+  JsonArray regions;
+  for (const auto& [region_id, info] : regions_) {
+    JsonObject r;
+    r["region_id"] = region_id;
+    r["last_digest_age_ms"] = now - info.last_digest_ms;
+    r["entries"] = info.entries;
+    regions.push_back(Json(std::move(r)));
+  }
+  o["regions"] = Json(std::move(regions));
+
+  JsonArray events;
+  for (const auto& ev : state_.events) events.push_back(Json(ev));
+  o["events"] = Json(std::move(events));
+  return Json(std::move(o));
+}
+
+std::string Lighthouse::status_json() {
+  Json j;
+  {
+    MutexLock lock(mu_);
+    j = status_json_locked();
+  }
+  JsonObject& o = j.as_object();
+  o["open_conns"] = static_cast<int64_t>(conns_.size());
+  o["address"] = address();
+  return j.dump();
+}
+
 void Lighthouse::handle_http(Socket& sock, const std::string& head) {
   std::istringstream is(head);
   std::string method, path;
@@ -371,6 +500,8 @@ void Lighthouse::handle_http(Socket& sock, const std::string& head) {
 
   if (method == "GET" && (path == "/" || path.empty())) {
     http_respond(sock, 200, "text/html", kIndexHtml);
+  } else if (method == "GET" && path == "/status.json") {
+    http_respond(sock, 200, "application/json", status_json());
   } else if (method == "GET" && path == "/status") {
     std::string body;
     {

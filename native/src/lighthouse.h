@@ -1,10 +1,13 @@
-// Global quorum service. One per job; replica-group managers heartbeat into it
-// and long-poll Quorum requests against it. Also serves an HTML dashboard on
-// the same port (HTTP requests are sniffed apart from protocol frames).
-// Reference: src/lighthouse.rs.
+// Global quorum service. One per job; replica-group managers heartbeat (or
+// batch-renew leases) into it and long-poll Quorum requests against it. Also
+// the ROOT of the hierarchical tier: region lighthouses push membership
+// digests into it and long-poll the global quorum back out. Serves an HTML
+// dashboard plus a JSON status view on the same port (HTTP requests are
+// sniffed apart from protocol frames). Reference: src/lighthouse.rs.
 #pragma once
 
 #include <atomic>
+#include <map>
 #include <memory>
 #include <string>
 #include <thread>
@@ -27,18 +30,27 @@ class Lighthouse {
   uint16_t port() const;
   void shutdown();
 
+  // Machine-readable status (the /status.json payload): members + lease
+  // deadlines, last quorum, tier role, tick cost counters, region digests.
+  std::string status_json();
+
  private:
   void accept_loop();
   void tick_loop();
   void handle_conn(Socket& sock);
   void handle_http(Socket& sock, const std::string& head);
   void handle_quorum_req(Socket& sock, const std::string& payload);
+  void handle_lease_renew(Socket& sock, const std::string& payload);
+  void handle_depart(Socket& sock, const std::string& payload);
+  void handle_region_digest(Socket& sock, const std::string& payload);
+  void handle_region_poll(Socket& sock, const std::string& payload);
 
   // Runs one quorum check; called with mu_ held. On success publishes the new
   // quorum (bumping quorum_id only when membership changed) and wakes waiters.
   void quorum_tick_locked() TFT_REQUIRES(mu_);
 
   std::string render_status_locked() TFT_REQUIRES(mu_);
+  Json status_json_locked() TFT_REQUIRES(mu_);
 
   LighthouseOpt opt_;
   std::unique_ptr<Listener> listener_;
@@ -50,6 +62,23 @@ class Lighthouse {
   // Broadcast channel equivalent: monotone generation + latest value.
   int64_t quorum_gen_ TFT_GUARDED_BY(mu_) = 0;
   torchft_tpu::Quorum latest_quorum_ TFT_GUARDED_BY(mu_);
+
+  // Region tier bookkeeping (status only; liveness rides the groups' own
+  // forwarded leases, so a region's death needs no root-side timeout).
+  struct RegionInfo {
+    int64_t last_digest_ms = 0;
+    int64_t entries = 0;
+  };
+  std::map<std::string, RegionInfo> regions_ TFT_GUARDED_BY(mu_);
+
+  // Tick cost counters ("root CPU per tick" in LIGHTHOUSE_BENCH). Idle
+  // ticks — no registered participant, so no quorum can possibly form —
+  // skip the O(groups) membership scan entirely; that is the lease-based
+  // replacement for the unconditional per-tick recompute.
+  int64_t ticks_total_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t ticks_computed_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t last_compute_us_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t total_compute_us_ TFT_GUARDED_BY(mu_) = 0;
 
   std::atomic<bool> shutting_down_{false};
   std::thread accept_thread_;

@@ -4,6 +4,9 @@
 
 #include <unistd.h>
 
+#include <chrono>
+#include <functional>
+
 #include "log.h"
 #include "wire.h"
 
@@ -30,20 +33,47 @@ Quorum LighthouseClient::quorum(const QuorumMember& requester, int64_t timeout_m
   return resp.quorum();
 }
 
-void LighthouseClient::heartbeat(const std::string& replica_id, int64_t timeout_ms) {
+template <typename Req, typename Resp>
+Resp LighthouseClient::roundtrip(uint8_t req_type, const Req& req,
+                                 uint8_t resp_type, int64_t timeout_ms) {
   MutexLock lock(hb_mu_);
-  torchft_tpu::LighthouseHeartbeatRequest req;
-  req.set_replica_id(replica_id);
   int64_t deadline = now_ms() + timeout_ms;
   if (!hb_sock_.valid()) hb_sock_ = connect_with_retry(addr_, timeout_ms);
   try {
-    send_msg(hb_sock_, MsgType::kLighthouseHeartbeatReq, req, deadline);
-    recv_expect<torchft_tpu::LighthouseHeartbeatResponse>(
-        hb_sock_, MsgType::kLighthouseHeartbeatResp, deadline);
+    send_msg(hb_sock_, static_cast<MsgType>(req_type), req, deadline);
+    return recv_expect<Resp>(hb_sock_, static_cast<MsgType>(resp_type), deadline);
   } catch (...) {
     hb_sock_.close(); // reconnect on next call
     throw;
   }
+}
+
+void LighthouseClient::heartbeat(const std::string& replica_id, int64_t timeout_ms) {
+  torchft_tpu::LighthouseHeartbeatRequest req;
+  req.set_replica_id(replica_id);
+  roundtrip<torchft_tpu::LighthouseHeartbeatRequest,
+            torchft_tpu::LighthouseHeartbeatResponse>(
+      static_cast<uint8_t>(MsgType::kLighthouseHeartbeatReq), req,
+      static_cast<uint8_t>(MsgType::kLighthouseHeartbeatResp), timeout_ms);
+}
+
+int64_t LighthouseClient::lease_renew(const std::vector<LeaseEntry>& entries,
+                                      int64_t timeout_ms) {
+  torchft_tpu::LeaseRenewRequest req;
+  lease_entries_to_pb(entries, &req);
+  auto resp = roundtrip<torchft_tpu::LeaseRenewRequest,
+                        torchft_tpu::LeaseRenewResponse>(
+      static_cast<uint8_t>(MsgType::kLeaseRenewReq), req,
+      static_cast<uint8_t>(MsgType::kLeaseRenewResp), timeout_ms);
+  return resp.quorum_id();
+}
+
+void LighthouseClient::depart(const std::string& replica_id, int64_t timeout_ms) {
+  torchft_tpu::DepartRequest req;
+  req.set_replica_id(replica_id);
+  roundtrip<torchft_tpu::DepartRequest, torchft_tpu::DepartResponse>(
+      static_cast<uint8_t>(MsgType::kDepartReq), req,
+      static_cast<uint8_t>(MsgType::kDepartResp), timeout_ms);
 }
 
 // ---- ManagerServer ----
@@ -53,20 +83,38 @@ ManagerServer::ManagerServer(const std::string& replica_id,
                              const std::string& hostname, const std::string& bind,
                              const std::string& store_addr, uint64_t world_size,
                              int64_t heartbeat_interval_ms,
-                             int64_t connect_timeout_ms)
+                             int64_t connect_timeout_ms,
+                             const std::string& root_addr, int64_t lease_ttl_ms)
     : replica_id_(replica_id),
       lighthouse_addr_(lighthouse_addr),
+      root_addr_(root_addr == lighthouse_addr ? "" : root_addr),
       hostname_(hostname.empty() ? local_hostname() : hostname),
       store_addr_(store_addr),
       world_size_(world_size),
       heartbeat_interval_ms_(heartbeat_interval_ms),
       connect_timeout_ms_(connect_timeout_ms),
+      lease_ttl_ms_(lease_ttl_ms),
       listener_(std::make_unique<Listener>(bind)),
       lighthouse_client_(
           std::make_unique<LighthouseClient>(lighthouse_addr, connect_timeout_ms)) {
+  if (!root_addr_.empty()) {
+    root_client_ =
+        std::make_unique<LighthouseClient>(root_addr_, connect_timeout_ms);
+  }
   // Fail fast if the lighthouse is unreachable, mirroring the reference's
-  // connect-at-construction (src/manager.rs:97).
-  lighthouse_client_->heartbeat(replica_id_, connect_timeout_ms);
+  // connect-at-construction (src/manager.rs:97). With a root fallback
+  // configured, a dead region demotes us at construction instead of failing.
+  try {
+    lighthouse_client_->heartbeat(replica_id_, connect_timeout_ms);
+  } catch (const std::exception& e) {
+    if (!root_client_) throw;
+    LOG_WARN("region lighthouse " << lighthouse_addr_ << " unreachable at "
+                                  << "startup (" << e.what()
+                                  << "); registering directly at root");
+    root_client_->heartbeat(replica_id_, connect_timeout_ms);
+    MutexLock lock(lh_mu_);
+    using_root_ = true;
+  }
   accept_thread_ = std::thread([this] { accept_loop(); });
   heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
   LOG_INFO("Manager " << replica_id_ << " listening on " << address());
@@ -85,11 +133,23 @@ void ManagerServer::shutdown() {
     if (shutting_down_.exchange(true)) return;
     quorum_cv_.notify_all();
     commit_cv_.notify_all();
+    hb_cv_.notify_all();
   }
   listener_->close();
   if (accept_thread_.joinable()) accept_thread_.join();
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
   conns_.shutdown_all();
+}
+
+bool ManagerServer::using_root_fallback() {
+  MutexLock lock(lh_mu_);
+  return using_root_;
+}
+
+LighthouseClient* ManagerServer::active_lighthouse() {
+  MutexLock lock(lh_mu_);
+  return using_root_ && root_client_ ? root_client_.get()
+                                     : lighthouse_client_.get();
 }
 
 void ManagerServer::accept_loop() {
@@ -100,17 +160,69 @@ void ManagerServer::accept_loop() {
   }
 }
 
+// Lease-renewal loop (the old heartbeat loop, upgraded three ways): the
+// renewal carries the manager's lease TTL, the healthy-path interval is
+// jittered so thousands of groups don't renew in lockstep, and a failing
+// lighthouse gets jittered EXPONENTIAL backoff instead of being hammered at
+// the fixed interval by every group simultaneously. With a root fallback
+// configured, two consecutive failures demote the group to direct-root
+// registration; the dead region is re-probed once per lease TTL and wins
+// the group back when it answers.
 void ManagerServer::heartbeat_loop() {
+  const uint64_t seed = std::hash<std::string>{}(replica_id_);
+  uint64_t tick = 0;
+  int failures = 0;
+  int64_t next_region_probe_ms = 0;
+  const int64_t probe_interval_ms =
+      lease_ttl_ms_ > 0 ? lease_ttl_ms_ : heartbeat_interval_ms_ * 10;
   while (!shutting_down_) {
-    try {
-      lighthouse_client_->heartbeat(replica_id_, heartbeat_interval_ms_ * 10);
-    } catch (const std::exception& e) {
-      LOG_WARN("heartbeat to lighthouse failed: " << e.what());
+    bool on_root;
+    LighthouseClient* client;
+    {
+      MutexLock lock(lh_mu_);
+      on_root = using_root_ && root_client_ != nullptr;
+      client = on_root ? root_client_.get() : lighthouse_client_.get();
     }
-    struct timespec ts;
-    ts.tv_sec = heartbeat_interval_ms_ / 1000;
-    ts.tv_nsec = (heartbeat_interval_ms_ % 1000) * 1000000;
-    nanosleep(&ts, nullptr);
+    try {
+      std::vector<LeaseEntry> entries(1);
+      entries[0].replica_id = replica_id_;
+      entries[0].ttl_ms = lease_ttl_ms_;
+      client->lease_renew(entries, heartbeat_interval_ms_ * 10);
+      failures = 0;
+    } catch (const std::exception& e) {
+      failures += 1;
+      LOG_WARN("lease renewal to " << (on_root ? "root" : "lighthouse")
+                                   << " failed (x" << failures
+                                   << "): " << e.what());
+      if (!on_root && failures >= 2 && root_client_) {
+        LOG_WARN("region lighthouse " << lighthouse_addr_
+                                      << " unresponsive; demoting "
+                                      << replica_id_
+                                      << " to direct root registration");
+        MutexLock lock(lh_mu_);
+        using_root_ = true;
+        failures = 0;
+      }
+    }
+    if (on_root && now_ms() >= next_region_probe_ms) {
+      next_region_probe_ms = now_ms() + probe_interval_ms;
+      try {
+        lighthouse_client_->heartbeat(replica_id_, heartbeat_interval_ms_ * 5);
+        LOG_INFO("region lighthouse " << lighthouse_addr_
+                                      << " is back; leaving root fallback");
+        MutexLock lock(lh_mu_);
+        using_root_ = false;
+      } catch (const std::exception&) {
+        // still down; stay on the root
+      }
+    }
+    int64_t sleep_ms =
+        failures == 0
+            ? jittered_interval_ms(heartbeat_interval_ms_, seed, tick++)
+            : backoff_ms(failures, heartbeat_interval_ms_, 10000, seed);
+    UniqueMutexLock lock(mu_);
+    if (!shutting_down_)
+      hb_cv_.wait_for(lock, std::chrono::milliseconds(sleep_ms));
   }
 }
 
@@ -194,7 +306,7 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
     requester.set_force_reconfigure(force_reconfigure_pending_);
     force_reconfigure_pending_ = false;
     try {
-      Quorum quorum = lighthouse_client_->quorum(requester, req.timeout_ms());
+      Quorum quorum = active_lighthouse()->quorum(requester, req.timeout_ms());
       LOG_INFO("got lighthouse quorum id=" << quorum.quorum_id());
       latest_quorum_ = std::move(quorum);
       quorum_error_.clear();

@@ -21,7 +21,8 @@
 
 namespace tft {
 
-// Client for the lighthouse protocol (used by ManagerServer and tests).
+// Client for the lighthouse protocol (used by ManagerServer, the region
+// tier's upstream side, bench_lighthouse simulated groups, and tests).
 class LighthouseClient {
  public:
   LighthouseClient(const std::string& addr, int64_t connect_timeout_ms);
@@ -29,8 +30,21 @@ class LighthouseClient {
   torchft_tpu::Quorum quorum(const torchft_tpu::QuorumMember& requester,
                              int64_t timeout_ms);
   void heartbeat(const std::string& replica_id, int64_t timeout_ms);
+  // Batched lease renewal; returns the lighthouse's current quorum_id.
+  int64_t lease_renew(const std::vector<LeaseEntry>& entries, int64_t timeout_ms);
+  // Explicit immediate departure (vs waiting out the lease TTL).
+  void depart(const std::string& replica_id, int64_t timeout_ms);
+
+  const std::string& addr() const { return addr_; }
 
  private:
+  // One request/response over the persistent connection, re-established on
+  // error (heartbeats, renewals and departs all ride the same socket).
+  // uint8_t carries MsgType so this header stays free of wire.h.
+  template <typename Req, typename Resp>
+  Resp roundtrip(uint8_t req_type, const Req& req, uint8_t resp_type,
+                 int64_t timeout_ms);
+
   std::string addr_;
   int64_t connect_timeout_ms_;
   // Persistent heartbeat connection (re-established on error).
@@ -40,14 +54,24 @@ class LighthouseClient {
 
 class ManagerServer {
  public:
+  // `lighthouse_addr` is the group's assigned lighthouse: the flat/root
+  // service, or a REGION lighthouse when a hierarchical tier is deployed.
+  // `root_addr` (optional, "" = none) is the root fallback: when the region
+  // stops answering, the manager demotes itself to direct-root registration
+  // and probes the region periodically until it returns. `lease_ttl_ms`
+  // <= 0 leaves liveness on the lighthouse's heartbeat_timeout_ms default.
   ManagerServer(const std::string& replica_id, const std::string& lighthouse_addr,
                 const std::string& hostname, const std::string& bind,
                 const std::string& store_addr, uint64_t world_size,
-                int64_t heartbeat_interval_ms, int64_t connect_timeout_ms);
+                int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
+                const std::string& root_addr = "", int64_t lease_ttl_ms = 0);
   ~ManagerServer();
 
   std::string address() const; // "http://host:port"
   void shutdown();
+  // Whether the manager is currently registered directly at the root
+  // (region failover active). Always false without a root_addr.
+  bool using_root_fallback();
 
  private:
   void accept_loop();
@@ -55,17 +79,28 @@ class ManagerServer {
   void handle_conn(Socket& sock);
   void handle_quorum(Socket& sock, const std::string& payload);
   void handle_should_commit(Socket& sock, const std::string& payload);
+  // The client quorum/renewal traffic should currently flow through.
+  LighthouseClient* active_lighthouse();
 
   std::string replica_id_;
   std::string lighthouse_addr_;
+  std::string root_addr_;
   std::string hostname_;
   std::string store_addr_;
   uint64_t world_size_;
   int64_t heartbeat_interval_ms_;
   int64_t connect_timeout_ms_;
+  int64_t lease_ttl_ms_;
 
   std::unique_ptr<Listener> listener_;
   std::unique_ptr<LighthouseClient> lighthouse_client_;
+  std::unique_ptr<LighthouseClient> root_client_; // null without root_addr
+
+  // Region-failover state. Both clients outlive every reader (destroyed
+  // only after the threads join), so readers copy the active pointer under
+  // lh_mu_ and call through it lock-free.
+  Mutex lh_mu_;
+  bool using_root_ TFT_GUARDED_BY(lh_mu_) = false;
 
   Mutex mu_;
   // Reference: src/manager.rs:40-48 (ManagerState).
@@ -86,6 +121,10 @@ class ManagerServer {
   CondVar commit_cv_;
   int64_t commit_gen_ TFT_GUARDED_BY(mu_) = 0;
   bool latest_decision_ TFT_GUARDED_BY(mu_) = false;
+
+  // Interruptible sleep for the renewal loop (backoff waits can reach
+  // seconds; shutdown must not stall behind them). Notified in shutdown().
+  CondVar hb_cv_;
 
   std::atomic<bool> shutting_down_{false};
   std::thread accept_thread_;

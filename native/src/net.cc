@@ -473,4 +473,40 @@ Socket connect_with_retry(const std::string& addr_str, int64_t timeout_ms) {
   }
 }
 
+namespace {
+
+// splitmix64: tiny, well-mixed, and stable across platforms — exactly what a
+// deterministic (testable) jitter needs.
+uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// Uniform double in [0, 1) from the top 53 bits.
+double unit_double(uint64_t h) {
+  return static_cast<double>(h >> 11) * (1.0 / 9007199254740992.0);
+}
+
+} // namespace
+
+int64_t backoff_ms(int failures, int64_t base_ms, int64_t max_ms, uint64_t seed) {
+  if (failures <= 0 || base_ms <= 0) return 0;
+  // Cap the exponent before shifting so 63+ consecutive failures cannot
+  // overflow into a negative delay.
+  int exp = failures - 1 > 40 ? 40 : failures - 1;
+  int64_t raw = base_ms << exp;
+  if (raw > max_ms || raw <= 0) raw = max_ms;
+  double jitter = 0.5 + unit_double(splitmix64(seed ^ static_cast<uint64_t>(failures)));
+  int64_t out = static_cast<int64_t>(static_cast<double>(raw) * jitter);
+  return out > max_ms ? max_ms : out;
+}
+
+int64_t jittered_interval_ms(int64_t interval_ms, uint64_t seed, uint64_t tick) {
+  if (interval_ms <= 0) return 0;
+  double f = 0.75 + 0.5 * unit_double(splitmix64(seed ^ (tick * 0x9e3779b97f4a7c15ULL)));
+  return static_cast<int64_t>(static_cast<double>(interval_ms) * f);
+}
+
 } // namespace tft

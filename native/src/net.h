@@ -111,4 +111,17 @@ Socket connect_once(const Addr& addr, int64_t deadline_ms);
 // bounded by an overall timeout. Mirrors reference src/retry.rs:14-41.
 Socket connect_with_retry(const std::string& addr, int64_t timeout_ms);
 
+// Deterministic jittered exponential backoff schedule for retry loops (the
+// manager's lease-renewal loop uses it so a dead lighthouse is not hammered
+// at the fixed heartbeat interval by every group at once). failures <= 0
+// yields 0; failure k waits base * 2^(k-1) capped at max_ms, scaled by a
+// jitter factor in [0.5, 1.5) derived from splitmix64(seed ^ failures) —
+// same (seed, failures) always yields the same delay, which is what makes
+// the schedule unit-testable.
+int64_t backoff_ms(int failures, int64_t base_ms, int64_t max_ms, uint64_t seed);
+
+// Jittered interval for periodic work: interval scaled by [0.75, 1.25),
+// deterministic in (seed, tick). Spreads renewal herds across groups.
+int64_t jittered_interval_ms(int64_t interval_ms, uint64_t seed, uint64_t tick);
+
 } // namespace tft

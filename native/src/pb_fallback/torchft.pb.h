@@ -361,6 +361,292 @@ class LighthouseHeartbeatResponse {
   TFT_PB_COMMON()
 };
 
+class LeaseEntry {
+ public:
+  const std::string& replica_id() const { return replica_id_; }
+  void set_replica_id(const std::string& v) { replica_id_ = v; }
+  int64_t ttl_ms() const { return ttl_ms_; }
+  void set_ttl_ms(int64_t v) { ttl_ms_ = v; }
+  bool participating() const { return participating_; }
+  void set_participating(bool v) { participating_ = v; }
+  bool has_member() const { return has_member_; }
+  const QuorumMember& member() const { return member_; }
+  QuorumMember* mutable_member() {
+    has_member_ = true;
+    return &member_;
+  }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, replica_id_);
+    tft_pb::put_int64(out, 2, ttl_ms_);
+    tft_pb::put_bool(out, 3, participating_);
+    if (has_member_) tft_pb::put_len_prefixed(out, 4, member_.SerializeAsString());
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { replica_id_ = r.bytes(); return true; } break;
+      case 2: if (w == 0) { ttl_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 3: if (w == 0) { participating_ = r.varint() != 0; return true; } break;
+      case 4:
+        if (w == 2) {
+          has_member_ = true;
+          if (!member_.ParseFromString(r.bytes())) r.fail = true;
+          return true;
+        }
+        break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string replica_id_;
+  int64_t ttl_ms_ = 0;
+  bool participating_ = false;
+  QuorumMember member_;
+  bool has_member_ = false;
+};
+
+class LeaseRenewRequest {
+ public:
+  const std::vector<LeaseEntry>& entries() const { return entries_; }
+  int entries_size() const { return static_cast<int>(entries_.size()); }
+  LeaseEntry* add_entries() {
+    entries_.emplace_back();
+    return &entries_.back();
+  }
+
+  void AppendTo(std::string& out) const {
+    for (const auto& e : entries_)
+      tft_pb::put_len_prefixed(out, 1, e.SerializeAsString());
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 2) {
+      LeaseEntry e;
+      if (!e.ParseFromString(r.bytes())) { r.fail = true; return true; }
+      entries_.push_back(std::move(e));
+      return true;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::vector<LeaseEntry> entries_;
+};
+
+class LeaseRenewResponse {
+ public:
+  int64_t quorum_id() const { return quorum_id_; }
+  void set_quorum_id(int64_t v) { quorum_id_ = v; }
+
+  void AppendTo(std::string& out) const { tft_pb::put_int64(out, 1, quorum_id_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 0) { quorum_id_ = static_cast<int64_t>(r.varint()); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t quorum_id_ = 0;
+};
+
+class DepartRequest {
+ public:
+  const std::string& replica_id() const { return replica_id_; }
+  void set_replica_id(const std::string& v) { replica_id_ = v; }
+
+  void AppendTo(std::string& out) const { tft_pb::put_str(out, 1, replica_id_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 2) { replica_id_ = r.bytes(); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string replica_id_;
+};
+
+class DepartResponse {
+ public:
+  void AppendTo(std::string&) const {}
+  bool Field(tft_pb::Reader&, uint32_t, uint32_t) { return false; }
+  TFT_PB_COMMON()
+};
+
+class DigestEntry {
+ public:
+  const std::string& replica_id() const { return replica_id_; }
+  void set_replica_id(const std::string& v) { replica_id_ = v; }
+  int64_t lease_age_ms() const { return lease_age_ms_; }
+  void set_lease_age_ms(int64_t v) { lease_age_ms_ = v; }
+  int64_t ttl_ms() const { return ttl_ms_; }
+  void set_ttl_ms(int64_t v) { ttl_ms_ = v; }
+  bool participating() const { return participating_; }
+  void set_participating(bool v) { participating_ = v; }
+  int64_t joined_age_ms() const { return joined_age_ms_; }
+  void set_joined_age_ms(int64_t v) { joined_age_ms_ = v; }
+  bool has_member() const { return has_member_; }
+  const QuorumMember& member() const { return member_; }
+  QuorumMember* mutable_member() {
+    has_member_ = true;
+    return &member_;
+  }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, replica_id_);
+    tft_pb::put_int64(out, 2, lease_age_ms_);
+    tft_pb::put_int64(out, 3, ttl_ms_);
+    tft_pb::put_bool(out, 4, participating_);
+    tft_pb::put_int64(out, 5, joined_age_ms_);
+    if (has_member_) tft_pb::put_len_prefixed(out, 6, member_.SerializeAsString());
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { replica_id_ = r.bytes(); return true; } break;
+      case 2: if (w == 0) { lease_age_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 3: if (w == 0) { ttl_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 4: if (w == 0) { participating_ = r.varint() != 0; return true; } break;
+      case 5: if (w == 0) { joined_age_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 6:
+        if (w == 2) {
+          has_member_ = true;
+          if (!member_.ParseFromString(r.bytes())) r.fail = true;
+          return true;
+        }
+        break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string replica_id_;
+  int64_t lease_age_ms_ = 0, ttl_ms_ = 0, joined_age_ms_ = 0;
+  bool participating_ = false;
+  QuorumMember member_;
+  bool has_member_ = false;
+};
+
+class RegionDigestRequest {
+ public:
+  const std::string& region_id() const { return region_id_; }
+  void set_region_id(const std::string& v) { region_id_ = v; }
+  const std::vector<DigestEntry>& entries() const { return entries_; }
+  int entries_size() const { return static_cast<int>(entries_.size()); }
+  DigestEntry* add_entries() {
+    entries_.emplace_back();
+    return &entries_.back();
+  }
+  const std::vector<std::string>& departed() const { return departed_; }
+  void add_departed(const std::string& v) { departed_.push_back(v); }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, region_id_);
+    for (const auto& e : entries_)
+      tft_pb::put_len_prefixed(out, 2, e.SerializeAsString());
+    for (const auto& d : departed_) tft_pb::put_len_prefixed(out, 3, d);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { region_id_ = r.bytes(); return true; } break;
+      case 2:
+        if (w == 2) {
+          DigestEntry e;
+          if (!e.ParseFromString(r.bytes())) { r.fail = true; return true; }
+          entries_.push_back(std::move(e));
+          return true;
+        }
+        break;
+      case 3: if (w == 2) { departed_.push_back(r.bytes()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string region_id_;
+  std::vector<DigestEntry> entries_;
+  std::vector<std::string> departed_;
+};
+
+class RegionDigestResponse {
+ public:
+  int64_t quorum_gen() const { return quorum_gen_; }
+  void set_quorum_gen(int64_t v) { quorum_gen_ = v; }
+
+  void AppendTo(std::string& out) const { tft_pb::put_int64(out, 1, quorum_gen_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 0) { quorum_gen_ = static_cast<int64_t>(r.varint()); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t quorum_gen_ = 0;
+};
+
+class RegionPollRequest {
+ public:
+  int64_t min_gen() const { return min_gen_; }
+  void set_min_gen(int64_t v) { min_gen_ = v; }
+  int64_t timeout_ms() const { return timeout_ms_; }
+  void set_timeout_ms(int64_t v) { timeout_ms_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, min_gen_);
+    tft_pb::put_int64(out, 2, timeout_ms_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { min_gen_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2: if (w == 0) { timeout_ms_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t min_gen_ = 0, timeout_ms_ = 0;
+};
+
+class RegionPollResponse {
+ public:
+  bool has_quorum() const { return has_quorum_; }
+  const Quorum& quorum() const { return quorum_; }
+  Quorum* mutable_quorum() {
+    has_quorum_ = true;
+    return &quorum_;
+  }
+  int64_t gen() const { return gen_; }
+  void set_gen(int64_t v) { gen_ = v; }
+
+  void AppendTo(std::string& out) const {
+    if (has_quorum_)
+      tft_pb::put_len_prefixed(out, 1, quorum_.SerializeAsString());
+    tft_pb::put_int64(out, 2, gen_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1:
+        if (w == 2) {
+          has_quorum_ = true;
+          if (!quorum_.ParseFromString(r.bytes())) r.fail = true;
+          return true;
+        }
+        break;
+      case 2: if (w == 0) { gen_ = static_cast<int64_t>(r.varint()); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  Quorum quorum_;
+  bool has_quorum_ = false;
+  int64_t gen_ = 0;
+};
+
 class ManagerQuorumRequest {
  public:
   int64_t rank() const { return rank_; }

@@ -22,12 +22,21 @@ bool quorum_changed(const std::vector<QuorumMember>& a,
   return false;
 }
 
+int64_t lease_ttl_for(const LighthouseState& state, const std::string& replica_id,
+                      const LighthouseOpt& opt) {
+  auto it = state.lease_ttls.find(replica_id);
+  return it != state.lease_ttls.end() ? it->second : opt.heartbeat_timeout_ms;
+}
+
 std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
     int64_t now, const LighthouseState& state, const LighthouseOpt& opt) {
-  // Replicas whose heartbeat is fresh enough to be considered alive.
+  // Replicas whose lease has not expired. A plain heartbeat is a lease of
+  // heartbeat_timeout_ms, so `now - last < ttl` reduces exactly to the
+  // pre-lease `now - last < heartbeat_timeout_ms` when no TTL was granted.
   std::set<std::string> healthy_replicas;
   for (const auto& [replica_id, last] : state.heartbeats) {
-    if (now - last < opt.heartbeat_timeout_ms) healthy_replicas.insert(replica_id);
+    if (now - last < lease_ttl_for(state, replica_id, opt))
+      healthy_replicas.insert(replica_id);
   }
 
   // Participants (replicas actively requesting a quorum) that are healthy.
@@ -208,6 +217,136 @@ ManagerQuorumResponse compute_quorum_results(const std::string& replica_id,
   return resp;
 }
 
+bool apply_lease_batch(LighthouseState& state, const std::vector<LeaseEntry>& entries,
+                       int64_t now) {
+  bool newly_registered = false;
+  for (const auto& e : entries) {
+    if (e.replica_id.empty()) continue;
+    state.heartbeats[e.replica_id] = now;
+    if (e.ttl_ms > 0) {
+      state.lease_ttls[e.replica_id] = e.ttl_ms;
+    } else {
+      state.lease_ttls.erase(e.replica_id); // default back to heartbeat timeout
+    }
+    if (e.participating) {
+      auto it = state.participants.find(e.replica_id);
+      if (it != state.participants.end()) {
+        it->second.member = e.member; // keep joined_ms: renewals must not
+                                      // reset the join-timeout clock
+      } else {
+        state.participants[e.replica_id] = ParticipantDetails{now, e.member};
+        newly_registered = true;
+      }
+    }
+  }
+  return newly_registered;
+}
+
+void apply_depart(LighthouseState& state, const std::string& replica_id) {
+  state.heartbeats.erase(replica_id);
+  state.lease_ttls.erase(replica_id);
+  state.participants.erase(replica_id);
+}
+
+std::vector<DigestEntry> make_digest(const LighthouseState& state, int64_t now,
+                                     const LighthouseOpt& opt) {
+  std::vector<DigestEntry> out;
+  out.reserve(state.heartbeats.size());
+  for (const auto& [replica_id, last] : state.heartbeats) {
+    DigestEntry e;
+    e.replica_id = replica_id;
+    e.lease_age_ms = now - last;
+    e.ttl_ms = lease_ttl_for(state, replica_id, opt);
+    auto it = state.participants.find(replica_id);
+    if (it != state.participants.end()) {
+      e.participating = true;
+      e.joined_age_ms = now - it->second.joined_ms;
+      e.member = it->second.member;
+    }
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+void apply_digest(LighthouseState& state, const std::vector<DigestEntry>& entries,
+                  int64_t now) {
+  for (const auto& e : entries) {
+    if (e.replica_id.empty()) continue;
+    int64_t reconstructed = now - e.lease_age_ms;
+    // Freshness gate: a member renewing DIRECTLY at the root (region
+    // failover) must not have its fresh lease clobbered by a region still
+    // digesting its pre-demotion state — a stale enough digest would count
+    // it dead despite live renewals. A digest entry only applies when it is
+    // at least as fresh as what the root already holds.
+    auto hb = state.heartbeats.find(e.replica_id);
+    if (hb != state.heartbeats.end() && hb->second > reconstructed) continue;
+    state.heartbeats[e.replica_id] = reconstructed;
+    state.lease_ttls[e.replica_id] = e.ttl_ms;
+    if (e.participating) {
+      // The region's joined_ms is authoritative (it preserved the first
+      // join), so overwrite rather than keep a stale direct registration.
+      state.participants[e.replica_id] =
+          ParticipantDetails{now - e.joined_age_ms, e.member};
+    }
+  }
+}
+
+void prune_expired(LighthouseState& state, int64_t now, const LighthouseOpt& opt) {
+  for (auto it = state.heartbeats.begin(); it != state.heartbeats.end();) {
+    int64_t ttl = lease_ttl_for(state, it->first, opt);
+    if (now - it->second >= 10 * ttl && !state.participants.count(it->first)) {
+      state.lease_ttls.erase(it->first);
+      it = state.heartbeats.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+QuorumStepResult quorum_step(int64_t now, int64_t unix_now, LighthouseState& state,
+                             const LighthouseOpt& opt) {
+  QuorumStepResult out;
+  auto [quorum_met, reason] = quorum_compute(now, state, opt);
+  out.reason = std::move(reason);
+
+  // Bounds state growth under long-running churn (10k-group benches would
+  // otherwise accumulate every corpse forever); provably output-invariant.
+  prune_expired(state, now, opt);
+
+  if (!quorum_met.has_value()) return out;
+  std::vector<QuorumMember>& participants = *quorum_met;
+
+  bool changed = !state.prev_quorum.has_value();
+  if (!changed) {
+    std::vector<QuorumMember> prev(state.prev_quorum->participants().begin(),
+                                   state.prev_quorum->participants().end());
+    changed = quorum_changed(participants, prev);
+  }
+  // A member with a failed data plane needs everyone to rebuild on a fresh
+  // rendezvous namespace, which only a quorum_id bump triggers.
+  for (const auto& p : participants) {
+    if (p.force_reconfigure()) {
+      changed = true;
+      break;
+    }
+  }
+  if (changed) {
+    state.quorum_id += 1;
+    state.quorum_formed_ms = now;
+  }
+
+  Quorum quorum;
+  quorum.set_quorum_id(state.quorum_id);
+  for (auto& p : participants) *quorum.add_participants() = std::move(p);
+  quorum.set_created_ms(unix_now);
+
+  state.prev_quorum = quorum;
+  state.participants.clear();
+  out.quorum = std::move(quorum);
+  out.changed = changed;
+  return out;
+}
+
 // ---- JSON conversions ----
 
 Json member_to_json(const QuorumMember& m) {
@@ -291,9 +430,141 @@ LighthouseState lighthouse_state_from_json(const Json& j) {
       state.heartbeats[replica_id] = ts.as_int();
     }
   }
+  const Json& ttls = j.at("lease_ttls");
+  if (!ttls.is_null()) {
+    for (const auto& [replica_id, ttl] : ttls.as_object()) {
+      state.lease_ttls[replica_id] = ttl.as_int();
+    }
+  }
   const Json& prev = j.at("prev_quorum");
   if (!prev.is_null()) state.prev_quorum = quorum_from_json(prev);
   return state;
+}
+
+Json lighthouse_state_to_json(const LighthouseState& state) {
+  JsonObject o;
+  o["quorum_id"] = state.quorum_id;
+  JsonObject parts;
+  for (const auto& [replica_id, d] : state.participants) {
+    JsonObject pj;
+    pj["joined_ms"] = d.joined_ms;
+    pj["member"] = member_to_json(d.member);
+    parts[replica_id] = Json(std::move(pj));
+  }
+  o["participants"] = Json(std::move(parts));
+  JsonObject hb;
+  for (const auto& [replica_id, ts] : state.heartbeats) hb[replica_id] = ts;
+  o["heartbeats"] = Json(std::move(hb));
+  JsonObject ttls;
+  for (const auto& [replica_id, ttl] : state.lease_ttls) ttls[replica_id] = ttl;
+  o["lease_ttls"] = Json(std::move(ttls));
+  if (state.prev_quorum.has_value()) {
+    o["prev_quorum"] = quorum_to_json(*state.prev_quorum);
+  } else {
+    o["prev_quorum"] = Json();
+  }
+  return Json(std::move(o));
+}
+
+std::vector<LeaseEntry> lease_entries_from_json(const Json& j) {
+  std::vector<LeaseEntry> out;
+  for (const auto& ej : j.as_array()) {
+    LeaseEntry e;
+    e.replica_id = ej.get_string("replica_id", "");
+    e.ttl_ms = ej.get_int("ttl_ms", 0);
+    e.participating = ej.get_bool("participating", false);
+    const Json& m = ej.at("member");
+    if (!m.is_null()) e.member = member_from_json(m);
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+Json digest_to_json(const std::vector<DigestEntry>& entries) {
+  JsonArray arr;
+  for (const auto& e : entries) {
+    JsonObject o;
+    o["replica_id"] = e.replica_id;
+    o["lease_age_ms"] = e.lease_age_ms;
+    o["ttl_ms"] = e.ttl_ms;
+    o["participating"] = e.participating;
+    o["joined_age_ms"] = e.joined_age_ms;
+    o["member"] = member_to_json(e.member);
+    arr.push_back(Json(std::move(o)));
+  }
+  return Json(std::move(arr));
+}
+
+// ---- protobuf conversions ----
+
+std::vector<LeaseEntry> lease_entries_from_pb(const torchft_tpu::LeaseRenewRequest& req) {
+  std::vector<LeaseEntry> out;
+  out.reserve(static_cast<size_t>(req.entries_size()));
+  for (const auto& pe : req.entries()) {
+    LeaseEntry e;
+    e.replica_id = pe.replica_id();
+    e.ttl_ms = pe.ttl_ms();
+    e.participating = pe.participating();
+    e.member = pe.member();
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+void lease_entries_to_pb(const std::vector<LeaseEntry>& entries,
+                         torchft_tpu::LeaseRenewRequest* req) {
+  for (const auto& e : entries) {
+    auto* pe = req->add_entries();
+    pe->set_replica_id(e.replica_id);
+    pe->set_ttl_ms(e.ttl_ms);
+    pe->set_participating(e.participating);
+    if (e.participating) *pe->mutable_member() = e.member;
+  }
+}
+
+std::vector<DigestEntry> digest_from_pb(const torchft_tpu::RegionDigestRequest& req) {
+  std::vector<DigestEntry> out;
+  out.reserve(static_cast<size_t>(req.entries_size()));
+  for (const auto& pe : req.entries()) {
+    DigestEntry e;
+    e.replica_id = pe.replica_id();
+    e.lease_age_ms = pe.lease_age_ms();
+    e.ttl_ms = pe.ttl_ms();
+    e.participating = pe.participating();
+    e.joined_age_ms = pe.joined_age_ms();
+    e.member = pe.member();
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+void digest_to_pb(const std::vector<DigestEntry>& entries,
+                  torchft_tpu::RegionDigestRequest* req) {
+  for (const auto& e : entries) {
+    auto* pe = req->add_entries();
+    pe->set_replica_id(e.replica_id);
+    pe->set_lease_age_ms(e.lease_age_ms);
+    pe->set_ttl_ms(e.ttl_ms);
+    pe->set_participating(e.participating);
+    pe->set_joined_age_ms(e.joined_age_ms);
+    if (e.participating) *pe->mutable_member() = e.member;
+  }
+}
+
+std::vector<DigestEntry> digest_from_json(const Json& j) {
+  std::vector<DigestEntry> out;
+  for (const auto& ej : j.as_array()) {
+    DigestEntry e;
+    e.replica_id = ej.get_string("replica_id", "");
+    e.lease_age_ms = ej.get_int("lease_age_ms", 0);
+    e.ttl_ms = ej.get_int("ttl_ms", 0);
+    e.participating = ej.get_bool("participating", false);
+    e.joined_age_ms = ej.get_int("joined_age_ms", 0);
+    const Json& m = ej.at("member");
+    if (!m.is_null()) e.member = member_from_json(m);
+    out.push_back(std::move(e));
+  }
+  return out;
 }
 
 LighthouseOpt lighthouse_opt_from_json(const Json& j) {

@@ -29,6 +29,40 @@ struct ParticipantDetails {
   torchft_tpu::QuorumMember member;
 };
 
+// One entry of a batched lease renewal (wire: LeaseEntry). A lease
+// generalizes a heartbeat: liveness holds until `granted + ttl` instead of
+// `granted + heartbeat_timeout_ms`, so one renewal can keep a member alive
+// for its own TTL and a single frame can renew a whole host/region worth of
+// members. `participating` additionally (re-)registers the member as a
+// quorum participant — the non-blocking registration path the simulated
+// bench groups and the region tier ride.
+struct LeaseEntry {
+  std::string replica_id;
+  int64_t ttl_ms = 0; // <= 0: the lighthouse's heartbeat_timeout_ms
+  bool participating = false;
+  torchft_tpu::QuorumMember member; // meaningful when participating
+};
+
+// One member's standing inside a region digest (wire: DigestEntry). Ages are
+// relative to the REGION's monotonic clock at digest-build time, so the root
+// can reconstruct absolute times on its own clock without any cross-host
+// clock comparison: `root_last = root_now - lease_age_ms`.
+struct DigestEntry {
+  std::string replica_id;
+  int64_t lease_age_ms = 0;  // region_now - last renewal
+  int64_t ttl_ms = 0;        // effective TTL (always > 0 in a digest)
+  bool participating = false;
+  int64_t joined_age_ms = 0; // region_now - joined_ms (participants only)
+  torchft_tpu::QuorumMember member;
+};
+
+// Outcome of one quorum tick over mutable state (see quorum_step).
+struct QuorumStepResult {
+  std::optional<torchft_tpu::Quorum> quorum; // set when one formed this tick
+  std::string reason;
+  bool changed = false; // quorum_id was bumped
+};
+
 // Mutable lighthouse state guarded by the caller's lock.
 // Reference: src/lighthouse.rs:48-57 (State).
 struct LighthouseState {
@@ -36,6 +70,10 @@ struct LighthouseState {
   std::optional<torchft_tpu::Quorum> prev_quorum;
   int64_t quorum_id = 0;
   std::map<std::string, int64_t> heartbeats; // replica_id -> last now_ms()
+  // Per-member lease TTL granted by the last renewal; members absent here
+  // fall back to opt.heartbeat_timeout_ms, so a state that never sees a
+  // lease renewal behaves exactly like the pre-lease lighthouse.
+  std::map<std::string, int64_t> lease_ttls; // replica_id -> ttl_ms
   // Dashboard telemetry (reference templates/status.html shows live
   // per-member recovery state; here membership/heal transitions are also
   // kept as a short event log).
@@ -54,6 +92,55 @@ bool quorum_changed(const std::vector<torchft_tpu::QuorumMember>& a,
 std::pair<std::optional<std::vector<torchft_tpu::QuorumMember>>, std::string>
 quorum_compute(int64_t now, const LighthouseState& state, const LighthouseOpt& opt);
 
+// Effective lease TTL of a member: the granted TTL, else the heartbeat
+// timeout. A member is alive iff now - heartbeats[id] < lease_ttl_for(id).
+int64_t lease_ttl_for(const LighthouseState& state, const std::string& replica_id,
+                      const LighthouseOpt& opt);
+
+// Applies a batched lease renewal: refreshes grant times and TTLs, and
+// (re-)registers participating members. A participant that is already
+// registered keeps its original joined_ms (renewals must not perpetually
+// reset the join-timeout clock). Returns true iff a participant was NEWLY
+// registered — the only case where the caller needs a proactive quorum
+// tick (re-renewals of existing participants change nothing the periodic
+// tick won't see, and ticking per renewal would be O(groups^2) aggregate
+// work during a held-open join window).
+bool apply_lease_batch(LighthouseState& state, const std::vector<LeaseEntry>& entries,
+                       int64_t now);
+
+// Explicit depart: the member leaves immediately (vs lease expiry, which
+// keeps it alive until the TTL runs out). Removes its heartbeat, lease and
+// participant registration.
+void apply_depart(LighthouseState& state, const std::string& replica_id);
+
+// Region side: compresses membership state into age-relative digest entries.
+std::vector<DigestEntry> make_digest(const LighthouseState& state, int64_t now,
+                                     const LighthouseOpt& opt);
+
+// Root side: merges a region digest. Participating entries carry the
+// region's authoritative joined_ms (as an age); liveness times are
+// reconstructed on the root's clock. Never removes members — removal happens
+// via lease expiry, explicit depart, or quorum formation, same as flat.
+void apply_digest(LighthouseState& state, const std::vector<DigestEntry>& entries,
+                  int64_t now);
+
+// Drops members dead for >= 10 effective TTLs (and not registered as
+// participants). Output-invariant: expired members are already excluded from
+// every healthy set; this only bounds state growth under long churn.
+void prune_expired(LighthouseState& state, int64_t now, const LighthouseOpt& opt);
+
+// ONE quorum tick as a pure-ish state transition: runs quorum_compute, and
+// when a quorum can form, applies the full formation protocol to `state`
+// (change detection incl. force_reconfigure, quorum_id bump, prev_quorum
+// update, participant clear) and returns the formed Quorum. This is the
+// single implementation both the flat lighthouse and the hierarchical root
+// run, which is what makes the flat-vs-hierarchical bit-identity contract a
+// structural property instead of a test hope. Also prunes long-expired
+// leases (dead for >= 10 TTLs) — provably output-invariant since expired
+// members are already excluded from every healthy set.
+QuorumStepResult quorum_step(int64_t now, int64_t unix_now, LighthouseState& state,
+                             const LighthouseOpt& opt);
+
 // Per-rank view of a quorum: replica rank, max-step cohort, primary store,
 // round-robin recovery assignments. Throws std::runtime_error if replica_id is
 // not in the quorum. Reference: src/manager.rs:357-480.
@@ -68,6 +155,19 @@ Json quorum_to_json(const torchft_tpu::Quorum& q);
 torchft_tpu::Quorum quorum_from_json(const Json& j);
 Json quorum_response_to_json(const torchft_tpu::ManagerQuorumResponse& r);
 LighthouseState lighthouse_state_from_json(const Json& j);
+Json lighthouse_state_to_json(const LighthouseState& state);
 LighthouseOpt lighthouse_opt_from_json(const Json& j);
+std::vector<LeaseEntry> lease_entries_from_json(const Json& j);
+Json digest_to_json(const std::vector<DigestEntry>& entries);
+std::vector<DigestEntry> digest_from_json(const Json& j);
+
+// ---- protobuf conversions (wire boundary, shared by lighthouse + region) ----
+
+std::vector<LeaseEntry> lease_entries_from_pb(const torchft_tpu::LeaseRenewRequest& req);
+void lease_entries_to_pb(const std::vector<LeaseEntry>& entries,
+                         torchft_tpu::LeaseRenewRequest* req);
+std::vector<DigestEntry> digest_from_pb(const torchft_tpu::RegionDigestRequest& req);
+void digest_to_pb(const std::vector<DigestEntry>& entries,
+                  torchft_tpu::RegionDigestRequest* req);
 
 } // namespace tft

@@ -1,0 +1,434 @@
+#include "region.h"
+
+#include <sys/socket.h>
+
+#include <algorithm>
+#include <chrono>
+#include <functional>
+
+#include "http_util.h"
+#include "log.h"
+#include "wire.h"
+
+namespace tft {
+
+using torchft_tpu::ErrorResponse;
+using torchft_tpu::QuorumMember;
+
+RegionLighthouse::RegionLighthouse(const std::string& bind_addr,
+                                   const std::string& root_addr,
+                                   const std::string& region_id,
+                                   const RegionOpt& opt)
+    : root_addr_(root_addr),
+      region_id_(region_id),
+      opt_(opt),
+      listener_(std::make_unique<Listener>(bind_addr)),
+      hostname_(local_hostname()) {
+  lh_opt_.heartbeat_timeout_ms = opt_.heartbeat_timeout_ms;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  digest_thread_ = std::thread([this] { digest_loop(); });
+  poll_thread_ = std::thread([this] { poll_loop(); });
+  LOG_INFO("Region lighthouse " << region_id_ << " listening on " << address()
+                                << " (root " << root_addr_ << ")");
+}
+
+RegionLighthouse::~RegionLighthouse() { shutdown(); }
+
+std::string RegionLighthouse::address() const {
+  return "http://" + hostname_ + ":" + std::to_string(listener_->port());
+}
+
+uint16_t RegionLighthouse::port() const { return listener_->port(); }
+
+void RegionLighthouse::shutdown() {
+  {
+    // Flag + notify under the cv's mutex so waiters can't miss the wakeup.
+    MutexLock lock(mu_);
+    if (shutting_down_.exchange(true)) return;
+    quorum_cv_.notify_all();
+    digest_cv_.notify_all();
+  }
+  // Wake the root-connection threads out of any blocking IO.
+  int fd = digest_fd_.exchange(-1);
+  if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  fd = poll_fd_.exchange(-1);
+  if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  listener_->close();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (digest_thread_.joinable()) digest_thread_.join();
+  if (poll_thread_.joinable()) poll_thread_.join();
+  conns_.shutdown_all();
+}
+
+void RegionLighthouse::accept_loop() {
+  while (!shutting_down_) {
+    Socket sock = listener_->accept();
+    if (!sock.valid()) return;
+    conns_.spawn(std::move(sock), [this](Socket& s) { handle_conn(s); });
+  }
+}
+
+namespace {
+
+// Shutdown-aware backoff nap for the root-connection loops (they cannot
+// park on a condvar while holding no state worth waking for, but must not
+// stall shutdown behind a multi-second backoff either).
+void nap_ms(int64_t total, const std::atomic<bool>& stop) {
+  while (total > 0 && !stop) {
+    int64_t chunk = total < 100 ? total : 100;
+    struct timespec ts;
+    ts.tv_sec = chunk / 1000;
+    ts.tv_nsec = (chunk % 1000) * 1000000;
+    nanosleep(&ts, nullptr);
+    total -= chunk;
+  }
+}
+
+} // namespace
+
+void RegionLighthouse::digest_loop() {
+  Socket sock;
+  int failures = 0;
+  uint64_t seed = std::hash<std::string>{}(region_id_);
+  while (!shutting_down_) {
+    torchft_tpu::RegionDigestRequest req;
+    req.set_region_id(region_id_);
+    std::vector<std::string> departed;
+    int64_t built_ms;
+    {
+      UniqueMutexLock lock(mu_);
+      if (!digest_urgent_ && !shutting_down_)
+        digest_cv_.wait_for(lock,
+                            std::chrono::milliseconds(opt_.digest_interval_ms));
+      if (shutting_down_) break;
+      digest_urgent_ = false;
+      prune_expired(state_, now_ms(), lh_opt_);
+      built_ms = now_ms();
+      digest_to_pb(make_digest(state_, built_ms, lh_opt_), &req);
+      departed.swap(departed_pending_);
+    }
+    for (const auto& d : departed) req.add_departed(d);
+
+    try {
+      if (!sock.valid()) {
+        sock = connect_with_retry(
+            root_addr_, std::min<int64_t>(2000, opt_.connect_timeout_ms));
+        digest_fd_ = sock.fd();
+        if (shutting_down_) break;
+      }
+      int64_t deadline = now_ms() + opt_.connect_timeout_ms;
+      send_msg(sock, MsgType::kRegionDigestReq, req, deadline);
+      recv_expect<torchft_tpu::RegionDigestResponse>(
+          sock, MsgType::kRegionDigestResp, deadline);
+      failures = 0;
+      MutexLock lock(mu_);
+      root_connected_ = true;
+      digests_sent_ += 1;
+      last_digest_ms_ = now_ms();
+      digest_built_ms_ = built_ms;
+    } catch (const std::exception& e) {
+      sock.close();
+      digest_fd_ = -1;
+      failures += 1;
+      {
+        MutexLock lock(mu_);
+        root_connected_ = false;
+        // Departs must not be lost to a root outage; re-queue them.
+        for (const auto& d : departed) departed_pending_.push_back(d);
+      }
+      if (failures == 1) LOG_WARN("digest push to root failed: " << e.what());
+      nap_ms(backoff_ms(failures, 100, 5000, seed), shutting_down_);
+    }
+  }
+  digest_fd_ = -1;
+}
+
+void RegionLighthouse::poll_loop() {
+  Socket sock;
+  int failures = 0;
+  uint64_t seed = std::hash<std::string>{}(region_id_) ^ 0x5eedULL;
+  while (!shutting_down_) {
+    int64_t gen;
+    {
+      MutexLock lock(mu_);
+      gen = root_gen_;
+    }
+    try {
+      if (!sock.valid()) {
+        sock = connect_with_retry(
+            root_addr_, std::min<int64_t>(2000, opt_.connect_timeout_ms));
+        poll_fd_ = sock.fd();
+        if (shutting_down_) break;
+        // Fresh connection: the broadcast generation belongs to a root
+        // INCARNATION. After a root restart its counter starts over, so a
+        // carried-over min_gen would park every poll forever. Resetting
+        // costs at worst one duplicate republish of a quorum we already
+        // saw (waiters re-check membership; harmless).
+        MutexLock lock(mu_);
+        root_gen_ = 0;
+        gen = 0;
+      }
+      torchft_tpu::RegionPollRequest req;
+      req.set_min_gen(gen);
+      req.set_timeout_ms(10000);
+      int64_t deadline = now_ms() + 15000;
+      send_msg(sock, MsgType::kRegionPollReq, req, deadline);
+      auto resp = recv_expect<torchft_tpu::RegionPollResponse>(
+          sock, MsgType::kRegionPollResp, deadline);
+      failures = 0;
+      MutexLock lock(mu_);
+      root_gen_ = resp.gen();
+      latest_quorum_ = resp.quorum();
+      // The root consumed every registered participant when it formed this
+      // quorum; mirror that clear so waiters not in the quorum re-register
+      // — exactly the flat flow. EXCEPT registrations newer than the last
+      // forwarded digest: the root never saw those, so clearing them would
+      // silently drop quorum intent for up to a renewal period.
+      for (auto it = state_.participants.begin();
+           it != state_.participants.end();) {
+        auto hb = state_.heartbeats.find(it->first);
+        int64_t touched = hb == state_.heartbeats.end() ? 0 : hb->second;
+        if (touched > digest_built_ms_) {
+          ++it; // never forwarded; keep its registration live
+        } else {
+          it = state_.participants.erase(it);
+        }
+      }
+      quorum_gen_ += 1;
+      quorum_cv_.notify_all();
+    } catch (const RpcError& e) {
+      if (e.code == ErrorResponse::DEADLINE_EXCEEDED) {
+        // No new quorum inside the poll window; the error frame was fully
+        // consumed, so the connection is still in sync. Just re-poll.
+        continue;
+      }
+      sock.close();
+      poll_fd_ = -1;
+      failures += 1;
+      nap_ms(backoff_ms(failures, 100, 5000, seed), shutting_down_);
+    } catch (const std::exception&) {
+      sock.close();
+      poll_fd_ = -1;
+      failures += 1;
+      nap_ms(backoff_ms(failures, 100, 5000, seed), shutting_down_);
+    }
+  }
+  poll_fd_ = -1;
+}
+
+void RegionLighthouse::register_participant_locked(const QuorumMember& member) {
+  state_.heartbeats[member.replica_id()] = now_ms();
+  state_.participants[member.replica_id()] =
+      ParticipantDetails{now_ms(), member};
+  digest_urgent_ = true;
+  digest_cv_.notify_all();
+}
+
+void RegionLighthouse::handle_conn(Socket& sock) {
+  try {
+    std::string req_head;
+    if (sniff_http(sock, req_head)) {
+      handle_http(sock, req_head);
+      return;
+    }
+
+    while (true) {
+      auto [type, payload] = recv_frame(sock);
+      switch (type) {
+        case MsgType::kLighthouseQuorumReq:
+          handle_quorum_req(sock, payload);
+          break;
+        case MsgType::kLighthouseHeartbeatReq: {
+          torchft_tpu::LighthouseHeartbeatRequest req;
+          req.ParseFromString(payload);
+          {
+            MutexLock lock(mu_);
+            // A first-seen member must reach the root promptly: another
+            // region's urgent quorum could otherwise form without it and
+            // the split-brain guard would then park that quorum's
+            // stragglers for a whole digest interval.
+            if (!state_.heartbeats.count(req.replica_id())) {
+              digest_urgent_ = true;
+              digest_cv_.notify_all();
+            }
+            state_.heartbeats[req.replica_id()] = now_ms();
+          }
+          send_msg(sock, MsgType::kLighthouseHeartbeatResp,
+                   torchft_tpu::LighthouseHeartbeatResponse());
+          break;
+        }
+        case MsgType::kLeaseRenewReq: {
+          torchft_tpu::LeaseRenewRequest req;
+          if (!req.ParseFromString(payload)) {
+            send_error(sock, ErrorResponse::INVALID_ARGUMENT,
+                       "bad lease renew request");
+            return;
+          }
+          std::vector<LeaseEntry> entries = lease_entries_from_pb(req);
+          bool urgent = false;
+          for (const auto& e : entries) urgent |= e.participating;
+          torchft_tpu::LeaseRenewResponse resp;
+          {
+            MutexLock lock(mu_);
+            // First-seen members propagate urgently too (see heartbeat).
+            for (const auto& e : entries)
+              urgent |= !state_.heartbeats.count(e.replica_id);
+            apply_lease_batch(state_, entries, now_ms());
+            if (urgent) {
+              // Quorum intent must reach the root promptly, not on the
+              // next periodic digest.
+              digest_urgent_ = true;
+              digest_cv_.notify_all();
+            }
+            resp.set_quorum_id(latest_quorum_.quorum_id());
+          }
+          send_msg(sock, MsgType::kLeaseRenewResp, resp);
+          break;
+        }
+        case MsgType::kDepartReq: {
+          torchft_tpu::DepartRequest req;
+          if (!req.ParseFromString(payload) || req.replica_id().empty()) {
+            send_error(sock, ErrorResponse::INVALID_ARGUMENT,
+                       "missing replica_id");
+            return;
+          }
+          {
+            MutexLock lock(mu_);
+            apply_depart(state_, req.replica_id());
+            departed_pending_.push_back(req.replica_id());
+            digest_urgent_ = true;
+            digest_cv_.notify_all();
+          }
+          send_msg(sock, MsgType::kDepartResp, torchft_tpu::DepartResponse());
+          break;
+        }
+        default:
+          send_error(sock, ErrorResponse::INVALID_ARGUMENT,
+                     "unexpected message type");
+          return;
+      }
+    }
+  } catch (const std::exception&) {
+    // peer went away
+  }
+}
+
+void RegionLighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
+  torchft_tpu::LighthouseQuorumRequest req;
+  if (!req.ParseFromString(payload) || !req.has_requester()) {
+    send_error(sock, ErrorResponse::INVALID_ARGUMENT, "missing requester");
+    return;
+  }
+  const QuorumMember& requester = req.requester();
+  LOG_INFO("region " << region_id_ << ": quorum request for replica "
+                     << requester.replica_id());
+
+  int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
+
+  UniqueMutexLock lock(mu_);
+  register_participant_locked(requester);
+  int64_t gen = quorum_gen_;
+
+  while (true) {
+    // Wait for a root quorum newer than our subscription point.
+    while (quorum_gen_ == gen && !shutting_down_) {
+      if (deadline < 0) {
+        quorum_cv_.wait(lock);
+      } else {
+        int64_t remain = deadline - now_ms();
+        if (remain <= 0) {
+          lock.unlock();
+          send_error(sock, ErrorResponse::DEADLINE_EXCEEDED,
+                     "region lighthouse quorum timed out");
+          return;
+        }
+        quorum_cv_.wait_for(lock, std::chrono::milliseconds(remain));
+      }
+    }
+    if (shutting_down_) {
+      lock.unlock();
+      send_error(sock, ErrorResponse::CANCELLED,
+                 "region lighthouse shutting down");
+      return;
+    }
+    gen = quorum_gen_;
+    bool in_quorum = false;
+    for (const auto& p : latest_quorum_.participants()) {
+      if (p.replica_id() == requester.replica_id()) {
+        in_quorum = true;
+        break;
+      }
+    }
+    if (in_quorum) {
+      torchft_tpu::LighthouseQuorumResponse resp;
+      *resp.mutable_quorum() = latest_quorum_;
+      lock.unlock();
+      send_msg(sock, MsgType::kLighthouseQuorumResp, resp);
+      return;
+    }
+    // A quorum formed without us; re-register (urgent digest) and wait on.
+    register_participant_locked(requester);
+  }
+}
+
+std::string RegionLighthouse::status_json() {
+  Json j;
+  {
+    MutexLock lock(mu_);
+    int64_t now = now_ms();
+    JsonObject o;
+    o["role"] = std::string("region");
+    o["region_id"] = region_id_;
+    o["root_addr"] = root_addr_;
+    o["root_connected"] = root_connected_;
+    o["quorum_id"] = latest_quorum_.quorum_id();
+    o["quorum_gen"] = quorum_gen_;
+    if (latest_quorum_.participants_size() > 0) {
+      o["quorum"] = quorum_to_json(latest_quorum_);
+    } else {
+      o["quorum"] = Json();
+    }
+    JsonArray members;
+    for (const auto& [replica_id, last] : state_.heartbeats) {
+      JsonObject m;
+      m["replica_id"] = replica_id;
+      int64_t ttl = lease_ttl_for(state_, replica_id, lh_opt_);
+      m["ttl_ms"] = ttl;
+      m["lease_remaining_ms"] = last + ttl - now;
+      m["participating"] = state_.participants.count(replica_id) > 0;
+      members.push_back(Json(std::move(m)));
+    }
+    o["members"] = Json(std::move(members));
+    o["digests_sent"] = digests_sent_;
+    if (last_digest_ms_ >= 0) {
+      o["last_digest_age_ms"] = now - last_digest_ms_;
+    } else {
+      o["last_digest_age_ms"] = Json();
+    }
+    j = Json(std::move(o));
+  }
+  JsonObject& o = j.as_object();
+  o["open_conns"] = static_cast<int64_t>(conns_.size());
+  o["address"] = address();
+  return j.dump();
+}
+
+void RegionLighthouse::handle_http(Socket& sock, const std::string& head) {
+  std::istringstream is(head);
+  std::string method, path;
+  is >> method >> path;
+
+  if (method == "GET" && path == "/status.json") {
+    http_respond(sock, 200, "application/json", status_json());
+  } else if (method == "GET" && (path == "/" || path.empty())) {
+    http_respond(sock, 200, "text/html",
+                 "<html><body><h1>torchft_tpu region lighthouse " +
+                     html_escape(region_id_) +
+                     "</h1><p>See <a href='/status.json'>/status.json</a>"
+                     "</p></body></html>");
+  } else {
+    http_respond(sock, 404, "text/plain", "not found");
+  }
+}
+
+} // namespace tft

@@ -1,0 +1,118 @@
+// Region lighthouse: the middle tier of the hierarchical quorum service.
+//
+// Speaks the full manager-facing lighthouse protocol on its own port
+// (heartbeats, batched lease renewals, departs, quorum long-polls) but never
+// computes a quorum itself. Instead it aggregates its jurisdiction's
+// membership into a compact digest pushed to the ROOT lighthouse (periodic,
+// plus an urgent push whenever a participant (re-)registers) and long-polls
+// the root's global quorum back out, republishing it to local waiters.
+//
+// Equivalence contract: the root applies digests through the same
+// apply_digest/quorum_step pure functions the flat lighthouse's state flows
+// through, with all times forwarded as ages on the region's monotonic clock,
+// so for any membership history the hierarchical quorum output is
+// bit-identical to the flat lighthouse's (tests/test_hierarchy.py drives the
+// scripted-history suite over exactly these functions).
+//
+// Failure behavior: a dead region simply stops digesting; its groups'
+// leases at the root run out on their own TTLs while the groups demote to
+// direct-root registration (manager-side failover), so no root-side region
+// timeout exists. When the region returns, managers drift back and digests
+// resume.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "conn_tracker.h"
+#include "net.h"
+#include "quorum.h"
+#include "thread_annotations.h"
+
+namespace tft {
+
+struct RegionOpt {
+  // Cadence of periodic digest pushes; urgent pushes (new participant) fire
+  // immediately regardless.
+  int64_t digest_interval_ms = 100;
+  // Default lease TTL for plain heartbeats; must match the root's
+  // heartbeat_timeout_ms for flat-equivalent semantics (docs/OPERATIONS.md).
+  int64_t heartbeat_timeout_ms = 5000;
+  int64_t connect_timeout_ms = 10000;
+};
+
+class RegionLighthouse {
+ public:
+  RegionLighthouse(const std::string& bind_addr, const std::string& root_addr,
+                   const std::string& region_id, const RegionOpt& opt);
+  ~RegionLighthouse();
+
+  std::string address() const; // "http://host:port"
+  uint16_t port() const;
+  const std::string& region_id() const { return region_id_; }
+  void shutdown();
+
+  // Machine-readable status (the /status.json payload).
+  std::string status_json();
+
+ private:
+  void accept_loop();
+  void digest_loop();
+  void poll_loop();
+  void handle_conn(Socket& sock);
+  void handle_http(Socket& sock, const std::string& head);
+  void handle_quorum_req(Socket& sock, const std::string& payload);
+
+  // Registers a member + marks the digest urgent; called with mu_ held.
+  void register_participant_locked(const torchft_tpu::QuorumMember& member)
+      TFT_REQUIRES(mu_);
+
+  std::string root_addr_;
+  std::string region_id_;
+  RegionOpt opt_;
+  // LighthouseOpt view of opt_ for the shared pure functions (make_digest /
+  // lease_ttl_for); only heartbeat_timeout_ms is meaningful here.
+  LighthouseOpt lh_opt_;
+
+  std::unique_ptr<Listener> listener_;
+  std::string hostname_;
+
+  Mutex mu_;
+  CondVar digest_cv_; // wakes digest_loop for urgent pushes + shutdown
+  CondVar quorum_cv_; // wakes local long-poll waiters
+  // Region-local membership; prev_quorum/quorum_id fields are unused (the
+  // root owns quorum formation).
+  LighthouseState state_ TFT_GUARDED_BY(mu_);
+  std::vector<std::string> departed_pending_ TFT_GUARDED_BY(mu_);
+  bool digest_urgent_ TFT_GUARDED_BY(mu_) = false;
+  // Local broadcast generation for waiters + the last root gen we consumed.
+  int64_t quorum_gen_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t root_gen_ TFT_GUARDED_BY(mu_) = 0;
+  torchft_tpu::Quorum latest_quorum_ TFT_GUARDED_BY(mu_);
+  bool root_connected_ TFT_GUARDED_BY(mu_) = false;
+  int64_t digests_sent_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t last_digest_ms_ TFT_GUARDED_BY(mu_) = -1;
+  // now_ms() at which the last SENT digest was built: participant
+  // registrations newer than this were never forwarded, so a root quorum
+  // arriving now cannot have consumed them — the poll loop's mirror-clear
+  // must leave them registered (flat has no such race: registration and
+  // the clearing quorum_step share one mutex).
+  int64_t digest_built_ms_ TFT_GUARDED_BY(mu_) = -1;
+
+  // Raw fds of the two root connections, published so shutdown() can wake
+  // threads blocked in their socket IO (the sockets themselves are owned by
+  // their loops; -1 = not connected).
+  std::atomic<int> digest_fd_{-1};
+  std::atomic<int> poll_fd_{-1};
+
+  std::atomic<bool> shutting_down_{false};
+  std::thread accept_thread_;
+  std::thread digest_thread_;
+  std::thread poll_thread_;
+  ConnTracker conns_;
+};
+
+} // namespace tft

@@ -28,6 +28,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <random>
 #include <string>
 #include <thread>
@@ -37,8 +38,10 @@
 #include "lighthouse.h"
 #include "manager.h"
 #include "net.h"
+#include "region.h"
 #include "store.h"
 #include "thread_annotations.h"
+#include "wire.h"
 
 namespace {
 
@@ -397,6 +400,139 @@ void control_plane_churn(int iters) {
   }
 }
 
+// Hierarchical-tier churn: a root + two region lighthouses with lease
+// batchers, quorum long-polls through the regions, and chaos that kills a
+// region mid-flight (its digest/poll connections die while the root keeps
+// serving) plus a root long-poll cancelled by shutdown. Exercises the new
+// guarded state: region digest/poll loops vs concurrent handler threads,
+// root digest-apply vs tick vs region-poll waiters, lease batch application
+// under renewal hammering.
+void hierarchical_churn(int iters) {
+  for (int i = 0; i < iters; i++) {
+    LighthouseOpt opt;
+    opt.min_replicas = 2;
+    opt.join_timeout_ms = 50;
+    opt.quorum_tick_ms = 10;
+    opt.heartbeat_timeout_ms = 800;
+    Lighthouse root("[::]:0", opt);
+    std::string root_addr = root.address();
+
+    RegionOpt ropt;
+    ropt.digest_interval_ms = 20;
+    ropt.heartbeat_timeout_ms = 800;
+    ropt.connect_timeout_ms = 2000;
+    auto ra = std::make_unique<RegionLighthouse>("[::]:0", root_addr, "ra", ropt);
+    auto rb = std::make_unique<RegionLighthouse>("[::]:0", root_addr, "rb", ropt);
+    std::string ra_addr = ra->address();
+    std::string rb_addr = rb->address();
+
+    std::atomic<bool> stop{false};
+
+    // Lease batcher hammering region A with participating renewals for a
+    // flock of simulated groups (the region's digest path under load).
+    std::thread batcher([&] {
+      try {
+        LighthouseClient c(ra_addr, 2000);
+        int k = 0;
+        while (!stop) {
+          std::vector<LeaseEntry> entries;
+          for (int g = 0; g < 4; g++) {
+            LeaseEntry e;
+            e.replica_id = "sim" + std::to_string(g);
+            e.ttl_ms = 500;
+            e.participating = false;
+            entries.push_back(std::move(e));
+          }
+          c.lease_renew(entries, 2000);
+          if (++k % 5 == 0) c.heartbeat("hb-sim", 2000);
+          sleep_ms(5);
+        }
+      } catch (const std::exception&) {
+        // region A dies mid-run by design; renewals after that just fail
+      }
+    });
+
+    // Two members quorum through DIFFERENT regions: the digest + root
+    // aggregation + region poll republish path end to end.
+    std::thread qa([&] {
+      try {
+        torchft_tpu::QuorumMember m;
+        m.set_replica_id("A");
+        m.set_address("a:1");
+        m.set_store_address("a:2");
+        m.set_step(i);
+        m.set_world_size(1);
+        LighthouseClient(ra_addr, 2000).quorum(m, 4000);
+        g_ok++;
+      } catch (const std::exception&) {
+        g_failed++;
+      }
+    });
+    std::thread qb([&] {
+      try {
+        torchft_tpu::QuorumMember m;
+        m.set_replica_id("B");
+        m.set_address("b:1");
+        m.set_store_address("b:2");
+        m.set_step(i);
+        m.set_world_size(1);
+        LighthouseClient(rb_addr, 2000).quorum(m, 4000);
+        g_ok++;
+      } catch (const std::exception&) {
+        g_failed++;
+      }
+    });
+    qa.join();
+    qb.join();
+
+    // Region chaos: kill region A while a long-poll is parked on it and
+    // its batcher is mid-renewal; the waiter must be CANCELLED (not hang),
+    // the root must keep serving region B.
+    std::thread parked([&] {
+      try {
+        torchft_tpu::QuorumMember m;
+        m.set_replica_id("lone");
+        m.set_address("l:1");
+        m.set_store_address("l:2");
+        m.set_step(0);
+        m.set_world_size(1);
+        LighthouseClient(ra_addr, 2000).quorum(m, 8000);
+        g_failed++;  // only region death can end this (B won't re-join)
+      } catch (const std::exception&) {
+        g_ok++;  // CANCELLED or connection died with the region
+      }
+    });
+    sleep_ms(30);
+    ra->shutdown();
+    parked.join();
+    ra.reset();
+
+    // Root long-poll cancel: park a region-style poller directly on the
+    // root (no new quorum will form), then shut the root down under it.
+    std::thread root_poll([&] {
+      try {
+        Socket sock = connect_with_retry(root_addr, 2000);
+        torchft_tpu::RegionPollRequest req;
+        req.set_min_gen(1000000);  // newer than anything: parks forever
+        req.set_timeout_ms(8000);
+        send_msg(sock, MsgType::kRegionPollReq, req);
+        recv_expect<torchft_tpu::RegionPollResponse>(sock,
+                                                     MsgType::kRegionPollResp);
+        g_failed++;  // should have been cancelled
+      } catch (const std::exception&) {
+        g_ok++;
+      }
+    });
+    sleep_ms(30);
+    stop = true;
+    batcher.join();
+    root.shutdown();
+    root_poll.join();
+    rb->shutdown();
+    rb.reset();
+  }
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -407,6 +543,7 @@ int main(int argc, char** argv) {
 
   collectives_stress(rounds, world, stripes, elems);
   control_plane_churn(3);
+  hierarchical_churn(3);
 
   fprintf(stderr,
           "stress_native: ok_ops=%ld failed_ops=%ld checks=%ld%s\n",

@@ -36,6 +36,15 @@ enum class MsgType : uint8_t {
   kStoreGetResp = 16,
   kStoreAddReq = 17,
   kStoreAddResp = 18,
+  // Control-plane scale-out (hierarchical lighthouse tier).
+  kLeaseRenewReq = 19,
+  kLeaseRenewResp = 20,
+  kDepartReq = 21,
+  kDepartResp = 22,
+  kRegionDigestReq = 23,
+  kRegionDigestResp = 24,
+  kRegionPollReq = 25,
+  kRegionPollResp = 26,
 };
 
 // Raised when the peer replied with an ErrorResponse frame.

@@ -1,0 +1,463 @@
+"""Hierarchical lighthouse tier: flat-vs-hierarchical equivalence + failover.
+
+Two layers of proof:
+
+1. **Scripted-history property suite** (pure): membership histories — joins,
+   renewals, silent deaths, explicit departs, region deaths (incl.
+   simultaneous region death + group join), demotion to direct-root
+   registration — are interpreted twice through the SAME C++ pure functions
+   the live servers run (``lease_apply``/``depart_apply``/``digest_make``/
+   ``digest_apply``/``quorum_step``): once flat (events applied directly to
+   one state) and once hierarchically (events buffered in per-region states,
+   forwarded as age-relative digests each tick). The formed-quorum sequences
+   must be BIT-IDENTICAL, including ``quorum_id`` monotonicity.
+
+2. **Live e2e**: root + two region lighthouses + native managers with root
+   fallback; a region kill demotes its manager to direct-root registration
+   (quorums keep forming), the revived region wins it back, and quorum_id
+   stays monotonic throughout.
+"""
+
+import threading
+import time
+from datetime import timedelta
+
+import pytest
+
+from torchft_tpu import _native
+from torchft_tpu._native import (
+    Lighthouse,
+    Manager,
+    ManagerClient,
+    RegionLighthouse,
+    Store,
+    depart_apply,
+    digest_apply,
+    digest_make,
+    lease_apply,
+    quorum_step,
+)
+from torchft_tpu.lighthouse import fetch_status
+
+TIMEOUT = timedelta(seconds=20)
+
+
+def member(replica_id, step=1, force_reconfigure=False):
+    return {
+        "replica_id": replica_id,
+        "address": f"addr_{replica_id}",
+        "store_address": f"store_{replica_id}",
+        "step": step,
+        "world_size": 1,
+        "shrink_only": False,
+        "force_reconfigure": force_reconfigure,
+    }
+
+
+def entry(replica_id, ttl_ms=200, participating=True, **kw):
+    return {
+        "replica_id": replica_id,
+        "ttl_ms": ttl_ms,
+        "participating": participating,
+        "member": member(replica_id, **kw),
+    }
+
+
+EMPTY = {
+    "participants": {},
+    "heartbeats": {},
+    "lease_ttls": {},
+    "prev_quorum": None,
+    "quorum_id": 0,
+}
+
+OPT = {
+    "min_replicas": 1,
+    "join_timeout_ms": 0,
+    "quorum_tick_ms": 10,
+    "heartbeat_timeout_ms": 200,
+}
+
+
+# ---- scripted-history interpreters -------------------------------------
+#
+# A history is a list of (t, op, *args), ops:
+#   ("lease", region, [entries])   renewal batch via `region` ("direct" =
+#                                  straight to the root, the demoted path)
+#   ("depart", region, replica_id)
+#   ("region_die", region)         region stops digesting; its state is lost
+#   ("region_revive", region)      region returns with a FRESH state
+#
+# Both interpreters tick every TICK ms over the horizon and record every
+# formed quorum as its full JSON (id, membership, created_ms).
+
+TICK = 10
+
+
+def run_flat(history, horizon, opt=OPT):
+    state = dict(EMPTY)
+    formed = []
+    by_time = sorted(history, key=lambda e: e[0])
+    i = 0
+    for t in range(0, horizon + TICK, TICK):
+        while i < len(by_time) and by_time[i][0] <= t:
+            ev = by_time[i]
+            if ev[1] == "lease":
+                state = lease_apply(state, ev[3], t)
+            elif ev[1] == "depart":
+                state = depart_apply(state, ev[3])
+            # region_die / region_revive: routing-only events; the flat
+            # service sees nothing (the history itself reroutes renewals)
+            i += 1
+        res = quorum_step(t, t, state, opt)
+        state = res["state"]
+        if res["quorum"] is not None:
+            formed.append((t, res["quorum"]))
+    return formed
+
+
+def run_hierarchical(history, horizon, regions, opt=OPT):
+    root = dict(EMPTY)
+    region_states = {r: dict(EMPTY) for r in regions}
+    alive = {r: True for r in regions}
+    formed = []
+    by_time = sorted(history, key=lambda e: e[0])
+    i = 0
+    for t in range(0, horizon + TICK, TICK):
+        departed = {r: [] for r in regions}
+        direct_departs = []
+        while i < len(by_time) and by_time[i][0] <= t:
+            ev = by_time[i]
+            if ev[1] == "lease":
+                if ev[2] == "direct":
+                    root = lease_apply(root, ev[3], t)
+                else:
+                    assert alive[ev[2]], f"lease via dead region {ev[2]}"
+                    region_states[ev[2]] = lease_apply(region_states[ev[2]], ev[3], t)
+            elif ev[1] == "depart":
+                if ev[2] == "direct":
+                    direct_departs.append(ev[3])
+                else:
+                    region_states[ev[2]] = depart_apply(region_states[ev[2]], ev[3])
+                    departed[ev[2]].append(ev[3])
+            elif ev[1] == "region_die":
+                alive[ev[2]] = False
+                region_states[ev[2]] = dict(EMPTY)  # process state is lost
+            elif ev[1] == "region_revive":
+                alive[ev[2]] = True
+            i += 1
+        # live regions push their digests (ages on the region clock, applied
+        # on the root clock — same t here, which is exactly the live
+        # behavior up to transport latency). Departs apply BEFORE entries,
+        # mirroring the root handler (a re-queued stale depart must not
+        # evict a rejoin carried in the same digest's entries).
+        for r in regions:
+            if alive[r]:
+                for d in departed[r]:
+                    root = depart_apply(root, d)
+                digest = digest_make(region_states[r], t, opt)
+                root = digest_apply(root, digest, t)
+        for d in direct_departs:
+            root = depart_apply(root, d)
+        res = quorum_step(t, t, root, opt)
+        root = res["state"]
+        if res["quorum"] is not None:
+            formed.append((t, res["quorum"]))
+            # regions observe the new quorum and mirror the root's
+            # participant clear (the poll_loop contract)
+            for r in regions:
+                if alive[r]:
+                    region_states[r]["participants"] = {}
+    return formed
+
+
+def renew_all(groups, t0, t1, every, via):
+    """Renewal events for `groups` every `every` ms in [t0, t1)."""
+    out = []
+    for t in range(t0, t1, every):
+        for region, ids in via.items():
+            ids = [g for g in ids if g in groups]
+            if ids:
+                out.append((t, "lease", region, [entry(g) for g in ids]))
+    return out
+
+
+def assert_equivalent(history, horizon, regions):
+    flat = run_flat(history, horizon)
+    hier = run_hierarchical(history, horizon, regions)
+    assert len(flat) == len(hier), (len(flat), len(hier))
+    for (tf, qf), (th, qh) in zip(flat, hier):
+        assert tf == th
+        assert qf == qh, f"divergence at t={tf}:\nflat={qf}\nhier={qh}"
+    ids = [q["quorum_id"] for _, q in flat]
+    assert ids == sorted(ids), f"quorum_id not monotonic: {ids}"
+    return flat
+
+
+class TestEquivalenceSuite:
+    def test_steady_state_and_expiry(self):
+        # 6 groups across 2 regions; g3 silently dies at 800 (lease runs
+        # out); rejoins at 1400. Membership sequence: 6 -> 5 -> 6.
+        via = {"A": ["g0", "g1", "g2"], "B": ["g3", "g4", "g5"]}
+        groups = set(sum(via.values(), []))
+        hist = renew_all(groups, 0, 800, 50, via)
+        hist += renew_all(groups - {"g3"}, 800, 1400, 50, via)
+        hist += renew_all(groups, 1400, 2000, 50, via)
+        formed = assert_equivalent(hist, 2000, ["A", "B"])
+        sizes = [len(q["participants"]) for _, q in formed]
+        assert 6 in sizes and 5 in sizes
+        assert len({q["quorum_id"] for _, q in formed}) >= 3
+
+    def test_simultaneous_region_death_and_join(self):
+        # At t=500 region B dies EXACTLY as a new group joins via region A.
+        # B's groups demote to direct-root renewal from t=550 (their leases
+        # at the root are still warm, so membership never flaps).
+        via = {"A": ["g0", "g1"], "B": ["g2", "g3"]}
+        groups = set(sum(via.values(), []))
+        hist = renew_all(groups, 0, 500, 50, via)
+        hist.append((500, "region_die", "B"))
+        hist.append((500, "lease", "A", [entry("g_new")]))
+        hist += renew_all(
+            groups | {"g_new"},
+            550,
+            1500,
+            50,
+            {"A": ["g0", "g1", "g_new"], "direct": ["g2", "g3"]},
+        )
+        formed = assert_equivalent(hist, 1500, ["A", "B"])
+        # all five present in the final quorum; no shrink below 4 (the
+        # demotion was seamless)
+        assert len(formed[-1][1]["participants"]) == 5
+        assert min(len(q["participants"]) for _, q in formed) >= 4
+
+    def test_region_failover_and_return(self):
+        # Region B dies, its groups demote, B revives, groups drift back.
+        via = {"A": ["g0", "g1"], "B": ["g2", "g3"]}
+        groups = set(sum(via.values(), []))
+        hist = renew_all(groups, 0, 600, 50, via)
+        hist.append((600, "region_die", "B"))
+        hist += renew_all(
+            groups, 650, 1200, 50, {"A": ["g0", "g1"], "direct": ["g2", "g3"]}
+        )
+        hist.append((1200, "region_revive", "B"))
+        hist += renew_all(groups, 1250, 1800, 50, via)
+        formed = assert_equivalent(hist, 1800, ["A", "B"])
+        # membership never changed -> quorum_id never bumps after the first
+        ids = {q["quorum_id"] for _, q in formed}
+        assert ids == {1}, ids
+
+    def test_departs_and_force_reconfigure(self):
+        via = {"A": ["g0", "g1"], "B": ["g2"]}
+        groups = set(sum(via.values(), []))
+        hist = renew_all(groups, 0, 1000, 50, via)
+        hist.append((400, "depart", "B", "g2"))
+        hist = [
+            e for e in hist
+            if not (e[1] == "lease" and e[2] == "B" and e[0] > 400)
+        ]
+        # force_reconfigure pulse from g0 at 700: same membership, id bump
+        hist.append(
+            (700, "lease", "A", [entry("g0", force_reconfigure=True)])
+        )
+        formed = assert_equivalent(hist, 1000, ["A", "B"])
+        sizes = [len(q["participants"]) for _, q in formed]
+        assert sizes[0] == 3 and sizes[-1] == 2
+        ids = [q["quorum_id"] for _, q in formed]
+        assert len(set(ids)) == 3  # join(1) -> depart(2) -> force(3)
+
+
+class TestDigestFreshnessGate:
+    def test_stale_digest_cannot_clobber_direct_lease(self):
+        # Region failover: the member renews DIRECTLY at the root while a
+        # region (that still remembers it) keeps digesting its pre-demotion
+        # state. The stale digest entry must not overwrite the fresh lease
+        # — it would count a live, renewing member as dead.
+        root = lease_apply(EMPTY, [entry("g0", ttl_ms=1000)], now_ms=5000)
+        stale = [
+            {
+                "replica_id": "g0",
+                "lease_age_ms": 4000,  # region last saw g0 at t=1400
+                "ttl_ms": 1000,
+                "participating": False,
+                "joined_age_ms": 0,
+                "member": member("g0"),
+            }
+        ]
+        after = digest_apply(root, stale, now_ms=5400)
+        assert after["heartbeats"]["g0"] == 5000  # fresh direct lease kept
+        # ... while an up-to-date digest still applies
+        fresh = [dict(stale[0], lease_age_ms=100)]
+        after = digest_apply(after, fresh, now_ms=5600)
+        assert after["heartbeats"]["g0"] == 5500
+
+
+class TestLiveHierarchy:
+    def _quorum(self, client, name, step, results, errors):
+        try:
+            results[name] = client.quorum(0, step, f"ck-{name}", timeout=TIMEOUT)
+        except Exception as e:  # noqa: BLE001
+            errors[name] = e
+
+    def _both_quorum(self, cA, cB, step):
+        results, errors = {}, {}
+        ts = [
+            threading.Thread(
+                target=self._quorum, args=(c, n, step, results, errors), daemon=True
+            )
+            for n, c in (("A", cA), ("B", cB))
+        ]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+        assert not errors, errors
+        return results
+
+    def test_managers_through_regions_with_failover(self):
+        root = Lighthouse(min_replicas=1, join_timeout_ms=200)
+        ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
+        rb = RegionLighthouse(root.address(), "rb", digest_interval_ms=50)
+        store = Store()
+        mA = Manager(
+            "repA", ra.address(), "localhost", "[::]:0", store.address(), 1,
+            heartbeat_interval=timedelta(milliseconds=50),
+            root_addr=root.address(),
+            lease_ttl=timedelta(milliseconds=500),
+        )
+        mB = Manager(
+            "repB", rb.address(), "localhost", "[::]:0", store.address(), 1,
+            heartbeat_interval=timedelta(milliseconds=50),
+            root_addr=root.address(),
+            lease_ttl=timedelta(milliseconds=500),
+        )
+        cA, cB = ManagerClient(mA.address()), ManagerClient(mB.address())
+        quorum_ids = []
+        try:
+            # wait until both members' liveness has propagated region->root
+            # (a quorum requested before that would form without the other
+            # member and park it behind the split-brain guard)
+            deadline = time.monotonic() + 10
+            while True:
+                ids = {m["replica_id"] for m in root.status_json()["members"]}
+                if {"repA", "repB"} <= ids:
+                    break
+                assert time.monotonic() < deadline, ids
+                time.sleep(0.05)
+
+            # 1. both groups quorum through their regions
+            r = self._both_quorum(cA, cB, step=1)
+            assert r["A"].replica_world_size == 2
+            assert r["A"].quorum_id == r["B"].quorum_id
+            quorum_ids.append(r["A"].quorum_id)
+            assert not mA.using_root_fallback()
+
+            # root status shows both regions digesting
+            st = root.status_json()
+            assert st["role"] == "root"
+            assert sorted(x["region_id"] for x in st["regions"]) == ["ra", "rb"]
+
+            # 2. region A dies -> manager A demotes to direct root
+            ra_port = int(ra.address().rsplit(":", 1)[1])
+            ra.shutdown()
+            deadline = time.monotonic() + 10
+            while not mA.using_root_fallback():
+                assert time.monotonic() < deadline, "manager A never demoted"
+                time.sleep(0.05)
+
+            r = self._both_quorum(cA, cB, step=2)
+            assert r["A"].replica_world_size == 2
+            quorum_ids.append(r["A"].quorum_id)
+
+            # 3. region A returns on the SAME port -> manager drifts back
+            ra = RegionLighthouse(
+                root.address(), "ra", bind=f"[::]:{ra_port}", digest_interval_ms=50
+            )
+            deadline = time.monotonic() + 10
+            while mA.using_root_fallback():
+                assert time.monotonic() < deadline, "manager A never returned"
+                time.sleep(0.05)
+
+            r = self._both_quorum(cA, cB, step=3)
+            assert r["A"].replica_world_size == 2
+            quorum_ids.append(r["A"].quorum_id)
+
+            # membership never changed across the failover: monotonic ids,
+            # and no spurious reconfigure (ids identical unless a lease
+            # expired during the demotion window)
+            assert quorum_ids == sorted(quorum_ids)
+            assert quorum_ids[-1] - quorum_ids[0] <= 1
+        finally:
+            mA.shutdown()
+            mB.shutdown()
+            ra.shutdown()
+            rb.shutdown()
+            root.shutdown()
+            store.shutdown()
+
+    def test_region_survives_root_restart(self):
+        # The root's broadcast generation belongs to an incarnation: after a
+        # root restart (counter back to 0) the region must reset its poll
+        # cursor, or every poll parks forever and the region goes quorumless.
+        root = Lighthouse(min_replicas=1, join_timeout_ms=100)
+        root_port = int(root.address().rsplit(":", 1)[1])
+        ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
+        try:
+            c = _native.LeaseClient(ra.address())
+            c.renew([entry("g0", ttl_ms=60000)])
+            deadline = time.monotonic() + 10
+            while ra.status_json()["quorum_gen"] < 1:
+                assert time.monotonic() < deadline, "no quorum before restart"
+                time.sleep(0.05)
+
+            root.shutdown()
+            root = Lighthouse(
+                bind=f"[::]:{root_port}", min_replicas=1, join_timeout_ms=100
+            )
+            # a new membership round against the RESTARTED root must still
+            # reach waiters through the region's poll loop (both members
+            # re-declare intent — a lone g1 would rightly sit behind the
+            # split-brain guard while g0 is healthy but silent)
+            deadline = time.monotonic() + 15
+            while True:
+                st = ra.status_json()
+                q = st.get("quorum") or {}
+                ids = [m["replica_id"] for m in q.get("participants", [])]
+                if "g1" in ids:
+                    break
+                assert time.monotonic() < deadline, st
+                c.renew(
+                    [entry("g0", ttl_ms=60000), entry("g1", ttl_ms=60000)]
+                )
+                time.sleep(0.1)
+        finally:
+            ra.shutdown()
+            root.shutdown()
+
+    def test_region_status_json_over_http(self):
+        root = Lighthouse(min_replicas=1, join_timeout_ms=100)
+        ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
+        try:
+            _native.LeaseClient(ra.address()).renew(
+                [entry("g0", ttl_ms=2000, participating=False)]
+            )
+            deadline = time.monotonic() + 5
+            while True:
+                st = fetch_status(ra.address())
+                if st["members"] and st["root_connected"]:
+                    break
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            assert st["role"] == "region"
+            assert st["region_id"] == "ra"
+            assert st["members"][0]["replica_id"] == "g0"
+            # and the root lists the region
+            deadline = time.monotonic() + 5
+            while True:
+                rst = fetch_status(root.address())
+                if rst["regions"]:
+                    break
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            assert rst["regions"][0]["region_id"] == "ra"
+            assert rst["role"] == "root"
+        finally:
+            ra.shutdown()
+            root.shutdown()

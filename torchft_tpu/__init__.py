@@ -8,9 +8,11 @@ healthy peer, and every step ends in a distributed commit vote.
 """
 
 from torchft_tpu._native import (
+    LeaseClient,
     Lighthouse,
     ManagerClient,
     QuorumResult,
+    RegionLighthouse,
     Store,
     StoreClient,
 )
@@ -48,7 +50,9 @@ __all__ = [
     "DurableCheckpointer",
     "LocalSGD",
     "HostCollectives",
+    "LeaseClient",
     "Lighthouse",
+    "RegionLighthouse",
     "FTTrainState",
     "Manager",
     "ManagerClient",

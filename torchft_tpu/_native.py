@@ -55,17 +55,71 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_char_p,
         ctypes.c_int64,
     ]
+    lib.tft_lighthouse_status_json.restype = ctypes.c_int
+    lib.tft_lighthouse_status_json.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+
+    # Region lighthouse (the hierarchical tier's middle layer).
+    lib.tft_region_create.restype = ctypes.c_void_p
+    lib.tft_region_create.argtypes = [
+        ctypes.c_char_p,  # bind
+        ctypes.c_char_p,  # root addr
+        ctypes.c_char_p,  # region id
+        ctypes.c_int64,   # digest interval ms
+        ctypes.c_int64,   # heartbeat timeout ms (must match the root's)
+        ctypes.c_int64,   # connect timeout ms
+    ]
+    lib.tft_region_address.restype = ctypes.c_void_p
+    lib.tft_region_address.argtypes = [ctypes.c_void_p]
+    lib.tft_region_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tft_region_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_region_status_json.restype = ctypes.c_int
+    lib.tft_region_status_json.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+
+    # Persistent lighthouse-protocol client: batched lease renewal /
+    # heartbeat / depart over ONE connection (bench simulated groups).
+    lib.tft_lease_client_create.restype = ctypes.c_void_p
+    lib.tft_lease_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tft_lease_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_lease_client_renew.restype = ctypes.c_int
+    lib.tft_lease_client_renew.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,  # entries JSON
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),  # quorum_id out
+    ]
+    lib.tft_lease_client_heartbeat.restype = ctypes.c_int
+    lib.tft_lease_client_heartbeat.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+    ]
+    lib.tft_lease_client_depart.restype = ctypes.c_int
+    lib.tft_lease_client_depart.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+    ]
 
     lib.tft_manager_create.restype = ctypes.c_void_p
     lib.tft_manager_create.argtypes = [ctypes.c_char_p] * 5 + [
         ctypes.c_uint64,
         ctypes.c_int64,
         ctypes.c_int64,
+        ctypes.c_char_p,  # root fallback addr ("" = none)
+        ctypes.c_int64,   # lease ttl ms (<=0 = lighthouse default)
     ]
     lib.tft_manager_address.restype = ctypes.c_void_p
     lib.tft_manager_address.argtypes = [ctypes.c_void_p]
     lib.tft_manager_shutdown.argtypes = [ctypes.c_void_p]
     lib.tft_manager_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_manager_using_root.restype = ctypes.c_int
+    lib.tft_manager_using_root.argtypes = [ctypes.c_void_p]
 
     lib.tft_client_create.restype = ctypes.c_void_p
     lib.tft_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
@@ -150,6 +204,56 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_void_p),
+    ]
+    # Pure-function entry points of the lease/digest protocol (the
+    # flat-vs-hierarchical equivalence property suite drives these).
+    lib.tft_quorum_step.restype = ctypes.c_int
+    lib.tft_quorum_step.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_lease_apply.restype = ctypes.c_int
+    lib.tft_lease_apply.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_depart_apply.restype = ctypes.c_int
+    lib.tft_depart_apply.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_digest_make.restype = ctypes.c_int
+    lib.tft_digest_make.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_digest_apply.restype = ctypes.c_int
+    lib.tft_digest_apply.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.tft_backoff_ms.restype = ctypes.c_int64
+    lib.tft_backoff_ms.argtypes = [
+        ctypes.c_int,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+    ]
+    lib.tft_jittered_interval_ms.restype = ctypes.c_int64
+    lib.tft_jittered_interval_ms.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
     ]
 
     # HostCollectives (the striped TCP ring; consumed by
@@ -414,6 +518,14 @@ class Lighthouse:
     def address(self) -> str:
         return _take_string(_lib.tft_lighthouse_address(self._handle))
 
+    def status_json(self) -> dict:
+        """Machine-readable status: members + lease deadlines, last quorum,
+        tier role (``flat``/``root``), tick cost counters, region digests.
+        Served over HTTP as ``GET /status.json`` on the same port."""
+        out = ctypes.c_void_p()
+        _check(_lib.tft_lighthouse_status_json(self._handle, ctypes.byref(out)))
+        return json.loads(_take_string(out))
+
     def shutdown(self) -> None:
         if self._handle:
             _lib.tft_lighthouse_shutdown(self._handle)
@@ -428,6 +540,110 @@ class Lighthouse:
 
     def __exit__(self, *exc: object) -> None:
         self.shutdown()
+
+
+class RegionLighthouse:
+    """In-process region lighthouse: the middle tier of the hierarchical
+    quorum service. Speaks the manager-facing lighthouse protocol locally,
+    pushes membership digests to the root, long-polls the global quorum back
+    out. See native/src/region.h for the equivalence + failover contract."""
+
+    def __init__(
+        self,
+        root_addr: str,
+        region_id: str,
+        bind: str = "[::]:0",
+        digest_interval_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+        connect_timeout_ms: int = 10000,
+    ) -> None:
+        self._handle = _lib.tft_region_create(
+            bind.encode(),
+            root_addr.encode(),
+            region_id.encode(),
+            digest_interval_ms,
+            heartbeat_timeout_ms,
+            connect_timeout_ms,
+        )
+        if not self._handle:
+            _check(2)
+        _live_servers.add(self)
+
+    def address(self) -> str:
+        return _take_string(_lib.tft_region_address(self._handle))
+
+    def status_json(self) -> dict:
+        out = ctypes.c_void_p()
+        _check(_lib.tft_region_status_json(self._handle, ctypes.byref(out)))
+        return json.loads(_take_string(out))
+
+    def shutdown(self) -> None:
+        if self._handle:
+            _lib.tft_region_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and _lib is not None:
+            _lib.tft_region_destroy(handle)
+
+    def __enter__(self) -> "RegionLighthouse":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.shutdown()
+
+
+class LeaseClient:
+    """Persistent lighthouse-protocol client: batched lease renewals,
+    heartbeats and explicit departs over ONE connection. The client surface
+    bench_lighthouse's simulated groups (and host-level renewal batchers)
+    ride; real managers renew through their native server instead."""
+
+    def __init__(
+        self, addr: str, connect_timeout: timedelta = timedelta(seconds=10)
+    ) -> None:
+        self._handle = _lib.tft_lease_client_create(addr.encode(), _ms(connect_timeout))
+
+    def renew(
+        self,
+        entries: List[dict],
+        timeout: timedelta = timedelta(seconds=10),
+    ) -> int:
+        """Renews a batch of leases; each entry is ``{replica_id, ttl_ms,
+        participating, member}``. Returns the service's current quorum_id."""
+        out = ctypes.c_int64()
+        _check(
+            _lib.tft_lease_client_renew(
+                self._handle,
+                json.dumps(entries).encode(),
+                _ms(timeout),
+                ctypes.byref(out),
+            )
+        )
+        return out.value
+
+    def heartbeat(
+        self, replica_id: str, timeout: timedelta = timedelta(seconds=10)
+    ) -> None:
+        _check(
+            _lib.tft_lease_client_heartbeat(
+                self._handle, replica_id.encode(), _ms(timeout)
+            )
+        )
+
+    def depart(
+        self, replica_id: str, timeout: timedelta = timedelta(seconds=10)
+    ) -> None:
+        _check(
+            _lib.tft_lease_client_depart(
+                self._handle, replica_id.encode(), _ms(timeout)
+            )
+        )
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and _lib is not None:
+            _lib.tft_lease_client_destroy(handle)
 
 
 def lighthouse_heartbeat(
@@ -455,7 +671,16 @@ class Manager:
         world_size: int,
         heartbeat_interval: timedelta = timedelta(milliseconds=100),
         connect_timeout: timedelta = timedelta(seconds=60),
+        root_addr: str = "",
+        lease_ttl: Optional[timedelta] = None,
     ) -> None:
+        """``lighthouse_addr`` is this group's assigned lighthouse (the
+        flat/root service, or a REGION lighthouse under a hierarchical
+        tier). ``root_addr`` is the optional root fallback: a dead region
+        demotes the group to direct-root registration until it returns.
+        ``lease_ttl`` (None = lighthouse default) is how long the group
+        stays live without a renewal; renewals are jittered and back off
+        exponentially while the lighthouse is unreachable."""
         self._handle = _lib.tft_manager_create(
             replica_id.encode(),
             lighthouse_addr.encode(),
@@ -465,6 +690,8 @@ class Manager:
             world_size,
             _ms(heartbeat_interval),
             _ms(connect_timeout),
+            root_addr.encode(),
+            _ms(lease_ttl) if lease_ttl is not None else 0,
         )
         if not self._handle:
             _check(2)
@@ -472,6 +699,11 @@ class Manager:
 
     def address(self) -> str:
         return _take_string(_lib.tft_manager_address(self._handle))
+
+    def using_root_fallback(self) -> bool:
+        """True while region failover has this group registered directly at
+        the root (always False without a ``root_addr``)."""
+        return bool(_lib.tft_manager_using_root(self._handle))
 
     def shutdown(self) -> None:
         if self._handle:
@@ -675,3 +907,85 @@ def compute_quorum_results(replica_id: str, rank: int, quorum: dict) -> QuorumRe
         )
     )
     return QuorumResult._from_json(_take_string(out))
+
+
+def quorum_step(now_ms: int, unix_now_ms: int, state: dict, opt: dict) -> dict:
+    """One full quorum tick as a pure state transition — the exact C++
+    function both the flat lighthouse and the hierarchical root run. Returns
+    ``{"state": ..., "quorum": {...}|None, "changed": bool, "reason": str}``.
+    The flat-vs-hierarchical equivalence property suite is built on this."""
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_quorum_step(
+            now_ms,
+            unix_now_ms,
+            json.dumps(state).encode(),
+            json.dumps(opt).encode(),
+            ctypes.byref(out),
+        )
+    )
+    return json.loads(_take_string(out))
+
+
+def lease_apply(state: dict, entries: list, now_ms: int) -> dict:
+    """Applies a batched lease renewal to a lighthouse state (pure)."""
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_lease_apply(
+            json.dumps(state).encode(),
+            json.dumps(entries).encode(),
+            now_ms,
+            ctypes.byref(out),
+        )
+    )
+    return json.loads(_take_string(out))
+
+
+def depart_apply(state: dict, replica_id: str) -> dict:
+    """Applies an explicit depart to a lighthouse state (pure)."""
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_depart_apply(
+            json.dumps(state).encode(), replica_id.encode(), ctypes.byref(out)
+        )
+    )
+    return json.loads(_take_string(out))
+
+
+def digest_make(state: dict, now_ms: int, opt: dict) -> list:
+    """Region side of the digest protocol: state -> age-relative entries."""
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_digest_make(
+            json.dumps(state).encode(),
+            now_ms,
+            json.dumps(opt).encode(),
+            ctypes.byref(out),
+        )
+    )
+    return json.loads(_take_string(out))
+
+
+def digest_apply(state: dict, digest: list, now_ms: int) -> dict:
+    """Root side of the digest protocol: merges entries into a state."""
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_digest_apply(
+            json.dumps(state).encode(),
+            json.dumps(digest).encode(),
+            now_ms,
+            ctypes.byref(out),
+        )
+    )
+    return json.loads(_take_string(out))
+
+
+def backoff_ms(failures: int, base_ms: int, max_ms: int, seed: int) -> int:
+    """Deterministic jittered exponential backoff delay (the manager
+    renewal loop's retry schedule)."""
+    return _lib.tft_backoff_ms(failures, base_ms, max_ms, seed)
+
+
+def jittered_interval_ms(interval_ms: int, seed: int, tick: int) -> int:
+    """Deterministic jittered renewal interval (herd spreading)."""
+    return _lib.tft_jittered_interval_ms(interval_ms, seed, tick)

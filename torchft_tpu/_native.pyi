@@ -51,9 +51,42 @@ class Lighthouse:
         heartbeat_timeout_ms: int = ...,
     ) -> None: ...
     def address(self) -> str: ...
+    def status_json(self) -> dict: ...
     def shutdown(self) -> None: ...
     def __enter__(self) -> "Lighthouse": ...
     def __exit__(self, *exc: object) -> None: ...
+
+
+class RegionLighthouse:
+    def __init__(
+        self,
+        root_addr: str,
+        region_id: str,
+        bind: str = ...,
+        digest_interval_ms: int = ...,
+        heartbeat_timeout_ms: int = ...,
+        connect_timeout_ms: int = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def status_json(self) -> dict: ...
+    def shutdown(self) -> None: ...
+    def __enter__(self) -> "RegionLighthouse": ...
+    def __exit__(self, *exc: object) -> None: ...
+
+
+class LeaseClient:
+    def __init__(
+        self, addr: str, connect_timeout: timedelta = ...
+    ) -> None: ...
+    def renew(
+        self, entries: List[dict], timeout: timedelta = ...
+    ) -> int: ...
+    def heartbeat(
+        self, replica_id: str, timeout: timedelta = ...
+    ) -> None: ...
+    def depart(
+        self, replica_id: str, timeout: timedelta = ...
+    ) -> None: ...
 
 
 def lighthouse_heartbeat(
@@ -74,8 +107,11 @@ class Manager:
         world_size: int,
         heartbeat_interval: timedelta = ...,
         connect_timeout: timedelta = ...,
+        root_addr: str = ...,
+        lease_ttl: Optional[timedelta] = ...,
     ) -> None: ...
     def address(self) -> str: ...
+    def using_root_fallback(self) -> bool: ...
     def shutdown(self) -> None: ...
 
 
@@ -159,6 +195,45 @@ class _NativeLib:
         replica_id: bytes,
         timeout_ms: int
     ) -> int: ...
+    def tft_lighthouse_status_json(self, handle: Any, out: Any) -> int: ...
+    def tft_region_create(
+        self,
+        bind: bytes,
+        root_addr: bytes,
+        region_id: bytes,
+        digest_interval_ms: int,
+        heartbeat_timeout_ms: int,
+        connect_timeout_ms: int
+    ) -> Any: ...
+    def tft_region_address(self, handle: Any) -> Any: ...
+    def tft_region_shutdown(self, handle: Any) -> None: ...
+    def tft_region_destroy(self, handle: Any) -> None: ...
+    def tft_region_status_json(self, handle: Any, out: Any) -> int: ...
+    def tft_lease_client_create(
+        self,
+        addr: bytes,
+        connect_timeout_ms: int
+    ) -> Any: ...
+    def tft_lease_client_destroy(self, handle: Any) -> None: ...
+    def tft_lease_client_renew(
+        self,
+        handle: Any,
+        entries_json: bytes,
+        timeout_ms: int,
+        quorum_id_out: Any
+    ) -> int: ...
+    def tft_lease_client_heartbeat(
+        self,
+        handle: Any,
+        replica_id: bytes,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_lease_client_depart(
+        self,
+        handle: Any,
+        replica_id: bytes,
+        timeout_ms: int
+    ) -> int: ...
     def tft_manager_create(
         self,
         replica_id: bytes,
@@ -168,11 +243,14 @@ class _NativeLib:
         store_addr: bytes,
         world_size: int,
         heartbeat_interval_ms: int,
-        connect_timeout_ms: int
+        connect_timeout_ms: int,
+        root_addr: bytes,
+        lease_ttl_ms: int
     ) -> Any: ...
     def tft_manager_address(self, handle: Any) -> Any: ...
     def tft_manager_shutdown(self, handle: Any) -> None: ...
     def tft_manager_destroy(self, handle: Any) -> None: ...
+    def tft_manager_using_root(self, handle: Any) -> int: ...
     def tft_client_create(
         self,
         addr: bytes,
@@ -395,6 +473,54 @@ class _NativeLib:
         quorum_json: bytes,
         result_json: Any
     ) -> int: ...
+    def tft_quorum_step(
+        self,
+        now: int,
+        unix_now: int,
+        state_json: bytes,
+        opt_json: bytes,
+        result_json: Any
+    ) -> int: ...
+    def tft_lease_apply(
+        self,
+        state_json: bytes,
+        entries_json: bytes,
+        now: int,
+        result_json: Any
+    ) -> int: ...
+    def tft_depart_apply(
+        self,
+        state_json: bytes,
+        replica_id: bytes,
+        result_json: Any
+    ) -> int: ...
+    def tft_digest_make(
+        self,
+        state_json: bytes,
+        now: int,
+        opt_json: bytes,
+        result_json: Any
+    ) -> int: ...
+    def tft_digest_apply(
+        self,
+        state_json: bytes,
+        digest_json: bytes,
+        now: int,
+        result_json: Any
+    ) -> int: ...
+    def tft_backoff_ms(
+        self,
+        failures: int,
+        base_ms: int,
+        max_ms: int,
+        seed: int
+    ) -> int: ...
+    def tft_jittered_interval_ms(
+        self,
+        interval_ms: int,
+        seed: int,
+        tick: int
+    ) -> int: ...
 
 
 def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict: ...
@@ -403,3 +529,26 @@ def quorum_compute(now_ms: int, state: dict, opt: dict) -> dict: ...
 def compute_quorum_results(
     replica_id: str, rank: int, quorum: dict
 ) -> QuorumResult: ...
+
+
+def quorum_step(
+    now_ms: int, unix_now_ms: int, state: dict, opt: dict
+) -> dict: ...
+
+
+def lease_apply(state: dict, entries: list, now_ms: int) -> dict: ...
+
+
+def depart_apply(state: dict, replica_id: str) -> dict: ...
+
+
+def digest_make(state: dict, now_ms: int, opt: dict) -> list: ...
+
+
+def digest_apply(state: dict, digest: list, now_ms: int) -> dict: ...
+
+
+def backoff_ms(failures: int, base_ms: int, max_ms: int, seed: int) -> int: ...
+
+
+def jittered_interval_ms(interval_ms: int, seed: int, tick: int) -> int: ...

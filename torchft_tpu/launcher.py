@@ -140,6 +140,7 @@ def launch(
     max_restarts: int = 10,
     env: Optional[Dict[str, str]] = None,
     hot_spare: bool = False,
+    regions: int = 0,
 ) -> int:
     """Runs one process per replica group locally, restarting any that exit
     non-zero up to ``max_restarts`` times (torchelastic's role in the
@@ -156,11 +157,32 @@ def launch(
     the standby warms on the SAME host as its primary, so this local
     launcher's hot-spare mode suits CPU workloads and multi-chip hosts;
     on a single-chip accelerator host the standby cannot warm the chip
-    the primary owns (see standby_gate's deployment note)."""
+    the primary owns (see standby_gate's deployment note).
+
+    ``regions > 0`` spawns a hierarchical-lighthouse tier: ``regions``
+    in-process region lighthouses aggregating into ``lighthouse_addr`` (the
+    root), with groups assigned round-robin. Each group gets its region as
+    ``TORCHFT_LIGHTHOUSE`` and the root as ``TORCHFT_LIGHTHOUSE_ROOT`` so a
+    region death demotes its groups to direct-root registration (see
+    docs/OPERATIONS.md control-plane deployment)."""
     import tempfile
     import uuid as _uuid
 
     standby_dir = tempfile.mkdtemp(prefix="torchft_standby_") if hot_spare else None
+    region_tier = []
+    if regions > 0:
+        from . import _native
+
+        for i in range(regions):
+            region_tier.append(
+                _native.RegionLighthouse(
+                    root_addr=lighthouse_addr, region_id=f"region_{i}"
+                )
+            )
+        logger.info(
+            f"region tier up: {[r.address() for r in region_tier]} -> root "
+            f"{lighthouse_addr}"
+        )
     # Probe ONCE, at spawn time: standbys only warm at idle priority when
     # the supervisor can lift them back at promotion, and cold restarts
     # only get the heal-priority boost when the supervisor can set a
@@ -176,14 +198,21 @@ def launch(
             "/ RLIMIT_NICE allowance), and a promoted worker must never "
             "keep training at nice 19"
         )
-    groups = [
-        _Supervised(
-            replica_group_spec(
-                cmd, g, num_replica_groups, lighthouse_addr, env, max_restarts
+    groups = []
+    for g in range(num_replica_groups):
+        group_env = dict(env or {})
+        group_lighthouse = lighthouse_addr
+        if region_tier:
+            group_lighthouse = region_tier[g % len(region_tier)].address()
+            group_env.setdefault("TORCHFT_LIGHTHOUSE_ROOT", lighthouse_addr)
+        groups.append(
+            _Supervised(
+                replica_group_spec(
+                    cmd, g, num_replica_groups, group_lighthouse, group_env,
+                    max_restarts,
+                )
             )
         )
-        for g in range(num_replica_groups)
-    ]
 
     def spawn(s: _Supervised, as_standby: bool = False) -> subprocess.Popen:
         full_env = {**os.environ, **s.spec["env"]}  # type: ignore[arg-type]
@@ -384,6 +413,8 @@ def launch(
             import shutil
 
             shutil.rmtree(standby_dir, ignore_errors=True)
+        for region in region_tier:
+            region.shutdown()
     return 0 if all(s.returncode == 0 for s in groups) else 1
 
 
@@ -399,6 +430,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="lighthouse address; spawns an in-process one when omitted",
     )
     parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument(
+        "--regions",
+        type=int,
+        default=0,
+        help="spawn N in-process region lighthouses aggregating into the "
+        "(root) lighthouse; groups are assigned round-robin and fail over "
+        "to the root when their region dies",
+    )
     parser.add_argument(
         "--hot-spare",
         action="store_true",
@@ -426,6 +465,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             lighthouse_addr=lighthouse_addr,
             max_restarts=args.max_restarts,
             hot_spare=args.hot_spare,
+            regions=args.regions,
         )
     finally:
         if lighthouse is not None:

@@ -79,6 +79,8 @@ class Manager:
         world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
         store_addr: Optional[str] = None,
         lighthouse_addr: Optional[str] = None,
+        lighthouse_root_addr: Optional[str] = None,
+        lease_ttl: Optional[timedelta] = None,
         replica_id: Optional[str] = None,
         hostname: str = socket.gethostname(),
         heartbeat_interval: timedelta = timedelta(milliseconds=100),
@@ -101,7 +103,16 @@ class Manager:
                 Store (env ``MASTER_ADDR``+``MASTER_PORT`` when None; if
                 neither is set and world_size == 1, an in-process Store is
                 created).
-            lighthouse_addr: global lighthouse (env ``TORCHFT_LIGHTHOUSE``).
+            lighthouse_addr: this group's lighthouse (env
+                ``TORCHFT_LIGHTHOUSE``): the flat/root service, or the
+                group's REGION lighthouse under a hierarchical tier.
+            lighthouse_root_addr: root fallback for the hierarchical tier
+                (env ``TORCHFT_LIGHTHOUSE_ROOT``): a dead region demotes
+                the group to direct-root registration until it returns.
+            lease_ttl: membership lease duration (env
+                ``TORCHFT_LEASE_TTL_MS``; None = the lighthouse's
+                heartbeat-timeout default). Renewals are jittered and back
+                off exponentially while the lighthouse is unreachable.
             replica_id: replica group name; a uuid suffix is appended by
                 group rank 0 (reference manager.py:196-200).
             profiler: windowed jax profiler capture advanced once per
@@ -174,6 +185,13 @@ class Manager:
         )
 
         lighthouse_addr = lighthouse_addr or os.environ.get("TORCHFT_LIGHTHOUSE")
+        lighthouse_root_addr = lighthouse_root_addr or os.environ.get(
+            "TORCHFT_LIGHTHOUSE_ROOT", ""
+        )
+        if lease_ttl is None:
+            env_ttl = os.environ.get("TORCHFT_LEASE_TTL_MS")
+            if env_ttl:
+                lease_ttl = timedelta(milliseconds=int(env_ttl))
         replica_id = replica_id if replica_id is not None else ""
 
         self._manager: Optional[_native.Manager] = None
@@ -198,6 +216,8 @@ class Manager:
                 world_size=self._world_size,
                 heartbeat_interval=heartbeat_interval,
                 connect_timeout=connect_timeout,
+                root_addr=lighthouse_root_addr,
+                lease_ttl=lease_ttl,
             )
             self._store.set(MANAGER_ADDR_KEY, self._manager.address().encode())
             self._store.set(REPLICA_ID_KEY, replica_id.encode())
