@@ -13,6 +13,7 @@
 #include "net.h"
 #include "quorum.h"
 #include "region.h"
+#include "shm.h"
 #include "store.h"
 #include "wire.h"
 
@@ -521,6 +522,56 @@ int64_t tft_hc_last_stripe_ns(void* handle, int64_t* out, int64_t cap) {
   int64_t n = static_cast<int64_t>(ns.size());
   for (int64_t i = 0; i < n && i < cap; i++) out[i] = ns[i];
   return n;
+}
+
+// ---- shared-memory segments (isolated accelerator data plane) ----
+// Lifecycle for the POSIX shm staging buffers the isolated XLA backend
+// feeds its disposable child through (see native/src/shm.h for the
+// ownership contract: the creator unlinks, attachments never do, and a
+// SIGKILLed child's mapping vanishes with it while the parent's survives).
+
+void* tft_shm_create(const char* name, int64_t bytes) {
+  try {
+    return ShmSegment::Create(name, static_cast<size_t>(bytes));
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+void* tft_shm_attach(const char* name, int64_t bytes) {
+  try {
+    return ShmSegment::Attach(name, static_cast<size_t>(bytes));
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+void* tft_shm_data(void* handle) {
+  return static_cast<ShmSegment*>(handle)->data();
+}
+
+int64_t tft_shm_size(void* handle) {
+  return static_cast<int64_t>(static_cast<ShmSegment*>(handle)->size());
+}
+
+void tft_shm_close(void* handle) { delete static_cast<ShmSegment*>(handle); }
+
+int tft_shm_unlink(const char* name) {
+  return guarded([&] { ShmSegment::Unlink(name); });
+}
+
+int64_t tft_shm_live_count() { return ShmSegment::live_count(); }
+
+// The CommPlan leaf->offset layout both sides of the shm boundary lay
+// payloads out with (the authority the Python mirror is pinned against).
+// wire: 0 native dtypes, 1 bf16, 2 q8, 3 q8+EF — plan_build's codes.
+int tft_shm_layout_json(const int64_t* counts, const int32_t* dtypes,
+                        int64_t n_leaves, int wire, char** out) {
+  return guarded([&] {
+    *out = dup_string(shm_layout_json(counts, dtypes, n_leaves, wire));
+  });
 }
 
 // ---- pure functions (test entry points) ----

@@ -1,0 +1,191 @@
+#include "shm.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <unordered_set>
+#include <vector>
+
+#include "collectives.h"
+#include "json.h"
+#include "net.h"
+
+namespace tft {
+
+namespace {
+
+// Registry of live handles: the leak oracle tests/stress assert against
+// after chaos rounds that abandon attachments (the SIGKILLed-child
+// pattern). Handles only — the kernel owns the pages.
+Mutex g_shm_mu;
+std::unordered_set<const ShmSegment*>* g_live TFT_GUARDED_BY(g_shm_mu) =
+    nullptr;
+
+void registry_add(const ShmSegment* seg) {
+  MutexLock lock(g_shm_mu);
+  if (g_live == nullptr) g_live = new std::unordered_set<const ShmSegment*>();
+  g_live->insert(seg);
+}
+
+void registry_remove(const ShmSegment* seg) {
+  MutexLock lock(g_shm_mu);
+  if (g_live != nullptr) g_live->erase(seg);
+}
+
+std::string posix_name(const std::string& name) {
+  if (!name.empty() && name[0] == '/') return name;
+  return "/" + name;
+}
+
+void* open_and_map(const std::string& pname, size_t bytes, bool create) {
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  int fd = shm_open(pname.c_str(), flags, 0600);
+  if (fd < 0)
+    throw SocketError("shm_open(" + pname + (create ? ", create" : ", attach") +
+                      "): " + strerror(errno));
+  if (create) {
+    if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+      int err = errno;
+      close(fd);
+      shm_unlink(pname.c_str());
+      throw SocketError("ftruncate(" + pname + "): " + strerror(err));
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < bytes) {
+      close(fd);
+      throw SocketError("shm attach(" + pname + "): segment smaller than " +
+                        std::to_string(bytes) +
+                        " bytes (layout generations out of sync)");
+    }
+  }
+  void* data =
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  // The mapping holds its own reference; the fd is not needed past mmap.
+  int err = errno;
+  close(fd);
+  if (data == MAP_FAILED) {
+    if (create) shm_unlink(pname.c_str());
+    throw SocketError("mmap(" + pname + "): " + strerror(err));
+  }
+  return data;
+}
+
+}  // namespace
+
+ShmSegment::ShmSegment(std::string name, void* data, size_t size, bool owner)
+    : name_(std::move(name)), data_(data), size_(size), owner_(owner) {
+  registry_add(this);
+}
+
+ShmSegment* ShmSegment::Create(const std::string& name, size_t bytes) {
+  if (bytes == 0) throw SocketError("shm create: zero-byte segment");
+  std::string pname = posix_name(name);
+  void* data = open_and_map(pname, bytes, /*create=*/true);
+  return new ShmSegment(pname, data, bytes, /*owner=*/true);
+}
+
+ShmSegment* ShmSegment::Attach(const std::string& name, size_t bytes) {
+  if (bytes == 0) throw SocketError("shm attach: zero-byte segment");
+  std::string pname = posix_name(name);
+  void* data = open_and_map(pname, bytes, /*create=*/false);
+  return new ShmSegment(pname, data, bytes, /*owner=*/false);
+}
+
+ShmSegment::~ShmSegment() {
+  registry_remove(this);
+  munmap(data_, size_);
+  if (owner_) shm_unlink(name_.c_str());  // idempotent: may already be gone
+}
+
+void ShmSegment::Unlink(const std::string& name) {
+  // ENOENT is success: respawn paths unlink defensively, and the creator
+  // destructor may already have removed the name.
+  if (shm_unlink(posix_name(name).c_str()) != 0 && errno != ENOENT &&
+      errno != EINVAL)
+    throw SocketError("shm_unlink(" + posix_name(name) +
+                      "): " + strerror(errno));
+}
+
+int64_t ShmSegment::live_count() {
+  MutexLock lock(g_shm_mu);
+  return g_live == nullptr ? 0 : static_cast<int64_t>(g_live->size());
+}
+
+std::string shm_layout_json(const int64_t* counts, const int32_t* dtypes,
+                            int64_t n_leaves, int wire) {
+  if (n_leaves <= 0) throw SocketError("shm layout of an empty signature");
+  if (wire < 0 || wire > 3) throw SocketError("shm layout: bad wire code");
+  const bool q8 = wire == static_cast<int>(PlanWire::kQ8) ||
+                  wire == static_cast<int>(PlanWire::kQ8EF);
+  struct Group {
+    Dtype dtype;
+    size_t count = 0;
+    size_t offset = 0;  // byte base within the segment
+  };
+  std::vector<Group> groups;
+  struct Leaf {
+    size_t group;
+    size_t off;  // element offset within the group
+    size_t count;
+  };
+  std::vector<Leaf> leaves(n_leaves);
+  for (int64_t i = 0; i < n_leaves; i++) {
+    if (counts[i] < 0) throw SocketError("shm layout: negative leaf count");
+    Dtype dt = static_cast<Dtype>(dtypes[i]);
+    dtype_size(dt);  // validates the code
+    Dtype gdt;
+    if (q8) {
+      if (dt != Dtype::kF32 && dt != Dtype::kBF16)
+        throw SocketError("shm layout: q8 wires take f32/bf16 leaves only");
+      gdt = Dtype::kF32;
+    } else if (wire == static_cast<int>(PlanWire::kBF16)) {
+      gdt = dt == Dtype::kF32 ? Dtype::kBF16 : dt;
+    } else {
+      gdt = dt;
+    }
+    // First-appearance group order — plan_build's discipline, which the
+    // Python mirror (_plan_groups) replicates positionally.
+    size_t gi = groups.size();
+    for (size_t g = 0; g < groups.size(); g++)
+      if (groups[g].dtype == gdt) { gi = g; break; }
+    if (gi == groups.size()) groups.push_back(Group{gdt, 0, 0});
+    leaves[i] = {gi, groups[gi].count, static_cast<size_t>(counts[i])};
+    groups[gi].count += static_cast<size_t>(counts[i]);
+  }
+  // 64-byte-aligned group bases: typed numpy views of the mapped segment
+  // stay cache-line clean and any dtype is naturally aligned.
+  size_t offset = 0;
+  for (auto& g : groups) {
+    g.offset = offset;
+    offset += g.count * dtype_size(g.dtype);
+    offset = (offset + 63) & ~static_cast<size_t>(63);
+  }
+  JsonObject out;
+  out["total_bytes"] = Json(static_cast<int64_t>(offset));
+  JsonArray garr;
+  for (const auto& g : groups) {
+    JsonObject jg;
+    jg["dtype"] = Json(static_cast<int64_t>(g.dtype));
+    jg["offset"] = Json(static_cast<int64_t>(g.offset));
+    jg["count"] = Json(static_cast<int64_t>(g.count));
+    garr.push_back(Json(std::move(jg)));
+  }
+  out["groups"] = Json(std::move(garr));
+  JsonArray larr;
+  for (const auto& l : leaves) {
+    JsonObject jl;
+    jl["group"] = Json(static_cast<int64_t>(l.group));
+    jl["off"] = Json(static_cast<int64_t>(l.off));
+    jl["count"] = Json(static_cast<int64_t>(l.count));
+    larr.push_back(Json(std::move(jl)));
+  }
+  out["leaves"] = Json(std::move(larr));
+  return Json(std::move(out)).dump();
+}
+
+}  // namespace tft

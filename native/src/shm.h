@@ -1,0 +1,85 @@
+// POSIX shared-memory segments for the isolated accelerator data plane.
+//
+// The isolated XLA backend (torchft_tpu/isolated_xla.py) runs the
+// jax.distributed runtime and its compiled collectives in a DISPOSABLE
+// child process; gradient payloads never ride the command pipe — the
+// parent lays them out into a shared-memory segment with the CommPlan
+// leaf->offset discipline and the child maps the SAME bytes. A segment is
+// therefore the one piece of state that must survive (and be reasoned
+// about across) a child SIGKILL: POSIX shm is kernel-owned, so a killed
+// child's mapping vanishes with it while the parent's mapping — and the
+// bytes — stay intact, and the respawned child re-attaches by name.
+//
+// Lifecycle contract (the tft_shm_* C API mirrors it 1:1):
+//   - Create(name, bytes): shm_open(O_CREAT|O_EXCL) + ftruncate + mmap.
+//     The CREATOR owns the name: it unlinks on destruction (or explicitly
+//     via Unlink) — attachments never do.
+//   - Attach(name, bytes): shm_open existing + mmap; fails if the segment
+//     is smaller than `bytes` (a truncated map would SIGBUS on touch).
+//   - close/destroy: munmap + close(fd). The kernel frees the pages when
+//     the last mapping AND the name are gone, so unlink-while-attached is
+//     safe (the standard anonymous-after-rendezvous idiom).
+//
+// A process-wide registry (guarded, TSA-annotated) counts live segments
+// so tests and the stress harness can assert leak-freedom after chaos
+// rounds that abandon attachments the way a SIGKILLed child would.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "thread_annotations.h"
+
+namespace tft {
+
+class ShmSegment {
+ public:
+  // Creates (O_EXCL) or attaches a named segment; throws SocketError on
+  // failure (name collision, ENOENT on attach, mmap failure). `name` is
+  // normalized to the POSIX form (one leading '/').
+  static ShmSegment* Create(const std::string& name, size_t bytes);
+  static ShmSegment* Attach(const std::string& name, size_t bytes);
+  ~ShmSegment();
+
+  void* data() const { return data_; }
+  size_t size() const { return size_; }
+  const std::string& name() const { return name_; }
+
+  // Removes the NAME (existing mappings stay valid). Idempotent: a
+  // missing name is success — respawn paths unlink defensively.
+  static void Unlink(const std::string& name);
+
+  // Live ShmSegment handles in this process (both creators and
+  // attachments) — the leak oracle for tests/stress.
+  static int64_t live_count();
+
+  ShmSegment(const ShmSegment&) = delete;
+  ShmSegment& operator=(const ShmSegment&) = delete;
+
+ private:
+  ShmSegment(std::string name, void* data, size_t size, bool owner);
+
+  std::string name_;
+  void* data_;
+  size_t size_;
+  // Creator unlinks the name at destruction; attachments never do.
+  const bool owner_;
+};
+
+// The CommPlan leaf->offset layout of a flat-packed signature, exported
+// as JSON — the ONE authority both sides of the shm boundary lay out
+// payloads with (the Python mirror `collectives._plan_groups` is pinned
+// against this in tests). Replicates plan_build's grouping exactly:
+// first-appearance order of the group dtype over leaves in signature
+// order; q8 wires collapse f32/bf16 leaves into a single f32 group, the
+// bf16 wire rides f32 leaves as bf16. Group bases are 64-byte aligned so
+// typed views of the segment stay cache-line clean.
+//
+// Returns {"total_bytes": N,
+//          "groups": [{"dtype": code, "offset": B, "count": C}],
+//          "leaves": [{"group": g, "off": elemOff, "count": C}]}.
+std::string shm_layout_json(const int64_t* counts, const int32_t* dtypes,
+                            int64_t n_leaves, int wire);
+
+}  // namespace tft

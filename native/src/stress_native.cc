@@ -34,11 +34,14 @@
 #include <thread>
 #include <vector>
 
+#include <unistd.h>
+
 #include "collectives.h"
 #include "lighthouse.h"
 #include "manager.h"
 #include "net.h"
 #include "region.h"
+#include "shm.h"
 #include "store.h"
 #include "thread_annotations.h"
 #include "wire.h"
@@ -533,6 +536,83 @@ void hierarchical_churn(int iters) {
   }
 }
 
+// Isolated-data-plane segment churn: the shm lifecycle under the exact
+// patterns a SIGKILLed child leaves behind — attachments abandoned
+// mid-protocol, names unlinked while mappings are live (the respawn
+// path's defensive unlink), concurrent attach/read/write/detach across
+// member threads, and the layout export hammered from every thread. The
+// guarded registry (g_shm_mu / g_live in shm.cc) is the shared state
+// under test; chaos rounds assert liveness, the final round asserts
+// exact data integrity through the segment, and the whole churn must
+// end with zero leaked handles.
+void shm_churn(int iters, int world) {
+  const size_t elems = 4096;
+  int64_t base_live = ShmSegment::live_count();
+  float parent_sum = 0;
+  for (size_t k = 0; k < elems; k++) parent_sum += static_cast<float>(k % 97);
+
+  for (int i = 0; i < iters; i++) {
+    bool chaos = i + 1 < iters;  // last round is chaos-free: exact checks
+    std::string name =
+        "tft_stress_shm_" + std::to_string(getpid()) + "_" + std::to_string(i);
+    std::unique_ptr<ShmSegment> seg(
+        ShmSegment::Create(name, elems * sizeof(float) * (world + 1)));
+    float* parent_block = static_cast<float*>(seg->data());
+    for (size_t k = 0; k < elems; k++)
+      parent_block[k] = static_cast<float>(k % 97);
+
+    std::vector<std::thread> members;
+    for (int r = 0; r < world; r++) {
+      members.emplace_back([&, r] {
+        try {
+          std::unique_ptr<ShmSegment> att(ShmSegment::Attach(
+              name, elems * sizeof(float) * (world + 1)));
+          float* p = static_cast<float*>(att->data());
+          float sum = 0;
+          for (size_t k = 0; k < elems; k++) sum += p[k];
+          if (!chaos)
+            expect(sum == parent_sum, "shm parent block corrupted");
+          float* mine = p + elems * (r + 1);
+          for (size_t k = 0; k < elems; k++)
+            mine[k] = static_cast<float>(r + 1) + static_cast<float>(k % 7);
+          if (chaos && r == 0) {
+            g_ok++;
+            return;  // abandon mid-protocol: the SIGKILLed-child shape
+          }
+          // the layout export is lock-free pure arithmetic; hammer it
+          // concurrently with segment churn
+          int64_t counts[3] = {100, 7, 33};
+          int32_t codes[3] = {0, 2, 0};
+          std::string lay = shm_layout_json(counts, codes, 3, /*wire=*/0);
+          expect(lay.find("total_bytes") != std::string::npos,
+                 "shm layout json malformed");
+          g_ok++;
+        } catch (const std::exception&) {
+          // chaos unlink races Attach: ENOENT is the expected casualty
+          g_failed++;
+        }
+      });
+    }
+    if (chaos) {
+      // Unlink while attachments live (and possibly while Attach races
+      // us): existing mappings stay valid, late attachers fail cleanly.
+      ShmSegment::Unlink(name);
+    }
+    for (auto& t : members) t.join();
+    if (!chaos) {
+      for (int r = 0; r < world; r++) {
+        float* mine = parent_block + elems * (r + 1);
+        expect(mine[0] == static_cast<float>(r + 1) &&
+                   mine[elems - 1] == static_cast<float>(r + 1) +
+                                          static_cast<float>((elems - 1) % 7),
+               "shm member reply block corrupted");
+      }
+    }
+    seg.reset();  // creator destructor: idempotent unlink after chaos
+  }
+  expect(ShmSegment::live_count() == base_live, "shm handles leaked");
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -544,6 +624,7 @@ int main(int argc, char** argv) {
   collectives_stress(rounds, world, stripes, elems);
   control_plane_churn(3);
   hierarchical_churn(3);
+  shm_churn(6, world);
 
   fprintf(stderr,
           "stress_native: ok_ops=%ld failed_ops=%ld checks=%ld%s\n",

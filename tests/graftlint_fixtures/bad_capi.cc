@@ -18,4 +18,10 @@ int tft_fix_undeclared(void* handle) { return 0; }
 // Missing from the pyi _NativeLib block.
 int tft_fix_unstubbed(void* handle) { return 0; }
 
+// Shared-memory surface drift: tft_shm_* symbols ride the same
+// three-file rule as every other export (the isolated-data-plane
+// satellite pinned this — a handle-returning shm export with no restype
+// would hand Python a truncated pointer).
+void* tft_shm_fix_noresty(const char* name, int64_t bytes) { return 0; }
+
 } // extern "C"

@@ -19,6 +19,9 @@ def _load_lib(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tft_fix_unstubbed.argtypes = [ctypes.c_void_p]
 
     # Stale: not exported by bad_capi.cc.
+    # shm drift: void* return declared without restype (pointer mangled).
+    lib.tft_shm_fix_noresty.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+
     lib.tft_fix_stale.restype = ctypes.c_int
     lib.tft_fix_stale.argtypes = [ctypes.c_void_p]
     return lib

@@ -50,6 +50,15 @@ class TestCapiSync:
         assert "tft_fix_phantom stubbed in _NativeLib but not exported" in found
         # pyi side of the argcount drift too.
         assert "tft_fix_argcount stub takes 1 parameters but capi.cc takes 3" in found
+        # tft_shm_* symbols ride the same three-file rule: a handle-
+        # returning shm export with no restype hands Python a truncated
+        # pointer (the isolated-data-plane surface is checked, not
+        # grandfathered).
+        assert (
+            "tft_shm_fix_noresty returns 'void *' but declares no restype "
+            "(ctypes defaults to c_int: truncated int64 / mangled pointer)"
+            in found
+        )
 
     def test_control_function_not_flagged(self):
         assert not any(
@@ -67,6 +76,8 @@ class TestCapiSync:
         assert len(exports) >= 40
         names = {e.name for e in exports}
         assert {"tft_hc_configure", "tft_plan_execute", "tft_last_error"} <= names
+        # the shared-memory lifecycle surface is part of the checked bridge
+        assert {"tft_shm_create", "tft_shm_attach", "tft_shm_layout_json"} <= names
 
 
 class TestLatchDiscipline:

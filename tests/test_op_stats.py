@@ -5,9 +5,17 @@ and per-stripe detail) is the ONLY signal that tells a slow transfer from
 a slow wire on a degraded link — per-step DDP diagnosis depends on it —
 yet until this file nothing asserted its accounting. Covers the
 device-packed bulk path, the chunk-pipelined op schedule, the q8 wire,
-and the plan path's per-bucket stats.
+the plan path's per-bucket stats, and — since the accounting contract
+went cross-backend (OpStatsMixin) — the XLA and isolated-XLA backends'
+parity keys (``op`` / ``bytes`` / ``d2h_bytes`` on every path), so
+AdaptiveDDP probe comparisons and diagnosis tooling read one schema no
+matter which data plane served the op.
 """
 
+import os
+import subprocess
+import sys
+import textwrap
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
@@ -15,8 +23,12 @@ from datetime import timedelta
 import numpy as np
 import pytest
 
+from conftest import CPU_MULTIPROCESS_SKIP, HAS_CPU_MULTIPROCESS
+
 from torchft_tpu._native import Store
 from torchft_tpu.collectives import HostCollectives, ReduceOp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture
@@ -210,6 +222,106 @@ class TestShardedStats:
         )
         for c in cols:
             c.shutdown()
+
+
+class TestIsolatedBackendStats:
+    def test_iso_entries_carry_the_parity_keys(self, store):
+        # The isolated backend drains through the SAME pop_op_stats
+        # contract as the host ring: op / bytes / d2h_bytes on every
+        # entry, plus its child-side wall and measured reduction path.
+        import jax.numpy as jnp
+
+        from torchft_tpu.isolated_xla import IsolatedXLACollectives
+
+        cols = [
+            IsolatedXLACollectives(timeout=timedelta(seconds=20))
+            for _ in range(2)
+        ]
+        addr = f"{store.address()}/isostats"
+        try:
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(
+                    lambda r: cols[r].configure(addr, r, 2), range(2)
+                ))
+                list(ex.map(
+                    lambda r: cols[r].allreduce(
+                        {"w": jnp.ones(2048, jnp.float32)}, ReduceOp.AVG
+                    ).wait(),
+                    range(2),
+                ))
+            stats = cols[0].pop_op_stats()
+            ar = [s for s in stats if s["op"] == "allreduce"][-1]
+            assert ar["backend"] == "iso"
+            assert ar["bytes"] >= 2048 * 4
+            assert ar["d2h_bytes"] == 2048 * 4  # the jax leaf's d2h leg
+            assert ar["path"] in ("psum", "store")
+            for key in ("pack", "d2h", "ring", "h2d", "child_s"):
+                assert key in ar and ar[key] >= 0.0
+            cfg = [s for s in stats if s["op"] == "configure"][-1]
+            assert {"spawn_s", "child_init_s", "rendezvous_s"} <= set(cfg)
+        finally:
+            for c in cols:
+                c.shutdown()
+
+
+_XLA_STATS_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+    sys.path.insert(0, {repo!r})
+    import jax, numpy as np, jax.numpy as jnp
+    jax.config.update("jax_platforms", "cpu")
+    from datetime import timedelta
+    from torchft_tpu import XLACollectives
+    from torchft_tpu.collectives import ReduceOp
+
+    rank = int(sys.argv[1]); store_addr = sys.argv[2]
+    xc = XLACollectives(timeout=timedelta(seconds=60),
+                        connect_timeout=timedelta(seconds=60))
+    xc.configure(store_addr + "/q0", rank, 2)
+    xc.allreduce({{"w": jnp.ones(1024, jnp.float32)}}, ReduceOp.SUM).wait()
+    xc.allgather(jnp.ones(16, jnp.float32)).wait()
+    xc.broadcast(jnp.ones(16, jnp.float32)).wait()
+    stats = xc.pop_op_stats()
+    ops = [s["op"] for s in stats]
+    assert "allreduce" in ops and "allgather" in ops and "broadcast" in ops, ops
+    ar = [s for s in stats if s["op"] == "allreduce"][-1]
+    assert ar["backend"] == "xla"
+    assert ar["bytes"] == 1024 * 4
+    assert ar["d2h_bytes"] == 1024 * 4  # host-backed results: localize fetch
+    for key in ("pack", "ring", "h2d"):
+        assert key in ar
+    ag = [s for s in stats if s["op"] == "allgather"][-1]
+    assert ag["d2h_bytes"] == 16 * 4 * 2  # every member's row fetched
+    assert xc.pop_op_stats() == []
+    print("XLA-STATS-OK")
+    xc.shutdown()
+    """
+).format(repo=REPO)
+
+
+@pytest.mark.skipif(not HAS_CPU_MULTIPROCESS, reason=CPU_MULTIPROCESS_SKIP)
+class TestXLABackendStats:
+    def test_xla_entries_carry_the_parity_keys(self, store):
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _XLA_STATS_WORKER, str(r),
+                 store.address()],
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for r in range(2)
+        ]
+        try:
+            outs = [p.communicate(timeout=180)[0] for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out
+            assert "XLA-STATS-OK" in out
 
 
 class TestPlanStats:

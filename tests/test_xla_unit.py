@@ -1,0 +1,254 @@
+"""XLACollectives unit coverage that needs NO multiprocess collectives
+backend: the coordinator port-reservation protocol (the close-then-rebind
+race fix) and the ``_pending_snapshots`` teardown discipline.
+
+Worker subprocesses are still used wherever ``jax.distributed`` state is
+touched — ``initialize()`` binds the whole process to a cohort and the
+pytest process must stay unpolluted — but no cross-process COMPUTATION is
+dispatched, so these run on any jax (unlike tests/test_xla_collectives.py,
+which needs the gloo CPU collectives build).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from torchft_tpu.xla_collectives import (
+    _coord_key,
+    _is_bind_failure,
+    _reserve_port,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_workers(body: str, nprocs: int = 1, timeout: float = 180.0):
+    from torchft_tpu import Store
+
+    store = Store()
+    prelude = textwrap.dedent(
+        """
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {repo!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import jax.numpy as jnp
+        from datetime import timedelta
+        from torchft_tpu import XLACollectives
+        from torchft_tpu.collectives import ReduceOp
+
+        rank = int(sys.argv[1])
+        store_addr = sys.argv[2]
+        xc = XLACollectives(timeout=timedelta(seconds=30),
+                            connect_timeout=timedelta(seconds=10))
+        """
+    ).format(repo=REPO)
+    script = prelude + textwrap.dedent(body)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(r), store.address()],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        store.shutdown()
+    for rc, out in outs:
+        assert rc == 0, f"worker failed:\n{out}"
+    return [out for _, out in outs]
+
+
+class TestPortReservation:
+    def test_reserved_port_is_actually_held(self):
+        # The fix's whole point: the port cannot be taken between
+        # publication and initialize because the reserving socket still
+        # holds the bind.
+        port, held = _reserve_port()
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            with pytest.raises(OSError):
+                probe.bind(("", port))
+            probe.close()
+        finally:
+            held.close()
+        # released: the runtime (or anyone) can bind it now
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", port))
+        s.close()
+
+    def test_bind_failure_classifier(self):
+        assert _is_bind_failure(
+            RuntimeError("UNKNOWN: Failed to start server: "
+                         "Address already in use")
+        )
+        assert _is_bind_failure(OSError("bind failed: port taken"))
+        assert not _is_bind_failure(
+            RuntimeError("jax.distributed.initialize() must be called "
+                         "before any JAX computations")
+        )
+        assert not _is_bind_failure(TimeoutError("barrier timed out"))
+
+    def test_coord_keys_are_attempt_scoped(self):
+        assert _coord_key("p", 0) == "p/xla_coordinator"
+        assert _coord_key("p", 2) == "p/xla_coordinator/r2"
+        assert _coord_key("", 1) == "xla_coordinator/r1"
+
+    def test_lost_race_rank0_republishes_and_recovers(self):
+        # The lost-race path, end to end in one worker: the first
+        # initialize "loses" the close->bind instant (injected bind
+        # failure), configure reserves a FRESH port, republishes under
+        # the attempt key, and succeeds — instead of failing the quorum
+        # round like the old probe-then-close helper.
+        outs = _run_workers(
+            """
+            import jax.distributed as jd
+            from torchft_tpu._native import StoreClient
+            real_init = jd.initialize
+            calls = {"n": 0}
+            def flaky(**kw):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError(
+                        "Failed to start server: Address already in use")
+                return real_init(**kw)
+            jd.initialize = flaky
+            xc.configure(store_addr + "/q0", 0, 1)
+            jd.initialize = real_init
+            assert calls["n"] == 2, calls
+
+            # both attempt keys were published, with DIFFERENT ports
+            store = StoreClient(store_addr,
+                                connect_timeout=timedelta(seconds=5))
+            a0 = store.get("q0/xla_coordinator",
+                           timeout=timedelta(seconds=5)).decode()
+            a1 = store.get("q0/xla_coordinator/r1",
+                           timeout=timedelta(seconds=5)).decode()
+            assert a0 != a1, (a0, a1)
+
+            # the recovered runtime works
+            out = xc.allreduce(jnp.ones((3,)), ReduceOp.SUM).wait()
+            assert np.allclose(np.asarray(out), 1.0)
+            print("OK")
+            xc.shutdown()
+            """
+        )
+        assert "OK" in outs[0]
+
+    def test_lost_race_nonzero_rank_follows_retry_key(self):
+        # Two processes, no collective computation: rank 0's first
+        # initialize loses the race; rank 1's first initialize fails
+        # against the doomed attempt-0 coordinator (injected — in
+        # production it times out connecting). Rank 1 must find the
+        # attempt-1 key and re-rendezvous instead of raising.
+        outs = _run_workers(
+            """
+            import jax.distributed as jd
+            real_init = jd.initialize
+            calls = {"n": 0}
+            def flaky(**kw):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    if rank == 0:
+                        raise RuntimeError(
+                            "Failed to start server: "
+                            "Address already in use")
+                    raise RuntimeError(
+                        "injected: coordinator never came up")
+                return real_init(**kw)
+            jd.initialize = flaky
+            xc.configure(store_addr + "/q0", rank, 2)
+            jd.initialize = real_init
+            assert calls["n"] == 2, calls
+            assert xc.size() == 2 and xc.rank() == rank
+            # sync exits through the store: the coordinator (rank 0's
+            # in-process service) must outlive rank 1's heartbeat or the
+            # coordination client fatals the process
+            from torchft_tpu._native import StoreClient
+            sc = StoreClient(store_addr, connect_timeout=timedelta(seconds=5))
+            sc.set(f"done{rank}", b"1")
+            sc.get(f"done{1 - rank}", timeout=timedelta(seconds=30))
+            print("OK", rank)
+            """,
+            nprocs=2,
+        )
+        for r, out in enumerate(outs):
+            assert f"OK {r}" in out
+
+
+class TestPendingSnapshotDiscipline:
+    def test_snapshot_never_overwritten_across_double_failure(self):
+        # The documented-but-untested branch (xla_collectives.py
+        # teardown_backends): after a teardown orphaned the registered
+        # holders, a SECOND teardown on the retry path must NOT
+        # re-snapshot — the holders' arrays are already orphans, and
+        # re-capturing them could capture garbage. The injected
+        # initialize corrupts the holder before failing, so a broken
+        # guard would restore the corruption; the correct guard restores
+        # the pre-teardown values.
+        outs = _run_workers(
+            """
+            import optax
+            from torchft_tpu import FTTrainState
+
+            state = FTTrainState({"w": jnp.arange(4, dtype=jnp.float32)},
+                                 optax.sgd(0.1))
+            xc.register_state(state)
+            xc.configure(store_addr + "/q0", 0, 1)
+            state.apply_gradients({"w": jnp.ones((4,))})
+            good = np.asarray(state.params["w"]).copy()
+
+            import jax.distributed as jd
+            real_init = jd.initialize
+            calls = {"n": 0}
+            def flaky(**kw):
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    # simulate the orphaning hazard: the holder's arrays
+                    # are garbage by the time the retry path's second
+                    # teardown_backends runs. The message matches the
+                    # backend-predates signature so the FIRST failure
+                    # takes the teardown-and-retry-once branch (where
+                    # the never-overwrite guard lives).
+                    state.params = {"w": jnp.full((4,), -777.0)}
+                    raise RuntimeError(
+                        "initialize() must be called before any JAX "
+                        "computations (injected %d)" % calls["n"])
+                return real_init(**kw)
+            jd.initialize = flaky
+            try:
+                xc.configure(store_addr + "/q1", 0, 1)
+                raise SystemExit("expected injected failure")
+            except RuntimeError as e:
+                assert "injected 2" in str(e), e
+            # both inner attempts ran (teardown happened between them,
+            # with a snapshot already pending)
+            assert calls["n"] == 2, calls
+            jd.initialize = real_init
+
+            xc.configure(store_addr + "/q2", 0, 1)
+            after = np.asarray(state.params["w"])
+            assert np.array_equal(after, good), (after, good)
+            print("OK")
+            xc.shutdown()
+            """
+        )
+        assert "OK" in outs[0]

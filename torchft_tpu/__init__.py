@@ -27,6 +27,7 @@ from torchft_tpu.collectives import (
 )
 from torchft_tpu.data import DistributedSampler, StatefulDataLoader
 from torchft_tpu.durable import DurableCheckpointer
+from torchft_tpu.isolated_xla import IsolatedXLACollectives
 from torchft_tpu.ddp import AdaptiveDDP, DistributedDataParallel, PipelinedDDP
 from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -50,6 +51,7 @@ __all__ = [
     "DurableCheckpointer",
     "LocalSGD",
     "HostCollectives",
+    "IsolatedXLACollectives",
     "LeaseClient",
     "Lighthouse",
     "RegionLighthouse",

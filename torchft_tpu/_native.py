@@ -411,6 +411,28 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_void_p),
     ]
+    # Shared-memory segments (the isolated accelerator data plane's
+    # staging buffers; consumed by torchft_tpu.isolated_xla).
+    lib.tft_shm_create.restype = ctypes.c_void_p
+    lib.tft_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tft_shm_attach.restype = ctypes.c_void_p
+    lib.tft_shm_attach.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tft_shm_data.restype = ctypes.c_void_p
+    lib.tft_shm_data.argtypes = [ctypes.c_void_p]
+    lib.tft_shm_size.restype = ctypes.c_int64
+    lib.tft_shm_size.argtypes = [ctypes.c_void_p]
+    lib.tft_shm_close.argtypes = [ctypes.c_void_p]
+    lib.tft_shm_unlink.restype = ctypes.c_int
+    lib.tft_shm_unlink.argtypes = [ctypes.c_char_p]
+    lib.tft_shm_live_count.restype = ctypes.c_int64
+    lib.tft_shm_layout_json.restype = ctypes.c_int
+    lib.tft_shm_layout_json.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),  # per-leaf flat element counts
+        ctypes.POINTER(ctypes.c_int32),  # per-leaf native dtype codes
+        ctypes.c_int64,                  # leaf count
+        ctypes.c_int,                    # wire: 0 native, 1 bf16, 2 q8, 3 q8+EF
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
     return lib
 
 
@@ -989,3 +1011,81 @@ def backoff_ms(failures: int, base_ms: int, max_ms: int, seed: int) -> int:
 def jittered_interval_ms(interval_ms: int, seed: int, tick: int) -> int:
     """Deterministic jittered renewal interval (herd spreading)."""
     return _lib.tft_jittered_interval_ms(interval_ms, seed, tick)
+
+
+class ShmSegment:
+    """A mapped POSIX shared-memory segment (native lifecycle, see
+    native/src/shm.h): the staging buffer the isolated XLA backend feeds
+    its disposable child through. The CREATOR owns the name (unlinks it
+    on close); attachments never unlink. ``buffer()`` exposes the mapped
+    bytes as a writable memoryview — numpy views of it are zero-copy, and
+    a child attached to the same name reads the identical pages."""
+
+    def __init__(self, name: str, nbytes: int, create: bool) -> None:
+        fn = _lib.tft_shm_create if create else _lib.tft_shm_attach
+        self._handle = fn(name.encode(), nbytes)
+        if not self._handle:
+            _check(2)
+        self._nbytes = nbytes
+        self.name = name
+
+    @classmethod
+    def create(cls, name: str, nbytes: int) -> "ShmSegment":
+        return cls(name, nbytes, create=True)
+
+    @classmethod
+    def attach(cls, name: str, nbytes: int) -> "ShmSegment":
+        return cls(name, nbytes, create=False)
+
+    def buffer(self) -> memoryview:
+        """Writable view of the mapped pages (zero-copy; valid until
+        ``close``). Callers must drop every numpy view derived from it
+        before closing — the mapping is unmapped underneath them."""
+        assert self._handle, "segment closed"
+        ptr = _lib.tft_shm_data(self._handle)
+        return memoryview(
+            (ctypes.c_char * self._nbytes).from_address(ptr)
+        ).cast("B")
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def close(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and _lib is not None:
+            _lib.tft_shm_close(handle)
+
+    def __del__(self) -> None:
+        self.close()
+
+
+def shm_unlink(name: str) -> None:
+    """Removes a segment NAME (idempotent; existing mappings stay valid)
+    — the defensive cleanup respawn paths run before re-creating."""
+    _check(_lib.tft_shm_unlink(name.encode()))
+
+
+def shm_live_count() -> int:
+    """Live ShmSegment handles in this process — the leak oracle."""
+    return _lib.tft_shm_live_count()
+
+
+def shm_layout(counts: List[int], dtype_codes: List[int], wire: int = 0) -> dict:
+    """The CommPlan leaf->offset layout of a flat-packed signature — the
+    native authority BOTH sides of the shm boundary lay payloads out with
+    (plan_build's first-appearance grouping; 64-byte-aligned group bases).
+    Returns ``{"total_bytes", "groups": [{dtype, offset, count}],
+    "leaves": [{group, off, count}]}``."""
+    n = len(counts)
+    out = ctypes.c_void_p()
+    _check(
+        _lib.tft_shm_layout_json(
+            (ctypes.c_int64 * n)(*counts),
+            (ctypes.c_int32 * n)(*dtype_codes),
+            n,
+            wire,
+            ctypes.byref(out),
+        )
+    )
+    return json.loads(_take_string(out))

@@ -433,6 +433,21 @@ class _NativeLib:
         plan_id: int,
         out: Any
     ) -> int: ...
+    def tft_shm_create(self, name: bytes, nbytes: int) -> Any: ...
+    def tft_shm_attach(self, name: bytes, nbytes: int) -> Any: ...
+    def tft_shm_data(self, handle: Any) -> int: ...
+    def tft_shm_size(self, handle: Any) -> int: ...
+    def tft_shm_close(self, handle: Any) -> None: ...
+    def tft_shm_unlink(self, name: bytes) -> int: ...
+    def tft_shm_live_count(self) -> int: ...
+    def tft_shm_layout_json(
+        self,
+        counts: Any,
+        dtypes: Any,
+        n_leaves: int,
+        wire: int,
+        out: Any
+    ) -> int: ...
     def tft_hc_allgather(
         self,
         handle: Any,
@@ -552,3 +567,28 @@ def backoff_ms(failures: int, base_ms: int, max_ms: int, seed: int) -> int: ...
 
 
 def jittered_interval_ms(interval_ms: int, seed: int, tick: int) -> int: ...
+
+
+class ShmSegment:
+    name: str
+
+    def __init__(self, name: str, nbytes: int, create: bool) -> None: ...
+    @classmethod
+    def create(cls, name: str, nbytes: int) -> "ShmSegment": ...
+    @classmethod
+    def attach(cls, name: str, nbytes: int) -> "ShmSegment": ...
+    def buffer(self) -> memoryview: ...
+    @property
+    def nbytes(self) -> int: ...
+    def close(self) -> None: ...
+
+
+def shm_unlink(name: str) -> None: ...
+
+
+def shm_live_count() -> int: ...
+
+
+def shm_layout(
+    counts: List[int], dtype_codes: List[int], wire: int = 0
+) -> dict: ...

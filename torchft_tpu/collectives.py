@@ -664,7 +664,47 @@ class _CommPlan:
             self.wire_bytes = self.bytes
 
 
-class HostCollectives(Collectives):
+class OpStatsMixin:
+    """Per-op phase-timing recorder shared by every data-plane backend
+    (host ring, XLA, isolated XLA): the accounting contract AdaptiveDDP's
+    probe comparisons and the diagnosis tooling rely on is that EVERY
+    backend's ops drain through one ``pop_op_stats`` with the same core
+    keys — ``op``, ``bytes`` (payload) and ``d2h_bytes`` (what actually
+    crossed the device link) — plus backend-specific phase timings."""
+
+    _op_stats: List[dict]
+
+    def _record_op_stats(self, stats: dict) -> None:
+        if not hasattr(self, "_op_stats"):
+            self._op_stats = []
+        self._op_stats.append(stats)
+        # Bounded: diagnostics, not a log. 256 keeps a full per-step
+        # breakdown window alive — at one gradient op + a handful of
+        # control ops per step, 64 silently dropped the early entries
+        # before the caller's median ever saw them.
+        del self._op_stats[:-256]
+
+    def pop_op_stats(self) -> List[dict]:
+        """Drains the recorded per-op phase timings (seconds). Core keys
+        on every backend: ``op``, ``bytes`` (the logical payload) and
+        ``d2h_bytes`` (bytes that crossed the DEVICE link — the number
+        that tells a slow transfer from a slow wire). Host-ring entries
+        additionally carry ``wire_bytes``/``chunks``/``stripe_s`` and the
+        per-bucket plan breakdown; XLA-path entries carry the
+        stack/dispatch/localize split; isolated entries add the
+        child-side wall and reduction path."""
+        out, self._op_stats = getattr(self, "_op_stats", []), []
+        for st in out:
+            # Plan entries carry their native per-bucket stats as a raw
+            # JSON string (decoding per step would put a parse on the
+            # zero-Python hot path); decode at drain time.
+            raw = st.pop("_buckets_json", None)
+            if raw is not None:
+                st["buckets"] = json.loads(raw).get("buckets", [])
+        return out
+
+
+class HostCollectives(OpStatsMixin, Collectives):
     """Deterministic TCP ring collectives (native C++), the Gloo role.
 
     One contiguous buffer per dtype group is reduced per op — leaves are
@@ -744,14 +784,6 @@ class HostCollectives(Collectives):
         # 10x the ring leg, and nothing else distinguishes them.
         self._op_stats: List[dict] = []
 
-    def _record_op_stats(self, stats: dict) -> None:
-        self._op_stats.append(stats)
-        # Bounded: diagnostics, not a log. 256 keeps a full per-step
-        # breakdown window alive — at one gradient op + a handful of
-        # control ops per step, 64 silently dropped the early entries
-        # before the caller's median ever saw them.
-        del self._op_stats[:-256]
-
     def _last_stripe_seconds(self) -> List[float]:
         """Per-stripe wall times (s) of the last native ring op; safe only
         on the op-executor thread (which is where all ring calls run)."""
@@ -759,31 +791,13 @@ class HostCollectives(Collectives):
         n = _lib.tft_hc_last_stripe_ns(self._handle, buf, _MAX_STRIPES)
         return [buf[i] / 1e9 for i in range(min(n, _MAX_STRIPES))]
 
-    def pop_op_stats(self) -> List[dict]:
-        """Drains the per-op phase timings (seconds) the device-packed
-        paths recorded: ``pack`` (jitted concat dispatch), ``d2h`` (the
-        blocking device→host read), ``ring`` (the native TCP op), ``h2d``
-        (result upload + unpack DISPATCH — jax uploads asynchronously, so
-        the actual transfer completes under the caller's next use/drain
-        and is charged there, not here), plus ``bytes`` = the bytes that
-        crossed the DEVICE link (``wire_bytes`` additionally, where the
-        TCP wire ships a different encoding — the q8 ring sends ~1/4 of
-        its f32 device payload). Bulk allreduce stats additionally carry
-        ``buckets`` — the per-dtype-bucket phase breakdown of the
-        cross-buffer op schedule, each with ``stripe_s``, the per-stripe
-        ring wall times (a skewed stripe means one of the parallel
-        connections is degraded). The numbers that tell a slow
-        collective's transfer cost from its wire cost — per-step DDP on a
-        degraded device link is diagnosable only with this split."""
-        out, self._op_stats = self._op_stats, []
-        for st in out:
-            # Plan entries carry their native per-bucket stats as a raw
-            # JSON string (decoding per step would put a parse on the
-            # zero-Python hot path); decode at drain time.
-            raw = st.pop("_buckets_json", None)
-            if raw is not None:
-                st["buckets"] = json.loads(raw).get("buckets", [])
-        return out
+    # pop_op_stats: OpStatsMixin. Host-ring entries record ``pack``
+    # (jitted concat dispatch), ``d2h`` (the blocking device→host read),
+    # ``ring`` (the native TCP op), ``h2d`` (result upload + unpack
+    # DISPATCH — jax uploads asynchronously, so the actual transfer
+    # completes under the caller's next use/drain and is charged there),
+    # ``wire_bytes`` where the TCP wire ships a different encoding, and
+    # per-bucket ``buckets`` with per-stripe ring wall times.
 
     # -- lifecycle --
 

@@ -157,12 +157,19 @@ class PipelinedDDP:
         members need not agree)."""
         if compress not in (None, "bf16", "int8", "q8"):
             raise ValueError(f"unsupported compress: {compress!r}")
-        if transport not in ("legacy", "plan"):
+        if transport not in ("legacy", "plan", "iso"):
             raise ValueError(f"unsupported transport: {transport!r}")
-        if transport == "plan" and compress == "int8":
+        if transport in ("plan", "iso") and compress == "int8":
             raise ValueError(
-                "compress='int8' rides a managed allgather; the comm-plan "
-                "transport has no allgather form (use compress='q8')"
+                "compress='int8' rides a managed allgather; the plan and "
+                "isolated transports have no allgather form (use "
+                "compress='q8')"
+            )
+        if transport == "iso" and not getattr(
+            manager, "has_iso_plane", lambda: False
+        )():
+            raise ValueError(
+                "transport='iso' needs Manager(iso_collectives=...)"
             )
         self._manager = manager
         self._state = state
@@ -268,6 +275,13 @@ class PipelinedDDP:
             return self._manager.plan_allreduce(
                 grads, wire=wire, device_pack=self._device_pack
             )
+        if self._transport == "iso":
+            # Isolated XLA data plane: same compress pipeline as legacy
+            # (the backend serves every wire losslessly — the compiled
+            # path's contract), dispatched through the disposable child.
+            payload = self._compress(grads)
+            wire = "q8" if self._compress_mode == "q8" else None
+            return self._manager.iso_allreduce(payload, wire=wire)
         payload = self._compress(grads)
         if self._compress_mode == "int8":
             return self._manager.allgather(payload)
@@ -427,7 +441,12 @@ class AdaptiveDDP:
     # the SAME lockstep-vote argmin as the schedule choice — on hosts
     # where the interpret-mode kernels are slower than the host pack the
     # probe measures it and host pack wins (the CPU fallback), on real
-    # device links the d2h saving wins.
+    # device links the d2h saving wins. "xla_iso" (the isolated-child
+    # XLA data plane) joins only when the manager carries an iso plane:
+    # host-ring vs compiled-XLA-path is then LOCKED per cohort by the
+    # same vote, never assumed — and an un-spawnable or store-fallback
+    # child simply measures slow (or records the failure sentinel), so
+    # the candidate can never win by crashing.
     _CANDIDATES = ("blocking", "plan", "pipelined")
 
     # Recorded instead of wall time for a probe step whose transaction
@@ -447,7 +466,7 @@ class AdaptiveDDP:
         device_pack: Any = None,
     ) -> None:
         mode = mode or os.environ.get("TORCHFT_DDP_MODE", "auto")
-        if mode not in ("auto", "blocking", "pipelined", "plan"):
+        if mode not in ("auto", "blocking", "pipelined", "plan", "xla_iso"):
             raise ValueError(f"unsupported TORCHFT_DDP_MODE: {mode!r}")
         self._manager = manager
         # One underlying engine; mode switches flip (transport, overlap).
@@ -468,8 +487,25 @@ class AdaptiveDDP:
             self._candidates.insert(
                 self._candidates.index("plan") + 1, "plan_devpack"
             )
+        has_iso = getattr(manager, "has_iso_plane", lambda: False)()
+        if has_iso and compress != "int8":
+            # Isolated-XLA-path candidate: the host-ring-vs-XLA decision
+            # rides the same cohort-agreed argmin as everything else.
+            # Candidate-list membership is keyed on the manager's
+            # CONSTRUCTION (every member attaches the plane or none do,
+            # like every other schedule knob), never on child health —
+            # a sick child records sentinels, not a shorter list.
+            self._candidates.append("xla_iso")
         if mode == "plan" and compress == "int8":
             raise ValueError("compress='int8' has no plan transport")
+        if mode == "xla_iso":
+            if compress == "int8":
+                raise ValueError("compress='int8' has no iso transport")
+            if not has_iso:
+                raise ValueError(
+                    "TORCHFT_DDP_MODE=xla_iso needs "
+                    "Manager(iso_collectives=...)"
+                )
         self._probe_steps = max(int(probe_steps), 2)
         self._mode: Optional[str] = mode if mode != "auto" else None
         self._auto = mode == "auto"
@@ -519,12 +555,16 @@ class AdaptiveDDP:
                 # candidate's settle verdict rather than inherit it.
                 d.last_commit = None
             return d.step(*batch)
-        # Blocking schedule (settle in-step), legacy or plan transport.
+        # Blocking schedule (settle in-step); legacy, plan or iso
+        # transport.
         if d._inflight is not None:
             d._settle()  # leaving pipelined mode: drain the overlap
-        d._transport = (
-            "plan" if mode in ("plan", "plan_devpack") else "legacy"
-        )
+        if mode in ("plan", "plan_devpack"):
+            d._transport = "plan"
+        elif mode == "xla_iso":
+            d._transport = "iso"
+        else:
+            d._transport = "legacy"
         if mode == "plan_devpack":
             d._device_pack = True
         elif mode == "plan":

@@ -1,0 +1,1468 @@
+"""Isolated XLA collectives: the compiled data plane in a disposable child.
+
+The reference solves "a compiled collective wedges until the runtime
+heartbeat gives up" by running NCCL in a killable subprocess ("Baby"
+process groups, reference torchft/process_group.py:551-1064): the parent
+feeds it tensors through shared memory, watches it through monitored
+queues, and a wedge or death is SIGKILL + respawn instead of a stuck
+training process. This module is the JAX equivalent:
+
+- :class:`IsolatedXLACollectives` (the parent half) owns NO ``jax.distributed``
+  state. Payloads are laid out into POSIX shared-memory segments with the
+  CommPlan leaf->offset discipline (the native ``tft_shm_layout_json``
+  authority — one flat buffer per accumulation dtype, 64-byte-aligned
+  group bases), device arrays never leave the parent (d2h/h2d ride the
+  parent's async streams into persistent segment views), and commands
+  cross a monitored line-JSON channel that is liveness-polled against the
+  child pid — the reference's ``_MonitoredQueue`` role. Child exceptions
+  re-raise in the parent with the child traceback attached.
+- The CHILD maps the same segments, runs ``jax.distributed`` + the jitted
+  global-mesh reduction (an :class:`~torchft_tpu.xla_collectives.XLACollectives`
+  instance — bit-identity with the in-process backend is structural), and
+  writes results back. Where the platform has no compiled multi-process
+  path (CPU jax without a gloo collectives build), a capability PROBE at
+  configure time falls back to a store-mediated numpy reduction — the
+  verdict is measured, stamped into every op's stats, and never assumed.
+- ``configure()`` onto new membership is **SIGKILL + respawn + store
+  re-rendezvous**: the parent's live jax arrays are never orphaned (no
+  in-process ``jax.distributed`` teardown, no backend clear, no
+  snapshot-to-host round trip), and a peer that is alive-but-stuck can
+  never wedge the parent past one step deadline — the monitored channel
+  times out, the error latches through the manager's managed discipline
+  (child death -> ``None``/input default + latch -> the commit vote
+  discards the step), and the next quorum's configure respawns.
+
+Respawn is import-warm: an optional single-threaded fork server (the
+PR-5 zygote discipline — imports jax/numpy once, never initializes the
+XLA backend, forks a ready child per request; ``TORCHFT_ISO_ZYGOTE=0``
+disables) turns the ~1-3 s cold interpreter+import bill into a ~ms fork.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import _native
+from .collectives import (
+    _NATIVE_DTYPES,
+    Collectives,
+    OpStatsMixin,
+    ReduceOp,
+    Work,
+    _divide_leaf,
+    _flatten,
+    _is_jax_array,
+    _unflatten,
+)
+
+# Payload-slot window of the store-fallback reduction: op n's payload keys
+# reuse slot n % window. A member can run at most one op ahead of the
+# slowest reader (finishing op n requires every member to have STARTED op
+# n), so any window >= 2 keeps writers from clobbering in-flight reads;
+# READ freshness additionally needs the per-(slot, rank) version key (see
+# _child_store_exchange — key existence alone would serve window-old
+# payloads). Memory honesty: the store retains, per quorum prefix, up to
+# window * world of each slot's LARGEST historical payload (a later
+# smaller op overwrites only its own chunk count), plus one 8-byte
+# barrier counter per barrier/broadcast op — bounded per step in
+# payloads, and barrier counters only grow on the rare control ops, all
+# discarded with the per-quorum prefix.
+_STORE_SLOTS = 4
+
+
+def _liveness_interval_s() -> float:
+    """How often the monitored channel polls the child pid while waiting
+    for a reply (``TORCHFT_ISO_LIVENESS_MS``, default 100): the bound on
+    how long a dead child can masquerade as a slow one."""
+    try:
+        return max(int(os.environ.get("TORCHFT_ISO_LIVENESS_MS", "100")), 10) / 1e3
+    except ValueError:
+        return 0.1
+
+
+def _zygote_enabled() -> bool:
+    return os.environ.get("TORCHFT_ISO_ZYGOTE", "1") != "0"
+
+
+class ChildDiedError(RuntimeError):
+    """The isolated child exited (or was killed) while the parent was
+    talking to it. Latches through the managed discipline like any other
+    data-plane error; the next quorum's configure() respawns."""
+
+
+# --------------------------------------------------------------------------
+# monitored channel: line JSON over a socket, liveness-polled
+# --------------------------------------------------------------------------
+
+
+class _MonitoredChannel:
+    """The reference's ``_MonitoredQueue`` role: a command/result pipe
+    that can never outwait a dead peer. ``recv`` polls the child's
+    liveness between select ticks, so a SIGKILLed or crashed child
+    surfaces as :class:`ChildDiedError` within one liveness interval
+    instead of the full op timeout; child-reported exceptions re-raise in
+    the parent with the child traceback attached."""
+
+    def __init__(self, sock: socket.socket, alive: Callable[[], Optional[int]]) -> None:
+        self._sock = sock
+        self._alive = alive  # returns exit code once dead, None while alive
+        self._buf = b""
+
+    def send(self, msg: dict) -> None:
+        try:
+            self._sock.sendall(json.dumps(msg).encode() + b"\n")
+        except OSError as e:
+            raise ChildDiedError(
+                f"isolated xla child unreachable on send: {e}"
+            ) from e
+
+    def recv(self, timeout_s: float) -> dict:
+        deadline = time.perf_counter() + timeout_s
+        tick = _liveness_interval_s()
+        while b"\n" not in self._buf:
+            rc = self._alive()
+            if rc is not None:
+                raise ChildDiedError(
+                    f"isolated xla child died (rc={rc}) mid-op"
+                )
+            remain = deadline - time.perf_counter()
+            if remain <= 0:
+                raise TimeoutError(
+                    f"isolated xla child reply timed out after {timeout_s:.1f}s"
+                )
+            try:
+                ready, _, _ = select.select(
+                    [self._sock], [], [], min(tick, remain)
+                )
+                if not ready:
+                    continue
+                chunk = self._sock.recv(1 << 16)
+            except (OSError, ValueError) as e:
+                # kill_child() closed the socket under us (abort /
+                # reconfigure): the op fails fast, not at the timeout.
+                raise ChildDiedError(
+                    f"isolated xla channel closed mid-op: {e}"
+                ) from e
+            if not chunk:
+                rc = self._alive()
+                raise ChildDiedError(
+                    f"isolated xla child closed its channel (rc={rc})"
+                )
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        msg = json.loads(line)
+        if "error" in msg:
+            # Re-raise the child's exception in the parent — the
+            # monitored-queue contract (reference process_group.py:
+            # exceptions cross the queue, not just results).
+            raise RuntimeError(
+                "isolated xla child error: " + msg["error"]
+                + ("\n--- child traceback ---\n" + msg["tb"] if msg.get("tb") else "")
+            )
+        return msg
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------
+# child process handles: zygote fork or classic spawn
+# --------------------------------------------------------------------------
+
+
+class _ChildHandle:
+    """Uniform pid-level surface over a zygote-forked or Popen child."""
+
+    def __init__(self, pid: int, poll: Callable[[], Optional[int]]) -> None:
+        self.pid = pid
+        self._poll = poll
+
+    def poll(self) -> Optional[int]:
+        return self._poll()
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+class _Zygote:
+    """Import-warm fork server for isolated-child respawn (the PR-5
+    zygote discipline): pays the jax/numpy import bill ONCE in a
+    single-threaded helper that never initializes the XLA backend, then
+    forks a ready child per request — respawn after a SIGKILL costs a
+    fork instead of a cold interpreter start. Protocol (line JSON):
+    ``{"connect": "host:port", "env": {overrides}}`` -> fork ->
+    ``{"pid": P}``; reaped children surface as ``{"exit": P, "rc": RC}``
+    (kills appear as negative signal codes, subprocess semantics)."""
+
+    def __init__(self) -> None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        self.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "from torchft_tpu.isolated_xla import main; main()",
+                "--zygote",
+            ],
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            text=True,
+            bufsize=1,
+        )
+        self.exit_codes: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._responses: List[dict] = []
+        self._resp_cv = threading.Condition()
+        threading.Thread(
+            target=self._read, daemon=True, name="iso_zygote_reader"
+        ).start()
+        msg = self._wait_response(timeout=120.0)
+        if not msg.get("ready"):
+            raise RuntimeError(f"iso zygote failed to warm: {msg}")
+
+    def _wait_response(self, timeout: float) -> dict:
+        with self._resp_cv:
+            deadline = time.monotonic() + timeout
+            while not self._responses:
+                remain = deadline - time.monotonic()
+                if remain <= 0 or not self.alive():
+                    raise RuntimeError("iso zygote unresponsive")
+                self._resp_cv.wait(min(remain, 0.2))
+            return self._responses.pop(0)
+
+    def _read(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                msg = json.loads(line)
+                if "exit" in msg:
+                    self.exit_codes[msg["exit"]] = msg["rc"]
+                else:
+                    if "pid" in msg:
+                        # pid recycling: clear a stale exit code IN PIPE
+                        # ORDER so a fresh child never reads as dead.
+                        self.exit_codes.pop(msg["pid"], None)
+                    with self._resp_cv:
+                        self._responses.append(msg)
+                        self._resp_cv.notify_all()
+        except Exception:  # noqa: BLE001 - zygote died; spawns fall back
+            pass
+
+    def spawn(self, connect: str, env: Dict[str, str]) -> _ChildHandle:
+        with self._lock:
+            self.proc.stdin.write(
+                json.dumps({"connect": connect, "env": env}) + "\n"
+            )
+            self.proc.stdin.flush()
+            msg = self._wait_response(timeout=60.0)
+        pid = msg["pid"]
+
+        def poll() -> Optional[int]:
+            rc = self.exit_codes.get(pid)
+            if rc is not None:
+                return rc
+            if not self.alive():
+                # Zygote gone: probe the child directly so a dead child
+                # can't masquerade as alive forever.
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    return -9
+            return None
+
+        return _ChildHandle(pid, poll)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def shutdown(self) -> None:
+        try:
+            self.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+_zygote: Optional[_Zygote] = None
+_zygote_failed = False
+_zygote_lock = threading.Lock()
+
+
+def _get_zygote() -> Optional[_Zygote]:
+    global _zygote, _zygote_failed
+    if not _zygote_enabled() or _zygote_failed:
+        return None
+    with _zygote_lock:
+        if _zygote is not None and _zygote.alive():
+            return _zygote
+        try:
+            _zygote = _Zygote()
+        except Exception:  # noqa: BLE001 - classic spawns still work
+            _zygote_failed = True
+            _zygote = None
+        return _zygote
+
+
+def _spawn_child(connect: str) -> _ChildHandle:
+    """Fork from the import-warm zygote when available, else a classic
+    interpreter spawn (both land in ``_child_connect(connect)``)."""
+    zyg = _get_zygote()
+    if zyg is not None:
+        try:
+            # Ship the CURRENT environment as overrides: the zygote's
+            # own env was captured when it first started, and a knob
+            # changed since (JAX_PLATFORMS, TORCHFT_*) must reach the
+            # child exactly as a classic spawn would deliver it.
+            return zyg.spawn(connect, dict(os.environ))
+        except Exception:  # noqa: BLE001 - zygote wedged: classic spawn
+            pass
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            # not `-m`: the runpy re-execution of an already-imported
+            # package submodule warns and double-runs module state
+            "from torchft_tpu.isolated_xla import main; main()",
+            "--child",
+            connect,
+        ],
+        env=env,
+    )
+    return _ChildHandle(proc.pid, proc.poll)
+
+
+# --------------------------------------------------------------------------
+# shared layout helpers (both sides)
+# --------------------------------------------------------------------------
+
+
+def _acc_dtype(dt: np.dtype) -> np.dtype:
+    """Accumulation dtype of a leaf — the host ring's grouping rule
+    (native dtypes as themselves, everything else rides f32)."""
+    return dt if dt in _NATIVE_DTYPES else np.dtype(np.float32)
+
+
+def _sig_layout(sig: Tuple[Tuple[Any, Any], ...]) -> dict:
+    """Native CommPlan layout for a (shape, dtype) signature at wire 0.
+    Both sides derive their segment views from this ONE authority."""
+    counts = [int(np.prod(s)) if s else 1 for s, _ in sig]
+    codes = [_NATIVE_DTYPES[_acc_dtype(np.dtype(dt))] for _, dt in sig]
+    return _native.shm_layout(counts, codes, 0)
+
+
+_CODE_TO_DTYPE = {v: k for k, v in _NATIVE_DTYPES.items()}
+
+
+def _group_views(
+    buf: memoryview, layout: dict, base: int = 0
+) -> List[np.ndarray]:
+    """One flat numpy view per layout group into a mapped segment."""
+    out = []
+    for g in layout["groups"]:
+        dt = _CODE_TO_DTYPE[g["dtype"]]
+        out.append(
+            np.frombuffer(
+                buf, dtype=dt, count=g["count"], offset=base + g["offset"]
+            )
+        )
+    return out
+
+
+def _leaf_views(
+    buf: memoryview,
+    layout: dict,
+    sig: Tuple[Tuple[Any, Any], ...],
+    base: int = 0,
+) -> List[np.ndarray]:
+    """One shaped numpy view per LEAF into a mapped segment (the
+    persistent staging the parent writes gradients into — zero
+    per-step allocation once built)."""
+    out = []
+    for (shape, _), leaf in zip(sig, layout["leaves"]):
+        g = layout["groups"][leaf["group"]]
+        dt = _CODE_TO_DTYPE[g["dtype"]]
+        off = base + g["offset"] + leaf["off"] * dt.itemsize
+        out.append(
+            np.frombuffer(buf, dtype=dt, count=leaf["count"], offset=off)
+            .reshape(shape)
+        )
+    return out
+
+
+def _apply_divisor_group(arr: np.ndarray, divisor: float) -> np.ndarray:
+    """Same-dtype divide on a flat group buffer (the ring's divisor
+    contract: bf16 divides through f32, ints floor-divide)."""
+    from .collectives import _BF16
+
+    if arr.dtype == _BF16:
+        return (arr.astype(np.float32) / divisor).astype(_BF16)
+    if np.issubdtype(arr.dtype, np.floating):
+        arr /= divisor
+        return arr
+    arr //= int(divisor)
+    return arr
+
+
+# --------------------------------------------------------------------------
+# parent: IsolatedXLACollectives
+# --------------------------------------------------------------------------
+
+
+class _Staging:
+    """Per-signature persistent views into the in/out segments, rebuilt
+    only when a segment regenerates (grow) or the signature changes."""
+
+    def __init__(
+        self,
+        sig: Tuple[Tuple[Any, Any], ...],
+        in_seg: "_native.ShmSegment",
+        out_seg: "_native.ShmSegment",
+        members: int,
+    ) -> None:
+        self.sig = sig
+        self.layout = _sig_layout(sig)
+        self.total = self.layout["total_bytes"]
+        in_buf = in_seg.buffer()
+        out_buf = out_seg.buffer()
+        self.in_leaves = _leaf_views(in_buf, self.layout, sig)
+        self.out_leaves = _leaf_views(out_buf, self.layout, sig)
+        # allgather reads member r's block at stride `total`; only built
+        # where the out segment was sized for it (out_mult)
+        self.out_members = [
+            _leaf_views(out_buf, self.layout, sig, base=r * self.total)
+            for r in range(members)
+        ]
+
+
+class IsolatedXLACollectives(OpStatsMixin, Collectives):
+    """Cross-group collectives whose ``jax.distributed`` runtime lives in
+    a disposable child process (module docstring): membership change is
+    kill-and-respawn at step granularity, the parent's device arrays are
+    never orphaned, and a wedged compiled collective can only cost one op
+    timeout. Results are host-backed local arrays (drop-in parity with
+    the host ring); there is no ``keep_global`` mode — keeping results on
+    a global mesh requires owning the runtime in-process, which is
+    exactly the coupling this backend exists to break."""
+
+    def __init__(
+        self,
+        timeout: timedelta = timedelta(seconds=60),
+        connect_timeout: timedelta = timedelta(seconds=60),
+    ) -> None:
+        self._timeout = timeout
+        self._connect_timeout = connect_timeout
+        self._rank = -1
+        self._world_size = 0
+        # One thread: collectives must issue in submission order.
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="isolated_xla"
+        )
+        self._shutdown = False
+        self._aborted = False
+        # Child state: written on the op thread (configure), killed from
+        # any thread (abort/configure entry) — guarded.
+        self._child_lock = threading.Lock()
+        self._child: Optional[_ChildHandle] = None
+        self._channel: Optional[_MonitoredChannel] = None
+        # The parked spare: (handle, connected channel) armed in the
+        # background after each configure (see _take_or_spawn_child).
+        self._spare: Optional[Tuple[_ChildHandle, _MonitoredChannel]] = None
+        # Segments: grow-only, regenerated under a fresh name (the child
+        # re-attaches by name on the next command; POSIX keeps the old
+        # mapping valid until both sides drop it).
+        self._segs: Dict[str, Optional[_native.ShmSegment]] = {
+            "in": None, "out": None
+        }
+        self._seg_gen = 0
+        self._uid = uuid.uuid4().hex[:12]
+        self._staging: Dict[Any, Tuple[int, _Staging]] = {}
+        self._path = "unconfigured"  # "psum" | "store" after configure
+        self._configure_count = 0
+        self._last_spawn_mode = "none"
+        # Hide the one-time zygote warm-up (~2 s of imports) behind the
+        # caller's own setup: constructing this backend declares intent
+        # to spawn children, so the fork server starts warming now.
+        if _zygote_enabled():
+            threading.Thread(
+                target=_get_zygote, daemon=True, name="iso_zygote_warm"
+            ).start()
+
+    # -- child lifecycle --
+
+    def _kill_child_locked(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+        if self._child is not None:
+            self._child.kill()
+            self._child = None
+
+    def kill_child(self) -> None:
+        """SIGKILL the current child (safe from any thread): an in-flight
+        op fails fast with :class:`ChildDiedError` and the next
+        ``configure()`` respawns. The public form of the wedge remedy —
+        ``abort()`` calls it."""
+        with self._child_lock:
+            self._kill_child_locked()
+
+    def abort(self) -> None:
+        self._aborted = True
+        self.kill_child()
+
+    def _spawn_and_connect_detached(
+        self,
+    ) -> Tuple[_ChildHandle, _MonitoredChannel]:
+        """Spawns a child and waits for its hello; does NOT install it as
+        the live child (configure and the spare pre-spawner both build
+        on this)."""
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        addr = f"127.0.0.1:{listener.getsockname()[1]}"
+        child = _spawn_child(addr)
+        listener.settimeout(self._connect_timeout.total_seconds())
+        try:
+            sock, _ = listener.accept()
+        except socket.timeout:
+            child.kill()
+            raise TimeoutError(
+                "isolated xla child did not connect within "
+                f"{self._connect_timeout.total_seconds():.0f}s "
+                f"(pid {child.pid}, rc={child.poll()})"
+            ) from None
+        finally:
+            listener.close()
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        channel = _MonitoredChannel(sock, child.poll)
+        hello = channel.recv(self._connect_timeout.total_seconds())
+        assert "hello" in hello, hello
+        return child, channel
+
+    def _take_or_spawn_child(self) -> _MonitoredChannel:
+        """Installs the PARKED SPARE child where one is alive, else
+        spawns synchronously. The spare is what makes kill-and-respawn
+        reconfigure cheap regardless of the platform's fork cost (under
+        gVisor a fork of a jax-warm image costs ~50-150 ms of COW
+        bookkeeping even import-warm): the next child is spawned in the
+        background right after each configure, parked connected, and a
+        reconfigure only pays the activation roundtrip."""
+        with self._child_lock:
+            spare, self._spare = self._spare, None
+        if spare is not None:
+            child, channel = spare
+            if child.poll() is None:
+                with self._child_lock:
+                    self._child, self._channel = child, channel
+                self._last_spawn_mode = "spare"
+                return channel
+            channel.close()
+            child.kill()
+        child, channel = self._spawn_and_connect_detached()
+        with self._child_lock:
+            self._child = child
+            self._channel = channel
+        self._last_spawn_mode = (
+            "zygote" if _zygote_enabled() and not _zygote_failed
+            else "classic"
+        )
+        return channel
+
+    def _prespawn_spare(self) -> None:
+        """Arms the next spare in the background (off the reconfigure
+        critical path); quietly gives up on failure — the next configure
+        then spawns synchronously and surfaces the real error."""
+
+        def arm() -> None:
+            try:
+                child, channel = self._spawn_and_connect_detached()
+            except Exception:  # noqa: BLE001
+                return
+            with self._child_lock:
+                if self._shutdown or self._spare is not None:
+                    keep = False
+                else:
+                    self._spare = (child, channel)
+                    keep = True
+            if not keep:
+                channel.close()
+                child.kill()
+
+        threading.Thread(
+            target=arm, daemon=True, name="iso_spare_arm"
+        ).start()
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        """Kill-and-respawn reconfigure: the old child (wedged or not) is
+        SIGKILLed from the calling thread — unblocking any op stuck on
+        it — and a fresh child rendezvouses on the new store prefix. No
+        in-process ``jax.distributed`` teardown happens in the parent,
+        so live jax arrays are untouched and no snapshot-to-host round
+        trip exists on this path."""
+        t_kill = time.perf_counter()
+        self._aborted = True
+        respawn = False
+        with self._child_lock:
+            respawn = self._child is not None
+            self._kill_child_locked()
+
+        def do_configure() -> None:
+            self._rank = rank
+            self._world_size = world_size
+            self._staging.clear()
+            if world_size <= 1:
+                # Nothing to isolate from: no peer can wedge a solo
+                # cohort, and ops short-circuit in the parent.
+                self._path = "solo"
+                self._aborted = False
+                return
+            t0 = time.perf_counter()
+            channel = self._take_or_spawn_child()
+            t1 = time.perf_counter()
+            channel.send({
+                "cmd": "configure",
+                "store_addr": store_addr,
+                "rank": rank,
+                "world_size": world_size,
+                "connect_timeout_s": self._connect_timeout.total_seconds(),
+                "timeout_s": self._timeout.total_seconds(),
+                # Reconfigures of a known backend skip re-probing the
+                # compiled-reduction capability (it is a property of the
+                # install, not the membership); a "store" hint also skips
+                # the distributed-runtime init the fallback never uses —
+                # the reconfigure then costs fork + rendezvous only.
+                "path_hint": self._path if self._path in (
+                    "psum", "store"
+                ) else None,
+            })
+            reply = channel.recv(
+                self._connect_timeout.total_seconds()
+                + self._timeout.total_seconds()
+            )
+            self._path = reply["path"]
+            self._configure_count += 1
+            self._record_op_stats({
+                "op": "configure",
+                "backend": "iso",
+                "path": self._path,
+                "respawn": respawn,
+                "spawn_mode": self._last_spawn_mode,
+                "kill_s": t0 - t_kill,
+                "spawn_s": t1 - t0,
+                "child_init_s": reply.get("init_s", 0.0),
+                "rendezvous_s": time.perf_counter() - t1,
+            })
+            self._aborted = False
+            # arm the NEXT child now, off any future reconfigure's
+            # critical path
+            self._prespawn_spare()
+
+        self._executor.submit(do_configure).result(
+            timeout=self._connect_timeout.total_seconds()
+            + self._timeout.total_seconds()
+        )
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        with self._child_lock:
+            channel = self._channel
+            if channel is not None:
+                try:
+                    channel.send({"cmd": "exit"})
+                except Exception:  # noqa: BLE001 - kill covers it
+                    pass
+            self._kill_child_locked()
+            spare, self._spare = self._spare, None
+        if spare is not None:
+            spare[1].close()
+            spare[0].kill()
+        self._executor.shutdown(wait=True)
+        for name, seg in self._segs.items():
+            if seg is not None:
+                seg.close()
+            self._segs[name] = None
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    def child_pid(self) -> Optional[int]:
+        """Pid of the live child (tests and the death bench target it)."""
+        with self._child_lock:
+            return self._child.pid if self._child is not None else None
+
+    def reduction_path(self) -> str:
+        """What the child's capability probe locked at configure:
+        ``"psum"`` (compiled global-mesh reduction) or ``"store"`` (the
+        measured fallback where the platform has no compiled
+        multi-process path), ``"solo"`` for world size 1."""
+        return self._path
+
+    # -- segments & staging --
+
+    def _seg_name(self, kind: str) -> str:
+        return f"tft_iso_{os.getpid()}_{self._uid}_{kind}_{self._seg_gen}"
+
+    def _ensure_segment(self, kind: str, nbytes: int) -> _native.ShmSegment:
+        seg = self._segs[kind]
+        if seg is not None and seg.nbytes >= nbytes:
+            return seg
+        # Grow-only regeneration under a fresh name: the child re-attaches
+        # on the next command (names ride every op message); the old
+        # creator handle unlinks its name here, and the child's stale
+        # mapping stays valid until it drops it.
+        self._seg_gen += 1
+        new = _native.ShmSegment.create(
+            self._seg_name(kind), max(nbytes, 1 << 16)
+        )
+        if seg is not None:
+            seg.close()
+        self._segs[kind] = new
+        return new
+
+    def _staging_for(
+        self, sig: Tuple[Tuple[Any, Any], ...], out_mult: int
+    ) -> _Staging:
+        key = (sig, out_mult >= 2)
+        cached = self._staging.get(key)
+        if cached is not None and cached[0] == self._seg_gen:
+            return cached[1]
+        layout = _sig_layout(sig)
+        total = layout["total_bytes"]
+        self._ensure_segment("in", total)
+        self._ensure_segment("out", total * max(out_mult, 1))
+        # read the final handles: either ensure may have regenerated
+        staging = _Staging(
+            sig, self._segs["in"], self._segs["out"], max(out_mult, 1)
+        )
+        self._staging[key] = (self._seg_gen, staging)
+        return staging
+
+    # -- ops --
+
+    def _submit(self, fn: Callable[[], Any]) -> Work:
+        if self._shutdown:
+            raise RuntimeError("collectives already shut down")
+
+        def guarded() -> Any:
+            if self._aborted:
+                raise RuntimeError("collectives aborted")
+            return fn()
+
+        return Work(self._executor.submit(guarded))
+
+    def _write_leaves(self, leaves: List[Any], staging: _Staging) -> int:
+        """d2h into the persistent segment views; returns device-link
+        bytes (0 when everything already lived on the host)."""
+        d2h = 0
+        # Queue every DMA before blocking on the first — the parent's
+        # async-stream discipline (device arrays never leave the parent;
+        # the child only ever sees the staged host bytes).
+        for leaf in leaves:
+            if _is_jax_array(leaf) and hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        for leaf, view in zip(leaves, staging.in_leaves):
+            if _is_jax_array(leaf):
+                d2h += view.nbytes
+            np.copyto(view, np.asarray(leaf), casting="same_kind")
+        return d2h
+
+    def _read_leaves(
+        self, views: List[np.ndarray], sig, was_jax: List[bool]
+    ) -> List[Any]:
+        """h2d (or host copy) out of the segment views. Always copies:
+        the views alias shared pages the next op overwrites."""
+        out = []
+        for view, (shape, dt), jaxy in zip(views, sig, was_jax):
+            arr = view.astype(np.dtype(dt), copy=True) if (
+                view.dtype != np.dtype(dt)
+            ) else np.array(view)
+            if jaxy:
+                import jax.numpy as jnp
+
+                out.append(jnp.array(arr))
+            else:
+                out.append(arr)
+        return out
+
+    def _roundtrip(self, cmd: dict, timeout_s: float) -> dict:
+        with self._child_lock:
+            channel = self._channel
+        if channel is None:
+            raise ChildDiedError(
+                "no isolated xla child (killed or never configured)"
+            )
+        channel.send(cmd)
+        try:
+            return channel.recv(timeout_s)
+        except TimeoutError:
+            # The channel has no correlation ids: a late reply from a
+            # timed-out op would be consumed by the NEXT op as its own
+            # ack, handing the caller stale out-segment bytes as a
+            # result. A child that outwaited its deadline is wedged by
+            # definition — SIGKILL it (the wedge remedy this backend
+            # exists for); the next configure respawns.
+            self.kill_child()
+            raise
+
+    def _op_cmd(self, op: str, staging: _Staging, **extra: Any) -> dict:
+        counts = [l["count"] for l in staging.layout["leaves"]]
+        return {
+            "cmd": "op",
+            "op": op,
+            "counts": counts,
+            "leaf_codes": [
+                staging.layout["groups"][l["group"]]["dtype"]
+                for l in staging.layout["leaves"]
+            ],
+            "seg_in": self._segs["in"].name,
+            "seg_in_bytes": self._segs["in"].nbytes,
+            "seg_out": self._segs["out"].name,
+            "seg_out_bytes": self._segs["out"].nbytes,
+            "timeout_s": self._timeout.total_seconds(),
+            **extra,
+        }
+
+    def allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        # wire="q8" is accepted and served LOSSLESSLY, the XLACollectives
+        # contract: the compiled path rides ICI/DCN where the f32 psum is
+        # native; the quantized wire exists for the host ring's TCP links.
+        return self._submit(lambda: self._allreduce_sync(tree, op, divisor))
+
+    def _allreduce_sync(
+        self, tree: Any, op: ReduceOp, divisor: Optional[float]
+    ) -> Any:
+        if divisor is not None and op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise ValueError("divisor only composes with ReduceOp.SUM")
+        if op == ReduceOp.AVG:
+            if divisor is not None:
+                raise ValueError("divisor only composes with ReduceOp.SUM")
+            divisor, op = float(self._world_size), ReduceOp.SUM
+        if self._world_size == 1:
+            if divisor is not None and divisor != 1:
+                import jax
+
+                return jax.tree_util.tree_map(
+                    lambda l: _divide_leaf(l, divisor)
+                    if hasattr(l, "__truediv__") else l,
+                    tree,
+                )
+            return tree
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return tree
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        was_jax = [_is_jax_array(l) for l in leaves]
+        t0 = time.perf_counter()
+        staging = self._staging_for(sig, out_mult=1)
+        t1 = time.perf_counter()
+        d2h = self._write_leaves(leaves, staging)
+        t2 = time.perf_counter()
+        reply = self._roundtrip(
+            self._op_cmd(
+                "allreduce", staging, opcode=int(op), divisor=divisor
+            ),
+            # slack over the child's own op deadline so its timeout
+            # error (with the child traceback) wins over ours
+            self._timeout.total_seconds() + 5.0,
+        )
+        t3 = time.perf_counter()
+        out = self._read_leaves(staging.out_leaves, sig, was_jax)
+        self._record_op_stats({
+            "op": "allreduce",
+            "backend": "iso",
+            "path": reply.get("path", self._path),
+            "bytes": staging.total,
+            "d2h_bytes": d2h,
+            "pack": t1 - t0,
+            "d2h": t2 - t1,
+            "ring": t3 - t2,
+            "child_s": reply.get("ring_s", 0.0),
+            "h2d": time.perf_counter() - t3,
+        })
+        return _unflatten(treedef, out)
+
+    def allgather(self, tree: Any) -> Work:
+        return self._submit(lambda: self._allgather_sync(tree))
+
+    def _allgather_sync(self, tree: Any) -> List[Any]:
+        if self._world_size == 1:
+            return [tree]
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return [tree] * self._world_size
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        was_jax = [_is_jax_array(l) for l in leaves]
+        staging = self._staging_for(sig, out_mult=self._world_size)
+        d2h = self._write_leaves(leaves, staging)
+        t0 = time.perf_counter()
+        reply = self._roundtrip(
+            self._op_cmd("allgather", staging),
+            self._timeout.total_seconds() + 5.0,
+        )
+        ring_s = time.perf_counter() - t0
+        results = [
+            _unflatten(
+                treedef,
+                self._read_leaves(staging.out_members[r], sig, was_jax),
+            )
+            for r in range(self._world_size)
+        ]
+        self._record_op_stats({
+            "op": "allgather",
+            "backend": "iso",
+            "path": reply.get("path", self._path),
+            "bytes": staging.total,
+            "d2h_bytes": d2h,
+            "ring": ring_s,
+            "child_s": reply.get("ring_s", 0.0),
+        })
+        return results
+
+    def broadcast(self, tree: Any, root: int = 0) -> Work:
+        return self._submit(lambda: self._broadcast_sync(tree, root))
+
+    def _broadcast_sync(self, tree: Any, root: int) -> Any:
+        if self._world_size == 1:
+            if root != 0:
+                raise RuntimeError(
+                    f"bad broadcast root {root} for world size 1"
+                )
+            return tree
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return tree
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        was_jax = [_is_jax_array(l) for l in leaves]
+        staging = self._staging_for(sig, out_mult=1)
+        d2h = self._write_leaves(leaves, staging)
+        t0 = time.perf_counter()
+        reply = self._roundtrip(
+            self._op_cmd("broadcast", staging, root=root),
+            self._timeout.total_seconds() + 5.0,
+        )
+        ring_s = time.perf_counter() - t0
+        out = self._read_leaves(staging.out_leaves, sig, was_jax)
+        self._record_op_stats({
+            "op": "broadcast",
+            "backend": "iso",
+            "path": reply.get("path", self._path),
+            "bytes": staging.total,
+            "d2h_bytes": d2h,
+            "ring": ring_s,
+            "child_s": reply.get("ring_s", 0.0),
+        })
+        return _unflatten(treedef, out)
+
+    def barrier(self) -> Work:
+        def sync() -> None:
+            if self._world_size == 1:
+                return
+            self._roundtrip(
+                {
+                    "cmd": "op",
+                    "op": "barrier",
+                    "timeout_s": self._timeout.total_seconds(),
+                },
+                self._timeout.total_seconds() + 5.0,
+            )
+
+        return self._submit(sync)
+
+
+# --------------------------------------------------------------------------
+# child: maps the segments, owns jax.distributed, serves ops
+# --------------------------------------------------------------------------
+
+
+class _ChildState:
+    def __init__(self) -> None:
+        self.xc: Optional[Any] = None  # XLACollectives on the psum path
+        self.store: Optional[Any] = None
+        self.prefix = ""
+        self.rank = -1
+        self.world = 0
+        self.path = "unconfigured"
+        self.opn = 0
+        self.segs: Dict[str, Tuple[str, Any]] = {}  # kind -> (name, seg)
+        # layout memo: the signature is per-step identical, so the
+        # native build + JSON round trip stays off the hot path
+        self.layouts: Dict[Any, dict] = {}
+
+    def layout_for(self, counts: List[int], codes: List[int]) -> dict:
+        key = (tuple(counts), tuple(codes))
+        lay = self.layouts.get(key)
+        if lay is None:
+            lay = self.layouts[key] = _native.shm_layout(counts, codes, 0)
+        return lay
+
+    def attach(self, kind: str, name: str, nbytes: int) -> memoryview:
+        cur = self.segs.get(kind)
+        if cur is not None and cur[0] == name:
+            return cur[1].buffer()
+        if cur is not None:
+            cur[1].close()
+        seg = _native.ShmSegment.attach(name, nbytes)
+        self.segs[kind] = (name, seg)
+        return seg.buffer()
+
+
+def _child_configure(state: _ChildState, req: dict) -> dict:
+    from ._native import StoreClient
+    from .xla_collectives import _split_store_addr
+
+    connect_timeout = timedelta(seconds=req["connect_timeout_s"])
+    t0 = time.perf_counter()
+    state.rank = req["rank"]
+    state.world = req["world_size"]
+    hostport, prefix = _split_store_addr(req["store_addr"])
+    state.prefix = prefix
+    state.store = StoreClient(hostport, connect_timeout=connect_timeout)
+    hint = req.get("path_hint")
+    if hint == "store":
+        # The capability verdict is a property of the install, not the
+        # membership: a known store-path host skips the distributed
+        # runtime its fallback never uses. No cohort barrier either —
+        # the first op's blocking fetch gives the same failure surface
+        # (a missing peer surfaces at the op deadline and latches), so
+        # a respawn costs child activation + store attach only: the
+        # step-granularity reconfigure the isolation exists for.
+        state.path = "store"
+        return {"ok": True, "path": "store",
+                "init_s": time.perf_counter() - t0}
+
+    from .platform import apply_jax_platform_env
+
+    apply_jax_platform_env()
+    import jax
+    import jax.numpy as jnp
+
+    from .xla_collectives import XLACollectives
+
+    xc = XLACollectives(
+        timeout=timedelta(seconds=req["timeout_s"]),
+        connect_timeout=connect_timeout,
+        probe_listen=True,
+    )
+    # The child's rendezvous rides the SAME store on a /child sub-prefix
+    # (a stale in-process backend on the same prefix must never
+    # cross-talk with the isolated cohort).
+    xc.configure(req["store_addr"] + "/child", state.rank, state.world)
+    init_s = time.perf_counter() - t0
+    if hint == "psum":
+        # Known-good compiled path: skip the probe collective.
+        state.xc = xc
+        state.path = "psum"
+        return {"ok": True, "path": "psum", "init_s": init_s}
+    # Capability probe: the compiled multi-process reduction is MEASURED,
+    # never assumed (CPU jax without a gloo collectives build raises at
+    # first cross-process dispatch). Every member probes at the same
+    # point, so the verdict is cohort-uniform on homogeneous installs.
+    try:
+        probe = xc.allreduce(jnp.ones((8,), jnp.float32), ReduceOp.SUM).wait()
+        jax.block_until_ready(probe)
+        state.xc = xc
+        state.path = "psum"
+    except Exception:  # noqa: BLE001 - no compiled path here
+        state.path = "store"
+        xc.abort()
+    return {"ok": True, "path": state.path, "init_s": init_s}
+
+
+def _store_key(state: _ChildState, kind: str, slot: Any, rank: int) -> str:
+    base = f"{state.prefix}/iso/{kind}/{slot}/{rank}"
+    return base
+
+
+# Store values ride the native wire protocol, whose frames cap at 64 MB
+# (wire.h kMaxFrameBytes): payloads split into fixed-size chunks. Every
+# member ships the same layout total, so chunk counts are derivable on
+# both sides with no extra metadata.
+_STORE_CHUNK = 16 << 20
+
+
+def _child_store_exchange(
+    state: _ChildState, payload: bytes, timeout_s: float, ranks: List[int]
+) -> List[bytes]:
+    """Store-fallback data exchange: publish this rank's payload under
+    the op-slot keys (chunked under the frame cap), fetch the listed
+    ranks'. Slots recycle modulo ``_STORE_SLOTS`` (see the window proof
+    at the constant), and every (slot, rank) carries a VERSION key set
+    AFTER the payload chunks: ``store.get`` only waits for key
+    EXISTENCE, so without the version a member one op ahead could read
+    a peer's window-old payload out of the recycled slot key and
+    silently corrupt the reduction. Readers poll the (8-byte) version
+    until it matches this op, then read the chunks once — fresh by the
+    write-after-read window proof (the writer's NEXT visit to this slot
+    cannot begin until this reader's op completed)."""
+    slot = state.opn % _STORE_SLOTS
+    timeout = timedelta(seconds=timeout_s)
+    ver = state.opn.to_bytes(8, "little")
+    nchunks = max(1, -(-len(payload) // _STORE_CHUNK))
+    for ci in range(nchunks):
+        state.store.set(
+            _store_key(state, "pay", slot, state.rank) + f"/{ci}",
+            payload[ci * _STORE_CHUNK:(ci + 1) * _STORE_CHUNK],
+            timeout=timeout,
+        )
+    state.store.set(
+        _store_key(state, "ver", slot, state.rank), ver, timeout=timeout
+    )
+    out = []
+    deadline = time.perf_counter() + timeout_s
+    for r in ranks:
+        if r == state.rank:
+            out.append(payload)
+            continue
+        while True:
+            got = state.store.get(
+                _store_key(state, "ver", slot, r), timeout=timeout
+            )
+            if got == ver:
+                break
+            if time.perf_counter() >= deadline:
+                raise TimeoutError(
+                    f"isolated store exchange: rank {r} never published "
+                    f"op {state.opn} (slot version "
+                    f"{int.from_bytes(got, 'little')})"
+                )
+            time.sleep(0.002)
+        parts = [
+            state.store.get(
+                _store_key(state, "pay", slot, r) + f"/{ci}",
+                timeout=timeout,
+            )
+            for ci in range(nchunks)
+        ]
+        out.append(parts[0] if nchunks == 1 else b"".join(parts))
+    return out
+
+
+def _child_store_barrier(state: _ChildState, timeout_s: float) -> None:
+    key = f"{state.prefix}/iso/bar/{state.opn}"
+    deadline = time.perf_counter() + timeout_s
+    n = state.store.add(key, 1, timeout=timedelta(seconds=timeout_s))
+    while n < state.world:
+        if time.perf_counter() >= deadline:
+            raise TimeoutError(f"isolated barrier timed out ({n}/{state.world})")
+        time.sleep(0.005)
+        n = state.store.add(key, 0, timeout=timedelta(seconds=timeout_s))
+
+
+_NUMPY_REDUCERS = {
+    int(ReduceOp.SUM): np.add,
+    int(ReduceOp.PRODUCT): np.multiply,
+    int(ReduceOp.MIN): np.minimum,
+    int(ReduceOp.MAX): np.maximum,
+}
+
+
+def _child_op(state: _ChildState, req: dict) -> dict:
+    op = req["op"]
+    timeout_s = req["timeout_s"]
+    t0 = time.perf_counter()
+    state.opn += 1
+    if op == "barrier":
+        if state.path == "psum":
+            state.xc.barrier().wait(timeout=timedelta(seconds=timeout_s))
+        else:
+            _child_store_barrier(state, timeout_s)
+        return {"ok": True, "path": state.path,
+                "ring_s": time.perf_counter() - t0}
+
+    counts = req["counts"]
+    codes = req["leaf_codes"]
+    layout = state.layout_for(counts, codes)
+    in_buf = state.attach("in", req["seg_in"], req["seg_in_bytes"])
+    out_buf = state.attach("out", req["seg_out"], req["seg_out_bytes"])
+    in_groups = _group_views(in_buf, layout)
+    total = layout["total_bytes"]
+
+    if op == "allreduce":
+        opcode = req["opcode"]
+        divisor = req.get("divisor")
+        if state.path == "psum":
+            import jax.numpy as jnp
+
+            tree = [jnp.array(g) for g in in_groups]
+            reduced = state.xc.allreduce(
+                tree, ReduceOp(opcode), divisor=divisor
+            ).wait(timeout=timedelta(seconds=timeout_s))
+            for g, r in zip(_group_views(out_buf, layout), reduced):
+                np.copyto(g, np.asarray(r))
+        else:
+            gathered = _child_store_exchange(
+                state, in_buf[:total].tobytes(), timeout_s,
+                list(range(state.world)),
+            )
+            reducer = _NUMPY_REDUCERS[opcode]
+            out_groups = _group_views(out_buf, layout)
+            for gi, g in enumerate(layout["groups"]):
+                dt = _CODE_TO_DTYPE[g["dtype"]]
+                acc: Optional[np.ndarray] = None
+                for payload in gathered:  # rank order: deterministic
+                    part = np.frombuffer(
+                        payload, dtype=dt, count=g["count"],
+                        offset=g["offset"],
+                    )
+                    acc = part.copy() if acc is None else reducer(acc, part)
+                if divisor is not None and divisor != 1:
+                    acc = _apply_divisor_group(acc, divisor)
+                np.copyto(out_groups[gi], acc)
+    elif op == "allgather":
+        if state.path == "psum":
+            tree = [np.array(g) for g in in_groups]
+            members = state.xc.allgather(tree).wait(
+                timeout=timedelta(seconds=timeout_s)
+            )
+            for r, member in enumerate(members):
+                for g, (val, gmeta) in enumerate(
+                    zip(member, layout["groups"])
+                ):
+                    dt = _CODE_TO_DTYPE[gmeta["dtype"]]
+                    dst = np.frombuffer(
+                        out_buf, dtype=dt, count=gmeta["count"],
+                        offset=r * total + gmeta["offset"],
+                    )
+                    np.copyto(dst, np.asarray(val))
+        else:
+            gathered = _child_store_exchange(
+                state, in_buf[:total].tobytes(), timeout_s,
+                list(range(state.world)),
+            )
+            for r, payload in enumerate(gathered):
+                out_buf[r * total:(r + 1) * total] = payload[:total]
+    elif op == "broadcast":
+        root = req["root"]
+        if state.path == "psum":
+            tree = [np.array(g) for g in in_groups]
+            result = state.xc.broadcast(tree, root=root).wait(
+                timeout=timedelta(seconds=timeout_s)
+            )
+            for g, r in zip(_group_views(out_buf, layout), result):
+                np.copyto(g, np.asarray(r))
+        else:
+            # every member publishes (uniform slot accounting), only the
+            # root's payload is read back
+            gathered = _child_store_exchange(
+                state, in_buf[:total].tobytes(), timeout_s, [root]
+            )
+            out_buf[:total] = gathered[0][:total]
+            # Publication order is the only sync broadcast needs on the
+            # store path, but a trailing barrier keeps slot recycling's
+            # one-op-lag invariant intact for mixed op sequences.
+            _child_store_barrier(state, timeout_s)
+    else:
+        raise ValueError(f"unknown isolated op {op!r}")
+    return {"ok": True, "path": state.path, "ring_s": time.perf_counter() - t0}
+
+
+def _child_serve(sock: socket.socket) -> None:
+    """The child's command loop: one line-JSON reply per command; any
+    exception crosses back as ``{"error", "tb"}`` and the loop continues
+    (the parent decides whether the error is fatal — usually by latching
+    it and letting the next configure respawn us)."""
+    state = _ChildState()
+    rfile = sock.makefile("rb")
+    sock.sendall(json.dumps({"hello": os.getpid()}).encode() + b"\n")
+    while True:
+        try:
+            line = rfile.readline()
+        except OSError:
+            break  # parent closed the channel (discarded spare / exit)
+        if not line:
+            break  # parent gone
+        try:
+            req = json.loads(line)
+            cmd = req.get("cmd")
+            if cmd == "exit":
+                sock.sendall(b'{"ok": true}\n')
+                break
+            if cmd == "configure":
+                reply = _child_configure(state, req)
+            elif cmd == "op":
+                reply = _child_op(state, req)
+            else:
+                raise ValueError(f"unknown command {cmd!r}")
+        except Exception as e:  # noqa: BLE001 - cross the channel
+            import traceback
+
+            reply = {"error": f"{type(e).__name__}: {e}",
+                     "tb": traceback.format_exc()}
+        try:
+            sock.sendall(json.dumps(reply).encode() + b"\n")
+        except OSError:
+            break
+
+
+def _child_connect(addr: str) -> None:
+    host, _, port = addr.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        _child_serve(sock)
+    finally:
+        sock.close()
+
+
+def _zygote_main() -> None:
+    """Import-warm fork server (see _Zygote): single-threaded, backend-
+    less — forking a multithreaded or backend-initialized process risks
+    inherited lock state, so the assert is load-bearing.
+
+    A respawn must be CHEAP, and forking a jax-loaded interpreter is not
+    free everywhere (~100-200 ms of page-table copy under gVisor), so
+    the zygote keeps ONE PRE-FORKED SPARE parked on a pipe: activation
+    is a pipe write (~ms) and the replacement spare forks right after,
+    off the requester's critical path — the hot-spare discipline applied
+    one level down, at the child-process granularity."""
+    from .platform import apply_jax_platform_env
+
+    apply_jax_platform_env()
+    import jax  # noqa: F401
+    import jax.numpy  # noqa: F401
+
+    assert threading.active_count() == 1, (
+        "iso zygote must stay single-threaded to fork safely; an import "
+        "started a thread"
+    )
+
+    def fork_spare() -> Tuple[int, int]:
+        """Forks a parked child; returns (pid, activation-pipe write fd).
+        The spare blocks reading its pipe until a request line arrives
+        (or exits silently on EOF — the zygote died unactivated)."""
+        r, w = os.pipe()
+        pid = os.fork()
+        if pid == 0:
+            # -- spare child: park until activated --
+            os.close(w)
+            try:
+                # Pre-touch the activation hot path BEFORE parking: fork
+                # is lazy (COW), so the pages behind json/socket fault in
+                # on first touch — tens of ms under gVisor if paid at
+                # activation, free while parked.
+                json.loads('{"warm": 1}')
+                _probe = socket.socket()
+                _probe.close()
+                data = b""
+                while not data.endswith(b"\n"):
+                    chunk = os.read(r, 1 << 16)
+                    if not chunk:
+                        os._exit(0)  # never activated
+                    data += chunk
+                os.close(r)
+                req = json.loads(data)
+                devnull = os.open(os.devnull, os.O_RDONLY)
+                os.dup2(devnull, 0)
+                os.dup2(2, 1)  # keep the protocol stdout clean
+                os.environ.update(req.get("env", {}))
+                _child_connect(req["connect"])
+                os._exit(0)
+            except SystemExit as e:
+                os._exit(int(e.code or 0))
+            except BaseException:
+                import traceback
+
+                traceback.print_exc()
+                os._exit(1)
+        os.close(r)
+        return pid, w
+
+    spare_pid, spare_w = fork_spare()
+    print(json.dumps({"ready": True}), flush=True)
+    # Parked spares ride the reap loop too: a spare that dies before
+    # activation must be waitpid'd (no zombie) and replaced, not crash
+    # the zygote with a broken activation pipe.
+    children: Dict[int, bool] = {spare_pid: True}
+    while True:
+        ready, _, _ = select.select([sys.stdin], [], [], 0.1)
+        if ready:
+            line = sys.stdin.readline()
+            if not line:
+                break  # parent gone; orphans are its to kill
+            req = json.loads(line)
+            # activate the parked spare (a pipe write), answer, THEN
+            # fork its replacement off the critical path
+            payload = (json.dumps(req) + "\n").encode()
+            for _attempt in range(2):
+                try:
+                    os.write(spare_w, payload)
+                    os.close(spare_w)
+                    break
+                except OSError:
+                    # the spare died unactivated (pipe's read end gone):
+                    # replace it and retry once; a second immediate
+                    # death is a real environment problem and may crash
+                    # us — the parent falls back to classic spawns.
+                    try:
+                        os.close(spare_w)
+                    except OSError:
+                        pass
+                    spare_pid, spare_w = fork_spare()
+                    children[spare_pid] = True
+            print(json.dumps({"pid": spare_pid}), flush=True)
+            spare_pid, spare_w = fork_spare()
+            children[spare_pid] = True
+        for pid in list(children):
+            wpid, status = os.waitpid(pid, os.WNOHANG)
+            if wpid:
+                del children[pid]
+                print(
+                    json.dumps(
+                        {"exit": wpid,
+                         "rc": os.waitstatus_to_exitcode(status)}
+                    ),
+                    flush=True,
+                )
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--zygote":
+        _zygote_main()
+    elif argv and argv[0] == "--child":
+        _child_connect(argv[1])
+    else:
+        raise SystemExit(
+            "usage: python -m torchft_tpu.isolated_xla --zygote | --child ADDR"
+        )
+
+
+if __name__ == "__main__":
+    main()

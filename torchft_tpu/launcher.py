@@ -54,6 +54,16 @@ def replica_group_spec(
                 "torchft_tpu", "jax_cache",
             ),
         ),
+        # Isolated-data-plane knobs ride the spec explicitly so external
+        # schedulers (which don't inherit this supervisor's environment)
+        # deploy every group with the same child-respawn discipline: the
+        # import-warm fork server is what keeps an isolated-child
+        # respawn at fork cost instead of a cold interpreter start.
+        **{
+            knob: os.environ[knob]
+            for knob in ("TORCHFT_ISO_ZYGOTE", "TORCHFT_ISO_LIVENESS_MS")
+            if knob in os.environ
+        },
         **(env or {}),
     }
     return {

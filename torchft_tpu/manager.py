@@ -86,6 +86,7 @@ class Manager:
         heartbeat_interval: timedelta = timedelta(milliseconds=100),
         checkpoint_transport: Optional[CheckpointTransport[Dict[str, T]]] = None,
         profiler: Optional["Profiler"] = None,
+        iso_collectives: Optional[Collectives] = None,
     ) -> None:
         """
         Args:
@@ -118,6 +119,14 @@ class Manager:
             profiler: windowed jax profiler capture advanced once per
                 step; defaults to ``Profiler.from_env()``
                 (``TORCHFT_PROFILE_DIR`` etc., torchft_tpu.profiling).
+            iso_collectives: optional SECONDARY data plane — an
+                :class:`~torchft_tpu.isolated_xla.IsolatedXLACollectives`
+                backend reconfigured alongside the primary on every
+                quorum change (on an ``/iso`` store sub-prefix, so the
+                two planes never cross-talk) and dispatched through
+                :meth:`iso_allreduce`. AdaptiveDDP's ``xla_iso``
+                candidate probes it against the host ring with the same
+                lockstep-vote argmin that picks the schedule.
         """
         self._load_state_dict = load_state_dict
         self._user_state_dict = state_dict
@@ -153,6 +162,8 @@ class Manager:
         self._store = StoreClient(store_addr, connect_timeout=connect_timeout)
 
         self._collectives = collectives
+        self._iso_collectives = iso_collectives
+        self._iso_ok = False
         self._checkpoint_transport: CheckpointTransport[Dict[str, T]] = (
             checkpoint_transport
             if checkpoint_transport is not None
@@ -234,6 +245,8 @@ class Manager:
             self._profiler.shutdown()
         self._checkpoint_transport.shutdown(wait=False)
         self._executor.shutdown(wait=True)
+        if self._iso_collectives is not None:
+            self._iso_collectives.shutdown()
         if self._manager is not None:
             self._manager.shutdown()
 
@@ -354,6 +367,31 @@ class Manager:
                 self._collectives.configure(
                     prefix, result.replica_rank, result.replica_world_size
                 )
+            if self._iso_collectives is not None:
+                # The secondary (isolated) plane reconfigures on its own
+                # sub-prefix: same quorum, disjoint store keys — its
+                # kill-and-respawn cannot collide with the ring's
+                # rendezvous, and a stale child never cross-talks. A
+                # failure here (un-spawnable child, dead fork server)
+                # must NEVER take the primary plane down with it: the
+                # plane is marked unusable, iso dispatches latch, and the
+                # AdaptiveDDP probe's failure sentinel keeps the
+                # candidate from ever winning ("never beat-by-crash").
+                with self._metrics.timed("reconfigure_iso"):
+                    try:
+                        self._iso_collectives.configure(
+                            f"{prefix}/iso",
+                            result.replica_rank,
+                            result.replica_world_size,
+                        )
+                        self._iso_ok = True
+                    except Exception as e:  # noqa: BLE001
+                        self._iso_ok = False
+                        self._metrics.incr("iso_configure_failures")
+                        self._logger.exception(
+                            f"isolated data plane configure failed "
+                            f"(primary plane unaffected): {e}"
+                        )
             self._metrics.incr("reconfigures")
             self._quorum_id = quorum_id
 
@@ -504,6 +542,62 @@ class Manager:
 
         return self._managed_dispatch(
             "plan_allreduce", tree, dispatch, lambda t: None
+        )
+
+    def has_iso_plane(self) -> bool:
+        """Whether a secondary isolated data plane was attached at
+        construction (NOT whether its child is currently healthy — a
+        sick plane still exists, and its dispatch failures are exactly
+        what the probe's sentinel discipline measures)."""
+        return self._iso_collectives is not None
+
+    def iso_collectives(self) -> Optional[Collectives]:
+        """The attached isolated data plane (None without one)."""
+        return self._iso_collectives
+
+    def iso_allreduce(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.AVG,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Fault-tolerantly averages a gradient pytree through the
+        ISOLATED data plane (the disposable-child XLA backend attached
+        as ``iso_collectives``): same quorum/zeroing/latching discipline
+        as :meth:`allreduce`, with the failure default ``None`` — a
+        child that died mid-op leaves no meaningful "as contributed"
+        tree (its shared-memory staging may hold a partial result), so
+        the Work resolves to ``None``, the error latches, and
+        ``should_commit`` discards the step; the error's forced
+        reconfigure then respawns the child at the next quorum (step-
+        granularity recovery). Raises eagerly (static usage error) when
+        no isolated plane was attached."""
+        if self._iso_collectives is None:
+            raise ValueError(
+                "no isolated data plane: construct the Manager with "
+                "iso_collectives=IsolatedXLACollectives(...)"
+            )
+        if op not in (ReduceOp.AVG, ReduceOp.SUM):
+            raise ValueError(f"unsupported managed iso_allreduce op: {op}")
+
+        def dispatch(zeroed_tree: Any) -> Work:
+            if not self._iso_ok:
+                raise RuntimeError(
+                    "isolated data plane unusable this quorum (its "
+                    "configure failed; primary plane unaffected)"
+                )
+            if op == ReduceOp.AVG:
+                num_participants = self.num_participants()
+                assert num_participants >= 1
+                divisor: Optional[float] = float(num_participants)
+            else:
+                divisor = None
+            return self._iso_collectives.allreduce(
+                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire
+            )
+
+        return self._managed_dispatch(
+            "iso_allreduce", tree, dispatch, lambda t: None
         )
 
     def reset_plan_feedback(self) -> None:

@@ -46,6 +46,7 @@ be restarted, unlike the ring's in-place abort.
 from __future__ import annotations
 
 import socket
+import time
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from datetime import timedelta
@@ -54,22 +55,62 @@ from typing import Any, Callable, List, Optional
 import numpy as np
 
 from ._native import StoreClient
-from .collectives import Collectives, ReduceOp, Work, _flatten, _unflatten
+from .collectives import (
+    Collectives,
+    OpStatsMixin,
+    ReduceOp,
+    Work,
+    _flatten,
+    _unflatten,
+)
 
 _COORD_KEY = "xla_coordinator"
 
+# Bounded retries of the coordinator-port race (see _reserve_port): each
+# lost race re-reserves and republishes under the next attempt key, so a
+# loss is recovered in-place instead of burning a whole quorum round.
+_COORD_ATTEMPTS = 3
 
-def _free_port() -> int:
-    # Close-then-rebind race: another process can take the port before the
-    # distributed runtime binds it. SO_REUSEADDR narrows the window; a lost
-    # race surfaces as a failed initialize, which the manager's quorum
-    # retry path reruns with a fresh port.
+
+def _reserve_port() -> tuple:
+    """Reserves an ephemeral port for the distributed-runtime coordinator:
+    binds port 0 and returns ``(port, bound_socket)`` with the socket
+    STILL HELD — the caller publishes the actual bound port through the
+    store while holding it, and closes it only immediately before
+    ``jax.distributed.initialize`` binds the same port. The old
+    probe-then-close helper released the port before publication, leaving
+    a publication-to-initialize window (a full cross-rank rendezvous) in
+    which any process could take it; holding the bind shrinks the race to
+    the close→re-bind instant, and the attempt-keyed retry in
+    ``configure()`` recovers the residual loss in-place."""
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return s.getsockname()[1], s
+
+
+def _is_bind_failure(exc: BaseException) -> bool:
+    """Whether an initialize() failure is the coordinator losing the
+    reserved port (the lost race the attempt-keyed retry recovers), as
+    opposed to a backend-predates-runtime error or a peer outage."""
+    msg = str(exc).lower()
+    return "address already in use" in msg or (
+        "bind" in msg and "fail" in msg
+    )
+
+
+def _is_backend_predates(exc: BaseException) -> bool:
+    """Whether an initialize() failure is "the XLA backend pre-dates the
+    distributed runtime" ("initialize() must be called before any JAX
+    computations") — the ONE failure the teardown-and-retry-once branch
+    exists for. Anything else must propagate to the attempt loop: the
+    old catch-all retried ARBITRARY RuntimeErrors against the same
+    (possibly doomed) coordinator address, paying a spurious
+    array-orphaning teardown and, on runtimes whose registration
+    timeout is a fatal process abort, dying before the retry protocol
+    could ever run."""
+    msg = str(exc).lower()
+    return "must be called before" in msg or "already initialized" in msg
 
 
 def _split_store_addr(store_addr: str) -> tuple:
@@ -81,7 +122,79 @@ def _split_store_addr(store_addr: str) -> tuple:
     return hostport, prefix
 
 
-class XLACollectives(Collectives):
+def _leaf_bytes(leaves) -> int:
+    """Payload bytes of a leaf list from shapes/dtypes alone (no device
+    fetch — ``np.asarray`` on a jax leaf would pull it to host just to
+    count)."""
+    total = 0
+    for l in leaves:
+        shape = getattr(l, "shape", ())
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * np.dtype(getattr(l, "dtype", np.float64)).itemsize
+    return total
+
+
+def _coord_key(prefix: str, attempt: int) -> str:
+    base = f"{prefix}/{_COORD_KEY}" if prefix else _COORD_KEY
+    return base if attempt == 0 else f"{base}/r{attempt}"
+
+
+def _rendezvous_coordinator(
+    store: StoreClient,
+    prefix: str,
+    rank: int,
+    attempt: int,
+    connect_timeout: timedelta,
+    probe_listen: bool = False,
+) -> tuple:
+    """One coordinator rendezvous attempt, shared by ``XLACollectives``
+    and the isolated backend's child. Rank 0 reserves a port (held bind),
+    publishes the ACTUAL bound ``host:port`` under the attempt key and
+    returns ``(coord, held_socket)`` — the caller must close the socket
+    immediately before ``jax.distributed.initialize``. Other ranks fetch
+    the key and return ``(coord, None)``.
+
+    ``probe_listen`` (non-zero ranks): poll a TCP connect against the
+    coordinator until it accepts before returning. The distributed
+    runtime's client retries a failed first connect on a ~1 s backoff, so
+    a cohort whose processes (re)start simultaneously pays a full second
+    per member without the probe — the dominant term in the measured
+    ~1.0 s in-process reconfigure. The isolated child probes; the
+    in-process path keeps its historical behavior."""
+    key = _coord_key(prefix, attempt)
+    if rank == 0:
+        port, held = _reserve_port()
+        coord = f"{socket.gethostname()}:{port}"
+        store.set(key, coord.encode())
+        return coord, held
+    coord = store.get(key, timeout=connect_timeout).decode()
+    if probe_listen:
+        host, _, port = coord.rpartition(":")
+        deadline = time.perf_counter() + connect_timeout.total_seconds()
+        while True:
+            try:
+                socket.create_connection((host, int(port)), timeout=0.25).close()
+                break
+            except OSError:
+                if time.perf_counter() >= deadline:
+                    # NEVER hand a dead coordinator to initialize(): on
+                    # runtimes whose registration timeout is a fatal
+                    # process abort (observed on jax 0.4's coordination
+                    # client) the caller's retry protocol would die with
+                    # it. Raising here routes to the attempt loop, which
+                    # checks whether rank 0 republished after a lost
+                    # port race.
+                    raise TimeoutError(
+                        f"coordinator {coord} never started listening "
+                        f"(attempt {attempt})"
+                    )
+                time.sleep(0.005)
+    return coord, None
+
+
+class XLACollectives(OpStatsMixin, Collectives):
     """Reconfigurable cross-group collectives as jitted global-mesh psums.
 
     Results are returned as host-backed local arrays by default (drop-in
@@ -96,10 +209,19 @@ class XLACollectives(Collectives):
         timeout: timedelta = timedelta(seconds=60),
         connect_timeout: timedelta = timedelta(seconds=60),
         keep_global: bool = False,
+        probe_listen: bool = False,
     ) -> None:
+        """``probe_listen``: non-zero ranks poll a TCP connect against
+        the published coordinator until it accepts before calling
+        ``initialize()`` — the distributed client retries a failed first
+        connect on a ~1 s backoff, so cohorts whose processes (re)start
+        simultaneously pay ~1 s per configure without it. Default off
+        (historical behavior); the isolated backend's child turns it on
+        (its whole point is cheap respawn)."""
         self._timeout = timeout
         self._connect_timeout = connect_timeout
         self._keep_global = keep_global
+        self._probe_listen = probe_listen
         self._rank = -1
         self._world_size = 0
         self._mesh: Optional[Any] = None
@@ -148,12 +270,6 @@ class XLACollectives(Collectives):
 
             hostport, prefix = _split_store_addr(store_addr)
             store = StoreClient(hostport, connect_timeout=self._connect_timeout)
-            key = f"{prefix}/{_COORD_KEY}" if prefix else _COORD_KEY
-            if rank == 0:
-                coord = f"{socket.gethostname()}:{_free_port()}"
-                store.set(key, coord.encode())
-            else:
-                coord = store.get(key, timeout=self._connect_timeout).decode()
 
             from jax.extend import backend as jax_backend
 
@@ -184,24 +300,71 @@ class XLACollectives(Collectives):
                 teardown_backends()
                 self._initialized = False
 
-            init_kwargs = dict(
-                coordinator_address=coord,
-                num_processes=world_size,
-                process_id=rank,
-                initialization_timeout=max(
-                    int(self._connect_timeout.total_seconds()), 1
-                ),
-            )
-            try:
-                jax.distributed.initialize(**init_kwargs)
-            except RuntimeError:
-                # The process already ran jax computations, so the XLA
-                # backend pre-dates the distributed runtime ("initialize()
-                # must be called before any JAX calls"). Clear it and
-                # retry once — pre-existing arrays are orphaned, same
-                # contract as a reconfigure.
-                teardown_backends()
-                jax.distributed.initialize(**init_kwargs)
+            attempt = 0
+            while True:
+                try:
+                    coord, held = _rendezvous_coordinator(
+                        store, prefix, rank, attempt, self._connect_timeout,
+                        probe_listen=self._probe_listen,
+                    )
+                    init_kwargs = dict(
+                        coordinator_address=coord,
+                        num_processes=world_size,
+                        process_id=rank,
+                        initialization_timeout=max(
+                            int(self._connect_timeout.total_seconds()), 1
+                        ),
+                    )
+                    if held is not None:
+                        # The reserved port was held through publication;
+                        # the close→bind instant below is the only
+                        # residual race window, and losing it is
+                        # recovered by the attempt loop instead of
+                        # failing the quorum round.
+                        held.close()
+                    try:
+                        jax.distributed.initialize(**init_kwargs)
+                    except RuntimeError as e:
+                        if not _is_backend_predates(e):
+                            raise
+                        # The process already ran jax computations, so the
+                        # XLA backend pre-dates the distributed runtime
+                        # ("initialize() must be called before any JAX
+                        # calls"). Clear it and retry once — pre-existing
+                        # arrays are orphaned, same contract as a
+                        # reconfigure.
+                        teardown_backends()
+                        jax.distributed.initialize(**init_kwargs)
+                    break
+                except Exception as e:  # noqa: BLE001 - attempt routing
+                    if attempt + 1 >= _COORD_ATTEMPTS:
+                        raise
+                    if rank == 0:
+                        if not _is_bind_failure(e):
+                            raise
+                        # Lost the close→bind instant: reserve a fresh
+                        # port and republish under the next attempt key.
+                        attempt += 1
+                        continue
+                    # Non-zero rank: a failed initialize may mean rank 0
+                    # lost the race and republished. The next attempt
+                    # key's presence tells a recoverable loss from a real
+                    # outage (absent -> re-raise the original failure).
+                    # Short bounded poll: rank 0 republishes within
+                    # milliseconds of ITS bind failure (which precedes
+                    # this rank's timeout), so waiting a full
+                    # connect_timeout here would only stall quorum-level
+                    # recovery on every genuine outage.
+                    try:
+                        store.get(
+                            _coord_key(prefix, attempt + 1),
+                            timeout=min(
+                                self._connect_timeout, timedelta(seconds=2)
+                            ),
+                        )
+                    except Exception:
+                        raise e
+                    attempt += 1
             self._initialized = True
             from jax.sharding import Mesh
 
@@ -421,15 +584,36 @@ class XLACollectives(Collectives):
         leaves, treedef = _flatten(tree)
         if not leaves:
             return tree
+        t0 = time.perf_counter()
         stacked = self._stack_global(leaves)
         fn = self._reduce_jit(len(leaves), op, divisor is not None)
+        t1 = time.perf_counter()
         if divisor is not None:
             import jax.numpy as jnp
 
             reduced = fn(stacked, jnp.float32(divisor))
         else:
             reduced = fn(stacked)
-        return _unflatten(treedef, self._localize(reduced))
+        t2 = time.perf_counter()
+        out = self._localize(reduced)
+        # pop_op_stats parity with the host ring: payload bytes, the
+        # bytes that crossed the device link (the localize fetch when
+        # results come back host-backed; keep_global leaves everything on
+        # the mesh), and the stack/dispatch/localize phase split. The
+        # compiled reduce is async — ``ring`` is its DISPATCH, and the
+        # wire wall is absorbed by the blocking localize (``h2d``) or the
+        # caller's next use under keep_global.
+        nbytes = _leaf_bytes(leaves)
+        self._record_op_stats({
+            "op": "allreduce",
+            "backend": "xla",
+            "bytes": nbytes,
+            "d2h_bytes": 0 if self._keep_global else nbytes,
+            "pack": t1 - t0,
+            "ring": t2 - t1,
+            "h2d": time.perf_counter() - t2,
+        })
+        return _unflatten(treedef, out)
 
     def allgather(self, tree: Any) -> Work:
         return self._submit(lambda: self._allgather_sync(tree))
@@ -443,6 +627,7 @@ class XLACollectives(Collectives):
         leaves, treedef = _flatten(tree)
         if not leaves:
             return [tree] * self._world_size
+        t0 = time.perf_counter()
         stacked = self._stack_global(leaves)
         key = ("gather", len(leaves))
         fn = self._jit_cache.get(key)
@@ -466,14 +651,36 @@ class XLACollectives(Collectives):
                     out_shardings=[[replicated] * len(leaves)]
                     * self._world_size,
                 )
-            return [
+            out = [
                 _unflatten(treedef, rows) for rows in row_fn(gathered)
             ]
+            # parity contract: every op drains through pop_op_stats,
+            # keep_global included (nothing crossed the device link)
+            self._record_op_stats({
+                "op": "allgather",
+                "backend": "xla",
+                "bytes": _leaf_bytes(leaves),
+                "d2h_bytes": 0,
+                "pack": time.perf_counter() - t0,
+            })
+            return out
+        t1 = time.perf_counter()
         host = [np.asarray(g) for g in gathered]
-        return [
+        out = [
             _unflatten(treedef, self._localize([h[r] for h in host]))
             for r in range(self._world_size)
         ]
+        nbytes = _leaf_bytes(leaves)
+        self._record_op_stats({
+            "op": "allgather",
+            "backend": "xla",
+            "bytes": nbytes,
+            # every member's row comes back through the host fetch
+            "d2h_bytes": nbytes * self._world_size,
+            "pack": t1 - t0,
+            "h2d": time.perf_counter() - t1,
+        })
+        return out
 
     def broadcast(self, tree: Any, root: int = 0) -> Work:
         return self._submit(lambda: self._broadcast_sync(tree, root))
@@ -498,7 +705,17 @@ class XLACollectives(Collectives):
                 lambda ls: [l[root] for l in ls],
                 out_shardings=[replicated] * len(leaves),
             )
-        return _unflatten(treedef, self._localize(fn(stacked)))
+        t0 = time.perf_counter()
+        out = _unflatten(treedef, self._localize(fn(stacked)))
+        nbytes = _leaf_bytes(leaves)
+        self._record_op_stats({
+            "op": "broadcast",
+            "backend": "xla",
+            "bytes": nbytes,
+            "d2h_bytes": 0 if self._keep_global else nbytes,
+            "h2d": time.perf_counter() - t0,
+        })
+        return out
 
     def barrier(self) -> Work:
         import jax.numpy as jnp
