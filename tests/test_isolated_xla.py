@@ -48,6 +48,8 @@ from torchft_tpu.isolated_xla import (
     ChildDiedError,
     IsolatedXLACollectives,
     _MonitoredChannel,
+    _apply_child_env,
+    _child_env,
     _sig_layout,
 )
 
@@ -253,6 +255,33 @@ class TestMonitoredChannel:
             ch.recv(0.3)
         ch.close()
         fake.child_sock.close()
+
+
+class TestChildEnvContract:
+    def test_child_env_is_parent_env_plus_repo_pythonpath(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_ENV_PROBE", "x1")
+        env = _child_env()
+        assert env["TORCHFT_ENV_PROBE"] == "x1"
+        assert REPO in env["PYTHONPATH"].split(os.pathsep)
+
+    def test_apply_child_env_replaces_not_merges(self):
+        # Regression: zygote-forked children used to MERGE the shipped
+        # env on top of the zygote's startup snapshot, so a variable
+        # unset in the parent since the zygote started (JAX_PLATFORMS,
+        # TORCHFT_*) still reached the child — diverging from the
+        # classic-spawn semantics _spawn_child promises.
+        snap = dict(os.environ)
+        try:
+            os.environ["TORCHFT_STALE_VAR"] = "zombie"
+            desired = dict(snap)
+            desired.pop("TORCHFT_STALE_VAR", None)
+            desired["TORCHFT_FRESH_VAR"] = "new"
+            _apply_child_env(desired)
+            assert "TORCHFT_STALE_VAR" not in os.environ
+            assert os.environ.get("TORCHFT_FRESH_VAR") == "new"
+        finally:
+            os.environ.clear()
+            os.environ.update(snap)
 
 
 class TestIsolatedBackendStorePath:
@@ -482,6 +511,142 @@ class TestIsolatedBackendStorePath:
         finally:
             for c in cols:
                 c.shutdown()
+
+    @pytest.mark.parametrize("fresh_rank", [0, 1])
+    def test_elastic_join_fresh_member_configures_uniformly(
+        self, store, fresh_rank
+    ):
+        # Regression: the capability probe and the /child rendezvous are
+        # cohort-wide, so a cohort with MIXED path hints — an elastic
+        # joiner's fresh parent sends none while incumbents hint the
+        # known verdict — used to strand one side alone in a collective
+        # the other never joins (the joiner wedged for the full
+        # connect+op deadline, and its parent's configure failed on
+        # every retry since _path never locked). Rank 0 now rendezvouses
+        # ONE decision through the store; both orderings must configure
+        # cleanly and land on the same path.
+        import jax.numpy as jnp
+
+        cols = _iso_ring(store, f"qjoin{fresh_rank}", 2, timeout_s=8)
+        old = None
+        try:
+            # the member at fresh_rank "restarts": a brand-new backend
+            # with no memory of the locked path (path_hint=None)
+            old = cols[fresh_rank]
+            cols[fresh_rank] = IsolatedXLACollectives(
+                timeout=timedelta(seconds=8),
+                connect_timeout=timedelta(seconds=20),
+            )
+            addr = f"{store.address()}/qjoin{fresh_rank}b"
+            _run_all(cols, lambda r, c: c.configure(addr, r, 2))
+            assert cols[0].reduction_path() == cols[1].reduction_path()
+            outs = _run_all(
+                cols,
+                lambda r, c: c.allreduce(
+                    jnp.full((4,), float(r + 1)), ReduceOp.SUM
+                ).wait(),
+            )
+            assert np.allclose(np.asarray(outs[0]), 3.0)
+            assert np.allclose(np.asarray(outs[1]), 3.0)
+        finally:
+            if old is not None:
+                old.shutdown()
+            for c in cols:
+                c.shutdown()
+
+    def test_superseded_configure_never_installs_its_child(self):
+        # Regression: a configure whose caller already gave up (outer
+        # timeout -> the next quorum's configure ran its entry kill)
+        # used to keep running, install its late child, flip
+        # _aborted=False, and leak the child untracked on the stale
+        # quorum prefix. The generation token makes the stale install
+        # kill the child and raise instead.
+        c = IsolatedXLACollectives(
+            timeout=timedelta(seconds=10),
+            connect_timeout=timedelta(seconds=20),
+        )
+        real_spawn = c._spawn_and_connect_detached
+        try:
+            gate = threading.Event()
+            release = threading.Event()
+            spawned_pids = []
+
+            def slow_spawn():
+                gate.set()
+                assert release.wait(timeout=30)
+                out = real_spawn()
+                spawned_pids.append(out[0].pid)
+                return out
+
+            c._spawn_and_connect_detached = slow_spawn
+            with c._child_lock:
+                c._cfg_gen += 1
+                gen = c._cfg_gen
+            errors = []
+
+            def stale_configure():
+                try:
+                    c._take_or_spawn_child(gen)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            t = threading.Thread(target=stale_configure)
+            t.start()
+            assert gate.wait(timeout=10)
+            with c._child_lock:
+                c._cfg_gen += 1  # the newer configure's entry kill ran
+            release.set()
+            t.join(timeout=60)
+            assert not t.is_alive()
+            assert errors and "superseded" in str(errors[0]), errors
+            assert c.child_pid() is None, "stale child must not install"
+            # ... and the late child is really reaped, not leaked
+            assert spawned_pids
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and _pid_alive(
+                spawned_pids[0]
+            ):
+                time.sleep(0.05)
+            assert not _pid_alive(spawned_pids[0]), spawned_pids
+        finally:
+            c._spawn_and_connect_detached = real_spawn
+            c.shutdown()
+
+    def test_outer_configure_timeout_covers_inner_deadlines(self):
+        # Regression: the outer configure bound was connect+op while the
+        # inner work can legitimately take spawn accept (<= connect) +
+        # hello (<= connect) + configure reply (<= connect+op) — a slow
+        # but healthy configure was abandoned mid-flight.
+        c = IsolatedXLACollectives(
+            timeout=timedelta(seconds=7),
+            connect_timeout=timedelta(seconds=11),
+        )
+        try:
+            assert c._outer_configure_timeout_s() >= 3 * 11 + 7
+        finally:
+            c.shutdown()
+
+    def test_segment_regrow_evicts_all_staging_views(self):
+        # Regression: regenerating a segment unmapped the old pages
+        # while _staging still held OTHER signatures' numpy views into
+        # them (use-after-unmap; the generation check only rejected the
+        # entries on their next lookup, it did not drop the views).
+        c = IsolatedXLACollectives()
+        try:
+            c._staging_for((((8,), np.dtype(np.float32)),), 1)
+            c._staging_for((((4,), np.dtype(np.int32)),), 1)
+            assert len(c._staging) == 2
+            # a signature larger than the segment forces regeneration
+            c._staging_for((((1 << 15,), np.dtype(np.float32)),), 1)
+            assert len(c._staging) == 1, (
+                "stale-generation staging (dangling views into the "
+                "unmapped segment) must be evicted, not retained"
+            )
+            assert all(g == c._seg_gen for g, _ in c._staging.values())
+            c.shutdown()
+            assert c._staging == {}
+        finally:
+            c.shutdown()
 
     def test_shutdown_reaps_children_and_segments(self, store):
         base = _native.shm_live_count()

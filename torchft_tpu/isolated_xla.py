@@ -23,6 +23,10 @@ training process. This module is the JAX equivalent:
   path (CPU jax without a gloo collectives build), a capability PROBE at
   configure time falls back to a store-mediated numpy reduction — the
   verdict is measured, stamped into every op's stats, and never assumed.
+  The DECISION to probe is rendezvoused through the store (rank 0
+  publishes, everyone follows), so an elastic joiner whose fresh parent
+  has no path hint can never probe alone while incumbents skip — the
+  cohort probes together, with a bounded wait, or not at all.
 - ``configure()`` onto new membership is **SIGKILL + respawn + store
   re-rendezvous**: the parent's live jax arrays are never orphaned (no
   in-process ``jax.distributed`` teardown, no backend clear, no
@@ -51,6 +55,7 @@ import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from datetime import timedelta
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -102,6 +107,30 @@ class ChildDiedError(RuntimeError):
     """The isolated child exited (or was killed) while the parent was
     talking to it. Latches through the managed discipline like any other
     data-plane error; the next quorum's configure() respawns."""
+
+
+def _child_env() -> Dict[str, str]:
+    """The EXACT environment a child must run under (classic-spawn
+    semantics): the parent's CURRENT env with the repo prepended to
+    PYTHONPATH. Both spawn paths use it — Popen gets it as ``env=`` and
+    the zygote ships it whole for the fork to REPLACE its inherited env
+    with (see :func:`_apply_child_env`)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    return env
+
+
+def _apply_child_env(env: Dict[str, str]) -> None:
+    """Child side of the env contract: REPLACE the inherited environment
+    (the zygote's startup snapshot) with the shipped one — clear then
+    update, never merge, so a variable UNSET in the parent since the
+    zygote started (JAX_PLATFORMS, TORCHFT_*) does not leak through the
+    fork and diverge from classic-spawn semantics."""
+    os.environ.clear()
+    os.environ.update(env)
 
 
 # --------------------------------------------------------------------------
@@ -188,11 +217,27 @@ class _MonitoredChannel:
 
 
 class _ChildHandle:
-    """Uniform pid-level surface over a zygote-forked or Popen child."""
+    """Uniform pid-level surface over a zygote-forked or Popen child.
 
-    def __init__(self, pid: int, poll: Callable[[], Optional[int]]) -> None:
+    ``spawn_mode`` records which path actually produced this child
+    ("zygote" | "classic") so op stats never misattribute a classic
+    cold-start's latency to the fork server."""
+
+    def __init__(
+        self,
+        pid: int,
+        poll: Callable[[], Optional[int]],
+        reap: Optional[Callable[..., Any]] = None,
+        spawn_mode: str = "unknown",
+    ) -> None:
         self.pid = pid
         self._poll = poll
+        # Blocking wait that REAPS the child (Popen.wait for classic
+        # spawns). Zygote forks are reaped by the zygote's own waitpid
+        # loop; a classic spawn has no other reaper — without this a
+        # SIGKILLed child lingers as a kill(0)-visible zombie forever.
+        self._reap = reap
+        self.spawn_mode = spawn_mode
 
     def poll(self) -> Optional[int]:
         return self._poll()
@@ -202,6 +247,13 @@ class _ChildHandle:
             os.kill(self.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+        if self._reap is not None:
+            try:
+                # SIGKILL makes this near-immediate; the cap only
+                # guards against a pathological unkillable child.
+                self._reap(timeout=5)
+            except Exception:  # noqa: BLE001 - best-effort reaping
+                pass
 
 
 class _Zygote:
@@ -215,11 +267,7 @@ class _Zygote:
     (kills appear as negative signal codes, subprocess semantics)."""
 
     def __init__(self) -> None:
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = repo + (
-            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-        )
+        env = _child_env()
         self.proc = subprocess.Popen(
             [
                 sys.executable,
@@ -278,7 +326,13 @@ class _Zygote:
             )
             self.proc.stdin.flush()
             msg = self._wait_response(timeout=60.0)
-        pid = msg["pid"]
+        pid = msg.get("pid")
+        if pid is None:
+            # e.g. {"spawn_error": ...}: both parked spares died before
+            # activation — fail NOW so the caller falls back to a
+            # classic spawn instead of waiting a connect timeout on a
+            # child that never got the connect payload.
+            raise RuntimeError(f"iso zygote spawn failed: {msg}")
 
         def poll() -> Optional[int]:
             rc = self.exit_codes.get(pid)
@@ -293,7 +347,7 @@ class _Zygote:
                     return -9
             return None
 
-        return _ChildHandle(pid, poll)
+        return _ChildHandle(pid, poll, spawn_mode="zygote")
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -331,18 +385,14 @@ def _spawn_child(connect: str) -> _ChildHandle:
     zyg = _get_zygote()
     if zyg is not None:
         try:
-            # Ship the CURRENT environment as overrides: the zygote's
-            # own env was captured when it first started, and a knob
-            # changed since (JAX_PLATFORMS, TORCHFT_*) must reach the
+            # Ship the full CURRENT environment: the zygote's own env
+            # was captured when it first started, so the fork REPLACES
+            # its snapshot with this (clear + update) — a knob changed
+            # OR UNSET since (JAX_PLATFORMS, TORCHFT_*) reaches the
             # child exactly as a classic spawn would deliver it.
-            return zyg.spawn(connect, dict(os.environ))
+            return zyg.spawn(connect, _child_env())
         except Exception:  # noqa: BLE001 - zygote wedged: classic spawn
             pass
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo + (
-        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-    )
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -353,9 +403,11 @@ def _spawn_child(connect: str) -> _ChildHandle:
             "--child",
             connect,
         ],
-        env=env,
+        env=_child_env(),
     )
-    return _ChildHandle(proc.pid, proc.poll)
+    return _ChildHandle(
+        proc.pid, proc.poll, reap=proc.wait, spawn_mode="classic"
+    )
 
 
 # --------------------------------------------------------------------------
@@ -491,6 +543,12 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
         self._child_lock = threading.Lock()
         self._child: Optional[_ChildHandle] = None
         self._channel: Optional[_MonitoredChannel] = None
+        # Configure generation (guarded by _child_lock): every configure
+        # entry, abort, and shutdown bumps it; an in-flight do_configure
+        # that no longer holds the current generation must never install
+        # a child or flip _path/_aborted — the caller already saw its
+        # failure, and the next quorum's entry kill must stay final.
+        self._cfg_gen = 0
         # The parked spare: (handle, connected channel) armed in the
         # background after each configure (see _take_or_spawn_child).
         self._spare: Optional[Tuple[_ChildHandle, _MonitoredChannel]] = None
@@ -534,7 +592,9 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
 
     def abort(self) -> None:
         self._aborted = True
-        self.kill_child()
+        with self._child_lock:
+            self._cfg_gen += 1  # cancels any in-flight configure too
+            self._kill_child_locked()
 
     def _spawn_and_connect_detached(
         self,
@@ -565,7 +625,26 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
         assert "hello" in hello, hello
         return child, channel
 
-    def _take_or_spawn_child(self) -> _MonitoredChannel:
+    def _install_child(
+        self, child: _ChildHandle, channel: _MonitoredChannel, gen: int
+    ) -> None:
+        """Installs under the lock iff ``gen`` is still the current
+        configure generation. A stale install (the caller's configure
+        already timed out / was aborted, and a newer entry kill ran)
+        kills the fresh child instead — it would otherwise leak
+        untracked against the new quorum's state."""
+        with self._child_lock:
+            if gen == self._cfg_gen:
+                self._child, self._channel = child, channel
+                return
+        channel.close()
+        child.kill()
+        raise RuntimeError(
+            "isolated xla configure superseded by a newer "
+            "configure/abort/shutdown"
+        )
+
+    def _take_or_spawn_child(self, gen: int) -> _MonitoredChannel:
         """Installs the PARKED SPARE child where one is alive, else
         spawns synchronously. The spare is what makes kill-and-respawn
         reconfigure cheap regardless of the platform's fork cost (under
@@ -578,20 +657,16 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
         if spare is not None:
             child, channel = spare
             if child.poll() is None:
-                with self._child_lock:
-                    self._child, self._channel = child, channel
+                self._install_child(child, channel, gen)
                 self._last_spawn_mode = "spare"
                 return channel
             channel.close()
             child.kill()
         child, channel = self._spawn_and_connect_detached()
-        with self._child_lock:
-            self._child = child
-            self._channel = channel
-        self._last_spawn_mode = (
-            "zygote" if _zygote_enabled() and not _zygote_failed
-            else "classic"
-        )
+        self._install_child(child, channel, gen)
+        # the handle knows which path REALLY produced it (a wedged-but-
+        # alive zygote silently falls back to classic per spawn)
+        self._last_spawn_mode = child.spawn_mode
         return channel
 
     def _prespawn_spare(self) -> None:
@@ -627,23 +702,38 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
         trip exists on this path."""
         t_kill = time.perf_counter()
         self._aborted = True
-        respawn = False
         with self._child_lock:
+            self._cfg_gen += 1
+            gen = self._cfg_gen
             respawn = self._child is not None
             self._kill_child_locked()
 
+        def check_current() -> None:
+            with self._child_lock:
+                if gen != self._cfg_gen:
+                    raise RuntimeError(
+                        "isolated xla configure superseded by a newer "
+                        "configure/abort/shutdown"
+                    )
+
         def do_configure() -> None:
+            check_current()
             self._rank = rank
             self._world_size = world_size
             self._staging.clear()
             if world_size <= 1:
                 # Nothing to isolate from: no peer can wedge a solo
                 # cohort, and ops short-circuit in the parent.
-                self._path = "solo"
-                self._aborted = False
+                with self._child_lock:
+                    if gen != self._cfg_gen:
+                        raise RuntimeError(
+                            "isolated xla configure superseded"
+                        )
+                    self._path = "solo"
+                    self._aborted = False
                 return
             t0 = time.perf_counter()
-            channel = self._take_or_spawn_child()
+            channel = self._take_or_spawn_child(gen)
             t1 = time.perf_counter()
             channel.send({
                 "cmd": "configure",
@@ -652,11 +742,14 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
                 "world_size": world_size,
                 "connect_timeout_s": self._connect_timeout.total_seconds(),
                 "timeout_s": self._timeout.total_seconds(),
-                # Reconfigures of a known backend skip re-probing the
-                # compiled-reduction capability (it is a property of the
-                # install, not the membership); a "store" hint also skips
-                # the distributed-runtime init the fallback never uses —
-                # the reconfigure then costs fork + rendezvous only.
+                # Reconfigures of a known backend hint the capability
+                # verdict (a property of the install, not the
+                # membership). The hint is advisory: rank 0's child
+                # rendezvouses ONE cohort-wide decision through the
+                # store (see _child_configure), so a cohort with mixed
+                # hints — an elastic joiner's fresh parent has none —
+                # either all probes or all skips, never a split where
+                # the joiner wedges alone in a cohort-wide probe.
                 "path_hint": self._path if self._path in (
                     "psum", "store"
                 ) else None,
@@ -665,12 +758,21 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
                 self._connect_timeout.total_seconds()
                 + self._timeout.total_seconds()
             )
-            self._path = reply["path"]
+            with self._child_lock:
+                if gen != self._cfg_gen:
+                    # superseded mid-flight: the child we installed
+                    # belongs to a stale quorum prefix — reap it.
+                    self._kill_child_locked()
+                    raise RuntimeError(
+                        "isolated xla configure superseded"
+                    )
+                self._path = reply["path"]
+                self._aborted = False
             self._configure_count += 1
             self._record_op_stats({
                 "op": "configure",
                 "backend": "iso",
-                "path": self._path,
+                "path": reply["path"],
                 "respawn": respawn,
                 "spawn_mode": self._last_spawn_mode,
                 "kill_s": t0 - t_kill,
@@ -678,14 +780,33 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
                 "child_init_s": reply.get("init_s", 0.0),
                 "rendezvous_s": time.perf_counter() - t1,
             })
-            self._aborted = False
             # arm the NEXT child now, off any future reconfigure's
             # critical path
             self._prespawn_spare()
 
-        self._executor.submit(do_configure).result(
-            timeout=self._connect_timeout.total_seconds()
+        fut = self._executor.submit(do_configure)
+        try:
+            fut.result(timeout=self._outer_configure_timeout_s())
+        except _FuturesTimeout:
+            # Abandoning do_configure mid-flight: invalidate its
+            # generation so it can never install a child or flip
+            # _path/_aborted after this caller-visible failure, and
+            # reap anything it already installed.
+            with self._child_lock:
+                self._cfg_gen += 1
+                self._kill_child_locked()
+            raise
+
+    def _outer_configure_timeout_s(self) -> float:
+        """Bound on the whole configure future. Must COVER the inner
+        deadlines — spawn accept (<= connect) + hello recv (<= connect)
+        + configure reply (<= connect + op) — else a legitimately slow
+        configure is abandoned while still running; the generation token
+        makes that abandonment safe, this sizing makes it rare."""
+        return (
+            3 * self._connect_timeout.total_seconds()
             + self._timeout.total_seconds()
+            + 10.0
         )
 
     def shutdown(self) -> None:
@@ -693,6 +814,7 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
             return
         self._shutdown = True
         with self._child_lock:
+            self._cfg_gen += 1  # a straggling configure can't install
             channel = self._channel
             if channel is not None:
                 try:
@@ -705,6 +827,9 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
             spare[1].close()
             spare[0].kill()
         self._executor.shutdown(wait=True)
+        # drop every staging view BEFORE the close unmaps the pages
+        # underneath them
+        self._staging.clear()
         for name, seg in self._segs.items():
             if seg is not None:
                 seg.close()
@@ -746,6 +871,13 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
             self._seg_name(kind), max(nbytes, 1 << 16)
         )
         if seg is not None:
+            # Every cached _Staging holds numpy views into the OLD
+            # mapping: evict them ALL before the close unmaps the pages
+            # underneath them. The generation check in _staging_for
+            # would reject the stale entries later, but the dangling
+            # views must not exist at all — any access in between would
+            # be a use-after-unmap.
+            self._staging.clear()
             seg.close()
         self._segs[kind] = new
         return new
@@ -1054,14 +1186,32 @@ def _child_configure(state: _ChildState, req: dict) -> dict:
     hostport, prefix = _split_store_addr(req["store_addr"])
     state.prefix = prefix
     state.store = StoreClient(hostport, connect_timeout=connect_timeout)
+    # The parent's path_hint is ADVISORY, never acted on alone: both the
+    # capability probe and the /child rendezvous are cohort-wide, so a
+    # cohort with mixed hints — an elastic joiner's fresh parent sends
+    # none while incumbents hint "psum"/"store" — would strand the
+    # joiner's child alone in a collective no incumbent joins. Rank 0
+    # rendezvouses ONE decision through the store: probe, or skip to the
+    # hinted verdict (a property of the install, not the membership).
+    # Every member follows it, so the cohort probes together or not at
+    # all; the follower fetch is bounded by connect_timeout.
     hint = req.get("path_hint")
-    if hint == "store":
-        # The capability verdict is a property of the install, not the
-        # membership: a known store-path host skips the distributed
-        # runtime its fallback never uses. No cohort barrier either —
-        # the first op's blocking fetch gives the same failure surface
-        # (a missing peer surfaces at the op deadline and latches), so
-        # a respawn costs child activation + store attach only: the
+    decision_key = f"{state.prefix}/iso/cfg/decision"
+    if state.rank == 0:
+        decision = hint if hint in ("psum", "store") else "probe"
+        state.store.set(
+            decision_key, decision.encode(), timeout=connect_timeout
+        )
+    else:
+        decision = state.store.get(
+            decision_key, timeout=connect_timeout
+        ).decode()
+    if decision == "store":
+        # Known store-path cohort: skip the distributed runtime the
+        # fallback never uses. No cohort barrier either — the first
+        # op's blocking fetch gives the same failure surface (a missing
+        # peer surfaces at the op deadline and latches), so a respawn
+        # costs child activation + store attach only: the
         # step-granularity reconfigure the isolation exists for.
         state.path = "store"
         return {"ok": True, "path": "store",
@@ -1085,20 +1235,30 @@ def _child_configure(state: _ChildState, req: dict) -> dict:
     # cross-talk with the isolated cohort).
     xc.configure(req["store_addr"] + "/child", state.rank, state.world)
     init_s = time.perf_counter() - t0
-    if hint == "psum":
+    if decision == "psum":
         # Known-good compiled path: skip the probe collective.
         state.xc = xc
         state.path = "psum"
         return {"ok": True, "path": "psum", "init_s": init_s}
     # Capability probe: the compiled multi-process reduction is MEASURED,
     # never assumed (CPU jax without a gloo collectives build raises at
-    # first cross-process dispatch). Every member probes at the same
-    # point, so the verdict is cohort-uniform on homogeneous installs.
+    # first cross-process dispatch). The store rendezvous above makes
+    # the decision to probe cohort-uniform; the verdict itself is
+    # uniform on homogeneous installs. The wait is BOUNDED: a peer that
+    # dies mid-probe costs one op deadline, never a wedge.
     try:
-        probe = xc.allreduce(jnp.ones((8,), jnp.float32), ReduceOp.SUM).wait()
+        probe = xc.allreduce(jnp.ones((8,), jnp.float32), ReduceOp.SUM).wait(
+            timeout=timedelta(seconds=req["timeout_s"])
+        )
         jax.block_until_ready(probe)
         state.xc = xc
         state.path = "psum"
+    except _FuturesTimeout:
+        # A probe TIMEOUT is not a capability verdict (a peer died or
+        # wedged mid-probe): fail the configure honestly — silently
+        # falling back here could split the cohort across paths.
+        xc.abort()
+        raise
     except Exception:  # noqa: BLE001 - no compiled path here
         state.path = "store"
         xc.abort()
@@ -1391,7 +1551,9 @@ def _zygote_main() -> None:
                 devnull = os.open(os.devnull, os.O_RDONLY)
                 os.dup2(devnull, 0)
                 os.dup2(2, 1)  # keep the protocol stdout clean
-                os.environ.update(req.get("env", {}))
+                env = req.get("env")
+                if env is not None:
+                    _apply_child_env(env)
                 _child_connect(req["connect"])
                 os._exit(0)
             except SystemExit as e:
@@ -1420,25 +1582,39 @@ def _zygote_main() -> None:
             # activate the parked spare (a pipe write), answer, THEN
             # fork its replacement off the critical path
             payload = (json.dumps(req) + "\n").encode()
+            delivered = False
             for _attempt in range(2):
                 try:
                     os.write(spare_w, payload)
                     os.close(spare_w)
+                    delivered = True
                     break
                 except OSError:
-                    # the spare died unactivated (pipe's read end gone):
-                    # replace it and retry once; a second immediate
-                    # death is a real environment problem and may crash
-                    # us — the parent falls back to classic spawns.
+                    # the spare died unactivated (pipe's read end
+                    # gone): replace it and retry once
                     try:
                         os.close(spare_w)
                     except OSError:
                         pass
                     spare_pid, spare_w = fork_spare()
                     children[spare_pid] = True
-            print(json.dumps({"pid": spare_pid}), flush=True)
-            spare_pid, spare_w = fork_spare()
-            children[spare_pid] = True
+            if delivered:
+                print(json.dumps({"pid": spare_pid}), flush=True)
+                spare_pid, spare_w = fork_spare()
+                children[spare_pid] = True
+            else:
+                # two spares died before activation: a real environment
+                # problem. Report FAILURE — never the pid of a spare
+                # that never received the connect payload (the parent
+                # would stall a full connect timeout on it); the caller
+                # falls back to a classic spawn, and the last-forked
+                # spare stays parked for the next request.
+                print(
+                    json.dumps(
+                        {"spawn_error": "spare died unactivated twice"}
+                    ),
+                    flush=True,
+                )
         for pid in list(children):
             wpid, status = os.waitpid(pid, os.WNOHANG)
             if wpid:
