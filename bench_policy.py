@@ -1,0 +1,609 @@
+"""Policy-engine benchmark: runtime strategy selection vs fixed strategies
+across a scripted scenario matrix (ROADMAP item 4 / Chameleon).
+
+Topology: two replica groups as threads (the manager-integ harness), one
+lighthouse, a REAL HostCollectives TCP ring between them, a ~2 MB MLP
+whose per-step compute is large enough that sync schedules genuinely
+trade off on this host.
+
+Scenarios (the conditions the policy engine must track):
+
+  stable         fat loopback link, no faults -> amortized-sync windowed
+                 strategies win; the policy must match the best fixed.
+  churny         fat link + a ring-visible fault every ``--fault-period``
+                 seconds (group 1 poisons its next data-plane collective:
+                 the native op-mismatch fail-fast latches EVERY member,
+                 the transaction aborts cohort-wide and forces a
+                 reconfigure — the surfacing behavior of a real
+                 mid-collective member death). Long windows lose a whole
+                 window per fault; per-step DDP loses one step.
+  degraded       the ring's send pacing capped (TORCHFT_HC_WIRE_CAP_MBPS)
+                 -> per-step f32 sync crawls; DiLoCo's q8 window strategy
+                 barely notices.
+  regime_change  first half churny-fat, second half degraded-quiet: no
+                 fixed strategy is right for both halves. The policy must
+                 switch mid-run and beat EVERY fixed strategy.
+
+Metric: goodput = cohort-committed inner training steps per wall second
+(windowed strategies only bank a window's steps when its sync commits).
+
+The artifact (POLICY_BENCH.json) also carries a ``switch_fault`` entry:
+a strategy switch with an injected member failure during the decision
+transaction, proving the transition is split-brain-free end-to-end across
+2 managers (both members abort the poisoned decision, both complete the
+switch on the next clean one, decision histories bit-identical).
+
+Usage::
+
+    python bench_policy.py                  # full matrix -> POLICY_BENCH.json
+    python bench_policy.py --dryrun         # seconds-scale CI smoke, no file
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+EPS = 0.25  # policy must reach (1-EPS) x best fixed on stable scenarios
+
+
+# --------------------------------------------------------------------------
+# model: large enough that compute vs sync is a real tradeoff on CPU
+# --------------------------------------------------------------------------
+
+
+def _make_problem(d: int, hidden: int, batch: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w1": jnp.asarray(rng.standard_normal((d, hidden)) * 0.02, jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((hidden, d)) * 0.02, jnp.float32),
+    }
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+
+    def grad_fn(p, xb):
+        def loss(pp):
+            h = jnp.tanh(xb @ pp["w1"])
+            return jnp.mean((h @ pp["w2"] - xb) ** 2)
+
+        return jax.value_and_grad(loss)(p)
+
+    return params, jax.jit(grad_fn), x
+
+
+# --------------------------------------------------------------------------
+# scenario scripting
+# --------------------------------------------------------------------------
+
+
+class Scenario:
+    def __init__(
+        self,
+        name: str,
+        ticks: Any,
+        fault_period_s: Optional[float] = None,
+        cap_mbps: Optional[float] = None,
+        regime_cap_mbps: Optional[float] = None,
+        phase_a_s: Optional[float] = None,
+    ) -> None:
+        self.name = name
+        # int, or {run_name: int}: per-strategy budgets let a crawling
+        # strategy finish while windowed runs get enough windows — the
+        # metric normalizes by wall time, so unequal budgets are fair.
+        self.ticks = ticks
+        self.fault_period_s = fault_period_s
+        self.cap_mbps = cap_mbps              # from the start
+        # regime change: applied when WALL time passes phase_a_s (wall,
+        # not ticks: every strategy must spend the same time in each
+        # phase, or fast-discarding strategies dodge the bad phase)
+        self.regime_cap_mbps = regime_cap_mbps
+        self.phase_a_s = phase_a_s
+
+    def budget(self, run_name: str) -> int:
+        if isinstance(self.ticks, dict):
+            return self.ticks.get(run_name, self.ticks["default"])
+        return self.ticks
+
+    def apply_initial_env(self) -> None:
+        if self.cap_mbps is not None:
+            os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(self.cap_mbps)
+        else:
+            os.environ.pop("TORCHFT_HC_WIRE_CAP_MBPS", None)
+
+
+class _Poison:
+    """One-shot ring-visible fault: when armed, group 1 ships a
+    wrong-shaped tree into its next data-plane collective — the native
+    op-mismatch fail-fast latches every member, so the transaction aborts
+    cohort-wide (the surfacing behavior of a member dying mid-window:
+    its lease outlives it and the next sync forms around the corpse)."""
+
+    def __init__(self) -> None:
+        self.armed = False
+        self.fired = 0
+
+    def arm(self) -> None:
+        self.armed = True
+
+    def wrap(self, manager) -> None:
+        import numpy as np
+
+        for name in ("allreduce", "reduce_scatter"):
+            orig = getattr(manager, name)
+
+            def poisoned(tree, *a, _orig=orig, **kw):
+                if self.armed:
+                    self.armed = False
+                    self.fired += 1
+                    tree = {"__fault__": np.zeros(3, np.float32)}
+                return _orig(tree, *a, **kw)
+
+            setattr(manager, name, poisoned)
+
+
+# --------------------------------------------------------------------------
+# one run: (scenario, candidate set) across two replica-group threads
+# --------------------------------------------------------------------------
+
+
+def _worker(
+    gid: int,
+    lighthouse_addr: str,
+    scenario: Scenario,
+    run_name: str,
+    candidates,
+    decide_every: int,
+    barrier: threading.Barrier,
+    problem_cfg,
+    poison_decide_epoch: Optional[int] = None,
+):
+    import numpy as np
+
+    from torchft_tpu import (
+        FTTrainState,
+        HostCollectives,
+        Manager,
+        PolicyEngine,
+        Store,
+    )
+    from torchft_tpu.policy import CostKnobs
+    import optax
+
+    params, grad_fn, x = _make_problem(*problem_cfg)
+    state = FTTrainState(params, optax.sgd(0.05))
+    store = Store()
+    policy = None
+    manager = Manager(
+        collectives=HostCollectives(timeout=timedelta(seconds=60)),
+        load_state_dict=lambda s: policy.load_state_dict(s),
+        state_dict=lambda: policy.state_dict(),
+        min_replica_size=2,
+        rank=0,
+        world_size=1,
+        use_async_quorum=False,
+        timeout=timedelta(seconds=60),
+        quorum_timeout=timedelta(seconds=60),
+        store_addr=store.address(),
+        lighthouse_addr=lighthouse_addr,
+        replica_id=f"pb_{gid}",
+    )
+    poison = _Poison()
+    if gid == 1:
+        poison.wrap(manager)
+    try:
+        policy = PolicyEngine(
+            manager, state, grad_fn, outer_tx=optax.sgd(0.7),
+            candidates=candidates, decide_every=decide_every,
+            # raw-goodput objective, pinned literals (NOT from_env: the
+            # bench must be reproducible regardless of ambient knobs) —
+            # staleness 0 because the metric is steps/s, no convergence
+            # discount
+            knobs=CostKnobs(
+                staleness_weight=0.0,
+                sync_fixed_s=0.002,
+                hysteresis=0.1,
+                surface_s=1.0,
+            ),
+        )
+        if poison_decide_epoch is not None and gid == 1:
+            orig_allgather = manager.allgather
+
+            def failing_allgather(tree):
+                if (
+                    isinstance(tree, dict)
+                    and "policy_sig" in tree
+                    and policy._decide_epoch == poison_decide_epoch
+                ):
+                    tree = {"policy_sig": np.zeros(3, np.float64)}
+                return orig_allgather(tree)
+
+            manager.allgather = failing_allgather
+
+        # Warm the compiled step OFF the clock (and before any fault can
+        # target it): early jit-compile walls otherwise eat several fault
+        # periods and poison every warmup transaction, polluting the
+        # measured churn regime.
+        import jax
+
+        jax.block_until_ready(grad_fn(state.params, x))
+        barrier.wait(timeout=120)
+        t0 = time.monotonic()
+        next_fault = (
+            t0 + scenario.fault_period_s
+            if scenario.fault_period_s is not None
+            else None
+        )
+        inner_committed = 0
+        regime_flipped = scenario.regime_cap_mbps is None
+        committed_at_flip: Optional[int] = None
+        flip_t: Optional[float] = None
+        for tick in range(scenario.budget(run_name)):
+            if (
+                not regime_flipped
+                and time.monotonic() - t0 >= scenario.phase_a_s
+            ):
+                # the regime event, on the WALL clock: the link degrades.
+                # Set the cap (read at the next reconfigure) and, from
+                # group 1, poison one transaction so the reconfigure
+                # actually happens — the bench's stand-in for the link
+                # flap that comes with a real degradation event. Only
+                # group 1 ACTS (env is process-shared; the poison is
+                # ring-visible), so the flip needs no cross-thread
+                # coordination.
+                os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(
+                    scenario.regime_cap_mbps
+                )
+                next_fault = None  # phase B is quiet
+                if gid == 1:
+                    poison.arm()
+                regime_flipped = True
+                committed_at_flip = inner_committed
+                flip_t = time.monotonic()
+            if next_fault is not None and time.monotonic() >= next_fault:
+                if gid == 1:
+                    poison.arm()
+                # from NOW, not += period: when steps run slower than the
+                # fault period, missed periods must not queue up into a
+                # poison-every-step storm (at most one fault per step)
+                next_fault = time.monotonic() + scenario.fault_period_s
+            spec = policy.strategy
+            eng = policy._engine(spec)
+            policy.step(x)
+            if spec.kind == "ddp":
+                if eng.last_commit:
+                    inner_committed += 1
+            elif eng._local_step == 0 and eng.last_sync_commit:
+                inner_committed += spec.sync_every
+        policy.flush()
+        elapsed = time.monotonic() - t0
+        out: Dict[str, Any] = {
+            "gid": gid,
+            "inner_committed": inner_committed,
+            "elapsed_s": elapsed,
+            "strategy": policy.strategy.name,
+            "decisions": policy.decisions,
+            "switches": [d for d in policy.decisions if d["switched"]],
+            "faults_fired": poison.fired,
+            "signals": manager.signals(60.0),
+            "params_digest": float(np.abs(np.asarray(state.params["w1"])).sum()),
+        }
+        if committed_at_flip is not None and flip_t is not None:
+            out["phase_a"] = {
+                "inner_committed": committed_at_flip,
+                "elapsed_s": flip_t - t0,
+            }
+            out["phase_b"] = {
+                "inner_committed": inner_committed - committed_at_flip,
+                "elapsed_s": time.monotonic() - flip_t,
+            }
+        return out
+    finally:
+        manager.shutdown()
+        store.shutdown()
+
+
+def run_once(
+    scenario: Scenario,
+    run_name: str,
+    candidates,
+    decide_every: int,
+    problem_cfg,
+    poison_decide_epoch: Optional[int] = None,
+) -> Dict[str, Any]:
+    from torchft_tpu import Lighthouse
+
+    scenario.apply_initial_env()
+    lighthouse = Lighthouse(
+        bind="[::]:0", min_replicas=2, join_timeout_ms=2000,
+        quorum_tick_ms=50, heartbeat_timeout_ms=10000,
+    )
+    barrier = threading.Barrier(2)
+    try:
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [
+                ex.submit(
+                    _worker, gid, lighthouse.address(), scenario, run_name,
+                    candidates, decide_every, barrier, problem_cfg,
+                    poison_decide_epoch,
+                )
+                for gid in range(2)
+            ]
+            results = sorted(
+                (f.result(timeout=1200) for f in futs),
+                key=lambda r: r["gid"],
+            )
+    finally:
+        lighthouse.shutdown()
+        os.environ.pop("TORCHFT_HC_WIRE_CAP_MBPS", None)
+    elapsed = max(r["elapsed_s"] for r in results)
+    total = sum(r["inner_committed"] for r in results)
+    out = {
+        "goodput_steps_per_s": round(total / elapsed, 3),
+        "elapsed_s": round(elapsed, 2),
+        "inner_committed": total,
+        "final_strategy": results[0]["strategy"],
+        "members": results,
+    }
+    for phase in ("phase_a", "phase_b"):
+        if phase in results[0]:
+            pe = max(r[phase]["elapsed_s"] for r in results)
+            pt = sum(r[phase]["inner_committed"] for r in results)
+            out[phase] = {
+                "goodput_steps_per_s": round(pt / pe, 3) if pe > 0 else 0.0,
+                "inner_committed": pt,
+            }
+    return out
+
+
+# --------------------------------------------------------------------------
+# the matrix
+# --------------------------------------------------------------------------
+
+
+def _specs():
+    """The benched candidate ladder: the strategy x wire x sync-interval
+    points whose orderings genuinely flip across the scenario regimes on
+    a CPU host — per-step DDP (f32), a SHORT q8 DiLoCo window (cheap to
+    lose, frequent syncs) and a LONG one (8x the amortization, a whole
+    window lost per surfacing fault)."""
+    from torchft_tpu import StrategySpec
+
+    return (
+        StrategySpec("ddp", "ddp"),
+        StrategySpec("diloco_q8_h4", "diloco", sync_every=4, wire="q8"),
+        StrategySpec("diloco_q8_h32", "diloco", sync_every=32, wire="q8"),
+    )
+
+
+def run_matrix(args) -> Dict[str, Any]:
+    specs = _specs()
+    fixed = {s.name: [s] for s in specs}
+    policy_cands = list(specs)
+    problem_cfg = (args.dim, args.hidden, args.batch)
+
+    t = args.ticks
+    scenarios = [
+        # the policy pays a fixed startup ramp in every scenario (it
+        # starts on the base strategy and needs a decision cycle or two
+        # to settle); its budgets are longer so steady state dominates —
+        # goodput is wall-normalized, so unequal budgets stay comparable
+        Scenario("stable", {"policy": t * 3, "default": t}),
+        Scenario(
+            "churny", {"policy": t * 3, "default": t},
+            fault_period_s=args.fault_period,
+        ),
+        Scenario(
+            "degraded",
+            {"ddp": max(t // 5, 32), "policy": t * 6, "default": t * 3},
+            cap_mbps=args.cap_mbps,
+        ),
+        Scenario(
+            "regime_change",
+            # per-strategy budgets sized so every run covers phase A's
+            # wall AND a comparable phase-B wall, despite order-of-
+            # magnitude per-tick speed differences
+            {
+                "ddp": int(t * 1.6),
+                "diloco_q8_h4": t * 4,
+                "diloco_q8_h32": t * 6,
+                "policy": t * 5,
+                "default": t * 4,
+            },
+            fault_period_s=args.fault_period,
+            regime_cap_mbps=args.cap_mbps,
+            phase_a_s=args.phase_a_s,
+        ),
+    ]
+
+    out: Dict[str, Any] = {"scenarios": {}}
+    for sc in scenarios:
+        entry: Dict[str, Any] = {"fixed": {}, "ticks": sc.ticks}
+        for name, cands in fixed.items():
+            print(f"[bench_policy] {sc.name} / fixed {name} ...", flush=True)
+            entry["fixed"][name] = run_once(
+                sc, name, cands, args.decide_every, problem_cfg
+            )
+        print(f"[bench_policy] {sc.name} / policy ...", flush=True)
+        entry["policy"] = run_once(
+            sc, "policy", policy_cands, args.decide_every, problem_cfg
+        )
+        best_name = max(
+            entry["fixed"], key=lambda n: entry["fixed"][n]["goodput_steps_per_s"]
+        )
+        best = entry["fixed"][best_name]["goodput_steps_per_s"]
+        pol = entry["policy"]["goodput_steps_per_s"]
+        entry["best_fixed"] = best_name
+        entry["policy_vs_best_fixed"] = round(pol / best, 3) if best else None
+        if sc.name == "regime_change":
+            entry["policy_beats_all_fixed"] = all(
+                pol > e["goodput_steps_per_s"]
+                for e in entry["fixed"].values()
+            )
+        else:
+            entry["policy_within_eps"] = pol >= (1.0 - EPS) * best
+        out["scenarios"][sc.name] = entry
+        print(
+            f"[bench_policy] {sc.name}: best_fixed={best_name} {best} "
+            f"policy={pol} final={entry['policy']['final_strategy']}",
+            flush=True,
+        )
+    return out
+
+
+def run_switch_fault(args) -> Dict[str, Any]:
+    """A strategy switch with a member failure injected into the decision
+    transaction, across 2 real managers: epoch 0's decision is poisoned by
+    group 1 (ring-visible), so BOTH members must abort it; the next clean
+    decision must complete the switch on both. Split-brain-free =
+    bit-identical decision histories + no epoch where members disagree."""
+    specs = _specs()
+    print("[bench_policy] switch_fault (split-brain probe) ...", flush=True)
+    sc = Scenario("switch_fault", args.ticks, cap_mbps=args.cap_mbps)
+    res = run_once(
+        sc, "policy", list(specs), max(args.decide_every // 2, 4),
+        (args.dim, args.hidden, args.batch),
+        poison_decide_epoch=0,
+    )
+    a, b = res["members"]
+    hist_a = [
+        (d["epoch"], d["from"], d["to"], d["committed"], d["switched"])
+        for d in a["decisions"]
+    ]
+    hist_b = [
+        (d["epoch"], d["from"], d["to"], d["committed"], d["switched"])
+        for d in b["decisions"]
+    ]
+    first_aborted = bool(
+        hist_a and not hist_a[0][3] and hist_b and not hist_b[0][3]
+    )
+    switched_later = any(h[4] for h in hist_a[1:]) and any(
+        h[4] for h in hist_b[1:]
+    )
+    same_final = a["strategy"] == b["strategy"]
+    return {
+        "split_brain_free": bool(
+            hist_a == hist_b and first_aborted and same_final
+        ),
+        "injected_fault_aborted_everywhere": first_aborted,
+        "switch_completed_on_next_clean_decision": switched_later,
+        "decision_histories_identical": hist_a == hist_b,
+        "final_strategy": {"g0": a["strategy"], "g1": b["strategy"]},
+        "decisions_g0": a["decisions"],
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ticks", type=int, default=256)
+    parser.add_argument("--decide-every", type=int, default=8)
+    parser.add_argument("--fault-period", type=float, default=0.2)
+    parser.add_argument("--phase-a-s", type=float, default=5.0,
+                        help="wall seconds of the regime script's first "
+                        "(churny, fat-link) phase")
+    parser.add_argument("--cap-mbps", type=float, default=3.0,
+                        help="per-connection send cap for degraded phases "
+                        "(x4 stripes = effective link)")
+    parser.add_argument("--dim", type=int, default=384)
+    parser.add_argument("--hidden", type=int, default=768)
+    parser.add_argument("--batch", type=int, default=192)
+    parser.add_argument("--out", default=os.path.join(REPO, "POLICY_BENCH.json"))
+    parser.add_argument(
+        "--dryrun", action="store_true",
+        help="seconds-scale smoke: regime-change policy run + switch-fault "
+        "probe only; asserts a recorded strategy switch with its "
+        "triggering signal; writes no artifact",
+    )
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the churn signal must decay fast enough to see a regime change
+    os.environ.setdefault("TORCHFT_POLICY_CHURN_WINDOW_S", "2")
+
+    if args.dryrun:
+        args.ticks = 64
+        args.decide_every = 8
+        args.fault_period = 0.2
+        specs = _specs()
+        sc = Scenario(
+            "dryrun_regime", args.ticks * 4,
+            fault_period_s=args.fault_period,
+            regime_cap_mbps=args.cap_mbps,
+            phase_a_s=2.0,
+        )
+        res = run_once(
+            sc, "policy", list(specs), args.decide_every,
+            (args.dim, args.hidden, args.batch),
+        )
+        switches = res["members"][0]["switches"]
+        assert switches, (
+            "dryrun: the regime-change script must record at least one "
+            f"strategy switch (decisions: {res['members'][0]['decisions']})"
+        )
+        for sw in switches:
+            assert sw["signals"], "a switch must carry its triggering signals"
+            assert "wire_eff_MBps" in sw["signals"]
+        fault = run_switch_fault(args)
+        assert fault["injected_fault_aborted_everywhere"], fault
+        assert fault["decision_histories_identical"], fault
+        print(json.dumps({
+            "dryrun": True,
+            "switches": switches,
+            "switch_fault_ok": fault["split_brain_free"],
+            "goodput": res["goodput_steps_per_s"],
+        }))
+        return
+
+    result: Dict[str, Any] = {
+        "generated_by": "bench_policy.py",
+        "eps": EPS,
+        "config": {
+            "groups": 2,
+            "model_params": args.dim * args.hidden * 2,
+            "model_bytes_f32": args.dim * args.hidden * 2 * 4,
+            "batch": args.batch,
+            "ticks": args.ticks,
+            "decide_every": args.decide_every,
+            "fault_period_s": args.fault_period,
+            "cap_mbps_per_conn": args.cap_mbps,
+            "phase_a_s": args.phase_a_s,
+            "candidates": [sp.name for sp in _specs()],
+            "churn_window_s": float(
+                os.environ["TORCHFT_POLICY_CHURN_WINDOW_S"]
+            ),
+            "staleness_weight": 0.0,
+        },
+    }
+    result.update(run_matrix(args))
+    result["switch_fault"] = run_switch_fault(args)
+
+    summary = {
+        name: {
+            "best_fixed": e["best_fixed"],
+            "policy_vs_best_fixed": e["policy_vs_best_fixed"],
+            "ok": e.get("policy_within_eps", e.get("policy_beats_all_fixed")),
+        }
+        for name, e in result["scenarios"].items()
+    }
+    summary["switch_fault"] = result["switch_fault"]["split_brain_free"]
+    print(json.dumps(summary))
+
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"[bench_policy] wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,6 +151,14 @@ int tft_region_status_json(void* handle, char** out) {
   });
 }
 
+// The region-side quorum cache: the last root quorum served locally with
+// its refresh age (no root round trip per read).
+int tft_region_quorum_json(void* handle, char** out) {
+  return guarded([&] {
+    *out = dup_string(static_cast<RegionLighthouse*>(handle)->quorum_json());
+  });
+}
+
 // ---- LeaseClient (persistent lighthouse-protocol client) ----
 
 // A LighthouseClient handle for batch lease renewal / heartbeat / depart
@@ -211,6 +219,15 @@ void* tft_manager_create(const char* replica_id, const char* lighthouse_addr,
 // (region failover active).
 int tft_manager_using_root(void* handle) {
   return static_cast<ManagerServer*>(handle)->using_root_fallback() ? 1 : 0;
+}
+
+// Publishes a member-health digest (JSON) carried on subsequent lease
+// renewals into the lighthouse's per-member /status.json view.
+int tft_manager_set_status(void* handle, const char* status_json) {
+  return guarded([&] {
+    static_cast<ManagerServer*>(handle)->set_status_json(
+        status_json ? status_json : "");
+  });
 }
 
 char* tft_manager_address(void* handle) {

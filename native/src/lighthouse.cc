@@ -449,6 +449,14 @@ Json Lighthouse::status_json_locked() {
     m["ttl_ms"] = ttl;
     m["lease_remaining_ms"] = last + ttl - now;
     m["participating"] = state_.participants.count(replica_id) > 0;
+    auto st = state_.member_status.find(replica_id);
+    if (st != state_.member_status.end()) {
+      try {
+        m["status"] = Json::parse(st->second);
+      } catch (const std::exception&) {
+        m["status"] = st->second; // unparseable digest: surface raw
+      }
+    }
     members.push_back(Json(std::move(m)));
   }
   o["members"] = Json(std::move(members));

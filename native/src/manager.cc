@@ -146,6 +146,11 @@ bool ManagerServer::using_root_fallback() {
   return using_root_;
 }
 
+void ManagerServer::set_status_json(const std::string& status_json) {
+  MutexLock lock(mu_);
+  status_json_ = status_json;
+}
+
 LighthouseClient* ManagerServer::active_lighthouse() {
   MutexLock lock(lh_mu_);
   return using_root_ && root_client_ ? root_client_.get()
@@ -187,6 +192,10 @@ void ManagerServer::heartbeat_loop() {
       std::vector<LeaseEntry> entries(1);
       entries[0].replica_id = replica_id_;
       entries[0].ttl_ms = lease_ttl_ms_;
+      {
+        MutexLock lock(mu_);
+        entries[0].status_json = status_json_;
+      }
       client->lease_renew(entries, heartbeat_interval_ms_ * 10);
       failures = 0;
     } catch (const std::exception& e) {

@@ -72,6 +72,14 @@ class ManagerServer {
   // Whether the manager is currently registered directly at the root
   // (region failover active). Always false without a root_addr.
   bool using_root_fallback();
+  // Publishes a member-health digest (JSON string) that rides every
+  // subsequent lease renewal to the lighthouse, where it appears in the
+  // per-member /status.json view. Display-only. Empty stops PUBLISHING
+  // (renewals then carry no digest — the wire form of a pre-status
+  // client); the lighthouse keeps the last non-empty digest until the
+  // member departs or its lease is pruned, because an empty entry is
+  // indistinguishable from a renewer that simply doesn't speak status.
+  void set_status_json(const std::string& status_json);
 
  private:
   void accept_loop();
@@ -103,6 +111,7 @@ class ManagerServer {
   bool using_root_ TFT_GUARDED_BY(lh_mu_) = false;
 
   Mutex mu_;
+  std::string status_json_ TFT_GUARDED_BY(mu_);
   // Reference: src/manager.rs:40-48 (ManagerState).
   std::map<int64_t, std::string> checkpoint_metadata_ TFT_GUARDED_BY(mu_);
   std::set<int64_t> participants_ TFT_GUARDED_BY(mu_);

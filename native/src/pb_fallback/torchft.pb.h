@@ -369,6 +369,8 @@ class LeaseEntry {
   void set_ttl_ms(int64_t v) { ttl_ms_ = v; }
   bool participating() const { return participating_; }
   void set_participating(bool v) { participating_ = v; }
+  const std::string& status_json() const { return status_json_; }
+  void set_status_json(const std::string& v) { status_json_ = v; }
   bool has_member() const { return has_member_; }
   const QuorumMember& member() const { return member_; }
   QuorumMember* mutable_member() {
@@ -381,6 +383,7 @@ class LeaseEntry {
     tft_pb::put_int64(out, 2, ttl_ms_);
     tft_pb::put_bool(out, 3, participating_);
     if (has_member_) tft_pb::put_len_prefixed(out, 4, member_.SerializeAsString());
+    tft_pb::put_str(out, 5, status_json_);
   }
   bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
     switch (f) {
@@ -394,6 +397,7 @@ class LeaseEntry {
           return true;
         }
         break;
+      case 5: if (w == 2) { status_json_ = r.bytes(); return true; } break;
     }
     return false;
   }
@@ -403,6 +407,7 @@ class LeaseEntry {
   std::string replica_id_;
   int64_t ttl_ms_ = 0;
   bool participating_ = false;
+  std::string status_json_;
   QuorumMember member_;
   bool has_member_ = false;
 };
@@ -492,6 +497,8 @@ class DigestEntry {
     has_member_ = true;
     return &member_;
   }
+  const std::string& status_json() const { return status_json_; }
+  void set_status_json(const std::string& v) { status_json_ = v; }
 
   void AppendTo(std::string& out) const {
     tft_pb::put_str(out, 1, replica_id_);
@@ -500,6 +507,7 @@ class DigestEntry {
     tft_pb::put_bool(out, 4, participating_);
     tft_pb::put_int64(out, 5, joined_age_ms_);
     if (has_member_) tft_pb::put_len_prefixed(out, 6, member_.SerializeAsString());
+    tft_pb::put_str(out, 7, status_json_);
   }
   bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
     switch (f) {
@@ -515,6 +523,7 @@ class DigestEntry {
           return true;
         }
         break;
+      case 7: if (w == 2) { status_json_ = r.bytes(); return true; } break;
     }
     return false;
   }
@@ -524,6 +533,7 @@ class DigestEntry {
   std::string replica_id_;
   int64_t lease_age_ms_ = 0, ttl_ms_ = 0, joined_age_ms_ = 0;
   bool participating_ = false;
+  std::string status_json_;
   QuorumMember member_;
   bool has_member_ = false;
 };

@@ -228,6 +228,7 @@ bool apply_lease_batch(LighthouseState& state, const std::vector<LeaseEntry>& en
     } else {
       state.lease_ttls.erase(e.replica_id); // default back to heartbeat timeout
     }
+    if (!e.status_json.empty()) state.member_status[e.replica_id] = e.status_json;
     if (e.participating) {
       auto it = state.participants.find(e.replica_id);
       if (it != state.participants.end()) {
@@ -246,6 +247,7 @@ void apply_depart(LighthouseState& state, const std::string& replica_id) {
   state.heartbeats.erase(replica_id);
   state.lease_ttls.erase(replica_id);
   state.participants.erase(replica_id);
+  state.member_status.erase(replica_id);
 }
 
 std::vector<DigestEntry> make_digest(const LighthouseState& state, int64_t now,
@@ -263,6 +265,8 @@ std::vector<DigestEntry> make_digest(const LighthouseState& state, int64_t now,
       e.joined_age_ms = now - it->second.joined_ms;
       e.member = it->second.member;
     }
+    auto st = state.member_status.find(replica_id);
+    if (st != state.member_status.end()) e.status_json = st->second;
     out.push_back(std::move(e));
   }
   return out;
@@ -282,6 +286,7 @@ void apply_digest(LighthouseState& state, const std::vector<DigestEntry>& entrie
     if (hb != state.heartbeats.end() && hb->second > reconstructed) continue;
     state.heartbeats[e.replica_id] = reconstructed;
     state.lease_ttls[e.replica_id] = e.ttl_ms;
+    if (!e.status_json.empty()) state.member_status[e.replica_id] = e.status_json;
     if (e.participating) {
       // The region's joined_ms is authoritative (it preserved the first
       // join), so overwrite rather than keep a stale direct registration.
@@ -296,6 +301,7 @@ void prune_expired(LighthouseState& state, int64_t now, const LighthouseOpt& opt
     int64_t ttl = lease_ttl_for(state, it->first, opt);
     if (now - it->second >= 10 * ttl && !state.participants.count(it->first)) {
       state.lease_ttls.erase(it->first);
+      state.member_status.erase(it->first);
       it = state.heartbeats.erase(it);
     } else {
       ++it;
@@ -473,6 +479,7 @@ std::vector<LeaseEntry> lease_entries_from_json(const Json& j) {
     e.replica_id = ej.get_string("replica_id", "");
     e.ttl_ms = ej.get_int("ttl_ms", 0);
     e.participating = ej.get_bool("participating", false);
+    e.status_json = ej.get_string("status_json", "");
     const Json& m = ej.at("member");
     if (!m.is_null()) e.member = member_from_json(m);
     out.push_back(std::move(e));
@@ -490,6 +497,7 @@ Json digest_to_json(const std::vector<DigestEntry>& entries) {
     o["participating"] = e.participating;
     o["joined_age_ms"] = e.joined_age_ms;
     o["member"] = member_to_json(e.member);
+    if (!e.status_json.empty()) o["status_json"] = e.status_json;
     arr.push_back(Json(std::move(o)));
   }
   return Json(std::move(arr));
@@ -505,6 +513,7 @@ std::vector<LeaseEntry> lease_entries_from_pb(const torchft_tpu::LeaseRenewReque
     e.replica_id = pe.replica_id();
     e.ttl_ms = pe.ttl_ms();
     e.participating = pe.participating();
+    e.status_json = pe.status_json();
     e.member = pe.member();
     out.push_back(std::move(e));
   }
@@ -518,6 +527,7 @@ void lease_entries_to_pb(const std::vector<LeaseEntry>& entries,
     pe->set_replica_id(e.replica_id);
     pe->set_ttl_ms(e.ttl_ms);
     pe->set_participating(e.participating);
+    pe->set_status_json(e.status_json);
     if (e.participating) *pe->mutable_member() = e.member;
   }
 }
@@ -528,6 +538,7 @@ std::vector<DigestEntry> digest_from_pb(const torchft_tpu::RegionDigestRequest& 
   for (const auto& pe : req.entries()) {
     DigestEntry e;
     e.replica_id = pe.replica_id();
+    e.status_json = pe.status_json();
     e.lease_age_ms = pe.lease_age_ms();
     e.ttl_ms = pe.ttl_ms();
     e.participating = pe.participating();
@@ -547,6 +558,7 @@ void digest_to_pb(const std::vector<DigestEntry>& entries,
     pe->set_ttl_ms(e.ttl_ms);
     pe->set_participating(e.participating);
     pe->set_joined_age_ms(e.joined_age_ms);
+    pe->set_status_json(e.status_json);
     if (e.participating) *pe->mutable_member() = e.member;
   }
 }
@@ -560,6 +572,7 @@ std::vector<DigestEntry> digest_from_json(const Json& j) {
     e.ttl_ms = ej.get_int("ttl_ms", 0);
     e.participating = ej.get_bool("participating", false);
     e.joined_age_ms = ej.get_int("joined_age_ms", 0);
+    e.status_json = ej.get_string("status_json", "");
     const Json& m = ej.at("member");
     if (!m.is_null()) e.member = member_from_json(m);
     out.push_back(std::move(e));

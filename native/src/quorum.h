@@ -41,6 +41,9 @@ struct LeaseEntry {
   int64_t ttl_ms = 0; // <= 0: the lighthouse's heartbeat_timeout_ms
   bool participating = false;
   torchft_tpu::QuorumMember member; // meaningful when participating
+  // Optional member-health digest (JSON) surfaced in /status.json; empty
+  // = none (keeps pre-status renewers wire-compatible).
+  std::string status_json;
 };
 
 // One member's standing inside a region digest (wire: DigestEntry). Ages are
@@ -54,6 +57,9 @@ struct DigestEntry {
   bool participating = false;
   int64_t joined_age_ms = 0; // region_now - joined_ms (participants only)
   torchft_tpu::QuorumMember member;
+  // Member-health digest forwarded region->root so the root's
+  // /status.json stays the fleet's single pane of glass. Empty = none.
+  std::string status_json;
 };
 
 // Outcome of one quorum tick over mutable state (see quorum_step).
@@ -74,6 +80,11 @@ struct LighthouseState {
   // fall back to opt.heartbeat_timeout_ms, so a state that never sees a
   // lease renewal behaves exactly like the pre-lease lighthouse.
   std::map<std::string, int64_t> lease_ttls; // replica_id -> ttl_ms
+  // Last member-health digest (raw JSON) carried by a lease renewal;
+  // pruned with the member's heartbeat. Display-only: never read by
+  // quorum logic, so it cannot perturb the flat-vs-hierarchical
+  // bit-identity contract.
+  std::map<std::string, std::string> member_status; // replica_id -> JSON
   // Dashboard telemetry (reference templates/status.html shows live
   // per-member recovery state; here membership/heal transitions are also
   // kept as a short event log).

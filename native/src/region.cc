@@ -179,6 +179,7 @@ void RegionLighthouse::poll_loop() {
       MutexLock lock(mu_);
       root_gen_ = resp.gen();
       latest_quorum_ = resp.quorum();
+      quorum_refresh_ms_ = now_ms();
       // The root consumed every registered participant when it formed this
       // quorum; mirror that clear so waiters not in the quorum re-register
       // — exactly the flat flow. EXCEPT registrations newer than the last
@@ -383,6 +384,11 @@ std::string RegionLighthouse::status_json() {
     o["root_connected"] = root_connected_;
     o["quorum_id"] = latest_quorum_.quorum_id();
     o["quorum_gen"] = quorum_gen_;
+    if (quorum_refresh_ms_ >= 0) {
+      o["quorum_age_ms"] = now - quorum_refresh_ms_;
+    } else {
+      o["quorum_age_ms"] = Json();
+    }
     if (latest_quorum_.participants_size() > 0) {
       o["quorum"] = quorum_to_json(latest_quorum_);
     } else {
@@ -396,6 +402,14 @@ std::string RegionLighthouse::status_json() {
       m["ttl_ms"] = ttl;
       m["lease_remaining_ms"] = last + ttl - now;
       m["participating"] = state_.participants.count(replica_id) > 0;
+      auto st = state_.member_status.find(replica_id);
+      if (st != state_.member_status.end()) {
+        try {
+          m["status"] = Json::parse(st->second);
+        } catch (const std::exception&) {
+          m["status"] = st->second; // unparseable digest: surface raw
+        }
+      }
       members.push_back(Json(std::move(m)));
     }
     o["members"] = Json(std::move(members));
@@ -413,6 +427,27 @@ std::string RegionLighthouse::status_json() {
   return j.dump();
 }
 
+std::string RegionLighthouse::quorum_json() {
+  JsonObject o;
+  {
+    MutexLock lock(mu_);
+    o["cached"] = true;
+    o["quorum_id"] = latest_quorum_.quorum_id();
+    o["root_connected"] = root_connected_;
+    if (quorum_refresh_ms_ >= 0) {
+      o["age_ms"] = now_ms() - quorum_refresh_ms_;
+      o["quorum"] = latest_quorum_.participants_size() > 0
+                        ? quorum_to_json(latest_quorum_)
+                        : Json();
+    } else {
+      o["age_ms"] = Json(); // no root quorum ever seen
+      o["quorum"] = Json();
+    }
+  }
+  o["region_id"] = region_id_;
+  return Json(std::move(o)).dump();
+}
+
 void RegionLighthouse::handle_http(Socket& sock, const std::string& head) {
   std::istringstream is(head);
   std::string method, path;
@@ -420,6 +455,9 @@ void RegionLighthouse::handle_http(Socket& sock, const std::string& head) {
 
   if (method == "GET" && path == "/status.json") {
     http_respond(sock, 200, "application/json", status_json());
+  } else if (method == "GET" && path == "/quorum.json") {
+    // Served from the region-side cache: no root traffic per request.
+    http_respond(sock, 200, "application/json", quorum_json());
   } else if (method == "GET" && (path == "/" || path.empty())) {
     http_respond(sock, 200, "text/html",
                  "<html><body><h1>torchft_tpu region lighthouse " +

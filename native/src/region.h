@@ -58,6 +58,17 @@ class RegionLighthouse {
   // Machine-readable status (the /status.json payload).
   std::string status_json();
 
+  // The region-side quorum CACHE (the /quorum.json payload): the last
+  // global quorum the poll loop pulled from the root, served locally with
+  // its refresh age. Read-mostly consumers (dashboards, fleet tooling,
+  // the policy engine's observers) hit this instead of long-polling the
+  // root per request — the root sees one standing poll per region
+  // regardless of reader count. `age_ms` bounds the staleness: while the
+  // root is reachable it stays within one poll round-trip of the root's
+  // quorum age; with the root down the cache keeps serving (age growing)
+  // and `root_connected` goes false.
+  std::string quorum_json();
+
  private:
   void accept_loop();
   void digest_loop();
@@ -92,6 +103,9 @@ class RegionLighthouse {
   int64_t quorum_gen_ TFT_GUARDED_BY(mu_) = 0;
   int64_t root_gen_ TFT_GUARDED_BY(mu_) = 0;
   torchft_tpu::Quorum latest_quorum_ TFT_GUARDED_BY(mu_);
+  // now_ms() at which latest_quorum_ was last refreshed off the root; -1
+  // until the first poll lands. The staleness stamp of the quorum cache.
+  int64_t quorum_refresh_ms_ TFT_GUARDED_BY(mu_) = -1;
   bool root_connected_ TFT_GUARDED_BY(mu_) = false;
   int64_t digests_sent_ TFT_GUARDED_BY(mu_) = 0;
   int64_t last_digest_ms_ TFT_GUARDED_BY(mu_) = -1;

@@ -399,3 +399,92 @@ class TestReprobeOnQuorumChange:
             ddp.step(x)
         assert ddp.mode is not None
         ddp.flush()
+
+
+class TestProbeRefresh:
+    """TORCHFT_DDP_REPROBE_STEPS: a locked schedule revalidates on a fixed
+    attempted-step cadence, not only on membership changes — closing the
+    stale-lock gap where a cohort's bandwidth moves but its quorum doesn't."""
+
+    def test_locked_mode_reprobes_on_cadence(self):
+        import jax.numpy as jnp
+
+        from torchft_tpu.collectives import _completed
+
+        class ScriptedManager:
+            def __init__(self):
+                self.qid = 1
+                self.committed = 0
+                self._m = _FakeManager([[0.0, 0.0, 0.0]])
+
+            def start_quorum(self, **kw):
+                pass
+
+            def quorum_id(self):
+                return self.qid
+
+            def current_step(self):
+                return self.committed
+
+            def errored(self):
+                return None
+
+            def plan_allreduce(self, tree, op=None, wire=None,
+                               device_pack=None):
+                return _completed(tree)
+
+            def allreduce(self, tree, op=None, wire=None):
+                return _completed(tree)
+
+            def allgather(self, tree):
+                return _completed([tree])
+
+            def should_commit(self, **kw):
+                self.committed += 1
+                return True
+
+            def is_healing(self):
+                return False
+
+            def metrics(self):
+                return self._m.metrics()
+
+            def reset_plan_feedback(self):
+                pass
+
+        mgr = ScriptedManager()
+        state = _state()
+        ddp = AdaptiveDDP(
+            mgr, state, _grad_fn, probe_steps=2, device_pack="off",
+            reprobe_steps=4,
+        )
+        x = jnp.ones((4, 8), jnp.float32)
+        # anchor + 3 candidates x 2 steps -> locks
+        for _ in range(7):
+            ddp.step(x)
+        assert ddp.mode is not None
+        first_decision_metrics = dict(ddp._manager._m._metrics_records)
+        # 3 locked steps: still locked (cadence is 4)
+        for _ in range(3):
+            ddp.step(x)
+        assert ddp.mode is not None
+        # 4th locked step trips the refresh: probing again, same quorum
+        ddp.step(x)
+        assert ddp.mode is None
+        assert ddp._manager._m._metrics_records.get("ddp_reprobe") == 1
+        # and the refreshed probe terminates in a new lock
+        for _ in range(6):
+            ddp.step(x)
+        assert ddp.mode is not None
+        ddp.flush()
+        assert first_decision_metrics  # decision metrics were recorded
+
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_DDP_REPROBE_STEPS", raising=False)
+        ddp = AdaptiveDDP(_ManagerStub(), _state(), _grad_fn, mode="blocking")
+        assert ddp._reprobe_steps == 0
+
+    def test_env_knob_sets_cadence(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_DDP_REPROBE_STEPS", "128")
+        ddp = AdaptiveDDP(_ManagerStub(), _state(), _grad_fn, mode="blocking")
+        assert ddp._reprobe_steps == 128

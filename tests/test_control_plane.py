@@ -343,3 +343,43 @@ time.sleep(60)
             if child.poll() is None:
                 child.kill()
         store.shutdown()
+
+
+class TestMemberStatusExport:
+    """Member-health digests ride lease renewals into the lighthouse's
+    /status.json per-member view (the fleet-visible half of the policy
+    engine's signal surface)."""
+
+    def test_status_rides_renewals_into_status_json(self, lighthouse):
+        store = Store()
+        m = Manager(
+            "statusrep",
+            lighthouse.address(),
+            "localhost",
+            "[::]:0",
+            store.address(),
+            1,
+            heartbeat_interval=timedelta(milliseconds=50),
+        )
+        try:
+            m.set_status(
+                {"churn_per_min": 1.5, "wire_eff_MBps": 42.0, "step": 7}
+            )
+            deadline = time.monotonic() + 10
+            entry = None
+            while time.monotonic() < deadline:
+                members = lighthouse.status_json()["members"]
+                entry = next(
+                    (e for e in members if e["replica_id"] == "statusrep"),
+                    None,
+                )
+                if entry is not None and "status" in entry:
+                    break
+                time.sleep(0.05)
+            assert entry is not None and "status" in entry, entry
+            # the digest arrives PARSED (an object, not a string blob)
+            assert entry["status"]["wire_eff_MBps"] == 42.0
+            assert entry["status"]["step"] == 7
+        finally:
+            m.shutdown()
+            store.shutdown()

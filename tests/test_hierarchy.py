@@ -37,7 +37,7 @@ from torchft_tpu._native import (
     lease_apply,
     quorum_step,
 )
-from torchft_tpu.lighthouse import fetch_status
+from torchft_tpu.lighthouse import fetch_quorum, fetch_status
 
 TIMEOUT = timedelta(seconds=20)
 
@@ -458,6 +458,112 @@ class TestLiveHierarchy:
                 time.sleep(0.05)
             assert rst["regions"][0]["region_id"] == "ra"
             assert rst["role"] == "root"
+        finally:
+            ra.shutdown()
+            root.shutdown()
+
+
+class TestRegionQuorumCache:
+    """The region-side quorum cache (ROADMAP item 2 carry-over): read-mostly
+    consumers get the last GLOBAL quorum from the region's standing root
+    poll instead of long-polling the root per request — and the staleness
+    of that cache is bounded and visible (`age_ms`)."""
+
+    def test_cache_serves_locally_with_bounded_staleness(self):
+        root = Lighthouse(min_replicas=1, join_timeout_ms=100)
+        ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
+        try:
+            c = _native.LeaseClient(ra.address())
+            # Before any root quorum: the cache is explicit about having
+            # nothing (age null), not fake-fresh.
+            q = ra.quorum_json()
+            assert q["cached"] is True
+            assert q["age_ms"] is None and q["quorum"] is None
+
+            c.renew([entry("g0", ttl_ms=60000)])
+            deadline = time.monotonic() + 10
+            while True:
+                q = ra.quorum_json()
+                if q["quorum_id"] >= 1 and q["quorum"] is not None:
+                    break
+                assert time.monotonic() < deadline, q
+                time.sleep(0.05)
+            ids = [m["replica_id"] for m in q["quorum"]["participants"]]
+            assert ids == ["g0"]
+
+            # Staleness bound: a freshly-caught quorum's cache age is within
+            # one poll round trip (the push path is the standing long-poll,
+            # not this read), far under the 10 s poll window.
+            assert q["age_ms"] is not None and q["age_ms"] < 3000
+
+            # A NEW root quorum (g0+g1) must land in the cache within the
+            # same bound — the cache tracks the root, it doesn't snapshot
+            # once.
+            deadline = time.monotonic() + 15
+            while True:
+                c.renew([entry("g0", ttl_ms=60000), entry("g1", ttl_ms=60000)])
+                q = fetch_quorum(ra.address())  # the HTTP read-mostly path
+                got = q["quorum"] or {}
+                ids = [m["replica_id"] for m in got.get("participants", [])]
+                if "g1" in ids:
+                    break
+                assert time.monotonic() < deadline, q
+                time.sleep(0.1)
+            assert q["cached"] is True
+            assert q["age_ms"] < 3000
+            assert q["region_id"] == "ra"
+            qid_before_outage = q["quorum_id"]
+
+            # Root down: the cache KEEPS serving the last global quorum
+            # locally (that is what makes it a cache, not a proxy), with a
+            # growing age — readers can bound their own staleness.
+            root.shutdown()
+            time.sleep(0.3)
+            q1 = fetch_quorum(ra.address())
+            assert q1["quorum_id"] == qid_before_outage
+            time.sleep(0.3)
+            q2 = fetch_quorum(ra.address())
+            assert q2["quorum_id"] == qid_before_outage
+            assert q2["age_ms"] > q1["age_ms"]
+            # status.json mirrors the cache age for dashboards
+            st = ra.status_json()
+            assert st["quorum_age_ms"] is not None
+        finally:
+            ra.shutdown()
+            root.shutdown()
+
+
+class TestStatusDigestForwarding:
+    """Member-health status digests ride lease renewals into the REGION and
+    are forwarded region->root inside membership digests — the root's
+    /status.json stays the fleet's single pane of glass under the
+    hierarchical tier."""
+
+    def test_status_reaches_root_through_digest(self):
+        root = Lighthouse(min_replicas=1, join_timeout_ms=100)
+        ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
+        try:
+            e = entry("gst", ttl_ms=60000, participating=False)
+            e["status_json"] = '{"wire_eff_MBps": 7.5, "step": 3}'
+            _native.LeaseClient(ra.address()).renew([e])
+            deadline = time.monotonic() + 10
+            got = None
+            while time.monotonic() < deadline:
+                members = root.status_json()["members"]
+                got = next(
+                    (m for m in members if m["replica_id"] == "gst"), None
+                )
+                if got is not None and "status" in got:
+                    break
+                time.sleep(0.05)
+            assert got is not None and "status" in got, got
+            assert got["status"]["wire_eff_MBps"] == 7.5
+            # and the region's own view carries it too
+            rm = next(
+                m for m in ra.status_json()["members"]
+                if m["replica_id"] == "gst"
+            )
+            assert rm["status"]["step"] == 3
         finally:
             ra.shutdown()
             root.shutdown()

@@ -688,3 +688,87 @@ def test_reduce_scatter_bad_op_raises_eagerly(store):
         m.reduce_scatter({"g": np.ones(2, np.float32)}, op=ReduceOp.MAX)
     assert m.errored() is None
     m.shutdown()
+
+
+class TestPolicySignals:
+    """The observability surface the policy engine consumes: rolling churn
+    rate, measured wire bandwidth, heal-cost breakdown."""
+
+    def test_churn_marks_on_quorum_change_but_not_cold_start(self, store):
+        m, client, _, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result(quorum_id=7)
+        m.start_quorum()
+        m.wait_quorum()
+        # the FIRST configure is a cold start, not churn
+        assert "churn" not in m.metrics().snapshot()["events"]
+        assert m.signals()["churn_per_min"] == 0.0
+
+        client.quorum.return_value = _quorum_result(quorum_id=8)
+        m.start_quorum()
+        m.wait_quorum()
+        snap = m.metrics().snapshot()["events"]["churn"]
+        assert snap["n"] == 1
+        assert m.signals()["churn_per_min"] > 0.0
+        m.shutdown()
+
+    def test_observe_op_stats_measures_effective_bandwidth(self, store):
+        class StatCollectives(DummyCollectives):
+            def pop_op_stats(self):
+                return [
+                    {
+                        "op": "allreduce",
+                        "bytes": 8 << 20,
+                        "wire_bytes": 4 << 20,
+                        "ring": 2.0,
+                        "stripe_s": [2.0, 2.0],
+                    },
+                    {"op": "barrier"},  # no payload: skipped
+                ]
+
+        m, client, _, _ = _create_manager(
+            store, collectives=StatCollectives()
+        )
+        drained = m.observe_op_stats()
+        assert len(drained) == 2  # pop semantics preserved for callers
+        sig = m.signals()
+        # 4 MiB over 2 s = 2 MB/s effective, 1 MB/s per connection
+        assert abs(sig["wire_eff_MBps"] - 2.0) < 1e-6
+        timers = m.metrics().snapshot()["timers_s"]
+        assert abs(timers["wire_conn_MBps"]["p50"] - 1.0) < 1e-6
+        m.shutdown()
+
+    def test_signals_heal_none_until_healed(self, store):
+        transport = MagicMock()
+        transport.metadata.return_value = "transport:meta"
+        transport.last_fetch_stats = None
+        m, _, _, _ = _create_manager(store, transport=transport)
+        assert m.signals()["heal"] is None
+        transport.last_fetch_stats = {
+            "path": "stream", "bytes": 123, "fetch_s": 0.5, "h2d_s": 0.1,
+        }
+        heal = m.signals()["heal"]
+        assert heal["last_fetch"]["path"] == "stream"
+        m.shutdown()
+
+    def test_control_transaction_skips_batch_accounting(self, store):
+        # A policy-engine decision is a committed transaction (the step
+        # clock must advance) but trains no batch: batches_committed must
+        # not inflate.
+        m, client, _, _ = _create_manager(store)
+        client.quorum.return_value = _quorum_result()
+        client.should_commit.return_value = True
+        m.start_quorum()
+        assert m.should_commit(count_batches=False)
+        assert m.current_step() == 1
+        assert m.batches_committed() == 0
+        m.start_quorum()
+        assert m.should_commit()
+        assert m.current_step() == 2
+        assert m.batches_committed() == 2  # 2 participants, 1 real step
+        m.shutdown()
+
+    def test_push_status_is_noop_without_native_manager(self, store):
+        # rank != 0 hosts no native manager server; the push must be safe
+        m, _, _, _ = _create_manager(store)
+        m.push_status({"policy": "ddp"})  # must not raise
+        m.shutdown()

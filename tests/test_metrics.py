@@ -1,0 +1,133 @@
+"""Direct suite for torchft_tpu.metrics: the windowed rollups the policy
+engine consumes (_Timer reservoirs, _TimedBlock, event-rate windows) had
+no coverage of their own — they were only exercised incidentally through
+Manager integration paths.
+"""
+
+import threading
+import time
+
+from torchft_tpu.metrics import Metrics, _EventWindow, _TimedBlock, _Timer
+
+
+class TestTimer:
+    def test_empty_snapshot(self):
+        assert _Timer().snapshot() == {"n": 0}
+
+    def test_percentiles_and_totals(self):
+        t = _Timer()
+        for v in [0.1, 0.2, 0.3, 0.4, 0.5]:
+            t.record(v)
+        snap = t.snapshot()
+        assert snap["n"] == 5
+        assert abs(snap["total_s"] - 1.5) < 1e-9
+        assert snap["p50"] == 0.3
+        assert snap["max"] == 0.5
+        # p90 of 5 samples indexes int(0.9*5)=4 -> the largest
+        assert snap["p90"] == 0.5
+
+    def test_reservoir_is_bounded_but_totals_are_not(self):
+        t = _Timer(maxlen=8)
+        for i in range(100):
+            t.record(float(i))
+        snap = t.snapshot()
+        # count/total keep the full history; percentiles see the window
+        assert snap["n"] == 100
+        assert abs(snap["total_s"] - sum(range(100))) < 1e-6
+        assert snap["p50"] >= 92.0  # only the last 8 samples remain
+        assert snap["max"] == 99.0
+
+    def test_single_sample_percentiles_clamp(self):
+        t = _Timer()
+        t.record(0.25)
+        snap = t.snapshot()
+        assert snap["p50"] == 0.25
+        assert snap["p90"] == 0.25
+        assert snap["max"] == 0.25
+
+
+class TestTimedBlock:
+    def test_records_elapsed_wall(self):
+        m = Metrics()
+        with m.timed("op"):
+            time.sleep(0.01)
+        snap = m.snapshot()["timers_s"]["op"]
+        assert snap["n"] == 1
+        assert snap["max"] >= 0.009
+
+    def test_records_even_when_body_raises(self):
+        m = Metrics()
+        try:
+            with m.timed("op"):
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert m.snapshot()["timers_s"]["op"]["n"] == 1
+
+    def test_returns_self_as_context(self):
+        block = Metrics().timed("x")
+        assert isinstance(block, _TimedBlock)
+        with block as entered:
+            assert entered is block
+
+
+class TestEventWindow:
+    def test_unmarked_rate_is_zero(self):
+        m = Metrics()
+        assert m.rate_per_min("never") == 0.0
+
+    def test_rate_uses_observed_window_when_young(self):
+        # A process 0.1 s old that saw 2 events is running at ~1200/min,
+        # not 2/600s=0.2/min: the divisor is observed time, not the
+        # nominal window.
+        w = _EventWindow()
+        w.mark()
+        w.mark()
+        time.sleep(0.05)
+        rate = w.rate_per_min(window_s=600.0)
+        assert rate > 100.0
+
+    def test_old_events_age_out_of_the_window(self):
+        w = _EventWindow()
+        w.mark()
+        time.sleep(0.12)
+        # a 0.05 s trailing window no longer contains the event
+        assert w.rate_per_min(window_s=0.05) == 0.0
+
+    def test_rollover_shrinks_observed_window(self):
+        # When the reservoir rolled over, time before the oldest retained
+        # stamp is unaccountable and must not dilute the rate.
+        w = _EventWindow(maxlen=4)
+        for _ in range(10):
+            w.mark()
+        assert w.count == 10
+        rate = w.rate_per_min(window_s=600.0)
+        assert rate > 0.0
+
+    def test_snapshot_shape(self):
+        m = Metrics()
+        m.mark("churn")
+        snap = m.snapshot()["events"]["churn"]
+        assert snap["n"] == 1
+        assert snap["rate_per_min"] > 0.0
+
+
+class TestMetricsThreading:
+    def test_concurrent_mixed_writes(self):
+        m = Metrics()
+
+        def writer():
+            for _ in range(200):
+                m.incr("c")
+                m.record("t", 0.001)
+                m.mark("e")
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = m.snapshot()
+        assert snap["counters"]["c"] == 800
+        assert snap["timers_s"]["t"]["n"] == 800
+        assert snap["events"]["e"]["n"] == 800

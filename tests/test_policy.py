@@ -1,0 +1,576 @@
+"""Policy-engine tests: the cost model's crossovers, the decision rules
+(sentinels, hysteresis, ties-to-current), and the voted transition's
+split-brain-free guarantee across >= 2 real managers.
+"""
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu import (
+    FTTrainState,
+    HostCollectives,
+    Lighthouse,
+    Manager,
+    PolicyEngine,
+    Store,
+    StrategySpec,
+)
+from torchft_tpu.policy import (
+    SENTINEL_COST_S,
+    CostKnobs,
+    default_candidates,
+    strategy_cost,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _grad_fn(params, x):
+    def loss(p):
+        return jnp.mean((x @ p["w"]) ** 2)
+
+    value, grads = jax.value_and_grad(loss)(params)
+    return value, grads
+
+
+def _state():
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    return FTTrainState(params, optax.sgd(0.1))
+
+
+_BASE_SIG = dict(
+    compute_s=0.01,
+    wire_eff_MBps=4000.0,
+    churn_per_min=0.0,
+    ctrl_s=0.001,
+    reconf_s=0.1,
+    heal_s=3.0,
+    world=2.0,
+    model_bytes=4e6,
+)
+
+
+def _best(sig, knobs=None):
+    knobs = knobs or CostKnobs()
+    costs = {c.name: strategy_cost(c, sig, knobs) for c in default_candidates()}
+    return min(costs, key=costs.get), costs
+
+
+class TestStrategySpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            StrategySpec("x", "warp")
+        with pytest.raises(ValueError, match="per-step"):
+            StrategySpec("x", "ddp", sync_every=4)
+        with pytest.raises(ValueError, match="sync_every"):
+            StrategySpec("x", "localsgd", sync_every=1)
+        with pytest.raises(ValueError, match="wire"):
+            StrategySpec("x", "diloco", sync_every=8, wire="fp4")
+        with pytest.raises(ValueError, match="transport"):
+            StrategySpec("x", "ddp", transport="warp")
+
+    def test_wire_factor(self):
+        assert StrategySpec("a", "ddp").wire_factor() == 1.0
+        assert StrategySpec("b", "ddp", wire="bf16").wire_factor() == 0.5
+        assert (
+            StrategySpec("c", "diloco", sync_every=8, wire="q8").wire_factor()
+            == 0.25
+        )
+
+
+class TestCostModel:
+    """The crossovers the ISSUE names, pinned as orderings (not absolute
+    numbers): per-step DDP on quiet fat links, DiLoCo(q8) when measured
+    bandwidth drops below the computed crossover, longer outer windows as
+    churn rises."""
+
+    def test_quiet_fat_link_picks_per_step_ddp(self):
+        best, costs = _best(dict(_BASE_SIG))
+        assert best == "ddp", costs
+
+    def test_degraded_bandwidth_picks_diloco_q8(self):
+        best, costs = _best(dict(_BASE_SIG, wire_eff_MBps=2.0))
+        assert best.startswith("diloco_q8"), costs
+        # and the q8 wire is doing real work: same strategy priced at the
+        # f32 wire costs strictly more
+        q8 = StrategySpec("q8", "diloco", sync_every=16, wire="q8")
+        f32 = StrategySpec("f32", "diloco", sync_every=16)
+        sig = dict(_BASE_SIG, wire_eff_MBps=2.0)
+        assert strategy_cost(q8, sig, CostKnobs()) < strategy_cost(
+            f32, sig, CostKnobs()
+        )
+
+    def test_rising_churn_prefers_longer_windows(self):
+        # Among windowed candidates whose windows are LONG in wall time
+        # (the production regime: seconds-scale steps, seconds-scale
+        # heals), heavy churn tips the balance toward the LONGER window:
+        # it hides more heal latency behind local compute and keeps most
+        # faults outside the transaction+surfacing horizon, so fewer
+        # windows discard (the Chameleon observation).
+        h16 = StrategySpec("h16", "diloco", sync_every=16, wire="q8")
+        h64 = StrategySpec("h64", "diloco", sync_every=64, wire="q8")
+        sig_quiet = dict(
+            _BASE_SIG, compute_s=0.05, heal_s=10.0, wire_eff_MBps=20.0
+        )
+        sig_churny = dict(sig_quiet, churn_per_min=2.0)
+        k = CostKnobs()
+        # the churn-induced relative penalty (cost under churn / cost
+        # quiet) must be SMALLER for the longer window: it pays less per
+        # fault, so rising churn shifts the balance toward it
+        penalty16 = strategy_cost(h16, sig_churny, k) / strategy_cost(
+            h16, sig_quiet, k
+        )
+        penalty64 = strategy_cost(h64, sig_churny, k) / strategy_cost(
+            h64, sig_quiet, k
+        )
+        assert penalty64 < penalty16
+
+    def test_fast_faults_prefer_tight_sync(self):
+        # The flip side: when windows are SHORT in wall time (bench-scale
+        # steps) every fault surfaces inside the next transaction and
+        # discards the whole window — rapid faulting then favors the
+        # per-step strategy, which only ever loses one step per fault.
+        sig = dict(
+            compute_s=0.03, wire_eff_MBps=500.0, churn_per_min=100.0,
+            ctrl_s=0.003, reconf_s=0.05, heal_s=0.0, world=2.0,
+            model_bytes=4 << 20,
+        )
+        k = CostKnobs(staleness_weight=0.0)
+        ddp = StrategySpec("ddp", "ddp")
+        h16 = StrategySpec("h16", "diloco", sync_every=16, wire="q8")
+        assert strategy_cost(ddp, sig, k) < strategy_cost(h16, sig, k)
+        # quiet, the same link orders the other way (amortized sync wins)
+        assert strategy_cost(
+            ddp, dict(sig, churn_per_min=0.0), k
+        ) > strategy_cost(h16, dict(sig, churn_per_min=0.0), k)
+
+    def test_unmeasured_bandwidth_does_not_price_the_wire(self):
+        # Before the first sync there is no bandwidth sample: the model
+        # must not invent one (it prices only fixed+control costs).
+        sig = dict(_BASE_SIG, wire_eff_MBps=0.0)
+        ddp = strategy_cost(StrategySpec("d", "ddp"), sig, CostKnobs())
+        assert ddp < 0.1  # no 4 MB / 0 blowup
+
+    def test_cost_is_deterministic(self):
+        sig = dict(_BASE_SIG, churn_per_min=3.7, wire_eff_MBps=17.3)
+        k = CostKnobs()
+        spec = StrategySpec("h", "diloco", sync_every=16, wire="q8")
+        assert strategy_cost(spec, sig, k) == strategy_cost(spec, sig, k)
+
+
+class TestDecisionRules:
+    def _engine(self, candidates=None, **kw):
+        # Construction-only engine against a stub manager: the decision
+        # rules are pure given costs.
+        class _Stub:
+            _use_async_quorum = False
+
+            def has_iso_plane(self):
+                return False
+
+        eng = PolicyEngine.__new__(PolicyEngine)
+        eng._manager = _Stub()
+        eng._state = _state()
+        eng._outer_tx = optax.sgd(0.7)
+        eng._candidates = list(
+            candidates
+            or [
+                StrategySpec("ddp", "ddp"),
+                StrategySpec("diloco_q8_h16", "diloco", sync_every=16,
+                             wire="q8"),
+            ]
+        )
+        eng._avail = [True] * len(eng._candidates)
+        eng._failed = [False] * len(eng._candidates)
+        eng._current = 0
+        eng._knobs = CostKnobs(**kw)
+        eng._model_bytes = 4 << 20
+        return eng
+
+    def test_hysteresis_stands_still_on_near_ties(self):
+        eng = self._engine(hysteresis=0.1)
+        assert eng._choose([1.00, 0.95]) == 0  # within 10%: stay
+        assert eng._choose([1.00, 0.85]) == 1  # clear win: move
+
+    def test_exact_tie_falls_to_current(self):
+        eng = self._engine(hysteresis=0.0)
+        eng._current = 1
+        assert eng._choose([1.0, 1.0]) == 1
+
+    def test_sentineled_incumbent_must_move(self):
+        eng = self._engine()
+        assert eng._choose([SENTINEL_COST_S, 0.5]) == 1
+
+    def test_all_sentineled_stands_still(self):
+        eng = self._engine()
+        assert eng._choose([SENTINEL_COST_S, SENTINEL_COST_S]) == 0
+
+    def test_failed_candidate_carries_sentinel(self):
+        eng = self._engine()
+        eng._failed[1] = True
+        agg = {
+            **_BASE_SIG,
+            "avail": np.ones(2),
+            "failed": np.array([0.0, 1.0]),
+        }
+        costs = eng._costs(agg)
+        assert costs[1] == SENTINEL_COST_S
+        assert costs[0] < SENTINEL_COST_S
+
+    def test_aggregate_excludes_zeroed_entries_and_takes_bottleneck(self):
+        eng = self._engine()
+        k = len(eng._candidates)
+
+        def vec(ok, compute, bw, churn):
+            return np.asarray(
+                [ok, compute, bw, churn, 0.001, 0.1, 0.0]
+                + [1.0] * k + [0.0] * k,
+                np.float64,
+            )
+
+        agg = eng._aggregate(
+            [
+                vec(1.0, 0.01, 100.0, 0.0),
+                vec(1.0, 0.02, 10.0, 2.0),
+                vec(0.0, 0.0, 0.0, 0.0),  # healing/spare: zeroed, excluded
+            ]
+        )
+        assert agg["compute_s"] == 0.02  # slowest paces the cohort
+        assert agg["wire_eff_MBps"] == 10.0  # bottleneck link
+        assert agg["churn_per_min"] == 2.0  # worst churn
+        assert agg["world"] == 2.0
+
+    def test_backstop_sentinels_incumbent_and_falls_to_base(self):
+        class _M:
+            def incr(self, *a, **k):
+                pass
+
+        eng = self._engine()
+        eng._manager.metrics = lambda: _M()
+        eng._engines = {}
+        eng._grad_fn = _grad_fn
+        eng._consec_errors = 0
+        eng._error_backstop = 8
+        eng._current = 1  # the windowed candidate is the incumbent
+        # 7 consecutive errored TRANSACTIONS: not yet (inner steps never
+        # call _note_errored at all, so the run can only be broken by a
+        # committed window in between)
+        for _ in range(7):
+            assert not eng._note_errored(True)
+        # the 8th trips: incumbent sentineled, base adopted immediately
+        assert eng._note_errored(True)
+        assert eng._failed[1] is True
+        assert eng._current == 0
+        # a committed transaction resets the run
+        eng._consec_errors = 5
+        assert not eng._note_errored(False)
+        assert eng._consec_errors == 0
+
+    def test_aggregate_rejects_shape_mismatch(self):
+        eng = self._engine()
+        with pytest.raises(RuntimeError, match="no live"):
+            eng._aggregate([np.asarray([1.0, 2.0])])
+
+    def test_construction_gates_diloco_without_outer_tx(self):
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+            quorum_tick_ms=50, heartbeat_timeout_ms=2000,
+        )
+        store = Store()
+        manager = Manager(
+            collectives=HostCollectives(timeout=timedelta(seconds=10)),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {},
+            min_replica_size=1,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="gate_test",
+        )
+        try:
+            eng = PolicyEngine(manager, _state(), _grad_fn, outer_tx=None)
+            names = [c.name for c in eng._candidates]
+            for i, name in enumerate(names):
+                if name.startswith("diloco"):
+                    assert not eng._avail[i]
+                if name.startswith("ddp") or name.startswith("localsgd"):
+                    assert eng._avail[i]
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+
+class TestSoloEndToEnd:
+    def test_trains_and_decides_on_cadence(self):
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+            quorum_tick_ms=50, heartbeat_timeout_ms=2000,
+        )
+        store = Store()
+        state = _state()
+        policy = None
+        manager = Manager(
+            collectives=HostCollectives(timeout=timedelta(seconds=10)),
+            load_state_dict=lambda s: policy.load_state_dict(s),
+            state_dict=lambda: policy.state_dict(),
+            min_replica_size=1,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="policy_solo",
+        )
+        try:
+            policy = PolicyEngine(
+                manager, state, _grad_fn, outer_tx=optax.sgd(0.7),
+                decide_every=8,
+            )
+            x = jnp.ones((4, 8), jnp.float32)
+            start = policy.strategy.name
+            for _ in range(20):
+                loss = policy.step(x)
+            policy.flush()
+            assert np.isfinite(float(loss))
+            assert len(policy.decisions) >= 2
+            for d in policy.decisions:
+                assert d["committed"] is True
+                assert set(d["costs"]) == {
+                    c.name for c in policy._candidates
+                }
+            assert manager.metrics().snapshot()["counters"][
+                "policy_decisions"
+            ] == len(policy.decisions)
+            # solo on an unmeasured loopback: no reason to leave the
+            # starting strategy unless a decision said so — and every
+            # decision must be internally consistent
+            for d in policy.decisions:
+                if d["switched"]:
+                    assert d["to"] != d["from"]
+            assert policy.strategy.name in {start} | {
+                d["to"] for d in policy.decisions if d["switched"]
+            }
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+    def test_state_dict_roundtrip_carries_strategy_and_clocks(self):
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+            quorum_tick_ms=50, heartbeat_timeout_ms=2000,
+        )
+        store = Store()
+        state = _state()
+        manager = Manager(
+            collectives=HostCollectives(timeout=timedelta(seconds=10)),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {},
+            min_replica_size=1,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="policy_sd",
+        )
+        try:
+            cands = [
+                StrategySpec("ddp", "ddp"),
+                StrategySpec("localsgd_h4", "localsgd", sync_every=4),
+            ]
+            policy = PolicyEngine(
+                manager, state, _grad_fn, candidates=cands, decide_every=64
+            )
+            x = jnp.ones((4, 8), jnp.float32)
+            for _ in range(3):
+                policy.step(x)
+            sd = policy.state_dict()
+
+            state2 = _state()
+            policy2 = PolicyEngine(
+                manager, state2, _grad_fn, candidates=cands, decide_every=64
+            )
+            policy2.load_state_dict(sd)
+            assert policy2._ticks == policy._ticks
+            assert policy2._current == policy._current
+            np.testing.assert_array_equal(
+                np.asarray(state2.params["w"]), np.asarray(state.params["w"])
+            )
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+
+class _PolicyRunner:
+    """Two replica groups as threads against one lighthouse: the e2e
+    harness for voted transitions (the test_manager_integ pattern, with a
+    PolicyEngine loop instead of OptimizerWrapper)."""
+
+    def __init__(self, num_groups=2, decide_every=4, steps=14,
+                 fail_decide_epoch=None, candidates=None, big_model=True):
+        self.num_groups = num_groups
+        self.decide_every = decide_every
+        self.steps = steps
+        self.fail_decide_epoch = fail_decide_epoch
+        self.candidates = candidates or [
+            StrategySpec("ddp", "ddp"),
+            StrategySpec("diloco_q8_h4", "diloco", sync_every=4, wire="q8"),
+        ]
+        self.big_model = big_model
+        self.barrier = threading.Barrier(num_groups)
+
+    def _worker(self, gid, lighthouse_addr):
+        store = Store()
+        state = _state()
+        policy = None
+        manager = Manager(
+            collectives=HostCollectives(timeout=timedelta(seconds=30)),
+            load_state_dict=lambda s: policy.load_state_dict(s),
+            state_dict=lambda: policy.state_dict(),
+            min_replica_size=self.num_groups,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            timeout=timedelta(seconds=30),
+            quorum_timeout=timedelta(seconds=30),
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse_addr,
+            replica_id=f"pol_{gid}",
+        )
+        try:
+            policy = PolicyEngine(
+                manager, state, _grad_fn, outer_tx=optax.sgd(0.7),
+                candidates=self.candidates,
+                decide_every=self.decide_every,
+            )
+            # Scripted conditions, identical on every member: a degraded
+            # measured link and a model large enough that the windowed-q8
+            # candidate must win the cost model decisively.
+            if self.big_model:
+                policy._model_bytes = 64 << 20
+                manager.signals = lambda w=600.0: {
+                    "churn_per_min": 0.0,
+                    "wire_eff_MBps": 2.0,
+                    "heal": None,
+                }
+            if self.fail_decide_epoch is not None:
+                orig_allgather = manager.allgather
+                runner = self
+
+                def failing_allgather(tree):
+                    if (
+                        isinstance(tree, dict)
+                        and "policy_sig" in tree
+                        and gid == 1
+                        and policy._decide_epoch == runner.fail_decide_epoch
+                    ):
+                        # A member failure DURING the transition, of the
+                        # ring-visible class (a dying/desynced member
+                        # ships a garbage frame): the native op-mismatch
+                        # fail-fast propagates to EVERY member, everyone's
+                        # error latches, and the whole cohort must abort
+                        # the switch together.
+                        tree = {
+                            "policy_sig": np.zeros(3, np.float64)
+                        }
+                    return orig_allgather(tree)
+
+                manager.allgather = failing_allgather
+
+            x = jnp.ones((4, 8), jnp.float32)
+            self.barrier.wait(timeout=60)
+            for _ in range(self.steps):
+                policy.step(x)
+            policy.flush()
+            return {
+                "gid": gid,
+                "strategy": policy.strategy.name,
+                "decisions": policy.decisions,
+                "params": np.asarray(state.params["w"]),
+                "steps": manager.current_step(),
+            }
+        finally:
+            manager.shutdown()
+            store.shutdown()
+
+    def run(self):
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=self.num_groups, join_timeout_ms=500,
+            quorum_tick_ms=50, heartbeat_timeout_ms=5000,
+        )
+        try:
+            with ThreadPoolExecutor(max_workers=self.num_groups) as ex:
+                futs = [
+                    ex.submit(self._worker, gid, lighthouse.address())
+                    for gid in range(self.num_groups)
+                ]
+                return sorted(
+                    (f.result(timeout=180) for f in futs),
+                    key=lambda r: r["gid"],
+                )
+        finally:
+            lighthouse.shutdown()
+
+
+class TestVotedTransition:
+    """Acceptance: a strategy switch across >= 2 managers is all-or-nothing
+    — committed everywhere, or aborted everywhere by any member's failure."""
+
+    def test_cohort_switches_together(self):
+        results = _PolicyRunner(steps=14, decide_every=4).run()
+        a, b = results
+        # Both members made the same decisions in the same order...
+        assert len(a["decisions"]) >= 1
+        assert [
+            (d["from"], d["to"], d["committed"]) for d in a["decisions"]
+        ] == [(d["from"], d["to"], d["committed"]) for d in b["decisions"]]
+        # ...the scripted degraded link forced the q8 window strategy...
+        assert a["strategy"] == b["strategy"] == "diloco_q8_h4"
+        assert any(d["switched"] for d in a["decisions"])
+        switch = next(d for d in a["decisions"] if d["switched"])
+        assert switch["signals"]["wire_eff_MBps"] == 2.0  # the trigger
+        # ...and training stayed bit-identical across the cohort.
+        np.testing.assert_array_equal(a["params"], b["params"])
+
+    def test_member_failure_aborts_transition_for_all(self):
+        # Member 1 fails during decision epoch 0 (the first attempted
+        # switch). The AND-vote must abort the transition on BOTH members
+        # — no state where one switched and one didn't — and the NEXT
+        # clean decision completes the switch on both.
+        results = _PolicyRunner(
+            steps=18, decide_every=4, fail_decide_epoch=0
+        ).run()
+        a, b = results
+        assert [
+            (d["from"], d["to"], d["committed"], d["switched"])
+            for d in a["decisions"]
+        ] == [
+            (d["from"], d["to"], d["committed"], d["switched"])
+            for d in b["decisions"]
+        ]
+        first_a, first_b = a["decisions"][0], b["decisions"][0]
+        # the injected failure aborted epoch 0 everywhere
+        assert first_a["committed"] is False and first_a["switched"] is False
+        assert first_b["committed"] is False and first_b["switched"] is False
+        # at no point did exactly one member hold the new strategy: the
+        # per-epoch (from, to, switched) tuples are identical, so the
+        # strategy history is identical — and the run converged to the
+        # same final strategy with bit-identical params.
+        assert a["strategy"] == b["strategy"]
+        later = [d for d in a["decisions"][1:] if d["switched"]]
+        assert later, "a later clean decision should complete the switch"
+        np.testing.assert_array_equal(a["params"], b["params"])

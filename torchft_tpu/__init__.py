@@ -33,6 +33,7 @@ from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
 from torchft_tpu.optim import OptimizerWrapper
+from torchft_tpu.policy import CostKnobs, PolicyEngine, StrategySpec
 from torchft_tpu.pipeline import pipeline_blocks, stack_blocks
 from torchft_tpu.profiling import Profiler
 from torchft_tpu.train_state import FTTrainState
@@ -61,6 +62,9 @@ __all__ = [
     "Optimizer",
     "OptimizerWrapper",
     "PipelinedDDP",
+    "PolicyEngine",
+    "CostKnobs",
+    "StrategySpec",
     "Profiler",
     "QuorumResult",
     "pipeline_blocks",

@@ -80,6 +80,11 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_void_p),
     ]
+    lib.tft_region_quorum_json.restype = ctypes.c_int
+    lib.tft_region_quorum_json.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
 
     # Persistent lighthouse-protocol client: batched lease renewal /
     # heartbeat / depart over ONE connection (bench simulated groups).
@@ -120,6 +125,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.tft_manager_destroy.argtypes = [ctypes.c_void_p]
     lib.tft_manager_using_root.restype = ctypes.c_int
     lib.tft_manager_using_root.argtypes = [ctypes.c_void_p]
+    lib.tft_manager_set_status.restype = ctypes.c_int
+    lib.tft_manager_set_status.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
 
     lib.tft_client_create.restype = ctypes.c_void_p
     lib.tft_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
@@ -599,6 +606,17 @@ class RegionLighthouse:
         _check(_lib.tft_region_status_json(self._handle, ctypes.byref(out)))
         return json.loads(_take_string(out))
 
+    def quorum_json(self) -> dict:
+        """The region-side quorum CACHE: the last global quorum pulled
+        from the root, served locally with its refresh ``age_ms`` (also
+        over HTTP as ``GET /quorum.json``). Read-mostly consumers use
+        this instead of long-polling the root — the root sees one
+        standing poll per region regardless of reader count, and with
+        the root down the cache keeps serving with a growing age."""
+        out = ctypes.c_void_p()
+        _check(_lib.tft_region_quorum_json(self._handle, ctypes.byref(out)))
+        return json.loads(_take_string(out))
+
     def shutdown(self) -> None:
         if self._handle:
             _lib.tft_region_shutdown(self._handle)
@@ -726,6 +744,21 @@ class Manager:
         """True while region failover has this group registered directly at
         the root (always False without a ``root_addr``)."""
         return bool(_lib.tft_manager_using_root(self._handle))
+
+    def set_status(self, status: dict) -> None:
+        """Publishes a member-health digest that rides every subsequent
+        lease renewal to the lighthouse, where it appears under this
+        member's entry in ``/status.json`` (``members[i].status``).
+        Display-only — the quorum logic never reads it. The lighthouse
+        keeps the LAST digest it saw until the member departs or its
+        lease is pruned (a renewal without a digest is indistinguishable
+        from a pre-status client), so readers should treat the embedded
+        step/commit counters as the digest's freshness stamp."""
+        _check(
+            _lib.tft_manager_set_status(
+                self._handle, json.dumps(status).encode()
+            )
+        )
 
     def shutdown(self) -> None:
         if self._handle:

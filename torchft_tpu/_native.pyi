@@ -69,6 +69,7 @@ class RegionLighthouse:
     ) -> None: ...
     def address(self) -> str: ...
     def status_json(self) -> dict: ...
+    def quorum_json(self) -> dict: ...
     def shutdown(self) -> None: ...
     def __enter__(self) -> "RegionLighthouse": ...
     def __exit__(self, *exc: object) -> None: ...
@@ -112,6 +113,7 @@ class Manager:
     ) -> None: ...
     def address(self) -> str: ...
     def using_root_fallback(self) -> bool: ...
+    def set_status(self, status: dict) -> None: ...
     def shutdown(self) -> None: ...
 
 
@@ -209,6 +211,7 @@ class _NativeLib:
     def tft_region_shutdown(self, handle: Any) -> None: ...
     def tft_region_destroy(self, handle: Any) -> None: ...
     def tft_region_status_json(self, handle: Any, out: Any) -> int: ...
+    def tft_region_quorum_json(self, handle: Any, out: Any) -> int: ...
     def tft_lease_client_create(
         self,
         addr: bytes,
@@ -251,6 +254,7 @@ class _NativeLib:
     def tft_manager_shutdown(self, handle: Any) -> None: ...
     def tft_manager_destroy(self, handle: Any) -> None: ...
     def tft_manager_using_root(self, handle: Any) -> int: ...
+    def tft_manager_set_status(self, handle: Any, status_json: Any) -> int: ...
     def tft_client_create(
         self,
         addr: bytes,

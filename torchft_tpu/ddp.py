@@ -342,6 +342,20 @@ class PipelinedDDP:
             self._residual = self._prev_residual
         return committed
 
+    def blocking_step(self, *batch: Any) -> Any:
+        """One UNPIPELINED step: quorum, dispatch, settle — the whole
+        transaction in-step (the schedule AdaptiveDDP probes as
+        ``blocking``/``plan`` and the policy engine's per-step-DDP
+        strategy runs). Drains any overlap left by earlier ``step`` calls
+        first, so the two schedules can be mixed."""
+        if self._inflight is not None:
+            self._settle()
+        self._manager.start_quorum()
+        loss, grads = self._grad_fn(self._state.params, *batch)
+        self._inflight = self._dispatch(grads)
+        self._settle()
+        return loss
+
     def step(self, *batch: Any) -> Any:
         """One pipelined step: dispatches this batch's gradient program,
         settles the PREVIOUS step's transaction while the device computes,
@@ -425,6 +439,17 @@ class AdaptiveDDP:
     All members must use the same setting, like every other schedule
     knob.
 
+    Probe refresh: a locked argmin is otherwise revisited only on a
+    quorum change — a cohort whose BANDWIDTH moved (congestion, a paced
+    link, a recovered NIC) but whose membership didn't would ride a stale
+    schedule forever. ``reprobe_steps`` (env
+    ``TORCHFT_DDP_REPROBE_STEPS``, default 0 = never) revalidates the
+    lock every N attempted steps: the refresh fires on the same global
+    step on every member (steps advance in lockstep and the lock itself
+    anchored at a global transaction), only on a clean step following a
+    clean step (the reconfigure-echo discipline above), so the cohort
+    re-enters the probe schedule together.
+
     Usage (identical surface to PipelinedDDP)::
 
         ddp = AdaptiveDDP(manager, state, grad_fn)
@@ -464,6 +489,7 @@ class AdaptiveDDP:
         mode: Optional[str] = None,
         probe_steps: int = 3,
         device_pack: Any = None,
+        reprobe_steps: Optional[int] = None,
     ) -> None:
         mode = mode or os.environ.get("TORCHFT_DDP_MODE", "auto")
         if mode not in ("auto", "blocking", "pipelined", "plan", "xla_iso"):
@@ -529,6 +555,14 @@ class AdaptiveDDP:
         # observes the echo. Only a clean step FOLLOWING a clean step
         # treats a new id as a membership change.
         self._last_errored = False
+        if reprobe_steps is None:
+            reprobe_steps = int(
+                os.environ.get("TORCHFT_DDP_REPROBE_STEPS", "0")
+            )
+        # <= 0 disables: a locked schedule then only revalidates on a
+        # quorum change (the pre-refresh behavior).
+        self._reprobe_steps = max(int(reprobe_steps), 0)
+        self._steps_since_lock = 0
 
     @property
     def mode(self) -> Optional[str]:
@@ -557,8 +591,6 @@ class AdaptiveDDP:
             return d.step(*batch)
         # Blocking schedule (settle in-step); legacy, plan or iso
         # transport.
-        if d._inflight is not None:
-            d._settle()  # leaving pipelined mode: drain the overlap
         if mode in ("plan", "plan_devpack"):
             d._transport = "plan"
         elif mode == "xla_iso":
@@ -569,11 +601,7 @@ class AdaptiveDDP:
             d._device_pack = True
         elif mode == "plan":
             d._device_pack = self._plan_device_pack()
-        self._manager.start_quorum()
-        loss, grads = d._grad_fn(d._state.params, *batch)
-        d._inflight = d._dispatch(grads)
-        d._settle()
-        return loss
+        return d.blocking_step(*batch)
 
     def _decide(self) -> None:
         import numpy as np
@@ -621,6 +649,7 @@ class AdaptiveDDP:
             best = int(np.argmin(total))
         self._mode = self._candidates[best]
         self._decision_qid = self._probe_qid
+        self._steps_since_lock = 0
         self.decision = {
             "mode": self._mode,
             "probe_s": {
@@ -672,6 +701,7 @@ class AdaptiveDDP:
         }
         self._manager.metrics().incr("ddp_mode_blocking_backstop")
         self._consec_errors = 0
+        self._steps_since_lock = 0
         return True
 
     def step(self, *batch: Any) -> Any:
@@ -690,11 +720,26 @@ class AdaptiveDDP:
                         # reconfigure echo): every member sees the new id
                         # at this same step and re-probes in lockstep.
                         self._restart_probe(qid)
-                    else:
-                        # The bump is (or may be) the echo of an errored
-                        # step's forced reconfigure — track it, don't
-                        # re-probe, or an error storm loops forever.
-                        self._decision_qid = qid
+                        return loss
+                    # The bump is (or may be) the echo of an errored
+                    # step's forced reconfigure — track it, don't
+                    # re-probe, or an error storm loops forever.
+                    self._decision_qid = qid
+                self._steps_since_lock += 1
+                if (
+                    self._reprobe_steps > 0
+                    and self._steps_since_lock >= self._reprobe_steps
+                    and clean
+                ):
+                    # Scheduled refresh: revalidate the locked argmin
+                    # against CURRENT conditions (bandwidth may have moved
+                    # without a membership change). Clean-after-clean only
+                    # — fires at the same global step on every member, so
+                    # the cohort re-enters the probe together; under a
+                    # sustained error run the counter just keeps waiting
+                    # (the backstop owns that regime).
+                    self._manager.metrics().incr("ddp_reprobe")
+                    self._restart_probe(qid)
             return loss
 
         # Probe phase: candidate = attempted steps since the anchor,

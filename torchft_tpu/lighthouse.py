@@ -39,15 +39,30 @@ from . import _native
 logger = logging.getLogger(__name__)
 
 
+def _fetch_json(addr: str, path: str, timeout: float) -> dict:
+    if not addr.startswith("http://") and not addr.startswith("https://"):
+        addr = "http://" + addr
+    with urllib.request.urlopen(addr + path, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
 def fetch_status(addr: str, timeout: float = 5.0) -> dict:
     """Fetches a lighthouse's (any role) machine-readable status view.
 
     ``addr`` is the service address (``http://host:port`` or ``host:port``).
     """
-    if not addr.startswith("http://") and not addr.startswith("https://"):
-        addr = "http://" + addr
-    with urllib.request.urlopen(addr + "/status.json", timeout=timeout) as r:
-        return json.loads(r.read().decode("utf-8"))
+    return _fetch_json(addr, "/status.json", timeout)
+
+
+def fetch_quorum(addr: str, timeout: float = 5.0) -> dict:
+    """Fetches a REGION lighthouse's cached view of the last global quorum
+    (``GET /quorum.json``): served from the region-side cache the standing
+    root poll maintains, so reading it generates no root traffic — the
+    read-mostly path for dashboards and fleet tooling. ``age_ms`` is the
+    time since the cache was refreshed off the root (null before the first
+    root quorum lands); with the root down the cache keeps serving while
+    ``age_ms`` grows and ``root_connected`` goes false."""
+    return _fetch_json(addr, "/quorum.json", timeout)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:

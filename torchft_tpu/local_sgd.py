@@ -97,6 +97,11 @@ class LocalSGD:
         # On-device backup of the last synchronized params (role of the
         # reference's CPU backup, :81-95; see module docstring).
         self._backup_params: Any = _detached_copy(state.params)
+        # Outcome of the most recent window sync (None before the first):
+        # the sync's commit vote happens inside _perform_sync, so without
+        # this record a wrapper (the policy engine) could not tell a
+        # committed window from a rolled-back one.
+        self.last_sync_commit: Optional[bool] = None
 
     # -- train-loop surface --
 
@@ -130,6 +135,20 @@ class LocalSGD:
         self._perform_sync()
         self._local_step = 0
 
+    def begin_fresh_window(self) -> None:
+        """Re-anchors the window at the CURRENT params: the backup becomes
+        the live params and the inner-step count restarts. The policy
+        engine's strategy-entry hook — when a runtime strategy switch
+        hands control to this engine mid-run, the first window's rollback
+        / pseudogradient baseline must be the switch point, not a stale
+        snapshot from this engine's last tenure. DiLoCo outer-optimizer
+        state is deliberately NOT touched (momentum survives a strategy
+        round trip; membership drift is handled by the quorum-id-keyed
+        reshard machinery at the next sync)."""
+        self._backup_params = _detached_copy(self._state.params)
+        self._local_step = 0
+        self.last_sync_commit = None
+
     # -- checkpoint plumbing (manager state callbacks) --
 
     def state_dict(self) -> Dict[str, Any]:
@@ -162,7 +181,9 @@ class LocalSGD:
         averaged = self._manager.allreduce(
             self._state.params, op=ReduceOp.AVG
         ).wait()
-        if self._manager.should_commit():
+        committed = self._manager.should_commit()
+        self.last_sync_commit = committed
+        if committed:
             self._state.params = averaged
             self._save_parameters()
         else:
@@ -289,6 +310,13 @@ class DiLoCo(LocalSGD):
         # residual would inject a fraction of a discarded correction.
         self._shard_residual = None
 
+    def begin_fresh_window(self) -> None:
+        # Strategy re-entry is a trajectory change for the EF carry (the
+        # residual belongs to deltas another strategy superseded), not for
+        # the outer state (momentum legitimately survives — see LocalSGD).
+        super().begin_fresh_window()
+        self._shard_residual = None
+
     def _perform_sync(self) -> None:
         """Sharded: RS → outer step on the owned shard → param allgather.
         Unsharded: average pseudogradients, outer-step from the restored
@@ -312,7 +340,9 @@ class DiLoCo(LocalSGD):
         # and old_global aliases the on-device backup.
         self._state.params = _detached_copy(old_global)
 
-        if self._manager.should_commit():
+        committed = self._manager.should_commit()
+        self.last_sync_commit = committed
+        if committed:
             updates, self._outer_state = self._outer_tx.update(
                 averaged, self._outer_state, self._state.params
             )
@@ -375,7 +405,9 @@ class DiLoCo(LocalSGD):
                 self._manager.report_error(e)
                 gathered = None
 
-        if self._manager.should_commit() and gathered is not None:
+        committed = self._manager.should_commit() and gathered is not None
+        self.last_sync_commit = committed
+        if committed:
             self._state.params = _to_device_tree(gathered)
             self._outer_state = new_outer
             self._outer_shard_meta = new_meta
@@ -635,6 +667,14 @@ class AsyncDiLoCo(DiLoCo):
         params, checkpointing durably, or shutdown)."""
         self._finish_pending()
 
+    def begin_fresh_window(self) -> None:
+        # An overlapped sync still in flight belongs to the OLD tenure's
+        # trajectory: settle it before re-anchoring, and drop the int8 EF
+        # carry with it.
+        self._finish_pending()
+        super().begin_fresh_window()
+        self._residual = None
+
     def state_dict(self) -> Dict[str, Any]:
         self._finish_pending()
         return super().state_dict()
@@ -791,7 +831,9 @@ class AsyncDiLoCo(DiLoCo):
         )
 
         t0 = time.perf_counter()
-        if self._manager.should_commit():
+        committed = self._manager.should_commit()
+        self.last_sync_commit = committed
+        if committed:
             self._state.params, new_global, self._outer_state = self._commit_fn(
                 averaged, old_global, delta, self._outer_state,
                 self._state.params,

@@ -191,6 +191,9 @@ class Manager:
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
         self._metrics = Metrics()
+        # Last measured effective wire throughput (MB/s), updated by
+        # observe_op_stats(); None until a ring op has been observed.
+        self._last_wire_eff_mbps: Optional[float] = None
         self._profiler = (
             profiler if profiler is not None else Profiler.from_env()
         )
@@ -356,6 +359,12 @@ class Manager:
         heal = allow_heal and result.heal
 
         if quorum_id != self._quorum_id:
+            if self._quorum_id != -1:
+                # Membership moved (or a data-plane error forced a rebuild)
+                # mid-run — the rolling churn signal the policy engine and
+                # the status export watch. The FIRST configure is a cold
+                # start, not churn.
+                self._metrics.mark("churn")
             # Reconfigure the data plane on a store prefix unique to this
             # quorum AND this local rank: cross-group rings are per local
             # rank, and stale members can't collide (reference :470-477).
@@ -410,7 +419,14 @@ class Manager:
                     )
             if heal:
                 self._healing = True
-                self._metrics.incr("heals")
+                # A recovery at max_step 0 is the initial weight
+                # synchronization every fresh cohort's non-primary runs —
+                # not a fault. Counting it as a heal would seed the policy
+                # engine's churn-cost signal with a phantom fault recovery
+                # on every clean startup.
+                self._metrics.incr(
+                    "heals" if result.max_step > 0 else "init_sync_heals"
+                )
                 self._logger.info(
                     f"healing required, fetching checkpoint from "
                     f"{result.recover_src_manager_address} step={result.max_step}"
@@ -785,11 +801,19 @@ class Manager:
 
     # -- commit protocol --
 
-    def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
+    def should_commit(
+        self,
+        timeout: Optional[timedelta] = None,
+        count_batches: bool = True,
+    ) -> bool:
         """Distributed AND-vote on step validity. Reference manager.py:545-598.
 
         Returns True iff every rank of every participating replica group
         completed the step without errors and quorum size >= min_replica_size.
+        ``count_batches=False`` marks a CONTROL transaction (e.g. the policy
+        engine's decision step): the committed step counter still advances
+        (transaction ordering and heal max_step depend on it) but
+        ``batches_committed`` does not — no batch was trained.
         """
         # Settle the quorum thread before reading _healing/_errored: it is
         # their writer, and an early-errored step may reach here without any
@@ -834,7 +858,8 @@ class Manager:
 
         if should_commit:
             self._step += 1
-            self._batches_committed += self.num_participants()
+            if count_batches:
+                self._batches_committed += self.num_participants()
         self._metrics.incr("commits" if should_commit else "aborts")
         if self._errored is not None:
             self._metrics.incr("errors")
@@ -860,6 +885,91 @@ class Manager:
         """Manager state to persist alongside user checkpoints.
         Reference manager.py:615-629."""
         return {"step": self._step, "batches_committed": self._batches_committed}
+
+    # -- observability / policy signals --
+
+    def observe_op_stats(self) -> List[dict]:
+        """Drains the data plane's per-op phase timings (``pop_op_stats``)
+        THROUGH the manager, folding ring entries into the rolling
+        effective-bandwidth estimate ``signals()`` reports: per op,
+        ``wire_bytes / ring_s`` is the achieved wire throughput (the number
+        the policy cost model divides by) and its per-connection share
+        (divided by the op's stripe count) is what operators compare
+        against ``TORCHFT_HC_WIRE_CAP_MBPS``. Returns the drained entries,
+        so a caller that wants the raw breakdown (benches, diagnosis
+        tooling) consumes the SAME drain — pop semantics are preserved,
+        just routed. A backend without op stats yields ``[]``."""
+        pop = getattr(self._collectives, "pop_op_stats", None)
+        entries: List[dict] = pop() if pop is not None else []
+        for st in entries:
+            ring_s = st.get("ring")
+            wire_bytes = st.get("wire_bytes") or st.get("bytes")
+            if not ring_s or not wire_bytes or ring_s <= 0:
+                continue
+            eff = wire_bytes / ring_s / (1 << 20)
+            stripes = len(st.get("stripe_s") or ()) or 1
+            self._metrics.record("wire_eff_MBps", eff)
+            self._metrics.record("wire_conn_MBps", eff / stripes)
+            self._last_wire_eff_mbps = eff
+        return entries
+
+    def signals(self, churn_window_s: float = 600.0) -> Dict[str, Any]:
+        """The policy engine's input signals as one JSON-able dict:
+
+        - ``churn_per_min``: rolling rate of data-plane reconfigures
+          (quorum-id bumps after the first — kills, joins, heals, forced
+          rebuilds) over the trailing ``churn_window_s``.
+        - ``wire_eff_MBps``: last measured effective wire throughput of a
+          ring op (``None`` until :meth:`observe_op_stats` has seen one).
+        - ``heal``: the last streamed-heal cost breakdown (the transport's
+          ``last_fetch_stats``: path/wire/bytes/fetch_s/h2d_s), plus the
+          ``heal_fetch``/``heal_apply`` timer snapshots — ``None`` when
+          this replica never healed.
+
+        Also the payload pushed to the lighthouse ``status.json`` member
+        view (see :meth:`push_status`)."""
+        heal: Optional[Dict[str, Any]] = None
+        fetch_stats = getattr(
+            self._checkpoint_transport, "last_fetch_stats", None
+        )
+        timers = self._metrics.snapshot()["timers_s"]
+        if fetch_stats is not None or "heal_fetch" in timers:
+            heal = {
+                "last_fetch": fetch_stats,
+                "fetch_s": timers.get("heal_fetch"),
+                "apply_s": timers.get("heal_apply"),
+            }
+        return {
+            "churn_per_min": round(
+                self._metrics.rate_per_min("churn", churn_window_s), 6
+            ),
+            "wire_eff_MBps": self._last_wire_eff_mbps,
+            "heal": heal,
+        }
+
+    def push_status(self, extra: Optional[Dict[str, Any]] = None) -> None:
+        """Publishes the current :meth:`signals` digest (plus step/commit
+        progress and any ``extra`` — e.g. the policy engine's active
+        strategy) to the lighthouse: it rides the native manager's lease
+        renewals and appears under this member in ``/status.json``. No-op
+        on ranks that don't host the native manager (group rank != 0) —
+        the group's digest is rank 0's."""
+        if self._manager is None:
+            return
+        counters = self._metrics.snapshot()["counters"]
+        status: Dict[str, Any] = {
+            "step": self._step,
+            "commits": counters.get("commits", 0),
+            "aborts": counters.get("aborts", 0),
+            "heals": counters.get("heals", 0),
+            **self.signals(),
+        }
+        if extra:
+            status.update(extra)
+        try:
+            self._manager.set_status(status)
+        except Exception as e:  # noqa: BLE001 - observability must not kill
+            self._logger.warn(f"status push failed (ignored): {e}")
 
     # -- introspection --
 

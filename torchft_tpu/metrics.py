@@ -51,14 +51,52 @@ class _Timer:
         }
 
 
+class _EventWindow:
+    """Bounded reservoir of event timestamps with a trailing-window rate.
+
+    The rolling-rate primitive behind signals like the churn estimate
+    (reconfigures per minute): ``mark()`` appends a monotonic timestamp,
+    ``rate_per_min(window_s)`` counts events inside the trailing window
+    and divides by the window actually OBSERVED — a process younger than
+    the window divides by its own age, so early-life rates aren't
+    diluted toward zero by time that never happened."""
+
+    def __init__(self, maxlen: int = 512) -> None:
+        self._stamps: deque = deque(maxlen=maxlen)
+        self._born = time.monotonic()
+        self.count = 0
+
+    def mark(self) -> None:
+        self._stamps.append(time.monotonic())
+        self.count += 1
+
+    def rate_per_min(self, window_s: float = 600.0) -> float:
+        now = time.monotonic()
+        cutoff = now - window_s
+        n = sum(1 for t in self._stamps if t >= cutoff)
+        observed = min(window_s, now - self._born)
+        if self._stamps and len(self._stamps) == self._stamps.maxlen:
+            # Reservoir rolled over: the window may predate the oldest
+            # retained stamp; never divide by time we can't account for.
+            observed = min(observed, now - self._stamps[0])
+        return 0.0 if observed <= 0 else n * 60.0 / observed
+
+    def snapshot(self, window_s: float = 600.0) -> Dict[str, float]:
+        return {
+            "n": self.count,
+            "rate_per_min": round(self.rate_per_min(window_s), 6),
+        }
+
+
 class Metrics:
-    """Thread-safe counters + timers. All methods are cheap enough for the
-    hot path; reading is lock-held but O(window)."""
+    """Thread-safe counters + timers + event windows. All methods are
+    cheap enough for the hot path; reading is lock-held but O(window)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = defaultdict(int)
         self._timers: Dict[str, _Timer] = {}
+        self._events: Dict[str, _EventWindow] = {}
 
     def incr(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -71,6 +109,23 @@ class Metrics:
                 timer = self._timers[name] = _Timer()
             timer.record(seconds)
 
+    def mark(self, name: str) -> None:
+        """Records one occurrence of a timestamped event (for rolling
+        rates — counters answer "how many ever", this answers "how often
+        lately")."""
+        with self._lock:
+            window = self._events.get(name)
+            if window is None:
+                window = self._events[name] = _EventWindow()
+            window.mark()
+
+    def rate_per_min(self, name: str, window_s: float = 600.0) -> float:
+        """Trailing-window rate (events/min) of a ``mark``ed event; 0.0
+        for a name never marked."""
+        with self._lock:
+            window = self._events.get(name)
+            return 0.0 if window is None else window.rate_per_min(window_s)
+
     def timed(self, name: str) -> "_TimedBlock":
         return _TimedBlock(self, name)
 
@@ -80,6 +135,9 @@ class Metrics:
                 "counters": dict(self._counters),
                 "timers_s": {
                     name: t.snapshot() for name, t in self._timers.items()
+                },
+                "events": {
+                    name: w.snapshot() for name, w in self._events.items()
                 },
             }
 
