@@ -56,6 +56,26 @@ fault-tolerance overhead):
                    device pack (it pays the interpret-mode kernels and
                    saves nothing). --dryrun shrinks iterations to a
                    smoke test (no artifact written).
+  --hier-sweep     FLAT ring vs the TWO-TIER topology-aware schedule on a
+                   W=8 / R=2-regions fleet of real processes, per wire
+                   (f32 / bf16 / q8+EF) and per stripe count, with the
+                   fast-intra/slow-inter fabric emulated via the existing
+                   per-connection pacing (TORCHFT_HC_WIRE_CAP_MBPS caps
+                   every flat edge AND the inter tier at 12 MB/s — the
+                   topology-oblivious placement where any flat hop may be
+                   a DCN hop — while the intra tier rides unpaced
+                   loopback) -> HIER_BENCH.json. Both sides ride the comm
+                   PLAN path (the AdaptiveDDP plan / plan_hier
+                   candidates). The artifact also carries: MEASURED
+                   per-leader inter-tier bytes (from the duplex tx
+                   accounting, checked against the (R-1)/R * N per-phase
+                   prediction), cross-member + cross-iteration
+                   bit-identity digests (incl. an uneven 5/3 region
+                   split), and a LEADER-KILL probe (SIGKILL a region
+                   leader mid-collective: the survivors must error within
+                   one op deadline and commit again after reconfiguring
+                   to W=7). --dryrun shrinks to W=4 / tiny payload as a
+                   CI smoke (no artifact written).
   --stripe-sweep   ring striped over N parallel TCP connections per
                    neighbor, N swept over STRIPE_COUNTS at the pipelined
                    chunk config -> STRIPE_BENCH.json. Two passes:
@@ -80,6 +100,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 from datetime import timedelta
 
@@ -137,6 +158,217 @@ PLAN_WIRES = ("f32", "bf16", "q8")
 PLAN_WIRE_CAP_MBPS = 12
 PLAN_STRIPES = 4
 PLAN_ITERS = 8
+
+# Hier-sweep knobs: a W=8 fleet split into R=2 regions of 4, every member
+# its own PROCESS (the leader-kill probe needs real SIGKILL). The
+# per-connection cap models the slow wide-area path at the top of the
+# measured tunnel rates (like the plan sweep); in FLAT mode it paces
+# every edge — the topology-oblivious placement where any hop may cross
+# the DCN — while the hier schedule's intra tier rides unpaced loopback
+# (TORCHFT_HC_WIRE_CAP_INTRA_MBPS unset), which is exactly the
+# fast-intra/slow-inter fabric the two-tier schedule exists for.
+HIER_WORLD = 8
+HIER_REGIONS = 2
+HIER_PAYLOAD_MB = 16
+HIER_WIRE_CAP_MBPS = 12
+HIER_STRIPES = (1, 4)
+HIER_ITERS = 3
+HIER_WIRES = {"f32": None, "bf16": "bf16", "q8": "q8ef"}
+# Leader-kill probe payload: sized so the inter phase runs for seconds
+# under the cap — the SIGKILL must land mid-collective, and the op
+# timeout bounds how fast the survivors must surface the death.
+HIER_KILL_MB = 24
+HIER_KILL_TIMEOUT_S = 30
+
+
+def _hier_world() -> int:
+    return 4 if "--dryrun" in sys.argv else HIER_WORLD
+
+
+def _hier_payload_mb() -> float:
+    return 1 if "--dryrun" in sys.argv else HIER_PAYLOAD_MB
+
+
+def _hier_kill_mb() -> float:
+    return 4 if "--dryrun" in sys.argv else HIER_KILL_MB
+
+
+def _hier_iters() -> int:
+    return 1 if "--dryrun" in sys.argv else HIER_ITERS
+
+
+def _hier_stripes():
+    return (1,) if "--dryrun" in sys.argv else HIER_STRIPES
+
+
+def _hier_regions(world: int):
+    half = world // 2
+    return ["east"] * half + ["west"] * (world - half)
+
+
+def _hier_digest(tree) -> str:
+    import hashlib
+
+    return hashlib.sha256(
+        np.ascontiguousarray(np.asarray(tree)).tobytes()
+    ).hexdigest()
+
+
+def _hier_member(store_addr: str, rank: int, rec=None) -> None:
+    """The full hier-sweep protocol for ONE member; rank 0 (the measurer)
+    passes `rec` and records timings/accounting. Every rank runs the
+    identical op sequence — the ring has no slack for divergence."""
+    import signal
+
+    from torchft_tpu._native import StoreClient
+    from torchft_tpu.collectives import HostCollectives, ReduceOp
+
+    W = _hier_world()
+    regions = _hier_regions(W)
+    count = int(_hier_payload_mb() * (1 << 20)) // 4
+    data = (np.arange(count, dtype=np.float32) % 1001) * 0.01 + (rank + 1)
+    iters = _hier_iters()
+    client = StoreClient(store_addr, connect_timeout=timedelta(seconds=60))
+
+    for stripes in _hier_stripes():
+        for wname, wire in HIER_WIRES.items():
+            cfg = f"{wname}_s{stripes}"
+            hc = HostCollectives(
+                timeout=timedelta(seconds=600),
+                connect_timeout=timedelta(seconds=600),
+                stripes=stripes,
+            )
+            hc.configure(f"{store_addr}/{cfg}", rank, W, regions)
+
+            def flat():
+                return hc.plan_allreduce(
+                    data.copy(), ReduceOp.SUM, divisor=float(W), wire=wire
+                ).wait()
+
+            def hier():
+                return hc.plan_allreduce(
+                    data.copy(), ReduceOp.SUM, divisor=float(W), wire=wire,
+                    hier=True,
+                ).wait()
+
+            flat()  # warm: plan builds
+            hier()
+            hc.pop_op_stats()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                flat()
+            flat_s = (time.perf_counter() - t0) / iters
+            hc.pop_op_stats()
+            digests = []
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                digests.append(_hier_digest(hier()))
+            hier_s = (time.perf_counter() - t0) / iters
+            stats = [
+                s for s in hc.pop_op_stats()
+                if s["op"] == "plan_allreduce" and s.get("hier")
+            ]
+            client.set(f"hier_digest/{cfg}/{rank}", digests[-1].encode())
+            if rec is not None:
+                rec[cfg] = {
+                    "wire": wname,
+                    "stripes": stripes,
+                    "flat_s": round(flat_s, 4),
+                    "hier_s": round(hier_s, 4),
+                    "flat_steps_per_s": round(1.0 / flat_s, 3),
+                    "hier_steps_per_s": round(1.0 / hier_s, 3),
+                    "hier_speedup": round(flat_s / hier_s, 3),
+                    # identical inputs every iteration: equal digests =
+                    # deterministic across runs of the reduction tree.
+                    # NOT asserted on the q8+EF wire — the leader's
+                    # error-feedback carry advances between syncs BY
+                    # DESIGN, so consecutive results differ while
+                    # cross-member identity (the real contract) holds.
+                    "deterministic_across_iters": (
+                        len(set(digests)) == 1 if wire != "q8ef" else None
+                    ),
+                    "tiers": stats[-1]["tiers"],
+                    "phase_s": {
+                        k: stats[-1][k]
+                        for k in ("intra_rs_s", "intra_ag_s",
+                                  "inter_ring_s", "intra_bcast_s")
+                    },
+                }
+            hc.shutdown()
+
+    # Uneven region split (5/3): the bit-identity contract must hold off
+    # the symmetric case too (the bulk op this time, q8 inter wire).
+    half = W // 2 + 1
+    uneven = ["east"] * half + ["west"] * (W - half)
+    hc = HostCollectives(
+        timeout=timedelta(seconds=600),
+        connect_timeout=timedelta(seconds=600),
+        stripes=_hier_stripes()[-1],
+    )
+    hc.configure(f"{store_addr}/uneven", rank, W, uneven)
+    out = hc.allreduce_hier(data.copy(), ReduceOp.SUM, wire="q8").wait()
+    client.set(f"hier_digest/uneven/{rank}", _hier_digest(out).encode())
+    hc.shutdown()
+
+    # Leader-kill probe: the WEST leader SIGKILLs itself mid-collective;
+    # every survivor must error within ONE op deadline (the configured
+    # timeout), not the 600 s rendezvous budget, and the reconfigured
+    # W-1 cohort must commit the next op.
+    victim = W // 2
+    hc = HostCollectives(
+        timeout=timedelta(seconds=HIER_KILL_TIMEOUT_S),
+        connect_timeout=timedelta(seconds=600),
+        stripes=1,
+    )
+    hc.configure(f"{store_addr}/kill", rank, W, regions)
+    big = np.ones(int(_hier_kill_mb() * (1 << 20)) // 4, np.float32)
+    if rank == victim:
+        # Early enough that the kill lands inside the op's inter phase
+        # even at the dryrun payload (the self-kill after the op is the
+        # backstop if the op still wins the race).
+        threading.Timer(
+            0.05, lambda: os.kill(os.getpid(), signal.SIGKILL)
+        ).start()
+    t0 = time.perf_counter()
+    died = None
+    try:
+        hc.allreduce_hier(big).wait()
+    except Exception as e:  # noqa: BLE001
+        died = e
+    err_s = time.perf_counter() - t0
+    if rank == victim:
+        # The op can race the timer and complete first; the victim must
+        # NEVER reach the recovery rendezvous (it would rejoin under a
+        # surviving rank and corrupt the handshake) — die here if the
+        # timer hasn't landed yet.
+        os.kill(os.getpid(), signal.SIGKILL)
+    hc.shutdown()
+    if rec is not None:
+        rec["leader_kill"] = {
+            "victim_rank": victim,
+            "payload_MB": _hier_kill_mb(),
+            "op_timeout_s": HIER_KILL_TIMEOUT_S,
+            "errored": died is not None,
+            "error_s": round(err_s, 3),
+            "error": str(died)[:120] if died else None,
+        }
+
+    new_rank = rank if rank < victim else rank - 1
+    new_regions = [g for i, g in enumerate(regions) if i != victim]
+    hc = HostCollectives(
+        timeout=timedelta(seconds=600),
+        connect_timeout=timedelta(seconds=600),
+        stripes=1,
+    )
+    hc.configure(f"{store_addr}/recover", new_rank, W - 1, new_regions)
+    out = hc.allreduce_hier(
+        np.arange(4096, dtype=np.float32) + new_rank
+    ).wait()
+    client.set(f"hier_digest/recover/{new_rank}", _hier_digest(out).encode())
+    hc.shutdown()
+    if rec is not None:
+        rec["leader_kill"]["recovered_commit"] = True
+        rec["leader_kill"]["surviving_world"] = W - 1
 
 
 def _plan_iters() -> int:
@@ -317,6 +549,13 @@ def _sync_sharded(hc, tree, wire, box):
 
 def peer(store_addr: str, mode: str) -> None:
     from torchft_tpu.platform import apply_jax_platform_env
+
+    if mode.startswith("hier:"):
+        # Hier-sweep member: the cap env was inherited from the parent
+        # (flat edges + inter tier paced, intra unpaced).
+        apply_jax_platform_env()
+        _hier_member(store_addr, int(mode.split(":", 1)[1]))
+        return
 
     _apply_cap(mode)
     apply_jax_platform_env()
@@ -665,6 +904,66 @@ def _run_mode(mode):
     return results
 
 
+def _run_hier():
+    """Spawns W-1 member processes, runs the measurer in-process, then
+    verifies cross-member digests and peer exit codes (the kill victim
+    must die by SIGKILL, everyone else exits clean)."""
+    from torchft_tpu import Store
+    from torchft_tpu._native import StoreClient
+
+    os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(HIER_WIRE_CAP_MBPS)
+    os.environ.pop("TORCHFT_HC_WIRE_CAP_INTRA_MBPS", None)
+    store = Store()
+    W = _hier_world()
+    victim = W // 2
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    peers = []
+    for r in range(1, W):
+        args = [sys.executable, os.path.abspath(__file__), "--peer",
+                store.address(), f"hier:{r}"]
+        if "--dryrun" in sys.argv:
+            args.append("--dryrun")
+        peers.append(subprocess.Popen(args, env=env))
+    rec = {}
+    try:
+        _hier_member(store.address(), 0, rec)
+        for i, p in enumerate(peers):
+            r = i + 1
+            code = p.wait(timeout=900)
+            if r == victim:
+                assert code != 0, "the kill victim exited cleanly"
+            else:
+                assert code == 0, f"peer {r} exited {code}"
+        client = StoreClient(
+            store.address(), connect_timeout=timedelta(seconds=30)
+        )
+        t = timedelta(seconds=30)
+
+        def digests(cfg, world):
+            return {
+                client.get(f"hier_digest/{cfg}/{r}", timeout=t).decode()
+                for r in range(world)
+            }
+
+        for cfg, row in rec.items():
+            if cfg == "leader_kill":
+                continue
+            row["digests_identical_across_members"] = (
+                len(digests(cfg, W)) == 1
+            )
+        rec["uneven_regions_bit_identity"] = len(digests("uneven", W)) == 1
+        rec["leader_kill"]["recover_bit_identity"] = (
+            len(digests("recover", W - 1)) == 1
+        )
+    finally:
+        for p in peers:
+            if p.poll() is None:
+                p.kill()
+        store.shutdown()
+    return rec
+
+
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--peer":
         peer(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "overlap")
@@ -859,6 +1158,120 @@ def main() -> None:
                 report["worst_devpack_speedup_raw"],
             "devpack_not_slower_tunnel":
                 report["devpack_not_slower_tunnel"],
+        }))
+        return
+
+    if "--hier-sweep" in sys.argv:
+        rec = _run_hier()
+        W, L = _hier_world(), HIER_REGIONS
+        count = int(_hier_payload_mb() * (1 << 20)) // 4
+        configs = {k: v for k, v in rec.items()
+                   if k not in ("leader_kill", "uneven_regions_bit_identity")}
+        # Accounting check: the leader's inter-tier bytes per ring phase
+        # must be ~(L-1)/L of the WIRE-sized payload — measured from the
+        # duplex tx counters, not modeled. Wire esize: f32 4, bf16 2,
+        # q8+EF ~1 (+ per-hop scales, allowed in the upper bound).
+        esize = {"f32": 4, "bf16": 2, "q8": 1}
+        for row in configs.values():
+            expected = count * esize[row["wire"]] * (L - 1) // L
+            inter = row["tiers"]["inter"]
+            row["expected_inter_phase_bytes"] = expected
+            row["inter_bytes_ok"] = all(
+                expected <= inter[k] <= expected * 1.10 + 8192
+                for k in ("rs_tx_bytes", "ag_tx_bytes")
+            )
+        f32_rows = {k: v for k, v in configs.items() if v["wire"] == "f32"}
+        best_key = max(f32_rows, key=lambda k: f32_rows[k]["hier_speedup"])
+        kill = rec["leader_kill"]
+        report = {
+            "platform": jax.devices()[0].platform,
+            "world_size": W,
+            "regions": {"east": W // 2, "west": W - W // 2},
+            "payload_MB": _hier_payload_mb(),
+            "iters": _hier_iters(),
+            "emulation": {
+                "inter_cap_MBps": HIER_WIRE_CAP_MBPS,
+                "how": "TORCHFT_HC_WIRE_CAP_MBPS send pacing per "
+                       "connection: in FLAT mode it paces EVERY ring edge "
+                       "(topology-oblivious placement — any hop may cross "
+                       "the DCN); the hier schedule's inter (leader) tier "
+                       "is paced by the same knob while the intra tier "
+                       "rides unpaced loopback "
+                       "(TORCHFT_HC_WIRE_CAP_INTRA_MBPS unset) — the "
+                       "fast-intra/slow-inter fabric the topology exists "
+                       "for",
+            },
+            "sync": "both sides ride the comm-plan path (the AdaptiveDDP "
+                    "plan vs plan_hier candidates): flat = one striped "
+                    "ring over all W members; hier = intra-region "
+                    "reduce-scatter -> intra allgather -> inter ring "
+                    "among the 2 region leaders (the only capped-link "
+                    "traffic) -> chunk-pipelined intra broadcast. Wires "
+                    "apply to the whole flat ring vs the inter hop only "
+                    "(f32 / bf16 / q8+EF at the leader).",
+            "determinism": "hier results are bit-identical across members "
+                    "and across iterations (sha256 digests in configs); "
+                    "the SUM ORDER differs from the flat ring, so "
+                    "flat-vs-hier values agree at the f32 reordering "
+                    "tolerance, never bit-for-bit (documented contract)",
+            "configs": configs,
+            "headline_config": best_key,
+            "hier_speedup": f32_rows[best_key]["hier_speedup"],
+            "hier_speedup_target_1p5_met":
+                f32_rows[best_key]["hier_speedup"] >= 1.5,
+            "inter_bytes_accounting_ok": all(
+                r["inter_bytes_ok"] for r in configs.values()
+            ),
+            "bit_identity_ok": all(
+                r["digests_identical_across_members"]
+                and r["deterministic_across_iters"] is not False
+                for r in configs.values()
+            ) and rec["uneven_regions_bit_identity"],
+            "uneven_regions_bit_identity": rec[
+                "uneven_regions_bit_identity"],
+            "leader_kill": kill,
+            "leader_kill_ok": bool(
+                kill["errored"]
+                and kill["error_s"] < kill["op_timeout_s"]
+                and kill.get("recovered_commit")
+                and kill.get("recover_bit_identity")
+            ),
+        }
+        if "--dryrun" in sys.argv:
+            print(json.dumps({
+                "dryrun": True,
+                "hier_speedup": report["hier_speedup"],
+                "inter_bytes_accounting_ok":
+                    report["inter_bytes_accounting_ok"],
+                "bit_identity_ok": report["bit_identity_ok"],
+                "leader_kill_ok": report["leader_kill_ok"],
+                "leader_kill": kill,
+            }))
+            # The CI smoke ASSERTS the contracts it exists for (a broken
+            # schedule must fail the step, not just print false). The
+            # speedup itself is NOT asserted here — a loaded CI runner's
+            # timing is noise at the dryrun payload; the accounting,
+            # identity and fault contracts are timing-free.
+            assert report["inter_bytes_accounting_ok"], (
+                "per-leader inter-tier bytes drifted from (L-1)/L * wire "
+                "payload"
+            )
+            assert report["bit_identity_ok"], (
+                "cross-member/cross-iteration bit identity broken"
+            )
+            assert report["leader_kill_ok"], (
+                f"leader-kill contract broken: {kill}"
+            )
+            return
+        with open(os.path.join(REPO, "HIER_BENCH.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps({
+            "hier_speedup": report["hier_speedup"],
+            "headline_config": best_key,
+            "inter_bytes_accounting_ok":
+                report["inter_bytes_accounting_ok"],
+            "bit_identity_ok": report["bit_identity_ok"],
+            "leader_kill_ok": report["leader_kill_ok"],
         }))
         return
 

@@ -205,12 +205,14 @@ void* tft_manager_create(const char* replica_id, const char* lighthouse_addr,
                          const char* hostname, const char* bind,
                          const char* store_addr, uint64_t world_size,
                          int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
-                         const char* root_addr, int64_t lease_ttl_ms) {
+                         const char* root_addr, int64_t lease_ttl_ms,
+                         const char* region) {
   ManagerServer* m = nullptr;
   int rc = guarded([&] {
     m = new ManagerServer(replica_id, lighthouse_addr, hostname, bind, store_addr,
                           world_size, heartbeat_interval_ms, connect_timeout_ms,
-                          root_addr ? root_addr : "", lease_ttl_ms);
+                          root_addr ? root_addr : "", lease_ttl_ms,
+                          region ? region : "");
   });
   return rc == kOk ? m : nullptr;
 }
@@ -357,6 +359,56 @@ int tft_hc_configure(void* handle, const char* store_addr, int64_t rank,
   });
 }
 
+// Configure with a REGION MAP: regions_json is a JSON array of one label
+// per rank ("" = unlabeled; null/empty string = no map -> flat only).
+// With >= 2 distinct labels the two-tier topology is built alongside the
+// flat ring; stripes_inter (<= 0: = stripes) is the inter (leader) ring's
+// connection count.
+int tft_hc_configure_hier(void* handle, const char* store_addr, int64_t rank,
+                          int64_t world_size, int64_t timeout_ms,
+                          int64_t stripes, int64_t stripes_inter,
+                          const char* regions_json) {
+  return guarded([&] {
+    std::vector<std::string> regions;
+    if (regions_json != nullptr && regions_json[0] != '\0') {
+      // Bound to a local: `Json::parse(...).as_array()` in the range-for
+      // would destroy the temporary before the loop body runs (the
+      // classic pre-C++23 range-for dangling reference).
+      Json parsed = Json::parse(regions_json);
+      for (const auto& r : parsed.as_array())
+        regions.push_back(r.as_string());
+    }
+    static_cast<HostCollectives*>(handle)->configure(
+        store_addr, rank, world_size, timeout_ms, stripes, regions,
+        stripes_inter);
+  });
+}
+
+// Whether the last configure built the two-tier topology.
+int64_t tft_hc_hier_capable(void* handle) {
+  return static_cast<HostCollectives*>(handle)->hier_capable() ? 1 : 0;
+}
+
+// In-place two-tier allreduce (see HostCollectives::allreduce_hier).
+// wire: 0 native across regions, 1 bf16 inter hop, 2 q8 inter hop.
+int tft_hc_allreduce_hier(void* handle, void* data, size_t count, int dtype,
+                          int op, int wire, int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->allreduce_hier(
+        data, count, static_cast<Dtype>(dtype), static_cast<ReduceOp>(op),
+        static_cast<HierWire>(wire), timeout_ms);
+  });
+}
+
+// Phase/byte breakdown of the last hierarchical op as JSON (measured
+// per-tier tx bytes; see HostCollectives::last_hier_json). Caller frees
+// via tft_string_free.
+int tft_hc_last_hier_json(void* handle, char** out) {
+  return guarded([&] {
+    *out = dup_string(static_cast<HostCollectives*>(handle)->last_hier_json());
+  });
+}
+
 int tft_hc_allreduce(void* handle, void* data, size_t count, int dtype, int op,
                      int64_t timeout_ms) {
   return guarded([&] {
@@ -461,6 +513,25 @@ int64_t tft_plan_build_pre(void* handle, const int64_t* counts,
     id = static_cast<HostCollectives*>(handle)->plan_build(
         counts, dtypes, n_leaves, static_cast<PlanWire>(wire),
         /*prepacked=*/true);
+  });
+  return rc == kOk ? id : -1;
+}
+
+// Builds a HIERARCHICAL CommPlan: execute (tft_plan_execute) runs the
+// two-tier schedule — intra reduce-scatter/allgather, inter ring among
+// region leaders at `wire` (bf16/q8/q8+EF applied at the slow hop ONLY;
+// staging and the intra tier stay native width), chunk-pipelined intra
+// broadcast. Requires a region-map configure (tft_hc_configure_hier) at
+// execute time; the signature hash bakes the hier geometry in, so a hier
+// plan meeting a flat plan errors instead of desyncing.
+int64_t tft_plan_build_hier(void* handle, const int64_t* counts,
+                            const int32_t* dtypes, int64_t n_leaves,
+                            int wire) {
+  int64_t id = -1;
+  int rc = guarded([&] {
+    id = static_cast<HostCollectives*>(handle)->plan_build(
+        counts, dtypes, n_leaves, static_cast<PlanWire>(wire),
+        /*prepacked=*/false, /*hier=*/true);
   });
   return rc == kOk ? id : -1;
 }

@@ -9,6 +9,7 @@
 #include <cmath>
 #include <exception>
 #include <limits>
+#include <set>
 #include <thread>
 #include <vector>
 
@@ -46,9 +47,18 @@ namespace {
 // byte on any future wire change.
 // rev 3: hello grew from {magic, rank} to {magic, rank, stripe, nstripes}
 // for the striped multi-connection ring.
-constexpr uint32_t kHelloMagic = 0x74667403; // "tft" + proto rev 3
+// rev 4: hello grew a TIER word ({magic, rank, stripe, nstripes, tier})
+// for the two-tier topology — one listener serves the flat, intra-region
+// and inter-region (leader) rings, and the hello names which ring a
+// connection belongs to.
+constexpr uint32_t kHelloMagic = 0x74667404; // "tft" + proto rev 4
 // "tftp": per-op header magic (part of the wire protocol).
 constexpr uint32_t kOpMagic = 0x74667470;
+
+// Connection tiers named in the hello (and indexing RingTier members).
+constexpr uint32_t kTierFlat = 0;
+constexpr uint32_t kTierIntra = 1;
+constexpr uint32_t kTierInter = 2;
 
 // Floor on bytes a stripe must carry before an extra connection/thread is
 // worth waking: below this, per-op thread dispatch costs more than the
@@ -149,6 +159,15 @@ std::pair<size_t, size_t> chunk_range(size_t count, int64_t ws, int64_t c) {
   return {start, len};
 }
 
+int64_t ns_between(std::chrono::steady_clock::time_point a,
+                   std::chrono::steady_clock::time_point b) {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(b - a).count();
+}
+
+int64_t cap_to_bps(const char* cap) {
+  return cap ? static_cast<int64_t>(std::atof(cap) * (1 << 20)) : 0;
+}
+
 }  // namespace
 
 std::pair<size_t, size_t> HostCollectives::stripe_range(size_t count,
@@ -173,14 +192,29 @@ void HostCollectives::abort() {
   aborted_ = true;
   abort_epoch_++;
   if (listener_) listener_->close();
-  for (auto& s : next_) s.shutdown_rdwr();
-  for (auto& s : prev_) s.shutdown_rdwr();
+  shutdown_sockets_locked();
+}
+
+void HostCollectives::shutdown_sockets_locked() {
+  for (RingTier* T : {&flat_, &intra_, &inter_}) {
+    for (auto& s : T->next) s.shutdown_rdwr();
+    for (auto& s : T->prev) s.shutdown_rdwr();
+  }
 }
 
 void HostCollectives::shutdown_sockets() {
   MutexLock lock(cfg_mu_);
-  for (auto& s : next_) s.shutdown_rdwr();
-  for (auto& s : prev_) s.shutdown_rdwr();
+  shutdown_sockets_locked();
+}
+
+int64_t HostCollectives::tier_tx(const RingTier& T) {
+  int64_t t = 0;
+  for (const auto& sc : T.scratch) t += sc.tx_bytes;
+  return t;
+}
+
+void HostCollectives::reset_tier_tx(RingTier& T) {
+  for (auto& sc : T.scratch) sc.tx_bytes = 0;
 }
 
 namespace {
@@ -198,12 +232,21 @@ int64_t remain_or_throw(int64_t deadline) {
 
 void HostCollectives::configure(const std::string& store_addr, int64_t rank,
                                 int64_t world_size, int64_t timeout_ms,
-                                int64_t stripes) {
+                                int64_t stripes,
+                                const std::vector<std::string>& regions,
+                                int64_t stripes_inter) {
   if (rank < 0 || world_size <= 0 || rank >= world_size)
     throw SocketError("bad rank/world_size");
   if (stripes < 1 || stripes > kMaxStripes)
     throw SocketError("bad stripe count (want 1.." +
                       std::to_string(kMaxStripes) + ")");
+  if (stripes_inter <= 0) stripes_inter = stripes;
+  if (stripes_inter > kMaxStripes)
+    throw SocketError("bad inter stripe count (want 1.." +
+                      std::to_string(kMaxStripes) + ")");
+  if (!regions.empty() &&
+      static_cast<int64_t>(regions.size()) != world_size)
+    throw SocketError("region map must carry one label per rank");
   abort(); // unblock any op stuck on the old ring
   MutexLock op_lock(op_mu_); // wait for it to drain
 
@@ -217,21 +260,84 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     plans_.clear();
   }
 
+  // Two-tier topology from the region map: pure arithmetic on
+  // (regions, rank order), identical on every member. The region LEADER
+  // is the lowest rank of the region (ranks sort by replica-id, so this
+  // is the lowest replica-id); the inter ring orders regions by their
+  // leader's rank.
+  bool hier = false;
+  std::vector<int64_t> intra_members;
+  int64_t intra_rank = -1;
+  std::vector<int64_t> leaders;
+  int64_t inter_rank = -1;
+  if (!regions.empty() && world_size > 1) {
+    std::set<std::string> distinct(regions.begin(), regions.end());
+    bool labeled = true;
+    for (const auto& r : regions)
+      if (r.empty()) labeled = false;
+    hier = labeled && distinct.size() >= 2;
+    if (hier) {
+      for (int64_t r = 0; r < world_size; r++) {
+        if (regions[r] == regions[rank]) {
+          if (r == rank)
+            intra_rank = static_cast<int64_t>(intra_members.size());
+          intra_members.push_back(r);
+        }
+      }
+      std::map<std::string, int64_t> leader_of;
+      for (int64_t r = 0; r < world_size; r++)
+        if (!leader_of.count(regions[r])) leader_of[regions[r]] = r;
+      for (const auto& [_, l] : leader_of) leaders.push_back(l);
+      std::sort(leaders.begin(), leaders.end());
+      for (size_t i = 0; i < leaders.size(); i++)
+        if (leaders[i] == rank) inter_rank = static_cast<int64_t>(i);
+    }
+  }
+  const int64_t intra_world = hier ? static_cast<int64_t>(intra_members.size()) : 0;
+  const int64_t inter_world = hier ? static_cast<int64_t>(leaders.size()) : 0;
+  const bool is_leader = hier && inter_rank >= 0;
+
   // Phase 1 (under cfg_mu_, non-blocking): retire the old ring, stand up the
   // new listener so a concurrent abort() can close it and wake phase 2.
   int64_t epoch;
   {
     MutexLock lock(cfg_mu_);
-    next_.clear();
-    prev_.clear();
+    flat_.clear();
+    intra_.clear();
+    inter_.clear();
     listener_.reset();
     rank_ = rank;
     world_size_ = world_size;
     stripes_ = stripes;
-    const char* cap = std::getenv("TORCHFT_HC_WIRE_CAP_MBPS");
-    wire_cap_bps_ =
-        cap ? static_cast<int64_t>(std::atof(cap) * (1 << 20)) : 0;
-    scratch_.assign(stripes, StripeScratch{});  // fresh pace state per ring
+    stripes_inter_ = stripes_inter;
+    hier_ = hier;
+    // Per-connection send caps, per tier: the main knob paces the
+    // slow/wide-area links (the flat ring's edges, the inter hop), the
+    // intra knob optionally paces the fast in-region links (0 = unpaced
+    // — the default, and what the fast-intra/slow-inter emulation in
+    // bench_overlap --hier-sweep relies on). Snapshotted here so the
+    // knobs are stable for the lifetime of a ring.
+    const int64_t cap_main =
+        cap_to_bps(std::getenv("TORCHFT_HC_WIRE_CAP_MBPS"));
+    const int64_t cap_intra =
+        cap_to_bps(std::getenv("TORCHFT_HC_WIRE_CAP_INTRA_MBPS"));
+    auto init_tier = [](RingTier& T, int64_t trank, int64_t tworld,
+                        int64_t conns, int64_t cap) {
+      T.rank = trank;
+      T.world = tworld;
+      T.conns = conns;
+      T.cap_bps = cap;
+      T.scratch.assign(conns, StripeScratch{});
+      for (auto& sc : T.scratch) sc.cap_bps = cap;
+    };
+    init_tier(flat_, rank, world_size, stripes, cap_main);
+    if (hier) {
+      init_tier(intra_, intra_rank, intra_world, stripes, cap_intra);
+      // Non-leaders never touch the inter ring; world stays 0 there so
+      // op bodies can branch on it uniformly.
+      init_tier(inter_, inter_rank, is_leader ? inter_world : 0,
+                stripes_inter, cap_main);
+    }
     aborted_ = true;
     epoch = abort_epoch_;
     if (world_size == 1) {
@@ -242,7 +348,7 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   }
 
   // Phase 2 (no locks held, every step deadline-bounded): rendezvous through
-  // the store and wire the ring. Both neighbors dial concurrently; connect()
+  // the store and wire the rings. All neighbors dial concurrently; connect()
   // lands in the peer's listen backlog, so no accept ordering is needed.
   int64_t deadline = now_ms() + timeout_ms;
   auto [kv_addr, prefix] = split_store_addr(store_addr);
@@ -253,61 +359,101 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   store.set(prefix + "/hc_addr_" + std::to_string(rank), my_addr,
             remain_or_throw(deadline));
 
-  int64_t next_rank = (rank + 1) % world_size;
-  std::string next_addr =
-      store.get(prefix + "/hc_addr_" + std::to_string(next_rank),
-                remain_or_throw(deadline));
-
-  // Dial the next rank once per stripe; the hello names the stripe slot so
-  // the peer can place accepted connections regardless of arrival order,
-  // and carries the stripe COUNT so a config mismatch that slipped past the
-  // store-level negotiation still fails at connect, not mid-op.
-  std::vector<Socket> next_socks(stripes);
-  for (int64_t s = 0; s < stripes; s++) {
-    next_socks[s] = connect_with_retry(next_addr, remain_or_throw(deadline));
-    uint32_t hello[4] = {kHelloMagic, static_cast<uint32_t>(rank),
-                         static_cast<uint32_t>(s),
-                         static_cast<uint32_t>(stripes)};
-    next_socks[s].send_all(hello, sizeof(hello), deadline);
+  // (tier, next global rank, prev global rank, connection count) of every
+  // ring this member participates in.
+  struct TierPlanEntry {
+    uint32_t tier;
+    int64_t next_rank;
+    int64_t prev_rank;
+    int64_t conns;
+    std::vector<Socket> next;
+    std::vector<Socket> prev;
+  };
+  std::vector<TierPlanEntry> tiers;
+  tiers.push_back({kTierFlat, (rank + 1) % world_size,
+                   (rank - 1 + world_size) % world_size, stripes, {}, {}});
+  if (hier && intra_world > 1) {
+    tiers.push_back(
+        {kTierIntra, intra_members[(intra_rank + 1) % intra_world],
+         intra_members[(intra_rank - 1 + intra_world) % intra_world],
+         stripes, {}, {}});
+  }
+  if (is_leader && inter_world > 1) {
+    tiers.push_back({kTierInter, leaders[(inter_rank + 1) % inter_world],
+                     leaders[(inter_rank - 1 + inter_world) % inter_world],
+                     stripes_inter, {}, {}});
   }
 
-  std::vector<Socket> prev_socks(stripes);
-  int64_t prev_rank = (rank - 1 + world_size) % world_size;
-  for (int64_t i = 0; i < stripes; i++) {
+  // Dial every tier's next member once per stripe; the hello names the
+  // (tier, stripe) slot so the peer can place accepted connections
+  // regardless of arrival order, and carries the stripe COUNT so a config
+  // mismatch that slipped past the store-level negotiation still fails at
+  // connect, not mid-op.
+  for (auto& tp : tiers) {
+    std::string next_addr =
+        store.get(prefix + "/hc_addr_" + std::to_string(tp.next_rank),
+                  remain_or_throw(deadline));
+    tp.next.resize(tp.conns);
+    for (int64_t s = 0; s < tp.conns; s++) {
+      tp.next[s] = connect_with_retry(next_addr, remain_or_throw(deadline));
+      uint32_t hello[5] = {kHelloMagic, static_cast<uint32_t>(rank),
+                           static_cast<uint32_t>(s),
+                           static_cast<uint32_t>(tp.conns), tp.tier};
+      tp.next[s].send_all(hello, sizeof(hello), deadline);
+    }
+    tp.prev.resize(tp.conns);
+  }
+
+  int64_t expected = 0;
+  for (auto& tp : tiers) expected += tp.conns;
+  for (int64_t i = 0; i < expected; i++) {
     Socket sock = listener_->accept(deadline);
     if (!sock.valid()) throw SocketError("listener closed during configure");
-    uint32_t peer_hello[4];
+    uint32_t peer_hello[5];
     sock.recv_all(peer_hello, sizeof(peer_hello), deadline);
     if (peer_hello[0] != kHelloMagic)
       throw SocketError(
           "ring handshake: wire-protocol mismatch (peer binary speaks a "
           "different ring protocol revision)");
-    if (peer_hello[1] != static_cast<uint32_t>(prev_rank))
+    TierPlanEntry* tp = nullptr;
+    for (auto& cand : tiers)
+      if (cand.tier == peer_hello[4]) { tp = &cand; break; }
+    if (tp == nullptr)
+      throw SocketError(
+          "ring handshake: connection for a tier this rank does not "
+          "participate in (mismatched region maps?)");
+    if (peer_hello[1] != static_cast<uint32_t>(tp->prev_rank))
       throw SocketError("ring handshake: unexpected peer rank");
-    if (peer_hello[3] != static_cast<uint32_t>(stripes))
+    if (peer_hello[3] != static_cast<uint32_t>(tp->conns))
       throw SocketError(
           "ring handshake: stripe-count mismatch (this rank " +
-          std::to_string(stripes) + ", prev rank " +
+          std::to_string(tp->conns) + ", prev rank " +
           std::to_string(peer_hello[3]) +
           " — all members must configure the same stripes)");
     uint32_t slot = peer_hello[2];
-    if (slot >= static_cast<uint32_t>(stripes) || prev_socks[slot].valid())
+    if (slot >= static_cast<uint32_t>(tp->conns) || tp->prev[slot].valid())
       throw SocketError("ring handshake: bad or duplicate stripe index");
-    prev_socks[slot] = std::move(sock);
+    tp->prev[slot] = std::move(sock);
   }
 
-  // Phase 3: publish the new ring unless an abort raced in.
+  // Phase 3: publish the new rings unless an abort raced in.
   MutexLock lock(cfg_mu_);
   if (abort_epoch_ != epoch) throw SocketError("aborted during configure");
-  next_ = std::move(next_socks);
-  prev_ = std::move(prev_socks);
+  for (auto& tp : tiers) {
+    RingTier& T = tp.tier == kTierFlat ? flat_
+                  : tp.tier == kTierIntra ? intra_
+                                          : inter_;
+    T.next = std::move(tp.next);
+    T.prev = std::move(tp.prev);
+  }
   aborted_ = false;
 }
 
 void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
                              size_t send_len, char* recv_buf, size_t recv_len,
-                             int64_t deadline_ms, PaceState* pace) {
-  const double bps = static_cast<double>(wire_cap_bps_);
+                             int64_t deadline_ms, StripeScratch* sc) {
+  const double bps = sc ? static_cast<double>(sc->cap_bps) : 0.0;
+  PaceState* pace = sc ? &sc->pace : nullptr;
   // Burst = 20 ms of credit (floor 64 KB): small enough that the realized
   // rate tracks the cap within any measurement window, large enough that a
   // chunk-sized write needs one send call.
@@ -372,6 +518,9 @@ void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
       if (w > 0) {
         sent += static_cast<size_t>(w);
         if (pace && bps > 0) pace->tokens -= static_cast<double>(w);
+        // Per-connection tx accounting (the hierarchical per-tier byte
+        // bill sums these): bytes actually handed to the kernel.
+        if (sc) sc->tx_bytes += w;
       } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
                  errno != EINTR) {
         throw SocketError(std::string("ring send: ") + strerror(errno));
@@ -391,25 +540,26 @@ void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
   }
 }
 
-void HostCollectives::check_op_header(uint32_t kind, uint64_t count,
-                                      uint32_t dtype, uint32_t op,
-                                      int64_t deadline_ms) {
+void HostCollectives::check_op_header(RingTier& T, uint32_t kind,
+                                      uint64_t count, uint32_t dtype,
+                                      uint32_t op, int64_t deadline_ms) {
   // One tiny duplex exchange describing the op each neighbor is about to
   // run. A mismatched op (different tree sizes, dtypes, or op kinds on
   // different members) otherwise DEADLOCKS silently: the small member
   // finishes, stops reading, and the large member blocks forever once
   // kernel buffers fill. ~20 bytes per collective — noise next to any
   // payload — converts that into an immediate, descriptive error. Runs on
-  // stripe 0 (the stripe COUNT is already pinned at connect time by the
-  // hello, so one stripe's agreement covers the schedule).
+  // stripe 0 of the tier (the stripe COUNT is already pinned at connect
+  // time by the hello, so one stripe's agreement covers the schedule);
+  // hierarchical ops run it once per tier they touch.
   struct Header {
     uint32_t magic, kind;
     uint64_t count;
     uint32_t dtype, op;
   } mine{kOpMagic, kind, count, dtype, op}, theirs{};
-  duplex(next_[0], prev_[0], reinterpret_cast<const char*>(&mine),
+  duplex(T.next[0], T.prev[0], reinterpret_cast<const char*>(&mine),
          sizeof(mine), reinterpret_cast<char*>(&theirs), sizeof(theirs),
-         deadline_ms);
+         deadline_ms, &T.scratch[0]);
   if (theirs.magic != kOpMagic)
     throw SocketError("ring op header corrupt (protocol desync)");
   if (theirs.kind != mine.kind || theirs.count != mine.count ||
@@ -508,50 +658,49 @@ void HostCollectives::pool_main(int64_t idx, int64_t start_gen) {
   }
 }
 
-void HostCollectives::rs_phase_stripe(int64_t s, char* bytes, size_t count,
-                                      size_t esize, Dtype dtype, ReduceOp op,
-                                      int64_t deadline) {
-  size_t max_chunk = count / world_size_ + 1;
-  std::vector<char>& recv_tmp = scratch_[s].recv;
+void HostCollectives::rs_phase_stripe(RingTier& T, int64_t s, char* bytes,
+                                      size_t count, size_t esize, Dtype dtype,
+                                      ReduceOp op, int64_t deadline) {
+  size_t max_chunk = count / T.world + 1;
+  std::vector<char>& recv_tmp = T.scratch[s].recv;
   if (recv_tmp.size() < max_chunk * esize) recv_tmp.resize(max_chunk * esize);
 
   // Reduce-scatter: after step t, chunk (rank - t) has accumulated the
   // values of ranks rank-t..rank. After ws-1 steps chunk (rank+1) holds the
   // full reduction at this rank — computed in the identical rank order
   // everywhere.
-  for (int64_t t = 0; t < world_size_ - 1; t++) {
-    int64_t send_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
-    int64_t recv_c =
-        ((rank_ - t - 1) % world_size_ + world_size_) % world_size_;
-    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-    duplex(next_[s], prev_[s], bytes + s_start * esize, s_len * esize,
-           recv_tmp.data(), r_len * esize, deadline, &scratch_[s].pace);
+  for (int64_t t = 0; t < T.world - 1; t++) {
+    int64_t send_c = ((T.rank - t) % T.world + T.world) % T.world;
+    int64_t recv_c = ((T.rank - t - 1) % T.world + T.world) % T.world;
+    auto [s_start, s_len] = chunk_range(count, T.world, send_c);
+    auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
+    duplex(T.next[s], T.prev[s], bytes + s_start * esize, s_len * esize,
+           recv_tmp.data(), r_len * esize, deadline, &T.scratch[s]);
     reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
   }
 }
 
-void HostCollectives::ag_phase_stripe(int64_t s, char* bytes, size_t count,
-                                      size_t esize, int64_t deadline) {
+void HostCollectives::ag_phase_stripe(RingTier& T, int64_t s, char* bytes,
+                                      size_t count, size_t esize,
+                                      int64_t deadline) {
   // Allgather: circulate the owned chunks, starting from (rank + 1) —
   // the chunk the reduce-scatter phase leaves fully reduced here.
-  for (int64_t t = 0; t < world_size_ - 1; t++) {
-    int64_t send_c =
-        ((rank_ + 1 - t) % world_size_ + world_size_) % world_size_;
-    int64_t recv_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
-    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
-    duplex(next_[s], prev_[s], bytes + s_start * esize, s_len * esize,
+  for (int64_t t = 0; t < T.world - 1; t++) {
+    int64_t send_c = ((T.rank + 1 - t) % T.world + T.world) % T.world;
+    int64_t recv_c = ((T.rank - t) % T.world + T.world) % T.world;
+    auto [s_start, s_len] = chunk_range(count, T.world, send_c);
+    auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
+    duplex(T.next[s], T.prev[s], bytes + s_start * esize, s_len * esize,
            bytes + r_start * esize, r_len * esize, deadline,
-           &scratch_[s].pace);
+           &T.scratch[s]);
   }
 }
 
-void HostCollectives::allreduce_stripe(int64_t s, char* bytes, size_t count,
-                                       size_t esize, Dtype dtype, ReduceOp op,
-                                       int64_t deadline) {
-  rs_phase_stripe(s, bytes, count, esize, dtype, op, deadline);
-  ag_phase_stripe(s, bytes, count, esize, deadline);
+void HostCollectives::allreduce_stripe(RingTier& T, int64_t s, char* bytes,
+                                       size_t count, size_t esize, Dtype dtype,
+                                       ReduceOp op, int64_t deadline) {
+  rs_phase_stripe(T, s, bytes, count, esize, dtype, op, deadline);
+  ag_phase_stripe(T, s, bytes, count, esize, deadline);
 }
 
 void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
@@ -563,7 +712,7 @@ void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
     // header exchanged even for count==0: an empty-vs-nonempty mismatch
     // must error, not hang the nonempty member
-    check_op_header(0, count, static_cast<uint32_t>(dtype),
+    check_op_header(flat_, 0, count, static_cast<uint32_t>(dtype),
                     static_cast<uint32_t>(op), deadline);
     if (count == 0) return;
     char* bytes = static_cast<char*>(data);
@@ -573,7 +722,7 @@ void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
     run_striped([&](int64_t s) {
       auto [start, len] = stripe_range(count, eff, s);
       if (len == 0) return;
-      allreduce_stripe(s, bytes + start * esize, len, esize, dtype, op,
+      allreduce_stripe(flat_, s, bytes + start * esize, len, esize, dtype, op,
                        deadline);
     });
   });
@@ -624,60 +773,63 @@ void q8_decode(const char* wire, size_t len, float* dst, bool accumulate) {
 
 }  // namespace
 
-void HostCollectives::rs_q8_phase_stripe(int64_t s, float* data, size_t count,
-                                         int64_t deadline) {
-  size_t max_chunk = count / world_size_ + 1;
+void HostCollectives::rs_q8_phase_stripe(RingTier& T, int64_t s, float* data,
+                                         size_t count, int64_t deadline) {
+  size_t max_chunk = count / T.world + 1;
   size_t max_wire = sizeof(float) + max_chunk;
-  std::vector<char>& send_wire = scratch_[s].send;
-  std::vector<char>& recv_wire = scratch_[s].recv;
+  std::vector<char>& send_wire = T.scratch[s].send;
+  std::vector<char>& recv_wire = T.scratch[s].recv;
   if (send_wire.size() < max_wire) send_wire.resize(max_wire);
   if (recv_wire.size() < max_wire) recv_wire.resize(max_wire);
 
   // Reduce-scatter: each hop quantizes its CURRENT partial sum of the
   // outgoing chunk and dequant-accumulates the incoming one in f32.
-  for (int64_t t = 0; t < world_size_ - 1; t++) {
-    int64_t send_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
-    int64_t recv_c =
-        ((rank_ - t - 1) % world_size_ + world_size_) % world_size_;
-    auto [s_start, s_len] = chunk_range(count, world_size_, send_c);
-    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+  for (int64_t t = 0; t < T.world - 1; t++) {
+    int64_t send_c = ((T.rank - t) % T.world + T.world) % T.world;
+    int64_t recv_c = ((T.rank - t - 1) % T.world + T.world) % T.world;
+    auto [s_start, s_len] = chunk_range(count, T.world, send_c);
+    auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
     q8_encode(data + s_start, s_len, send_wire.data());
-    duplex(next_[s], prev_[s], send_wire.data(), sizeof(float) + s_len,
+    duplex(T.next[s], T.prev[s], send_wire.data(), sizeof(float) + s_len,
            recv_wire.data(), sizeof(float) + r_len, deadline,
-           &scratch_[s].pace);
+           &T.scratch[s]);
     q8_decode(recv_wire.data(), r_len, data + r_start, /*accumulate=*/true);
   }
 }
 
-void HostCollectives::allreduce_q8_stripe(int64_t s, float* data, size_t count,
-                                          int64_t deadline) {
-  rs_q8_phase_stripe(s, data, count, deadline);
+void HostCollectives::ag_q8_phase_stripe(RingTier& T, int64_t s, float* data,
+                                         size_t count, int64_t deadline) {
   // Allgather: the OWNER quantizes its fully-reduced chunk exactly once
   // (first send); every later hop forwards the received wire bytes
   // verbatim, so all members decode identical codes — the reduced
   // values stay bit-identical across ranks (the determinism oracle).
-  std::vector<std::vector<char>>& stored = scratch_[s].stored;
-  stored.resize(world_size_);
+  std::vector<std::vector<char>>& stored = T.scratch[s].stored;
+  stored.resize(T.world);
   {
-    int64_t own_c = (rank_ + 1) % world_size_;
-    auto [o_start, o_len] = chunk_range(count, world_size_, own_c);
+    int64_t own_c = (T.rank + 1) % T.world;
+    auto [o_start, o_len] = chunk_range(count, T.world, own_c);
     stored[own_c].resize(sizeof(float) + o_len);
     q8_encode(data + o_start, o_len, stored[own_c].data());
     // decode own chunk too: every member must hold the DECODED codes,
     // not its higher-precision f32 partial (bit-identity across ranks)
     q8_decode(stored[own_c].data(), o_len, data + o_start, false);
   }
-  for (int64_t t = 0; t < world_size_ - 1; t++) {
-    int64_t send_c =
-        ((rank_ + 1 - t) % world_size_ + world_size_) % world_size_;
-    int64_t recv_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
-    auto [r_start, r_len] = chunk_range(count, world_size_, recv_c);
+  for (int64_t t = 0; t < T.world - 1; t++) {
+    int64_t send_c = ((T.rank + 1 - t) % T.world + T.world) % T.world;
+    int64_t recv_c = ((T.rank - t) % T.world + T.world) % T.world;
+    auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
     stored[recv_c].resize(sizeof(float) + r_len);
-    duplex(next_[s], prev_[s], stored[send_c].data(), stored[send_c].size(),
+    duplex(T.next[s], T.prev[s], stored[send_c].data(), stored[send_c].size(),
            stored[recv_c].data(), stored[recv_c].size(), deadline,
-           &scratch_[s].pace);
+           &T.scratch[s]);
     q8_decode(stored[recv_c].data(), r_len, data + r_start, false);
   }
+}
+
+void HostCollectives::allreduce_q8_stripe(RingTier& T, int64_t s, float* data,
+                                          size_t count, int64_t deadline) {
+  rs_q8_phase_stripe(T, s, data, count, deadline);
+  ag_q8_phase_stripe(T, s, data, count, deadline);
 }
 
 void HostCollectives::allreduce_q8(float* data, size_t count,
@@ -689,7 +841,7 @@ void HostCollectives::allreduce_q8(float* data, size_t count,
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
     // distinct kind: a q8 op meeting a plain allreduce must error, not
     // desync (their wire framings differ even at equal counts)
-    check_op_header(4, count, /*dtype=*/100, /*op=*/0, deadline);
+    check_op_header(flat_, 4, count, /*dtype=*/100, /*op=*/0, deadline);
     if (count == 0) return;
     // ~1 wire byte per f32 element (int8 codes + per-chunk scales)
     int64_t eff = effective_stripes(count, stripes_);
@@ -697,7 +849,7 @@ void HostCollectives::allreduce_q8(float* data, size_t count,
     run_striped([&](int64_t s) {
       auto [start, len] = stripe_range(count, eff, s);
       if (len == 0) return;
-      allreduce_q8_stripe(s, data + start, len, deadline);
+      allreduce_q8_stripe(flat_, s, data + start, len, deadline);
     });
   });
 }
@@ -711,7 +863,7 @@ void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
   if (world_size_ == 1) return;
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
-    check_op_header(1, nbytes, 0, 0, deadline);
+    check_op_header(flat_, 1, nbytes, 0, 0, deadline);
     if (nbytes == 0) return;
     int64_t eff = effective_stripes(nbytes, stripes_);
     last_stripe_ns_.assign(eff, 0);
@@ -722,9 +874,9 @@ void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
         int64_t send_c = ((rank_ - t) % world_size_ + world_size_) % world_size_;
         int64_t recv_c =
             ((rank_ - t - 1) % world_size_ + world_size_) % world_size_;
-        duplex(next_[st], prev_[st], slots + send_c * nbytes + off, len,
-               slots + recv_c * nbytes + off, len, deadline,
-               &scratch_[st].pace);
+        duplex(flat_.next[st], flat_.prev[st], slots + send_c * nbytes + off,
+               len, slots + recv_c * nbytes + off, len, deadline,
+               &flat_.scratch[st]);
       }
     });
   });
@@ -781,7 +933,7 @@ void HostCollectives::reduce_scatter(void* data, size_t count, Dtype dtype,
     // The layout rides the header's op slot: a reduce_scatter meeting a
     // differently-partitioned one must error, not scatter to the wrong
     // shard boundaries (ReduceOp fits in the low byte).
-    check_op_header(5, count, static_cast<uint32_t>(dtype),
+    check_op_header(flat_, 5, count, static_cast<uint32_t>(dtype),
                     static_cast<uint32_t>(op) |
                         (static_cast<uint32_t>(eff) << 8),
                     deadline);
@@ -791,7 +943,7 @@ void HostCollectives::reduce_scatter(void* data, size_t count, Dtype dtype,
     run_striped([&](int64_t s) {
       auto [start, len] = stripe_range(count, eff, s);
       if (len == 0) return;
-      rs_phase_stripe(s, bytes + start * esize, len, esize, dtype, op,
+      rs_phase_stripe(flat_, s, bytes + start * esize, len, esize, dtype, op,
                       deadline);
     });
     copy_shard(bytes, static_cast<char*>(shard_out), count, esize, eff,
@@ -815,21 +967,21 @@ void HostCollectives::reduce_scatter_q8(float* data, size_t count,
     int64_t eff = layout_stripes > 0
                       ? std::min(layout_stripes, stripes_)
                       : effective_stripes(count, stripes_);
-    check_op_header(7, count, /*dtype=*/100,
+    check_op_header(flat_, 7, count, /*dtype=*/100,
                     static_cast<uint32_t>(eff) << 8, deadline);
     if (count == 0) return;
     last_stripe_ns_.assign(eff, 0);
     run_striped([&](int64_t s) {
       auto [start, len] = stripe_range(count, eff, s);
       if (len == 0) return;
-      rs_q8_phase_stripe(s, data + start, len, deadline);
+      rs_q8_phase_stripe(flat_, s, data + start, len, deadline);
       if (grid_shard) {
         // Reproduce the fused op's phase-2 owner quantize+decode so the
         // shard sits on the same int8 grid the fused allreduce returns.
         int64_t own_c = (rank_ + 1) % world_size_;
         auto [cs, cl] = chunk_range(len, world_size_, own_c);
         if (cl) {
-          std::vector<char>& wire = scratch_[s].send;
+          std::vector<char>& wire = flat_.scratch[s].send;
           if (wire.size() < sizeof(float) + cl)
             wire.resize(sizeof(float) + cl);
           q8_encode(data + start + cs, cl, wire.data());
@@ -859,7 +1011,7 @@ void HostCollectives::allgather_into(const void* shard, void* data,
     int64_t eff = layout_stripes > 0
                       ? std::min(layout_stripes, stripes_)
                       : effective_stripes(count * esize, stripes_);
-    check_op_header(6, count, static_cast<uint32_t>(dtype),
+    check_op_header(flat_, 6, count, static_cast<uint32_t>(dtype),
                     static_cast<uint32_t>(eff) << 8, deadline);
     if (count == 0) return;
     char* bytes = static_cast<char*>(data);
@@ -869,19 +1021,256 @@ void HostCollectives::allgather_into(const void* shard, void* data,
     run_striped([&](int64_t s) {
       auto [start, len] = stripe_range(count, eff, s);
       if (len == 0) return;
-      ag_phase_stripe(s, bytes + start * esize, len, esize, deadline);
+      ag_phase_stripe(flat_, s, bytes + start * esize, len, esize, deadline);
     });
   });
+}
+
+// ---- hierarchical (two-tier) schedule ----
+
+void HostCollectives::bcast_pipe_stripe(RingTier& T, int64_t s, char* bytes,
+                                        size_t nbytes, int64_t root,
+                                        int64_t deadline) {
+  if (T.world <= 1 || nbytes == 0) return;
+  int64_t d = ((T.rank - root) % T.world + T.world) % T.world;
+  // Chunk-pipelined store-and-forward: member d forwards chunk c-1 while
+  // receiving chunk c (duplex pumps both directions), so the wall is
+  // ~bytes/bw + (world-1) chunk fills instead of (world-1) * bytes/bw.
+  // The chunk count is a pure function of nbytes — identical everywhere.
+  int64_t k = std::min<int64_t>(16, std::max<int64_t>(
+                                        1, static_cast<int64_t>(
+                                               nbytes / (256 << 10))));
+  const bool fwd = d + 1 < T.world;  // the last member's next IS the root
+  for (int64_t c = 0; c < k; c++) {
+    auto [cs, cl] = chunk_range(nbytes, k, c);
+    if (d == 0) {
+      duplex(T.next[s], T.prev[s], bytes + cs, cl, nullptr, 0, deadline,
+             &T.scratch[s]);
+    } else {
+      const char* sbuf = nullptr;
+      size_t slen = 0;
+      if (fwd && c > 0) {
+        auto [ps, pl] = chunk_range(nbytes, k, c - 1);
+        sbuf = bytes + ps;
+        slen = pl;
+      }
+      duplex(T.next[s], T.prev[s], sbuf, slen, bytes + cs, cl, deadline,
+             &T.scratch[s]);
+    }
+  }
+  if (d > 0 && fwd) {
+    auto [ps, pl] = chunk_range(nbytes, k, k - 1);
+    duplex(T.next[s], T.prev[s], bytes + ps, pl, nullptr, 0, deadline,
+           &T.scratch[s]);
+  }
+}
+
+void HostCollectives::inter_ring_phase(HierWire wire, char* buf, size_t count,
+                                       size_t esize, Dtype dtype, ReduceOp op,
+                                       int64_t eff_inter, int64_t deadline,
+                                       int64_t* rs_tx) {
+  // Two explicit ring phases (the same rs/ag bodies the flat ring uses)
+  // so the per-phase slow-link bill — (L-1)/L of the wire payload each
+  // way — is measured separately.
+  const int64_t tx0 = tier_tx(inter_);
+  if (wire == HierWire::kQ8) {
+    float* f = reinterpret_cast<float*>(buf);
+    last_stripe_ns_.assign(eff_inter, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_inter, s);
+      if (len == 0) return;
+      rs_q8_phase_stripe(inter_, s, f + start, len, deadline);
+    });
+    *rs_tx = tier_tx(inter_) - tx0;
+    last_stripe_ns_.assign(eff_inter, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_inter, s);
+      if (len == 0) return;
+      ag_q8_phase_stripe(inter_, s, f + start, len, deadline);
+    });
+  } else if (wire == HierWire::kBF16) {
+    // Leaders round the f32 payload to bf16 ONCE, ride the slow hop at
+    // half width (per-hop f32 math, RNE back — the native bf16 ring
+    // body), and decode; quantization noise is paid exactly once, on
+    // the link that needs it, and all leaders decode identical words.
+    if (hier_wire_buf_.size() < count * 2) hier_wire_buf_.resize(count * 2);
+    uint16_t* w = reinterpret_cast<uint16_t*>(hier_wire_buf_.data());
+    const float* f = reinterpret_cast<const float*>(buf);
+    for (size_t i = 0; i < count; i++) w[i] = f32_to_bf16(f[i]);
+    char* wb = hier_wire_buf_.data();
+    last_stripe_ns_.assign(eff_inter, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_inter, s);
+      if (len == 0) return;
+      rs_phase_stripe(inter_, s, wb + start * 2, len, 2, Dtype::kBF16,
+                      ReduceOp::kSum, deadline);
+    });
+    *rs_tx = tier_tx(inter_) - tx0;
+    last_stripe_ns_.assign(eff_inter, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_inter, s);
+      if (len == 0) return;
+      ag_phase_stripe(inter_, s, wb + start * 2, len, 2, deadline);
+    });
+    float* out = reinterpret_cast<float*>(buf);
+    for (size_t i = 0; i < count; i++) out[i] = bf16_to_f32(w[i]);
+  } else {
+    last_stripe_ns_.assign(eff_inter, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_inter, s);
+      if (len == 0) return;
+      rs_phase_stripe(inter_, s, buf + start * esize, len, esize, dtype, op,
+                      deadline);
+    });
+    *rs_tx = tier_tx(inter_) - tx0;
+    last_stripe_ns_.assign(eff_inter, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_inter, s);
+      if (len == 0) return;
+      ag_phase_stripe(inter_, s, buf + start * esize, len, esize, deadline);
+    });
+  }
+}
+
+void HostCollectives::hier_schedule(char* bytes, size_t count, size_t esize,
+                                    Dtype dtype, ReduceOp op, HierWire wire,
+                                    int64_t eff_intra, int64_t eff_inter,
+                                    int64_t deadline) {
+  using clock = std::chrono::steady_clock;
+  const bool leader = intra_.world <= 1 || intra_.rank == 0;
+
+  // Phase 1 — intra reduce-scatter: member shards of the REGION sum, on
+  // the fast links, spreading reduction bandwidth and compute.
+  auto t0 = clock::now();
+  if (intra_.world > 1) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_intra, s);
+      if (len == 0) return;
+      rs_phase_stripe(intra_, s, bytes + start * esize, len, esize, dtype,
+                      op, deadline);
+    });
+  }
+  // Phase 2 — intra allgather: delivers the full region sum to the LEADER
+  // (on a ring, gather-to-one costs the same edges as gather-to-all).
+  auto t1 = clock::now();
+  if (intra_.world > 1) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_intra, s);
+      if (len == 0) return;
+      ag_phase_stripe(intra_, s, bytes + start * esize, len, esize, deadline);
+    });
+  }
+  // Phase 3 — inter ring among leaders: the ONLY bytes on the slow links
+  // ((L-1)/L of the wire payload per phase, measured into rs_tx/the
+  // counter delta by the shared inter_ring_phase body).
+  auto t2 = clock::now();
+  const int64_t inter_tx0 = tier_tx(inter_);
+  int64_t inter_rs_tx = 0;
+  if (leader && inter_.world > 1)
+    inter_ring_phase(wire, bytes, count, esize, dtype, op, eff_inter,
+                     deadline, &inter_rs_tx);
+  // Phase 4 — chunk-pipelined intra broadcast of the leader's result:
+  // every member adopts the leader's bytes VERBATIM, and leaders are
+  // bit-identical across regions (ring determinism), so the global
+  // result is bit-identical on every member.
+  auto t3 = clock::now();
+  if (intra_.world > 1) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_intra, s);
+      if (len == 0) return;
+      bcast_pipe_stripe(intra_, s, bytes + start * esize, len * esize, 0,
+                        deadline);
+    });
+  }
+  auto t4 = clock::now();
+  last_hier_.intra_rs_ns += ns_between(t0, t1);
+  last_hier_.intra_ag_ns += ns_between(t1, t2);
+  last_hier_.inter_ring_ns += ns_between(t2, t3);
+  last_hier_.intra_bcast_ns += ns_between(t3, t4);
+  last_hier_.inter_rs_tx_bytes += inter_rs_tx;
+  last_hier_.inter_ag_tx_bytes += tier_tx(inter_) - inter_tx0 - inter_rs_tx;
+}
+
+void HostCollectives::allreduce_hier(void* data, size_t count, Dtype dtype,
+                                     ReduceOp op, HierWire wire,
+                                     int64_t timeout_ms) {
+  MutexLock lock(op_mu_);
+  if (aborted_) throw SocketError("collectives not configured");
+  last_hier_ = HierStats{};
+  last_hier_.wire = static_cast<int>(wire);
+  if (world_size_ == 1) return;
+  if (!hier_)
+    throw SocketError(
+        "two-tier schedule unavailable: configure() was not given a region "
+        "map with >= 2 distinct labels (single-region cohort or unlabeled "
+        "members ride the flat ring)");
+  if (wire != HierWire::kNone &&
+      (dtype != Dtype::kF32 || op != ReduceOp::kSum))
+    throw SocketError("hier wire bf16/q8 takes f32 payloads and SUM only");
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    size_t esize = dtype_size(dtype);
+    size_t inter_esize = wire == HierWire::kQ8 ? 1
+                         : wire == HierWire::kBF16 ? 2
+                                                   : esize;
+    int64_t eff_intra = effective_stripes(count * esize, stripes_);
+    int64_t eff_inter = effective_stripes(count * inter_esize, stripes_inter_);
+    reset_tier_tx(intra_);
+    reset_tier_tx(inter_);
+    // Both effective stripe counts and the wire ride the header's op slot:
+    // every member derives them from negotiated inputs, but a drifted knob
+    // must error, not desync two tiers' schedules.
+    uint32_t opword = static_cast<uint32_t>(op) |
+                      (static_cast<uint32_t>(wire) << 4) |
+                      (static_cast<uint32_t>(eff_intra) << 8) |
+                      (static_cast<uint32_t>(eff_inter) << 16);
+    if (intra_.world > 1)
+      check_op_header(intra_, 9, count, static_cast<uint32_t>(dtype), opword,
+                      deadline);
+    const bool leader = intra_.world <= 1 || intra_.rank == 0;
+    if (leader && inter_.world > 1)
+      check_op_header(inter_, 9, count, static_cast<uint32_t>(dtype), opword,
+                      deadline);
+    if (count == 0) return;
+    last_hier_.payload_bytes = static_cast<int64_t>(count * esize);
+    last_hier_.eff_intra = eff_intra;
+    last_hier_.eff_inter = eff_inter;
+    last_hier_.intra_world = intra_.world;
+    last_hier_.inter_world = leader ? inter_.world : 0;
+    last_hier_.leader = leader;
+    hier_schedule(static_cast<char*>(data), count, esize, dtype, op, wire,
+                  eff_intra, eff_inter, deadline);
+    last_hier_.intra_tx_bytes = tier_tx(intra_);
+    last_hier_.inter_tx_bytes = tier_tx(inter_);
+  });
+}
+
+std::string HostCollectives::last_hier_json() const {
+  JsonObject o;
+  o["intra_rs_s"] = Json(last_hier_.intra_rs_ns / 1e9);
+  o["intra_ag_s"] = Json(last_hier_.intra_ag_ns / 1e9);
+  o["inter_ring_s"] = Json(last_hier_.inter_ring_ns / 1e9);
+  o["intra_bcast_s"] = Json(last_hier_.intra_bcast_ns / 1e9);
+  o["intra_tx_bytes"] = Json(last_hier_.intra_tx_bytes);
+  o["inter_tx_bytes"] = Json(last_hier_.inter_tx_bytes);
+  o["inter_rs_tx_bytes"] = Json(last_hier_.inter_rs_tx_bytes);
+  o["inter_ag_tx_bytes"] = Json(last_hier_.inter_ag_tx_bytes);
+  o["payload_bytes"] = Json(last_hier_.payload_bytes);
+  o["eff_intra"] = Json(last_hier_.eff_intra);
+  o["eff_inter"] = Json(last_hier_.eff_inter);
+  o["intra_world"] = Json(last_hier_.intra_world);
+  o["inter_world"] = Json(last_hier_.inter_world);
+  o["leader"] = Json(last_hier_.leader);
+  o["wire"] = Json(static_cast<int64_t>(last_hier_.wire));
+  return Json(std::move(o)).dump();
 }
 
 // ---- persistent comm plans ----
 
 namespace {
-
-int64_t ns_between(std::chrono::steady_clock::time_point a,
-                   std::chrono::steady_clock::time_point b) {
-  return std::chrono::duration_cast<std::chrono::nanoseconds>(b - a).count();
-}
 
 // Python-floor integer division (numpy's // semantics): C++ / truncates
 // toward zero, which would disagree with the legacy host path on
@@ -897,13 +1286,18 @@ T floor_div(T a, T d) {
 
 int64_t HostCollectives::plan_build(const int64_t* counts,
                                     const int32_t* dtypes, int64_t n_leaves,
-                                    PlanWire wire, bool prepacked) {
+                                    PlanWire wire, bool prepacked, bool hier) {
   if (world_size_ <= 0)
     throw SocketError("plan_build before configure (layout needs the ring)");
   if (n_leaves <= 0) throw SocketError("plan_build of an empty signature");
+  if (hier && prepacked)
+    throw SocketError(
+        "hier plans take no pre-packed leaves (the wire encoding happens at "
+        "the leader's inter hop, not at pack)");
   auto p = std::make_unique<CommPlan>();
   p->wire = wire;
   p->prepacked = prepacked;
+  p->hier = hier;
   p->leaves.resize(n_leaves);
   // FNV-1a over (wire, geometry, signature): exchanged in the execute
   // header so mismatched plans error instead of desyncing the ring.
@@ -917,6 +1311,13 @@ int64_t HostCollectives::plan_build(const int64_t* counts,
   mix(static_cast<uint64_t>(wire));
   mix(static_cast<uint64_t>(world_size_));
   mix(static_cast<uint64_t>(stripes_));
+  if (hier) {
+    // Hier plans bake in the two-tier geometry as well: a hier plan
+    // meeting a flat plan — or one built against a different inter
+    // stripe knob — must error at the header, not desync mid-payload.
+    mix(0x48494552ull /*"HIER"*/);
+    mix(static_cast<uint64_t>(stripes_inter_));
+  }
   const bool q8 = wire == PlanWire::kQ8 || wire == PlanWire::kQ8EF;
   for (int64_t i = 0; i < n_leaves; i++) {
     if (counts[i] < 0) throw SocketError("plan_build: negative leaf count");
@@ -933,7 +1334,10 @@ int64_t HostCollectives::plan_build(const int64_t* counts,
             "back to the legacy path for other dtypes)");
       gdt = Dtype::kF32;
     } else if (wire == PlanWire::kBF16) {
-      gdt = dt == Dtype::kF32 ? Dtype::kBF16 : dt;
+      // Hier: the wire applies at the INTER hop only — staging (and the
+      // intra ring) stays full-width native, the leader casts for the
+      // slow link. Flat: the whole ring rides the bf16 group.
+      gdt = (!hier && dt == Dtype::kF32) ? Dtype::kBF16 : dt;
     } else {
       gdt = dt;
     }
@@ -956,8 +1360,11 @@ int64_t HostCollectives::plan_build(const int64_t* counts,
     // The stripe partition IS the plan's bucket list, derived exactly
     // like the fused op derives it (q8 wires: ~1 byte/element) so the
     // ring arithmetic — chunk boundaries, q8 scales — matches the
-    // legacy single-op path bit for bit.
-    g.eff = effective_stripes(g.count * (q8 ? 1 : esize), stripes_);
+    // legacy single-op path bit for bit. Hier plans partition by the
+    // INTRA tier's full-width bytes (the intra ring is what streams per
+    // bucket; the inter hop re-stripes per phase at execute).
+    g.eff = effective_stripes(
+        g.count * (q8 && !hier ? 1 : esize), stripes_);
     g.staging.resize(g.count * esize);
     total_f32 += g.count;
   }
@@ -1004,6 +1411,7 @@ std::string HostCollectives::plan_stats_json(int64_t plan_id) {
   out["execs"] = Json(p.execs);
   out["wire"] = Json(static_cast<int64_t>(p.wire));
   out["prepacked"] = Json(static_cast<int64_t>(p.prepacked ? 1 : 0));
+  out["hier"] = Json(static_cast<int64_t>(p.hier ? 1 : 0));
   JsonArray buckets;
   for (const auto& st : p.stats) {
     JsonObject b;
@@ -1188,6 +1596,45 @@ void HostCollectives::plan_pack_ef(CommPlan& p, CommPlan::Group& g,
   }
 }
 
+void HostCollectives::plan_ef_inplace(CommPlan& p, CommPlan::Group& g) const {
+  // The hier kQ8EF step: identical arithmetic to plan_pack_ef, applied to
+  // the REGION SUM already sitting in staging (d = staging + residual).
+  // Runs at the LEADER only, just before the quantized inter hop — the
+  // carry refines this region's contribution window over window, and the
+  // expensive residual never rides the fast intra links at all.
+  float* stg = reinterpret_cast<float*>(g.staging.data());
+  for (size_t k = 0; k < g.leaf_idx.size(); k++) {
+    size_t off = g.leaf_off[k];
+    size_t n = p.leaves[g.leaf_idx[k]].count;
+    float* d = stg + off;
+    float* res = p.residual.data() + off;
+    for (size_t i = 0; i < n; i++) d[i] = d[i] + res[i];
+    float absmax = 0.f;
+    bool finite = true;
+    for (size_t i = 0; i < n; i++) {
+      float a = std::fabs(d[i]);
+      if (!std::isfinite(a)) finite = false;
+      absmax = std::max(absmax, a);
+    }
+    if (!finite) {
+      float nan = std::numeric_limits<float>::quiet_NaN();
+      for (size_t i = 0; i < n; i++) {
+        res[i] = nan;
+        d[i] = nan;
+      }
+      continue;
+    }
+    float scale = std::max(absmax / 127.0f, 1e-12f);
+    for (size_t i = 0; i < n; i++) {
+      float q = std::nearbyint(d[i] / scale);
+      q = std::max(-127.f, std::min(127.f, q));
+      float dq = q * scale;
+      res[i] = d[i] - dq;
+      d[i] = dq;
+    }
+  }
+}
+
 void HostCollectives::plan_pack_pre_range(const CommPlan& p,
                                           CommPlan::Group& g,
                                           const void* group_in,
@@ -1254,7 +1701,8 @@ void HostCollectives::plan_execute_pre(int64_t plan_id,
     // Same header as the host-pack execute (the hash excludes
     // `prepacked`): a device-packing member and a host-packing member of
     // one ring agree here and produce identical staging.
-    check_op_header(8, p.sig, static_cast<uint32_t>(p.wire), 0, deadline);
+    check_op_header(flat_, 8, p.sig, static_cast<uint32_t>(p.wire), 0,
+                    deadline);
     for (size_t gi = 0; gi < p.groups.size(); gi++) {
       CommPlan::Group& g = p.groups[gi];
       if (g.count == 0) continue;
@@ -1277,11 +1725,11 @@ void HostCollectives::plan_execute_pre(int64_t plan_id,
         auto t1 = std::chrono::steady_clock::now();
         if (q8) {
           allreduce_q8_stripe(
-              s, reinterpret_cast<float*>(g.staging.data()) + start, len,
-              deadline);
+              flat_, s, reinterpret_cast<float*>(g.staging.data()) + start,
+              len, deadline);
         } else {
-          allreduce_stripe(s, g.staging.data() + start * esize, len, esize,
-                           g.dtype, ReduceOp::kSum, deadline);
+          allreduce_stripe(flat_, s, g.staging.data() + start * esize, len,
+                           esize, g.dtype, ReduceOp::kSum, deadline);
         }
         auto t2 = std::chrono::steady_clock::now();
         plan_unpack_range(p, g, leaf_out, start, len, divisor, has_divisor);
@@ -1293,6 +1741,124 @@ void HostCollectives::plan_execute_pre(int64_t plan_id,
     }
   });
   p.execs++;
+}
+
+void HostCollectives::plan_execute_hier_group(CommPlan& p, size_t gi,
+                                              const void* const* leaf_in,
+                                              void* const* leaf_out,
+                                              double divisor, bool has_divisor,
+                                              int64_t deadline) {
+  CommPlan::Group& g = p.groups[gi];
+  if (g.count == 0) return;
+  size_t esize = dtype_size(g.dtype);
+  const bool q8 = p.wire == PlanWire::kQ8 || p.wire == PlanWire::kQ8EF;
+  // The plan wire applies at the inter hop, and only where it means
+  // something: q8 plans have a single f32 group; a bf16 plan's non-f32
+  // groups (ints, f64, native bf16) ride the inter ring at native width.
+  HierWire wire = HierWire::kNone;
+  if (g.dtype == Dtype::kF32) {
+    if (q8) wire = HierWire::kQ8;
+    else if (p.wire == PlanWire::kBF16) wire = HierWire::kBF16;
+  }
+  const int64_t eff_intra = g.eff;
+  const size_t inter_esize = wire == HierWire::kQ8 ? 1
+                             : wire == HierWire::kBF16 ? 2
+                                                       : esize;
+  const int64_t eff_inter =
+      effective_stripes(g.count * inter_esize, stripes_inter_);
+  const bool leader = intra_.world <= 1 || intra_.rank == 0;
+  char* stg = g.staging.data();
+
+  size_t stat_base = p.stats.size();
+  p.stats.resize(stat_base + eff_intra);
+  for (int64_t s = 0; s < eff_intra; s++) {
+    auto [start, len] = stripe_range(g.count, eff_intra, s);
+    p.stats[stat_base + s].group = static_cast<int64_t>(gi);
+    p.stats[stat_base + s].stripe = s;
+    p.stats[stat_base + s].bytes = static_cast<int64_t>(len * esize);
+  }
+
+  using clock = std::chrono::steady_clock;
+  auto t0 = clock::now();
+  // Phase 1 — pack fused into the intra reduce-scatter, per stripe bucket
+  // (bucket i+1 packs while bucket i rides its intra connection: the
+  // triple pipeline survives the extra tier).
+  if (intra_.world > 1) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, eff_intra, s);
+      if (len == 0) return;
+      auto p0 = clock::now();
+      plan_pack_range(p, g, leaf_in, start, len);
+      auto p1 = clock::now();
+      rs_phase_stripe(intra_, s, stg + start * esize, len, esize, g.dtype,
+                      ReduceOp::kSum, deadline);
+      auto p2 = clock::now();
+      CommPlan::BucketStat& st = p.stats[stat_base + s];
+      st.pack_ns = ns_between(p0, p1);
+      st.ring_ns += ns_between(p1, p2);
+    });
+  } else {
+    plan_pack_range(p, g, leaf_in, 0, g.count);
+  }
+  auto t1 = clock::now();
+  // Phase 2 — intra allgather: the leader ends with the full region sum.
+  if (intra_.world > 1) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, eff_intra, s);
+      if (len == 0) return;
+      auto p0 = clock::now();
+      ag_phase_stripe(intra_, s, stg + start * esize, len, esize, deadline);
+      p.stats[stat_base + s].ring_ns += ns_between(p0, clock::now());
+    });
+  }
+  auto t2 = clock::now();
+  const int64_t inter_tx0 = tier_tx(inter_);
+  int64_t inter_rs_tx = 0;
+  // Phase 3 — the leader's inter hop at the plan wire. kQ8EF first runs
+  // the per-leaf error-feedback quantization against the plan's residual
+  // — on the REGION SUM, at the leader, so the carry refines this
+  // region's contribution and quantization noise is paid exactly once.
+  if (leader && inter_.world > 1) {
+    if (p.wire == PlanWire::kQ8EF && wire == HierWire::kQ8)
+      plan_ef_inplace(p, g);
+    // The SAME inter-ring body the bulk op runs — a wire or accounting
+    // change can never desync the plan path from allreduce_hier.
+    inter_ring_phase(wire, stg, g.count, esize, g.dtype, ReduceOp::kSum,
+                     eff_inter, deadline, &inter_rs_tx);
+  }
+  auto t3 = clock::now();
+  // Phase 4 — broadcast the leader's result and unpack per stripe bucket
+  // (bucket i+1 still rides the intra ring while bucket i unpacks).
+  if (intra_.world > 1) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, eff_intra, s);
+      if (len == 0) return;
+      auto p0 = clock::now();
+      bcast_pipe_stripe(intra_, s, stg + start * esize, len * esize, 0,
+                        deadline);
+      auto p1 = clock::now();
+      plan_unpack_range(p, g, leaf_out, start, len, divisor, has_divisor);
+      auto p2 = clock::now();
+      CommPlan::BucketStat& st = p.stats[stat_base + s];
+      st.ring_ns += ns_between(p0, p1);
+      st.unpack_ns = ns_between(p1, p2);
+    });
+  } else {
+    plan_unpack_range(p, g, leaf_out, 0, g.count, divisor, has_divisor);
+  }
+  auto t4 = clock::now();
+  last_hier_.intra_rs_ns += ns_between(t0, t1);
+  last_hier_.intra_ag_ns += ns_between(t1, t2);
+  last_hier_.inter_ring_ns += ns_between(t2, t3);
+  last_hier_.intra_bcast_ns += ns_between(t3, t4);
+  last_hier_.inter_rs_tx_bytes += inter_rs_tx;
+  last_hier_.inter_ag_tx_bytes += tier_tx(inter_) - inter_tx0 - inter_rs_tx;
+  last_hier_.payload_bytes += static_cast<int64_t>(g.count * esize);
+  last_hier_.eff_intra = eff_intra;
+  last_hier_.eff_inter = eff_inter;
 }
 
 void HostCollectives::plan_execute(int64_t plan_id,
@@ -1307,11 +1873,13 @@ void HostCollectives::plan_execute(int64_t plan_id,
   p.stats.clear();
   const bool q8 = p.wire == PlanWire::kQ8 || p.wire == PlanWire::kQ8EF;
   if (world_size_ == 1) {
-    // Solo: pack -> identity -> unpack, so divisor and (for kQ8EF) the
-    // error-feedback state evolve exactly as they would in a ring —
-    // a member that later joins a cohort carries coherent state.
+    // Solo: pack -> identity -> unpack. Flat kQ8EF advances the
+    // error-feedback state exactly as it would in a ring (a member that
+    // later joins a cohort carries coherent state); a HIER plan's EF
+    // belongs to the inter hop, which does not exist solo, so the carry
+    // stays untouched (the wire only ever applies on the slow link).
     for (auto& g : p.groups) {
-      if (p.wire == PlanWire::kQ8EF)
+      if (p.wire == PlanWire::kQ8EF && !p.hier)
         plan_pack_ef(p, g, leaf_in);
       else
         plan_pack_range(p, g, leaf_in, 0, g.count);
@@ -1321,12 +1889,48 @@ void HostCollectives::plan_execute(int64_t plan_id,
     return;
   }
   if (aborted_) throw SocketError("collectives not configured");
+  if (p.hier) {
+    if (!hier_)
+      throw SocketError(
+          "hier plan on a flat ring: configure() was not given a region map "
+          "with >= 2 distinct labels");
+    run_op([&] {
+      int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+      last_hier_ = HierStats{};
+      last_hier_.wire = static_cast<int>(
+          p.wire == PlanWire::kBF16 ? HierWire::kBF16
+          : q8 ? HierWire::kQ8
+               : HierWire::kNone);
+      reset_tier_tx(intra_);
+      reset_tier_tx(inter_);
+      const bool leader = intra_.world <= 1 || intra_.rank == 0;
+      // kind 10 = hier plan: a hier plan meeting a flat plan (kind 8) or
+      // a bulk hier op (kind 9) must error at the header.
+      if (intra_.world > 1)
+        check_op_header(intra_, 10, p.sig, static_cast<uint32_t>(p.wire), 0,
+                        deadline);
+      if (leader && inter_.world > 1)
+        check_op_header(inter_, 10, p.sig, static_cast<uint32_t>(p.wire), 0,
+                        deadline);
+      last_hier_.intra_world = intra_.world;
+      last_hier_.inter_world = leader ? inter_.world : 0;
+      last_hier_.leader = leader;
+      for (size_t gi = 0; gi < p.groups.size(); gi++)
+        plan_execute_hier_group(p, gi, leaf_in, leaf_out, divisor,
+                                has_divisor, deadline);
+      last_hier_.intra_tx_bytes = tier_tx(intra_);
+      last_hier_.inter_tx_bytes = tier_tx(inter_);
+    });
+    p.execs++;
+    return;
+  }
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
     // The signature hash covers (wire, geometry, leaf counts, dtypes):
     // two members executing different plans error here instead of
     // deadlocking mid-payload.
-    check_op_header(8, p.sig, static_cast<uint32_t>(p.wire), 0, deadline);
+    check_op_header(flat_, 8, p.sig, static_cast<uint32_t>(p.wire), 0,
+                    deadline);
     for (size_t gi = 0; gi < p.groups.size(); gi++) {
       CommPlan::Group& g = p.groups[gi];
       if (g.count == 0) continue;
@@ -1355,11 +1959,11 @@ void HostCollectives::plan_execute(int64_t plan_id,
         auto t1 = std::chrono::steady_clock::now();
         if (q8) {
           allreduce_q8_stripe(
-              s, reinterpret_cast<float*>(g.staging.data()) + start, len,
-              deadline);
+              flat_, s, reinterpret_cast<float*>(g.staging.data()) + start,
+              len, deadline);
         } else {
-          allreduce_stripe(s, g.staging.data() + start * esize, len, esize,
-                           g.dtype, ReduceOp::kSum, deadline);
+          allreduce_stripe(flat_, s, g.staging.data() + start * esize, len,
+                           esize, g.dtype, ReduceOp::kSum, deadline);
         }
         auto t2 = std::chrono::steady_clock::now();
         plan_unpack_range(p, g, leaf_out, start, len, divisor, has_divisor);
@@ -1381,7 +1985,8 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
   if (root < 0 || root >= world_size_) throw SocketError("bad broadcast root");
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
-    check_op_header(2, nbytes, static_cast<uint32_t>(root), 0, deadline);
+    check_op_header(flat_, 2, nbytes, static_cast<uint32_t>(root), 0,
+                    deadline);
     if (nbytes == 0) return;
     char* bytes = static_cast<char*>(data);
     int64_t eff = effective_stripes(nbytes, stripes_);
@@ -1393,13 +1998,14 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
       auto [off, len] = stripe_range(nbytes, eff, st);
       if (len == 0) return;
       if (rank_ == root) {
-        duplex(next_[st], prev_[st], bytes + off, len, nullptr, 0, deadline,
-               &scratch_[st].pace);
+        duplex(flat_.next[st], flat_.prev[st], bytes + off, len, nullptr, 0,
+               deadline, &flat_.scratch[st]);
       } else {
-        duplex(next_[st], prev_[st], nullptr, 0, bytes + off, len, deadline);
+        duplex(flat_.next[st], flat_.prev[st], nullptr, 0, bytes + off, len,
+               deadline, &flat_.scratch[st]);
         if ((rank_ + 1) % world_size_ != root)
-          duplex(next_[st], prev_[st], bytes + off, len, nullptr, 0,
-                 deadline, &scratch_[st].pace);
+          duplex(flat_.next[st], flat_.prev[st], bytes + off, len, nullptr, 0,
+                 deadline, &flat_.scratch[st]);
       }
     });
   });
@@ -1411,17 +2017,21 @@ void HostCollectives::barrier(int64_t timeout_ms) {
   if (world_size_ == 1) return;
   run_op([&] {
     int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
-    check_op_header(3, 0, 0, 0, deadline);
+    check_op_header(flat_, 3, 0, 0, 0, deadline);
     // Two full ring passes on stripe 0: after the first, rank 0 knows
     // everyone arrived; the second releases everyone.
     char token = 1;
     for (int round = 0; round < 2; round++) {
       if (rank_ == 0) {
-        duplex(next_[0], prev_[0], &token, 1, nullptr, 0, deadline);
-        duplex(next_[0], prev_[0], nullptr, 0, &token, 1, deadline);
+        duplex(flat_.next[0], flat_.prev[0], &token, 1, nullptr, 0, deadline,
+               &flat_.scratch[0]);
+        duplex(flat_.next[0], flat_.prev[0], nullptr, 0, &token, 1, deadline,
+               &flat_.scratch[0]);
       } else {
-        duplex(next_[0], prev_[0], nullptr, 0, &token, 1, deadline);
-        duplex(next_[0], prev_[0], &token, 1, nullptr, 0, deadline);
+        duplex(flat_.next[0], flat_.prev[0], nullptr, 0, &token, 1, deadline,
+               &flat_.scratch[0]);
+        duplex(flat_.next[0], flat_.prev[0], &token, 1, nullptr, 0, deadline,
+               &flat_.scratch[0]);
       }
     }
   });

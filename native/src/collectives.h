@@ -22,6 +22,27 @@
 // actually cross), so striping multiplies achievable throughput the way
 // NCCL channels or multi-stream object fetches do.
 //
+// TWO-TIER TOPOLOGY (configure with a region map): on a fleet spanning
+// regions, the flat ring makes every member push 2*(W-1)/W*N bytes across
+// whatever link its neighbor happens to sit behind — on a topology-oblivious
+// placement that is the slow inter-region (DCN) path for every edge. With a
+// region label per rank, configure() additionally builds
+//   - an INTRA ring per region (the member's region peers, rank order), and
+//   - an INTER ring among one deterministic LEADER per region (the lowest
+//     rank — i.e. lowest replica-id, since ranks sort by replica-id — with
+//     regions ordered by their leader's rank),
+// and allreduce_hier() runs the hierarchical schedule
+//   intra reduce-scatter -> intra allgather (delivers the full region sum to
+//   the leader; on a ring, gather-to-one costs the same edges as
+//   gather-to-all) -> inter ring allreduce among leaders (the only bytes on
+//   the slow links: (L-1)/L*N sent per leader per phase, L = region count)
+//   -> chunk-pipelined intra broadcast of the leader's result.
+// Every phase reuses the SAME rs/ag stripe bodies as the flat ring, so the
+// schedule is composed from proven pieces; all members of a region adopt the
+// leader's bytes verbatim and leaders are bit-identical by ring determinism,
+// so results are bit-identical across ALL members and across runs. The sum
+// ORDER differs from the flat ring (documented; tolerance-class equal).
+//
 // Ring allreduce = reduce-scatter + allgather; within each stripe every
 // chunk is reduced in the same rank order on every participant, and stripe
 // boundaries depend only on (count, stripes, world_size) — all negotiated —
@@ -93,6 +114,92 @@ enum class PlanWire : int {
   kQ8EF = 3,
 };
 
+// Wire of the hierarchical op's INTER hop (allreduce_hier / hier plans).
+// The intra tier always rides native dtypes — quantization noise is paid
+// exactly once, on the slow link that needs it.
+enum class HierWire : int {
+  kNone = 0,   // native dtype across regions too
+  kBF16 = 1,   // leaders ring in bf16 (f32 payloads, SUM only)
+  kQ8 = 2,     // leaders ride the quantized ring (f32 payloads, SUM only)
+};
+
+// Token bucket for per-connection send pacing (TORCHFT_HC_WIRE_CAP_MBPS /
+// TORCHFT_HC_WIRE_CAP_INTRA_MBPS). Two uses: QoS — cap the gradient ring's
+// per-connection rate so it cannot starve heal/checkpoint traffic on a
+// shared NIC — and transport validation, emulating a per-connection-limited
+// path (TCP window / BDP cap, tunnel throttling, a wide-area inter-region
+// hop) on loopback so the stripe and hierarchy sweeps can measure where the
+// real win lives. Pure pacing: no wire-format or schedule effect, so
+// members need NOT agree on it.
+struct PaceState {
+  double tokens = 0;  // bytes available to send now
+  std::chrono::steady_clock::time_point last{};
+  bool init = false;
+};
+
+// Per-stripe persistent staging (grow-only, reused across ops): per-op
+// allocation of a world-size chunk — up to payload/world_size bytes —
+// costs an mmap + demand-zero page faults EVERY op at gradient scale.
+// Also carries the connection's pacing state and the per-op tx counter
+// (bytes actually handed to the kernel by duplex) the hierarchical
+// accounting sums per tier — measured traffic, not a model.
+struct StripeScratch {
+  std::vector<char> recv;           // allreduce recv / q8 recv wire
+  std::vector<char> send;           // q8 send wire
+  std::vector<std::vector<char>> stored;  // q8 phase-2 circulating codes
+  PaceState pace;                   // this connection's send pacing
+  int64_t cap_bps = 0;              // tier's per-connection send cap
+  int64_t tx_bytes = 0;             // bytes sent since the op reset it
+};
+
+// One ring a member participates in: the FLAT ring over all W members, the
+// INTRA ring over its region peers, or the INTER ring over region leaders.
+// `rank`/`world` are tier-local (flat: the global rank/world). `conns` is
+// the tier's parallel-connection count per neighbor edge, `cap_bps` the
+// tier's per-connection send pacing (0 = unpaced) — a hierarchical fleet
+// paces its slow inter links without throttling the fast intra ones.
+struct RingTier {
+  int64_t rank = -1;
+  int64_t world = 0;
+  int64_t conns = 0;
+  int64_t cap_bps = 0;
+  std::vector<Socket> next;   // one per stripe
+  std::vector<Socket> prev;   // one per stripe
+  // Persistent per-stripe staging + pacing + per-op tx accounting
+  // (grow-only, reused across ops).
+  std::vector<StripeScratch> scratch;
+  void clear() {
+    rank = -1;
+    world = 0;
+    next.clear();
+    prev.clear();
+  }
+};
+
+// Per-op phase/byte breakdown of the last hierarchical op (allreduce_hier
+// or one hier plan execute): wall seconds per schedule phase and MEASURED
+// bytes sent on each tier's connections (summed from the per-connection tx
+// counters duplex maintains — what actually hit the kernel, headers
+// included). inter_rs/inter_ag split the leader's slow-link bill per ring
+// phase: each is (L-1)/L of the payload, the number the topology buys.
+struct HierStats {
+  int64_t intra_rs_ns = 0;
+  int64_t intra_ag_ns = 0;
+  int64_t inter_ring_ns = 0;
+  int64_t intra_bcast_ns = 0;
+  int64_t intra_tx_bytes = 0;
+  int64_t inter_tx_bytes = 0;
+  int64_t inter_rs_tx_bytes = 0;
+  int64_t inter_ag_tx_bytes = 0;
+  int64_t payload_bytes = 0;
+  int64_t eff_intra = 0;
+  int64_t eff_inter = 0;
+  int64_t intra_world = 0;
+  int64_t inter_world = 0;
+  bool leader = false;
+  int wire = 0;  // HierWire of the inter hop
+};
+
 // A persistent, precompiled description of one pytree's gradient sync:
 // leaf -> dtype-group assignment with per-leaf element offsets, the wire
 // format, the stripe partition (the plan's "buckets" — each stripe
@@ -141,11 +248,25 @@ struct CommPlan {
   // EF/cast arithmetic), so mixed rings interoperate — pack placement is
   // a local choice, not a wire-contract change.
   bool prepacked = false;
+  // Hierarchical plan: execute runs the two-tier schedule (intra rs/ag,
+  // inter ring at `wire` among leaders, intra bcast) instead of the flat
+  // ring. Groups keep their NATIVE dtypes — the plan wire applies at the
+  // inter hop only (kBF16: leaders cast f32 staging to bf16 for the slow
+  // link; kQ8/kQ8EF: leaders ride the quantized ring, kQ8EF with the
+  // per-leaf error-feedback carry applied to the REGION sum at the
+  // leader, so the residual refines each region's own contribution).
+  // Baked into the signature hash: a hier plan meeting a flat plan must
+  // error, not desync.
+  bool hier = false;
   std::vector<Leaf> leaves;
   std::vector<Group> groups;
   // kQ8EF: persistent error-feedback carry, laid out exactly like the
   // single f32 group's staging (per-leaf offsets shared). Prepacked q8
   // plans leave it empty — the carry lives device-side in the packer.
+  // Hier kQ8EF plans allocate it everywhere but only the region LEADER
+  // advances it (the EF quantize happens at the inter hop); a leader
+  // change rebuilds plans (configure invalidates), so a new leader
+  // starts from a zero carry — the standard reset discipline.
   std::vector<float> residual;
   uint64_t sig = 0;      // structure hash, exchanged in the op header
   int64_t execs = 0;     // executes since build (0 = cold)
@@ -157,7 +278,7 @@ class HostCollectives {
   HostCollectives() = default;
   ~HostCollectives();
 
-  // Rebuilds the ring for a (possibly new) membership. store_addr is
+  // Rebuilds the ring(s) for a (possibly new) membership. store_addr is
   // "host:port/prefix"; the prefix must be unique per quorum — stale members
   // of an old quorum never see the new keys, so they cannot cross-talk
   // (reference manager.py:470-477 store-prefix discipline). Aborts any
@@ -166,12 +287,37 @@ class HostCollectives {
   // handshake rejects mismatches, and the Python layer additionally
   // negotiates it through the store so mismatched ranks fail fast with a
   // descriptive error before any socket work).
+  //
+  // `regions` (optional): one region label per rank, identical on every
+  // member (it comes from the quorum, which already agrees). When given
+  // with >= 2 distinct labels, the TWO-TIER topology is built alongside
+  // the flat ring (see the file comment) and allreduce_hier()/hier plans
+  // become available; `stripes_inter` (0 = `stripes`) is the inter
+  // (leader) ring's connection count — the slow wide-area hop is where
+  // striping pays, so it gets its own knob.
   void configure(const std::string& store_addr, int64_t rank, int64_t world_size,
-                 int64_t timeout_ms, int64_t stripes = 1);
+                 int64_t timeout_ms, int64_t stripes = 1,
+                 const std::vector<std::string>& regions = {},
+                 int64_t stripes_inter = 0);
+
+  // Whether the last configure() built the two-tier topology (a region map
+  // with >= 2 distinct labels was supplied).
+  bool hier_capable() const { return hier_; }
 
   // In-place ring allreduce over `count` elements of `data`.
   void allreduce(void* data, size_t count, Dtype dtype, ReduceOp op,
                  int64_t timeout_ms);
+
+  // In-place TWO-TIER allreduce (requires a hier configure):
+  //   intra reduce-scatter -> intra allgather -> inter ring among leaders
+  //   -> chunk-pipelined intra broadcast.
+  // `wire` selects the INTER hop's encoding (HierWire; bf16/q8 take f32
+  // payloads and kSum only — intra stays native/full precision either
+  // way). Results are bit-identical across members and runs; the sum
+  // order differs from the flat ring (two-tier reduction tree).
+  // Phase/byte breakdown of the last call: last_hier_json().
+  void allreduce_hier(void* data, size_t count, Dtype dtype, ReduceOp op,
+                      HierWire wire, int64_t timeout_ms);
 
   // In-place QUANTIZED ring SUM over `count` f32 elements: every hop
   // ships each chunk as [f32 absmax/127 scale][int8 payload] and the
@@ -255,9 +401,13 @@ class HostCollectives {
   // execute takes pre-packed per-GROUP wire buffers (plan_execute_pre)
   // instead of per-leaf source pointers; it does not change the wire
   // contract (see CommPlan::prepacked), so prepacked and plain plans of
-  // the same signature interoperate in one ring.
+  // the same signature interoperate in one ring. `hier` builds a
+  // HIERARCHICAL plan (see CommPlan::hier; requires a hier configure at
+  // execute time): groups stay native-dtype and `wire` applies at the
+  // inter hop only.
   int64_t plan_build(const int64_t* counts, const int32_t* dtypes,
-                     int64_t n_leaves, PlanWire wire, bool prepacked = false);
+                     int64_t n_leaves, PlanWire wire, bool prepacked = false,
+                     bool hier = false);
 
   // Executes one gradient sync over the plan: packs/casts leaf_in[i]
   // into the persistent staging (kQ8EF additionally runs the native
@@ -269,6 +419,9 @@ class HostCollectives {
   // The ring arithmetic per group is bit-identical to the legacy
   // single-op path (same stripe partition, same *_stripe bodies).
   // Aborts/peer death wake every stripe exactly like the bulk ops.
+  // Hier plans run the two-tier schedule instead: pack streams into the
+  // intra reduce-scatter phase and unpack out of the broadcast phase, so
+  // the per-bucket triple pipeline survives the extra tiers.
   void plan_execute(int64_t plan_id, const void* const* leaf_in,
                     void* const* leaf_out, double divisor, bool has_divisor,
                     int64_t timeout_ms);
@@ -278,7 +431,7 @@ class HostCollectives {
   // for q8 wires, bf16/native words otherwise) and group_aux[g] at its
   // per-leaf f32 scale sidecar (q8 wires only; ignored — may be null —
   // for other groups). The pack stage per stripe bucket is a straight
-  // decode (q8: staging[i] = q[i] * scale[leaf]; else memcpy) streamed
+  // decode (q8: staging[i] = q[i] * scale; else memcpy) streamed
   // per bucket like any other phase; ring and unpack are plan_execute's
   // own, so device-packed results are bit-identical to host-packed ones
   // whenever the device pack mirrors the native pack arithmetic (the
@@ -297,6 +450,17 @@ class HostCollectives {
   // {"execs": n, "buckets": [{"group", "stripe", "bytes", "pack_s",
   // "ring_s", "unpack_s"}, ...]}.
   std::string plan_stats_json(int64_t plan_id);
+
+  // Phase/byte breakdown of the LAST hierarchical op (allreduce_hier or
+  // hier plan execute; hier plans accumulate across their groups), as
+  // JSON: {"intra_rs_s", "intra_ag_s", "inter_ring_s", "intra_bcast_s",
+  // "intra_tx_bytes", "inter_tx_bytes", "inter_rs_tx_bytes",
+  // "inter_ag_tx_bytes", "payload_bytes", "eff_intra", "eff_inter",
+  // "intra_world", "inter_world", "leader", "wire"}. tx bytes are
+  // MEASURED (summed from the per-connection counters duplex maintains),
+  // not modeled. Same read discipline as last_stripe_ns: call from the
+  // thread that issued the op.
+  std::string last_hier_json() const;
 
   // Gathers `nbytes` from every rank into `out` (world_size * nbytes), in
   // rank order.
@@ -319,44 +483,22 @@ class HostCollectives {
   void abort();
 
  private:
-  // Token bucket for per-connection send pacing (TORCHFT_HC_WIRE_CAP_MBPS).
-  // Two uses: QoS — cap the gradient ring's per-connection rate so it
-  // cannot starve heal/checkpoint traffic on a shared NIC — and transport
-  // validation, emulating a per-connection-limited path (TCP window / BDP
-  // cap, tunnel throttling) on loopback so the stripe sweep can measure
-  // aggregation where the real win lives. Pure pacing: no wire-format or
-  // schedule effect, so members need NOT agree on it.
-  struct PaceState {
-    double tokens = 0;  // bytes available to send now
-    std::chrono::steady_clock::time_point last{};
-    bool init = false;
-  };
-
-  // Per-stripe persistent staging (grow-only, reused across ops): per-op
-  // allocation of a world-size chunk — up to payload/world_size bytes —
-  // costs an mmap + demand-zero page faults EVERY op at gradient scale.
-  struct StripeScratch {
-    std::vector<char> recv;           // allreduce recv / q8 recv wire
-    std::vector<char> send;           // q8 send wire
-    std::vector<std::vector<char>> stored;  // q8 phase-2 circulating codes
-    PaceState pace;                   // this connection's send pacing
-  };
-
   // Sends send_len bytes to next while concurrently receiving recv_len
   // bytes from prev (full-duplex pump; one-directional blocking would
-  // deadlock once kernel buffers fill on a large ring step). `pace`
-  // (nullable) applies the per-connection send cap; receives are never
-  // paced, and a token-dry sender keeps draining its receive side.
+  // deadlock once kernel buffers fill on a large ring step). `sc`
+  // (nullable) carries the connection's send pacing (cap_bps token
+  // bucket) and accumulates sent bytes into its tx counter; receives are
+  // never paced, and a token-dry sender keeps draining its receive side.
   void duplex(Socket& next, Socket& prev, const char* send_buf,
               size_t send_len, char* recv_buf, size_t recv_len,
-              int64_t deadline_ms, PaceState* pace = nullptr);
+              int64_t deadline_ms, StripeScratch* sc = nullptr);
 
   // Exchanges a tiny (kind, count, dtype, op) header with both neighbors
-  // on stripe 0 before a collective and throws on mismatch — a
+  // of tier `T` on stripe 0 before a collective and throws on mismatch — a
   // size/dtype-mismatched op would otherwise deadlock silently once kernel
   // buffers fill.
-  void check_op_header(uint32_t kind, uint64_t count, uint32_t dtype,
-                       uint32_t op, int64_t deadline_ms);
+  void check_op_header(RingTier& T, uint32_t kind, uint64_t count,
+                       uint32_t dtype, uint32_t op, int64_t deadline_ms);
 
   // Runs fn(stripe) for every stripe concurrently: stripe 0 on the calling
   // thread, the rest on PERSISTENT pool workers. The FIRST failing stripe
@@ -376,26 +518,62 @@ class HostCollectives {
   void ensure_pool(int64_t workers);
   void pool_main(int64_t idx, int64_t start_gen);
 
-  // Per-stripe ring bodies over an element/byte sub-range.
-  void allreduce_stripe(int64_t s, char* bytes, size_t count, size_t esize,
-                        Dtype dtype, ReduceOp op, int64_t deadline);
-  void allreduce_q8_stripe(int64_t s, float* data, size_t count,
+  // Per-stripe ring bodies over an element/byte sub-range of tier `T`'s
+  // ring. Parameterized by tier so the flat, intra and inter rings all
+  // run the SAME proven bodies — the two-tier schedule is composed from
+  // them, never reimplemented.
+  void allreduce_stripe(RingTier& T, int64_t s, char* bytes, size_t count,
+                        size_t esize, Dtype dtype, ReduceOp op,
+                        int64_t deadline);
+  void allreduce_q8_stripe(RingTier& T, int64_t s, float* data, size_t count,
                            int64_t deadline);
   // The two phases of the ring schedule, shared verbatim by the fused
-  // allreduce and the first-class reduce_scatter / allgather_into (the
-  // sharing is what makes decomposed-vs-fused bit-identity structural
+  // allreduce, the first-class reduce_scatter / allgather_into, and the
+  // two-tier schedule's intra/inter hops (the sharing is what makes
+  // decomposed-vs-fused and hier-vs-oracle bit-identity structural
   // rather than coincidental).
-  void rs_phase_stripe(int64_t s, char* bytes, size_t count, size_t esize,
-                       Dtype dtype, ReduceOp op, int64_t deadline);
-  void ag_phase_stripe(int64_t s, char* bytes, size_t count, size_t esize,
+  void rs_phase_stripe(RingTier& T, int64_t s, char* bytes, size_t count,
+                       size_t esize, Dtype dtype, ReduceOp op,
                        int64_t deadline);
-  void rs_q8_phase_stripe(int64_t s, float* data, size_t count,
+  void ag_phase_stripe(RingTier& T, int64_t s, char* bytes, size_t count,
+                       size_t esize, int64_t deadline);
+  void rs_q8_phase_stripe(RingTier& T, int64_t s, float* data, size_t count,
                           int64_t deadline);
+  // The allgather phase of the quantized ring (owner-quantize + circulate
+  // codes verbatim); allreduce_q8_stripe = rs_q8_phase + this.
+  void ag_q8_phase_stripe(RingTier& T, int64_t s, float* data, size_t count,
+                          int64_t deadline);
+  // Chunk-pipelined store-and-forward broadcast of a byte sub-range from
+  // tier rank `root` around tier T's ring: member d forwards chunk k-1
+  // while receiving chunk k (duplex), so the wall is ~bytes/bw + a chunk
+  // of fill per hop instead of hops * bytes/bw. The two-tier schedule's
+  // distribution phase.
+  void bcast_pipe_stripe(RingTier& T, int64_t s, char* bytes, size_t nbytes,
+                         int64_t root, int64_t deadline);
+  // One hierarchical schedule over `count` elements of `data` (already
+  // under op_mu_/run_op): the shared body of allreduce_hier and the hier
+  // plan execute. Accumulates phase/byte stats into last_hier_.
+  void hier_schedule(char* bytes, size_t count, size_t esize, Dtype dtype,
+                     ReduceOp op, HierWire wire, int64_t eff_intra,
+                     int64_t eff_inter, int64_t deadline);
+  // The leader's inter hop — rs then ag among region leaders over `buf`,
+  // re-striped at eff_inter, with the wire encoding applied (bf16: cast
+  // through hier_wire_buf_; q8: the quantized ring bodies). ONE
+  // implementation serves the bulk op and the hier plan, so a wire or
+  // accounting change can never desync the two. `*rs_tx` receives the
+  // rs phase's measured slow-link tx (delta of the tier counter).
+  void inter_ring_phase(HierWire wire, char* buf, size_t count, size_t esize,
+                        Dtype dtype, ReduceOp op, int64_t eff_inter,
+                        int64_t deadline, int64_t* rs_tx);
   // Copies the rank-owned chunk of every stripe between the full buffer
   // and the compacted shard (to_shard=true: gather out of `data` into
   // `shard`; false: scatter back).
   void copy_shard(char* data, char* shard, size_t count, size_t esize,
                   int64_t eff, bool to_shard) const;
+  // Sum of the per-connection tx counters of a tier's scratch; resetting
+  // them is the per-op accounting boundary.
+  static int64_t tier_tx(const RingTier& T);
+  static void reset_tier_tx(RingTier& T);
 
   // Plan internals: pack/unpack one element range of a group (casts per
   // the plan wire; unpack applies the divisor), and the kQ8EF per-leaf
@@ -409,22 +587,37 @@ class HostCollectives {
                          double divisor, bool has_divisor) const;
   void plan_pack_ef(CommPlan& p, CommPlan::Group& g,
                     const void* const* leaf_in) const;
+  // Hier kQ8EF: the same per-leaf EF quantization applied IN PLACE to the
+  // group's staging (which holds the REGION sum at the leader before the
+  // inter hop): d = staging + residual; quantize; staging = dq;
+  // residual = d - dq. Leader-only by construction.
+  void plan_ef_inplace(CommPlan& p, CommPlan::Group& g) const;
   // Prepacked decode of one element range: q8 groups dequantize the int8
   // codes against the per-leaf scale sidecar, everything else memcpys the
   // already-wire-encoded words into staging.
   void plan_pack_pre_range(const CommPlan& p, CommPlan::Group& g,
                            const void* group_in, const void* group_aux,
                            size_t start, size_t len) const;
+  // The hier plan execute body for one group (under run_op): pack fused
+  // into the intra_rs phase, unpack fused into the bcast phase.
+  void plan_execute_hier_group(CommPlan& p, size_t gi,
+                               const void* const* leaf_in,
+                               void* const* leaf_out, double divisor,
+                               bool has_divisor, int64_t deadline);
   CommPlan& plan_get(int64_t plan_id);
 
-  // Shuts down every ring socket (all stripes); cfg_mu_ must NOT be held.
+  // Shuts down every ring socket (all tiers, all stripes); cfg_mu_ must
+  // NOT be held.
   void shutdown_sockets();
+  void shutdown_sockets_locked() TFT_REQUIRES(cfg_mu_);
 
   // Runs an op body; on ANY failure shuts down all ring sockets before
-  // rethrowing. The FIN propagates the failure around the ring: every
-  // member's in-flight op fails within milliseconds instead of blocking on
-  // its timeout while a majority of survivors can't reach the next quorum —
-  // the distributed analog of NCCL's abort-on-error. The dead ring stays
+  // rethrowing. The FIN propagates the failure around the ring — and, for
+  // hierarchical ops, ACROSS TIERS: a dead region leader kills its inter
+  // peers' op, whose intra members then fail on their own tier's sockets,
+  // so every member of every region errors within one op deadline instead
+  // of blocking while a majority of survivors can't reach the next quorum
+  // — the distributed analog of NCCL's abort-on-error. The dead ring stays
   // dead (ops throw immediately) until the next configure().
   template <typename Fn>
   void run_op(Fn&& fn) {
@@ -433,8 +626,7 @@ class HostCollectives {
     } catch (...) {
       {
         MutexLock lock(cfg_mu_);
-        for (auto& s : next_) s.shutdown_rdwr();
-        for (auto& s : prev_) s.shutdown_rdwr();
+        shutdown_sockets_locked();
         aborted_ = true;
       }
       throw;
@@ -453,24 +645,30 @@ class HostCollectives {
   // the same order on every rank anyway).
   Mutex op_mu_;
 
-  // Ring geometry and per-stripe state below ride a DUAL protocol no single
-  // capability can express (so no GUARDED_BY): identity writers (configure)
-  // hold op_mu_ AND cfg_mu_; the op thread reads under op_mu_; pool workers
-  // read with NO lock, synchronized by the pool_mu_ job handoff (the op
-  // thread publishes the job under pool_mu_ while itself holding op_mu_, so
-  // no write can overlap a worker's read). abort()/run_op touch only the
-  // sockets' fds, under cfg_mu_.
+  // Ring geometry and per-stripe/tier state below ride a DUAL protocol no
+  // single capability can express (so no GUARDED_BY): identity writers
+  // (configure) hold op_mu_ AND cfg_mu_; the op thread reads under op_mu_;
+  // pool workers read with NO lock, synchronized by the pool_mu_ job
+  // handoff (the op thread publishes the job under pool_mu_ while itself
+  // holding op_mu_, so no write can overlap a worker's read). abort()/
+  // run_op touch only the sockets' fds, under cfg_mu_.
   int64_t rank_ = -1;
   int64_t world_size_ = 0;
   int64_t stripes_ = 1;
-  // Per-connection send cap in bytes/s (0 = unpaced). Snapshotted from
-  // TORCHFT_HC_WIRE_CAP_MBPS at configure() so the knob is stable for the
-  // lifetime of a ring.
-  int64_t wire_cap_bps_ = 0;
+  int64_t stripes_inter_ = 1;
+  bool hier_ = false;
   std::unique_ptr<Listener> listener_;
-  std::vector<Socket> next_;  // one per stripe
-  std::vector<Socket> prev_;  // one per stripe
-  std::vector<StripeScratch> scratch_;     // persistent staging, per stripe
+  // The three rings a member can participate in. flat_ always exists
+  // after a multi-member configure; intra_/inter_ only under a hier
+  // configure (intra_.world == 1 for a one-member region, inter_.world
+  // only meaningful on the region leader).
+  RingTier flat_;
+  RingTier intra_;
+  RingTier inter_;
+  HierStats last_hier_;
+  // Leader-side inter-hop wire staging for allreduce_hier's bf16 wire
+  // (grow-only, reused across ops).
+  std::vector<char> hier_wire_buf_;
   std::vector<int64_t> last_stripe_ns_;    // per-stripe time of the last op
   std::atomic<bool> aborted_{true}; // not configured yet
   // Bumped by every abort(); configure() uses it to detect an abort that

@@ -84,12 +84,14 @@ ManagerServer::ManagerServer(const std::string& replica_id,
                              const std::string& store_addr, uint64_t world_size,
                              int64_t heartbeat_interval_ms,
                              int64_t connect_timeout_ms,
-                             const std::string& root_addr, int64_t lease_ttl_ms)
+                             const std::string& root_addr, int64_t lease_ttl_ms,
+                             const std::string& region)
     : replica_id_(replica_id),
       lighthouse_addr_(lighthouse_addr),
       root_addr_(root_addr == lighthouse_addr ? "" : root_addr),
       hostname_(hostname.empty() ? local_hostname() : hostname),
       store_addr_(store_addr),
+      region_(region),
       world_size_(world_size),
       heartbeat_interval_ms_(heartbeat_interval_ms),
       connect_timeout_ms_(connect_timeout_ms),
@@ -301,8 +303,7 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
 
   if (participants_.size() >= world_size_) {
     // Last local rank arrived: forward one request to the lighthouse on
-    // behalf of the whole replica group. The state lock is held across the
-    // call, matching the reference (src/manager.rs:181 TODO).
+    // behalf of the whole replica group.
     participants_.clear();
     LOG_INFO("all workers joined -- starting quorum");
     QuorumMember requester;
@@ -312,30 +313,61 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
     requester.set_step(req.step());
     requester.set_world_size(world_size_);
     requester.set_shrink_only(req.shrink_only());
+    requester.set_region(region_);
     requester.set_force_reconfigure(force_reconfigure_pending_);
     force_reconfigure_pending_ = false;
+    // The state lock is NOT held across the lighthouse round trip (the
+    // reference's src/manager.rs:181 TODO, carried here until this fix):
+    // the quorum RPC long-polls the join window — seconds against a slow
+    // or stalled root — and with mu_ held, every lease renewal's status
+    // snapshot, checkpoint-metadata lookup and should_commit barrier on
+    // other connections serialized behind it. Release, call, re-acquire,
+    // and REVALIDATE via the quorum generation: everything this block
+    // needed from the state was copied into `requester` above, and the
+    // generation tells us whether a sibling forward published a NEWER
+    // result while the lock was free (possible when client timeouts
+    // re-register the ranks and another thread sees the set full) — an
+    // older result or error must then be dropped, not installed over it.
+    lock.unlock();
+    std::optional<Quorum> got;
+    std::string err;
+    ErrorResponse::Code err_code = ErrorResponse::UNAVAILABLE;
     try {
-      Quorum quorum = active_lighthouse()->quorum(requester, req.timeout_ms());
-      LOG_INFO("got lighthouse quorum id=" << quorum.quorum_id());
-      latest_quorum_ = std::move(quorum);
-      quorum_error_.clear();
+      got = active_lighthouse()->quorum(requester, req.timeout_ms());
+      LOG_INFO("got lighthouse quorum id=" << got->quorum_id());
     } catch (const TimeoutError& e) {
       // Preserve deadline semantics so the client raises TimeoutError,
       // mirroring the reference's DeadlineExceeded mapping (src/lib.rs:321-333).
-      quorum_error_ = e.what();
-      quorum_error_code_ = ErrorResponse::DEADLINE_EXCEEDED;
-      LOG_ERROR("lighthouse quorum failed: " << quorum_error_);
+      err = e.what();
+      err_code = ErrorResponse::DEADLINE_EXCEEDED;
+      LOG_ERROR("lighthouse quorum failed: " << err);
     } catch (const RpcError& e) {
-      quorum_error_ = e.what();
-      quorum_error_code_ = e.code;
-      LOG_ERROR("lighthouse quorum failed: " << quorum_error_);
+      err = e.what();
+      err_code = e.code;
+      LOG_ERROR("lighthouse quorum failed: " << err);
     } catch (const std::exception& e) {
-      quorum_error_ = e.what();
-      quorum_error_code_ = ErrorResponse::UNAVAILABLE;
-      LOG_ERROR("lighthouse quorum failed: " << quorum_error_);
+      err = e.what();
+      err_code = ErrorResponse::UNAVAILABLE;
+      LOG_ERROR("lighthouse quorum failed: " << err);
     }
-    quorum_gen_ += 1;
-    quorum_cv_.notify_all();
+    lock.lock();
+    if (quorum_gen_ == gen) {
+      if (got.has_value()) {
+        latest_quorum_ = std::move(*got);
+        quorum_error_.clear();
+      } else {
+        quorum_error_ = err;
+        quorum_error_code_ = err_code;
+      }
+      quorum_gen_ += 1;
+      quorum_cv_.notify_all();
+    } else {
+      // A sibling forward already advanced the generation: its (newer)
+      // result serves every waiter, including this connection via the
+      // wait loop below. Installing ours would roll the state back.
+      LOG_WARN("dropping superseded lighthouse quorum result (generation "
+               << gen << " -> " << quorum_gen_ << ")");
+    }
   }
 
   while (quorum_gen_ == gen && !shutting_down_) {

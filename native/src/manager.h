@@ -60,11 +60,16 @@ class ManagerServer {
   // stops answering, the manager demotes itself to direct-root registration
   // and probes the region periodically until it returns. `lease_ttl_ms`
   // <= 0 leaves liveness on the lighthouse's heartbeat_timeout_ms default.
+  // `region` (optional, "" = unlabeled) is the group's topology label
+  // (TORCHFT_REGION): it rides the quorum requester into every member's
+  // QuorumMember, and the quorum result's region map is what the data
+  // plane compiles into the two-tier collective schedule.
   ManagerServer(const std::string& replica_id, const std::string& lighthouse_addr,
                 const std::string& hostname, const std::string& bind,
                 const std::string& store_addr, uint64_t world_size,
                 int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
-                const std::string& root_addr = "", int64_t lease_ttl_ms = 0);
+                const std::string& root_addr = "", int64_t lease_ttl_ms = 0,
+                const std::string& region = "");
   ~ManagerServer();
 
   std::string address() const; // "http://host:port"
@@ -95,6 +100,7 @@ class ManagerServer {
   std::string root_addr_;
   std::string hostname_;
   std::string store_addr_;
+  std::string region_;
   uint64_t world_size_;
   int64_t heartbeat_interval_ms_;
   int64_t connect_timeout_ms_;

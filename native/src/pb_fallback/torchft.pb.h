@@ -199,6 +199,8 @@ class QuorumMember {
   void set_shrink_only(bool v) { shrink_only_ = v; }
   bool force_reconfigure() const { return force_reconfigure_; }
   void set_force_reconfigure(bool v) { force_reconfigure_ = v; }
+  const std::string& region() const { return region_; }
+  void set_region(const std::string& v) { region_ = v; }
 
   void AppendTo(std::string& out) const {
     tft_pb::put_str(out, 1, replica_id_);
@@ -208,6 +210,7 @@ class QuorumMember {
     tft_pb::put_int64(out, 5, static_cast<int64_t>(world_size_));
     tft_pb::put_bool(out, 6, shrink_only_);
     tft_pb::put_bool(out, 7, force_reconfigure_);
+    tft_pb::put_str(out, 8, region_);
   }
   bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
     switch (f) {
@@ -218,13 +221,14 @@ class QuorumMember {
       case 5: if (w == 0) { world_size_ = r.varint(); return true; } break;
       case 6: if (w == 0) { shrink_only_ = r.varint() != 0; return true; } break;
       case 7: if (w == 0) { force_reconfigure_ = r.varint() != 0; return true; } break;
+      case 8: if (w == 2) { region_ = r.bytes(); return true; } break;
     }
     return false;
   }
   TFT_PB_COMMON()
 
  private:
-  std::string replica_id_, address_, store_address_;
+  std::string replica_id_, address_, store_address_, region_;
   int64_t step_ = 0;
   uint64_t world_size_ = 0;
   bool shrink_only_ = false;
@@ -737,6 +741,12 @@ class ManagerQuorumResponse {
   void set_replica_world_size(int64_t v) { replica_world_size_ = v; }
   bool heal() const { return heal_; }
   void set_heal(bool v) { heal_ = v; }
+  const std::vector<std::string>& replica_regions() const {
+    return replica_regions_;
+  }
+  void add_replica_regions(const std::string& v) {
+    replica_regions_.push_back(v);
+  }
 
   void AppendTo(std::string& out) const {
     tft_pb::put_int64(out, 1, quorum_id_);
@@ -751,6 +761,10 @@ class ManagerQuorumResponse {
     tft_pb::put_int64(out, 9, replica_rank_);
     tft_pb::put_int64(out, 10, replica_world_size_);
     tft_pb::put_bool(out, 11, heal_);
+    // repeated string: EVERY element serializes, empty ones included —
+    // the list is indexed by replica rank, so holes would shift labels.
+    for (const auto& rg : replica_regions_)
+      tft_pb::put_len_prefixed(out, 12, rg);
   }
   bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
     switch (f) {
@@ -777,6 +791,7 @@ class ManagerQuorumResponse {
       case 9: if (w == 0) { replica_rank_ = static_cast<int64_t>(r.varint()); return true; } break;
       case 10: if (w == 0) { replica_world_size_ = static_cast<int64_t>(r.varint()); return true; } break;
       case 11: if (w == 0) { heal_ = r.varint() != 0; return true; } break;
+      case 12: if (w == 2) { replica_regions_.push_back(r.bytes()); return true; } break;
     }
     return false;
   }
@@ -787,6 +802,7 @@ class ManagerQuorumResponse {
   int64_t max_world_size_ = 0, replica_rank_ = 0, replica_world_size_ = 0;
   std::string recover_src_manager_address_, store_address_;
   std::vector<int64_t> recover_dst_ranks_;
+  std::vector<std::string> replica_regions_;
   bool has_recover_src_rank_ = false, has_max_rank_ = false, heal_ = false;
 };
 

@@ -214,6 +214,10 @@ ManagerQuorumResponse compute_quorum_results(const std::string& replica_id,
   resp.set_max_step(max_step);
   if (max_rank.has_value()) resp.set_max_rank(*max_rank);
   resp.set_max_world_size(static_cast<int64_t>(max_participants.size()));
+  // The full region map, indexed by replica rank: what the data plane
+  // compiles into the two-tier collective schedule. Every member derives
+  // the identical map from the identical sorted quorum.
+  for (const auto& p : participants) resp.add_replica_regions(p.region());
   return resp;
 }
 
@@ -364,6 +368,7 @@ Json member_to_json(const QuorumMember& m) {
   o["world_size"] = static_cast<int64_t>(m.world_size());
   o["shrink_only"] = m.shrink_only();
   o["force_reconfigure"] = m.force_reconfigure();
+  o["region"] = m.region();
   return Json(std::move(o));
 }
 
@@ -376,6 +381,7 @@ QuorumMember member_from_json(const Json& j) {
   m.set_world_size(static_cast<uint64_t>(j.get_int("world_size", 1)));
   m.set_shrink_only(j.get_bool("shrink_only", false));
   m.set_force_reconfigure(j.get_bool("force_reconfigure", false));
+  m.set_region(j.get_string("region", ""));
   return m;
 }
 
@@ -415,6 +421,9 @@ Json quorum_response_to_json(const ManagerQuorumResponse& r) {
   if (r.has_max_rank()) o["max_rank"] = r.max_rank();
   o["max_world_size"] = r.max_world_size();
   o["heal"] = r.heal();
+  JsonArray regions;
+  for (const auto& rg : r.replica_regions()) regions.push_back(rg);
+  o["replica_regions"] = Json(std::move(regions));
   return Json(std::move(o));
 }
 

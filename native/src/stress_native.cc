@@ -613,6 +613,185 @@ void shm_churn(int iters, int world) {
   expect(ShmSegment::live_count() == base_live, "shm handles leaked");
 }
 
+// Two-tier collectives churn: W ranks with region labels reconfigure per
+// round — the labels ROTATE so region membership (and therefore
+// LEADERSHIP) moves across reconfigures — then run the hier ops per wire
+// plus a hier q8ef plan (the leader-carry path), under a chaos thread
+// that aborts rank 0 preferentially (a region leader in every rotation):
+// a dead leader must error every tier within the op deadline and the
+// next round's configure must revive the full topology. Clean rounds
+// assert exact sums on the native wire.
+void hier_collectives_churn(int rounds, int world, int stripes,
+                            size_t elems) {
+  if (world < 2) return;
+  StoreServer store("[::]:0");
+  std::string store_addr = "localhost:" + std::to_string(store.port());
+
+  std::vector<std::unique_ptr<HostCollectives>> hcs;
+  for (int r = 0; r < world; r++)
+    hcs.push_back(std::make_unique<HostCollectives>());
+
+  Barrier barrier(world);
+  std::atomic<bool> stop{false};
+  std::atomic<int> in_ops{0};
+  const int chaos_until = rounds > 2 ? rounds - 2 : 0;
+  std::atomic<int> cur_round{0};
+
+  std::thread chaos([&] {
+    std::mt19937 rng(0xBADC0DE);
+    while (!stop) {
+      sleep_ms(2 + static_cast<int64_t>(rng() % 10));
+      if (cur_round.load() < chaos_until && in_ops.load() == world)
+        hcs[rng() % 2 == 0 ? 0 : rng() % world]->abort();
+    }
+  });
+
+  std::vector<std::thread> ranks;
+  for (int64_t r = 0; r < world; r++) {
+    ranks.emplace_back([&, r] {
+      const int64_t timeout = 8000;
+      std::vector<float> data(elems), out(elems);
+      for (int round = 0; round < rounds; round++) {
+        barrier.arrive_and_wait();
+        if (r == 0) cur_round = round;
+        bool chaos_round = round < chaos_until;
+        std::vector<std::string> regions(world);
+        for (int64_t m = 0; m < world; m++)
+          regions[m] =
+              ((m + round) % world) < (world + 1) / 2 ? "east" : "west";
+        bool two = false;
+        for (auto& g : regions)
+          if (g != regions[0]) two = true;
+        if (!two) regions[world - 1] = "west";
+        std::string prefix = store_addr + "/hier/" + std::to_string(round);
+        bool configured = false;
+        for (int attempt = 0; attempt < 2 && !configured; attempt++) {
+          try {
+            hcs[r]->configure(prefix + "/" + std::to_string(attempt), r,
+                              world, 15000, stripes, regions, stripes);
+            configured = true;
+          } catch (const std::exception&) {
+            g_failed++;
+          }
+        }
+        expect(configured, "hier configure failed twice in one round");
+        barrier.arrive_and_wait();
+        in_ops++;
+        if (configured) {
+          try {
+            expect(hcs[r]->hier_capable(),
+                   "hier configure did not build the two-tier topology");
+            for (int w = 0; w < 3; w++) {
+              for (size_t i = 0; i < elems; i++)
+                data[i] = static_cast<float>(r + 1);
+              hcs[r]->allreduce_hier(data.data(), elems, Dtype::kF32,
+                                     ReduceOp::kSum,
+                                     static_cast<HierWire>(w), timeout);
+              if (!chaos_round && w == 0) {
+                float want = static_cast<float>(world * (world + 1) / 2);
+                expect(data[0] == want && data[elems - 1] == want,
+                       "hier allreduce sum mismatch");
+              }
+              g_ok++;
+            }
+            (void)hcs[r]->last_hier_json();
+            // hier q8ef plan: the leader-side EF carry, executed twice so
+            // the residual evolves, then reset (the heal discipline).
+            int64_t counts[2] = {static_cast<int64_t>(elems / 2),
+                                 static_cast<int64_t>(elems - elems / 2)};
+            int32_t dtypes[2] = {static_cast<int32_t>(Dtype::kF32),
+                                 static_cast<int32_t>(Dtype::kF32)};
+            int64_t plan = hcs[r]->plan_build(counts, dtypes, 2,
+                                              PlanWire::kQ8EF,
+                                              /*prepacked=*/false,
+                                              /*hier=*/true);
+            const void* ins[2] = {data.data(), data.data() + counts[0]};
+            void* outs[2] = {out.data(), out.data() + counts[0]};
+            for (int it = 0; it < 2; it++) {
+              for (size_t i = 0; i < elems; i++)
+                data[i] = static_cast<float>(r + 1) * 0.25f;
+              hcs[r]->plan_execute(plan, ins, outs,
+                                   static_cast<double>(world),
+                                   /*has_divisor=*/true, timeout);
+            }
+            hcs[r]->plan_reset_feedback(plan);
+            hcs[r]->plan_free(plan);
+            g_ok++;
+          } catch (const std::exception&) {
+            // chaos abort / leader-death FIN across tiers — expected;
+            // the topology is dead until the next round's configure.
+            g_failed++;
+          }
+        }
+        in_ops--;
+        barrier.arrive_and_wait();
+      }
+    });
+  }
+  for (auto& t : ranks) t.join();
+  stop = true;
+  chaos.join();
+  hcs.clear();
+  store.shutdown();
+}
+
+// Regression probe for the manager state lock: a min_replicas=2
+// lighthouse with one registered group long-polls the quorum for the full
+// client timeout — the STALL — while a status publish and a
+// checkpoint-metadata RPC on another connection must complete promptly.
+// Before the fix, handle_quorum held mu_ across the lighthouse round
+// trip, so both serialized behind the stall.
+void stalled_lighthouse_round() {
+  LighthouseOpt opt;
+  opt.min_replicas = 2;  // never satisfiable here: the forward stalls
+  opt.join_timeout_ms = 60000;
+  opt.quorum_tick_ms = 10;
+  opt.heartbeat_timeout_ms = 5000;
+  Lighthouse lh("[::]:0", opt);
+  StoreServer store("[::]:0");
+  ManagerServer ms("stall", lh.address(), "localhost", "[::]:0",
+                   store.address(), /*world_size=*/1,
+                   /*heartbeat_interval_ms=*/20,
+                   /*connect_timeout_ms=*/3000, "", 0, /*region=*/"east");
+  std::string maddr = ms.address();
+
+  std::atomic<bool> quorum_done{false};
+  std::thread q([&] {
+    try {
+      ManagerClient c(maddr, 3000);
+      c.quorum(0, 0, "stall-meta", false, false, 2500);
+      g_failed++;  // a quorum can never form
+    } catch (const std::exception&) {
+      g_ok++;  // DEADLINE_EXCEEDED — expected
+    }
+    quorum_done = true;
+  });
+  sleep_ms(300);  // the forward is now parked inside the lighthouse call
+  expect(!quorum_done.load(), "stall never engaged (probe broken)");
+  auto t0 = std::chrono::steady_clock::now();
+  ms.set_status_json("{\"probe\":1}");
+  try {
+    ManagerClient c(maddr, 2000);
+    expect(c.checkpoint_metadata(0, 2000) == "stall-meta",
+           "checkpoint metadata mismatch under stall");
+  } catch (const std::exception& e) {
+    fprintf(stderr, "metadata rpc under stall failed: %s\n", e.what());
+    g_bad = true;
+  }
+  int64_t elapsed_ms =
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          std::chrono::steady_clock::now() - t0)
+          .count();
+  expect(elapsed_ms < 1500,
+         "status/metadata serialized behind the stalled lighthouse quorum "
+         "(state lock held across the RPC)");
+  g_ok++;
+  q.join();
+  ms.shutdown();
+  store.shutdown();
+  lh.shutdown();
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -622,8 +801,11 @@ int main(int argc, char** argv) {
   size_t elems = argc > 4 ? static_cast<size_t>(atoll(argv[4])) : 49152;
 
   collectives_stress(rounds, world, stripes, elems);
+  hier_collectives_churn(rounds > 6 ? 6 : rounds, world, stripes,
+                         elems / 4);
   control_plane_churn(3);
   hierarchical_churn(3);
+  stalled_lighthouse_round();
   shm_churn(6, world);
 
   fprintf(stderr,

@@ -91,6 +91,10 @@ class TFT_SCOPED_CAPABILITY UniqueMutexLock {
   UniqueMutexLock& operator=(const UniqueMutexLock&) = delete;
 
   void unlock() TFT_RELEASE() { lk_.unlock(); }
+  // Re-acquire after an explicit unlock() (e.g. releasing the state lock
+  // across a slow RPC). Guarded state must be revalidated afterwards —
+  // the manager's quorum-generation check is the canonical pattern.
+  void lock() TFT_ACQUIRE() { lk_.lock(); }
 
   // For CondVar only: waiting temporarily releases and reacquires the
   // native lock, which the analysis (correctly) treats as held across the

@@ -488,3 +488,130 @@ class TestProbeRefresh:
         monkeypatch.setenv("TORCHFT_DDP_REPROBE_STEPS", "128")
         ddp = AdaptiveDDP(_ManagerStub(), _state(), _grad_fn, mode="blocking")
         assert ddp._reprobe_steps == 128
+
+
+class TestPlanHierCandidate:
+    """The topology-aware candidate: joins the race only on region-labeled
+    members (construction-time, cohort-uniform like every schedule knob),
+    and on a cohort that cannot run the two-tier schedule every one of its
+    probe steps records the failure sentinel — it can never win, and
+    nothing crashes."""
+
+    def _scripted(self, region="", hier_works=True):
+        from torchft_tpu.collectives import _completed
+
+        class ScriptedManager:
+            _region = region
+
+            def __init__(self):
+                self.qid = 1
+                self.committed = 0
+                self.hier_dispatches = 0
+                self._fail_commit = False
+                self._m = _FakeManager([[0.0] * 6])
+
+            def start_quorum(self, **kw):
+                pass
+
+            def quorum_id(self):
+                return self.qid
+
+            def current_step(self):
+                return self.committed
+
+            def errored(self):
+                return None
+
+            def plan_allreduce(self, tree, op=None, wire=None,
+                               device_pack=None, hier=False):
+                if hier:
+                    self.hier_dispatches += 1
+                    if not hier_works:
+                        # The managed discipline: the dispatch error
+                        # latches, the Work resolves to the failure
+                        # default, and the commit vote discards the step.
+                        self._fail_commit = True
+                        return _completed(None)
+                return _completed(tree)
+
+            def allreduce(self, tree, op=None, wire=None):
+                return _completed(tree)
+
+            def allgather(self, tree):
+                return _completed([tree])
+
+            def should_commit(self, **kw):
+                failed, self._fail_commit = self._fail_commit, False
+                if not failed:
+                    self.committed += 1
+                return not failed
+
+            def is_healing(self):
+                return False
+
+            def metrics(self):
+                return self._m.metrics()
+
+            def reset_plan_feedback(self):
+                pass
+
+        return ScriptedManager()
+
+    def test_candidate_only_on_region_labeled_members(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_REGION", raising=False)
+        state = _state()
+        ddp = AdaptiveDDP(
+            self._scripted(region=""), state, _grad_fn, device_pack="off"
+        )
+        assert "plan_hier" not in ddp._candidates
+        ddp2 = AdaptiveDDP(
+            self._scripted(region="east"), state, _grad_fn,
+            device_pack="off",
+        )
+        assert ddp2._candidates.index("plan_hier") == \
+            ddp2._candidates.index("plan") + 1
+
+    def test_env_label_enables_candidate(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_REGION", "west")
+        ddp = AdaptiveDDP(
+            self._scripted(region=""), _state(), _grad_fn,
+            device_pack="off",
+        )
+        assert "plan_hier" in ddp._candidates
+
+    def test_unusable_cohort_records_sentinel_never_wins(self, monkeypatch):
+        import jax.numpy as jnp
+
+        monkeypatch.delenv("TORCHFT_REGION", raising=False)
+        mgr = self._scripted(region="east", hier_works=False)
+        state = _state()
+        ddp = AdaptiveDDP(mgr, state, _grad_fn, probe_steps=2,
+                          device_pack="off")
+        assert "plan_hier" in ddp._candidates
+        x = jnp.ones((4, 8), jnp.float32)
+        # anchor + 4 candidates x 2 probe steps (+ slack for the
+        # error-echo step the reconfigure-free script never emits)
+        for _ in range(1 + 2 * len(ddp._candidates) + 2):
+            ddp.step(x)
+        ddp.flush()
+        assert ddp.mode is not None
+        assert ddp.mode != "plan_hier", (
+            "a candidate whose every probe step failed won the argmin"
+        )
+        assert mgr.hier_dispatches >= 1  # it really was probed
+        hier_idx = ddp._candidates.index("plan_hier")
+        assert ddp.decision["probe_s"][ddp._candidates[hier_idx]] >= \
+            AdaptiveDDP._PROBE_FAILED_S
+
+    def test_pinned_mode_accepts_plan_hier(self):
+        mgr = self._scripted(region="east", hier_works=True)
+        ddp = AdaptiveDDP(mgr, _state(), _grad_fn, mode="plan_hier",
+                          device_pack="off")
+        import jax.numpy as jnp
+
+        x = jnp.ones((4, 8), jnp.float32)
+        for _ in range(3):
+            ddp.step(x)
+        ddp.flush()
+        assert ddp.mode == "plan_hier"
+        assert mgr.hier_dispatches == 3

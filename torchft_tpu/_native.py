@@ -118,6 +118,7 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_char_p,  # root fallback addr ("" = none)
         ctypes.c_int64,   # lease ttl ms (<=0 = lighthouse default)
+        ctypes.c_char_p,  # region label ("" = unlabeled)
     ]
     lib.tft_manager_address.restype = ctypes.c_void_p
     lib.tft_manager_address.argtypes = [ctypes.c_void_p]
@@ -276,6 +277,37 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_int64,  # stripes: parallel ring connections per neighbor
     ]
+    # Two-tier (topology-aware) configure + ops: a region map compiles
+    # into intra-region + inter-region (leader) rings alongside the flat
+    # one (consumed by torchft_tpu.collectives.HostCollectives).
+    lib.tft_hc_configure_hier.restype = ctypes.c_int
+    lib.tft_hc_configure_hier.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,  # stripes (flat + intra tiers)
+        ctypes.c_int64,  # stripes_inter (<=0 = stripes)
+        ctypes.c_char_p,  # regions JSON array (one label per rank; "" = flat)
+    ]
+    lib.tft_hc_hier_capable.restype = ctypes.c_int64
+    lib.tft_hc_hier_capable.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_allreduce_hier.restype = ctypes.c_int
+    lib.tft_hc_allreduce_hier.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,    # inter-hop wire: 0 native, 1 bf16, 2 q8
+        ctypes.c_int64,
+    ]
+    lib.tft_hc_last_hier_json.restype = ctypes.c_int
+    lib.tft_hc_last_hier_json.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
     lib.tft_hc_allreduce.restype = ctypes.c_int
     lib.tft_hc_allreduce.argtypes = [
         ctypes.c_void_p,
@@ -397,6 +429,16 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,                  # leaf count
         ctypes.c_int,                    # wire: 0 native, 1 bf16, 2 q8, 3 q8+EF
     ]
+    # Hierarchical plans: the two-tier schedule behind the one-call
+    # execute (wire applies at the leader's inter hop only).
+    lib.tft_plan_build_hier.restype = ctypes.c_int64
+    lib.tft_plan_build_hier.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),  # per-leaf flat element counts
+        ctypes.POINTER(ctypes.c_int32),  # per-leaf native dtype codes
+        ctypes.c_int64,                  # leaf count
+        ctypes.c_int,                    # wire: 0 native, 1 bf16, 2 q8, 3 q8+EF
+    ]
     lib.tft_plan_execute_pre.restype = ctypes.c_int
     lib.tft_plan_execute_pre.argtypes = [
         ctypes.c_void_p,
@@ -503,6 +545,11 @@ class QuorumResult:
     max_rank: Optional[int] = None
     max_world_size: int = 0
     heal: bool = False
+    # Region label of EVERY participant, indexed by replica rank (empty
+    # strings for unlabeled members; empty list from pre-region servers).
+    # What Manager.configure hands the data plane for the two-tier
+    # collective schedule.
+    replica_regions: List[str] = field(default_factory=list)
 
     @classmethod
     def _from_json(cls, raw: str) -> "QuorumResult":
@@ -519,6 +566,7 @@ class QuorumResult:
             max_rank=d.get("max_rank"),
             max_world_size=d["max_world_size"],
             heal=d["heal"],
+            replica_regions=list(d.get("replica_regions", [])),
         )
 
 
@@ -713,6 +761,7 @@ class Manager:
         connect_timeout: timedelta = timedelta(seconds=60),
         root_addr: str = "",
         lease_ttl: Optional[timedelta] = None,
+        region: str = "",
     ) -> None:
         """``lighthouse_addr`` is this group's assigned lighthouse (the
         flat/root service, or a REGION lighthouse under a hierarchical
@@ -720,7 +769,11 @@ class Manager:
         demotes the group to direct-root registration until it returns.
         ``lease_ttl`` (None = lighthouse default) is how long the group
         stays live without a renewal; renewals are jittered and back off
-        exponentially while the lighthouse is unreachable."""
+        exponentially while the lighthouse is unreachable. ``region``
+        ("" = unlabeled) is the group's topology label: it rides the
+        quorum requester into every member's QuorumMember, and the quorum
+        result's region map is what the data plane compiles into the
+        two-tier collective schedule."""
         self._handle = _lib.tft_manager_create(
             replica_id.encode(),
             lighthouse_addr.encode(),
@@ -732,6 +785,7 @@ class Manager:
             _ms(connect_timeout),
             root_addr.encode(),
             _ms(lease_ttl) if lease_ttl is not None else 0,
+            region.encode(),
         )
         if not self._handle:
             _check(2)

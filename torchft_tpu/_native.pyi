@@ -248,7 +248,8 @@ class _NativeLib:
         heartbeat_interval_ms: int,
         connect_timeout_ms: int,
         root_addr: bytes,
-        lease_ttl_ms: int
+        lease_ttl_ms: int,
+        region: bytes
     ) -> Any: ...
     def tft_manager_address(self, handle: Any) -> Any: ...
     def tft_manager_shutdown(self, handle: Any) -> None: ...
@@ -335,6 +336,29 @@ class _NativeLib:
         timeout_ms: int,
         stripes: int
     ) -> int: ...
+    def tft_hc_configure_hier(
+        self,
+        handle: Any,
+        store_addr: bytes,
+        rank: int,
+        world_size: int,
+        timeout_ms: int,
+        stripes: int,
+        stripes_inter: int,
+        regions_json: bytes
+    ) -> int: ...
+    def tft_hc_hier_capable(self, handle: Any) -> int: ...
+    def tft_hc_allreduce_hier(
+        self,
+        handle: Any,
+        data: Any,
+        count: int,
+        dtype: int,
+        op: int,
+        wire: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_hc_last_hier_json(self, handle: Any, out: Any) -> int: ...
     def tft_hc_allreduce(
         self,
         handle: Any,
@@ -411,6 +435,14 @@ class _NativeLib:
         timeout_ms: int
     ) -> int: ...
     def tft_plan_build_pre(
+        self,
+        handle: Any,
+        counts: Any,
+        dtypes: Any,
+        n_leaves: int,
+        wire: int
+    ) -> int: ...
+    def tft_plan_build_hier(
         self,
         handle: Any,
         counts: Any,

@@ -201,9 +201,54 @@ class Collectives(ABC):
     """
 
     @abstractmethod
-    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+    def configure(
+        self,
+        store_addr: str,
+        rank: int,
+        world_size: int,
+        regions: Optional[Sequence[str]] = None,
+    ) -> None:
         """(Re)builds the communicator for a new membership. ``store_addr``
-        is ``host:port/prefix`` with a prefix unique to the quorum."""
+        is ``host:port/prefix`` with a prefix unique to the quorum.
+
+        ``regions`` (optional): one topology label per rank — the quorum's
+        region map. Backends that understand topology (the host ring)
+        compile it into a two-tier schedule when every member is labeled
+        and >= 2 regions are present; every other backend accepts and
+        ignores it (the kwarg is part of the reconfigure contract so the
+        manager can hand the map to whichever plane it drives)."""
+
+    def hier_capable(self) -> bool:
+        """Whether the LAST configure built a topology-aware (two-tier)
+        schedule — i.e. a region map with >= 2 distinct labels reached a
+        backend that compiles one. Backends without the capability return
+        False; callers feature-detect (the plan_hier probe candidate's
+        sentinel discipline rides this)."""
+        return False
+
+    def allreduce_hier(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Like :meth:`allreduce` but over the TWO-TIER schedule (intra-
+        region reduce-scatter -> intra allgather -> inter-region ring
+        among one leader per region -> intra broadcast): the slow
+        inter-region links carry (L-1)/L of the payload per ring phase
+        per LEADER instead of 2*(W-1)/W per MEMBER. ``wire`` selects the
+        inter hop's encoding only (``None`` | ``"bf16"`` | ``"q8"``;
+        intra stays full precision — quantization noise is paid once, on
+        the link that needs it). Results are bit-identical across members
+        and across runs; the summation ORDER differs from the flat ring
+        (two-tier reduction tree), so values match the flat result at the
+        accumulation-reordering tolerance class, not bit-for-bit. Raises
+        when the cohort has no usable region map (callers under the
+        managed discipline see the error latched — the sentinel path)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no two-tier schedule"
+        )
 
     @abstractmethod
     def allreduce(
@@ -239,6 +284,7 @@ class Collectives(ABC):
         divisor: Optional[float] = None,
         wire: Optional[str] = None,
         device_pack: Optional[bool] = None,
+        hier: bool = False,
     ) -> Work:
         """Like :meth:`allreduce` (SUM/AVG only) but through a persistent
         precompiled comm plan: the leaf->bucket layout, dtype casts, wire
@@ -255,7 +301,12 @@ class Collectives(ABC):
         encoding onto the accelerator where supported, so the
         device->host leg costs wire bytes instead of f32 bytes —
         results stay bit-identical, backends without the capability
-        host-pack."""
+        host-pack. ``hier`` runs the plan over the TWO-TIER schedule
+        (requires a hier-capable configure — see
+        :meth:`allreduce_hier`): the wire then applies at the leader's
+        inter-region hop only, staging and the intra tier stay native
+        width, and ``q8ef``'s error-feedback carry refines each REGION's
+        contribution at its leader."""
         raise NotImplementedError(
             f"{type(self).__name__} has no persistent comm plans"
         )
@@ -427,6 +478,10 @@ class _DevicePacker:
 
 # Python wire names -> native PlanWire codes (collectives.h).
 _PLAN_WIRES = {None: 0, "bf16": 1, "q8": 2, "q8ef": 3}
+
+# Python wire names -> native HierWire codes (the INTER hop's encoding of
+# the two-tier schedule; intra always rides native dtypes).
+_HIER_WIRES = {None: 0, "bf16": 1, "q8": 2}
 
 # Wires the DEVICE pack (Pallas kernels emitting the wire encoding on the
 # accelerator) supports. Plain "q8" is deliberately absent: its host-pack
@@ -605,17 +660,23 @@ class _CommPlan:
 
     def __init__(self, handle: Any, sig: Sequence[Any], treedef: Any,
                  wire: Optional[str], stripes: int = 1, world: int = 1,
-                 prepacked: bool = False) -> None:
+                 prepacked: bool = False, hier: bool = False) -> None:
         self.treedef = treedef
         self.sig = tuple(sig)
         self.wire = wire
         self.prepacked = prepacked
+        self.hier = hier
         n = len(self.sig)
         counts = [int(np.prod(s)) if s else 1 for s, _ in self.sig]
         # KeyError on a non-native dtype: the caller treats it as
         # "unsupported signature" and falls back to the legacy path.
         codes = [_NATIVE_DTYPES[dt] for _, dt in self.sig]
-        build = _lib.tft_plan_build_pre if prepacked else _lib.tft_plan_build
+        assert not (prepacked and hier)
+        build = (
+            _lib.tft_plan_build_hier if hier
+            else _lib.tft_plan_build_pre if prepacked
+            else _lib.tft_plan_build
+        )
         plan_id = build(
             handle,
             (ctypes.c_int64 * n)(*counts),
@@ -721,6 +782,7 @@ class HostCollectives(OpStatsMixin, Collectives):
         pipeline_chunks: Optional[int] = None,
         pipeline_min_bytes: int = 4 << 20,
         stripes: Optional[int] = None,
+        stripes_inter: Optional[int] = None,
     ) -> None:
         """``pipeline_chunks`` > 1 splits large device-packed buffers so
         device->host DMA, the TCP ring, and host->device upload overlap
@@ -745,7 +807,13 @@ class HostCollectives(OpStatsMixin, Collectives):
         channels do. Default: env ``TORCHFT_HC_STRIPES`` (else 4). Every
         member of a ring must use the same value; configure() negotiates
         it through the rendezvous store (exactly like the pipeline knobs)
-        and fails fast on a mismatch."""
+        and fails fast on a mismatch.
+
+        ``stripes_inter`` is the INTER-REGION (leader) ring's parallel-
+        connection count under a two-tier configure — the slow wide-area
+        hop is exactly where striping pays, so it gets its own knob.
+        Default: env ``TORCHFT_HC_STRIPES_INTER`` (else ``stripes``).
+        Store-negotiated like the rest of the schedule knobs."""
         self._handle = _lib.tft_hc_create()
         self._timeout = timeout
         self._connect_timeout = connect_timeout
@@ -758,6 +826,13 @@ class HostCollectives(OpStatsMixin, Collectives):
         if stripes is None:
             stripes = int(os.environ.get("TORCHFT_HC_STRIPES", "4"))
         self._stripes = min(max(int(stripes), 1), _MAX_STRIPES)
+        if stripes_inter is None:
+            stripes_inter = int(
+                os.environ.get("TORCHFT_HC_STRIPES_INTER", "0")
+            )
+        # <= 0: follow the main stripe knob (resolved at configure, so
+        # the negotiated string stays honest about the effective value).
+        self._stripes_inter = min(int(stripes_inter), _MAX_STRIPES)
         self._world_size = 0
         self._rank = -1
         # One thread: collectives must issue in submission order.
@@ -801,10 +876,30 @@ class HostCollectives(OpStatsMixin, Collectives):
 
     # -- lifecycle --
 
-    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+    def configure(
+        self,
+        store_addr: str,
+        rank: int,
+        world_size: int,
+        regions: Optional[Sequence[str]] = None,
+    ) -> None:
         # Abort synchronously so a wedged op can't block the executor, then
         # run the (blocking) rendezvous on the op thread to keep ordering.
         _lib.tft_hc_abort(self._handle)
+        # The region map is part of the schedule contract (it decides
+        # which tiers exist and who leads them); normalize it here so the
+        # negotiated fingerprint below and the native build see one form.
+        region_list: List[str] = (
+            [str(r) for r in regions] if regions else []
+        )
+        if region_list and len(region_list) != world_size:
+            raise ValueError(
+                f"regions must carry one label per rank "
+                f"({len(region_list)} labels for world_size {world_size})"
+            )
+        stripes_inter = (
+            self._stripes_inter if self._stripes_inter > 0 else self._stripes
+        )
 
         def do_configure() -> None:
             # The pipeline parameters are part of the ring's op schedule
@@ -813,6 +908,9 @@ class HostCollectives(OpStatsMixin, Collectives):
             # every member must agree — validate against rank 0's via the
             # rendezvous store and fail fast instead of desyncing. A solo
             # member has no peers (and possibly no real store) to check.
+            # The two-tier inputs (inter stripes + the region map) ride
+            # the same fingerprint: a member with a drifted map would
+            # otherwise build a different topology and wedge mid-op.
             if world_size > 1:
                 hostport, _, prefix = store_addr.partition("/")
                 store = _native.StoreClient(
@@ -820,7 +918,8 @@ class HostCollectives(OpStatsMixin, Collectives):
                 )
                 mine = (
                     f"{self._pipeline_chunks}:{self._pipeline_min_bytes}"
-                    f":{self._stripes}"
+                    f":{self._stripes}:{stripes_inter}"
+                    f":{','.join(region_list)}"
                 )
                 key = f"{prefix}/pipecfg" if prefix else "pipecfg"
                 if rank == 0:
@@ -834,16 +933,20 @@ class HostCollectives(OpStatsMixin, Collectives):
                             f"pipeline config mismatch: rank {rank} has "
                             f"{mine}, rank 0 has {theirs} — all ring members "
                             "must construct HostCollectives with the same "
-                            "pipeline_chunks / pipeline_min_bytes / stripes"
+                            "pipeline_chunks / pipeline_min_bytes / stripes "
+                            "/ stripes_inter and see the same region map"
                         )
             _check(
-                _lib.tft_hc_configure(
+                _lib.tft_hc_configure_hier(
                     self._handle,
                     store_addr.encode(),
                     rank,
                     world_size,
                     _ms(self._connect_timeout),
                     self._stripes,
+                    stripes_inter,
+                    json.dumps(region_list).encode()
+                    if region_list else b"",
                 )
             )
             # Assign on the op thread: ops queued after this configure see
@@ -1247,6 +1350,247 @@ class HostCollectives(OpStatsMixin, Collectives):
             )
         )
 
+    # -- two-tier (topology-aware) ops --
+
+    def hier_capable(self) -> bool:
+        """Whether the last configure() received a usable region map (>= 2
+        distinct labels, every rank labeled) and built the two-tier
+        topology alongside the flat ring."""
+        return bool(_lib.tft_hc_hier_capable(self._handle))
+
+    def _last_hier_dict(self) -> dict:
+        out = ctypes.c_void_p()
+        _check(_lib.tft_hc_last_hier_json(self._handle, ctypes.byref(out)))
+        return json.loads(_native._take_string(out))
+
+    @staticmethod
+    def _hier_stats_fields(h: dict) -> dict:
+        """The op-stat fragment shared by the bulk and plan hier paths:
+        per-tier phase seconds + MEASURED per-tier tx bytes (duplex's
+        per-connection counters, summed) — ONE schema, so consumers
+        (bench accounting, diagnosis tooling) never see the two paths
+        drift."""
+        return {
+            "wire_bytes": h["intra_tx_bytes"] + h["inter_tx_bytes"],
+            "intra_rs_s": h["intra_rs_s"],
+            "intra_ag_s": h["intra_ag_s"],
+            "inter_ring_s": h["inter_ring_s"],
+            "intra_bcast_s": h["intra_bcast_s"],
+            "tiers": {
+                "intra": {
+                    "tx_bytes": h["intra_tx_bytes"],
+                    "world": h["intra_world"],
+                    "eff": h["eff_intra"],
+                    "rs_s": h["intra_rs_s"],
+                    "ag_s": h["intra_ag_s"],
+                    "bcast_s": h["intra_bcast_s"],
+                },
+                "inter": {
+                    "tx_bytes": h["inter_tx_bytes"],
+                    "rs_tx_bytes": h["inter_rs_tx_bytes"],
+                    "ag_tx_bytes": h["inter_ag_tx_bytes"],
+                    "world": h["inter_world"],
+                    "eff": h["eff_inter"],
+                    "ring_s": h["inter_ring_s"],
+                    "leader": h["leader"],
+                },
+            },
+        }
+
+    @staticmethod
+    def _merge_hier_stats(acc: Optional[dict], h: dict) -> dict:
+        """Accumulates per-group native hier breakdowns (one native op per
+        dtype group overwrites last_hier_) into one per-op record."""
+        if acc is None:
+            return dict(h)
+        for k in (
+            "intra_rs_s", "intra_ag_s", "inter_ring_s", "intra_bcast_s",
+            "intra_tx_bytes", "inter_tx_bytes", "inter_rs_tx_bytes",
+            "inter_ag_tx_bytes", "payload_bytes",
+        ):
+            acc[k] += h[k]
+        return acc
+
+    def allreduce_hier(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Two-tier allreduce (see Collectives.allreduce_hier): intra-
+        region reduce-scatter -> intra allgather -> striped inter-region
+        ring among one deterministic leader per region (lowest
+        replica-id) -> chunk-pipelined intra broadcast, composed from the
+        SAME native rs/ag stripe bodies as the flat ring. ``wire``
+        applies to the inter hop only (``"bf16"`` halves its bytes,
+        ``"q8"`` quarters them with per-chunk scales) — quantization
+        noise is paid once per sync, on the slow link. Requires a
+        hier-capable configure; raises otherwise (the managed dispatch
+        latches it — the probe candidates' sentinel discipline)."""
+        timeout_ms = _ms(self._timeout)
+        if wire not in _HIER_WIRES:
+            raise ValueError(f"unsupported hier wire: {wire!r}")
+        if op == ReduceOp.AVG:
+            if divisor is not None:
+                raise ValueError("divisor only composes with ReduceOp.SUM")
+            divisor, op = float(self._world_size), ReduceOp.SUM
+        if divisor is not None and op != ReduceOp.SUM:
+            raise ValueError("divisor only composes with ReduceOp.SUM")
+        if wire is not None and op != ReduceOp.SUM:
+            raise ValueError("hier wire bf16/q8 supports SUM/AVG only")
+        return self._submit(
+            lambda: self._allreduce_hier_sync(tree, op, divisor, wire,
+                                              timeout_ms)
+        )
+
+    def _allreduce_hier_sync(
+        self,
+        tree: Any,
+        op: ReduceOp,
+        divisor: Optional[float],
+        wire: Optional[str],
+        timeout_ms: int,
+    ) -> Any:
+        if self._world_size == 1:
+            if divisor is not None and divisor != 1:
+                import jax
+
+                return jax.tree_util.tree_map(
+                    lambda l: _divide_leaf(l, divisor)
+                    if hasattr(l, "__truediv__")
+                    else l,
+                    tree,
+                )
+            return tree
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            return tree
+        native_op = int(op)
+        all_jax = all(_is_jax_array(l) for l in leaves)
+        f32 = np.dtype(np.float32)
+
+        t0 = time.perf_counter()
+        if all_jax:
+            key = (
+                "hier_q8" if wire == "q8" else "hier", treedef,
+                tuple((l.shape, np.dtype(l.dtype)) for l in leaves),
+            )
+            packer = self._packers.get(key)
+            if packer is None:
+                packer = self._packers[key] = _DevicePacker(
+                    leaves, force_f32=(wire == "q8")
+                )
+            bufs = packer.pack(leaves)
+            names = sorted(bufs)
+            for name in names:  # queue every DMA before blocking on one
+                bufs[name].copy_to_host_async()
+            host = {}
+            for name in names:
+                arr = np.asarray(bufs[name])
+                if not arr.flags.writeable or not arr.flags.c_contiguous:
+                    arr = np.array(arr)  # the schedule reduces in place
+                host[name] = arr
+            arrays = was_jax = None
+        else:
+            packer = None
+            arrays = [_as_numpy(l) for l in leaves]
+            was_jax = [_is_jax_array(l) for l in leaves]
+            groups: dict = {}
+            for i, a in enumerate(arrays):
+                if wire == "q8":
+                    acc = f32  # the quantized inter hop reduces ONE f32 group
+                else:
+                    acc = (a.dtype if a.dtype in _NATIVE_DTYPES else f32)
+                groups.setdefault(str(acc), []).append(i)
+            host = {
+                name: np.concatenate(
+                    [arrays[i].astype(np.dtype(name), copy=False).ravel()
+                     for i in idxs]
+                )
+                for name, idxs in groups.items()
+            }
+            names = sorted(host)
+        d2h_s = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        hier_stats: Optional[dict] = None
+        for name in names:
+            buf = host[name]
+            # The wire applies where it means something: the q8 grouping
+            # is a single f32 buffer by construction, and bf16 compresses
+            # f32 groups only (others ride the inter hop at native width).
+            if wire == "q8":
+                gw = _HIER_WIRES["q8"]
+            elif wire == "bf16" and buf.dtype == f32:
+                gw = _HIER_WIRES["bf16"]
+            else:
+                gw = _HIER_WIRES[None]
+            _check(
+                _lib.tft_hc_allreduce_hier(
+                    self._handle,
+                    buf.ctypes.data_as(ctypes.c_void_p),
+                    buf.size,
+                    _NATIVE_DTYPES[buf.dtype],
+                    native_op,
+                    gw,
+                    timeout_ms,
+                )
+            )
+            hier_stats = self._merge_hier_stats(
+                hier_stats, self._last_hier_dict()
+            )
+            if divisor is not None and divisor != 1:
+                host[name] = self._apply_divisor(buf, divisor)
+        ring_s = time.perf_counter() - t1
+
+        t2 = time.perf_counter()
+        if all_jax:
+            import jax.numpy as jnp
+
+            out = _unflatten(
+                treedef,
+                packer.unpack(
+                    {name: jnp.asarray(host[name]) for name in names}
+                ),
+            )
+        else:
+            out_leaves: List[Any] = [None] * len(arrays)
+            for name, idxs in groups.items():
+                buf = host[name]
+                offset = 0
+                for i in idxs:
+                    n = arrays[i].size
+                    leaf = (
+                        buf[offset:offset + n]
+                        .reshape(arrays[i].shape)
+                        .astype(arrays[i].dtype, copy=False)
+                    )
+                    offset += n
+                    if was_jax[i]:
+                        import jax.numpy as jnp
+
+                        leaf = jnp.asarray(leaf)
+                    out_leaves[i] = leaf
+            out = _unflatten(treedef, out_leaves)
+        total_bytes = sum(host[n].nbytes for n in names)
+        st: dict = {
+            "op": "allreduce_hier",
+            "wire": wire,
+            "bytes": total_bytes,
+            "d2h_bytes": total_bytes if all_jax else 0,
+            # MEASURED traffic this member sent, per tier (duplex's
+            # per-connection counters, summed) — the number that shows
+            # the inter-tier byte reduction directly, not a model.
+            "d2h": d2h_s,
+            "ring": ring_s,
+            "h2d": time.perf_counter() - t2,
+        }
+        if hier_stats is not None:
+            st.update(self._hier_stats_fields(hier_stats))
+        self._record_op_stats(st)
+        return out
+
     # -- planned ops --
 
     def plan_allreduce(
@@ -1256,6 +1600,7 @@ class HostCollectives(OpStatsMixin, Collectives):
         divisor: Optional[float] = None,
         wire: Optional[str] = None,
         device_pack: Optional[bool] = None,
+        hier: bool = False,
     ) -> Work:
         """The plan-path allreduce (see Collectives.plan_allreduce): one
         native call per step over a cached, precompiled plan. Bit-identical
@@ -1264,6 +1609,13 @@ class HostCollectives(OpStatsMixin, Collectives):
         Unsupported signatures (non-native leaf dtypes; q8 wires with
         non-float leaves) silently take the legacy path with equivalent
         semantics where one exists (``wire=None``), else raise.
+
+        ``hier`` executes the plan over the TWO-TIER schedule (requires a
+        hier-capable configure; the error latches under the managed
+        discipline otherwise). The wire applies at the leader's
+        inter-region hop only; ``device_pack`` is ignored on this path —
+        there is no pre-packed hier form, because the wire encoding
+        happens at the inter boundary, not at pack.
 
         ``device_pack``: ``True`` packs the wire encoding ON DEVICE
         (Pallas quantize/cast kernels + prepacked plan leaves) so the
@@ -1296,7 +1648,7 @@ class HostCollectives(OpStatsMixin, Collectives):
         device_pack = _resolve_device_pack_setting(device_pack)
         return self._submit(
             lambda: self._plan_allreduce_sync(
-                tree, divisor, wire, timeout_ms, device_pack
+                tree, divisor, wire, timeout_ms, device_pack, hier
             )
         )
 
@@ -1340,23 +1692,25 @@ class HostCollectives(OpStatsMixin, Collectives):
 
     def _plan_for(
         self, leaves: Sequence[Any], treedef: Any, wire: Optional[str],
-        prepacked: bool = False,
+        prepacked: bool = False, hier: bool = False,
     ) -> Optional[_CommPlan]:
         # The signature MUST stay in the key: executing a plan against a
         # same-treedef tree with different shapes/dtypes would pack with
         # the wrong per-leaf counts (reading past leaf buffers). It is
         # computed once here and handed to the plan, never recomputed.
         sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
-        key = (wire, treedef, sig, prepacked) if prepacked else (
-            wire, treedef, sig
-        )
+        key: Any = (wire, treedef, sig)
+        if prepacked:
+            key = (wire, treedef, sig, "pre")
+        elif hier:
+            key = (wire, treedef, sig, "hier")
         if key in self._plans:
             return self._plans[key]
         try:
             plan: Optional[_CommPlan] = _CommPlan(
                 self._handle, sig, treedef, wire,
                 stripes=self._stripes, world=self._world_size,
-                prepacked=prepacked,
+                prepacked=prepacked, hier=hier,
             )
         except (KeyError, RuntimeError):
             # Non-native leaf dtype, or a wire/dtype combination the
@@ -1373,10 +1727,15 @@ class HostCollectives(OpStatsMixin, Collectives):
         wire: Optional[str],
         timeout_ms: int,
         device_pack: Optional[bool] = None,
+        hier: bool = False,
     ) -> Any:
         leaves, treedef = _flatten(tree)
         if not leaves:
             return tree
+        if hier:
+            return self._plan_hier_sync(
+                leaves, treedef, tree, divisor, wire, timeout_ms
+            )
         if self._resolve_device_pack(device_pack, leaves, wire):
             packer = self._device_packer_for(leaves, treedef, wire)
             plan = (
@@ -1451,6 +1810,90 @@ class HostCollectives(OpStatsMixin, Collectives):
             "py_staging_allocs": staging_allocs,
             "plan_execs": plan.execs,
         })
+        return _unflatten(treedef, outs)
+
+    def _plan_hier_sync(
+        self,
+        leaves: Sequence[Any],
+        treedef: Any,
+        tree: Any,
+        divisor: Optional[float],
+        wire: Optional[str],
+        timeout_ms: int,
+    ) -> Any:
+        """Hier plan execute: ONE native call runs the whole two-tier
+        schedule per group (pack streamed into the intra reduce-scatter,
+        unpack out of the broadcast — the triple pipeline survives the
+        extra tiers), with the wire applied at the leader's inter hop."""
+        if self._world_size > 1 and not self.hier_capable():
+            raise RuntimeError(
+                "plan_allreduce(hier=True) needs a hier-capable configure: "
+                "the quorum's region map had < 2 distinct labels (or "
+                "unlabeled members) — single-region cohorts ride the flat "
+                "plan"
+            )
+        plan = self._plan_for(leaves, treedef, wire, hier=True)
+        if plan is None:
+            if wire is None:
+                # Non-native leaf dtypes: the bulk hier path groups them
+                # into f32 with equivalent semantics.
+                return self._allreduce_hier_sync(
+                    tree, ReduceOp.SUM, divisor, None, timeout_ms
+                )
+            if wire in ("q8", "q8ef"):
+                raise ValueError(
+                    "hier plan wire 'q8'/'q8ef' requires f32/bf16 leaves"
+                )
+            raise ValueError(
+                "hier plan wire 'bf16' requires native-dtype leaves"
+            )
+        t0 = time.perf_counter()
+        staging_allocs = 0
+        refs = []  # keep host views alive across the native call
+        in_ptrs = plan.in_ptrs
+        for i, l in enumerate(leaves):
+            a = np.asarray(l)  # zero-copy for numpy / CPU jax leaves
+            if not a.flags.c_contiguous:
+                a = np.ascontiguousarray(a)
+                staging_allocs += 1
+            refs.append(a)
+            in_ptrs[i] = a.ctypes.data
+        t1 = time.perf_counter()
+        outs = plan.out_sets[plan.flip]
+        out_ptrs = plan.out_ptrs[plan.flip]
+        plan.flip ^= 1
+        _check(
+            _lib.tft_plan_execute(
+                self._handle,
+                plan.plan_id,
+                in_ptrs,
+                out_ptrs,
+                float(divisor if divisor is not None else 1.0),
+                0 if divisor is None else 1,
+                timeout_ms,
+            )
+        )
+        ring_s = time.perf_counter() - t1
+        del refs
+        plan.execs += 1
+        st: dict = {
+            "op": "plan_allreduce",
+            "wire": wire,
+            "hier": True,
+            "device_pack": False,
+            "bytes": plan.bytes,
+            "d2h_bytes": plan.bytes,
+            "d2h": t1 - t0,  # pointer gather; host leaves make it ~free
+            "ring": ring_s,  # the single native call: the whole schedule
+            "_buckets_json": self._plan_stats_json(plan.plan_id),
+            "py_staging_allocs": staging_allocs,
+            "plan_execs": plan.execs,
+        }
+        if self._world_size > 1:
+            st.update(self._hier_stats_fields(self._last_hier_dict()))
+        else:
+            st["wire_bytes"] = plan.wire_bytes
+        self._record_op_stats(st)
         return _unflatten(treedef, outs)
 
     def _plan_execute_device(
@@ -2024,11 +2467,44 @@ class DummyCollectives(Collectives):
         self._world_size = world_size
         self.configure_count = 0
         self.op_count = 0
+        self.last_regions: Optional[List[str]] = None
+        self._hier = False
 
-    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+    def configure(
+        self,
+        store_addr: str,
+        rank: int,
+        world_size: int,
+        regions: Optional[Sequence[str]] = None,
+    ) -> None:
         self.configure_count += 1
         self._rank = rank
         self._world_size = world_size
+        self.last_regions = list(regions) if regions else None
+        # Mirror the host ring's capability rule so wrapper-semantics
+        # tests can drive the hier dispatch paths without a real ring.
+        self._hier = bool(
+            regions
+            and len(set(regions)) >= 2
+            and all(regions)
+            and world_size > 1
+        )
+
+    def hier_capable(self) -> bool:
+        return self._hier
+
+    def allreduce_hier(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Lossless fake of the two-tier schedule (sum of one member);
+        raises without a usable region map, like the real backend."""
+        if not self._hier and self._world_size > 1:
+            raise RuntimeError("DummyCollectives: no region map configured")
+        return self.allreduce(tree, op, divisor=divisor)
 
     def allreduce(
         self,
@@ -2056,13 +2532,18 @@ class DummyCollectives(Collectives):
         divisor: Optional[float] = None,
         wire: Optional[str] = None,  # accepted, ignored (lossless fake)
         device_pack: Optional[bool] = None,  # accepted, ignored
+        hier: bool = False,
     ) -> Work:
         """Same lossless semantics as the fake allreduce — wrapper tests
-        exercise the plan-path call shape without a ring."""
+        exercise the plan-path call shape without a ring. ``hier``
+        reproduces the real backend's capability rule (raises on a
+        multi-member cohort without a usable region map)."""
         if op == ReduceOp.AVG:
             if divisor is not None:
                 raise ValueError("divisor only composes with ReduceOp.SUM")
             divisor = float(self._world_size)
+        if hier and not self._hier and self._world_size > 1:
+            raise RuntimeError("DummyCollectives: no region map configured")
         return self.allreduce(tree, ReduceOp.SUM, divisor=divisor)
 
     def reduce_scatter(

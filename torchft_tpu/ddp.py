@@ -133,6 +133,7 @@ class PipelinedDDP:
         compress: Optional[str] = None,
         transport: str = "legacy",
         device_pack: Any = None,
+        hier: bool = False,
     ) -> None:
         """``transport="plan"`` routes the gradient sync through
         ``Manager.plan_allreduce`` — the persistent native comm plan —
@@ -154,7 +155,18 @@ class PipelinedDDP:
         on the host, ``None`` (default) / ``"auto"`` the
         ``TORCHFT_DEVICE_PACK`` env discipline (auto device-packs only
         on a real device backend; every setting is bit-identical, so
-        members need not agree)."""
+        members need not agree).
+
+        ``hier`` (plan transport only) runs the sync over the TWO-TIER
+        topology-aware schedule — intra-region rings plus an inter-region
+        leader ring, with the wire applied on the slow inter hop only
+        (``compress="q8"`` -> the leader-side q8+EF inter wire). Requires
+        the cohort's quorum to carry a usable region map
+        (``TORCHFT_REGION`` on every member, >= 2 regions); otherwise
+        every sync latches an error and the steps are discarded — which
+        is exactly the sentinel AdaptiveDDP's ``plan_hier`` candidate
+        records, so under ``TORCHFT_DDP_MODE=auto`` an un-hierarchical
+        cohort simply never picks it."""
         if compress not in (None, "bf16", "int8", "q8"):
             raise ValueError(f"unsupported compress: {compress!r}")
         if transport not in ("legacy", "plan", "iso"):
@@ -171,11 +183,17 @@ class PipelinedDDP:
             raise ValueError(
                 "transport='iso' needs Manager(iso_collectives=...)"
             )
+        if hier and transport != "plan":
+            raise ValueError(
+                "hier=True rides the plan transport (the two-tier schedule "
+                "is a comm-plan form)"
+            )
         self._manager = manager
         self._state = state
         self._grad_fn = grad_fn
         self._compress_mode = compress
         self._transport = transport
+        self._hier = hier
         self._device_pack = _resolve_device_pack_setting(device_pack)
         self._inflight: Optional[Work] = None
         self._inflight_dtypes: Any = None  # grad dtype TUPLE at dispatch
@@ -268,13 +286,19 @@ class PipelinedDDP:
         self._inflight_transport = self._transport
         if self._transport == "plan":
             # Raw grads in, native cast/quantize at pack: the plan is
-            # the whole wire pipeline, no jitted compress program.
+            # the whole wire pipeline, no jitted compress program. Under
+            # hier the wire moves to the leader's inter-region hop
+            # (device_pack has no hier form and is ignored there).
             wire = {None: None, "bf16": "bf16", "q8": "q8ef"}[
                 self._compress_mode
             ]
-            return self._manager.plan_allreduce(
-                grads, wire=wire, device_pack=self._device_pack
-            )
+            kwargs: dict = {"wire": wire, "device_pack": self._device_pack}
+            if self._hier:
+                # Passed only when set: pre-hier Manager stand-ins (test
+                # scaffolding, older wrappers) keep working on the flat
+                # schedule they know.
+                kwargs["hier"] = True
+            return self._manager.plan_allreduce(grads, **kwargs)
         if self._transport == "iso":
             # Isolated XLA data plane: same compress pipeline as legacy
             # (the backend serves every wire losslessly — the compiled
@@ -466,7 +490,13 @@ class AdaptiveDDP:
     # the SAME lockstep-vote argmin as the schedule choice — on hosts
     # where the interpret-mode kernels are slower than the host pack the
     # probe measures it and host pack wins (the CPU fallback), on real
-    # device links the d2h saving wins. "xla_iso" (the isolated-child
+    # device links the d2h saving wins. "plan_hier" (the plan transport
+    # over the TWO-TIER topology-aware schedule) joins whenever "plan"
+    # does: on a region-labeled multi-region cohort its probe steps
+    # measure the real inter-link saving; on any other cohort every
+    # probe step latches the dispatch error and records the sentinel,
+    # so it can never win — the lockstep vote stays shape-identical on
+    # every member either way. "xla_iso" (the isolated-child
     # XLA data plane) joins only when the manager carries an iso plane:
     # host-ring vs compiled-XLA-path is then LOCKED per cohort by the
     # same vote, never assumed — and an un-spawnable or store-fallback
@@ -492,7 +522,8 @@ class AdaptiveDDP:
         reprobe_steps: Optional[int] = None,
     ) -> None:
         mode = mode or os.environ.get("TORCHFT_DDP_MODE", "auto")
-        if mode not in ("auto", "blocking", "pipelined", "plan", "xla_iso"):
+        if mode not in ("auto", "blocking", "pipelined", "plan",
+                        "plan_hier", "xla_iso"):
             raise ValueError(f"unsupported TORCHFT_DDP_MODE: {mode!r}")
         self._manager = manager
         # One underlying engine; mode switches flip (transport, overlap).
@@ -502,6 +533,27 @@ class AdaptiveDDP:
             c for c in self._CANDIDATES
             if not (c == "plan" and compress == "int8")
         ]
+        region_labeled = bool(
+            getattr(manager, "_region", "") or os.environ.get(
+                "TORCHFT_REGION", ""
+            )
+        )
+        if "plan" in self._candidates and region_labeled:
+            # Topology-aware candidate: the plan transport over the
+            # two-tier schedule. Candidate-list membership is keyed on
+            # CONSTRUCTION (this member carries a region label — set via
+            # TORCHFT_REGION on every member of a regional fleet or on
+            # none, like every other schedule knob), so unlabeled
+            # deployments keep the exact pre-hier probe. Whether the
+            # COHORT is actually hierarchical is only known per quorum: a
+            # labeled member in a single-region (or partially labeled)
+            # cohort probes it anyway, each probe step latches the
+            # dispatch error and records the failure sentinel, so the
+            # candidate can never win there — never a crash, same
+            # discipline as an un-spawnable xla_iso child.
+            self._candidates.insert(
+                self._candidates.index("plan") + 1, "plan_hier"
+            )
         if (
             self._devpack_setting is None  # TORCHFT_DEVICE_PACK=auto
             and "plan" in self._candidates
@@ -522,7 +574,7 @@ class AdaptiveDDP:
             # like every other schedule knob), never on child health —
             # a sick child records sentinels, not a shorter list.
             self._candidates.append("xla_iso")
-        if mode == "plan" and compress == "int8":
+        if mode in ("plan", "plan_hier") and compress == "int8":
             raise ValueError("compress='int8' has no plan transport")
         if mode == "xla_iso":
             if compress == "int8":
@@ -591,16 +643,22 @@ class AdaptiveDDP:
             return d.step(*batch)
         # Blocking schedule (settle in-step); legacy, plan or iso
         # transport.
-        if mode in ("plan", "plan_devpack"):
+        if mode in ("plan", "plan_devpack", "plan_hier"):
             d._transport = "plan"
         elif mode == "xla_iso":
             d._transport = "iso"
         else:
             d._transport = "legacy"
+        # The two-tier schedule is the plan_hier candidate's alone; every
+        # other mode pins the flat ring (and hier has no device-pack
+        # form, so the candidate always host-packs).
+        d._hier = mode == "plan_hier"
         if mode == "plan_devpack":
             d._device_pack = True
         elif mode == "plan":
             d._device_pack = self._plan_device_pack()
+        elif mode == "plan_hier":
+            d._device_pack = False
         return d.blocking_step(*batch)
 
     def _decide(self) -> None:

@@ -693,13 +693,21 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
             target=arm, daemon=True, name="iso_spare_arm"
         ).start()
 
-    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+    def configure(
+        self,
+        store_addr: str,
+        rank: int,
+        world_size: int,
+        regions: Optional[Sequence[str]] = None,
+    ) -> None:
         """Kill-and-respawn reconfigure: the old child (wedged or not) is
         SIGKILLed from the calling thread — unblocking any op stuck on
         it — and a fresh child rendezvouses on the new store prefix. No
         in-process ``jax.distributed`` teardown happens in the parent,
         so live jax arrays are untouched and no snapshot-to-host round
-        trip exists on this path."""
+        trip exists on this path. ``regions`` is accepted and ignored
+        (the reconfigure contract; the child's compiled collectives have
+        no host-side topology to compile)."""
         t_kill = time.perf_counter()
         self._aborted = True
         with self._child_lock:

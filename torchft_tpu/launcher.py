@@ -215,6 +215,13 @@ def launch(
         if region_tier:
             group_lighthouse = region_tier[g % len(region_tier)].address()
             group_env.setdefault("TORCHFT_LIGHTHOUSE_ROOT", lighthouse_addr)
+            # The same label the lighthouse tier is deployed by also
+            # labels the DATA plane: it rides the quorum and, on a >= 2-
+            # region cohort, compiles the two-tier collective schedule
+            # (see OPERATIONS.md "topology-aware collectives").
+            group_env.setdefault(
+                "TORCHFT_REGION", f"region_{g % len(region_tier)}"
+            )
         groups.append(
             _Supervised(
                 replica_group_spec(

@@ -222,7 +222,18 @@ class DiLoCo(LocalSGD):
     ``param_wire="bf16"`` rounds the parameter allgather to bfloat16
     (half its bytes; every member — including each shard's owner — adopts
     the decoded bf16 words, so params stay bit-identical across the
-    cohort)."""
+    cohort).
+
+    ``hier=True`` (unsharded only) rides the outer pseudogradient
+    average over the TOPOLOGY-AWARE two-tier schedule
+    (``Manager.allreduce_hier``): on a region-labeled cohort the slow
+    inter-region links carry a fraction of the flat ring's bytes, on
+    the leaders only. ``hier_wire`` (``None`` | ``"bf16"`` | ``"q8"``)
+    compresses the inter hop only, so the once-per-window quantization
+    noise is paid exactly where the bandwidth is scarce. On a cohort
+    without a usable region map the sync latches an error and the
+    window is discarded (retry next window) — pin ``hier`` only on
+    fleets actually deployed across regions."""
 
     def __init__(
         self,
@@ -233,6 +244,8 @@ class DiLoCo(LocalSGD):
         sharded: bool = False,
         shard_wire: Optional[str] = None,
         param_wire: Optional[str] = None,
+        hier: bool = False,
+        hier_wire: Optional[str] = None,
     ) -> None:
         if manager._use_async_quorum:
             raise ValueError(
@@ -245,6 +258,15 @@ class DiLoCo(LocalSGD):
             raise ValueError(f"unsupported param_wire: {param_wire!r}")
         if (shard_wire or param_wire) and not sharded:
             raise ValueError("shard_wire/param_wire require sharded=True")
+        if hier_wire not in (None, "bf16", "q8"):
+            raise ValueError(f"unsupported hier_wire: {hier_wire!r}")
+        if hier_wire is not None and not hier:
+            raise ValueError("hier_wire requires hier=True")
+        if hier and sharded:
+            raise ValueError(
+                "hier=True composes with the unsharded outer sync only "
+                "(the sharded schedule's shard layout is the FLAT ring's)"
+            )
         if sharded:
             # The shard must pack into ONE flat group: the outer-state
             # re-partition after a membership change identifies shard-
@@ -266,6 +288,8 @@ class DiLoCo(LocalSGD):
         super().__init__(manager, state, sync_every)
         self._outer_tx = outer_tx
         self._sharded = sharded
+        self._hier = hier
+        self._hier_wire = hier_wire
         self._shard_wire = shard_wire
         self._param_wire = param_wire
         if sharded:
@@ -333,7 +357,18 @@ class DiLoCo(LocalSGD):
         pseudo_grads = jax.tree_util.tree_map(
             lambda old, new: old - new, old_global, self._state.params
         )
-        averaged = self._manager.allreduce(pseudo_grads, op=ReduceOp.AVG).wait()
+        if self._hier:
+            # Topology-aware outer sync: intra-region rings + the
+            # inter-region leader ring, with hier_wire compressing the
+            # slow hop only. Managed discipline is allreduce's own — an
+            # un-hierarchical cohort latches and the window is discarded.
+            averaged = self._manager.allreduce_hier(
+                pseudo_grads, op=ReduceOp.AVG, wire=self._hier_wire
+            ).wait()
+        else:
+            averaged = self._manager.allreduce(
+                pseudo_grads, op=ReduceOp.AVG
+            ).wait()
 
         # Restore to the last global state before applying the outer step.
         # Copy: state.params buffers get donated by the next inner step,

@@ -81,6 +81,7 @@ class Manager:
         lighthouse_addr: Optional[str] = None,
         lighthouse_root_addr: Optional[str] = None,
         lease_ttl: Optional[timedelta] = None,
+        region: Optional[str] = None,
         replica_id: Optional[str] = None,
         hostname: str = socket.gethostname(),
         heartbeat_interval: timedelta = timedelta(milliseconds=100),
@@ -114,6 +115,15 @@ class Manager:
                 ``TORCHFT_LEASE_TTL_MS``; None = the lighthouse's
                 heartbeat-timeout default). Renewals are jittered and back
                 off exponentially while the lighthouse is unreachable.
+            region: this replica group's topology label (env
+                ``TORCHFT_REGION``; "" = unlabeled) — the same label the
+                hierarchical lighthouse tier is deployed by. It rides the
+                quorum, and when EVERY quorum member carries one (>= 2
+                distinct regions), ``configure`` hands the region map to
+                the data plane, which compiles the topology-aware
+                two-tier collective schedule (intra-region rings + an
+                inter-region leader ring; see
+                ``HostCollectives.allreduce_hier``).
             replica_id: replica group name; a uuid suffix is appended by
                 group rank 0 (reference manager.py:196-200).
             profiler: windowed jax profiler capture advanced once per
@@ -206,6 +216,12 @@ class Manager:
             env_ttl = os.environ.get("TORCHFT_LEASE_TTL_MS")
             if env_ttl:
                 lease_ttl = timedelta(milliseconds=int(env_ttl))
+        if region is None:
+            region = os.environ.get("TORCHFT_REGION", "")
+        self._region = region
+        # The quorum's region map (replica-rank order), refreshed every
+        # quorum; what hier_capable() and the configure call key off.
+        self._replica_regions: List[str] = []
         replica_id = replica_id if replica_id is not None else ""
 
         self._manager: Optional[_native.Manager] = None
@@ -232,6 +248,7 @@ class Manager:
                 connect_timeout=connect_timeout,
                 root_addr=lighthouse_root_addr,
                 lease_ttl=lease_ttl,
+                region=region,
             )
             self._store.set(MANAGER_ADDR_KEY, self._manager.address().encode())
             self._store.set(REPLICA_ID_KEY, replica_id.encode())
@@ -370,11 +387,17 @@ class Manager:
             # rank, and stale members can't collide (reference :470-477).
             prefix = f"{store_address}/torchft/{quorum_id}/{self._rank}"
             self._logger.info(f"reconfiguring collectives quorum_id={quorum_id}")
+            # The quorum's region map (one label per replica rank) rides
+            # into the data plane: a host ring compiles it into the
+            # two-tier schedule when usable; other backends ignore it.
+            regions = list(result.replica_regions)
+            self._replica_regions = regions
             with self._metrics.timed("reconfigure"), span(
                 "torchft::reconfigure"
             ):
                 self._collectives.configure(
-                    prefix, result.replica_rank, result.replica_world_size
+                    prefix, result.replica_rank, result.replica_world_size,
+                    regions=regions or None,
                 )
             if self._iso_collectives is not None:
                 # The secondary (isolated) plane reconfigures on its own
@@ -521,6 +544,7 @@ class Manager:
         op: ReduceOp = ReduceOp.AVG,
         wire: Optional[str] = None,
         device_pack: Optional[bool] = None,
+        hier: bool = False,
     ) -> Work:
         """Fault-tolerantly averages a gradient pytree through a
         persistent precompiled comm plan (one GIL-released native call
@@ -539,7 +563,11 @@ class Manager:
         backend (True/False/None = ``TORCHFT_DEVICE_PACK``): pack the
         wire encoding on the accelerator so d2h bytes scale with the
         wire, results bit-identical either way — see
-        Collectives.plan_allreduce."""
+        Collectives.plan_allreduce. ``hier`` runs the plan over the
+        TWO-TIER schedule (see :meth:`allreduce_hier`); a cohort without
+        a usable region map latches the error and the step is discarded
+        — the sentinel path AdaptiveDDP's ``plan_hier`` candidate relies
+        on, never a crash."""
         if op not in (ReduceOp.AVG, ReduceOp.SUM):
             # Static usage error: raise eagerly, don't latch.
             raise ValueError(f"unsupported managed plan_allreduce op: {op}")
@@ -553,12 +581,67 @@ class Manager:
                 divisor = None
             return self._collectives.plan_allreduce(
                 zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire,
-                device_pack=device_pack,
+                device_pack=device_pack, hier=hier,
             )
 
         return self._managed_dispatch(
             "plan_allreduce", tree, dispatch, lambda t: None
         )
+
+    def allreduce_hier(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.AVG,
+        wire: Optional[str] = None,
+    ) -> Work:
+        """Fault-tolerantly averages a pytree over the TOPOLOGY-AWARE
+        two-tier schedule (``Collectives.allreduce_hier``): intra-region
+        reduce-scatter -> intra allgather -> inter-region ring among one
+        leader per region -> intra broadcast, so the slow inter-region
+        links carry a fraction of the flat ring's bytes and only on the
+        leaders. ``wire`` (``None`` | ``"bf16"`` | ``"q8"``) applies to
+        the inter hop only. Same quorum/zeroing/latching discipline as
+        :meth:`allreduce` (failure resolves to the tree as contributed,
+        the error latches, ``should_commit`` discards); a cohort whose
+        region map is unusable (single region, unlabeled members, or a
+        backend without the schedule) latches the dispatch error — the
+        sentinel discipline, never a crash."""
+        if op not in (ReduceOp.AVG, ReduceOp.SUM):
+            raise ValueError(f"unsupported managed allreduce_hier op: {op}")
+
+        def dispatch(zeroed_tree: Any) -> Work:
+            if op == ReduceOp.AVG:
+                num_participants = self.num_participants()
+                assert num_participants >= 1
+                divisor: Optional[float] = float(num_participants)
+            else:
+                divisor = None
+            return self._collectives.allreduce_hier(
+                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire
+            )
+
+        return self._managed_dispatch(
+            "allreduce_hier", tree, dispatch, lambda t: t
+        )
+
+    def hier_capable(self) -> bool:
+        """Whether the CURRENT quorum's data plane compiled a two-tier
+        (topology-aware) schedule: every member carried a region label
+        and >= 2 distinct regions were present, on a backend that
+        understands topology (the host ring). Settles the quorum thread
+        first — the region map is its writer."""
+        if self._quorum_future is not None:
+            self.wait_quorum()
+        cap = getattr(self._collectives, "hier_capable", None)
+        return bool(cap()) if cap is not None else False
+
+    def replica_regions(self) -> List[str]:
+        """The current quorum's region map, indexed by replica rank
+        (empty strings for unlabeled members; empty before the first
+        quorum). Settles the quorum thread first."""
+        if self._quorum_future is not None:
+            self.wait_quorum()
+        return list(self._replica_regions)
 
     def has_iso_plane(self) -> bool:
         """Whether a secondary isolated data plane was attached at

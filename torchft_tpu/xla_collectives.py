@@ -50,7 +50,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from datetime import timedelta
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -260,7 +260,16 @@ class XLACollectives(OpStatsMixin, Collectives):
         after that the process must reconfigure or restart)."""
         self._aborted = True
 
-    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+    def configure(
+        self,
+        store_addr: str,
+        rank: int,
+        world_size: int,
+        regions: Optional[Sequence[str]] = None,
+    ) -> None:
+        # `regions` accepted and ignored (the reconfigure contract): the
+        # compiled XLA data plane has no host-side topology to compile —
+        # the runtime owns placement.
         # Unblock the queue the way HostCollectives does pre-configure;
         # do_configure clears the flag once the new membership is live.
         self._aborted = True
