@@ -1062,6 +1062,8 @@ def main() -> None:
         if quarters
         else None
     )
+    from torchft_tpu.chaos import bench_fault_stamp
+
     result = {
         "config": {
             "groups": args.groups,
@@ -1070,6 +1072,13 @@ def main() -> None:
             "host_cpus": os.cpu_count(),
             "tpu_group0": args.tpu_group0,
         },
+        # The seeded schedule (env TORCHFT_CHAOS_SEED/_PLAN) plus this
+        # bench's own fault knobs: any anomaly in this artifact replays
+        # via scripts/chaos_run.py --seed.
+        "fault_plan": bench_fault_stamp(
+            bench="bench_churn", kill_every=args.kill_every,
+            kill_kind="sigkill",
+        ),
         "healthy": healthy,
         "churn": churn,
         "churn_hot_spare": churn_hot,

@@ -461,6 +461,11 @@ def main() -> None:
         print("dryrun OK (no artifact written)")
         return
 
+    from torchft_tpu.chaos import bench_fault_stamp
+
+    report["fault_plan"] = bench_fault_stamp(
+        bench="bench_dcn", kill_kind="sigkill_mid_collective",
+    )
     with open(os.path.join(REPO, "DCN_BENCH.json"), "w") as f:
         json.dump(report, f, indent=2)
     print("wrote DCN_BENCH.json")

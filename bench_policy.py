@@ -600,6 +600,12 @@ def main() -> None:
     summary["switch_fault"] = result["switch_fault"]["split_brain_free"]
     print(json.dumps(summary))
 
+    from torchft_tpu.chaos import bench_fault_stamp
+
+    result["fault_plan"] = bench_fault_stamp(
+        bench="bench_policy", fault_period_s=args.fault_period,
+        fault_kind="ring_visible_poisoned_frame",
+    )
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
     print(f"[bench_policy] wrote {args.out}")

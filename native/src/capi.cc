@@ -7,6 +7,7 @@
 #include <string>
 
 #include "collectives.h"
+#include "fault.h"
 #include "json.h"
 #include "lighthouse.h"
 #include "manager.h"
@@ -592,6 +593,56 @@ int tft_hc_barrier(void* handle, int64_t timeout_ms) {
 }
 
 void tft_hc_abort(void* handle) { static_cast<HostCollectives*>(handle)->abort(); }
+
+// Requests per-frame CRC32C on the ring wire for the NEXT configure
+// (default: TORCHFT_WIRE_CRC). All members must agree — the hello magic
+// carries the frame format, and the Python layer negotiates the knob
+// through the store like stripes.
+void tft_hc_set_wire_crc(void* handle, int on) {
+  static_cast<HostCollectives*>(handle)->set_wire_crc(on != 0);
+}
+
+// Whether the ACTIVE ring (last configure) runs the CRC-guarded frames.
+int tft_hc_wire_crc(void* handle) {
+  return static_cast<HostCollectives*>(handle)->wire_crc() ? 1 : 0;
+}
+
+// ---- chaos plane (deterministic fault injection) ----
+// The seeded fault schedule is process-global: rules match on (seam,
+// member, op_index) so one armed plan drives every member hosted by the
+// process (thread fleets included). See native/src/fault.h.
+
+// Arms (replaces) the fault plan: {"seed": u64, "rules": [{"seam":
+// "ring_send"|"net_send"|..., "kind": "drop"|"delay"|"truncate"|
+// "duplicate"|"bit_flip"|"partition", "member": -1|rank, "min_op",
+// "max_op", "permille", "max_fires", "param"}]}. Stats persist across
+// re-arms (the harness re-arms per step); tft_fault_disarm resets them.
+int tft_fault_arm(const char* plan_json) {
+  return guarded([&] { fault::arm_from_json(plan_json ? plan_json : "{}"); });
+}
+
+void tft_fault_disarm(void) { fault::disarm(); }
+
+int tft_fault_armed(void) { return fault::armed() ? 1 : 0; }
+
+// Injection stats: {"armed", "fired_total", "fired": {"seam:kind": n}}.
+int tft_fault_stats_json(char** out) {
+  return guarded([&] { *out = dup_string(fault::stats_json()); });
+}
+
+// CRC32C (Castagnoli) over a buffer — the same polynomial the ring
+// frames ride; exposed so the Python heal stream and tests share one
+// implementation.
+uint32_t tft_crc32c(const void* data, uint64_t len) {
+  return fault::crc32c(data, static_cast<size_t>(len));
+}
+
+// Incremental form for non-contiguous payloads (the heal staging's
+// per-leaf segments): seed with 0xFFFFFFFF, chain updates, invert at the
+// end — exactly what tft_crc32c does for one buffer.
+uint32_t tft_crc32c_update(uint32_t state, const void* data, uint64_t len) {
+  return fault::crc32c_update(state, data, static_cast<size_t>(len));
+}
 
 int64_t tft_hc_world_size(void* handle) {
   return static_cast<HostCollectives*>(handle)->world_size();

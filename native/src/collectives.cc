@@ -1,7 +1,9 @@
 #include "collectives.h"
 
+#include <netdb.h>
 #include <poll.h>
 #include <string.h>
+#include <sys/socket.h>
 
 #include <algorithm>
 #include <cstdlib>
@@ -13,6 +15,7 @@
 #include <thread>
 #include <vector>
 
+#include "fault.h"
 #include "json.h"
 #include "log.h"
 #include "store.h"
@@ -52,6 +55,13 @@ namespace {
 // and inter-region (leader) rings, and the hello names which ring a
 // connection belongs to.
 constexpr uint32_t kHelloMagic = 0x74667404; // "tft" + proto rev 4
+// rev 5: the CRC-guarded frame format — every ring/stripe payload frame
+// carries a 4-byte CRC32C trailer (TORCHFT_WIRE_CRC, store-negotiated
+// like stripes). The rev-5 magic is used ONLY when CRC is on, so a
+// CRC-off fleet keeps speaking the byte-identical rev-4 format and
+// interops with un-upgraded peers; a mixed on/off pair fails AT CONNECT
+// with a CRC-specific error instead of a frame desync.
+constexpr uint32_t kHelloMagicCrc = 0x74667405;
 // "tftp": per-op header magic (part of the wire protocol).
 constexpr uint32_t kOpMagic = 0x74667470;
 
@@ -174,6 +184,34 @@ std::pair<size_t, size_t> HostCollectives::stripe_range(size_t count,
                                                         int64_t n, int64_t s) {
   return chunk_range(count, n, s);
 }
+
+namespace {
+
+bool env_wire_crc() {
+  const char* e = std::getenv("TORCHFT_WIRE_CRC");
+  if (e == nullptr) return false;
+  std::string v(e);
+  return v == "1" || v == "on" || v == "true";
+}
+
+// "host:port" of a connected socket's peer, for edge diagnostics.
+std::string peer_addr_str(int fd) {
+  struct sockaddr_storage ss;
+  socklen_t slen = sizeof(ss);
+  if (getpeername(fd, reinterpret_cast<struct sockaddr*>(&ss), &slen) != 0)
+    return "?";
+  char host[NI_MAXHOST];
+  char port[NI_MAXSERV];
+  if (getnameinfo(reinterpret_cast<struct sockaddr*>(&ss), slen, host,
+                  sizeof(host), port, sizeof(port),
+                  NI_NUMERICHOST | NI_NUMERICSERV) != 0)
+    return "?";
+  return std::string(host) + ":" + port;
+}
+
+}  // namespace
+
+HostCollectives::HostCollectives() : crc_req_(env_wire_crc()) {}
 
 HostCollectives::~HostCollectives() {
   abort();
@@ -321,23 +359,30 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
         cap_to_bps(std::getenv("TORCHFT_HC_WIRE_CAP_MBPS"));
     const int64_t cap_intra =
         cap_to_bps(std::getenv("TORCHFT_HC_WIRE_CAP_INTRA_MBPS"));
-    auto init_tier = [](RingTier& T, int64_t trank, int64_t tworld,
-                        int64_t conns, int64_t cap) {
+    auto init_tier = [](RingTier& T, const char* name, int64_t trank,
+                        int64_t tworld, int64_t conns, int64_t cap) {
       T.rank = trank;
       T.world = tworld;
       T.conns = conns;
       T.cap_bps = cap;
+      T.name = name;
+      T.peer_next_addr.clear();
+      T.peer_prev_addr.clear();
       T.scratch.assign(conns, StripeScratch{});
       for (auto& sc : T.scratch) sc.cap_bps = cap;
     };
-    init_tier(flat_, rank, world_size, stripes, cap_main);
+    init_tier(flat_, "flat", rank, world_size, stripes, cap_main);
     if (hier) {
-      init_tier(intra_, intra_rank, intra_world, stripes, cap_intra);
+      init_tier(intra_, "intra", intra_rank, intra_world, stripes, cap_intra);
       // Non-leaders never touch the inter ring; world stays 0 there so
       // op bodies can branch on it uniformly.
-      init_tier(inter_, inter_rank, is_leader ? inter_world : 0,
+      init_tier(inter_, "inter", inter_rank, is_leader ? inter_world : 0,
                 stripes_inter, cap_main);
     }
+    // The frame format is fixed for the life of the ring: snapshot the
+    // CRC request here, under the same publication protocol as the
+    // geometry.
+    crc_ = crc_req_;
     aborted_ = true;
     epoch = abort_epoch_;
     if (world_size == 1) {
@@ -368,20 +413,23 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     int64_t conns;
     std::vector<Socket> next;
     std::vector<Socket> prev;
+    std::string next_addr;  // diagnostics: where this tier's edges lead
+    std::string prev_addr;
   };
   std::vector<TierPlanEntry> tiers;
   tiers.push_back({kTierFlat, (rank + 1) % world_size,
-                   (rank - 1 + world_size) % world_size, stripes, {}, {}});
+                   (rank - 1 + world_size) % world_size, stripes, {}, {},
+                   {}, {}});
   if (hier && intra_world > 1) {
     tiers.push_back(
         {kTierIntra, intra_members[(intra_rank + 1) % intra_world],
          intra_members[(intra_rank - 1 + intra_world) % intra_world],
-         stripes, {}, {}});
+         stripes, {}, {}, {}, {}});
   }
   if (is_leader && inter_world > 1) {
     tiers.push_back({kTierInter, leaders[(inter_rank + 1) % inter_world],
                      leaders[(inter_rank - 1 + inter_world) % inter_world],
-                     stripes_inter, {}, {}});
+                     stripes_inter, {}, {}, {}, {}});
   }
 
   // Dial every tier's next member once per stripe; the hello names the
@@ -389,14 +437,18 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   // regardless of arrival order, and carries the stripe COUNT so a config
   // mismatch that slipped past the store-level negotiation still fails at
   // connect, not mid-op.
+  // The hello magic names the FRAME FORMAT (rev 4 raw, rev 5 CRC-guarded):
+  // a pair that disagrees on TORCHFT_WIRE_CRC fails right here instead of
+  // desyncing 4 bytes into the first payload frame.
+  const uint32_t hello_magic = crc_ ? kHelloMagicCrc : kHelloMagic;
   for (auto& tp : tiers) {
-    std::string next_addr =
+    tp.next_addr =
         store.get(prefix + "/hc_addr_" + std::to_string(tp.next_rank),
                   remain_or_throw(deadline));
     tp.next.resize(tp.conns);
     for (int64_t s = 0; s < tp.conns; s++) {
-      tp.next[s] = connect_with_retry(next_addr, remain_or_throw(deadline));
-      uint32_t hello[5] = {kHelloMagic, static_cast<uint32_t>(rank),
+      tp.next[s] = connect_with_retry(tp.next_addr, remain_or_throw(deadline));
+      uint32_t hello[5] = {hello_magic, static_cast<uint32_t>(rank),
                            static_cast<uint32_t>(s),
                            static_cast<uint32_t>(tp.conns), tp.tier};
       tp.next[s].send_all(hello, sizeof(hello), deadline);
@@ -411,10 +463,17 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     if (!sock.valid()) throw SocketError("listener closed during configure");
     uint32_t peer_hello[5];
     sock.recv_all(peer_hello, sizeof(peer_hello), deadline);
-    if (peer_hello[0] != kHelloMagic)
+    if (peer_hello[0] != hello_magic) {
+      if (peer_hello[0] == kHelloMagic || peer_hello[0] == kHelloMagicCrc)
+        throw SocketError(
+            "ring handshake: wire-CRC mismatch (this rank has "
+            "TORCHFT_WIRE_CRC " + std::string(crc_ ? "on" : "off") +
+            ", peer has the opposite — all members must agree; the store "
+            "negotiation should have caught this first)");
       throw SocketError(
           "ring handshake: wire-protocol mismatch (peer binary speaks a "
           "different ring protocol revision)");
+    }
     TierPlanEntry* tp = nullptr;
     for (auto& cand : tiers)
       if (cand.tier == peer_hello[4]) { tp = &cand; break; }
@@ -433,6 +492,7 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     uint32_t slot = peer_hello[2];
     if (slot >= static_cast<uint32_t>(tp->conns) || tp->prev[slot].valid())
       throw SocketError("ring handshake: bad or duplicate stripe index");
+    if (tp->prev_addr.empty()) tp->prev_addr = peer_addr_str(sock.fd());
     tp->prev[slot] = std::move(sock);
   }
 
@@ -445,27 +505,136 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
                                           : inter_;
     T.next = std::move(tp.next);
     T.prev = std::move(tp.prev);
+    T.peer_next_addr = tp.next_addr;
+    T.peer_prev_addr = tp.prev_addr;
+    for (size_t s = 0; s < T.scratch.size(); s++)
+      T.scratch[s].tag = "tier=" + T.name + " stripe=" + std::to_string(s) +
+                         " prev_peer=" + T.peer_prev_addr;
   }
   aborted_ = false;
 }
 
 void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
                              size_t send_len, char* recv_buf, size_t recv_len,
-                             int64_t deadline_ms, StripeScratch* sc) {
+                             int64_t deadline_ms, StripeScratch* sc,
+                             bool header_frame) {
   const double bps = sc ? static_cast<double>(sc->cap_bps) : 0.0;
   PaceState* pace = sc ? &sc->pace : nullptr;
   // Burst = 20 ms of credit (floor 64 KB): small enough that the realized
   // rate tracks the cap within any measurement window, large enough that a
   // chunk-sized write needs one send call.
   const double burst = std::max(65536.0, bps / 50.0);
-  size_t sent = 0, got = 0;
-  while (sent < send_len || got < recv_len) {
+
+  // Chaos seam: the ring frame send path. Disarmed, this is one relaxed
+  // atomic load; armed, the seeded schedule decides per (member,
+  // op_index) — and at most one frame of the op is hit (the harness arms
+  // one-shot rules), on whichever stripe claims the firing first.
+  bool flip_pending = false;
+  bool partitioned = false;
+  fault::Decision fd =
+      send_len > 0
+          ? TFT_FAULT_CHECK(header_frame ? fault::kSeamRingHdr
+                                         : fault::kSeamRingSend,
+                            rank_, op_seq_)
+          : fault::Decision{};
+  if (fd.kind != fault::kNone) {
+    // Deadline-bounded raw send of a fault's own bytes (the sockets are
+    // non-blocking).
+    auto raw_send = [&](const char* buf, size_t n) {
+      size_t done = 0;
+      while (done < n) {
+        ssize_t w =
+            ::send(next.fd(), buf + done, n - done, MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w > 0) {
+          done += static_cast<size_t>(w);
+          if (sc) sc->tx_bytes += w;
+          continue;
+        }
+        if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          struct pollfd pfd{next.fd(), POLLOUT, 0};
+          int timeout =
+              poll_timeout_or_throw(deadline_ms, "collective timed out");
+          if (::poll(&pfd, 1, timeout) < 0 && errno != EINTR)
+            throw SocketError(std::string("poll: ") + strerror(errno));
+          continue;
+        }
+        if (w < 0 && errno == EINTR) continue;
+        throw SocketError(std::string("ring send: ") + strerror(errno));
+      }
+    };
+    switch (fd.kind) {
+      case fault::kDrop:
+        next.shutdown_rdwr();
+        prev.shutdown_rdwr();
+        throw SocketError("chaos injected: ring send dropped (" +
+                          (sc ? sc->tag : std::string("?")) + ")");
+      case fault::kDelay: {
+        // Bounded by the op deadline (the fault.h contract): a delay
+        // fault stalls the op, it must never stall PAST the op.
+        int64_t ms = fd.param;
+        if (deadline_ms >= 0) {
+          int64_t remain = deadline_ms - now_ms();
+          if (remain < 0) remain = 0;
+          if (ms > remain) ms = remain;
+        }
+        struct timespec ts;
+        ts.tv_sec = ms / 1000;
+        ts.tv_nsec = (ms % 1000) * 1000000;
+        nanosleep(&ts, nullptr);
+        break;
+      }
+      case fault::kTruncate:
+        // A torn write then death: the peer sees a partial frame + EOF.
+        raw_send(send_buf, send_len / 2);
+        next.shutdown_rdwr();
+        prev.shutdown_rdwr();
+        throw SocketError("chaos injected: ring send truncated (" +
+                          (sc ? sc->tag : std::string("?")) + ")");
+      case fault::kDuplicate:
+        // Repeat a prefix: every later byte of the stream lands at the
+        // wrong offset. With CRC on, THIS frame's trailer check catches
+        // it; off, the desync surfaces at the next op header.
+        raw_send(send_buf, send_len < 16 ? send_len : 16);
+        break;
+      case fault::kBitFlip:
+        // Applied to the first chunk actually sent below: the caller's
+        // buffer (and the CRC, computed over the ORIGINAL bytes) stay
+        // clean — only the wire is poisoned.
+        flip_pending = true;
+        break;
+      case fault::kPartition:
+        // Asymmetric partition: our sends silently vanish while our
+        // receives keep draining — the peer stalls until ITS op
+        // deadline (a stall, not an error, is the injected failure).
+        partitioned = true;
+        break;
+      default:
+        break;
+    }
+  }
+
+  // CRC-guarded framing (negotiated at configure): each direction with a
+  // payload carries a 4-byte CRC32C trailer after its last payload byte.
+  // The CRC state updates incrementally per kernel chunk, so the payload
+  // is walked exactly once either way; with crc_ off the totals collapse
+  // to the raw lengths and no CRC code runs — the single-branch contract.
+  const bool crc = crc_;
+  const size_t send_total = send_len + ((crc && send_len > 0) ? 4 : 0);
+  const size_t recv_total = recv_len + ((crc && recv_len > 0) ? 4 : 0);
+  uint32_t scrc = 0xFFFFFFFFu;
+  uint32_t rcrc = 0xFFFFFFFFu;
+  char strail[4];
+  char rtrail[4];
+  size_t sent = partitioned ? send_total : 0;
+  size_t got = 0;
+  while (sent < send_total || got < recv_total) {
     // Refill the token bucket and decide whether this pass may send; when
     // token-dry, the send fd leaves the poll set and the poll timeout
     // shrinks to the refill time, so receives still drain at full speed.
+    // Pacing covers payload bytes only (the 4-byte trailer is noise).
     int64_t pace_wait_ms = -1;
-    bool may_send = sent < send_len;
-    if (may_send && pace && bps > 0) {
+    bool may_send = sent < send_total;
+    if (may_send && sent < send_len && pace && bps > 0) {
       auto now = std::chrono::steady_clock::now();
       if (!pace->init) {
         pace->init = true;
@@ -491,7 +660,7 @@ void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
       pfds[n].events = POLLOUT;
       n++;
     }
-    if (got < recv_len) {
+    if (got < recv_total) {
       recv_idx = n;
       pfds[n].fd = prev.fd();
       pfds[n].events = POLLIN;
@@ -510,31 +679,90 @@ void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
       throw SocketError(std::string("poll: ") + strerror(errno));
     }
     if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      size_t allow = send_len - sent;
-      if (pace && bps > 0 && static_cast<double>(allow) > pace->tokens)
-        allow = static_cast<size_t>(pace->tokens);
-      ssize_t w = ::send(next.fd(), send_buf + sent, allow,
-                         MSG_NOSIGNAL | MSG_DONTWAIT);
-      if (w > 0) {
-        sent += static_cast<size_t>(w);
-        if (pace && bps > 0) pace->tokens -= static_cast<double>(w);
-        // Per-connection tx accounting (the hierarchical per-tier byte
-        // bill sums these): bytes actually handed to the kernel.
-        if (sc) sc->tx_bytes += w;
-      } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
-                 errno != EINTR) {
-        throw SocketError(std::string("ring send: ") + strerror(errno));
+      if (sent < send_len) {
+        size_t allow = send_len - sent;
+        if (pace && bps > 0 && static_cast<double>(allow) > pace->tokens)
+          allow = static_cast<size_t>(pace->tokens);
+        const char* src = send_buf + sent;
+        char flipbuf[4096];
+        if (flip_pending && allow > 0) {
+          // Poison exactly one bit of the first byte of this chunk on
+          // its way to the wire; the sender's CRC (below) covers the
+          // ORIGINAL bytes, so the receiver's trailer check must fire.
+          size_t n = allow < sizeof(flipbuf) ? allow : sizeof(flipbuf);
+          memcpy(flipbuf, src, n);
+          flipbuf[0] ^= static_cast<char>(1u << ((fd.h >> 8) % 8));
+          src = flipbuf;
+          allow = n;
+        }
+        ssize_t w = ::send(next.fd(), src, allow,
+                           MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w > 0) {
+          if (flip_pending) flip_pending = false;  // byte 0 is out
+          if (crc) scrc = fault::crc32c_update(scrc, send_buf + sent, w);
+          sent += static_cast<size_t>(w);
+          if (pace && bps > 0) pace->tokens -= static_cast<double>(w);
+          // Per-connection tx accounting (the hierarchical per-tier byte
+          // bill sums these): bytes actually handed to the kernel.
+          if (sc) sc->tx_bytes += w;
+          if (crc && sent == send_len) {
+            uint32_t fin = ~scrc;
+            memcpy(strail, &fin, sizeof(fin));
+          }
+        } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                   errno != EINTR) {
+          throw SocketError(std::string("ring send: ") + strerror(errno));
+        }
+      } else {
+        // CRC trailer (4 bytes, unpaced).
+        ssize_t w = ::send(next.fd(), strail + (sent - send_len),
+                           send_total - sent, MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w > 0) {
+          sent += static_cast<size_t>(w);
+          if (sc) sc->tx_bytes += w;
+        } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                   errno != EINTR) {
+          throw SocketError(std::string("ring send: ") + strerror(errno));
+        }
       }
     }
     if (recv_idx >= 0 &&
         (pfds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t r = ::recv(prev.fd(), recv_buf + got, recv_len - got, MSG_DONTWAIT);
-      if (r > 0) {
-        got += static_cast<size_t>(r);
-      } else if (r == 0) {
-        throw SocketError("ring peer closed connection");
-      } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
-        throw SocketError(std::string("ring recv: ") + strerror(errno));
+      if (got < recv_len) {
+        ssize_t r =
+            ::recv(prev.fd(), recv_buf + got, recv_len - got, MSG_DONTWAIT);
+        if (r > 0) {
+          if (crc) rcrc = fault::crc32c_update(rcrc, recv_buf + got, r);
+          got += static_cast<size_t>(r);
+        } else if (r == 0) {
+          throw SocketError("ring peer closed connection");
+        } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+          throw SocketError(std::string("ring recv: ") + strerror(errno));
+        }
+      } else {
+        ssize_t r = ::recv(prev.fd(), rtrail + (got - recv_len),
+                           recv_total - got, MSG_DONTWAIT);
+        if (r > 0) {
+          got += static_cast<size_t>(r);
+          if (got == recv_total) {
+            uint32_t want;
+            memcpy(&want, rtrail, sizeof(want));
+            if (want != ~rcrc)
+              // The typed integrity error: rides the caller's latch ->
+              // vote-discard -> reconfigure machinery instead of
+              // committing poisoned bytes.
+              throw WireCorruptionError(
+                  "ring frame CRC32C mismatch (" +
+                  (sc ? sc->tag : std::string("?")) + ", rank " +
+                  std::to_string(rank_) + ", op_index " +
+                  std::to_string(op_seq_) + ", frame " +
+                  std::to_string(recv_len) + " bytes)");
+          }
+        } else if (r == 0) {
+          throw SocketError("ring peer closed connection");
+        } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+          throw SocketError(std::string("ring recv: ") + strerror(errno));
+        }
       }
     }
   }
@@ -559,9 +787,20 @@ void HostCollectives::check_op_header(RingTier& T, uint32_t kind,
   } mine{kOpMagic, kind, count, dtype, op}, theirs{};
   duplex(T.next[0], T.prev[0], reinterpret_cast<const char*>(&mine),
          sizeof(mine), reinterpret_cast<char*>(&theirs), sizeof(theirs),
-         deadline_ms, &T.scratch[0]);
+         deadline_ms, &T.scratch[0], /*header_frame=*/true);
   if (theirs.magic != kOpMagic)
-    throw SocketError("ring op header corrupt (protocol desync)");
+    // Keep the historic prefix (operators and tests grep for it); the
+    // context after it is what makes the error actionable in a W=8
+    // fleet log — which edge, which tier, which op.
+    throw SocketError(
+        "ring op header corrupt (protocol desync): tier=" + T.name +
+        " prev_peer=" + T.peer_prev_addr + " op_kind=" +
+        std::to_string(kind) + " op_index=" + std::to_string(op_seq_) +
+        " rank=" + std::to_string(rank_) + " got_magic=0x" + [&] {
+          char buf[16];
+          snprintf(buf, sizeof(buf), "%08x", theirs.magic);
+          return std::string(buf);
+        }());
   if (theirs.kind != mine.kind || theirs.count != mine.count ||
       theirs.dtype != mine.dtype || theirs.op != mine.op)
     throw SocketError(
@@ -620,8 +859,25 @@ void HostCollectives::run_striped(const std::function<void(int64_t)>& fn) {
       pool_body_ = nullptr;
     }
   }
-  for (auto& e : errs)
-    if (e) std::rethrow_exception(e);  // ONE error: lowest stripe wins
+  // ONE error is rethrown. A typed WireCorruptionError beats its
+  // siblings regardless of stripe index: the failing stripe's shutdown
+  // makes every other stripe die with a GENERIC socket error, and
+  // rethrowing one of those would erase the integrity verdict the
+  // cross-language "wire corruption:" contract (and the chaos harness's
+  // detection ledger) depends on. Otherwise: lowest stripe wins.
+  std::exception_ptr chosen;
+  for (auto& e : errs) {
+    if (!e) continue;
+    if (!chosen) chosen = e;
+    try {
+      std::rethrow_exception(e);
+    } catch (const WireCorruptionError&) {
+      chosen = e;
+      break;
+    } catch (...) {
+    }
+  }
+  if (chosen) std::rethrow_exception(chosen);
 }
 
 void HostCollectives::ensure_pool(int64_t workers) {
@@ -706,6 +962,7 @@ void HostCollectives::allreduce_stripe(RingTier& T, int64_t s, char* bytes,
 void HostCollectives::allreduce(void* data, size_t count, Dtype dtype,
                                 ReduceOp op, int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   run_op([&] {
@@ -835,6 +1092,7 @@ void HostCollectives::allreduce_q8_stripe(RingTier& T, int64_t s, float* data,
 void HostCollectives::allreduce_q8(float* data, size_t count,
                                    int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   run_op([&] {
@@ -857,6 +1115,7 @@ void HostCollectives::allreduce_q8(float* data, size_t count,
 void HostCollectives::allgather(const void* in, void* out, size_t nbytes,
                                 int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   char* slots = static_cast<char*>(out);
   memcpy(slots + rank_ * nbytes, in, nbytes);
@@ -919,6 +1178,7 @@ void HostCollectives::reduce_scatter(void* data, size_t count, Dtype dtype,
                                      int64_t layout_stripes,
                                      int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   size_t esize = dtype_size(dtype);
   if (world_size_ == 1) {
@@ -956,6 +1216,7 @@ void HostCollectives::reduce_scatter_q8(float* data, size_t count,
                                         int64_t layout_stripes,
                                         int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) {
     memcpy(shard_out, data, count * sizeof(float));
@@ -1000,6 +1261,7 @@ void HostCollectives::allgather_into(const void* shard, void* data,
                                      int64_t layout_stripes,
                                      int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   size_t esize = dtype_size(dtype);
   if (world_size_ == 1) {
@@ -1198,6 +1460,7 @@ void HostCollectives::allreduce_hier(void* data, size_t count, Dtype dtype,
                                      ReduceOp op, HierWire wire,
                                      int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   last_hier_ = HierStats{};
   last_hier_.wire = static_cast<int>(wire);
@@ -1680,6 +1943,7 @@ void HostCollectives::plan_execute_pre(int64_t plan_id,
                                        void* const* leaf_out, double divisor,
                                        bool has_divisor, int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   CommPlan& p = plan_get(plan_id);
   if (!p.prepacked)
     throw SocketError(
@@ -1866,6 +2130,7 @@ void HostCollectives::plan_execute(int64_t plan_id,
                                    void* const* leaf_out, double divisor,
                                    bool has_divisor, int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   CommPlan& p = plan_get(plan_id);
   if (p.prepacked)
     throw SocketError(
@@ -1980,6 +2245,7 @@ void HostCollectives::plan_execute(int64_t plan_id,
 void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
                                 int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   if (root < 0 || root >= world_size_) throw SocketError("bad broadcast root");
@@ -2013,6 +2279,7 @@ void HostCollectives::broadcast(void* data, size_t nbytes, int64_t root,
 
 void HostCollectives::barrier(int64_t timeout_ms) {
   MutexLock lock(op_mu_);
+  op_seq_++;
   if (aborted_) throw SocketError("collectives not configured");
   if (world_size_ == 1) return;
   run_op([&] {

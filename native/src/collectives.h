@@ -150,6 +150,10 @@ struct StripeScratch {
   PaceState pace;                   // this connection's send pacing
   int64_t cap_bps = 0;              // tier's per-connection send cap
   int64_t tx_bytes = 0;             // bytes sent since the op reset it
+  // Diagnostic tag ("tier=... stripe=... prev=host:port") baked at
+  // configure: wire-integrity and desync errors carry it so a W=8 fleet
+  // log names the guilty edge instead of an anonymous socket.
+  std::string tag;
 };
 
 // One ring a member participates in: the FLAT ring over all W members, the
@@ -163,6 +167,12 @@ struct RingTier {
   int64_t world = 0;
   int64_t conns = 0;
   int64_t cap_bps = 0;
+  // Diagnostics: tier name ("flat"/"intra"/"inter") and the neighbor
+  // addresses wired at configure — protocol-desync and CRC errors name
+  // the edge they fired on.
+  std::string name;
+  std::string peer_next_addr;
+  std::string peer_prev_addr;
   std::vector<Socket> next;   // one per stripe
   std::vector<Socket> prev;   // one per stripe
   // Persistent per-stripe staging + pacing + per-op tx accounting
@@ -275,7 +285,7 @@ struct CommPlan {
 
 class HostCollectives {
  public:
-  HostCollectives() = default;
+  HostCollectives();  // wire-CRC default snapshotted from TORCHFT_WIRE_CRC
   ~HostCollectives();
 
   // Rebuilds the ring(s) for a (possibly new) membership. store_addr is
@@ -303,6 +313,19 @@ class HostCollectives {
   // Whether the last configure() built the two-tier topology (a region map
   // with >= 2 distinct labels was supplied).
   bool hier_capable() const { return hier_; }
+
+  // Requests per-frame CRC32C on every ring/stripe payload frame of the
+  // NEXT configure() (and thereafter, until changed). Every member must
+  // agree — the hello magic carries the frame format, so a mismatch
+  // fails at connect with a descriptive error, and the Python layer
+  // additionally negotiates the knob through the store. Default comes
+  // from TORCHFT_WIRE_CRC at construction. A CRC mismatch on a frame
+  // raises WireCorruptionError ("wire corruption: ..."), which rides
+  // the normal latch -> vote-discard -> reconfigure machinery. Disabled
+  // (the default), the wire format is byte-identical to the pre-CRC
+  // protocol and duplex pays a single branch.
+  void set_wire_crc(bool on) { crc_req_ = on; }
+  bool wire_crc() const { return crc_; }
 
   // In-place ring allreduce over `count` elements of `data`.
   void allreduce(void* data, size_t count, Dtype dtype, ReduceOp op,
@@ -491,7 +514,8 @@ class HostCollectives {
   // never paced, and a token-dry sender keeps draining its receive side.
   void duplex(Socket& next, Socket& prev, const char* send_buf,
               size_t send_len, char* recv_buf, size_t recv_len,
-              int64_t deadline_ms, StripeScratch* sc = nullptr);
+              int64_t deadline_ms, StripeScratch* sc = nullptr,
+              bool header_frame = false);
 
   // Exchanges a tiny (kind, count, dtype, op) header with both neighbors
   // of tier `T` on stripe 0 before a collective and throws on mismatch — a
@@ -657,6 +681,16 @@ class HostCollectives {
   int64_t stripes_ = 1;
   int64_t stripes_inter_ = 1;
   bool hier_ = false;
+  // Wire CRC: crc_req_ is the caller's request (env default at
+  // construction, settable until configure); crc_ is the ACTIVE frame
+  // format, snapshotted by configure so it is stable for the life of a
+  // ring (same dual protocol as rank_/stripes_).
+  bool crc_req_ = false;
+  bool crc_ = false;
+  // Monotonic per-member collective-op counter (bumped under op_mu_ at
+  // every public op): the op_index axis of the seeded fault schedule and
+  // the index desync/corruption errors report.
+  int64_t op_seq_ = 0;
   std::unique_ptr<Listener> listener_;
   // The three rings a member can participate in. flat_ always exists
   // after a multi-member configure; intra_/inter_ only under a hier

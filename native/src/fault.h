@@ -1,0 +1,114 @@
+// Deterministic chaos plane: seeded fault injection at native wire seams,
+// plus the CRC32C the guarded frame format rides on.
+//
+// DESIGN. Every training step is a transaction (error anywhere -> latch ->
+// vote discards -> heal); the chaos plane exists to *exercise* that
+// invariant from one seeded schedule instead of hand-written SIGKILLs. A
+// fault plan is armed process-wide (tft_fault_arm, JSON rules); each
+// injection point asks, per (seam, member, op_index), whether a fault
+// fires — the decision is a pure splitmix64 hash of (seed, seam, member,
+// op_index, rule), so the same (seed, plan) replays the same schedule.
+//
+// HOT-PATH CONTRACT. Disarmed (the production state), an injection point
+// costs exactly ONE relaxed atomic load and a predictable branch — no
+// call, no lock, no hash. That is what the TFT_FAULT_CHECK macro compiles
+// to when g_armed is 0. graftlint's `fault_guard` rule enforces that no
+// call site reaches tft_fault_maybe() except through the macro, so the
+// contract cannot silently erode as seams are added.
+//
+// ADDING A SEAM (see docs/DEVELOPING.md "adding an injectable seam"):
+//   1. add a Seam enum value here and its name to seam_from_name in
+//      fault.cc;
+//   2. at the call site:
+//        fault::Decision fd = TFT_FAULT_CHECK(fault::kSeamX, member, op);
+//        if (fd.kind != fault::kNone) { ...seam-specific behavior... }
+//      (the BEHAVIOR lives at the seam: only the seam knows how to drop,
+//      delay or corrupt its own traffic);
+//   3. cover it from a FaultPlan in tests/test_chaos_invariants.py.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace tft {
+namespace fault {
+
+// Injection seams. Values are wire-stable (they appear in plan JSON and
+// stats); append only.
+enum Seam : int {
+  kSeamRingSend = 0,  // collectives.cc duplex() PAYLOAD frames
+  kSeamNetSend = 1,   // net.cc Socket::send_all (control-plane frames)
+  kSeamStore = 2,     // reserved: store client ops (Python-side injector)
+  kSeamHeal = 3,      // reserved: heal HTTP (Python-side injector)
+  kSeamChild = 4,     // reserved: isolated-child lifecycle (Python-side)
+  kSeamShm = 5,       // reserved: shm attach (Python-side injector)
+  kSeamRingHdr = 6,   // collectives.cc duplex() per-op HEADER frames —
+                      // split from kSeamRingSend so a "mid-ring payload
+                      // corruption" plan cannot be satisfied by hitting
+                      // the 24-byte header (whose magic check would
+                      // catch it even without CRC)
+};
+
+// Fault kinds a native seam can realize. Python-side seams reuse the
+// same names (chaos.py) so one plan schema spans both layers.
+enum Kind : int {
+  kNone = 0,
+  kDrop = 1,       // abandon the op: shut the seam down, error out
+  kDelay = 2,      // stall the send `param` ms (bounded by op deadline)
+  kTruncate = 3,   // ship a partial frame, then die (torn write)
+  kDuplicate = 4,  // repeat a prefix of the frame (stream desync)
+  kBitFlip = 5,    // flip one bit of the frame ON THE WIRE (payload
+                   // untouched at the sender — the CRC contract's prey)
+  kPartition = 6,  // asymmetric partition: sends silently vanish while
+                   // receives keep flowing (A->B dead, B->A alive)
+};
+
+// One firing: what to do and the hash that parameterizes it (bit
+// position, prefix length). kind == kNone means "no fault here".
+struct Decision {
+  int kind = kNone;
+  int64_t param = 0;  // rule's param (delay ms, ...)
+  uint64_t h = 0;     // decision hash: deterministic per-firing entropy
+};
+
+// Armed flag. Relaxed is sufficient: arming happens-before the ops a
+// harness injects into via its own synchronization (the plan is armed
+// before the step starts), and a stale 0 read merely skips a fault.
+extern std::atomic<uint32_t> g_armed;
+inline bool armed() { return g_armed.load(std::memory_order_relaxed) != 0; }
+
+// C++ surfaces behind the capi wrappers (capi.cc guards + JSON-ifies).
+void arm_from_json(const std::string& plan_json);  // throws on bad JSON
+void disarm();
+std::string stats_json();
+
+// splitmix64 — the shared deterministic mixer (same constants as
+// net.cc's jitter; duplicated into chaos.py so Python plans hash
+// identically).
+uint64_t mix64(uint64_t x);
+
+// Incremental CRC32C (Castagnoli), slicing-by-8. State starts at
+// 0xFFFFFFFF; finalize by inverting. crc32c() does the full
+// init-update-finalize for one buffer.
+uint32_t crc32c_update(uint32_t state, const void* data, size_t len);
+uint32_t crc32c(const void* data, size_t len);
+
+}  // namespace fault
+}  // namespace tft
+
+extern "C" {
+// The slow-path decision. NEVER call directly — every injection point
+// must go through TFT_FAULT_CHECK so the disarmed cost stays one relaxed
+// load (graftlint `fault_guard` greps for violations). `op_index` < 0
+// uses an internal per-seam call counter (control-plane seams with no
+// natural op ordering).
+tft::fault::Decision tft_fault_maybe(int seam, int64_t member,
+                                     int64_t op_index);
+}  // extern "C"
+
+// The disarmed fast path: one relaxed atomic load, one branch, nothing
+// else. All native injection points MUST use this macro.
+#define TFT_FAULT_CHECK(seam, member, op_index)                         \
+  (tft::fault::armed() ? tft_fault_maybe((seam), (member), (op_index)) \
+                       : tft::fault::Decision{})

@@ -1,5 +1,7 @@
 #include "net.h"
 
+#include "fault.h"
+
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
@@ -143,6 +145,66 @@ void Socket::wait_ready(bool for_read, int64_t deadline_ms) {
 void Socket::send_all(const void* buf, size_t len, int64_t deadline_ms) {
   const char* p = static_cast<const char*>(buf);
   size_t sent = 0;
+  // Chaos seam: the control plane's send path (store ops, manager/
+  // lighthouse RPC frames, ring hellos). Disarmed this is one relaxed
+  // load; armed, the seeded schedule decides per frame. `corrupt` keeps
+  // a mutated copy alive for the send loop — the caller's buffer is
+  // never touched, and nothing recurses back through the fault check.
+  std::string corrupt;
+  bool truncate_after = false;
+  fault::Decision fd =
+      TFT_FAULT_CHECK(fault::kSeamNetSend, /*member=*/-1, /*op_index=*/-1);
+  if (fd.kind != fault::kNone && len > 0) {
+    switch (fd.kind) {
+      case fault::kDrop:
+        shutdown_rdwr();
+        throw SocketError("chaos injected: control-plane send dropped");
+      case fault::kDelay: {
+        // Bounded by the caller's deadline (the fault.h contract).
+        int64_t ms = fd.param;
+        if (deadline_ms >= 0) {
+          int64_t remain = deadline_ms - now_ms();
+          if (remain < 0) remain = 0;
+          if (ms > remain) ms = remain;
+        }
+        struct timespec ts;
+        ts.tv_sec = ms / 1000;
+        ts.tv_nsec = (ms % 1000) * 1000000;
+        nanosleep(&ts, nullptr);
+        break;
+      }
+      case fault::kTruncate:
+        // Ship a torn prefix, then die — the peer sees a partial frame
+        // followed by EOF (a mid-write crash).
+        corrupt.assign(p, len / 2);
+        p = corrupt.data();
+        len = corrupt.size();
+        truncate_after = true;
+        break;
+      case fault::kPartition:
+        // Asymmetric partition: the frame silently vanishes; the peer
+        // keeps waiting until ITS deadline while our receives still
+        // flow. Nothing to throw here — the stall IS the fault.
+        return;
+      case fault::kBitFlip:
+        // Corrupt one bit of the frame on the wire: protocol framing on
+        // the far side must reject it, never act on it.
+        corrupt.assign(p, len);
+        corrupt[fd.h % len] ^= static_cast<char>(1u << ((fd.h >> 8) % 8));
+        p = corrupt.data();
+        break;
+      case fault::kDuplicate:
+        // Repeat a prefix of the frame: every byte after it lands at
+        // the wrong stream offset (the classic torn-retry desync).
+        corrupt.assign(p, len < 16 ? len : 16);
+        corrupt.append(p, len);
+        p = corrupt.data();
+        len = corrupt.size();
+        break;
+      default:
+        break;
+    }
+  }
   while (sent < len) {
     ssize_t n = ::send(fd_, p + sent, len - sent, MSG_NOSIGNAL);
     if (n > 0) {
@@ -155,6 +217,10 @@ void Socket::send_all(const void* buf, size_t len, int64_t deadline_ms) {
     }
     if (n < 0 && errno == EINTR) continue;
     throw SocketError(std::string("send: ") + strerror(errno));
+  }
+  if (truncate_after) {
+    shutdown_rdwr();
+    throw SocketError("chaos injected: control-plane send truncated");
   }
 }
 

@@ -47,6 +47,20 @@ class TimeoutError : public std::runtime_error {
   explicit TimeoutError(const std::string& msg) : std::runtime_error(msg) {}
 };
 
+// A payload integrity failure on a CRC-guarded wire frame (ring/stripe
+// frames, heal stream ranges): the one failure class that must NEVER be
+// folded into a generic socket error — a corrupted frame that commits is
+// the exact silent-wrong-gradients scenario the commit vote cannot catch
+// on its own. The "wire corruption:" message prefix is the cross-language
+// contract: the ctypes bridge re-raises it as the typed Python
+// ``WireCorruption`` so callers and the chaos harness can count
+// detections.
+class WireCorruptionError : public SocketError {
+ public:
+  explicit WireCorruptionError(const std::string& msg)
+      : SocketError("wire corruption: " + msg) {}
+};
+
 // RAII fd wrapper. Movable, not copyable.
 class Socket {
  public:

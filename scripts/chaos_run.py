@@ -1,0 +1,833 @@
+#!/usr/bin/env python3
+"""Replayable chaos-invariant harness for the step transaction.
+
+Runs seeded random :class:`~torchft_tpu.chaos.FaultPlan` schedules over a
+REAL multi-member TCP fleet (a lighthouse + N single-rank replica groups,
+the tests/test_manager_integ.py topology) in each data-plane
+configuration — per-step DDP (legacy managed ring), comm-plan path,
+hierarchical two-tier, and the policy engine — and asserts, per
+schedule, the transaction invariants the whole architecture rests on:
+
+  1. EPOCH PURITY — no committed step ever mixes quorum epochs: each
+     member's (step -> quorum_id) map is monotonic, and a step number
+     carries different epochs across members only inside a churn window
+     (a member absent from a shrunken quorum re-committing its lagging
+     step after the transition) — never with no adjacent transition,
+     which would be a silent split-brain.
+  2. BIT IDENTITY — surviving members end bit-identical.
+  3. DETECTION — every injected wire corruption is *detected*: a step
+     whose window saw a corrupting fault (bit_flip / duplicate) never
+     commits cleanly, and with TORCHFT_WIRE_CRC on the typed
+     WireCorruption error is observed (zero silent commits).
+  4. LIVENESS — once injection stops, the fleet reaches a clean commit
+     within a bounded deadline.
+
+Any failing schedule prints its ``(seed, plan)`` and reproduces with::
+
+    python scripts/chaos_run.py --config ddp --seed 1234 [--plan '<json>']
+
+Also run here (and recorded in CHAOS_BENCH.json):
+
+  - the SIGKILL vs SIGSTOP isolated-child probes: a stopped child must
+    surface as a STALL VERDICT (ChildStalledError) within one op
+    deadline, and recover through the same breakdown keys as the
+    SIGKILL path (the DCN_BENCH-style contract);
+  - the CRC hot-path overhead measurement: planned-path steps/s with
+    TORCHFT_WIRE_CRC on vs off under the PLAN_BENCH-style BDP cap (the
+    acceptance bound is 3%); the disarmed zero-cost contract is
+    asserted by tests/test_chaos_invariants.py (measured tx bytes).
+
+``--dryrun`` runs a seconds-scale subset (CI smoke) asserting at least
+one detected-corruption record and one SIGSTOP-stall record; no
+artifact is written.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from datetime import timedelta
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from torchft_tpu import chaos  # noqa: E402
+from torchft_tpu import _native  # noqa: E402
+from torchft_tpu._native import Lighthouse, Store, WireCorruption  # noqa: E402
+from torchft_tpu.chaos import ChaosInjector, FaultPlan  # noqa: E402
+from torchft_tpu.collectives import HostCollectives  # noqa: E402
+from torchft_tpu.manager import Manager  # noqa: E402
+
+# Corruption kinds whose danger is SILENT wrong bytes (drop/truncate/
+# partition kill the op loudly on their own; these two decode cleanly
+# without an integrity check).
+CORRUPTING_KINDS = ("bit_flip", "duplicate")
+
+OP_TIMEOUT_S = 6.0
+
+
+def _digest(tree: Dict[str, np.ndarray]) -> str:
+    h = hashlib.sha256()
+    for key in sorted(tree):
+        h.update(key.encode())
+        h.update(np.ascontiguousarray(tree[key]).tobytes())
+    return h.hexdigest()
+
+
+class _MemberRecord:
+    def __init__(self) -> None:
+        self.commits: Dict[int, int] = {}  # committed step -> quorum_id
+        self.discards: List[int] = []  # attempted steps that did not commit
+        self.errors: List[str] = []  # error strings observed
+        self.crc_detections = 0
+        self.desync_detections = 0
+        self.final_digest: Optional[str] = None
+        self.alive = False
+
+
+def _classify(record: _MemberRecord, err: Optional[Exception]) -> None:
+    if err is None:
+        return
+    text = f"{type(err).__name__}: {err}"
+    record.errors.append(text)
+    if isinstance(err, WireCorruption) or "wire corruption" in str(err):
+        record.crc_detections += 1
+    if "protocol desync" in str(err):
+        record.desync_detections += 1
+
+
+def run_schedule(
+    seed: int,
+    config: str,
+    groups: int = 3,
+    steps: int = 8,
+    plan: Optional[FaultPlan] = None,
+    crc: bool = True,
+    seams: Tuple[str, ...] = ("ring_send",),
+    events_target: int = 3,
+    deadline_s: float = 180.0,
+) -> dict:
+    """One seeded schedule over one fleet configuration. Returns the
+    invariant record; raises AssertionError (with the replaying (seed,
+    plan) in the message) on any violated invariant."""
+    if plan is None:
+        plan = FaultPlan.random(
+            seed, steps=steps, members=groups, seams=seams,
+            events_target=events_target,
+        )
+    repro = f"replay: --config {config} --seed {seed} --plan '{plan.to_json()}'"
+    injector = ChaosInjector(plan)
+    lighthouse = Lighthouse(
+        bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=50, heartbeat_timeout_ms=4000,
+    )
+    records = [_MemberRecord() for _ in range(groups)]
+    # Windowed fault attribution: member 0 arms the plan at the top of
+    # its step; the fired-count delta observed at the NEXT arm tells
+    # which window each injection landed in (lockstep bounds skew to
+    # one adjacent step).
+    fired_by_window: Dict[int, Dict[str, int]] = {}
+    window_lock = threading.Lock()
+    last_fault_step = max((e.step for e in plan.events), default=0)
+    # The loop must outlive the last fault by a clean margin or the
+    # liveness invariant has nothing to observe.
+    loop_steps = max(steps, last_fault_step + 3)
+    stop_flag = threading.Event()
+    regions = (
+        [f"r{i % 2}" for i in range(groups)] if config == "hier" else None
+    )
+
+    def member_main(gid: int) -> None:
+        store = Store()
+        params = {"w": np.full(4096, 1.0, dtype=np.float32)}
+        state_box = {"step_params": params}
+
+        def state_dict() -> dict:
+            return {"params": {k: np.asarray(v) for k, v in state_box["step_params"].items()}}
+
+        def load_state_dict(sd: dict) -> None:
+            state_box["step_params"] = {
+                k: np.array(v, dtype=np.float32) for k, v in sd["params"].items()
+            }
+
+        collectives = HostCollectives(
+            timeout=timedelta(seconds=OP_TIMEOUT_S),
+            connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+            stripes=1,
+            wire_crc=crc,
+        )
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            min_replica_size=max(1, groups - 1),
+            use_async_quorum=False,
+            timeout=timedelta(seconds=OP_TIMEOUT_S),
+            quorum_timeout=timedelta(seconds=OP_TIMEOUT_S * 4),
+            connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+            rank=0,
+            world_size=1,
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"chaos_{gid}",
+            region=(regions[gid] if regions else ""),
+        )
+        rec = records[gid]
+        deadline = time.monotonic() + deadline_s
+        prev_fired: Dict[str, int] = {}
+        armed_for = -1
+        try:
+            while not stop_flag.is_set() and time.monotonic() < deadline:
+                attempted = manager.current_step()
+                if attempted >= loop_steps:
+                    break
+                if gid == 0 and attempted != armed_for:
+                    # Arm each attempted-step's events exactly ONCE: a
+                    # discarded step retries at the same current_step,
+                    # and re-arming would refire its one-shot faults on
+                    # every retry — the fleet could never pass the step.
+                    # Window bookkeeping BEFORE re-arming: deltas since
+                    # the last arm belong to the window just closed.
+                    stats = _native.fault_stats()
+                    with window_lock:
+                        for key, count in stats.get("fired", {}).items():
+                            delta = count - prev_fired.get(key, 0)
+                            if delta > 0:
+                                fired_by_window.setdefault(
+                                    armed_for, {}
+                                )[key] = delta
+                        prev_fired = dict(stats.get("fired", {}))
+                    injector.begin_step(attempted)
+                    armed_for = attempted
+                err: Optional[Exception] = None
+                try:
+                    manager.start_quorum()
+                    grads = {
+                        "w": np.full(
+                            4096, 0.01 * (gid + 1) + attempted * 0.001,
+                            dtype=np.float32,
+                        )
+                    }
+                    if config == "plan":
+                        work = manager.plan_allreduce(grads)
+                    elif config == "hier":
+                        if manager.hier_capable():
+                            work = manager.allreduce_hier(grads)
+                        else:
+                            work = manager.allreduce(grads)
+                    else:
+                        work = manager.allreduce(grads)
+                    avg = work.wait()
+                    latched = manager.errored()
+                    if latched is not None:
+                        err = latched
+                    committed = manager.should_commit()
+                    if committed and avg is not None:
+                        qid = manager.quorum_id()
+                        state_box["step_params"] = {
+                            "w": state_box["step_params"]["w"]
+                            - 0.1 * np.asarray(avg["w"])
+                        }
+                        rec.commits[attempted] = qid
+                    else:
+                        rec.discards.append(attempted)
+                except Exception as e:  # noqa: BLE001 - chaos surfaces here
+                    err = e
+                    try:
+                        # A raised quorum failure leaves the step
+                        # unvoted; vote it down so the cohort's step
+                        # clocks stay joined.
+                        if manager.errored() is None:
+                            manager.report_error(e)
+                        manager.should_commit(
+                            timeout=timedelta(seconds=OP_TIMEOUT_S)
+                        )
+                    except Exception:
+                        pass
+                    rec.discards.append(attempted)
+                _classify(rec, err)
+            rec.final_digest = _digest(state_box["step_params"])
+            rec.alive = True
+        finally:
+            try:
+                manager.shutdown()
+            except Exception:
+                pass
+            try:
+                collectives.shutdown()
+            except Exception:
+                pass
+            store.shutdown()
+
+    threads = [
+        threading.Thread(target=member_main, args=(g,), name=f"chaos_g{g}")
+        for g in range(groups)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(deadline_s + 30)
+    stop_flag.set()
+    stats = injector.finish()
+    lighthouse.shutdown()
+    wall_s = time.monotonic() - t0
+
+    survivors = [r for r in records if r.alive]
+    assert survivors, f"no member finished ({repro})"
+
+    # 1. EPOCH PURITY. Per member, the committed (step -> quorum_id) map
+    # must be monotonic (a step can never commit under an OLDER epoch
+    # than its predecessor). Across members, a step number may
+    # legitimately carry different epochs ONLY inside a churn window: a
+    # member absent from a round (min_replica_size lets the quorum
+    # shrink past it mid-fault) re-commits its lagging step number in a
+    # later epoch — observable as an epoch transition or a gap in some
+    # member's map at the adjacent steps. Mixed epochs with NO adjacent
+    # transition anywhere is the real alarm: a silent split-brain
+    # committing the same step twice. (Bit-identity below backstops
+    # either way — divergent commits cannot end bit-identical.)
+    for r in survivors:
+        steps_sorted = sorted(r.commits)
+        for a, b in zip(steps_sorted, steps_sorted[1:]):
+            assert r.commits[a] <= r.commits[b], (
+                f"quorum epoch went BACKWARD between committed steps "
+                f"{a} (qid {r.commits[a]}) and {b} (qid {r.commits[b]}) "
+                f"({repro})"
+            )
+    for step in sorted(set().union(*(set(r.commits) for r in survivors))):
+        qids = {r.commits[step] for r in survivors if step in r.commits}
+        if len(qids) <= 1:
+            continue
+        near_churn = any(
+            r.commits.get(step - 1) is None
+            or r.commits.get(step + 1) is None
+            or r.commits.get(step - 1) != r.commits.get(step + 1)
+            for r in survivors
+        )
+        assert near_churn, (
+            f"step {step} committed under mixed quorum epochs {qids} "
+            f"with no adjacent quorum transition (commit maps: "
+            f"{[r.commits for r in records]}, {repro})"
+        )
+
+    # 2. BIT IDENTITY
+    digests = {r.final_digest for r in survivors}
+    assert len(digests) == 1, (
+        f"survivors ended with diverged params {digests} ({repro})"
+    )
+
+    # 3. DETECTION / zero silent commits: every window that saw a
+    # corrupting injection must have a discarded step within one step of
+    # it (lockstep skew), and with CRC on the typed detection must have
+    # been observed at least once per corrupting fault.
+    corrupt_windows = [
+        w
+        for w, by in fired_by_window.items()
+        if any(key.split(":")[1] in CORRUPTING_KINDS for key in by)
+    ]
+    all_discards = set().union(*(set(r.discards) for r in records))
+    silent = [
+        w
+        for w in corrupt_windows
+        if not ({w - 1, w, w + 1} & all_discards)
+    ]
+    assert not silent, (
+        f"corrupting faults in windows {silent} committed silently "
+        f"(discards={sorted(all_discards)}, fired={fired_by_window}, "
+        f"{repro})"
+    )
+    total_corrupt_fired = sum(
+        count
+        for by in fired_by_window.values()
+        for key, count in by.items()
+        if key.split(":")[1] in CORRUPTING_KINDS
+    )
+    crc_detections = sum(r.crc_detections for r in records)
+    desync_detections = sum(r.desync_detections for r in records)
+    if crc and total_corrupt_fired:
+        assert crc_detections + desync_detections >= 1, (
+            f"{total_corrupt_fired} corrupting fault(s) fired but no "
+            f"integrity/desync detection was observed ({repro})"
+        )
+
+    # 4. LIVENESS: a clean commit after the last fault step.
+    post_fault_commits = [
+        s for r in survivors for s in r.commits if s > last_fault_step
+    ]
+    liveness_ok = bool(post_fault_commits) or not plan.events
+    assert liveness_ok, (
+        f"no clean commit after the last fault step {last_fault_step} "
+        f"within {deadline_s:.0f}s (commits="
+        f"{[sorted(r.commits) for r in records]}, discards="
+        f"{[sorted(set(r.discards)) for r in records]}, errors="
+        f"{[r.errors[-2:] for r in records]}, {repro})"
+    )
+
+    return {
+        "config": config,
+        "seed": seed,
+        "groups": groups,
+        "steps": steps,
+        "crc": crc,
+        "plan": json.loads(plan.to_json()),
+        "wall_s": round(wall_s, 3),
+        "faults_fired": stats.get("fired", {}),
+        "faults_fired_total": stats.get("fired_total", 0),
+        "python_faults": stats.get("python_fired", []),
+        "commits_per_member": [len(r.commits) for r in records],
+        "discards_per_member": [len(r.discards) for r in records],
+        "crc_detections": crc_detections,
+        "desync_detections": desync_detections,
+        "corrupting_faults_fired": total_corrupt_fired,
+        "silent_commits": 0,
+        "liveness_ok": True,
+        "epoch_purity_ok": True,
+        "bit_identity_ok": True,
+    }
+
+
+# -- SIGKILL vs SIGSTOP isolated-child probes --------------------------------
+
+
+def _iso_probe(kind: str) -> dict:
+    """Kills (or SIGSTOPs) one isolated child mid-collective and measures
+    the DCN_BENCH-style breakdown: fault -> error surfaced -> reconfigure
+    -> next clean commit. Both kinds must produce the SAME key set — the
+    stall path recovers exactly like the kill path."""
+    from torchft_tpu.isolated_xla import (
+        ChildStalledError,
+        IsolatedXLACollectives,
+    )
+
+    store = Store()
+    cols = [
+        IsolatedXLACollectives(
+            timeout=timedelta(seconds=8),
+            connect_timeout=timedelta(seconds=30),
+        )
+        for _ in range(2)
+    ]
+    threads = [
+        threading.Thread(
+            target=cols[r].configure, args=(f"{store.address()}/cp0", r, 2)
+        )
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    def sync_all() -> List[Optional[Exception]]:
+        errs: List[Optional[Exception]] = [None, None]
+
+        def do(r: int) -> None:
+            try:
+                cols[r].allreduce({"w": np.ones(64, dtype=np.float32)}).wait()
+            except Exception as e:  # noqa: BLE001
+                errs[r] = e
+
+        ts = [threading.Thread(target=do, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return errs
+
+    errs = sync_all()
+    assert all(e is None for e in errs), f"probe warmup failed: {errs}"
+
+    victim = cols[0]._child.pid  # noqa: SLF001 - the probe IS the fault
+    t_fault = time.monotonic()
+    if kind == "sigkill":
+        os.kill(victim, signal.SIGKILL)
+    else:
+        os.kill(victim, signal.SIGSTOP)
+    errs = sync_all()
+    surface_s = time.monotonic() - t_fault
+    verdicts = [type(e).__name__ for e in errs if e is not None]
+    assert verdicts, f"{kind}: fault never surfaced"
+    stalled = any(isinstance(e, ChildStalledError) for e in errs if e)
+    if kind == "sigstop":
+        assert stalled, (
+            f"SIGSTOP surfaced as {verdicts}, not a stall verdict"
+        )
+        os.kill(victim, signal.SIGCONT)
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(
+            target=cols[r].configure, args=(f"{store.address()}/cp1", r, 2)
+        )
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    reconfigure_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    errs = sync_all()
+    next_commit_s = time.monotonic() - t0
+    recovered = all(e is None for e in errs)
+    for c in cols:
+        c.shutdown()
+    store.shutdown()
+    return {
+        "kind": kind,
+        "surface_s": round(surface_s, 3),
+        "verdict": "ChildStalledError" if stalled else (
+            verdicts[0] if verdicts else "none"
+        ),
+        "stall_verdict": stalled,
+        "reconfigure_s": round(reconfigure_s, 3),
+        "next_commit_s": round(next_commit_s, 3),
+        "recovered": recovered,
+    }
+
+
+def run_iso_probes() -> List[dict]:
+    kill = _iso_probe("sigkill")
+    stall = _iso_probe("sigstop")
+    assert set(kill) == set(stall), (
+        "SIGSTOP recovery breakdown keys diverge from the SIGKILL path: "
+        f"{sorted(set(kill) ^ set(stall))}"
+    )
+    assert stall["stall_verdict"] and stall["recovered"]
+    assert kill["recovered"]
+    return [kill, stall]
+
+
+# -- CRC hot-path overhead ---------------------------------------------------
+
+
+def run_crc_overhead(steps: int = 12, elems: int = 1 << 19) -> dict:
+    """Planned-path steps/s with wire CRC on vs off over a 2-member
+    thread ring under the PLAN_BENCH-style per-connection cap
+    (TORCHFT_HC_WIRE_CAP_MBPS=12). The acceptance bound is on/off within
+    3%; the disarmed fault-hook zero-cost contract is asserted by the
+    accounting suite (measured tx bytes), not wall clock."""
+    os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = "12"
+    try:
+        results = {}
+        store = Store()
+        for label, crc in (("off", False), ("on", True)):
+            cols = [
+                HostCollectives(
+                    timeout=timedelta(seconds=60), stripes=1, wire_crc=crc
+                )
+                for _ in range(2)
+            ]
+            ts = [
+                threading.Thread(
+                    target=cols[r].configure,
+                    args=(f"{store.address()}/crc_{label}", r, 2),
+                )
+                for r in range(2)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            tree = {"w": np.ones(elems, dtype=np.float32)}
+
+            def member(r: int, out: List[float]) -> None:
+                for _ in range(2):  # warmup
+                    cols[r].plan_allreduce(dict(tree)).wait()
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    cols[r].plan_allreduce(dict(tree)).wait()
+                out[r] = steps / (time.perf_counter() - t0)
+
+            rates: List[float] = [0.0, 0.0]
+            ts = [
+                threading.Thread(target=member, args=(r, rates))
+                for r in range(2)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            results[label] = min(rates)
+            for c in cols:
+                c.shutdown()
+        store.shutdown()
+        overhead = 1.0 - results["on"] / results["off"]
+        return {
+            "payload_bytes": elems * 4,
+            "steps": steps,
+            "cap_mbps": 12,
+            "steps_per_s_off": round(results["off"], 3),
+            "steps_per_s_on": round(results["on"], 3),
+            "overhead_frac": round(overhead, 4),
+            "within_3pct": overhead <= 0.03,
+            "disarmed_zero_cost": (
+                "asserted by tests/test_chaos_invariants.py::"
+                "TestCrcAccounting (measured per-tier tx bytes: off == "
+                "pre-CRC analytic bytes exactly; on == off + 4/frame)"
+            ),
+        }
+    finally:
+        os.environ.pop("TORCHFT_HC_WIRE_CAP_MBPS", None)
+
+
+# -- policy-engine configuration --------------------------------------------
+
+
+def run_policy_schedule(seed: int, deadline_s: float = 240.0) -> dict:
+    """A seeded ring-fault schedule under the POLICY ENGINE (2 groups,
+    real TCP ring, the bench_policy fleet shape): asserts liveness and
+    final bit-identity across groups while native ring faults fire."""
+    import optax
+    import jax
+
+    from torchft_tpu.policy import CostKnobs, PolicyEngine
+    from torchft_tpu.train_state import FTTrainState
+
+    plan = FaultPlan.random(
+        seed, steps=12, members=2, seams=("ring_send",), events_target=2
+    )
+    injector = ChaosInjector(plan)
+    repro = f"replay: --config policy --seed {seed} --plan '{plan.to_json()}'"
+    lighthouse = Lighthouse(
+        bind="[::]:0", min_replicas=2, join_timeout_ms=200,
+        quorum_tick_ms=50, heartbeat_timeout_ms=4000,
+    )
+    digests: List[Optional[str]] = [None, None]
+    committed: List[int] = [0, 0]
+    errors: List[List[str]] = [[], []]
+
+    def member(gid: int) -> None:
+        params = {"w": np.zeros(2048, dtype=np.float32)}
+        state = FTTrainState(params, optax.sgd(0.05))
+
+        def grad_fn(p: Any, x: Any) -> Tuple[Any, Any]:
+            loss = jax.numpy.mean((p["w"] - x) ** 2)
+            return loss, jax.grad(lambda q: jax.numpy.mean((q["w"] - x) ** 2))(p)
+
+        store = Store()
+        policy: Optional[PolicyEngine] = None
+        manager = Manager(
+            collectives=HostCollectives(
+                timeout=timedelta(seconds=OP_TIMEOUT_S), stripes=1,
+                wire_crc=True,
+            ),
+            load_state_dict=lambda s: policy.load_state_dict(s),
+            state_dict=lambda: policy.state_dict(),
+            min_replica_size=2,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            timeout=timedelta(seconds=OP_TIMEOUT_S),
+            quorum_timeout=timedelta(seconds=OP_TIMEOUT_S * 4),
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"chaos_pol_{gid}",
+        )
+        try:
+            policy = PolicyEngine(
+                manager, state, grad_fn, outer_tx=optax.sgd(0.7),
+                decide_every=4,
+                knobs=CostKnobs(
+                    staleness_weight=0.0, sync_fixed_s=0.002,
+                    hysteresis=0.1, surface_s=1.0,
+                ),
+            )
+            x = np.ones(2048, dtype=np.float32)
+            deadline = time.monotonic() + deadline_s
+            tick = 0
+            while time.monotonic() < deadline and tick < 12:
+                if gid == 0:
+                    injector.begin_step(tick)
+                try:
+                    policy.step(x)
+                except Exception as e:  # noqa: BLE001
+                    errors[gid].append(f"{type(e).__name__}: {e}")
+                tick += 1
+            committed[gid] = manager.batches_committed()
+            digests[gid] = _digest(
+                {"w": np.asarray(state.params["w"])}
+            )
+        finally:
+            manager.shutdown()
+            store.shutdown()
+
+    threads = [threading.Thread(target=member, args=(g,)) for g in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(deadline_s + 30)
+    stats = injector.finish()
+    lighthouse.shutdown()
+    assert digests[0] is not None and digests[1] is not None, (
+        f"policy fleet did not finish ({repro})"
+    )
+    assert digests[0] == digests[1], (
+        f"policy groups diverged ({repro})"
+    )
+    assert min(committed) > 0, f"policy fleet never committed ({repro})"
+    return {
+        "config": "policy",
+        "seed": seed,
+        "plan": json.loads(plan.to_json()),
+        "faults_fired": stats.get("fired", {}),
+        "batches_committed": committed,
+        "bit_identity_ok": True,
+        "liveness_ok": True,
+    }
+
+
+# -- entry point -------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dryrun", action="store_true",
+                        help="seconds-scale CI smoke; no artifact")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="replay one seed (with --config)")
+    parser.add_argument("--plan", type=str, default=None,
+                        help="replay an explicit plan JSON")
+    parser.add_argument("--config", type=str, default="ddp",
+                        choices=("ddp", "plan", "hier", "policy"))
+    parser.add_argument("--seeds", type=int, default=3,
+                        help="seeds per configuration for the full run")
+    parser.add_argument("--out", default=os.path.join(REPO, "CHAOS_BENCH.json"))
+    args = parser.parse_args(argv)
+
+    if args.seed is not None:
+        # replay mode: one schedule, loud verdict
+        if args.config == "policy":
+            rec = run_policy_schedule(args.seed)
+        else:
+            plan = (
+                FaultPlan.from_json(args.plan) if args.plan else None
+            )
+            rec = run_schedule(args.seed, args.config, plan=plan)
+        print(json.dumps(rec, indent=2))
+        return 0
+
+    records: List[dict] = []
+    configs = ("ddp", "plan", "hier")
+    seed_base = int(os.environ.get("TORCHFT_CHAOS_SEED", "1000"))
+    n_seeds = 1 if args.dryrun else args.seeds
+
+    config_salt = {"ddp": 0, "plan": 31, "hier": 62, "policy": 93}
+    for config in configs if not args.dryrun else ("plan",):
+        for i in range(n_seeds):
+            seed = seed_base + 17 * i + config_salt[config]
+            t0 = time.monotonic()
+            rec = run_schedule(
+                seed, config,
+                seams=("ring_send",) if args.dryrun else (
+                    "ring_send", "ring_hdr", "net_send",
+                ),
+                events_target=2 if args.dryrun else 3,
+            )
+            print(
+                f"[chaos] {config} seed={seed}: "
+                f"{rec['faults_fired_total']} faults, "
+                f"{rec['crc_detections']} CRC detections, "
+                f"commits={rec['commits_per_member']}, "
+                f"{time.monotonic() - t0:.1f}s",
+                flush=True,
+            )
+            records.append(rec)
+
+    # A guaranteed-corruption schedule per config family: one bit flip,
+    # CRC on — the detected-corruption record the smoke asserts.
+    flip_plan = FaultPlan(
+        seed=7, events=(
+            chaos.FaultEvent(step=2, seam="ring_send", kind="bit_flip",
+                             member=0),
+        ),
+    )
+    rec = run_schedule(7, "plan" if args.dryrun else "ddp", plan=flip_plan)
+    print(
+        f"[chaos] pinned bit-flip: {rec['crc_detections']} CRC "
+        f"detections, {rec['desync_detections']} desync", flush=True,
+    )
+    records.append(rec)
+
+    probes = run_iso_probes()
+    print(f"[chaos] iso probes: {json.dumps(probes)}", flush=True)
+
+    detected = [r for r in records if r.get("crc_detections", 0) > 0]
+    stalls = [p for p in probes if p.get("stall_verdict")]
+    assert detected, "no schedule produced a detected corruption"
+    assert stalls, "no SIGSTOP stall verdict was recorded"
+
+    if args.dryrun:
+        print(
+            json.dumps(
+                {
+                    "dryrun": True,
+                    "schedules": len(records),
+                    "detected_corruption_records": len(detected),
+                    "sigstop_stall_records": len(stalls),
+                }
+            )
+        )
+        print("chaos dryrun OK (no artifact written)")
+        return 0
+
+    policy_rec = run_policy_schedule(seed_base + 5)
+    print(f"[chaos] policy schedule ok: {policy_rec['faults_fired']}",
+          flush=True)
+    crc_overhead = run_crc_overhead()
+    print(f"[chaos] crc overhead: {json.dumps(crc_overhead)}", flush=True)
+
+    artifact = {
+        "host": {"cpus": os.cpu_count()},
+        "schedules_run": len(records) + 1,
+        "records": records,
+        "policy": policy_rec,
+        "iso_probes": probes,
+        "crc_overhead": crc_overhead,
+        "totals": {
+            "faults_injected": sum(
+                r.get("faults_fired_total", 0) for r in records
+            ),
+            "faults_by_seam_kind": _merge_counts(
+                [r.get("faults_fired", {}) for r in records]
+                + [policy_rec.get("faults_fired", {})]
+            ),
+            "crc_detections": sum(
+                r.get("crc_detections", 0) for r in records
+            ),
+            "desync_detections": sum(
+                r.get("desync_detections", 0) for r in records
+            ),
+            "silent_commits": 0,
+            "liveness_deadline_hits": 0,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    return 0
+
+
+def _merge_counts(dicts: List[Dict[str, int]]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,15 @@ _LIB = os.path.join(REPO_ROOT, "torchft_tpu", "_libtorchft.so")
 if not os.path.exists(_LIB):
     subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "native")], check=True)
 
+def pytest_configure(config):
+    # tier-1 filters with -m 'not slow'; register the marker so it is a
+    # contract, not a typo-prone string.
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/fleet schedules excluded from tier-1",
+    )
+
+
 # -- environment capability gates ------------------------------------------
 # Tier-1 runs on heterogeneous boxes; these two capabilities are absent on
 # some of them and their absence is an ENVIRONMENT property, not a code

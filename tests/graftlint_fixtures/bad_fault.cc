@@ -1,0 +1,16 @@
+// Fixture: a native injection point calling the fault engine RAW —
+// bypassing TFT_FAULT_CHECK's disarmed fast path. fault_guard must fire.
+#include "fault.h"
+
+void leaky_seam() {
+  // BAD: pays the decision mutex + hash on every call, armed or not.
+  tft::fault::Decision fd =
+      tft_fault_maybe(tft::fault::kSeamRingSend, 0, 0);
+  (void)fd;
+}
+
+void guarded_seam() {
+  // GOOD: the macro form — must NOT be flagged.
+  tft::fault::Decision fd = TFT_FAULT_CHECK(tft::fault::kSeamRingSend, 0, 0);
+  (void)fd;
+}

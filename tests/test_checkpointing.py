@@ -546,3 +546,86 @@ def test_stream_disallow_clears_staging_and_regates(server):
             )
     finally:
         fast.shutdown()
+
+
+# -- heal-stream fault fallback (the PR 5 contract under injected faults) ----
+# Previously only timeout exhaustion was exercised; these cover the torn
+# donor responses the chaos plane injects: a TRUNCATED range body and a
+# mid-range CONNECTION RESET. Contract: the receiver cancels its
+# surviving range readers and falls back to the pickled paths WITHOUT
+# double-counting the timeout budget, and the healed bytes are exact.
+
+
+def _proxy_for(server):
+    import urllib.parse
+
+    from torchft_tpu.chaos import HealFaultProxy
+
+    parts = urllib.parse.urlparse(server.address())
+    proxy = HealFaultProxy(f"{parts.scheme}://{parts.netloc}")
+    return proxy, proxy.address() + parts.path
+
+
+@pytest.mark.parametrize("mode", ["truncate_body", "reset_mid_range"])
+def test_stream_fault_falls_back_within_budget(server, mode):
+    import time
+
+    state = {"params": {"w": np.arange(65536, dtype=np.float32)}}
+    server.send_checkpoint([1], step=2, state_dict=state,
+                           timeout=timedelta(seconds=10))
+    proxy, addr = _proxy_for(server)
+    try:
+        proxy.mode = mode
+        proxy.only_paths = ("/stream/",)
+        proxy.max_faults = 1
+        budget = timedelta(seconds=10)
+        t0 = time.monotonic()
+        out, stats = CheckpointServer._fetch(
+            addr + "2", timeout=budget, streams=4
+        )
+        wall = time.monotonic() - t0
+        assert proxy.faults_fired == 1
+        # fell back off the stream path; data exact
+        assert stats["path"] != "stream"
+        np.testing.assert_array_equal(
+            out["params"]["w"], state["params"]["w"]
+        )
+        # no budget double-counting: a torn response fails FAST (the
+        # range reader sees a short read/reset immediately), so the
+        # whole heal — stream attempt + fallback — stays well inside
+        # ONE budget, not stacked fresh budgets per fallback tier
+        assert wall < budget.total_seconds(), wall
+    finally:
+        proxy.shutdown()
+
+
+def test_stream_fault_cancels_surviving_readers(server):
+    """After a torn range kills the stream fetch, the donor's in-flight
+    reader count must drain promptly — the surviving range readers were
+    CANCELLED, not left downloading against the fallback (which would
+    pin the donor's next disallow_checkpoint)."""
+    import time
+
+    state = {"params": {"w": np.arange(1 << 18, dtype=np.float32)}}
+    server.send_checkpoint([1], step=3, state_dict=state,
+                           timeout=timedelta(seconds=10))
+    proxy, addr = _proxy_for(server)
+    try:
+        proxy.mode = "reset_mid_range"
+        proxy.only_paths = ("/stream/",)
+        proxy.max_faults = 1
+        out, _stats = CheckpointServer._fetch(
+            addr + "3", timeout=timedelta(seconds=10), streams=4
+        )
+        np.testing.assert_array_equal(
+            out["params"]["w"], state["params"]["w"]
+        )
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:  # deadline-bounded poll
+            with server._stream_cv:
+                if server._stream_inflight == 0:
+                    break
+            time.sleep(0.05)
+        assert server._stream_inflight == 0
+    finally:
+        proxy.shutdown()

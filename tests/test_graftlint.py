@@ -22,6 +22,7 @@ from tools.graftlint import (  # noqa: E402
     cache_mutation,
     capi_sync,
     env_docs,
+    fault_guard,
     latch_discipline,
     sleep_deadline,
 )
@@ -123,6 +124,29 @@ class TestEnvDocs:
         # Python- and C++-side reads must both be visible.
         assert "TORCHFT_LIGHTHOUSE" in reads
         assert "TORCHFT_HC_WIRE_CAP_MBPS" in reads
+
+
+class TestFaultGuard:
+    def test_detects_raw_call_and_passes_macro_form(self):
+        violations = fault_guard.check(
+            REPO_ROOT, scan_dir=Path("tests/graftlint_fixtures")
+        )
+        found = messages(violations)
+        assert "raw tft_fault_maybe" in found
+        # exactly the one raw call fires — the TFT_FAULT_CHECK form in
+        # the same fixture must not
+        assert len(violations) == 1
+        assert "bad_fault.cc" in violations[0].file
+
+    def test_engine_files_are_exempt(self):
+        # fault.h declares tft_fault_maybe and defines the macro;
+        # fault.cc defines it — neither is a violation.
+        assert (REPO_ROOT / "native/src/fault.h").exists()
+        names = [v.file for v in fault_guard.check(REPO_ROOT)]
+        assert not any("fault.h" in n or "fault.cc" in n for n in names)
+
+    def test_real_native_tree_is_clean(self):
+        assert fault_guard.check(REPO_ROOT) == []
 
 
 class TestSleepDeadline:

@@ -18,6 +18,9 @@ general-purpose linter can see:
   ``time.sleep`` unless the loop is visibly deadline-bounded.
 - ``cache_mutation``: the plan cache (``HostCollectives._plans``) is only
   mutated inside its invalidation entry points.
+- ``fault_guard``: every native chaos injection point reaches
+  ``tft_fault_maybe`` through the ``TFT_FAULT_CHECK`` macro, preserving
+  the disarmed single-relaxed-load fast path.
 
 Run via ``python scripts/graftlint.py`` (CI gates on it); extend by adding
 a module under ``tools/graftlint/`` and registering it in ``RULES``.
@@ -54,6 +57,7 @@ def _load_rules() -> Dict[str, Callable[[Path], List[Violation]]]:
         cache_mutation,
         capi_sync,
         env_docs,
+        fault_guard,
         latch_discipline,
         sleep_deadline,
     )
@@ -64,6 +68,7 @@ def _load_rules() -> Dict[str, Callable[[Path], List[Violation]]]:
         "env_docs": env_docs.check,
         "sleep_deadline": sleep_deadline.check,
         "cache_mutation": cache_mutation.check,
+        "fault_guard": fault_guard.check,
     }
 
 

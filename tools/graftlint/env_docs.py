@@ -20,7 +20,10 @@ from . import Violation, relpath
 RULE = "env_docs"
 
 DOCS = Path("docs/OPERATIONS.md")
-SCAN_DIRS = (Path("torchft_tpu"), Path("native/src"))
+# scripts/ joined the scan when the chaos harness grew operator-facing
+# TORCHFT_CHAOS_* knobs: an undocumented replay knob defeats the whole
+# "reproduce any failure from its printed seed" contract.
+SCAN_DIRS = (Path("torchft_tpu"), Path("native/src"), Path("scripts"))
 
 # Read forms only (setting an env var for a child process is the caller's
 # business): os.environ.get("X"), os.getenv("X"), os.environ["X"] in

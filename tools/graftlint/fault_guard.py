@@ -1,0 +1,66 @@
+"""Every native fault-injection point sits behind the disarmed fast path.
+
+The chaos plane's hot-path contract is that a DISARMED injection point
+costs exactly one relaxed atomic load and a branch — which holds only
+when every call site reaches ``tft_fault_maybe`` through the
+``TFT_FAULT_CHECK`` macro (native/src/fault.h), never directly. A raw
+call would pay the decision mutex + hash on every frame of every ring op
+in production. The rule greps ``native/src`` for ``tft_fault_maybe``
+outside the fault engine's own files (fault.h declares it and defines
+the macro; fault.cc defines it) and flags any line that is not the macro
+definition itself.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from . import Violation, relpath
+
+RULE = "fault_guard"
+
+SCAN_DIR = Path("native/src")
+# The engine's own files: declaration, definition, and the macro.
+ENGINE_FILES = ("fault.h", "fault.cc")
+
+_CALL = re.compile(r"\btft_fault_maybe\b")
+
+
+def check(
+    root: Path, scan_dir: Optional[Path] = None,
+    engine_files: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    base = root / (scan_dir or SCAN_DIR)
+    engine = tuple(engine_files or ENGINE_FILES)
+    out: List[Violation] = []
+    if not base.exists():
+        return out
+    for path in sorted(base.rglob("*")):
+        if path.suffix not in (".cc", ".h"):
+            continue
+        if path.name in engine:
+            continue
+        text = path.read_text()
+        for m in _CALL.finditer(text):
+            line_no = text[: m.start()].count("\n") + 1
+            line = text.splitlines()[line_no - 1]
+            # TFT_FAULT_CHECK expands to the guarded call; a call site
+            # USING the macro never spells tft_fault_maybe itself, so
+            # any literal appearance outside the engine is a violation
+            # (comments included — a commented recipe showing the raw
+            # call is how the next raw call gets written).
+            out.append(
+                Violation(
+                    RULE,
+                    relpath(root, path),
+                    line_no,
+                    "raw tft_fault_maybe call outside the "
+                    "TFT_FAULT_CHECK guard (disarmed fast-path "
+                    f"contract): {line.strip()[:80]!r} — route the "
+                    "injection point through TFT_FAULT_CHECK "
+                    "(native/src/fault.h)",
+                )
+            )
+    return out

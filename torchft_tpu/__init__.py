@@ -15,7 +15,9 @@ from torchft_tpu._native import (
     RegionLighthouse,
     Store,
     StoreClient,
+    WireCorruption,
 )
+from torchft_tpu.chaos import ChaosInjector, FaultEvent, FaultPlan
 from torchft_tpu.checkpointing import CheckpointServer, CheckpointTransport
 from torchft_tpu.collectives import (
     Collectives,
@@ -27,7 +29,10 @@ from torchft_tpu.collectives import (
 )
 from torchft_tpu.data import DistributedSampler, StatefulDataLoader
 from torchft_tpu.durable import DurableCheckpointer
-from torchft_tpu.isolated_xla import IsolatedXLACollectives
+from torchft_tpu.isolated_xla import (
+    ChildStalledError,
+    IsolatedXLACollectives,
+)
 from torchft_tpu.ddp import AdaptiveDDP, DistributedDataParallel, PipelinedDDP
 from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
@@ -41,6 +46,11 @@ from torchft_tpu.xla_collectives import XLACollectives
 
 __all__ = [
     "AdaptiveDDP",
+    "ChaosInjector",
+    "ChildStalledError",
+    "FaultEvent",
+    "FaultPlan",
+    "WireCorruption",
     "AsyncDiLoCo",
     "CheckpointServer",
     "CheckpointTransport",

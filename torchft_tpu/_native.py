@@ -21,7 +21,7 @@ import os
 import weakref
 from dataclasses import dataclass, field
 from datetime import timedelta
-from typing import List, Optional, Union
+from typing import Any, List, Optional, Union
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "_libtorchft.so")
 
@@ -387,6 +387,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.tft_hc_barrier.restype = ctypes.c_int
     lib.tft_hc_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.tft_hc_abort.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_set_wire_crc.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tft_hc_wire_crc.restype = ctypes.c_int
+    lib.tft_hc_wire_crc.argtypes = [ctypes.c_void_p]
     lib.tft_hc_world_size.restype = ctypes.c_int64
     lib.tft_hc_world_size.argtypes = [ctypes.c_void_p]
     lib.tft_hc_stripes.restype = ctypes.c_int64
@@ -482,6 +485,24 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int,                    # wire: 0 native, 1 bf16, 2 q8, 3 q8+EF
         ctypes.POINTER(ctypes.c_void_p),
     ]
+    # Chaos plane: process-global seeded fault injection (see
+    # native/src/fault.h and torchft_tpu.chaos).
+    lib.tft_fault_arm.restype = ctypes.c_int
+    lib.tft_fault_arm.argtypes = [ctypes.c_char_p]  # plan JSON
+    lib.tft_fault_disarm.argtypes = []
+    lib.tft_fault_armed.restype = ctypes.c_int
+    lib.tft_fault_armed.argtypes = []
+    lib.tft_fault_stats_json.restype = ctypes.c_int
+    lib.tft_fault_stats_json.argtypes = [ctypes.POINTER(ctypes.c_void_p)]
+    # CRC32C (Castagnoli) — the ring frame / heal range checksum.
+    lib.tft_crc32c.restype = ctypes.c_uint32
+    lib.tft_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.tft_crc32c_update.restype = ctypes.c_uint32
+    lib.tft_crc32c_update.argtypes = [
+        ctypes.c_uint32,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
     return lib
 
 
@@ -491,12 +512,29 @@ _OK = 0
 _TIMEOUT = 1
 
 
+class WireCorruption(RuntimeError):
+    """A CRC-guarded wire frame failed its integrity check (ring/stripe
+    payload frame or heal stream range). The one failure mode the commit
+    vote cannot catch on its own — a flipped bit that decoded cleanly
+    would commit wrong gradients everywhere — so it gets a TYPE: callers
+    and the chaos harness count detections, while the error itself rides
+    the ordinary managed-collective latch -> vote-discard -> reconfigure
+    machinery (it subclasses RuntimeError like every native failure)."""
+
+
+# The native WireCorruptionError's message prefix — the cross-language
+# contract _check keys the typed re-raise on.
+_WIRE_CORRUPTION_PREFIX = "wire corruption:"
+
+
 def _check(rc: int) -> None:
     if rc == _OK:
         return
     msg = _lib.tft_last_error().decode("utf-8", "replace")
     if rc == _TIMEOUT:
         raise TimeoutError(msg)
+    if msg.startswith(_WIRE_CORRUPTION_PREFIX):
+        raise WireCorruption(msg)
     raise RuntimeError(msg)
 
 
@@ -1156,6 +1194,79 @@ def shm_unlink(name: str) -> None:
 def shm_live_count() -> int:
     """Live ShmSegment handles in this process — the leak oracle."""
     return _lib.tft_shm_live_count()
+
+
+def fault_arm(plan: dict) -> None:
+    """Arms (replaces) the process-global seeded fault plan — see
+    native/src/fault.h for the rule schema and torchft_tpu.chaos for the
+    declarative layer that builds these. Stats persist across re-arms;
+    :func:`fault_disarm` resets everything."""
+    _check(_lib.tft_fault_arm(json.dumps(plan).encode()))
+
+
+def fault_disarm() -> None:
+    """Disarms fault injection and clears the plan + stats. The disarmed
+    state is the production state: every native injection point costs one
+    relaxed atomic load."""
+    _lib.tft_fault_disarm()
+
+
+def fault_armed() -> bool:
+    return bool(_lib.tft_fault_armed())
+
+
+def fault_stats() -> dict:
+    """Cumulative injection counts: ``{"armed", "fired_total",
+    "fired": {"seam:kind": n}}`` — the harness's injected-fault ledger."""
+    out = ctypes.c_void_p()
+    _check(_lib.tft_fault_stats_json(ctypes.byref(out)))
+    return json.loads(_take_string(out))
+
+
+def _crc_arg(
+    data: Union[bytes, bytearray, memoryview]
+) -> "tuple[Any, int]":
+    """One marshalling rule for every CRC entry point: bytes pass
+    through; writable buffers (the heal receiver's shared bytearray)
+    hash zero-copy via a c_char view; readonly non-bytes views pay one
+    copy."""
+    if isinstance(data, bytes):
+        return data, len(data)
+    mv = memoryview(data).cast("B")
+    n = mv.nbytes
+    if n == 0:
+        return b"", 0
+    if mv.readonly:
+        return mv.tobytes(), n
+    return (ctypes.c_char * n).from_buffer(mv), n
+
+
+def crc32c(data: Union[bytes, bytearray, memoryview]) -> int:
+    """CRC32C (Castagnoli) — the exact checksum the native ring frames
+    and the heal stream ranges carry."""
+    buf, n = _crc_arg(data)
+    return int(_lib.tft_crc32c(buf, n))
+
+
+def crc32c_update(
+    state: int, data: Union[bytes, bytearray, memoryview]
+) -> int:
+    """Incremental CRC32C: seed with ``0xFFFFFFFF``, chain updates, and
+    finalize with ``state ^ 0xFFFFFFFF`` — what the heal receiver folds
+    into its readinto loop so the verify costs no extra memory pass."""
+    buf, n = _crc_arg(data)
+    if n == 0:
+        return state
+    return int(_lib.tft_crc32c_update(state, buf, n))
+
+
+def crc32c_combine(parts: List[Union[bytes, bytearray, memoryview]]) -> int:
+    """CRC32C over the logical concatenation of ``parts`` without
+    materializing it (the donor's multi-segment heal ranges)."""
+    state = 0xFFFFFFFF
+    for part in parts:
+        state = crc32c_update(state, part)
+    return state ^ 0xFFFFFFFF
 
 
 def shm_layout(counts: List[int], dtype_codes: List[int], wire: int = 0) -> dict:

@@ -502,6 +502,14 @@ class _NativeLib:
     ) -> int: ...
     def tft_hc_barrier(self, handle: Any, timeout_ms: int) -> int: ...
     def tft_hc_abort(self, handle: Any) -> None: ...
+    def tft_hc_set_wire_crc(self, handle: Any, on: int) -> None: ...
+    def tft_hc_wire_crc(self, handle: Any) -> int: ...
+    def tft_fault_arm(self, plan_json: bytes) -> int: ...
+    def tft_fault_disarm(self) -> None: ...
+    def tft_fault_armed(self) -> int: ...
+    def tft_fault_stats_json(self, out: Any) -> int: ...
+    def tft_crc32c(self, data: bytes, len: int) -> int: ...
+    def tft_crc32c_update(self, state: int, data: bytes, len: int) -> int: ...
     def tft_hc_world_size(self, handle: Any) -> int: ...
     def tft_hc_stripes(self, handle: Any) -> int: ...
     def tft_hc_last_stripe_ns(
@@ -628,3 +636,35 @@ def shm_live_count() -> int: ...
 def shm_layout(
     counts: List[int], dtype_codes: List[int], wire: int = 0
 ) -> dict: ...
+
+
+class WireCorruption(RuntimeError):
+    """A CRC-guarded wire frame (ring payload frame / heal stream range)
+    failed its integrity check; rides the managed latch -> vote-discard
+    machinery like any data-plane error, but typed so detections can be
+    counted."""
+
+
+def fault_arm(plan: dict) -> None: ...
+
+
+def fault_disarm() -> None: ...
+
+
+def fault_armed() -> bool: ...
+
+
+def fault_stats() -> dict: ...
+
+
+def crc32c(data: Union[bytes, bytearray, memoryview]) -> int: ...
+
+
+def crc32c_update(
+    state: int, data: Union[bytes, bytearray, memoryview]
+) -> int: ...
+
+
+def crc32c_combine(
+    parts: List[Union[bytes, bytearray, memoryview]]
+) -> int: ...

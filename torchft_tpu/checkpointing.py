@@ -84,6 +84,13 @@ from typing import Any, Dict, Generic, List, Optional, Tuple, TypeVar
 
 import numpy as np
 
+from ._native import (
+    WireCorruption,
+    crc32c as _crc32c,
+    crc32c_combine as _crc32c_combine,
+    crc32c_update as _crc32c_update,
+)
+
 logger: logging.Logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
@@ -394,6 +401,31 @@ class _StreamStaging:
                 pos = seg_start + hi
             i += 1
 
+    def range_crc32c(self, begin: int, end: int) -> int:
+        """CRC32C over bytes [begin, end) of the packed layout — the
+        integrity header each /stream/ range response carries (the same
+        Castagnoli polynomial the ring frames ride). Walks the exact
+        slices :meth:`write_range` ships (zero-copy, chained through the
+        native incremental update), so header and body can never
+        disagree about what was covered."""
+        import bisect
+
+        if begin >= end:
+            return _crc32c(b"")
+        i = bisect.bisect_right(self._starts, begin) - 1
+        pos = begin
+        parts: List[memoryview] = []
+        while pos < end and i < len(self._segments):
+            seg = self._segments[i]
+            seg_start = self._starts[i]
+            lo = pos - seg_start
+            hi = min(len(seg), end - seg_start)
+            if lo < hi:
+                parts.append(seg[lo:hi])
+                pos = seg_start + hi
+            i += 1
+        return _crc32c_combine(parts)
+
 
 def _is_jax_leaf(leaf: Any) -> bool:
     import sys
@@ -669,6 +701,14 @@ class CheckpointServer(CheckpointTransport[T]):
                         "Content-Type", "application/octet-stream"
                     )
                     self.send_header("Content-Length", str(end - begin))
+                    # Per-range CRC32C (same polynomial as the ring
+                    # frames): the receiver verifies before trusting the
+                    # bytes — a flipped bit on a heal range otherwise
+                    # installs corrupted weights with no vote to catch it.
+                    self.send_header(
+                        "X-TFT-Crc32c",
+                        f"{staging.range_crc32c(begin, end):08x}",
+                    )
                     self.end_headers()
                     staging.write_range(self.wfile, begin, end)
                 finally:
@@ -769,6 +809,16 @@ class CheckpointServer(CheckpointTransport[T]):
             # wedged donor, stretching a 30 s heal budget to ~90 s of
             # no-redundancy window the quorum never agreed to).
             raise
+        except WireCorruption as e:
+            # DETECTED corruption on a stream range: never install the
+            # bytes. The pickled fallback re-reads everything from
+            # scratch (a transient flip heals itself; a persistently
+            # corrupting path will fail there too and surface as a
+            # failed heal, not silent weight rot).
+            logger.error(
+                f"heal stream failed integrity check ({e}); refetching "
+                "via the pickled fallback"
+            )
         except OSError as e:
             if isinstance(
                 getattr(e, "reason", None), TimeoutError
@@ -874,7 +924,12 @@ class CheckpointServer(CheckpointTransport[T]):
                     f"{address}/stream/{i}/{streams}/{wire_tok}/{seq}",
                     timeout=timeout.total_seconds(),
                 ) as resp:
+                    want_crc = resp.headers.get("X-TFT-Crc32c")
                     pos = begin
+                    # Incremental CRC folded into the readinto loop: the
+                    # verify never costs a second memory pass on the
+                    # heal critical path.
+                    crc_state = 0xFFFFFFFF
                     while pos < end and not cancel.is_set():
                         n = resp.readinto(
                             view[pos:min(pos + _STREAM_CHUNK, end)]
@@ -884,7 +939,24 @@ class CheckpointServer(CheckpointTransport[T]):
                                 f"heal stream {i} ended early at "
                                 f"{pos}/{end}"
                             )
+                        if want_crc is not None:
+                            crc_state = _crc32c_update(
+                                crc_state, view[pos:pos + n]
+                            )
                         pos += n
+                        if pos >= end and want_crc is not None:
+                            # Verify BEFORE publishing the final
+                            # progress: the walker only ever consumes
+                            # integrity-checked ranges (a pre-CRC donor
+                            # sends no header and is trusted as before).
+                            got_crc = crc_state ^ 0xFFFFFFFF
+                            if got_crc != int(want_crc, 16):
+                                raise WireCorruption(
+                                    "wire corruption: heal stream range "
+                                    f"{i} CRC32C mismatch (got "
+                                    f"{got_crc:08x}, donor sent "
+                                    f"{want_crc}, bytes [{begin}, {end}))"
+                                )
                         with cond:
                             progress[i] = pos
                             cond.notify_all()

@@ -783,6 +783,7 @@ class HostCollectives(OpStatsMixin, Collectives):
         pipeline_min_bytes: int = 4 << 20,
         stripes: Optional[int] = None,
         stripes_inter: Optional[int] = None,
+        wire_crc: Optional[bool] = None,
     ) -> None:
         """``pipeline_chunks`` > 1 splits large device-packed buffers so
         device->host DMA, the TCP ring, and host->device upload overlap
@@ -813,7 +814,18 @@ class HostCollectives(OpStatsMixin, Collectives):
         connection count under a two-tier configure — the slow wide-area
         hop is exactly where striping pays, so it gets its own knob.
         Default: env ``TORCHFT_HC_STRIPES_INTER`` (else ``stripes``).
-        Store-negotiated like the rest of the schedule knobs."""
+        Store-negotiated like the rest of the schedule knobs.
+
+        ``wire_crc`` (default: env ``TORCHFT_WIRE_CRC``, off) puts a
+        CRC32C trailer on every ring/stripe payload frame; a mismatch
+        raises the typed :class:`~torchft_tpu._native.WireCorruption`
+        (latched by the Manager, step discarded by the vote) instead of
+        committing poisoned bytes — the one failure mode the vote alone
+        cannot catch. All members must agree: the knob rides the same
+        store-negotiated fingerprint as the stripes, and the ring hello
+        carries the frame format so a drifted member fails at connect.
+        Off, the wire format is byte-identical to the pre-CRC protocol
+        (un-upgraded peers interop) and the hot path pays one branch."""
         self._handle = _lib.tft_hc_create()
         self._timeout = timeout
         self._connect_timeout = connect_timeout
@@ -833,6 +845,11 @@ class HostCollectives(OpStatsMixin, Collectives):
         # <= 0: follow the main stripe knob (resolved at configure, so
         # the negotiated string stays honest about the effective value).
         self._stripes_inter = min(int(stripes_inter), _MAX_STRIPES)
+        if wire_crc is None:
+            wire_crc = os.environ.get("TORCHFT_WIRE_CRC", "").lower() in (
+                "1", "on", "true",
+            )
+        self._wire_crc = bool(wire_crc)
         self._world_size = 0
         self._rank = -1
         # One thread: collectives must issue in submission order.
@@ -916,10 +933,16 @@ class HostCollectives(OpStatsMixin, Collectives):
                 store = _native.StoreClient(
                     hostport, connect_timeout=self._connect_timeout
                 )
+                # The CRC token is appended ONLY when on: a CRC-off fleet
+                # keeps the exact pre-CRC fingerprint, so un-upgraded
+                # peers interop; a mixed on/off pair mismatches here with
+                # a descriptive error (and would fail at the hello
+                # anyway — this is the friendlier first line of defense).
                 mine = (
                     f"{self._pipeline_chunks}:{self._pipeline_min_bytes}"
                     f":{self._stripes}:{stripes_inter}"
                     f":{','.join(region_list)}"
+                    + (":crc1" if self._wire_crc else "")
                 )
                 key = f"{prefix}/pipecfg" if prefix else "pipecfg"
                 if rank == 0:
@@ -936,6 +959,7 @@ class HostCollectives(OpStatsMixin, Collectives):
                             "pipeline_chunks / pipeline_min_bytes / stripes "
                             "/ stripes_inter and see the same region map"
                         )
+            _lib.tft_hc_set_wire_crc(self._handle, 1 if self._wire_crc else 0)
             _check(
                 _lib.tft_hc_configure_hier(
                     self._handle,

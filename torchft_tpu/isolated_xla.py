@@ -103,10 +103,47 @@ def _zygote_enabled() -> bool:
     return os.environ.get("TORCHFT_ISO_ZYGOTE", "1") != "0"
 
 
+def _stall_grace_s() -> float:
+    """How long the monitored channel lets the child sit in the STOPPED
+    process state before issuing a stall verdict (``TORCHFT_ISO_STALL_MS``,
+    default 1500). Always additionally bounded by the op deadline, so the
+    verdict can never outwait the op it is protecting."""
+    try:
+        return max(int(os.environ.get("TORCHFT_ISO_STALL_MS", "1500")), 50) / 1e3
+    except ValueError:
+        return 1.5
+
+
+def _proc_state(pid: int) -> Optional[str]:
+    """One-letter process state from /proc/<pid>/stat ("R", "S", "T",
+    ...), None when unreadable (dead, or a non-procfs platform — the
+    stall verdict then simply never fires and the op deadline rules)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # field 3 follows the parenthesized comm, which may itself
+        # contain parens — split at the LAST ')'.
+        return data[data.rindex(b")") + 2 : data.rindex(b")") + 3].decode()
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 class ChildDiedError(RuntimeError):
     """The isolated child exited (or was killed) while the parent was
     talking to it. Latches through the managed discipline like any other
     data-plane error; the next quorum's configure() respawns."""
+
+
+class ChildStalledError(ChildDiedError):
+    """The isolated child is alive but STOPPED (SIGSTOP / 'T' state) —
+    stalled, not dead, which a pid liveness poll cannot distinguish from
+    slow. The monitored channel issues this STALL VERDICT once the child
+    has sat in the stopped state for the stall grace (bounded by the op
+    deadline), so a wedged child surfaces within ONE op deadline — never
+    the runtime heartbeat's minutes. Subclassing :class:`ChildDiedError`
+    makes recovery identical to the SIGKILL path: the error latches, the
+    vote discards, and the forced reconfigure SIGKILLs (which stopped
+    processes cannot block) + respawns."""
 
 
 def _child_env() -> Dict[str, str]:
@@ -146,9 +183,19 @@ class _MonitoredChannel:
     instead of the full op timeout; child-reported exceptions re-raise in
     the parent with the child traceback attached."""
 
-    def __init__(self, sock: socket.socket, alive: Callable[[], Optional[int]]) -> None:
+    def __init__(
+        self,
+        sock: socket.socket,
+        alive: Callable[[], Optional[int]],
+        pid: Optional[int] = None,
+    ) -> None:
         self._sock = sock
         self._alive = alive  # returns exit code once dead, None while alive
+        # pid enables the STALL VERDICT: /proc state is polled alongside
+        # liveness, so a SIGSTOPped child surfaces as ChildStalledError
+        # within min(stall grace, op deadline) instead of masquerading as
+        # slow until the deadline (and never until the runtime heartbeat).
+        self._pid = pid
         self._buf = b""
 
     def send(self, msg: dict) -> None:
@@ -162,14 +209,39 @@ class _MonitoredChannel:
     def recv(self, timeout_s: float) -> dict:
         deadline = time.perf_counter() + timeout_s
         tick = _liveness_interval_s()
+        # Stall verdict bookkeeping: grace bounded by the op deadline so
+        # the verdict always lands within one deadline.
+        stall_grace = min(_stall_grace_s(), timeout_s)
+        stopped_since: Optional[float] = None
         while b"\n" not in self._buf:
             rc = self._alive()
             if rc is not None:
                 raise ChildDiedError(
                     f"isolated xla child died (rc={rc}) mid-op"
                 )
+            if self._pid is not None:
+                state = _proc_state(self._pid)
+                now = time.perf_counter()
+                if state in ("T", "t"):
+                    if stopped_since is None:
+                        stopped_since = now
+                    elif now - stopped_since >= stall_grace:
+                        raise ChildStalledError(
+                            "isolated xla child STALLED (stopped/'T' "
+                            f"state for {now - stopped_since:.2f}s, pid "
+                            f"{self._pid}): alive to the liveness poll "
+                            "but not running — stall verdict"
+                        )
+                else:
+                    stopped_since = None
             remain = deadline - time.perf_counter()
             if remain <= 0:
+                if self._pid is not None and _proc_state(self._pid) in ("T", "t"):
+                    raise ChildStalledError(
+                        "isolated xla child STALLED (stopped/'T' state "
+                        f"at the {timeout_s:.1f}s op deadline, pid "
+                        f"{self._pid}) — stall verdict"
+                    )
                 raise TimeoutError(
                     f"isolated xla child reply timed out after {timeout_s:.1f}s"
                 )
@@ -620,7 +692,7 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
         finally:
             listener.close()
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        channel = _MonitoredChannel(sock, child.poll)
+        channel = _MonitoredChannel(sock, child.poll, pid=child.pid)
         hello = channel.recv(self._connect_timeout.total_seconds())
         assert "hello" in hello, hello
         return child, channel
