@@ -207,13 +207,13 @@ void* tft_manager_create(const char* replica_id, const char* lighthouse_addr,
                          const char* store_addr, uint64_t world_size,
                          int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
                          const char* root_addr, int64_t lease_ttl_ms,
-                         const char* region) {
+                         const char* region, const char* host) {
   ManagerServer* m = nullptr;
   int rc = guarded([&] {
     m = new ManagerServer(replica_id, lighthouse_addr, hostname, bind, store_addr,
                           world_size, heartbeat_interval_ms, connect_timeout_ms,
                           root_addr ? root_addr : "", lease_ttl_ms,
-                          region ? region : "");
+                          region ? region : "", host ? host : "");
   });
   return rc == kOk ? m : nullptr;
 }
@@ -360,34 +360,54 @@ int tft_hc_configure(void* handle, const char* store_addr, int64_t rank,
   });
 }
 
-// Configure with a REGION MAP: regions_json is a JSON array of one label
-// per rank ("" = unlabeled; null/empty string = no map -> flat only).
-// With >= 2 distinct labels the two-tier topology is built alongside the
-// flat ring; stripes_inter (<= 0: = stripes) is the inter (leader) ring's
-// connection count.
+// Configure with a REGION and/or HOST MAP: each *_json is a JSON array
+// of one label per rank ("" = unlabeled; null/empty string = no map).
+// With >= 2 distinct region labels the intra/inter tiers are built
+// alongside the flat ring; with a host map grouping >= 2 co-hosted
+// ranks the shared-memory HOST tier is built below them
+// (TORCHFT_HC_SHM=0 falls it back to loopback TCP). stripes_inter
+// (<= 0: = stripes) is the inter (leader) ring's connection count.
 int tft_hc_configure_hier(void* handle, const char* store_addr, int64_t rank,
                           int64_t world_size, int64_t timeout_ms,
                           int64_t stripes, int64_t stripes_inter,
-                          const char* regions_json) {
+                          const char* regions_json, const char* hosts_json) {
   return guarded([&] {
-    std::vector<std::string> regions;
-    if (regions_json != nullptr && regions_json[0] != '\0') {
-      // Bound to a local: `Json::parse(...).as_array()` in the range-for
-      // would destroy the temporary before the loop body runs (the
-      // classic pre-C++23 range-for dangling reference).
-      Json parsed = Json::parse(regions_json);
-      for (const auto& r : parsed.as_array())
-        regions.push_back(r.as_string());
-    }
+    auto parse_labels = [](const char* js) {
+      std::vector<std::string> out;
+      if (js != nullptr && js[0] != '\0') {
+        // Bound to a local: `Json::parse(...).as_array()` in the
+        // range-for would destroy the temporary before the loop body
+        // runs (the classic pre-C++23 range-for dangling reference).
+        Json parsed = Json::parse(js);
+        for (const auto& r : parsed.as_array()) out.push_back(r.as_string());
+      }
+      return out;
+    };
     static_cast<HostCollectives*>(handle)->configure(
-        store_addr, rank, world_size, timeout_ms, stripes, regions,
-        stripes_inter);
+        store_addr, rank, world_size, timeout_ms, stripes,
+        parse_labels(regions_json), stripes_inter, parse_labels(hosts_json));
   });
 }
 
-// Whether the last configure built the two-tier topology.
+// Whether the last configure built a hierarchical topology (region
+// and/or host tiers).
 int64_t tft_hc_hier_capable(void* handle) {
   return static_cast<HostCollectives*>(handle)->hier_capable() ? 1 : 0;
+}
+
+// Host-tier transport of the last configure: 0 = no host tier, 1 =
+// loopback TCP (TORCHFT_HC_SHM=0), 2 = shared-memory rings.
+int64_t tft_hc_host_tier_transport(void* handle) {
+  return static_cast<HostCollectives*>(handle)->host_tier_transport();
+}
+
+// abort() + deterministic release of every ring resource (sockets,
+// listener, shm segments) without destroying the handle; a later
+// configure rebuilds. The Python shutdown() path — segment lifetime must
+// not ride garbage-collection timing.
+int tft_hc_release(void* handle) {
+  return guarded(
+      [&] { static_cast<HostCollectives*>(handle)->release_rings(); });
 }
 
 // In-place two-tier allreduce (see HostCollectives::allreduce_hier).

@@ -1,9 +1,14 @@
 #include "collectives.h"
 
+#include <linux/futex.h>
 #include <netdb.h>
 #include <poll.h>
+#include <signal.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstdlib>
@@ -18,6 +23,7 @@
 #include "fault.h"
 #include "json.h"
 #include "log.h"
+#include "shm.h"
 #include "store.h"
 
 namespace tft {
@@ -69,6 +75,141 @@ constexpr uint32_t kOpMagic = 0x74667470;
 constexpr uint32_t kTierFlat = 0;
 constexpr uint32_t kTierIntra = 1;
 constexpr uint32_t kTierInter = 2;
+// Host (intra-host) tier: shared-memory rings by default, so the hello
+// tier word only appears on the wire under the TORCHFT_HC_SHM=0
+// loopback-TCP fallback.
+constexpr uint32_t kTierHost = 3;
+
+// ---- shared-memory ring buffers (the host tier's transport) ----
+//
+// One SPSC byte ring per directed edge per stripe, living in a POSIX shm
+// segment (ShmSegment, creator = the producing member). Layout: a
+// 64-byte header, then `capacity` data bytes. head/tail are free-running
+// byte counters (the ring is full when head - tail == capacity); db_w /
+// db_r are futex doorbells bumped after every publish/consume. SHARED
+// futexes (no PRIVATE flag): producer and consumer are different
+// processes mapping the same page. The magic doubles as the liveness
+// word — abort/teardown/torn-segment faults poison it, and both sides
+// treat a poisoned ring exactly like a socket FIN.
+
+struct ShmRingHdr {
+  std::atomic<uint32_t> magic;
+  uint32_t capacity;
+  std::atomic<uint64_t> head;   // bytes produced (free-running)
+  std::atomic<uint64_t> tail;   // bytes consumed
+  std::atomic<uint32_t> db_w;   // producer doorbell
+  std::atomic<uint32_t> db_r;   // consumer doorbell
+  // Liveness: the producer (creator) and consumer (attacher) publish
+  // their pids. A SIGKILLed co-hosted process closes no socket and
+  // poisons no magic — the kernel tells us nothing — so a blocked
+  // waiter probes the counterpart's pid (kill(pid, 0), ESRCH = gone)
+  // once per futex slice and surfaces the death in ~100 ms instead of
+  // waiting out the whole op deadline.
+  std::atomic<uint32_t> owner_pid;  // producer, set at create
+  std::atomic<uint32_t> peer_pid;   // consumer, set at attach
+};
+static_assert(sizeof(ShmRingHdr) <= 64, "shm ring header outgrew its slot");
+static_assert(std::atomic<uint64_t>::is_always_lock_free,
+              "shm ring counters must be lock-free (they cross processes)");
+
+constexpr uint32_t kShmRingMagic = 0x74667368;   // "tfsh"
+constexpr uint32_t kShmRingPoison = 0xDEADD00Du;
+constexpr size_t kShmHdrBytes = 64;
+
+// Every shm_duplex call moves exactly one frame per direction: a 16-byte
+// in-stream header (monotonic per-edge sequence + payload length), then
+// the payload. The sequence is the stale-payload oracle (a replayed
+// frame mismatches), the length the desync oracle (a mismatched op would
+// otherwise reduce the wrong bytes).
+struct ShmFrame {
+  uint64_t fseq;
+  uint32_t len;
+  uint32_t pad;
+};
+static_assert(sizeof(ShmFrame) == 16, "shm frame header must be 16 bytes");
+
+inline ShmRingHdr* shm_ring_hdr(void* seg) {
+  return static_cast<ShmRingHdr*>(seg);
+}
+inline char* shm_ring_data(void* seg) {
+  return static_cast<char*>(seg) + kShmHdrBytes;
+}
+
+// True when `pid` names a process that can never feed its ring again:
+// gone entirely (ESRCH), or a ZOMBIE — a SIGKILLed bench/training child
+// whose parent has not reaped it yet still *exists* for kill(pid, 0),
+// but will never produce another byte (the /proc state disambiguates,
+// exactly like the isolated plane's stall monitor). pid 0 = not yet
+// published — indeterminate, not dead. Co-hosted by construction, so
+// the pid is always probeable.
+bool shm_pid_gone(uint32_t pid) {
+  if (pid == 0) return false;
+  if (kill(static_cast<pid_t>(pid), 0) != 0) return errno == ESRCH;
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/%u/stat", pid);
+  FILE* f = fopen(path, "r");
+  if (f == nullptr) return false;  // no /proc: fall back to the deadline
+  char buf[256];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = '\0';
+  // State is the field after the parenthesized comm (which may itself
+  // contain spaces and parens — scan from the LAST ')').
+  const char* rp = strrchr(buf, ')');
+  if (rp == nullptr) return false;
+  for (rp++; *rp == ' '; rp++) {
+  }
+  return *rp == 'Z' || *rp == 'X';
+}
+
+// Deadline-sliced futex wait on a doorbell: `expect` must be the value
+// read BEFORE the caller re-checked its condition (the lost-wakeup
+// protocol); the slice cap bounds the worst case even if a wake is
+// missed entirely.
+void shm_futex_wait(std::atomic<uint32_t>* addr, uint32_t expect,
+                    int64_t max_ms) {
+  if (max_ms <= 0) return;
+  if (max_ms > 100) max_ms = 100;
+  struct timespec ts;
+  ts.tv_sec = max_ms / 1000;
+  ts.tv_nsec = (max_ms % 1000) * 1000000;
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAIT, expect,
+          &ts, nullptr, 0);
+}
+
+void shm_futex_wake(std::atomic<uint32_t>* addr) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAKE,
+          std::numeric_limits<int>::max(), nullptr, nullptr, 0);
+}
+
+// Wrap-aware copy of `n` bytes into/out of a ring at free-running
+// position `pos`.
+void shm_ring_write(char* data, uint32_t cap, uint64_t pos, const char* src,
+                    size_t n) {
+  size_t off = static_cast<size_t>(pos % cap);
+  size_t first = std::min<size_t>(n, cap - off);
+  memcpy(data + off, src, first);
+  if (n > first) memcpy(data, src + first, n - first);
+}
+
+void shm_ring_read(const char* data, uint32_t cap, uint64_t pos, char* dst,
+                   size_t n) {
+  size_t off = static_cast<size_t>(pos % cap);
+  size_t first = std::min<size_t>(n, cap - off);
+  memcpy(dst, data + off, first);
+  if (n > first) memcpy(dst + first, data, n - first);
+}
+
+// FNV-1a over a string — the shm segment namespace and the topology-map
+// hash mixed into hier plan signatures.
+uint64_t fnv64(const std::string& s) {
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
 
 // Floor on bytes a stripe must carry before an extra connection/thread is
 // worth waking: below this, per-op thread dispatch costs more than the
@@ -234,9 +375,30 @@ void HostCollectives::abort() {
 }
 
 void HostCollectives::shutdown_sockets_locked() {
-  for (RingTier* T : {&flat_, &intra_, &inter_}) {
+  for (RingTier* T : {&flat_, &intra_, &inter_, &host_}) {
     for (auto& s : T->next) s.shutdown_rdwr();
     for (auto& s : T->prev) s.shutdown_rdwr();
+  }
+  shm_poison_wake_locked();
+}
+
+void HostCollectives::shm_poison_wake_locked() {
+  // The shm analog of the socket FIN sweep: poison every ring magic this
+  // member produces into (its TX rings) so the consumer errors instead
+  // of waiting out its deadline, and wake every doorbell — local waiters
+  // re-check aborted_/magic, the peer's waiter sees the poison.
+  for (auto& e : host_.shm) {
+    if (e.tx) {
+      ShmRingHdr* h = shm_ring_hdr(e.tx->data());
+      h->magic.store(kShmRingPoison, std::memory_order_release);
+      shm_futex_wake(&h->db_w);
+      shm_futex_wake(&h->db_r);
+    }
+    if (e.rx) {
+      ShmRingHdr* h = shm_ring_hdr(e.rx->data());
+      shm_futex_wake(&h->db_w);
+      shm_futex_wake(&h->db_r);
+    }
   }
 }
 
@@ -245,14 +407,34 @@ void HostCollectives::shutdown_sockets() {
   shutdown_sockets_locked();
 }
 
+void HostCollectives::release_rings() {
+  abort();                    // poison + wake every waiter
+  MutexLock op_lock(op_mu_);  // wait for in-flight ops to drain
+  MutexLock lock(cfg_mu_);
+  flat_.clear();
+  intra_.clear();
+  inter_.clear();
+  host_.clear();  // unlinks this member's shm segments (creator-owned)
+  listener_.reset();
+}
+
 int64_t HostCollectives::tier_tx(const RingTier& T) {
   int64_t t = 0;
   for (const auto& sc : T.scratch) t += sc.tx_bytes;
   return t;
 }
 
+int64_t HostCollectives::tier_shm(const RingTier& T) {
+  int64_t t = 0;
+  for (const auto& sc : T.scratch) t += sc.shm_bytes;
+  return t;
+}
+
 void HostCollectives::reset_tier_tx(RingTier& T) {
-  for (auto& sc : T.scratch) sc.tx_bytes = 0;
+  for (auto& sc : T.scratch) {
+    sc.tx_bytes = 0;
+    sc.shm_bytes = 0;
+  }
 }
 
 namespace {
@@ -268,11 +450,40 @@ int64_t remain_or_throw(int64_t deadline) {
 
 } // namespace
 
+namespace {
+
+// TORCHFT_HC_SHM: the host tier's transport. Default on — the whole
+// point of the tier is replacing loopback TCP; 0/off/false falls back to
+// a TCP host ring with identical geometry (the bench's honest control).
+bool env_shm_on() {
+  const char* e = std::getenv("TORCHFT_HC_SHM");
+  if (e == nullptr) return true;
+  std::string v(e);
+  // Case-insensitive, matching the Python layer's parse exactly: the
+  // negotiated fingerprint is computed from Python's reading, so any
+  // divergence here would pass the mismatch guard and then wedge
+  // configure (one member wiring shm, the other TCP).
+  for (auto& c : v) c = static_cast<char>(tolower(c));
+  return !(v == "0" || v == "off" || v == "false");
+}
+
+size_t env_shm_ring_bytes() {
+  const char* e = std::getenv("TORCHFT_HC_SHM_RING_BYTES");
+  size_t v = e ? static_cast<size_t>(std::atoll(e)) : (1u << 20);
+  // Floor keeps the frame pump making progress at sane chunk sizes; the
+  // ring handles frames larger than itself, but a degenerate capacity
+  // would turn every hop into a futex ping-pong.
+  return std::max<size_t>(v, 4096);
+}
+
+}  // namespace
+
 void HostCollectives::configure(const std::string& store_addr, int64_t rank,
                                 int64_t world_size, int64_t timeout_ms,
                                 int64_t stripes,
                                 const std::vector<std::string>& regions,
-                                int64_t stripes_inter) {
+                                int64_t stripes_inter,
+                                const std::vector<std::string>& hosts) {
   if (rank < 0 || world_size <= 0 || rank >= world_size)
     throw SocketError("bad rank/world_size");
   if (stripes < 1 || stripes > kMaxStripes)
@@ -285,6 +496,8 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   if (!regions.empty() &&
       static_cast<int64_t>(regions.size()) != world_size)
     throw SocketError("region map must carry one label per rank");
+  if (!hosts.empty() && static_cast<int64_t>(hosts.size()) != world_size)
+    throw SocketError("host map must carry one label per rank");
   abort(); // unblock any op stuck on the old ring
   MutexLock op_lock(op_mu_); // wait for it to drain
 
@@ -298,42 +511,106 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     plans_.clear();
   }
 
-  // Two-tier topology from the region map: pure arithmetic on
-  // (regions, rank order), identical on every member. The region LEADER
-  // is the lowest rank of the region (ranks sort by replica-id, so this
-  // is the lowest replica-id); the inter ring orders regions by their
-  // leader's rank.
-  bool hier = false;
-  std::vector<int64_t> intra_members;
-  int64_t intra_rank = -1;
-  std::vector<int64_t> leaders;
-  int64_t inter_rank = -1;
-  if (!regions.empty() && world_size > 1) {
-    std::set<std::string> distinct(regions.begin(), regions.end());
-    bool labeled = true;
+  // Hierarchical topology from the (region, host) maps: pure arithmetic
+  // on (labels, rank order), identical on every member. The region
+  // LEADER is the lowest rank of the region (ranks sort by replica-id,
+  // so this is the lowest replica-id); the inter ring orders regions by
+  // their leader's rank. HOST groups are keyed by the (region, host)
+  // PAIR — a host label that leaks across region boundaries can never
+  // stitch two regions together — and the host leader is the lowest
+  // rank of the group, so the region leader is always a host leader.
+  // The intra ring spans the HOST LEADERS of a region (with no host
+  // grouping every member is its own host leader, which is exactly the
+  // two-tier topology).
+  const bool regions_labeled = [&] {
+    if (regions.empty() || world_size <= 1) return false;
     for (const auto& r : regions)
-      if (r.empty()) labeled = false;
-    hier = labeled && distinct.size() >= 2;
-    if (hier) {
+      if (r.empty()) return false;
+    return true;
+  }();
+  const bool hosts_labeled = [&] {
+    if (hosts.empty() || world_size <= 1) return false;
+    for (const auto& h : hosts)
+      if (h.empty()) return false;
+    return true;
+  }();
+  auto region_of = [&](int64_t r) {
+    return regions_labeled ? regions[r] : std::string();
+  };
+  auto hkey = [&](int64_t r) {
+    return region_of(r) + '\x1f' + hosts[r];
+  };
+
+  bool multi_region = false;
+  if (regions_labeled) {
+    std::set<std::string> distinct(regions.begin(), regions.end());
+    multi_region = distinct.size() >= 2;
+  }
+  bool host_grouped = false;
+  if (hosts_labeled) {
+    std::map<std::string, int64_t> sizes;
+    for (int64_t r = 0; r < world_size; r++)
+      if (++sizes[hkey(r)] >= 2) host_grouped = true;
+  }
+  const bool hier = multi_region || host_grouped;
+
+  std::vector<int64_t> host_members;   // my (region, host) group
+  int64_t host_rank = -1;
+  std::vector<int64_t> intra_members;  // host leaders of my region
+  int64_t intra_rank = -1;
+  std::vector<int64_t> leaders;        // region leaders
+  int64_t inter_rank = -1;
+  bool is_host_leader = true;
+  if (hier) {
+    if (hosts_labeled) {
       for (int64_t r = 0; r < world_size; r++) {
-        if (regions[r] == regions[rank]) {
+        if (hkey(r) == hkey(rank)) {
           if (r == rank)
-            intra_rank = static_cast<int64_t>(intra_members.size());
-          intra_members.push_back(r);
+            host_rank = static_cast<int64_t>(host_members.size());
+          host_members.push_back(r);
         }
       }
-      std::map<std::string, int64_t> leader_of;
-      for (int64_t r = 0; r < world_size; r++)
-        if (!leader_of.count(regions[r])) leader_of[regions[r]] = r;
-      for (const auto& [_, l] : leader_of) leaders.push_back(l);
-      std::sort(leaders.begin(), leaders.end());
-      for (size_t i = 0; i < leaders.size(); i++)
-        if (leaders[i] == rank) inter_rank = static_cast<int64_t>(i);
+    } else {
+      host_members = {rank};
+      host_rank = 0;
     }
+    is_host_leader = host_members[0] == rank;
+    // Host leaders of my region, rank order — the intra tier's members.
+    std::set<std::string> seen_hosts;
+    for (int64_t r = 0; r < world_size; r++) {
+      if (region_of(r) != region_of(rank)) continue;
+      std::string k = hosts_labeled ? hkey(r) : std::to_string(r);
+      if (!seen_hosts.insert(k).second) continue;  // not the host leader
+      if (r == rank) intra_rank = static_cast<int64_t>(intra_members.size());
+      intra_members.push_back(r);
+    }
+    std::map<std::string, int64_t> leader_of;
+    for (int64_t r = 0; r < world_size; r++)
+      if (!leader_of.count(region_of(r))) leader_of[region_of(r)] = r;
+    for (const auto& [_, l] : leader_of) leaders.push_back(l);
+    std::sort(leaders.begin(), leaders.end());
+    for (size_t i = 0; i < leaders.size(); i++)
+      if (leaders[i] == rank) inter_rank = static_cast<int64_t>(i);
   }
+  const int64_t host_world =
+      hier ? static_cast<int64_t>(host_members.size()) : 0;
   const int64_t intra_world = hier ? static_cast<int64_t>(intra_members.size()) : 0;
   const int64_t inter_world = hier ? static_cast<int64_t>(leaders.size()) : 0;
   const bool is_leader = hier && inter_rank >= 0;
+  const bool shm_on = env_shm_on();
+  // Canonical topology hash (mixed into hier plan signatures): identical
+  // maps hash identically on every member.
+  uint64_t topo = 1469598103934665603ull;
+  {
+    std::string all;
+    for (int64_t r = 0; r < world_size; r++) {
+      all += region_of(r);
+      all += '\x1f';
+      all += hosts_labeled ? hosts[r] : std::string();
+      all += '\x1e';
+    }
+    topo = fnv64(all);
+  }
 
   // Phase 1 (under cfg_mu_, non-blocking): retire the old ring, stand up the
   // new listener so a concurrent abort() can close it and wake phase 2.
@@ -343,12 +620,17 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     flat_.clear();
     intra_.clear();
     inter_.clear();
+    // Dropping the host tier's edges unlinks every segment this member
+    // created — shm segments are owned by the configure generation.
+    host_.clear();
     listener_.reset();
     rank_ = rank;
     world_size_ = world_size;
     stripes_ = stripes;
     stripes_inter_ = stripes_inter;
     hier_ = hier;
+    topo_hash_ = topo;
+    shm_ring_bytes_ = env_shm_ring_bytes();
     // Per-connection send caps, per tier: the main knob paces the
     // slow/wide-area links (the flat ring's edges, the inter hop), the
     // intra knob optionally paces the fast in-region links (0 = unpaced
@@ -373,11 +655,16 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     };
     init_tier(flat_, "flat", rank, world_size, stripes, cap_main);
     if (hier) {
-      init_tier(intra_, "intra", intra_rank, intra_world, stripes, cap_intra);
-      // Non-leaders never touch the inter ring; world stays 0 there so
-      // op bodies can branch on it uniformly.
+      // Only HOST LEADERS participate in the intra (and inter) rings;
+      // world stays 0 for everyone else so op bodies branch uniformly.
+      init_tier(intra_, "intra", intra_rank,
+                is_host_leader ? intra_world : 0, stripes, cap_intra);
       init_tier(inter_, "inter", inter_rank, is_leader ? inter_world : 0,
                 stripes_inter, cap_main);
+      // The host ring is intra-host by construction: never paced (there
+      // is no NIC to protect), shm-backed unless TORCHFT_HC_SHM=0.
+      init_tier(host_, "host", host_rank, host_world > 1 ? host_world : 0,
+                stripes, /*cap=*/0);
     }
     // The frame format is fixed for the life of the ring: snapshot the
     // CRC request here, under the same publication protocol as the
@@ -420,7 +707,7 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
   tiers.push_back({kTierFlat, (rank + 1) % world_size,
                    (rank - 1 + world_size) % world_size, stripes, {}, {},
                    {}, {}});
-  if (hier && intra_world > 1) {
+  if (hier && is_host_leader && intra_world > 1) {
     tiers.push_back(
         {kTierIntra, intra_members[(intra_rank + 1) % intra_world],
          intra_members[(intra_rank - 1 + intra_world) % intra_world],
@@ -430,6 +717,18 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     tiers.push_back({kTierInter, leaders[(inter_rank + 1) % inter_world],
                      leaders[(inter_rank - 1 + inter_world) % inter_world],
                      stripes_inter, {}, {}, {}, {}});
+  }
+  const int64_t host_next =
+      host_world > 1 ? host_members[(host_rank + 1) % host_world] : -1;
+  const int64_t host_prev =
+      host_world > 1 ? host_members[(host_rank - 1 + host_world) % host_world]
+                     : -1;
+  if (host_world > 1 && !shm_on) {
+    // TORCHFT_HC_SHM=0: the host ring rides loopback TCP with identical
+    // geometry — the honest control the shm bench row is measured
+    // against, and the fallback where /dev/shm is unavailable.
+    tiers.push_back({kTierHost, host_next, host_prev, stripes, {}, {}, {},
+                     {}});
   }
 
   // Dial every tier's next member once per stripe; the hello names the
@@ -496,13 +795,30 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
     tp->prev[slot] = std::move(sock);
   }
 
+  // Shared-memory host edges: created/attached AFTER the TCP rendezvous
+  // (the store round already ordered everyone into this generation), one
+  // edge pair per stripe. Deadline-bounded like every phase-2 step.
+  std::vector<ShmEdge> shm_edges;
+  if (host_world > 1 && shm_on) {
+    // Segment namespace: the store prefix is unique per quorum, so its
+    // hash scopes the names to this generation; ranks scope the edge.
+    std::string base = "tft_hc_" + [&] {
+      char buf[20];
+      snprintf(buf, sizeof(buf), "%016llx",
+               static_cast<unsigned long long>(fnv64(store_addr)));
+      return std::string(buf);
+    }();
+    wire_shm_edges(shm_edges, stripes, base, host_next, host_prev, deadline);
+  }
+
   // Phase 3: publish the new rings unless an abort raced in.
   MutexLock lock(cfg_mu_);
   if (abort_epoch_ != epoch) throw SocketError("aborted during configure");
   for (auto& tp : tiers) {
     RingTier& T = tp.tier == kTierFlat ? flat_
                   : tp.tier == kTierIntra ? intra_
-                                          : inter_;
+                  : tp.tier == kTierInter ? inter_
+                                          : host_;
     T.next = std::move(tp.next);
     T.prev = std::move(tp.prev);
     T.peer_next_addr = tp.next_addr;
@@ -511,7 +827,70 @@ void HostCollectives::configure(const std::string& store_addr, int64_t rank,
       T.scratch[s].tag = "tier=" + T.name + " stripe=" + std::to_string(s) +
                          " prev_peer=" + T.peer_prev_addr;
   }
+  if (!shm_edges.empty()) {
+    host_.use_shm = true;
+    host_.shm = std::move(shm_edges);
+    host_.peer_next_addr = "shm:rank" + std::to_string(host_next);
+    host_.peer_prev_addr = "shm:rank" + std::to_string(host_prev);
+    for (size_t s = 0; s < host_.scratch.size(); s++)
+      host_.scratch[s].tag = "tier=host stripe=" + std::to_string(s) +
+                             " prev_peer=" + host_.peer_prev_addr;
+  }
   aborted_ = false;
+}
+
+void HostCollectives::wire_shm_edges(std::vector<ShmEdge>& edges,
+                                     int64_t conns, const std::string& base,
+                                     int64_t next_rank, int64_t prev_rank,
+                                     int64_t deadline) {
+  const size_t seg_bytes = kShmHdrBytes + shm_ring_bytes_;
+  for (int64_t s = 0; s < conns; s++) {
+    ShmEdge e;
+    std::string txname = base + "_" + std::to_string(rank_) + "_" +
+                         std::to_string(next_rank) + "_s" + std::to_string(s);
+    // Defensive unlink: a SIGKILLed predecessor of a crashed run may have
+    // leaked the name (same idempotent discipline as the iso plane).
+    ShmSegment::Unlink(txname);
+    e.tx.reset(ShmSegment::Create(txname, seg_bytes));
+    // Fresh segments are zero-filled (ftruncate): head/tail/doorbells
+    // start at 0; publish capacity, then the magic with release so an
+    // attacher that sees the magic sees the capacity too.
+    ShmRingHdr* h = shm_ring_hdr(e.tx->data());
+    h->capacity = static_cast<uint32_t>(shm_ring_bytes_);
+    h->owner_pid.store(static_cast<uint32_t>(getpid()),
+                       std::memory_order_relaxed);
+    h->magic.store(kShmRingMagic, std::memory_order_release);
+
+    std::string rxname = base + "_" + std::to_string(prev_rank) + "_" +
+                         std::to_string(rank_) + "_s" + std::to_string(s);
+    for (;;) {
+      remain_or_throw(deadline);
+      try {
+        e.rx.reset(ShmSegment::Attach(rxname, seg_bytes));
+        break;
+      } catch (const SocketError&) {
+        // Not created yet (or still the wrong generation's size): the
+        // peer is inside its own configure. Retry until the deadline.
+        struct timespec ts{0, 5 * 1000000};
+        nanosleep(&ts, nullptr);
+      }
+    }
+    ShmRingHdr* rh = shm_ring_hdr(e.rx->data());
+    while (rh->magic.load(std::memory_order_acquire) != kShmRingMagic) {
+      remain_or_throw(deadline);
+      struct timespec ts{0, 1 * 1000000};
+      nanosleep(&ts, nullptr);
+    }
+    if (rh->capacity != shm_ring_bytes_)
+      throw SocketError(
+          "shm ring capacity mismatch (TORCHFT_HC_SHM_RING_BYTES drifted "
+          "across co-hosted members: mine " +
+          std::to_string(shm_ring_bytes_) + ", peer " +
+          std::to_string(rh->capacity) + ")");
+    rh->peer_pid.store(static_cast<uint32_t>(getpid()),
+                       std::memory_order_relaxed);
+    edges.push_back(std::move(e));
+  }
 }
 
 void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
@@ -768,6 +1147,225 @@ void HostCollectives::duplex(Socket& next, Socket& prev, const char* send_buf,
   }
 }
 
+void HostCollectives::edge_duplex(RingTier& T, int64_t s, const char* send_buf,
+                                  size_t send_len, char* recv_buf,
+                                  size_t recv_len, int64_t deadline_ms,
+                                  bool header_frame) {
+  if (T.use_shm)
+    shm_duplex(T, s, send_buf, send_len, recv_buf, recv_len, deadline_ms,
+               header_frame);
+  else
+    duplex(T.next[s], T.prev[s], send_buf, send_len, recv_buf, recv_len,
+           deadline_ms, &T.scratch[s], header_frame);
+}
+
+void HostCollectives::shm_duplex(RingTier& T, int64_t s, const char* send_buf,
+                                 size_t send_len, char* recv_buf,
+                                 size_t recv_len, int64_t deadline_ms,
+                                 bool header_frame) {
+  ShmEdge& e = T.shm[s];
+  StripeScratch& sc = T.scratch[s];
+  ShmRingHdr* txh = shm_ring_hdr(e.tx->data());
+  ShmRingHdr* rxh = shm_ring_hdr(e.rx->data());
+  char* txd = shm_ring_data(e.tx->data());
+  char* rxd = shm_ring_data(e.rx->data());
+  const uint32_t tx_cap = txh->capacity;
+  const uint32_t rx_cap = rxh->capacity;
+
+  // Chaos seam: the shm ring frame path (payload frames only — like
+  // ring_hdr/ring_send, a "mid-ring corruption" plan must not be
+  // satisfiable by the op header). Disarmed: one relaxed atomic load.
+  bool swallow = false;  // drop-doorbell: the publish silently vanishes
+  bool stale = false;    // stale-payload: replay the previous frame seq
+  bool torn = false;     // torn-segment: half a frame, then poison + die
+  fault::Decision fd =
+      (send_len > 0 && !header_frame)
+          ? TFT_FAULT_CHECK(fault::kSeamShmRing, rank_, op_seq_)
+          : fault::Decision{};
+  switch (fd.kind) {
+    case fault::kDrop:
+    case fault::kPartition:
+      // The doorbell (and the bytes behind it) never land: the consumer
+      // stalls until ITS op deadline — the stall, not an error, is the
+      // injected failure (the co-hosted analog of an asymmetric
+      // partition / SIGKILLed producer).
+      swallow = true;
+      break;
+    case fault::kBitFlip:
+      stale = true;
+      break;
+    case fault::kTruncate:
+      torn = true;
+      break;
+    case fault::kDelay: {
+      int64_t ms = fd.param;
+      if (deadline_ms >= 0) {
+        int64_t remain = deadline_ms - now_ms();
+        if (remain < 0) remain = 0;
+        if (ms > remain) ms = remain;
+      }
+      struct timespec ts;
+      ts.tv_sec = ms / 1000;
+      ts.tv_nsec = (ms % 1000) * 1000000;
+      nanosleep(&ts, nullptr);
+      break;
+    }
+    default:
+      break;
+  }
+
+  ShmFrame shdr{};
+  // A swallowed (dropped/partitioned) frame never ships: its sequence
+  // must not advance either, or a later frame would read as a skip.
+  if (send_len > 0 && !swallow) e.fseq_tx++;
+  shdr.fseq = stale ? e.fseq_tx - 1 : e.fseq_tx;
+  shdr.len = static_cast<uint32_t>(send_len);
+  const char* shdr_bytes = reinterpret_cast<const char*>(&shdr);
+  const size_t send_total = send_len > 0 ? sizeof(ShmFrame) + send_len : 0;
+  // Torn-segment fault: stop mid-frame, poison, die (the consumer's
+  // magic check is the detection).
+  const size_t send_stop =
+      torn ? sizeof(ShmFrame) + send_len / 2 : send_total;
+  const size_t recv_total = recv_len > 0 ? sizeof(ShmFrame) + recv_len : 0;
+
+  size_t sent = swallow ? send_total : 0;
+  size_t got = 0;
+  char rhdr_buf[sizeof(ShmFrame)];
+  bool rhdr_checked = recv_total == 0;
+
+  while (sent < send_total || got < recv_total) {
+    if (aborted_.load(std::memory_order_relaxed))
+      throw SocketError("collective aborted (" + sc.tag + ")");
+    // Doorbell values read BEFORE the condition re-check: the standard
+    // futex lost-wakeup protocol (a publish between our check and the
+    // wait makes the wait return immediately).
+    uint32_t v_w = rxh->db_w.load(std::memory_order_acquire);
+    uint32_t v_r = txh->db_r.load(std::memory_order_acquire);
+    bool progress = false;
+
+    if (sent < send_stop) {
+      if (txh->magic.load(std::memory_order_relaxed) != kShmRingMagic)
+        throw SocketError("shm ring torn (aborted or reconfigured): " +
+                          sc.tag);
+      uint64_t head = txh->head.load(std::memory_order_relaxed);
+      uint64_t tail = txh->tail.load(std::memory_order_acquire);
+      size_t space = tx_cap - static_cast<size_t>(head - tail);
+      if (space > 0) {
+        size_t n = std::min(space, send_stop - sent);
+        // The logical stream: 16 header bytes, then the payload.
+        size_t done = 0;
+        while (done < n) {
+          size_t off = sent + done;
+          const char* src;
+          size_t avail;
+          if (off < sizeof(ShmFrame)) {
+            src = shdr_bytes + off;
+            avail = sizeof(ShmFrame) - off;
+          } else {
+            src = send_buf + (off - sizeof(ShmFrame));
+            avail = send_total - off;
+          }
+          size_t chunk = std::min(n - done, avail);
+          shm_ring_write(txd, tx_cap, head + done, src, chunk);
+          done += chunk;
+        }
+        txh->head.store(head + n, std::memory_order_release);
+        txh->db_w.fetch_add(1, std::memory_order_release);
+        shm_futex_wake(&txh->db_w);
+        sc.shm_bytes += static_cast<int64_t>(n);
+        sent += n;
+        progress = true;
+      }
+      if (torn && sent >= send_stop) {
+        {
+          MutexLock lock(cfg_mu_);
+          shm_poison_wake_locked();
+        }
+        throw SocketError("chaos injected: shm segment torn (" + sc.tag +
+                          ")");
+      }
+    }
+
+    if (got < recv_total) {
+      uint64_t head = rxh->head.load(std::memory_order_acquire);
+      uint64_t tail = rxh->tail.load(std::memory_order_relaxed);
+      size_t avail = static_cast<size_t>(head - tail);
+      if (avail == 0 &&
+          rxh->magic.load(std::memory_order_acquire) != kShmRingMagic)
+        throw SocketError("shm ring torn by peer (abort or death): " +
+                          sc.tag);
+      if (avail > 0) {
+        size_t n = std::min(avail, recv_total - got);
+        size_t done = 0;
+        while (done < n) {
+          size_t off = got + done;
+          char* dst;
+          size_t room;
+          if (off < sizeof(ShmFrame)) {
+            dst = rhdr_buf + off;
+            room = sizeof(ShmFrame) - off;
+          } else {
+            dst = recv_buf + (off - sizeof(ShmFrame));
+            room = recv_total - off;
+          }
+          size_t chunk = std::min(n - done, room);
+          shm_ring_read(rxd, rx_cap, tail + done, dst, chunk);
+          done += chunk;
+        }
+        rxh->tail.store(tail + n, std::memory_order_release);
+        rxh->db_r.fetch_add(1, std::memory_order_release);
+        shm_futex_wake(&rxh->db_r);
+        got += n;
+        progress = true;
+        if (!rhdr_checked && got >= sizeof(ShmFrame)) {
+          ShmFrame rhdr;
+          memcpy(&rhdr, rhdr_buf, sizeof(rhdr));
+          e.fseq_rx++;
+          if (rhdr.fseq != e.fseq_rx)
+            // The typed integrity verdict: a replayed (stale) frame must
+            // ride the latch -> vote-discard -> reconfigure machinery,
+            // not silently reduce yesterday's bytes.
+            throw WireCorruptionError(
+                "shm ring stale frame (" + sc.tag + ", rank " +
+                std::to_string(rank_) + ", op_index " +
+                std::to_string(op_seq_) + ": expected frame " +
+                std::to_string(e.fseq_rx) + ", got " +
+                std::to_string(rhdr.fseq) + ")");
+          if (rhdr.len != recv_len)
+            throw SocketError(
+                "shm ring frame desync (" + sc.tag + "): expected " +
+                std::to_string(recv_len) + " bytes, peer framed " +
+                std::to_string(rhdr.len) +
+                " (members must run identical ops)");
+          rhdr_checked = true;
+        }
+      }
+    }
+
+    if (!progress) {
+      int64_t remain = deadline_ms < 0 ? 100 : deadline_ms - now_ms();
+      if (remain <= 0) throw TimeoutError("collective timed out");
+      // Liveness probe before sleeping: a SIGKILLed co-hosted peer
+      // leaves no FIN and no poison — its pid vanishing is the only
+      // signal, checked once per slice (~100 ms surfacing).
+      if (got < recv_total &&
+          shm_pid_gone(rxh->owner_pid.load(std::memory_order_relaxed)))
+        throw SocketError("shm ring peer died (producer pid gone): " +
+                          sc.tag);
+      if (sent < send_stop &&
+          shm_pid_gone(txh->peer_pid.load(std::memory_order_relaxed)))
+        throw SocketError("shm ring peer died (consumer pid gone): " +
+                          sc.tag);
+      // Wait on whichever side is blocking us; receives take priority
+      // (they are what unblocks a full TX ring on the far side).
+      if (got < recv_total)
+        shm_futex_wait(&rxh->db_w, v_w, remain);
+      else
+        shm_futex_wait(&txh->db_r, v_r, remain);
+    }
+  }
+}
+
 void HostCollectives::check_op_header(RingTier& T, uint32_t kind,
                                       uint64_t count, uint32_t dtype,
                                       uint32_t op, int64_t deadline_ms) {
@@ -785,9 +1383,9 @@ void HostCollectives::check_op_header(RingTier& T, uint32_t kind,
     uint64_t count;
     uint32_t dtype, op;
   } mine{kOpMagic, kind, count, dtype, op}, theirs{};
-  duplex(T.next[0], T.prev[0], reinterpret_cast<const char*>(&mine),
-         sizeof(mine), reinterpret_cast<char*>(&theirs), sizeof(theirs),
-         deadline_ms, &T.scratch[0], /*header_frame=*/true);
+  edge_duplex(T, 0, reinterpret_cast<const char*>(&mine), sizeof(mine),
+              reinterpret_cast<char*>(&theirs), sizeof(theirs), deadline_ms,
+              /*header_frame=*/true);
   if (theirs.magic != kOpMagic)
     // Keep the historic prefix (operators and tests grep for it); the
     // context after it is what makes the error actionable in a W=8
@@ -930,8 +1528,8 @@ void HostCollectives::rs_phase_stripe(RingTier& T, int64_t s, char* bytes,
     int64_t recv_c = ((T.rank - t - 1) % T.world + T.world) % T.world;
     auto [s_start, s_len] = chunk_range(count, T.world, send_c);
     auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
-    duplex(T.next[s], T.prev[s], bytes + s_start * esize, s_len * esize,
-           recv_tmp.data(), r_len * esize, deadline, &T.scratch[s]);
+    edge_duplex(T, s, bytes + s_start * esize, s_len * esize,
+                recv_tmp.data(), r_len * esize, deadline);
     reduce_into(bytes + r_start * esize, recv_tmp.data(), r_len, dtype, op);
   }
 }
@@ -946,9 +1544,8 @@ void HostCollectives::ag_phase_stripe(RingTier& T, int64_t s, char* bytes,
     int64_t recv_c = ((T.rank - t) % T.world + T.world) % T.world;
     auto [s_start, s_len] = chunk_range(count, T.world, send_c);
     auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
-    duplex(T.next[s], T.prev[s], bytes + s_start * esize, s_len * esize,
-           bytes + r_start * esize, r_len * esize, deadline,
-           &T.scratch[s]);
+    edge_duplex(T, s, bytes + s_start * esize, s_len * esize,
+                bytes + r_start * esize, r_len * esize, deadline);
   }
 }
 
@@ -1047,9 +1644,8 @@ void HostCollectives::rs_q8_phase_stripe(RingTier& T, int64_t s, float* data,
     auto [s_start, s_len] = chunk_range(count, T.world, send_c);
     auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
     q8_encode(data + s_start, s_len, send_wire.data());
-    duplex(T.next[s], T.prev[s], send_wire.data(), sizeof(float) + s_len,
-           recv_wire.data(), sizeof(float) + r_len, deadline,
-           &T.scratch[s]);
+    edge_duplex(T, s, send_wire.data(), sizeof(float) + s_len,
+                recv_wire.data(), sizeof(float) + r_len, deadline);
     q8_decode(recv_wire.data(), r_len, data + r_start, /*accumulate=*/true);
   }
 }
@@ -1076,9 +1672,8 @@ void HostCollectives::ag_q8_phase_stripe(RingTier& T, int64_t s, float* data,
     int64_t recv_c = ((T.rank - t) % T.world + T.world) % T.world;
     auto [r_start, r_len] = chunk_range(count, T.world, recv_c);
     stored[recv_c].resize(sizeof(float) + r_len);
-    duplex(T.next[s], T.prev[s], stored[send_c].data(), stored[send_c].size(),
-           stored[recv_c].data(), stored[recv_c].size(), deadline,
-           &T.scratch[s]);
+    edge_duplex(T, s, stored[send_c].data(), stored[send_c].size(),
+                stored[recv_c].data(), stored[recv_c].size(), deadline);
     q8_decode(stored[recv_c].data(), r_len, data + r_start, false);
   }
 }
@@ -1306,8 +1901,7 @@ void HostCollectives::bcast_pipe_stripe(RingTier& T, int64_t s, char* bytes,
   for (int64_t c = 0; c < k; c++) {
     auto [cs, cl] = chunk_range(nbytes, k, c);
     if (d == 0) {
-      duplex(T.next[s], T.prev[s], bytes + cs, cl, nullptr, 0, deadline,
-             &T.scratch[s]);
+      edge_duplex(T, s, bytes + cs, cl, nullptr, 0, deadline);
     } else {
       const char* sbuf = nullptr;
       size_t slen = 0;
@@ -1316,14 +1910,12 @@ void HostCollectives::bcast_pipe_stripe(RingTier& T, int64_t s, char* bytes,
         sbuf = bytes + ps;
         slen = pl;
       }
-      duplex(T.next[s], T.prev[s], sbuf, slen, bytes + cs, cl, deadline,
-             &T.scratch[s]);
+      edge_duplex(T, s, sbuf, slen, bytes + cs, cl, deadline);
     }
   }
   if (d > 0 && fwd) {
     auto [ps, pl] = chunk_range(nbytes, k, k - 1);
-    duplex(T.next[s], T.prev[s], bytes + ps, pl, nullptr, 0, deadline,
-           &T.scratch[s]);
+    edge_duplex(T, s, bytes + ps, pl, nullptr, 0, deadline);
   }
 }
 
@@ -1399,10 +1991,45 @@ void HostCollectives::hier_schedule(char* bytes, size_t count, size_t esize,
                                     int64_t eff_intra, int64_t eff_inter,
                                     int64_t deadline) {
   using clock = std::chrono::steady_clock;
-  const bool leader = intra_.world <= 1 || intra_.rank == 0;
+  const bool host_leader = host_.world <= 1 || host_.rank == 0;
+  const bool leader =
+      host_leader && (intra_.world <= 1 || intra_.rank == 0);
+  // The host tier partitions exactly like the intra one (full-width
+  // bytes over the main stripe knob) — the two tiers hand the same
+  // buckets to the same phase bodies.
+  const int64_t eff_host = eff_intra;
 
-  // Phase 1 — intra reduce-scatter: member shards of the REGION sum, on
-  // the fast links, spreading reduction bandwidth and compute.
+  // Phase 0a/0b — host reduce-scatter + allgather over the shm rings
+  // (or the loopback-TCP fallback): the HOST leader ends with the host
+  // sum, at memcpy speed, before any socket is touched. Non-leaders
+  // rejoin at the host broadcast.
+  auto h0 = clock::now();
+  if (host_.world > 1) {
+    last_stripe_ns_.assign(eff_host, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_host, s);
+      if (len == 0) return;
+      rs_phase_stripe(host_, s, bytes + start * esize, len, esize, dtype,
+                      op, deadline);
+    });
+  }
+  auto h1 = clock::now();
+  if (host_.world > 1) {
+    last_stripe_ns_.assign(eff_host, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_host, s);
+      if (len == 0) return;
+      ag_phase_stripe(host_, s, bytes + start * esize, len, esize, deadline);
+    });
+  }
+  auto h2 = clock::now();
+  last_hier_.shm_rs_ns += ns_between(h0, h1);
+  last_hier_.shm_ag_ns += ns_between(h1, h2);
+
+  // Phase 1 — intra reduce-scatter: HOST-LEADER shards of the REGION
+  // sum, on the fast links, spreading reduction bandwidth and compute.
+  // (intra_.world is 0 on non-host-leaders — they skip straight to the
+  // host broadcast below.)
   auto t0 = clock::now();
   if (intra_.world > 1) {
     last_stripe_ns_.assign(eff_intra, 0);
@@ -1448,10 +2075,24 @@ void HostCollectives::hier_schedule(char* bytes, size_t count, size_t esize,
     });
   }
   auto t4 = clock::now();
+  // Phase 5 — host broadcast of the host leader's (now-global) bytes:
+  // every co-hosted member adopts them verbatim, completing the
+  // bit-identity chain host member -> host leader -> region leader.
+  if (host_.world > 1) {
+    last_stripe_ns_.assign(eff_host, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(count, eff_host, s);
+      if (len == 0) return;
+      bcast_pipe_stripe(host_, s, bytes + start * esize, len * esize, 0,
+                        deadline);
+    });
+  }
+  auto h3 = clock::now();
   last_hier_.intra_rs_ns += ns_between(t0, t1);
   last_hier_.intra_ag_ns += ns_between(t1, t2);
   last_hier_.inter_ring_ns += ns_between(t2, t3);
   last_hier_.intra_bcast_ns += ns_between(t3, t4);
+  last_hier_.shm_bcast_ns += ns_between(t4, h3);
   last_hier_.inter_rs_tx_bytes += inter_rs_tx;
   last_hier_.inter_ag_tx_bytes += tier_tx(inter_) - inter_tx0 - inter_rs_tx;
 }
@@ -1467,9 +2108,9 @@ void HostCollectives::allreduce_hier(void* data, size_t count, Dtype dtype,
   if (world_size_ == 1) return;
   if (!hier_)
     throw SocketError(
-        "two-tier schedule unavailable: configure() was not given a region "
-        "map with >= 2 distinct labels (single-region cohort or unlabeled "
-        "members ride the flat ring)");
+        "hierarchical schedule unavailable: configure() saw neither a "
+        "region map with >= 2 distinct labels nor a host map grouping "
+        ">= 2 co-hosted ranks (the cohort rides the flat ring)");
   if (wire != HierWire::kNone &&
       (dtype != Dtype::kF32 || op != ReduceOp::kSum))
     throw SocketError("hier wire bf16/q8 takes f32 payloads and SUM only");
@@ -1483,17 +2124,24 @@ void HostCollectives::allreduce_hier(void* data, size_t count, Dtype dtype,
     int64_t eff_inter = effective_stripes(count * inter_esize, stripes_inter_);
     reset_tier_tx(intra_);
     reset_tier_tx(inter_);
+    reset_tier_tx(host_);
     // Both effective stripe counts and the wire ride the header's op slot:
     // every member derives them from negotiated inputs, but a drifted knob
-    // must error, not desync two tiers' schedules.
+    // must error, not desync two tiers' schedules. The host tier shares
+    // eff_intra by construction.
     uint32_t opword = static_cast<uint32_t>(op) |
                       (static_cast<uint32_t>(wire) << 4) |
                       (static_cast<uint32_t>(eff_intra) << 8) |
                       (static_cast<uint32_t>(eff_inter) << 16);
+    if (host_.world > 1)
+      check_op_header(host_, 9, count, static_cast<uint32_t>(dtype), opword,
+                      deadline);
     if (intra_.world > 1)
       check_op_header(intra_, 9, count, static_cast<uint32_t>(dtype), opword,
                       deadline);
-    const bool leader = intra_.world <= 1 || intra_.rank == 0;
+    const bool host_leader = host_.world <= 1 || host_.rank == 0;
+    const bool leader =
+        host_leader && (intra_.world <= 1 || intra_.rank == 0);
     if (leader && inter_.world > 1)
       check_op_header(inter_, 9, count, static_cast<uint32_t>(dtype), opword,
                       deadline);
@@ -1501,13 +2149,19 @@ void HostCollectives::allreduce_hier(void* data, size_t count, Dtype dtype,
     last_hier_.payload_bytes = static_cast<int64_t>(count * esize);
     last_hier_.eff_intra = eff_intra;
     last_hier_.eff_inter = eff_inter;
+    last_hier_.eff_host = host_.world > 1 ? eff_intra : 0;
     last_hier_.intra_world = intra_.world;
     last_hier_.inter_world = leader ? inter_.world : 0;
+    last_hier_.host_world = host_.world;
     last_hier_.leader = leader;
+    last_hier_.host_leader = host_leader;
+    last_hier_.host_shm = host_.use_shm;
     hier_schedule(static_cast<char*>(data), count, esize, dtype, op, wire,
                   eff_intra, eff_inter, deadline);
     last_hier_.intra_tx_bytes = tier_tx(intra_);
     last_hier_.inter_tx_bytes = tier_tx(inter_);
+    last_hier_.host_tx_bytes = tier_tx(host_);
+    last_hier_.shm_bytes = tier_shm(host_);
   });
 }
 
@@ -1521,12 +2175,21 @@ std::string HostCollectives::last_hier_json() const {
   o["inter_tx_bytes"] = Json(last_hier_.inter_tx_bytes);
   o["inter_rs_tx_bytes"] = Json(last_hier_.inter_rs_tx_bytes);
   o["inter_ag_tx_bytes"] = Json(last_hier_.inter_ag_tx_bytes);
+  o["shm_rs_s"] = Json(last_hier_.shm_rs_ns / 1e9);
+  o["shm_ag_s"] = Json(last_hier_.shm_ag_ns / 1e9);
+  o["shm_bcast_s"] = Json(last_hier_.shm_bcast_ns / 1e9);
+  o["host_tx_bytes"] = Json(last_hier_.host_tx_bytes);
+  o["shm_bytes"] = Json(last_hier_.shm_bytes);
   o["payload_bytes"] = Json(last_hier_.payload_bytes);
   o["eff_intra"] = Json(last_hier_.eff_intra);
   o["eff_inter"] = Json(last_hier_.eff_inter);
+  o["eff_host"] = Json(last_hier_.eff_host);
   o["intra_world"] = Json(last_hier_.intra_world);
   o["inter_world"] = Json(last_hier_.inter_world);
+  o["host_world"] = Json(last_hier_.host_world);
   o["leader"] = Json(last_hier_.leader);
+  o["host_leader"] = Json(last_hier_.host_leader);
+  o["host_shm"] = Json(last_hier_.host_shm);
   o["wire"] = Json(static_cast<int64_t>(last_hier_.wire));
   return Json(std::move(o)).dump();
 }
@@ -1575,11 +2238,13 @@ int64_t HostCollectives::plan_build(const int64_t* counts,
   mix(static_cast<uint64_t>(world_size_));
   mix(static_cast<uint64_t>(stripes_));
   if (hier) {
-    // Hier plans bake in the two-tier geometry as well: a hier plan
+    // Hier plans bake in the hierarchical geometry as well: a hier plan
     // meeting a flat plan — or one built against a different inter
-    // stripe knob — must error at the header, not desync mid-payload.
+    // stripe knob or a drifted (region, host) topology map — must error
+    // at the header, not desync mid-payload.
     mix(0x48494552ull /*"HIER"*/);
     mix(static_cast<uint64_t>(stripes_inter_));
+    mix(topo_hash_);
   }
   const bool q8 = wire == PlanWire::kQ8 || wire == PlanWire::kQ8EF;
   for (int64_t i = 0; i < n_leaves; i++) {
@@ -2030,7 +2695,9 @@ void HostCollectives::plan_execute_hier_group(CommPlan& p, size_t gi,
                                                        : esize;
   const int64_t eff_inter =
       effective_stripes(g.count * inter_esize, stripes_inter_);
-  const bool leader = intra_.world <= 1 || intra_.rank == 0;
+  const bool host_leader = host_.world <= 1 || host_.rank == 0;
+  const bool leader =
+      host_leader && (intra_.world <= 1 || intra_.rank == 0);
   char* stg = g.staging.data();
 
   size_t stat_base = p.stats.size();
@@ -2043,11 +2710,14 @@ void HostCollectives::plan_execute_hier_group(CommPlan& p, size_t gi,
   }
 
   using clock = std::chrono::steady_clock;
-  auto t0 = clock::now();
-  // Phase 1 — pack fused into the intra reduce-scatter, per stripe bucket
-  // (bucket i+1 packs while bucket i rides its intra connection: the
-  // triple pipeline survives the extra tier).
-  if (intra_.world > 1) {
+  auto h0 = clock::now();
+  // Phase 0 — pack fused into the HOST reduce-scatter when the host tier
+  // exists (bucket i+1 packs while bucket i rides its shm ring), then
+  // the host allgather: the host leader ends with the host sum without
+  // a socket in sight. With no host tier the pack fuses into the intra
+  // reduce-scatter exactly as before.
+  const bool host_active = host_.world > 1;
+  if (host_active) {
     last_stripe_ns_.assign(eff_intra, 0);
     run_striped([&](int64_t s) {
       auto [start, len] = stripe_range(g.count, eff_intra, s);
@@ -2055,14 +2725,51 @@ void HostCollectives::plan_execute_hier_group(CommPlan& p, size_t gi,
       auto p0 = clock::now();
       plan_pack_range(p, g, leaf_in, start, len);
       auto p1 = clock::now();
-      rs_phase_stripe(intra_, s, stg + start * esize, len, esize, g.dtype,
+      rs_phase_stripe(host_, s, stg + start * esize, len, esize, g.dtype,
                       ReduceOp::kSum, deadline);
       auto p2 = clock::now();
       CommPlan::BucketStat& st = p.stats[stat_base + s];
       st.pack_ns = ns_between(p0, p1);
       st.ring_ns += ns_between(p1, p2);
     });
-  } else {
+  }
+  auto h1 = clock::now();
+  if (host_active) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, eff_intra, s);
+      if (len == 0) return;
+      auto p0 = clock::now();
+      ag_phase_stripe(host_, s, stg + start * esize, len, esize, deadline);
+      p.stats[stat_base + s].ring_ns += ns_between(p0, clock::now());
+    });
+  }
+  auto h2 = clock::now();
+  last_hier_.shm_rs_ns += ns_between(h0, h1);
+  last_hier_.shm_ag_ns += ns_between(h1, h2);
+
+  auto t0 = clock::now();
+  // Phase 1 — pack fused into the intra reduce-scatter, per stripe bucket
+  // (bucket i+1 packs while bucket i rides its intra connection: the
+  // triple pipeline survives the extra tier). Under an active host tier
+  // the payload is already packed and host-summed; intra_.world is 0 on
+  // non-host-leaders, so only host leaders run these phases.
+  if (intra_.world > 1) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, eff_intra, s);
+      if (len == 0) return;
+      auto p0 = clock::now();
+      if (!host_active) plan_pack_range(p, g, leaf_in, start, len);
+      auto p1 = clock::now();
+      rs_phase_stripe(intra_, s, stg + start * esize, len, esize, g.dtype,
+                      ReduceOp::kSum, deadline);
+      auto p2 = clock::now();
+      CommPlan::BucketStat& st = p.stats[stat_base + s];
+      st.pack_ns += ns_between(p0, p1);
+      st.ring_ns += ns_between(p1, p2);
+    });
+  } else if (!host_active) {
     plan_pack_range(p, g, leaf_in, 0, g.count);
   }
   auto t1 = clock::now();
@@ -2093,8 +2800,9 @@ void HostCollectives::plan_execute_hier_group(CommPlan& p, size_t gi,
                      eff_inter, deadline, &inter_rs_tx);
   }
   auto t3 = clock::now();
-  // Phase 4 — broadcast the leader's result and unpack per stripe bucket
-  // (bucket i+1 still rides the intra ring while bucket i unpacks).
+  // Phase 4 — broadcast the leader's result down the tiers. With a host
+  // tier the unpack fuses into the HOST broadcast (the last phase every
+  // member runs); otherwise into the intra broadcast as before.
   if (intra_.world > 1) {
     last_stripe_ns_.assign(eff_intra, 0);
     run_striped([&](int64_t s) {
@@ -2104,25 +2812,45 @@ void HostCollectives::plan_execute_hier_group(CommPlan& p, size_t gi,
       bcast_pipe_stripe(intra_, s, stg + start * esize, len * esize, 0,
                         deadline);
       auto p1 = clock::now();
+      if (!host_active)
+        plan_unpack_range(p, g, leaf_out, start, len, divisor, has_divisor);
+      auto p2 = clock::now();
+      CommPlan::BucketStat& st = p.stats[stat_base + s];
+      st.ring_ns += ns_between(p0, p1);
+      st.unpack_ns += ns_between(p1, p2);
+    });
+  } else if (!host_active) {
+    plan_unpack_range(p, g, leaf_out, 0, g.count, divisor, has_divisor);
+  }
+  auto t4 = clock::now();
+  if (host_active) {
+    last_stripe_ns_.assign(eff_intra, 0);
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, eff_intra, s);
+      if (len == 0) return;
+      auto p0 = clock::now();
+      bcast_pipe_stripe(host_, s, stg + start * esize, len * esize, 0,
+                        deadline);
+      auto p1 = clock::now();
       plan_unpack_range(p, g, leaf_out, start, len, divisor, has_divisor);
       auto p2 = clock::now();
       CommPlan::BucketStat& st = p.stats[stat_base + s];
       st.ring_ns += ns_between(p0, p1);
-      st.unpack_ns = ns_between(p1, p2);
+      st.unpack_ns += ns_between(p1, p2);
     });
-  } else {
-    plan_unpack_range(p, g, leaf_out, 0, g.count, divisor, has_divisor);
   }
-  auto t4 = clock::now();
+  auto h3 = clock::now();
   last_hier_.intra_rs_ns += ns_between(t0, t1);
   last_hier_.intra_ag_ns += ns_between(t1, t2);
   last_hier_.inter_ring_ns += ns_between(t2, t3);
   last_hier_.intra_bcast_ns += ns_between(t3, t4);
+  last_hier_.shm_bcast_ns += ns_between(t4, h3);
   last_hier_.inter_rs_tx_bytes += inter_rs_tx;
   last_hier_.inter_ag_tx_bytes += tier_tx(inter_) - inter_tx0 - inter_rs_tx;
   last_hier_.payload_bytes += static_cast<int64_t>(g.count * esize);
   last_hier_.eff_intra = eff_intra;
   last_hier_.eff_inter = eff_inter;
+  last_hier_.eff_host = host_active ? eff_intra : 0;
 }
 
 void HostCollectives::plan_execute(int64_t plan_id,
@@ -2168,9 +2896,15 @@ void HostCollectives::plan_execute(int64_t plan_id,
                : HierWire::kNone);
       reset_tier_tx(intra_);
       reset_tier_tx(inter_);
-      const bool leader = intra_.world <= 1 || intra_.rank == 0;
+      reset_tier_tx(host_);
+      const bool host_leader = host_.world <= 1 || host_.rank == 0;
+      const bool leader =
+          host_leader && (intra_.world <= 1 || intra_.rank == 0);
       // kind 10 = hier plan: a hier plan meeting a flat plan (kind 8) or
       // a bulk hier op (kind 9) must error at the header.
+      if (host_.world > 1)
+        check_op_header(host_, 10, p.sig, static_cast<uint32_t>(p.wire), 0,
+                        deadline);
       if (intra_.world > 1)
         check_op_header(intra_, 10, p.sig, static_cast<uint32_t>(p.wire), 0,
                         deadline);
@@ -2179,12 +2913,17 @@ void HostCollectives::plan_execute(int64_t plan_id,
                         deadline);
       last_hier_.intra_world = intra_.world;
       last_hier_.inter_world = leader ? inter_.world : 0;
+      last_hier_.host_world = host_.world;
       last_hier_.leader = leader;
+      last_hier_.host_leader = host_leader;
+      last_hier_.host_shm = host_.use_shm;
       for (size_t gi = 0; gi < p.groups.size(); gi++)
         plan_execute_hier_group(p, gi, leaf_in, leaf_out, divisor,
                                 has_divisor, deadline);
       last_hier_.intra_tx_bytes = tier_tx(intra_);
       last_hier_.inter_tx_bytes = tier_tx(inter_);
+      last_hier_.host_tx_bytes = tier_tx(host_);
+      last_hier_.shm_bytes = tier_shm(host_);
     });
     p.execs++;
     return;

@@ -22,11 +22,12 @@
 // actually cross), so striping multiplies achievable throughput the way
 // NCCL channels or multi-stream object fetches do.
 //
-// TWO-TIER TOPOLOGY (configure with a region map): on a fleet spanning
-// regions, the flat ring makes every member push 2*(W-1)/W*N bytes across
-// whatever link its neighbor happens to sit behind — on a topology-oblivious
-// placement that is the slow inter-region (DCN) path for every edge. With a
-// region label per rank, configure() additionally builds
+// HIERARCHICAL TOPOLOGY (configure with a region and/or host map): on a
+// fleet spanning regions, the flat ring makes every member push
+// 2*(W-1)/W*N bytes across whatever link its neighbor happens to sit
+// behind — on a topology-oblivious placement that is the slow inter-region
+// (DCN) path for every edge. With a region label per rank, configure()
+// additionally builds
 //   - an INTRA ring per region (the member's region peers, rank order), and
 //   - an INTER ring among one deterministic LEADER per region (the lowest
 //     rank — i.e. lowest replica-id, since ranks sort by replica-id — with
@@ -42,6 +43,32 @@
 // leader's bytes verbatim and leaders are bit-identical by ring determinism,
 // so results are bit-identical across ALL members and across runs. The sum
 // ORDER differs from the flat ring (documented; tolerance-class equal).
+//
+// THIRD TIER — the HOST ring (configure with a host map): members sharing
+// a (region, host) label pair are co-resident processes; pushing their
+// ring bytes through loopback TCP costs two kernel copies plus syscalls
+// per chunk. configure() groups them into a HOST ring below the intra
+// tier, carried over POSIX shared-memory ring buffers (one SPSC ring per
+// directed edge per stripe, tft_shm_* segments, futex doorbells) — a
+// single memcpy per hop instead of a socket round trip. The schedule
+// grows to
+//   host reduce-scatter -> host allgather (the HOST leader — lowest rank
+//   on the host — holds the host sum) -> intra rs/ag among HOST leaders
+//   of a region -> inter ring among region leaders (wire applied there,
+//   unchanged) -> intra broadcast to host leaders -> host broadcast.
+// The intra tier therefore spans host LEADERS only; the region leader is
+// the lowest rank of its region, which is by construction also a host
+// leader. Segments are owned by the configure generation (created by the
+// producing member, torn down — unlinked — on reconfigure/destruction);
+// abort() poisons the ring magic and futex-wakes every waiter, so a
+// failure propagates across the shm tier the way a socket FIN does on
+// TCP. TORCHFT_HC_SHM=0 falls the host tier back to loopback TCP (same
+// geometry, kTierHost hello) — the honest control the shm bench row is
+// measured against; with no host map (or no (region,host) group of >= 2)
+// the host tier is absent and the schedule is exactly the two-tier one.
+// Shared-memory hops hand NOTHING to the kernel: their tx_bytes stay 0
+// (wire accounting is honest) and the bytes moved are reported
+// separately as shm_bytes.
 //
 // Ring allreduce = reduce-scatter + allgather; within each stripe every
 // chunk is reduced in the same rank order on every participant, and stripe
@@ -150,10 +177,33 @@ struct StripeScratch {
   PaceState pace;                   // this connection's send pacing
   int64_t cap_bps = 0;              // tier's per-connection send cap
   int64_t tx_bytes = 0;             // bytes sent since the op reset it
+  // Bytes moved through this stripe's SHARED-MEMORY rings since the op
+  // reset it (frame headers included). Kept apart from tx_bytes on
+  // purpose: shm hops hand nothing to the kernel, so the wire bill
+  // stays honest while the movement is still measurable.
+  int64_t shm_bytes = 0;
   // Diagnostic tag ("tier=... stripe=... prev=host:port") baked at
   // configure: wire-integrity and desync errors carry it so a W=8 fleet
   // log names the guilty edge instead of an anonymous socket.
   std::string tag;
+};
+
+class ShmSegment;
+
+// One directed shared-memory edge pair of the host ring, per stripe: the
+// TX ring this member CREATES and produces into (toward its next host
+// neighbor) and the RX ring it ATTACHES and consumes from (fed by its
+// prev neighbor). Creator-owned segments: dropping the handle unlinks
+// the name — the configure-generation ownership contract.
+struct ShmEdge {
+  std::unique_ptr<ShmSegment> tx;
+  std::unique_ptr<ShmSegment> rx;
+  uint64_t fseq_tx = 0;  // frames produced (stale-payload detection)
+  uint64_t fseq_rx = 0;  // frames consumed
+  // Chaos: op index whose sends this edge swallows (drop-doorbell /
+  // partition faults persist for the whole op — the injected failure is
+  // the peer's stall, not a detectable frame skip).
+  int64_t drop_op = -1;
 };
 
 // One ring a member participates in: the FLAT ring over all W members, the
@@ -167,14 +217,19 @@ struct RingTier {
   int64_t world = 0;
   int64_t conns = 0;
   int64_t cap_bps = 0;
-  // Diagnostics: tier name ("flat"/"intra"/"inter") and the neighbor
-  // addresses wired at configure — protocol-desync and CRC errors name
-  // the edge they fired on.
+  // Diagnostics: tier name ("flat"/"intra"/"inter"/"host") and the
+  // neighbor addresses wired at configure — protocol-desync and CRC
+  // errors name the edge they fired on.
   std::string name;
   std::string peer_next_addr;
   std::string peer_prev_addr;
   std::vector<Socket> next;   // one per stripe
   std::vector<Socket> prev;   // one per stripe
+  // Shared-memory transport (host tier only, TORCHFT_HC_SHM on): one
+  // edge pair per stripe instead of sockets. When non-empty, every ring
+  // body routes its duplex through the shm rings.
+  bool use_shm = false;
+  std::vector<ShmEdge> shm;
   // Persistent per-stripe staging + pacing + per-op tx accounting
   // (grow-only, reused across ops).
   std::vector<StripeScratch> scratch;
@@ -183,6 +238,8 @@ struct RingTier {
     world = 0;
     next.clear();
     prev.clear();
+    use_shm = false;
+    shm.clear();
   }
 };
 
@@ -201,12 +258,24 @@ struct HierStats {
   int64_t inter_tx_bytes = 0;
   int64_t inter_rs_tx_bytes = 0;
   int64_t inter_ag_tx_bytes = 0;
+  // Host (third) tier: phase walls of the shm (or loopback-TCP
+  // fallback) ring, its MEASURED socket tx (0 under shm — the honest
+  // zero-tx contract) and the bytes moved through the shm rings.
+  int64_t shm_rs_ns = 0;
+  int64_t shm_ag_ns = 0;
+  int64_t shm_bcast_ns = 0;
+  int64_t host_tx_bytes = 0;
+  int64_t shm_bytes = 0;
   int64_t payload_bytes = 0;
   int64_t eff_intra = 0;
   int64_t eff_inter = 0;
+  int64_t eff_host = 0;
   int64_t intra_world = 0;
   int64_t inter_world = 0;
-  bool leader = false;
+  int64_t host_world = 0;
+  bool leader = false;       // region leader
+  bool host_leader = false;
+  bool host_shm = false;     // host tier transport: shm (else TCP)
   int wire = 0;  // HierWire of the inter hop
 };
 
@@ -305,14 +374,32 @@ class HostCollectives {
   // become available; `stripes_inter` (0 = `stripes`) is the inter
   // (leader) ring's connection count — the slow wide-area hop is where
   // striping pays, so it gets its own knob.
+  //
+  // `hosts` (optional): one host label per rank (quorum-agreed, like
+  // regions). Whenever a (region, host) pair groups >= 2 ranks, the
+  // HOST tier is built below the intra one (see the file comment) —
+  // shared-memory rings by default, loopback TCP under TORCHFT_HC_SHM=0
+  // — and the hierarchical schedule becomes available even on a
+  // single-region cohort (host rings + a leader ring are two real
+  // tiers). Ring-buffer bytes per edge per stripe:
+  // TORCHFT_HC_SHM_RING_BYTES (default 1 MiB).
   void configure(const std::string& store_addr, int64_t rank, int64_t world_size,
                  int64_t timeout_ms, int64_t stripes = 1,
                  const std::vector<std::string>& regions = {},
-                 int64_t stripes_inter = 0);
+                 int64_t stripes_inter = 0,
+                 const std::vector<std::string>& hosts = {});
 
-  // Whether the last configure() built the two-tier topology (a region map
-  // with >= 2 distinct labels was supplied).
+  // Whether the last configure() built a hierarchical topology: a region
+  // map with >= 2 distinct labels, a host map grouping >= 2 co-hosted
+  // ranks, or both.
   bool hier_capable() const { return hier_; }
+
+  // Host-tier transport of the last configure: 0 = no host tier,
+  // 1 = loopback TCP (TORCHFT_HC_SHM off), 2 = shared-memory rings.
+  int host_tier_transport() const {
+    if (!hier_ || host_.world <= 1) return 0;
+    return host_.use_shm ? 2 : 1;
+  }
 
   // Requests per-frame CRC32C on every ring/stripe payload frame of the
   // NEXT configure() (and thereafter, until changed). Every member must
@@ -505,6 +592,14 @@ class HostCollectives {
   // stays usable via a subsequent configure(). Safe to call from any thread.
   void abort();
 
+  // abort() plus deterministic release of every ring resource — sockets,
+  // listener and the host tier's shm segments (creator unlink) — without
+  // destroying the instance. The shutdown() counterpart of configure's
+  // generation ownership: callers that keep the object alive (pending
+  // GC, caches) must not keep kernel-named segments alive with it. A
+  // later configure() rebuilds everything.
+  void release_rings();
+
  private:
   // Sends send_len bytes to next while concurrently receiving recv_len
   // bytes from prev (full-duplex pump; one-directional blocking would
@@ -516,6 +611,26 @@ class HostCollectives {
               size_t send_len, char* recv_buf, size_t recv_len,
               int64_t deadline_ms, StripeScratch* sc = nullptr,
               bool header_frame = false);
+
+  // The shared-memory analog of duplex for one host-tier edge pair:
+  // produces one frame ([len, fseq] header + payload) into the stripe's
+  // TX ring while consuming one from its RX ring, futex-blocking (with
+  // the op deadline) when a ring is full/empty. Frame sequence numbers
+  // and lengths are checked on consume — a stale or desynced frame
+  // errors instead of reducing wrong bytes; a poisoned ring magic (peer
+  // abort/death, torn segment) errors like a socket FIN. Accounts moved
+  // bytes into scratch.shm_bytes, never tx_bytes.
+  void shm_duplex(RingTier& T, int64_t s, const char* send_buf,
+                  size_t send_len, char* recv_buf, size_t recv_len,
+                  int64_t deadline_ms, bool header_frame);
+
+  // Routes one edge exchange of tier T / stripe s through the tier's
+  // transport: shm rings when T.use_shm, else the TCP duplex. Every ring
+  // body goes through here, so the host tier reuses the proven phase
+  // bodies unchanged.
+  void edge_duplex(RingTier& T, int64_t s, const char* send_buf,
+                   size_t send_len, char* recv_buf, size_t recv_len,
+                   int64_t deadline_ms, bool header_frame = false);
 
   // Exchanges a tiny (kind, count, dtype, op) header with both neighbors
   // of tier `T` on stripe 0 before a collective and throws on mismatch — a
@@ -576,7 +691,9 @@ class HostCollectives {
                          int64_t root, int64_t deadline);
   // One hierarchical schedule over `count` elements of `data` (already
   // under op_mu_/run_op): the shared body of allreduce_hier and the hier
-  // plan execute. Accumulates phase/byte stats into last_hier_.
+  // plan execute. Runs the host (shm) phases when the host tier exists,
+  // the intra/inter phases on host leaders, and accumulates phase/byte
+  // stats into last_hier_.
   void hier_schedule(char* bytes, size_t count, size_t esize, Dtype dtype,
                      ReduceOp op, HierWire wire, int64_t eff_intra,
                      int64_t eff_inter, int64_t deadline);
@@ -595,9 +712,22 @@ class HostCollectives {
   void copy_shard(char* data, char* shard, size_t count, size_t esize,
                   int64_t eff, bool to_shard) const;
   // Sum of the per-connection tx counters of a tier's scratch; resetting
-  // them is the per-op accounting boundary.
+  // them is the per-op accounting boundary. tier_shm sums the bytes
+  // moved through the tier's shared-memory rings (0 on TCP tiers).
   static int64_t tier_tx(const RingTier& T);
+  static int64_t tier_shm(const RingTier& T);
   static void reset_tier_tx(RingTier& T);
+
+  // Builds the host tier's shared-memory edges (create TX, attach RX
+  // with retry until `deadline`) for the freshly computed geometry;
+  // called from configure's phase 2 with no locks held.
+  void wire_shm_edges(std::vector<ShmEdge>& edges, int64_t conns,
+                      const std::string& base, int64_t next_rank,
+                      int64_t prev_rank, int64_t deadline);
+  // Poisons every shm ring magic and futex-wakes all waiters (local and
+  // peer) — the shm analog of a socket shutdown; part of the abort/
+  // failure-propagation path.
+  void shm_poison_wake_locked() TFT_REQUIRES(cfg_mu_);
 
   // Plan internals: pack/unpack one element range of a group (casts per
   // the plan wire; unpack applies the divisor), and the kQ8EF per-leaf
@@ -681,6 +811,13 @@ class HostCollectives {
   int64_t stripes_ = 1;
   int64_t stripes_inter_ = 1;
   bool hier_ = false;
+  // Canonical hash of the (region, host) topology map of the last
+  // configure — mixed into hier plan signatures so plans built against
+  // different topologies error at the header instead of desyncing.
+  uint64_t topo_hash_ = 0;
+  // Shared-memory ring-buffer bytes per edge per stripe, snapshotted at
+  // configure (TORCHFT_HC_SHM_RING_BYTES).
+  size_t shm_ring_bytes_ = 1 << 20;
   // Wire CRC: crc_req_ is the caller's request (env default at
   // construction, settable until configure); crc_ is the ACTIVE frame
   // format, snapshotted by configure so it is stable for the life of a
@@ -692,13 +829,15 @@ class HostCollectives {
   // the index desync/corruption errors report.
   int64_t op_seq_ = 0;
   std::unique_ptr<Listener> listener_;
-  // The three rings a member can participate in. flat_ always exists
-  // after a multi-member configure; intra_/inter_ only under a hier
-  // configure (intra_.world == 1 for a one-member region, inter_.world
-  // only meaningful on the region leader).
+  // The four rings a member can participate in. flat_ always exists
+  // after a multi-member configure; intra_/inter_/host_ only under a
+  // hier configure (intra_.world == 1 for a one-member region,
+  // inter_.world only meaningful on the region leader, host_.world <= 1
+  // when this member is alone on its host).
   RingTier flat_;
   RingTier intra_;
   RingTier inter_;
+  RingTier host_;
   HierStats last_hier_;
   // Leader-side inter-hop wire staging for allreduce_hier's bf16 wire
   // (grow-only, reused across ops).

@@ -67,6 +67,7 @@ const char* seam_name(int seam) {
     case kSeamChild: return "child";
     case kSeamShm: return "shm";
     case kSeamRingHdr: return "ring_hdr";
+    case kSeamShmRing: return "shm_ring";
   }
   return "unknown";
 }
@@ -79,6 +80,7 @@ int seam_from_name(const std::string& s) {
   if (s == "child") return kSeamChild;
   if (s == "shm") return kSeamShm;
   if (s == "ring_hdr") return kSeamRingHdr;
+  if (s == "shm_ring") return kSeamShmRing;
   throw std::runtime_error("fault plan: unknown seam '" + s + "'");
 }
 

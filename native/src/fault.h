@@ -48,6 +48,14 @@ enum Seam : int {
                       // corruption" plan cannot be satisfied by hitting
                       // the 24-byte header (whose magic check would
                       // catch it even without CRC)
+  kSeamShmRing = 7,   // collectives.cc shm_duplex() PAYLOAD frames (the
+                      // host tier's shared-memory rings): drop = every
+                      // publish of the op silently vanishes (asymmetric
+                      // partition; the consumer stalls to its op
+                      // deadline); bit_flip = a stale frame sequence
+                      // ships (replayed payload, detected); truncate =
+                      // a torn segment (half a frame, ring magic
+                      // poisoned)
 };
 
 // Fault kinds a native seam can realize. Python-side seams reuse the

@@ -85,13 +85,15 @@ ManagerServer::ManagerServer(const std::string& replica_id,
                              int64_t heartbeat_interval_ms,
                              int64_t connect_timeout_ms,
                              const std::string& root_addr, int64_t lease_ttl_ms,
-                             const std::string& region)
+                             const std::string& region,
+                             const std::string& host)
     : replica_id_(replica_id),
       lighthouse_addr_(lighthouse_addr),
       root_addr_(root_addr == lighthouse_addr ? "" : root_addr),
       hostname_(hostname.empty() ? local_hostname() : hostname),
       store_addr_(store_addr),
       region_(region),
+      host_label_(host),
       world_size_(world_size),
       heartbeat_interval_ms_(heartbeat_interval_ms),
       connect_timeout_ms_(connect_timeout_ms),
@@ -314,6 +316,7 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
     requester.set_world_size(world_size_);
     requester.set_shrink_only(req.shrink_only());
     requester.set_region(region_);
+    requester.set_host(host_label_);
     requester.set_force_reconfigure(force_reconfigure_pending_);
     force_reconfigure_pending_ = false;
     // The state lock is NOT held across the lighthouse round trip (the

@@ -63,13 +63,16 @@ class ManagerServer {
   // `region` (optional, "" = unlabeled) is the group's topology label
   // (TORCHFT_REGION): it rides the quorum requester into every member's
   // QuorumMember, and the quorum result's region map is what the data
-  // plane compiles into the two-tier collective schedule.
+  // plane compiles into the two-tier collective schedule. `host`
+  // (optional, "" = unlabeled; TORCHFT_HOST, default hostname at the
+  // Python layer) rides the same way — the quorum's host map is what
+  // groups co-hosted members into the shared-memory intra-host tier.
   ManagerServer(const std::string& replica_id, const std::string& lighthouse_addr,
                 const std::string& hostname, const std::string& bind,
                 const std::string& store_addr, uint64_t world_size,
                 int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
                 const std::string& root_addr = "", int64_t lease_ttl_ms = 0,
-                const std::string& region = "");
+                const std::string& region = "", const std::string& host = "");
   ~ManagerServer();
 
   std::string address() const; // "http://host:port"
@@ -101,6 +104,7 @@ class ManagerServer {
   std::string hostname_;
   std::string store_addr_;
   std::string region_;
+  std::string host_label_;
   uint64_t world_size_;
   int64_t heartbeat_interval_ms_;
   int64_t connect_timeout_ms_;

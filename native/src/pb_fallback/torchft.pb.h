@@ -201,6 +201,8 @@ class QuorumMember {
   void set_force_reconfigure(bool v) { force_reconfigure_ = v; }
   const std::string& region() const { return region_; }
   void set_region(const std::string& v) { region_ = v; }
+  const std::string& host() const { return host_; }
+  void set_host(const std::string& v) { host_ = v; }
 
   void AppendTo(std::string& out) const {
     tft_pb::put_str(out, 1, replica_id_);
@@ -211,6 +213,7 @@ class QuorumMember {
     tft_pb::put_bool(out, 6, shrink_only_);
     tft_pb::put_bool(out, 7, force_reconfigure_);
     tft_pb::put_str(out, 8, region_);
+    tft_pb::put_str(out, 9, host_);
   }
   bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
     switch (f) {
@@ -222,13 +225,14 @@ class QuorumMember {
       case 6: if (w == 0) { shrink_only_ = r.varint() != 0; return true; } break;
       case 7: if (w == 0) { force_reconfigure_ = r.varint() != 0; return true; } break;
       case 8: if (w == 2) { region_ = r.bytes(); return true; } break;
+      case 9: if (w == 2) { host_ = r.bytes(); return true; } break;
     }
     return false;
   }
   TFT_PB_COMMON()
 
  private:
-  std::string replica_id_, address_, store_address_, region_;
+  std::string replica_id_, address_, store_address_, region_, host_;
   int64_t step_ = 0;
   uint64_t world_size_ = 0;
   bool shrink_only_ = false;
@@ -747,6 +751,12 @@ class ManagerQuorumResponse {
   void add_replica_regions(const std::string& v) {
     replica_regions_.push_back(v);
   }
+  const std::vector<std::string>& replica_hosts() const {
+    return replica_hosts_;
+  }
+  void add_replica_hosts(const std::string& v) {
+    replica_hosts_.push_back(v);
+  }
 
   void AppendTo(std::string& out) const {
     tft_pb::put_int64(out, 1, quorum_id_);
@@ -765,6 +775,8 @@ class ManagerQuorumResponse {
     // the list is indexed by replica rank, so holes would shift labels.
     for (const auto& rg : replica_regions_)
       tft_pb::put_len_prefixed(out, 12, rg);
+    for (const auto& rh : replica_hosts_)
+      tft_pb::put_len_prefixed(out, 13, rh);
   }
   bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
     switch (f) {
@@ -792,6 +804,7 @@ class ManagerQuorumResponse {
       case 10: if (w == 0) { replica_world_size_ = static_cast<int64_t>(r.varint()); return true; } break;
       case 11: if (w == 0) { heal_ = r.varint() != 0; return true; } break;
       case 12: if (w == 2) { replica_regions_.push_back(r.bytes()); return true; } break;
+      case 13: if (w == 2) { replica_hosts_.push_back(r.bytes()); return true; } break;
     }
     return false;
   }
@@ -803,6 +816,7 @@ class ManagerQuorumResponse {
   std::string recover_src_manager_address_, store_address_;
   std::vector<int64_t> recover_dst_ranks_;
   std::vector<std::string> replica_regions_;
+  std::vector<std::string> replica_hosts_;
   bool has_recover_src_rank_ = false, has_max_rank_ = false, heal_ = false;
 };
 

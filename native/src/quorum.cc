@@ -218,6 +218,9 @@ ManagerQuorumResponse compute_quorum_results(const std::string& replica_id,
   // compiles into the two-tier collective schedule. Every member derives
   // the identical map from the identical sorted quorum.
   for (const auto& p : participants) resp.add_replica_regions(p.region());
+  // The host map rides the same indexing: (region, host) groups are what
+  // the data plane compiles into the shared-memory intra-host tier.
+  for (const auto& p : participants) resp.add_replica_hosts(p.host());
   return resp;
 }
 
@@ -369,6 +372,7 @@ Json member_to_json(const QuorumMember& m) {
   o["shrink_only"] = m.shrink_only();
   o["force_reconfigure"] = m.force_reconfigure();
   o["region"] = m.region();
+  o["host"] = m.host();
   return Json(std::move(o));
 }
 
@@ -382,6 +386,7 @@ QuorumMember member_from_json(const Json& j) {
   m.set_shrink_only(j.get_bool("shrink_only", false));
   m.set_force_reconfigure(j.get_bool("force_reconfigure", false));
   m.set_region(j.get_string("region", ""));
+  m.set_host(j.get_string("host", ""));
   return m;
 }
 
@@ -424,6 +429,9 @@ Json quorum_response_to_json(const ManagerQuorumResponse& r) {
   JsonArray regions;
   for (const auto& rg : r.replica_regions()) regions.push_back(rg);
   o["replica_regions"] = Json(std::move(regions));
+  JsonArray hostsj;
+  for (const auto& rh : r.replica_hosts()) hostsj.push_back(rh);
+  o["replica_hosts"] = Json(std::move(hostsj));
   return Json(std::move(o));
 }
 

@@ -613,17 +613,22 @@ void shm_churn(int iters, int world) {
   expect(ShmSegment::live_count() == base_live, "shm handles leaked");
 }
 
-// Two-tier collectives churn: W ranks with region labels reconfigure per
-// round — the labels ROTATE so region membership (and therefore
-// LEADERSHIP) moves across reconfigures — then run the hier ops per wire
-// plus a hier q8ef plan (the leader-carry path), under a chaos thread
-// that aborts rank 0 preferentially (a region leader in every rotation):
-// a dead leader must error every tier within the op deadline and the
-// next round's configure must revive the full topology. Clean rounds
-// assert exact sums on the native wire.
+// Hierarchical collectives churn: W ranks with region AND host labels
+// reconfigure per round — both label sets ROTATE so region membership,
+// host grouping (and therefore LEADERSHIP at both tiers) move across
+// reconfigures, exercising shared-memory ring creation/attachment/
+// teardown under churn — then run the hier ops per wire plus a hier
+// q8ef plan (the leader-carry path), under a chaos thread that aborts
+// rank 0 preferentially (a region leader in every rotation): a dead
+// leader must error every tier (including co-hosted shm peers, woken by
+// the poisoned ring magic) within the op deadline and the next round's
+// configure must revive the full topology. Clean rounds assert exact
+// sums on the native wire; the live-segment count is asserted back at
+// its baseline at the end (the churn leak oracle).
 void hier_collectives_churn(int rounds, int world, int stripes,
                             size_t elems) {
   if (world < 2) return;
+  const int64_t shm_base = ShmSegment::live_count();
   StoreServer store("[::]:0");
   std::string store_addr = "localhost:" + std::to_string(store.port());
 
@@ -663,12 +668,19 @@ void hier_collectives_churn(int rounds, int world, int stripes,
         for (auto& g : regions)
           if (g != regions[0]) two = true;
         if (!two) regions[world - 1] = "west";
+        // Host labels rotate on their own cadence: pairs co-host, and
+        // which ranks pair moves every round — shm rings are created,
+        // attached, poisoned (chaos aborts) and torn down continuously.
+        std::vector<std::string> hosts(world);
+        for (int64_t m = 0; m < world; m++)
+          hosts[m] = "hst" + std::to_string(((m + round) % world) / 2);
         std::string prefix = store_addr + "/hier/" + std::to_string(round);
         bool configured = false;
         for (int attempt = 0; attempt < 2 && !configured; attempt++) {
           try {
             hcs[r]->configure(prefix + "/" + std::to_string(attempt), r,
-                              world, 15000, stripes, regions, stripes);
+                              world, 15000, stripes, regions, stripes,
+                              hosts);
             configured = true;
           } catch (const std::exception&) {
             g_failed++;
@@ -732,6 +744,8 @@ void hier_collectives_churn(int rounds, int world, int stripes,
   stop = true;
   chaos.join();
   hcs.clear();
+  expect(ShmSegment::live_count() == shm_base,
+         "hier churn leaked shm ring segments");
   store.shutdown();
 }
 

@@ -147,6 +147,13 @@ def run_schedule(
     regions = (
         [f"r{i % 2}" for i in range(groups)] if config == "hier" else None
     )
+    # hier_shm: every replica group carries the SAME explicit host label
+    # (they really are co-hosted — one machine), so the data plane builds
+    # the shared-memory host tier and the shm_ring seam has real rings to
+    # poison. The live-segment count is the LEAK ORACLE: asserted back at
+    # its baseline after the fleet tears down, every round.
+    host_label = f"chaoshost_{seed}" if config == "hier_shm" else ""
+    shm_base = _native._lib.tft_shm_live_count() if host_label else 0
 
     def member_main(gid: int) -> None:
         store = Store()
@@ -182,6 +189,7 @@ def run_schedule(
             lighthouse_addr=lighthouse.address(),
             replica_id=f"chaos_{gid}",
             region=(regions[gid] if regions else ""),
+            host_label=host_label,
         )
         rec = records[gid]
         deadline = time.monotonic() + deadline_s
@@ -221,7 +229,7 @@ def run_schedule(
                     }
                     if config == "plan":
                         work = manager.plan_allreduce(grads)
-                    elif config == "hier":
+                    elif config in ("hier", "hier_shm"):
                         if manager.hier_capable():
                             work = manager.allreduce_hier(grads)
                         else:
@@ -286,6 +294,16 @@ def run_schedule(
 
     survivors = [r for r in records if r.alive]
     assert survivors, f"no member finished ({repro})"
+
+    # 0. SHM LEAK ORACLE (hier_shm fleets): every shared-memory ring
+    # segment the generations created must be gone once the fleet is
+    # down — chaos rounds must not leak handles.
+    if host_label:
+        live = _native._lib.tft_shm_live_count()
+        assert live == shm_base, (
+            f"shm segment handles leaked after the chaos round: "
+            f"{live - shm_base} live above baseline ({repro})"
+        )
 
     # 1. EPOCH PURITY. Per member, the committed (step -> quorum_id) map
     # must be monotonic (a step can never commit under an OLDER epoch
@@ -701,7 +719,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--plan", type=str, default=None,
                         help="replay an explicit plan JSON")
     parser.add_argument("--config", type=str, default="ddp",
-                        choices=("ddp", "plan", "hier", "policy"))
+                        choices=("ddp", "plan", "hier", "hier_shm",
+                                 "policy"))
     parser.add_argument("--seeds", type=int, default=3,
                         help="seeds per configuration for the full run")
     parser.add_argument("--out", default=os.path.join(REPO, "CHAOS_BENCH.json"))
@@ -720,20 +739,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     records: List[dict] = []
-    configs = ("ddp", "plan", "hier")
+    configs = ("ddp", "plan", "hier", "hier_shm")
     seed_base = int(os.environ.get("TORCHFT_CHAOS_SEED", "1000"))
     n_seeds = 1 if args.dryrun else args.seeds
 
-    config_salt = {"ddp": 0, "plan": 31, "hier": 62, "policy": 93}
-    for config in configs if not args.dryrun else ("plan",):
+    config_salt = {"ddp": 0, "plan": 31, "hier": 62, "hier_shm": 77,
+                   "policy": 93}
+    for config in configs if not args.dryrun else ("plan", "hier_shm"):
         for i in range(n_seeds):
             seed = seed_base + 17 * i + config_salt[config]
             t0 = time.monotonic()
+            # The co-hosted fleet draws from the shm_ring seam as well:
+            # drop-doorbell (stall to the op deadline), stale-payload
+            # (typed WireCorruption), torn-segment (poisoned ring magic)
+            # all must land in detection -> latch -> vote-discard ->
+            # reconfigure, with the leak oracle green after the round.
+            if config == "hier_shm":
+                seams = (
+                    ("shm_ring",) if args.dryrun
+                    else ("shm_ring", "ring_send")
+                )
+            elif args.dryrun:
+                seams = ("ring_send",)
+            else:
+                seams = ("ring_send", "ring_hdr", "net_send")
             rec = run_schedule(
                 seed, config,
-                seams=("ring_send",) if args.dryrun else (
-                    "ring_send", "ring_hdr", "net_send",
-                ),
+                seams=seams,
                 events_target=2 if args.dryrun else 3,
             )
             print(

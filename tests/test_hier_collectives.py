@@ -12,6 +12,7 @@ differs from the flat ring, so flat-vs-hier is tolerance-checked, never
 bit-compared.
 """
 
+import json
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -41,8 +42,10 @@ def store():
 
 
 def _make_ring(store, regions, prefix="h0", stripes=1, stripes_inter=None,
-               timeout=timedelta(seconds=20), world=None):
-    world = world if world is not None else len(regions)
+               timeout=timedelta(seconds=20), world=None, hosts=None):
+    world = world if world is not None else len(
+        regions if regions is not None else hosts
+    )
     cols = [
         HostCollectives(timeout=timeout, stripes=stripes,
                         stripes_inter=stripes_inter or 0)
@@ -51,7 +54,7 @@ def _make_ring(store, regions, prefix="h0", stripes=1, stripes_inter=None,
     addr = f"{store.address()}/{prefix}"
     with ThreadPoolExecutor(max_workers=world) as ex:
         for f in [
-            ex.submit(cols[r].configure, addr, r, world, regions)
+            ex.submit(cols[r].configure, addr, r, world, regions, hosts)
             for r in range(world)
         ]:
             f.result()
@@ -171,9 +174,19 @@ def _striped(bufs, eff, phase):
 
 
 def hier_oracle(datas, regions, stripes=1, stripes_inter=None, wire=None,
-                divisor=None, leader_ef_residuals=None, leaf_sizes=None):
-    """The full two-tier schedule in numpy; returns the per-member results
-    (bit-identical across members by construction, like the native op).
+                divisor=None, leader_ef_residuals=None, leaf_sizes=None,
+                hosts=None):
+    """The full hierarchical schedule in numpy; returns the per-member
+    results (bit-identical across members by construction, like the
+    native op).
+
+    ``hosts`` (optional, one label per rank) adds the THIRD tier: members
+    sharing a (region, host) pair first ring-reduce among themselves
+    (host rs + ag, the shm tier's arithmetic), the intra tier then spans
+    only HOST LEADERS, and the final adoption chain (member -> host
+    leader -> region leader) collapses to "every member adopts its
+    region leader's bytes" — the same adoption the two-tier oracle ends
+    with.
 
     ``leader_ef_residuals``: dict region->f32 carry array — enables the
     q8ef PLAN semantics (per-leaf EF applied to the REGION sum at the
@@ -188,10 +201,33 @@ def hier_oracle(datas, regions, stripes=1, stripes_inter=None, wire=None,
     esz = 1 if wire in ("q8", "q8ef") else 2 if wire == "bf16" else 4
     eff_inter = _effective_stripes(count * esz, stripes_inter)
 
+    if regions is None:
+        regions = [""] * len(datas)
     members = {}
     for r, g in enumerate(regions):
         members.setdefault(g, []).append(r)
     leaders = sorted(m[0] for m in members.values())
+
+    if hosts is not None:
+        # Host tier first: ring rs + ag within each (region, host) group
+        # (the host stripe partition is the intra one by construction).
+        host_groups = {}
+        for r in range(len(datas)):
+            host_groups.setdefault((regions[r], hosts[r]), []).append(r)
+        for mem in host_groups.values():
+            if len(mem) > 1:
+                sub = [bufs[r] for r in mem]
+                _striped(sub, eff_intra, _ring_rs)
+                _striped(sub, eff_intra, _ring_ag)
+        # The intra tier spans HOST LEADERS only.
+        members = {}
+        seen = set()
+        for r, g in enumerate(regions):
+            k = (g, hosts[r])
+            if k in seen:
+                continue
+            seen.add(k)
+            members.setdefault(g, []).append(r)
 
     # intra reduce-scatter + allgather (full precision, fast links)
     for mem in members.values():
@@ -799,3 +835,345 @@ class TestManagerRegionPlumbing:
             hc.shutdown()
             store.shutdown()
             lighthouse.shutdown()
+
+
+# ---- the shared-memory host (third) tier ----
+
+HOST_LAYOUTS = [
+    # (regions, hosts) — co-hosted pairs inside 2 regions
+    (["a", "a", "b", "b"], ["h0", "h0", "h1", "h1"]),
+    # uneven: a 3-member host group + a singleton + a pair
+    (["a", "a", "a", "b", "b"], ["h0", "h0", "h0", "h1", "h1"]),
+    # hosts straddle nothing: one host per region member (degenerates to
+    # the pure two-tier schedule — host tier world 1 everywhere)
+    (["a", "a", "b"], ["h0", "h1", "h2"]),
+    # single-region cohort grouped by host only (no inter tier at all)
+    (None, ["h0", "h0", "h1", "h1"]),
+]
+
+
+class TestShmTier:
+    """The zero-copy intra-host tier: shm rings below the region tiers,
+    bit-identity pinned against the three-tier numpy oracle, the
+    loopback-TCP fallback as the control, and the segment-lifecycle /
+    abort contracts."""
+
+    def _live(self):
+        from torchft_tpu._native import _lib
+
+        return int(_lib.tft_shm_live_count())
+
+    @pytest.mark.parametrize("layout", HOST_LAYOUTS)
+    @pytest.mark.parametrize("wire", [None, "bf16", "q8"])
+    def test_bit_identity_against_three_tier_oracle(self, store, layout,
+                                                    wire):
+        regions, hosts = layout
+        W = len(hosts)
+        rng = np.random.default_rng(11)
+        datas = [
+            (rng.standard_normal(997) * (r + 1)).astype(np.float32)
+            for r in range(W)
+        ]
+        expect = hier_oracle(datas, regions, wire=wire, hosts=hosts)
+        cols = _make_ring(store, regions, prefix=f"shm_{wire}", hosts=hosts)
+        res = _run_all(
+            cols,
+            lambda r, c: c.allreduce_hier(datas[r].copy(), wire=wire).wait(),
+        )
+        for r in range(W):
+            np.testing.assert_array_equal(
+                np.asarray(res[r]), expect[r],
+                err_msg=f"rank {r} diverged from the three-tier oracle",
+            )
+        for c in cols:
+            c.shutdown()
+
+    def test_multi_stripe_three_tier_matches_oracle(self, store):
+        regions, hosts = ["a", "a", "b", "b"], ["h0", "h0", "h1", "h1"]
+        rng = np.random.default_rng(13)
+        # > 2 * 16384 f32 elements so effective_stripes picks 2
+        datas = [
+            (rng.standard_normal(40_000) * (r + 1)).astype(np.float32)
+            for r in range(4)
+        ]
+        expect = hier_oracle(datas, regions, stripes=2, wire="q8",
+                             hosts=hosts)
+        cols = _make_ring(store, regions, prefix="shm_s2", stripes=2,
+                          hosts=hosts)
+        res = _run_all(
+            cols,
+            lambda r, c: c.allreduce_hier(datas[r].copy(), wire="q8").wait(),
+        )
+        for r in range(4):
+            np.testing.assert_array_equal(np.asarray(res[r]), expect[r])
+        for c in cols:
+            c.shutdown()
+
+    def test_tcp_fallback_matches_shm_bit_for_bit(self, store, monkeypatch):
+        # TORCHFT_HC_SHM=0: same geometry over loopback TCP. The schedule
+        # (and therefore every bit) must be identical — transport is not
+        # arithmetic.
+        regions, hosts = None, ["h0", "h0", "h1", "h1"]
+        rng = np.random.default_rng(17)
+        datas = [
+            (rng.standard_normal(997) * (r + 1)).astype(np.float32)
+            for r in range(4)
+        ]
+        cols = _make_ring(store, regions, prefix="shm_on", hosts=hosts)
+        assert [c.host_tier_transport() for c in cols] == ["shm"] * 4
+        res_shm = _run_all(
+            cols, lambda r, c: c.allreduce_hier(datas[r].copy()).wait()
+        )
+        for c in cols:
+            c.shutdown()
+
+        monkeypatch.setenv("TORCHFT_HC_SHM", "0")
+        cols = _make_ring(store, regions, prefix="tcp_fb", hosts=hosts)
+        assert [c.host_tier_transport() for c in cols] == ["tcp"] * 4
+        res_tcp = _run_all(
+            cols, lambda r, c: c.allreduce_hier(datas[r].copy()).wait()
+        )
+        for r in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(res_shm[r]), np.asarray(res_tcp[r])
+            )
+        # and both match the oracle
+        expect = hier_oracle(datas, regions, hosts=hosts)
+        np.testing.assert_array_equal(np.asarray(res_tcp[0]), expect[0])
+        for c in cols:
+            c.shutdown()
+
+    def test_hosts_only_cohort_is_hier_capable(self, store):
+        # No region labels at all: >= 2 co-hosted members still make the
+        # hierarchical schedule available (host rings + a host-leader
+        # ring are two real tiers).
+        cols = _make_ring(store, None, prefix="honly",
+                          hosts=["h0", "h0", "h1"])
+        assert all(c.hier_capable() for c in cols)
+        assert cols[0].host_tier_transport() == "shm"
+        assert cols[2].host_tier_transport() == "none"  # singleton host
+        res = _run_all(
+            cols,
+            lambda r, c: c.allreduce_hier(
+                np.full(64, float(r + 1), np.float32)
+            ).wait(),
+        )
+        for r in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(res[r]), np.full(64, 6.0, np.float32)
+            )
+        for c in cols:
+            c.shutdown()
+
+    def test_plan_q8ef_carry_matches_three_tier_oracle(self, store):
+        # The leader-side EF carry discipline is UNCHANGED by the host
+        # tier: the region leader quantizes the region sum (which now
+        # includes the host-tier reduction) against its persistent
+        # residual before the inter hop.
+        regions, hosts = ["a", "a", "b", "b"], ["h0", "h0", "h1", "h1"]
+        rng = np.random.default_rng(23)
+        leaf_sizes = [300, 197]
+        count = sum(leaf_sizes)
+        cols = _make_ring(store, regions, prefix="shm_ef", hosts=hosts)
+        residuals = {g: np.zeros(count, F32) for g in ("a", "b")}
+        for it in range(3):
+            datas = [
+                (rng.standard_normal(count) * (r + 1) * (it + 1)).astype(
+                    np.float32
+                )
+                for r in range(4)
+            ]
+            expect = hier_oracle(
+                datas, regions, wire="q8ef", hosts=hosts,
+                leader_ef_residuals=residuals, leaf_sizes=leaf_sizes,
+            )
+            res = _run_all(
+                cols,
+                lambda r, c: c.plan_allreduce(
+                    {"a": datas[r][:300].copy(), "b": datas[r][300:].copy()},
+                    ReduceOp.SUM, wire="q8ef", hier=True,
+                ).wait(),
+            )
+            for r in range(4):
+                got = np.concatenate(
+                    [np.asarray(res[r]["a"]), np.asarray(res[r]["b"])]
+                )
+                np.testing.assert_array_equal(
+                    got, expect[r], err_msg=f"iter {it} rank {r}"
+                )
+        for c in cols:
+            c.shutdown()
+
+    def test_segments_owned_by_configure_generation(self, store):
+        base = self._live()
+        hosts = ["h0", "h0"]
+        cols = _make_ring(store, None, prefix="gen0", world=2, hosts=hosts)
+        # 2 members x 1 stripe x (1 tx + 1 rx) handles
+        assert self._live() == base + 4
+        # reconfigure under a fresh prefix: old generation torn down, new
+        # one stands — the count must not grow
+        addr = f"{store.address()}/gen1"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            for f in [
+                ex.submit(cols[r].configure, addr, r, 2, None, hosts)
+                for r in range(2)
+            ]:
+                f.result()
+        assert self._live() == base + 4
+        # reconfigure WITHOUT hosts: the host tier (and every segment)
+        # must be gone
+        addr = f"{store.address()}/gen2"
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            for f in [
+                ex.submit(cols[r].configure, addr, r, 2) for r in range(2)
+            ]:
+                f.result()
+        assert self._live() == base
+        for c in cols:
+            c.shutdown()
+
+    def test_cohosted_abort_wakes_peer_within_deadline(self, store):
+        # One co-hosted member aborts mid-collective: its peers must
+        # error promptly (the poisoned ring magic is the shm FIN), not
+        # wait out a long deadline.
+        hosts = ["h0", "h0", "h0"]
+        cols = _make_ring(store, None, prefix="abrt", world=3, hosts=hosts,
+                          timeout=timedelta(seconds=60))
+        data = np.ones(1 << 20, np.float32)
+        start = time.perf_counter()
+        errs = []
+
+        def run(r, c):
+            if r == 2:
+                time.sleep(0.15)
+                c.abort()
+                return "aborted"
+            try:
+                return c.allreduce_hier(data.copy()).wait()
+            except Exception as e:  # noqa: BLE001
+                errs.append((r, e, time.perf_counter() - start))
+                return None
+
+        _run_all(cols, run)
+        assert len(errs) == 2, "both survivors must error"
+        for _, _, dt in errs:
+            assert dt < 30.0, f"survivor blocked {dt:.1f}s (deadline leak)"
+        for c in cols:
+            c.shutdown()
+
+    def test_stale_frame_detected_as_wire_corruption(self, store):
+        # The shm_ring bit_flip fault replays a stale frame sequence; the
+        # consumer must surface the typed WireCorruption verdict (the
+        # latch -> vote-discard contract), never reduce yesterday's bytes.
+        from torchft_tpu._native import WireCorruption, _lib
+
+        hosts = ["h0", "h0"]
+        cols = _make_ring(store, None, prefix="stale", world=2, hosts=hosts,
+                          timeout=timedelta(seconds=15))
+        plan = {
+            "seed": 7,
+            "rules": [{
+                "seam": "shm_ring", "kind": "bit_flip", "member": 0,
+                "min_op": 0, "max_op": -1, "permille": 1000, "one_shot": 1,
+            }],
+        }
+        _lib.tft_fault_arm(json.dumps(plan).encode())
+        try:
+            errs = []
+
+            def run(r, c):
+                try:
+                    return c.allreduce_hier(
+                        np.ones(256, np.float32)
+                    ).wait()
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return None
+
+            _run_all(cols, run)
+            assert errs, "the stale frame went undetected"
+            assert any(
+                isinstance(e, WireCorruption)
+                or "stale frame" in str(e)
+                for e in errs
+            ), f"wrong verdict: {errs}"
+        finally:
+            _lib.tft_fault_disarm()
+        for c in cols:
+            c.shutdown()
+
+
+class TestManagerHostPlumbing:
+    def test_host_label_flows_quorum_to_shm_tier(self, monkeypatch):
+        # TORCHFT_HOST rides QuorumMember like region does: two co-hosted
+        # replica groups (same explicit host label) come back in
+        # replica_hosts, Manager.configure hands the map to the data
+        # plane, and the shm host tier stands up end to end.
+        from torchft_tpu import Lighthouse, Manager
+
+        monkeypatch.setenv("TORCHFT_HOST", "testhost0")
+        lighthouse = Lighthouse(min_replicas=2, join_timeout_ms=100)
+        results = {}
+        errors = []
+        barrier = threading.Barrier(2)
+
+        def replica(idx):
+            store = Store()
+            hc = HostCollectives(timeout=timedelta(seconds=20))
+            manager = None
+            try:
+                state_box = {"params": 0}
+                manager = Manager(
+                    collectives=hc,
+                    # Step-0 initial weight sync: the non-primary replica
+                    # heals from the primary, so real callbacks are needed.
+                    load_state_dict=lambda s: state_box.update(s),
+                    state_dict=lambda: dict(state_box),
+                    min_replica_size=2,
+                    use_async_quorum=False,
+                    rank=0,
+                    world_size=1,
+                    store_addr=store.address(),
+                    lighthouse_addr=lighthouse.address(),
+                    replica_id=f"hostplumb{idx}",
+                    timeout=timedelta(seconds=20),
+                    quorum_timeout=timedelta(seconds=20),
+                )
+                barrier.wait(timeout=20)
+                manager.start_quorum()
+                tree = {"g": np.full(64, float(idx + 1), np.float32)}
+                out = manager.allreduce_hier(tree).wait()
+                committed = manager.should_commit()
+                results[idx] = {
+                    "hosts": manager.replica_hosts(),
+                    "hier_capable": manager.hier_capable(),
+                    "transport": hc.host_tier_transport(),
+                    "avg": np.asarray(out["g"]).copy(),
+                    "committed": committed,
+                }
+            except Exception as e:  # noqa: BLE001
+                errors.append((idx, e))
+            finally:
+                if manager is not None:
+                    manager.shutdown()
+                hc.shutdown()
+                store.shutdown()
+
+        threads = [
+            threading.Thread(target=replica, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lighthouse.shutdown()
+        assert not errors, errors
+        for idx in (0, 1):
+            r = results[idx]
+            assert r["hosts"] == ["testhost0"] * 2
+            assert r["hier_capable"]
+            assert r["transport"] == "shm"
+            assert r["committed"]
+            # AVG of 1.0 and 2.0 across the two co-hosted groups
+            np.testing.assert_allclose(r["avg"], np.full(64, 1.5), rtol=1e-6)
+        np.testing.assert_array_equal(results[0]["avg"], results[1]["avg"])

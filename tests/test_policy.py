@@ -230,17 +230,17 @@ class TestDecisionRules:
         eng = self._engine()
         k = len(eng._candidates)
 
-        def vec(ok, compute, bw, churn):
+        def vec(ok, compute, bw, churn, intra=0.0, inter=0.0):
             return np.asarray(
-                [ok, compute, bw, churn, 0.001, 0.1, 0.0]
+                [ok, compute, bw, churn, 0.001, 0.1, 0.0, intra, inter]
                 + [1.0] * k + [0.0] * k,
                 np.float64,
             )
 
         agg = eng._aggregate(
             [
-                vec(1.0, 0.01, 100.0, 0.0),
-                vec(1.0, 0.02, 10.0, 2.0),
+                vec(1.0, 0.01, 100.0, 0.0, intra=800.0, inter=12.0),
+                vec(1.0, 0.02, 10.0, 2.0, intra=400.0),  # inter unmeasured
                 vec(0.0, 0.0, 0.0, 0.0),  # healing/spare: zeroed, excluded
             ]
         )
@@ -248,6 +248,9 @@ class TestDecisionRules:
         assert agg["wire_eff_MBps"] == 10.0  # bottleneck link
         assert agg["churn_per_min"] == 2.0  # worst churn
         assert agg["world"] == 2.0
+        # per-tier bottleneck: min over MEASURED (non-zero) entries only
+        assert agg["tier_intra_MBps"] == 400.0
+        assert agg["tier_inter_MBps"] == 12.0
 
     def test_backstop_sentinels_incumbent_and_falls_to_base(self):
         class _M:
@@ -574,3 +577,101 @@ class TestVotedTransition:
         later = [d for d in a["decisions"][1:] if d["switched"]]
         assert later, "a later clean decision should complete the switch"
         np.testing.assert_array_equal(a["params"], b["params"])
+
+
+class TestPerTierPricing:
+    """Satellite of the shm-tier PR: hier candidates are priced on the
+    BOTTLENECK tier's measured bandwidth, not the folded flat average."""
+
+    def test_hier_spec_validation(self):
+        with pytest.raises(ValueError, match="plan transport"):
+            StrategySpec("x", "ddp", hier=True)
+        with pytest.raises(ValueError, match="localsgd"):
+            StrategySpec("x", "localsgd", sync_every=8, hier=True)
+        spec = StrategySpec("x", "ddp", transport="plan", hier=True)
+        assert spec.hier
+
+    def test_topology_labeled_ladder_gains_hier_candidate(self):
+        names = [c.name for c in default_candidates()]
+        assert "ddp_plan_hier" not in names  # unlabeled: exact old ladder
+        names = [c.name for c in default_candidates(topology_labeled=True)]
+        assert "ddp_plan_hier" in names
+        assert names.index("ddp_plan_hier") == names.index("ddp_plan") + 1
+
+    def test_hier_cost_prices_bottleneck_tier_not_folded_average(self):
+        knobs = CostKnobs(staleness_weight=0.0, sync_fixed_s=0.0)
+        model = 8 * (1 << 20)
+        sig = dict(
+            compute_s=0.01, churn_per_min=0.0, ctrl_s=0.0, reconf_s=0.0,
+            heal_s=0.0, world=4.0, model_bytes=float(model),
+            # Folded flat average is FAST (the shm tier inflates it)...
+            wire_eff_MBps=500.0,
+            # ...but the inter tier is the real bottleneck.
+            tier_intra_MBps=400.0,
+            tier_inter_MBps=10.0,
+        )
+        flat = StrategySpec("ddp_plan", "ddp", transport="plan")
+        hier = StrategySpec("h", "ddp", transport="plan", hier=True)
+        c_flat = strategy_cost(flat, sig, knobs)
+        c_hier = strategy_cost(hier, sig, knobs)
+        # flat priced on the folded average: 8 MB / 500 MBps = 16 ms
+        assert c_flat == pytest.approx(0.01 + 8 / 500.0, rel=1e-6)
+        # hier priced on max(inter leg, intra leg):
+        #   inter: 8 MB / 10 MBps = 0.8 s; intra: 16 MB / 400 = 40 ms
+        assert c_hier == pytest.approx(0.01 + 8 / 10.0, rel=1e-6)
+        # a q8 hier wire compresses the bottleneck leg 4x; the intra leg
+        # (full width) now competes but inter still bounds
+        hier_q8 = StrategySpec(
+            "hq", "ddp", transport="plan", hier=True, wire="q8",
+        )
+        c_q8 = strategy_cost(hier_q8, sig, knobs)
+        assert c_q8 == pytest.approx(0.01 + 2 / 10.0, rel=1e-6)
+        # unmeasured tiers: hier falls back to the flat pricing
+        sig2 = dict(sig, tier_intra_MBps=0.0, tier_inter_MBps=0.0)
+        assert strategy_cost(hier, sig2, knobs) == pytest.approx(
+            c_flat, rel=1e-6
+        )
+
+    def test_manager_folds_tier_stats_into_signals(self):
+        from torchft_tpu.manager import Manager
+
+        class _FakeTierCollectives:
+            def __init__(self):
+                self._stats = [{
+                    "op": "allreduce_hier",
+                    "ring": 0.5,
+                    "wire_bytes": 4 << 20,
+                    "tiers": {
+                        "host": {"tx_bytes": 0, "shm_bytes": 32 << 20,
+                                 "rs_s": 0.004, "ag_s": 0.004,
+                                 "bcast_s": 0.008, "world": 4, "eff": 1,
+                                 "leader": True, "transport": "shm"},
+                        "intra": {"tx_bytes": 8 << 20, "rs_s": 0.05,
+                                  "ag_s": 0.05, "bcast_s": 0.06,
+                                  "world": 2, "eff": 1},
+                        "inter": {"tx_bytes": 4 << 20, "ring_s": 0.4,
+                                  "world": 2, "eff": 1, "leader": True},
+                    },
+                }]
+
+            def pop_op_stats(self):
+                out, self._stats = self._stats, []
+                return out
+
+        mgr = Manager.__new__(Manager)  # signals-path state only
+        from torchft_tpu.metrics import Metrics
+
+        mgr._collectives = _FakeTierCollectives()
+        mgr._metrics = Metrics()
+        mgr._last_wire_eff_mbps = None
+        mgr._last_tier_mbps = {}
+        mgr._checkpoint_transport = object()
+        entries = mgr.observe_op_stats()
+        assert len(entries) == 1
+        sig = mgr.signals()
+        tiers = sig["tier_eff_MBps"]
+        # host: 32 MiB over 16 ms = 2000 MB/s; intra: 8 MiB / 0.16 s =
+        # 50 MB/s; inter: 4 MiB / 0.4 s = 10 MB/s
+        assert tiers["host"] == pytest.approx(2000.0, rel=0.01)
+        assert tiers["intra"] == pytest.approx(50.0, rel=0.01)
+        assert tiers["inter"] == pytest.approx(10.0, rel=0.01)

@@ -119,6 +119,7 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_char_p,  # root fallback addr ("" = none)
         ctypes.c_int64,   # lease ttl ms (<=0 = lighthouse default)
         ctypes.c_char_p,  # region label ("" = unlabeled)
+        ctypes.c_char_p,  # host label ("" = unlabeled)
     ]
     lib.tft_manager_address.restype = ctypes.c_void_p
     lib.tft_manager_address.argtypes = [ctypes.c_void_p]
@@ -290,9 +291,16 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,  # stripes (flat + intra tiers)
         ctypes.c_int64,  # stripes_inter (<=0 = stripes)
         ctypes.c_char_p,  # regions JSON array (one label per rank; "" = flat)
+        ctypes.c_char_p,  # hosts JSON array (one label per rank; "" = none)
     ]
     lib.tft_hc_hier_capable.restype = ctypes.c_int64
     lib.tft_hc_hier_capable.argtypes = [ctypes.c_void_p]
+    # Host-tier transport of the last configure: 0 none, 1 loopback TCP
+    # (TORCHFT_HC_SHM=0), 2 shared-memory rings.
+    lib.tft_hc_host_tier_transport.restype = ctypes.c_int64
+    lib.tft_hc_host_tier_transport.argtypes = [ctypes.c_void_p]
+    lib.tft_hc_release.restype = ctypes.c_int
+    lib.tft_hc_release.argtypes = [ctypes.c_void_p]
     lib.tft_hc_allreduce_hier.restype = ctypes.c_int
     lib.tft_hc_allreduce_hier.argtypes = [
         ctypes.c_void_p,
@@ -588,6 +596,10 @@ class QuorumResult:
     # What Manager.configure hands the data plane for the two-tier
     # collective schedule.
     replica_regions: List[str] = field(default_factory=list)
+    # Host label of EVERY participant, same indexing/emptiness contract:
+    # (region, host) groups are what the data plane compiles into the
+    # shared-memory intra-host ring tier.
+    replica_hosts: List[str] = field(default_factory=list)
 
     @classmethod
     def _from_json(cls, raw: str) -> "QuorumResult":
@@ -605,6 +617,7 @@ class QuorumResult:
             max_world_size=d["max_world_size"],
             heal=d["heal"],
             replica_regions=list(d.get("replica_regions", [])),
+            replica_hosts=list(d.get("replica_hosts", [])),
         )
 
 
@@ -800,6 +813,7 @@ class Manager:
         root_addr: str = "",
         lease_ttl: Optional[timedelta] = None,
         region: str = "",
+        host: str = "",
     ) -> None:
         """``lighthouse_addr`` is this group's assigned lighthouse (the
         flat/root service, or a REGION lighthouse under a hierarchical
@@ -811,7 +825,9 @@ class Manager:
         ("" = unlabeled) is the group's topology label: it rides the
         quorum requester into every member's QuorumMember, and the quorum
         result's region map is what the data plane compiles into the
-        two-tier collective schedule."""
+        two-tier collective schedule. ``host`` ("" = unlabeled) rides the
+        same way: the quorum's host map is what groups co-hosted members
+        into the shared-memory intra-host tier."""
         self._handle = _lib.tft_manager_create(
             replica_id.encode(),
             lighthouse_addr.encode(),
@@ -824,6 +840,7 @@ class Manager:
             root_addr.encode(),
             _ms(lease_ttl) if lease_ttl is not None else 0,
             region.encode(),
+            host.encode(),
         )
         if not self._handle:
             _check(2)

@@ -24,6 +24,8 @@ class QuorumResult:
     max_rank: Optional[int]
     max_world_size: int
     heal: bool
+    replica_regions: List[str]
+    replica_hosts: List[str]
 
     def __init__(
         self,
@@ -38,6 +40,8 @@ class QuorumResult:
         max_rank: Optional[int] = ...,
         max_world_size: int = ...,
         heal: bool = ...,
+        replica_regions: List[str] = ...,
+        replica_hosts: List[str] = ...,
     ) -> None: ...
 
 
@@ -110,6 +114,8 @@ class Manager:
         connect_timeout: timedelta = ...,
         root_addr: str = ...,
         lease_ttl: Optional[timedelta] = ...,
+        region: str = ...,
+        host: str = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def using_root_fallback(self) -> bool: ...
@@ -249,7 +255,8 @@ class _NativeLib:
         connect_timeout_ms: int,
         root_addr: bytes,
         lease_ttl_ms: int,
-        region: bytes
+        region: bytes,
+        host: bytes
     ) -> Any: ...
     def tft_manager_address(self, handle: Any) -> Any: ...
     def tft_manager_shutdown(self, handle: Any) -> None: ...
@@ -345,9 +352,12 @@ class _NativeLib:
         timeout_ms: int,
         stripes: int,
         stripes_inter: int,
-        regions_json: bytes
+        regions_json: bytes,
+        hosts_json: bytes
     ) -> int: ...
     def tft_hc_hier_capable(self, handle: Any) -> int: ...
+    def tft_hc_host_tier_transport(self, handle: Any) -> int: ...
+    def tft_hc_release(self, handle: Any) -> int: ...
     def tft_hc_allreduce_hier(
         self,
         handle: Any,

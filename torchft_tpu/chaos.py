@@ -12,7 +12,8 @@ ad-hoc SIGKILLs:
   and serialized as JSON, so any failing schedule reproduces
   byte-for-byte from the ``(seed, plan)`` printed in a failure message.
 - :class:`ChaosInjector` drives a plan against a live member: native
-  seams (``ring_send``/``ring_hdr``/``net_send``) arm one-shot rules in
+  seams (``ring_send``/``ring_hdr``/``net_send``/``shm_ring``) arm
+  one-shot rules in
   the C++ fault engine per step (see native/src/fault.h); Python seams
   (``store``/``heal``/``child``/``shm``) are realized by the injector
   wrappers below.
@@ -47,7 +48,7 @@ _MASK = (1 << 64) - 1
 
 # Seams a plan may name. The native engine owns the first three; the
 # rest are realized Python-side by the injectors in this module.
-NATIVE_SEAMS = ("ring_send", "ring_hdr", "net_send")
+NATIVE_SEAMS = ("ring_send", "ring_hdr", "net_send", "shm_ring")
 PYTHON_SEAMS = ("store", "heal", "child", "shm", "lighthouse")
 SEAMS = NATIVE_SEAMS + PYTHON_SEAMS
 
@@ -57,6 +58,13 @@ SEAM_KINDS: Dict[str, Tuple[str, ...]] = {
     "ring_send": ("drop", "delay", "truncate", "duplicate", "bit_flip",
                   "partition"),
     "ring_hdr": ("bit_flip", "drop"),
+    # The host tier's shared-memory rings (native/src/collectives.cc
+    # shm_duplex): drop = drop-doorbell (every publish of the op
+    # silently vanishes — an asymmetric partition; the consumer stalls
+    # to its op deadline), bit_flip = stale-payload (a replayed frame
+    # sequence, detected as WireCorruption), truncate = torn-segment
+    # (half a frame + poisoned ring magic).
+    "shm_ring": ("drop", "delay", "truncate", "bit_flip"),
     "net_send": ("drop", "delay", "truncate", "bit_flip"),
     "store": ("drop", "delay", "stale"),
     "heal": ("truncate_body", "reset_mid_range", "slow_loris", "error_500",

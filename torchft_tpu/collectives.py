@@ -207,6 +207,7 @@ class Collectives(ABC):
         rank: int,
         world_size: int,
         regions: Optional[Sequence[str]] = None,
+        hosts: Optional[Sequence[str]] = None,
     ) -> None:
         """(Re)builds the communicator for a new membership. ``store_addr``
         is ``host:port/prefix`` with a prefix unique to the quorum.
@@ -216,11 +217,18 @@ class Collectives(ABC):
         compile it into a two-tier schedule when every member is labeled
         and >= 2 regions are present; every other backend accepts and
         ignores it (the kwarg is part of the reconfigure contract so the
-        manager can hand the map to whichever plane it drives)."""
+        manager can hand the map to whichever plane it drives).
+
+        ``hosts`` (optional): one host label per rank — the quorum's host
+        map (``TORCHFT_HOST``, default hostname). The host ring groups
+        members sharing a (region, host) pair into the SHARED-MEMORY
+        intra-host ring tier (loopback TCP under ``TORCHFT_HC_SHM=0``);
+        every other backend accepts and ignores it."""
 
     def hier_capable(self) -> bool:
-        """Whether the LAST configure built a topology-aware (two-tier)
-        schedule — i.e. a region map with >= 2 distinct labels reached a
+        """Whether the LAST configure built a topology-aware
+        (hierarchical) schedule — a region map with >= 2 distinct labels
+        and/or a host map grouping >= 2 co-hosted members reached a
         backend that compiles one. Backends without the capability return
         False; callers feature-detect (the plan_hier probe candidate's
         sentinel discipline rides this)."""
@@ -899,13 +907,15 @@ class HostCollectives(OpStatsMixin, Collectives):
         rank: int,
         world_size: int,
         regions: Optional[Sequence[str]] = None,
+        hosts: Optional[Sequence[str]] = None,
     ) -> None:
         # Abort synchronously so a wedged op can't block the executor, then
         # run the (blocking) rendezvous on the op thread to keep ordering.
         _lib.tft_hc_abort(self._handle)
-        # The region map is part of the schedule contract (it decides
-        # which tiers exist and who leads them); normalize it here so the
-        # negotiated fingerprint below and the native build see one form.
+        # The region and host maps are part of the schedule contract (they
+        # decide which tiers exist and who leads them); normalize them
+        # here so the negotiated fingerprint below and the native build
+        # see one form.
         region_list: List[str] = (
             [str(r) for r in regions] if regions else []
         )
@@ -914,8 +924,26 @@ class HostCollectives(OpStatsMixin, Collectives):
                 f"regions must carry one label per rank "
                 f"({len(region_list)} labels for world_size {world_size})"
             )
+        host_list: List[str] = [str(h) for h in hosts] if hosts else []
+        if host_list and len(host_list) != world_size:
+            raise ValueError(
+                f"hosts must carry one label per rank "
+                f"({len(host_list)} labels for world_size {world_size})"
+            )
         stripes_inter = (
             self._stripes_inter if self._stripes_inter > 0 else self._stripes
+        )
+        # The shm knobs are schedule-relevant for co-hosted members (the
+        # producer and consumer of one ring must agree on transport and
+        # capacity), so they ride the negotiated fingerprint like every
+        # other knob. Snapshotted here; the native side re-reads the env
+        # at configure, so the two stay in step.
+        shm_on = os.environ.get("TORCHFT_HC_SHM", "").lower() not in (
+            "0", "off", "false",
+        )
+        shm_ring = max(
+            int(os.environ.get("TORCHFT_HC_SHM_RING_BYTES", str(1 << 20))),
+            4096,
         )
 
         def do_configure() -> None:
@@ -943,6 +971,19 @@ class HostCollectives(OpStatsMixin, Collectives):
                     f":{self._stripes}:{stripes_inter}"
                     f":{','.join(region_list)}"
                     + (":crc1" if self._wire_crc else "")
+                    # Appended ONLY when the host map is USABLE (every
+                    # rank labeled — the native hosts_labeled rule): a
+                    # partially labeled map (mixed-version fleet, some
+                    # members pre-host-PR) builds no host tier, so the
+                    # knobs are schedule-irrelevant there and appending
+                    # them would break interop with un-upgraded peers
+                    # for nothing. Fully unlabeled fleets keep the exact
+                    # pre-host fingerprint.
+                    + (
+                        f":hosts={','.join(host_list)}"
+                        f":shm{1 if shm_on else 0}:{shm_ring}"
+                        if host_list and all(host_list) else ""
+                    )
                 )
                 key = f"{prefix}/pipecfg" if prefix else "pipecfg"
                 if rank == 0:
@@ -971,6 +1012,8 @@ class HostCollectives(OpStatsMixin, Collectives):
                     stripes_inter,
                     json.dumps(region_list).encode()
                     if region_list else b"",
+                    json.dumps(host_list).encode()
+                    if host_list else b"",
                 )
             )
             # Assign on the op thread: ops queued after this configure see
@@ -1028,6 +1071,10 @@ class HostCollectives(OpStatsMixin, Collectives):
         self._shutdown = True
         _lib.tft_hc_abort(self._handle)
         self._executor.shutdown(wait=True)
+        # Deterministic ring teardown (sockets, listener, shm segments):
+        # named kernel resources must not live until garbage collection
+        # gets around to the handle.
+        _lib.tft_hc_release(self._handle)
 
     def __del__(self) -> None:
         handle = getattr(self, "_handle", None)
@@ -1377,10 +1424,19 @@ class HostCollectives(OpStatsMixin, Collectives):
     # -- two-tier (topology-aware) ops --
 
     def hier_capable(self) -> bool:
-        """Whether the last configure() received a usable region map (>= 2
-        distinct labels, every rank labeled) and built the two-tier
-        topology alongside the flat ring."""
+        """Whether the last configure() received a usable topology map —
+        a region map with >= 2 distinct labels and/or a host map grouping
+        >= 2 co-hosted ranks — and built the hierarchical topology
+        alongside the flat ring."""
         return bool(_lib.tft_hc_hier_capable(self._handle))
+
+    def host_tier_transport(self) -> str:
+        """Transport of the host (intra-host) tier after the last
+        configure: ``"shm"`` (shared-memory rings), ``"tcp"`` (the
+        ``TORCHFT_HC_SHM=0`` loopback fallback) or ``"none"`` (this
+        member's (region, host) group has < 2 ranks)."""
+        code = int(_lib.tft_hc_host_tier_transport(self._handle))
+        return {0: "none", 1: "tcp", 2: "shm"}[code]
 
     def _last_hier_dict(self) -> dict:
         out = ctypes.c_void_p()
@@ -1394,8 +1450,13 @@ class HostCollectives(OpStatsMixin, Collectives):
         per-connection counters, summed) — ONE schema, so consumers
         (bench accounting, diagnosis tooling) never see the two paths
         drift."""
-        return {
-            "wire_bytes": h["intra_tx_bytes"] + h["inter_tx_bytes"],
+        out = {
+            # The wire bill: MEASURED socket traffic only. The shm host
+            # tier hands nothing to the kernel, so its hops contribute 0
+            # here by construction (host_tx_bytes is non-zero only under
+            # the TORCHFT_HC_SHM=0 TCP fallback).
+            "wire_bytes": h["intra_tx_bytes"] + h["inter_tx_bytes"]
+            + h["host_tx_bytes"],
             "intra_rs_s": h["intra_rs_s"],
             "intra_ag_s": h["intra_ag_s"],
             "inter_ring_s": h["inter_ring_s"],
@@ -1420,6 +1481,25 @@ class HostCollectives(OpStatsMixin, Collectives):
                 },
             },
         }
+        if h.get("host_world", 0) > 1:
+            # The third (intra-host) tier, present only on co-hosted
+            # cohorts: shm_* phase keys + the honest byte split (tx_bytes
+            # = kernel traffic, 0 under shm; shm_bytes = ring movement).
+            out["shm_rs_s"] = h["shm_rs_s"]
+            out["shm_ag_s"] = h["shm_ag_s"]
+            out["shm_bcast_s"] = h["shm_bcast_s"]
+            out["tiers"]["host"] = {
+                "tx_bytes": h["host_tx_bytes"],
+                "shm_bytes": h["shm_bytes"],
+                "world": h["host_world"],
+                "eff": h["eff_host"],
+                "rs_s": h["shm_rs_s"],
+                "ag_s": h["shm_ag_s"],
+                "bcast_s": h["shm_bcast_s"],
+                "leader": h["host_leader"],
+                "transport": "shm" if h["host_shm"] else "tcp",
+            }
+        return out
 
     @staticmethod
     def _merge_hier_stats(acc: Optional[dict], h: dict) -> dict:
@@ -1431,6 +1511,8 @@ class HostCollectives(OpStatsMixin, Collectives):
             "intra_rs_s", "intra_ag_s", "inter_ring_s", "intra_bcast_s",
             "intra_tx_bytes", "inter_tx_bytes", "inter_rs_tx_bytes",
             "inter_ag_tx_bytes", "payload_bytes",
+            "shm_rs_s", "shm_ag_s", "shm_bcast_s", "host_tx_bytes",
+            "shm_bytes",
         ):
             acc[k] += h[k]
         return acc
@@ -2492,6 +2574,7 @@ class DummyCollectives(Collectives):
         self.configure_count = 0
         self.op_count = 0
         self.last_regions: Optional[List[str]] = None
+        self.last_hosts: Optional[List[str]] = None
         self._hier = False
 
     def configure(
@@ -2500,19 +2583,30 @@ class DummyCollectives(Collectives):
         rank: int,
         world_size: int,
         regions: Optional[Sequence[str]] = None,
+        hosts: Optional[Sequence[str]] = None,
     ) -> None:
         self.configure_count += 1
         self._rank = rank
         self._world_size = world_size
         self.last_regions = list(regions) if regions else None
+        self.last_hosts = list(hosts) if hosts else None
         # Mirror the host ring's capability rule so wrapper-semantics
-        # tests can drive the hier dispatch paths without a real ring.
-        self._hier = bool(
+        # tests can drive the hier dispatch paths without a real ring:
+        # multi-region, or a (region, host) pair grouping >= 2 ranks.
+        multi_region = bool(
             regions
             and len(set(regions)) >= 2
             and all(regions)
             and world_size > 1
         )
+        host_grouped = False
+        if hosts and all(hosts) and world_size > 1:
+            keys = [
+                ((regions[i] if regions and all(regions) else ""), hosts[i])
+                for i in range(len(hosts))
+            ]
+            host_grouped = any(keys.count(k) >= 2 for k in keys)
+        self._hier = multi_region or host_grouped
 
     def hier_capable(self) -> bool:
         return self._hier

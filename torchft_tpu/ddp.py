@@ -533,22 +533,31 @@ class AdaptiveDDP:
             c for c in self._CANDIDATES
             if not (c == "plan" and compress == "int8")
         ]
+        # Topology opt-in markers. Region: the member carries a label
+        # (TORCHFT_REGION / Manager(region=)). Host: the operator set
+        # TORCHFT_HOST EXPLICITLY — the Manager's hostname DEFAULT is
+        # deliberately not enough here, or every unlabeled single-host
+        # dev fleet would grow an extra probe candidate; the quorum's
+        # host map (hostname-defaulted) still drives the data plane's
+        # tier selection either way, this only gates the probe list.
         region_labeled = bool(
             getattr(manager, "_region", "") or os.environ.get(
                 "TORCHFT_REGION", ""
             )
         )
-        if "plan" in self._candidates and region_labeled:
+        host_labeled = bool(os.environ.get("TORCHFT_HOST", ""))
+        if "plan" in self._candidates and (region_labeled or host_labeled):
             # Topology-aware candidate: the plan transport over the
-            # two-tier schedule. Candidate-list membership is keyed on
-            # CONSTRUCTION (this member carries a region label — set via
-            # TORCHFT_REGION on every member of a regional fleet or on
-            # none, like every other schedule knob), so unlabeled
+            # hierarchical schedule. Candidate-list membership is keyed
+            # on CONSTRUCTION (this member carries a region label, or
+            # the operator explicitly labeled hosts with TORCHFT_HOST for
+            # the shm intra-host tier — set on every member of the fleet
+            # or on none, like every other schedule knob), so unlabeled
             # deployments keep the exact pre-hier probe. Whether the
             # COHORT is actually hierarchical is only known per quorum: a
-            # labeled member in a single-region (or partially labeled)
-            # cohort probes it anyway, each probe step latches the
-            # dispatch error and records the failure sentinel, so the
+            # labeled member in a single-region cohort with no >= 2-
+            # member host group probes it anyway, each probe step latches
+            # the dispatch error and records the failure sentinel, so the
             # candidate can never win there — never a crash, same
             # discipline as an un-spawnable xla_iso child.
             self._candidates.insert(

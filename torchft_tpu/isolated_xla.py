@@ -771,6 +771,7 @@ class IsolatedXLACollectives(OpStatsMixin, Collectives):
         rank: int,
         world_size: int,
         regions: Optional[Sequence[str]] = None,
+        hosts: Optional[Sequence[str]] = None,
     ) -> None:
         """Kill-and-respawn reconfigure: the old child (wedged or not) is
         SIGKILLed from the calling thread — unblocking any op stuck on

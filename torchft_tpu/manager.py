@@ -82,6 +82,7 @@ class Manager:
         lighthouse_root_addr: Optional[str] = None,
         lease_ttl: Optional[timedelta] = None,
         region: Optional[str] = None,
+        host_label: Optional[str] = None,
         replica_id: Optional[str] = None,
         hostname: str = socket.gethostname(),
         heartbeat_interval: timedelta = timedelta(milliseconds=100),
@@ -124,6 +125,15 @@ class Manager:
                 two-tier collective schedule (intra-region rings + an
                 inter-region leader ring; see
                 ``HostCollectives.allreduce_hier``).
+            host_label: this replica group's HOST label (env
+                ``TORCHFT_HOST``; defaults to the machine hostname, ""
+                disables). It rides the quorum like ``region``, and
+                whenever a (region, host) pair groups >= 2 members,
+                ``configure`` hands the host map to the data plane, which
+                builds the shared-memory intra-host ring tier below the
+                region tiers — co-hosted members sync at memcpy speed
+                instead of loopback TCP (``TORCHFT_HC_SHM`` gates the
+                transport).
             replica_id: replica group name; a uuid suffix is appended by
                 group rank 0 (reference manager.py:196-200).
             profiler: windowed jax profiler capture advanced once per
@@ -204,6 +214,10 @@ class Manager:
         # Last measured effective wire throughput (MB/s), updated by
         # observe_op_stats(); None until a ring op has been observed.
         self._last_wire_eff_mbps: Optional[float] = None
+        # Per-tier effective throughput of the last hierarchical op
+        # (MB/s per tier key; shm host tiers measure ring movement over
+        # phase wall). Empty until a hier op has been observed.
+        self._last_tier_mbps: Dict[str, float] = {}
         self._profiler = (
             profiler if profiler is not None else Profiler.from_env()
         )
@@ -219,9 +233,14 @@ class Manager:
         if region is None:
             region = os.environ.get("TORCHFT_REGION", "")
         self._region = region
-        # The quorum's region map (replica-rank order), refreshed every
-        # quorum; what hier_capable() and the configure call key off.
+        if host_label is None:
+            host_label = os.environ.get("TORCHFT_HOST", socket.gethostname())
+        self._host_label = host_label
+        # The quorum's region and host maps (replica-rank order),
+        # refreshed every quorum; what hier_capable() and the configure
+        # call key off.
         self._replica_regions: List[str] = []
+        self._replica_hosts: List[str] = []
         replica_id = replica_id if replica_id is not None else ""
 
         self._manager: Optional[_native.Manager] = None
@@ -249,6 +268,7 @@ class Manager:
                 root_addr=lighthouse_root_addr,
                 lease_ttl=lease_ttl,
                 region=region,
+                host=host_label,
             )
             self._store.set(MANAGER_ADDR_KEY, self._manager.address().encode())
             self._store.set(REPLICA_ID_KEY, replica_id.encode())
@@ -387,17 +407,25 @@ class Manager:
             # rank, and stale members can't collide (reference :470-477).
             prefix = f"{store_address}/torchft/{quorum_id}/{self._rank}"
             self._logger.info(f"reconfiguring collectives quorum_id={quorum_id}")
-            # The quorum's region map (one label per replica rank) rides
-            # into the data plane: a host ring compiles it into the
-            # two-tier schedule when usable; other backends ignore it.
+            # The quorum's region and host maps (one label per replica
+            # rank) ride into the data plane: a host ring compiles them
+            # into the hierarchical schedule when usable; other backends
+            # ignore them. The hosts kwarg is passed only to backends
+            # that declare it (every in-repo backend does) so external
+            # stand-ins with the pre-host signature keep working.
             regions = list(result.replica_regions)
             self._replica_regions = regions
+            hosts = list(result.replica_hosts)
+            self._replica_hosts = hosts
+            cfg_kwargs: Dict[str, Any] = {"regions": regions or None}
+            if hosts and any(hosts) and self._configure_takes_hosts():
+                cfg_kwargs["hosts"] = hosts
             with self._metrics.timed("reconfigure"), span(
                 "torchft::reconfigure"
             ):
                 self._collectives.configure(
                     prefix, result.replica_rank, result.replica_world_size,
-                    regions=regions or None,
+                    **cfg_kwargs,
                 )
             if self._iso_collectives is not None:
                 # The secondary (isolated) plane reconfigures on its own
@@ -634,6 +662,26 @@ class Manager:
             self.wait_quorum()
         cap = getattr(self._collectives, "hier_capable", None)
         return bool(cap()) if cap is not None else False
+
+    def _configure_takes_hosts(self) -> bool:
+        try:
+            import inspect
+
+            sig = inspect.signature(self._collectives.configure)
+        except (TypeError, ValueError):
+            return False
+        params = sig.parameters
+        return "hosts" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+        )
+
+    def replica_hosts(self) -> List[str]:
+        """The current quorum's host map, indexed by replica rank (empty
+        until the first quorum; empty strings for unlabeled members).
+        Paired with :meth:`replica_regions`: (region, host) groups are
+        what the data plane compiles into the shared-memory intra-host
+        tier."""
+        return list(self._replica_hosts)
 
     def replica_regions(self) -> List[str]:
         """The current quorum's region map, indexed by replica rank
@@ -985,6 +1033,26 @@ class Manager:
         pop = getattr(self._collectives, "pop_op_stats", None)
         entries: List[dict] = pop() if pop is not None else []
         for st in entries:
+            # Hierarchical entries additionally fold PER-TIER effective
+            # throughput (measured tier bytes over that tier's phase
+            # wall): the policy engine prices hier/shm candidates on the
+            # bottleneck tier, not this op's folded average. Shm host
+            # tiers bill ring movement (tx_bytes is honestly 0 there).
+            tiers = st.get("tiers")
+            if tiers:
+                for name, t in tiers.items():
+                    if name == "inter":
+                        phase_s = t.get("ring_s") or 0.0
+                    else:
+                        phase_s = (
+                            (t.get("rs_s") or 0.0) + (t.get("ag_s") or 0.0)
+                            + (t.get("bcast_s") or 0.0)
+                        )
+                    moved = t.get("tx_bytes") or t.get("shm_bytes") or 0
+                    if phase_s > 0 and moved > 0:
+                        tier_eff = moved / phase_s / (1 << 20)
+                        self._last_tier_mbps[name] = tier_eff
+                        self._metrics.record(f"tier_{name}_MBps", tier_eff)
             ring_s = st.get("ring")
             wire_bytes = st.get("wire_bytes") or st.get("bytes")
             if not ring_s or not wire_bytes or ring_s <= 0:
@@ -1004,6 +1072,10 @@ class Manager:
           rebuilds) over the trailing ``churn_window_s``.
         - ``wire_eff_MBps``: last measured effective wire throughput of a
           ring op (``None`` until :meth:`observe_op_stats` has seen one).
+        - ``tier_eff_MBps``: per-tier effective throughput of the last
+          hierarchical op ({"host"/"intra"/"inter": MB/s}; ``None`` until
+          one has been observed) — what prices hier/shm strategy
+          candidates on their bottleneck tier.
         - ``heal``: the last streamed-heal cost breakdown (the transport's
           ``last_fetch_stats``: path/wire/bytes/fetch_s/h2d_s), plus the
           ``heal_fetch``/``heal_apply`` timer snapshots — ``None`` when
@@ -1027,6 +1099,7 @@ class Manager:
                 self._metrics.rate_per_min("churn", churn_window_s), 6
             ),
             "wire_eff_MBps": self._last_wire_eff_mbps,
+            "tier_eff_MBps": dict(self._last_tier_mbps) or None,
             "heal": heal,
         }
 

@@ -85,7 +85,13 @@ class StrategySpec:
     selects the data path: ``"legacy"`` managed ring, ``"plan"``
     persistent native comm plan, ``"iso"`` the isolated-child XLA plane.
     ``sharded`` (diloco only) uses the weight-update-sharded outer sync
-    (requires f32 masters and an elementwise outer optimizer)."""
+    (requires f32 masters and an elementwise outer optimizer). ``hier``
+    (ddp/plan or diloco) runs the sync over the topology-aware
+    hierarchical schedule (shm host rings -> intra-region rings -> the
+    inter-region leader ring); such candidates are priced on the
+    BOTTLENECK tier's measured bandwidth, not the folded flat average,
+    and an un-hierarchical cohort latches them into the failure
+    sentinel at runtime."""
 
     name: str
     kind: str
@@ -93,6 +99,7 @@ class StrategySpec:
     wire: Optional[str] = None
     transport: str = "legacy"
     sharded: bool = False
+    hier: bool = False
 
     def __post_init__(self) -> None:
         if self.kind not in ("ddp", "localsgd", "diloco"):
@@ -105,24 +112,43 @@ class StrategySpec:
             raise ValueError(f"unsupported wire: {self.wire!r}")
         if self.transport not in ("legacy", "plan", "iso"):
             raise ValueError(f"unsupported transport: {self.transport!r}")
+        if self.hier and self.kind == "localsgd":
+            raise ValueError("localsgd has no hier schedule")
+        if self.hier and self.kind == "ddp" and self.transport != "plan":
+            raise ValueError("hier ddp rides the plan transport")
 
     def wire_factor(self) -> float:
         """Sync payload bytes relative to f32."""
         return _WIRE_FACTOR[self.wire]
 
 
-def default_candidates(f32_masters: bool = True) -> Tuple[StrategySpec, ...]:
+def default_candidates(
+    f32_masters: bool = True, topology_labeled: bool = False
+) -> Tuple[StrategySpec, ...]:
     """The default ladder, ordered from tightest to loosest sync: per-step
     DDP (legacy and plan transports), LocalSGD, and two DiLoCo(q8) window
     lengths — sharded outer sync when the masters are f32 (the ISSUE's
     ``DiLoCo(sharded, q8)`` point), plain q8 otherwise. Availability is
     still checked per cohort at construction (a diloco candidate without
     an outer optimizer or under an async-quorum manager simply can't
-    win)."""
+    win).
+
+    ``topology_labeled`` (the AdaptiveDDP construction gate: this member
+    carries TORCHFT_REGION or an explicit TORCHFT_HOST) adds the
+    ``ddp_plan_hier`` candidate — the plan transport over the
+    hierarchical (shm host tier / region tier) schedule, priced on the
+    bottleneck tier's measured bandwidth. Unlabeled fleets keep the
+    exact pre-hier ladder."""
     sharded = bool(f32_masters)
-    return (
+    ladder = [
         StrategySpec("ddp", "ddp"),
         StrategySpec("ddp_plan", "ddp", transport="plan"),
+    ]
+    if topology_labeled:
+        ladder.append(
+            StrategySpec("ddp_plan_hier", "ddp", transport="plan", hier=True)
+        )
+    ladder += [
         StrategySpec("localsgd_h16", "localsgd", sync_every=16),
         StrategySpec(
             "diloco_q8_h16", "diloco", sync_every=16, wire="q8",
@@ -132,7 +158,8 @@ def default_candidates(f32_masters: bool = True) -> Tuple[StrategySpec, ...]:
             "diloco_q8_h64", "diloco", sync_every=64, wire="q8",
             sharded=sharded,
         ),
-    )
+    ]
+    return tuple(ladder)
 
 
 @dataclass(frozen=True)
@@ -199,14 +226,32 @@ def strategy_cost(
     """
     c = max(float(signals["compute_s"]), 1e-6)
     bw_mbps = float(signals.get("wire_eff_MBps") or 0.0)
-    if bw_mbps <= 0.0:
+    model_bytes = float(signals["model_bytes"])
+    intra_bw = float(signals.get("tier_intra_MBps") or 0.0)
+    inter_bw = float(signals.get("tier_inter_MBps") or 0.0)
+    if spec.hier and (intra_bw > 0.0 or inter_bw > 0.0):
+        # Hierarchical candidates are priced on the BOTTLENECK tier, not
+        # the folded flat average: the schedule's phases are sequential,
+        # so the wall is bounded below by its worst leg — the wire-
+        # compressed inter hop at the measured inter bandwidth vs the
+        # full-width intra/host legs (~2N per member: rs + ag) at the
+        # measured intra bandwidth. An shm host tier simply makes the
+        # host leg's measured bandwidth enormous, so it never bounds.
+        legs = []
+        if inter_bw > 0.0:
+            legs.append(
+                model_bytes * spec.wire_factor() / (inter_bw * (1 << 20))
+            )
+        if intra_bw > 0.0:
+            legs.append(2.0 * model_bytes / (intra_bw * (1 << 20)))
+        wire_s = max(legs)
+    elif bw_mbps <= 0.0:
         # Unmeasured bandwidth: price syncs at the fixed cost only; the
         # first windows' op stats fill this in.
         wire_s = 0.0
     else:
         wire_s = (
-            float(signals["model_bytes"]) * spec.wire_factor()
-            / (bw_mbps * (1 << 20))
+            model_bytes * spec.wire_factor() / (bw_mbps * (1 << 20))
         )
     sync_s = wire_s + knobs.sync_fixed_s
     ctrl_s = max(float(signals.get("ctrl_s") or 0.0), 0.0)
@@ -292,7 +337,12 @@ class PolicyEngine:
         self._outer_tx = outer_tx
         if candidates is None:
             candidates = default_candidates(
-                f32_masters=self._masters_are_f32()
+                f32_masters=self._masters_are_f32(),
+                topology_labeled=bool(
+                    getattr(manager, "_region", "")
+                    or os.environ.get("TORCHFT_REGION", "")
+                    or os.environ.get("TORCHFT_HOST", "")
+                ),
             )
         self._candidates: List[StrategySpec] = list(candidates)
         if not self._candidates:
@@ -406,6 +456,7 @@ class PolicyEngine:
             eng = PipelinedDDP(
                 self._manager, self._state, self._grad_fn,
                 compress=spec.wire, transport=spec.transport,
+                hier=spec.hier,
             )
         elif spec.kind == "localsgd":
             eng = LocalSGD(self._manager, self._state, spec.sync_every)
@@ -531,6 +582,7 @@ class PolicyEngine:
             if self._compute_samples
             else 0.0
         )
+        tiers = sig.get("tier_eff_MBps") or {}
         head = [
             1.0,  # ok marker: a zeroed (non-participating) entry drops out
             compute_s,
@@ -539,6 +591,11 @@ class PolicyEngine:
             _p50("quorum") + _p50("commit_vote"),
             _p50("reconfigure"),
             (float(heal_fetch) + float(heal_apply)) * heal_frac,
+            # Per-tier measured bandwidth of the hierarchical schedule
+            # (0 = unmeasured): what prices hier/shm candidates on the
+            # bottleneck tier instead of the folded flat average.
+            float(tiers.get("intra") or 0.0),
+            float(tiers.get("inter") or 0.0),
         ]
         avail = [1.0 if a else 0.0 for a in self._avail]
         failed = [1.0 if f else 0.0 for f in self._failed]
@@ -553,15 +610,23 @@ class PolicyEngine:
         k = len(self._candidates)
         live = [
             e for e in entries
-            if e.shape == (7 + 2 * k,) and np.isfinite(e).all() and e[0] > 0.5
+            if e.shape == (9 + 2 * k,) and np.isfinite(e).all() and e[0] > 0.5
         ]
         if not live:
             raise RuntimeError("no live signal entries in decision gather")
         mat = np.stack(live)
         bws = mat[:, 2]
         bws = bws[bws > 0.0]
-        avail = mat[:, 7:7 + k].min(axis=0)  # AND across members
-        failed = mat[:, 7 + k:].max(axis=0)  # OR across members
+
+        def _tier_min(col: int) -> float:
+            # Bottleneck across members, like the flat bandwidth: the
+            # slowest member's measured tier bounds every phase.
+            v = mat[:, col]
+            v = v[v > 0.0]
+            return float(v.min()) if v.size else 0.0
+
+        avail = mat[:, 9:9 + k].min(axis=0)  # AND across members
+        failed = mat[:, 9 + k:].max(axis=0)  # OR across members
         return {
             "compute_s": float(mat[:, 1].max()),
             "wire_eff_MBps": float(bws.min()) if bws.size else 0.0,
@@ -569,6 +634,8 @@ class PolicyEngine:
             "ctrl_s": float(mat[:, 4].max()),
             "reconf_s": float(mat[:, 5].max()),
             "heal_s": float(mat[:, 6].max()),
+            "tier_intra_MBps": _tier_min(7),
+            "tier_inter_MBps": _tier_min(8),
             "world": float(len(live)),
             "model_bytes": float(self._model_bytes),
             "avail": avail,

@@ -266,6 +266,7 @@ class XLACollectives(OpStatsMixin, Collectives):
         rank: int,
         world_size: int,
         regions: Optional[Sequence[str]] = None,
+        hosts: Optional[Sequence[str]] = None,
     ) -> None:
         # `regions` accepted and ignored (the reconfigure contract): the
         # compiled XLA data plane has no host-side topology to compile —
