@@ -391,6 +391,196 @@ class Phase:
         return rec
 
 
+def run_root_outage_phase(n_groups: int, args: argparse.Namespace) -> dict:
+    """Durable-control-plane bench: primary + warm-standby ROOT
+    SUBPROCESSES (both WAL'd) behind a region tier, ``n_groups``
+    simulated groups renewing in batches. Measures:
+
+    - **takeover**: SIGKILL the primary -> first observed sample where
+      the standby is ACTIVE and every group's lease is FRESH (renewed
+      after the kill, i.e. the whole fleet re-registered through the
+      failover set without any group restart), plus the quorum_id
+      watermark continuity across the epoch bump.
+    - **restart replay**: restart the killed primary on its WAL ->
+      status-reported replay wall time + record count, and the fencing
+      verdict (it must come back PASSIVE behind the takeover epoch).
+    """
+    import tempfile
+
+    from torchft_tpu.chaos import RootProcess, free_port
+
+    ports = [free_port(), free_port()]
+    addrs = [f"http://localhost:{p}" for p in ports]
+    roots_list = ",".join(addrs)
+    wal_dirs = [tempfile.mkdtemp(prefix="tft_lhb_wal_") for _ in ports]
+    takeover_ms = args.takeover_ms
+    primary = RootProcess(
+        ports[0], wal_dir=wal_dirs[0], peers=addrs[1],
+        takeover_ms=takeover_ms, heartbeat_timeout_ms=args.ttl_ms,
+        join_timeout_ms=1000,
+    )
+    standby = RootProcess(
+        ports[1], wal_dir=wal_dirs[1], peers=addrs[0], standby=True,
+        takeover_ms=takeover_ms, heartbeat_timeout_ms=args.ttl_ms,
+        join_timeout_ms=1000,
+    )
+    primary.wait_serving()
+    standby.wait_serving()
+
+    regions = [
+        _native.RegionLighthouse(
+            roots_list,
+            f"region_{i}",
+            digest_interval_ms=max(50, args.renew_interval_ms // 4),
+            heartbeat_timeout_ms=args.ttl_ms,
+        )
+        for i in range(args.regions)
+    ]
+    groups = [f"g{i:05d}" for i in range(n_groups)]
+    region_of = {g: i % len(regions) for i, g in enumerate(groups)}
+    stop = threading.Event()
+    samples: List[dict] = []
+    out: dict = {"phase": "root_outage", "groups": n_groups,
+                 "regions": args.regions, "takeover_ms_bound": takeover_ms}
+
+    def driver(slice_groups: List[str], stagger_s: float) -> None:
+        clients: Dict[int, _native.LeaseClient] = {}
+        time.sleep(stagger_s)
+        while not stop.is_set():
+            t0 = time.monotonic()
+            by_region: Dict[int, List[str]] = {}
+            for g in slice_groups:
+                by_region.setdefault(region_of[g], []).append(g)
+            for r, gs in by_region.items():
+                for i in range(0, len(gs), args.batch):
+                    if stop.is_set():
+                        return
+                    chunk = [entry(g, args.ttl_ms) for g in gs[i:i + args.batch]]
+                    try:
+                        if r not in clients:
+                            clients[r] = _native.LeaseClient(
+                                regions[r].address(),
+                                connect_timeout=timedelta(seconds=5),
+                            )
+                        clients[r].renew(chunk, timeout=timedelta(seconds=5))
+                    except Exception:  # noqa: BLE001
+                        clients.pop(r, None)
+            elapsed = time.monotonic() - t0
+            stop.wait(max(0.0, args.renew_interval_ms / 1000.0 - elapsed))
+
+    def watcher() -> None:
+        while not stop.is_set():
+            for idx, root in enumerate((primary, standby)):
+                st = root.status(timeout=2.0)
+                if st is None:
+                    continue
+                samples.append(
+                    {
+                        "t": time.monotonic(),
+                        "endpoint": idx,
+                        "active": st.get("active", False),
+                        "root_epoch": st.get("root_epoch", 0),
+                        "quorum_id": st.get("quorum_id", 0),
+                        "members": {
+                            m["replica_id"]: m["lease_remaining_ms"]
+                            for m in st.get("members", [])
+                        },
+                    }
+                )
+            stop.wait(0.05)
+
+    threads: List[threading.Thread] = []
+    per = max(1, (n_groups + args.threads - 1) // args.threads)
+    for i in range(args.threads):
+        sl = groups[i * per:(i + 1) * per]
+        if sl:
+            t = threading.Thread(
+                target=driver,
+                args=(sl, i * args.renew_interval_ms / 1000.0 / args.threads),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+    w = threading.Thread(target=watcher, daemon=True)
+    w.start()
+    threads.append(w)
+
+    def wait_sample(pred, deadline_s: float) -> Optional[dict]:
+        start = time.monotonic()
+        n = len(samples)
+        while time.monotonic() < start + deadline_s:
+            cur = samples
+            while n < len(cur):
+                s = cur[n]
+                n += 1
+                if s["t"] >= start and pred(s):
+                    return s
+            time.sleep(0.02)
+        return None
+
+    deadline = max(30.0, 3 * args.ttl_ms / 1000.0 + 0.002 * n_groups)
+    try:
+        want = set(groups)
+        t_start = time.monotonic()
+        warm = wait_sample(
+            lambda s: s["active"] and set(s["members"]) >= want,
+            4 * deadline,
+        )
+        if warm is None:
+            out["error"] = "fleet never fully leased at the primary"
+            return out
+        out["warmup_s"] = round(warm["t"] - t_start, 3)
+        qid_before = warm["quorum_id"]
+        epoch_before = warm["root_epoch"]
+
+        # ---- takeover: SIGKILL the primary ----
+        t_kill = time.monotonic()
+        primary.kill()
+
+        def taken_over(s: dict) -> bool:
+            if s["endpoint"] != 1 or not s["active"]:
+                return False
+            elapsed_ms = (s["t"] - t_kill) * 1000.0
+            need = args.ttl_ms - elapsed_ms + 100.0
+            return all(s["members"].get(g, -1) > need for g in want)
+
+        s = wait_sample(taken_over, 2 * deadline)
+        if s is None:
+            out["error"] = "standby never took over with fresh fleet leases"
+            return out
+        out["takeover_s"] = round(s["t"] - t_kill, 3)
+        out["epoch_before"] = epoch_before
+        out["epoch_after"] = s["root_epoch"]
+        out["quorum_id_before"] = qid_before
+        out["quorum_id_after"] = s["quorum_id"]
+        out["watermark_monotone"] = s["quorum_id"] >= qid_before
+
+        # ---- restart replay: revive the primary on its WAL ----
+        t_restart = time.monotonic()
+        primary.restart()
+        st = primary.wait_serving(deadline_s=60)
+        out["restart_serving_s"] = round(time.monotonic() - t_restart, 3)
+        wal = st.get("wal", {})
+        out["restart_wal_replayed"] = st.get("wal_replayed", False)
+        out["restart_replay_ms"] = wal.get("replay_ms")
+        out["restart_records_replayed"] = wal.get("records_replayed")
+        out["restart_fenced_standby"] = not st.get("active", True)
+        out["restart_root_epoch"] = st.get("root_epoch")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        for r in regions:
+            r.shutdown()
+        primary.stop()
+        standby.stop()
+        import shutil
+
+        for d in wal_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def run_phase(
     mode: str,
     n_groups: int,
@@ -495,14 +685,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--threads", type=int, default=4)
     p.add_argument("--kills", type=int, default=6)
     p.add_argument("--region-kills", type=int, default=1)
+    p.add_argument(
+        "--takeover-ms",
+        type=int,
+        default=1500,
+        help="standby takeover bound for the root-outage phase "
+        "(TORCHFT_LH_TAKEOVER_MS on the spawned roots)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="LIGHTHOUSE_BENCH.json")
     p.add_argument(
         "--dryrun",
         action="store_true",
         help="seconds-scale smoke: small group count, one group kill + one "
-        "region kill, asserts convergence + region-failover records, "
-        "writes NO artifact",
+        "region kill + one root kill/restart, asserts convergence, "
+        "region-failover and root-takeover records, writes NO artifact",
+    )
+    p.add_argument(
+        "--root-outage-only",
+        action="store_true",
+        help="run ONLY the root-outage phase per scale and merge its "
+        "records into an existing artifact (the flat/hier scale phases "
+        "are expensive; the durability phase can be refreshed alone)",
     )
     args = p.parse_args(argv)
 
@@ -517,6 +721,29 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rng = random.Random(args.seed)
     scales = [int(s) for s in args.scales.split(",") if s]
+
+    if args.root_outage_only:
+        try:
+            with open(args.out) as fp:
+                result = json.load(fp)
+        except (OSError, json.JSONDecodeError):
+            result = {"bench": "lighthouse", "scales": []}
+        by_groups = {row.get("groups"): row for row in result.get("scales", [])}
+        for n in scales:
+            print(f"=== root_outage @ {n} groups ===", flush=True)
+            rec = run_root_outage_phase(n, args)
+            print(json.dumps(rec), flush=True)
+            row = by_groups.get(n)
+            if row is None:
+                row = {"groups": n}
+                result.setdefault("scales", []).append(row)
+                by_groups[n] = row
+            row["root_outage"] = rec
+        result.setdefault("config", {})["takeover_ms"] = args.takeover_ms
+        with open(args.out, "w") as fp:
+            json.dump(result, fp, indent=2, sort_keys=True)
+        print(f"wrote {args.out}")
+        return 0
     result = {
         "bench": "lighthouse",
         "host": {"cpus": os.cpu_count()},
@@ -539,6 +766,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"=== {mode} @ {n} groups ===", flush=True)
             row[mode] = run_phase(mode, n, args, rng)
             print(json.dumps(row[mode]), flush=True)
+        print(f"=== root_outage @ {n} groups ===", flush=True)
+        row["root_outage"] = run_root_outage_phase(n, args)
+        print(json.dumps(row["root_outage"]), flush=True)
         f, h = row["flat"], row["hier"]
         if f.get("convergence_p99_s") is not None and h.get(
             "convergence_p99_s"
@@ -554,7 +784,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         assert row["flat"]["convergence_s"], "no flat convergence record"
         assert row["hier"]["convergence_s"], "no hier convergence record"
         assert row["hier"]["region_failovers"], "no region-failover record"
-        print("dryrun OK: convergence + region-failover records present")
+        ro = row["root_outage"]
+        assert "takeover_s" in ro, f"no root takeover record: {ro}"
+        assert ro["watermark_monotone"], f"takeover regressed quorum_id: {ro}"
+        assert ro["restart_wal_replayed"] and ro["restart_fenced_standby"], (
+            f"restarted primary did not replay+fence: {ro}"
+        )
+        print(
+            "dryrun OK: convergence + region-failover + root-takeover "
+            "records present"
+        )
         return 0
 
     with open(args.out, "w") as fp:

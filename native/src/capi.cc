@@ -16,6 +16,7 @@
 #include "region.h"
 #include "shm.h"
 #include "store.h"
+#include "wal.h"
 #include "wire.h"
 
 using namespace tft;
@@ -78,9 +79,16 @@ void tft_string_free(char* s) { free(s); }
 
 // ---- Lighthouse ----
 
+// wal_dir ("" = no durability), peers ("" = no failover set; comma-
+// separated other root endpoints), standby (1 = start passive) and
+// takeover_ms (0 = default) are the durable-control-plane knobs — see
+// native/src/lighthouse.h and docs/OPERATIONS.md "control-plane
+// durability & failover".
 void* tft_lighthouse_create(const char* bind, uint64_t min_replicas,
                             int64_t join_timeout_ms, int64_t quorum_tick_ms,
-                            int64_t heartbeat_timeout_ms) {
+                            int64_t heartbeat_timeout_ms, const char* wal_dir,
+                            int64_t snapshot_every, const char* peers,
+                            int standby, int64_t takeover_ms) {
   Lighthouse* lh = nullptr;
   int rc = guarded([&] {
     LighthouseOpt opt;
@@ -88,9 +96,24 @@ void* tft_lighthouse_create(const char* bind, uint64_t min_replicas,
     opt.join_timeout_ms = join_timeout_ms;
     opt.quorum_tick_ms = quorum_tick_ms;
     opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    opt.wal_dir = wal_dir ? wal_dir : "";
+    opt.snapshot_every = snapshot_every;
+    opt.peers = peers ? peers : "";
+    opt.standby = standby != 0;
+    opt.takeover_ms = takeover_ms;
     lh = new Lighthouse(bind, opt);
   });
   return rc == kOk ? lh : nullptr;
+}
+
+// Whether this root is ACTIVE (serving) vs a passive warm standby.
+int tft_lighthouse_active(void* handle) {
+  return static_cast<Lighthouse*>(handle)->active() ? 1 : 0;
+}
+
+// Monotonic root epoch (0 = never active; fenced through the WAL).
+int64_t tft_lighthouse_root_epoch(void* handle) {
+  return static_cast<Lighthouse*>(handle)->root_epoch();
 }
 
 char* tft_lighthouse_address(void* handle) {
@@ -202,18 +225,23 @@ int tft_lease_client_depart(void* handle, const char* replica_id,
 
 // ---- ManagerServer ----
 
+// lighthouse_addr and root_addr may be COMMA-SEPARATED endpoint lists
+// (root failover sets); region_probe_max bounds the demoted manager's
+// region re-probes (0 = probe forever, the pre-durability behavior).
 void* tft_manager_create(const char* replica_id, const char* lighthouse_addr,
                          const char* hostname, const char* bind,
                          const char* store_addr, uint64_t world_size,
                          int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
                          const char* root_addr, int64_t lease_ttl_ms,
-                         const char* region, const char* host) {
+                         const char* region, const char* host,
+                         int64_t region_probe_max) {
   ManagerServer* m = nullptr;
   int rc = guarded([&] {
     m = new ManagerServer(replica_id, lighthouse_addr, hostname, bind, store_addr,
                           world_size, heartbeat_interval_ms, connect_timeout_ms,
                           root_addr ? root_addr : "", lease_ttl_ms,
-                          region ? region : "", host ? host : "");
+                          region ? region : "", host ? host : "",
+                          region_probe_max);
   });
   return rc == kOk ? m : nullptr;
 }
@@ -222,6 +250,12 @@ void* tft_manager_create(const char* replica_id, const char* lighthouse_addr,
 // (region failover active).
 int tft_manager_using_root(void* handle) {
   return static_cast<ManagerServer*>(handle)->using_root_fallback() ? 1 : 0;
+}
+
+// Whether the bounded region re-probe gave up (region_probe_max
+// consecutive failures while demoted) — the manager stays on the root.
+int tft_manager_probe_given_up(void* handle) {
+  return static_cast<ManagerServer*>(handle)->region_probe_given_up() ? 1 : 0;
 }
 
 // Publishes a member-health digest (JSON) carried on subsequent lease
@@ -825,6 +859,75 @@ int tft_digest_apply(const char* state_json, const char* digest_json, int64_t no
     LighthouseState state = lighthouse_state_from_json(Json::parse(state_json));
     apply_digest(state, digest_from_json(Json::parse(digest_json)), now);
     *result_json = dup_string(lighthouse_state_to_json(state).dump());
+  });
+}
+
+// ---- write-ahead quorum log (pure entry points) ----
+// The scripted kill-at-every-record property suites drive the EXACT
+// DurableLog encoder/decoder the live root runs, with caller-supplied
+// clocks (mono == unix == scripted t makes the rebase an identity).
+
+void* tft_wal_open(const char* dir, int64_t snapshot_every) {
+  DurableLog* wal = nullptr;
+  int rc = guarded([&] { wal = new DurableLog(dir, snapshot_every); });
+  return rc == kOk ? wal : nullptr;
+}
+
+void tft_wal_close(void* handle) { delete static_cast<DurableLog*>(handle); }
+
+// entries_json: [{replica_id, age_ms, ttl_ms, participating,
+// joined_age_ms, member}] — the POST-APPLY state slices (ages relative
+// to unix_ms).
+int tft_wal_log_lease(void* handle, const char* entries_json, int64_t unix_ms) {
+  return guarded([&] {
+    static_cast<DurableLog*>(handle)->log_lease(
+        wal_lease_entries_from_json(Json::parse(entries_json)), unix_ms);
+  });
+}
+
+int tft_wal_log_depart(void* handle, const char* replica_id) {
+  return guarded(
+      [&] { static_cast<DurableLog*>(handle)->log_depart(replica_id); });
+}
+
+int tft_wal_log_quorum(void* handle, const char* quorum_json,
+                       int64_t quorum_gen, int64_t root_epoch) {
+  return guarded([&] {
+    static_cast<DurableLog*>(handle)->log_quorum(
+        quorum_from_json(Json::parse(quorum_json)), quorum_gen, root_epoch);
+  });
+}
+
+int tft_wal_log_epoch(void* handle, int64_t epoch) {
+  return guarded([&] { static_cast<DurableLog*>(handle)->log_epoch(epoch); });
+}
+
+// state_json uses the lighthouse_state_to_json schema with MONOTONIC
+// times at mono_now (the scripted suites pass mono_now == unix_now == t).
+int tft_wal_snapshot(void* handle, const char* state_json, int64_t quorum_gen,
+                     int64_t root_epoch, int64_t mono_now, int64_t unix_now) {
+  return guarded([&] {
+    static_cast<DurableLog*>(handle)->snapshot(
+        lighthouse_state_from_json(Json::parse(state_json)), quorum_gen,
+        root_epoch, mono_now, unix_now);
+  });
+}
+
+// Replays snapshot + log; returns {"state": <lighthouse state JSON>,
+// "quorum_gen", "root_epoch", "replayed", "records_replayed",
+// "dropped_tail_bytes"} with times re-based onto mono_now.
+int tft_wal_recover(const char* dir, int64_t mono_now, int64_t unix_now,
+                    char** result_json) {
+  return guarded([&] {
+    WalRecovery rec = DurableLog::recover(dir, mono_now, unix_now);
+    JsonObject out;
+    out["state"] = lighthouse_state_to_json(rec.state);
+    out["quorum_gen"] = rec.quorum_gen;
+    out["root_epoch"] = rec.root_epoch;
+    out["replayed"] = rec.replayed;
+    out["records_replayed"] = rec.records_replayed;
+    out["dropped_tail_bytes"] = rec.dropped_tail_bytes;
+    *result_json = dup_string(Json(std::move(out)).dump());
   });
 }
 

@@ -68,6 +68,7 @@ const char* seam_name(int seam) {
     case kSeamShm: return "shm";
     case kSeamRingHdr: return "ring_hdr";
     case kSeamShmRing: return "shm_ring";
+    case kSeamWalWrite: return "wal_write";
   }
   return "unknown";
 }
@@ -81,6 +82,7 @@ int seam_from_name(const std::string& s) {
   if (s == "shm") return kSeamShm;
   if (s == "ring_hdr") return kSeamRingHdr;
   if (s == "shm_ring") return kSeamShmRing;
+  if (s == "wal_write") return kSeamWalWrite;
   throw std::runtime_error("fault plan: unknown seam '" + s + "'");
 }
 

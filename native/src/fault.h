@@ -56,6 +56,13 @@ enum Seam : int {
                       // ships (replayed payload, detected); truncate =
                       // a torn segment (half a frame, ring magic
                       // poisoned)
+  kSeamWalWrite = 8,  // wal.cc DurableLog append (the root's write-ahead
+                      // quorum log): truncate = crash mid-append (half a
+                      // record on disk — the torn tail recovery must
+                      // detect and drop), drop = crash before any byte
+                      // lands, delay = slow disk. Both crash kinds kill
+                      // the log (the process would be dead too), so the
+                      // service stops making new promises.
 };
 
 // Fault kinds a native seam can realize. Python-side seams reuse the

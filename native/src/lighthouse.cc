@@ -1,10 +1,14 @@
 #include "lighthouse.h"
 
 #include <sys/socket.h>
+#include <unistd.h>
 
+#include <algorithm>
+#include <cctype>
 #include <chrono>
 #include <sstream>
 
+#include "fault.h"
 #include "http_util.h"
 #include "log.h"
 #include "manager.h"
@@ -16,13 +20,125 @@ using torchft_tpu::ErrorResponse;
 using torchft_tpu::Quorum;
 using torchft_tpu::QuorumMember;
 
+namespace {
+
+// One RootSync round trip on a fresh connection; false on any failure
+// (the peer being down is the normal case this exists to tolerate).
+bool root_sync_call(const std::string& addr, int64_t my_epoch,
+                    int64_t timeout_ms, torchft_tpu::RootSyncResponse* out) {
+  try {
+    torchft_tpu::RootSyncRequest req;
+    req.set_root_epoch(my_epoch);
+    *out = call<torchft_tpu::RootSyncRequest, torchft_tpu::RootSyncResponse>(
+        addr, MsgType::kRootSyncReq, req, MsgType::kRootSyncResp, timeout_ms,
+        timeout_ms);
+    return true;
+  } catch (const std::exception&) {
+    return false;
+  }
+}
+
+constexpr char kStandbyMsg[] =
+    "standby root (passive; retry another root endpoint)";
+
+// Per-activation tie-break nonce: distinct across processes and across
+// claims within one process (pid ^ wall clock ^ a counter, mixed; 0 is
+// reserved for "no claim"). Collisions would need two claims mixing to
+// the same 64-bit value — and even then the tie merely persists until
+// the next epoch bump, never corrupts state.
+uint64_t fresh_claim_nonce() {
+  static std::atomic<uint64_t> counter{0};
+  uint64_t n = fault::mix64(static_cast<uint64_t>(::getpid()) ^
+                            (static_cast<uint64_t>(unix_ms()) << 16) ^
+                            (counter.fetch_add(1) << 56));
+  return n == 0 ? 1 : n;
+}
+
+} // namespace
+
 Lighthouse::Lighthouse(const std::string& bind_addr, const LighthouseOpt& opt)
     : opt_(opt),
       listener_(std::make_unique<Listener>(bind_addr)),
       hostname_(local_hostname()) {
+  peers_ = split_addr_list(opt_.peers);
+  takeover_ms_ = opt_.takeover_ms > 0 ? opt_.takeover_ms : 3000;
+
+  int64_t recovered_epoch = 0;
+  if (!opt_.wal_dir.empty()) {
+    int64_t t0 = now_ms();
+    WalRecovery rec = DurableLog::recover(opt_.wal_dir, now_ms(), unix_ms());
+    wal_replay_ms_ = now_ms() - t0;
+    wal_replayed_ = rec.replayed;
+    wal_records_replayed_ = rec.records_replayed;
+    wal_dropped_tail_bytes_ = rec.dropped_tail_bytes;
+    {
+      MutexLock lock(mu_);  // no sibling threads yet; for the analysis
+      state_ = std::move(rec.state);
+      quorum_gen_ = rec.quorum_gen;
+      root_epoch_ = rec.root_epoch;
+      wal_quorum_logged_ = state_.quorum_id;
+      recovered_epoch = rec.root_epoch;
+    }
+    wal_ = std::make_unique<DurableLog>(opt_.wal_dir, opt_.snapshot_every);
+    if (rec.replayed) {
+      LOG_INFO("lighthouse WAL replayed: quorum_id="
+               << rec.state.quorum_id << " quorum_gen=" << rec.quorum_gen
+               << " root_epoch=" << rec.root_epoch << " records="
+               << rec.records_replayed << " dropped_tail_bytes="
+               << rec.dropped_tail_bytes << " in " << wal_replay_ms_ << " ms");
+    }
+  }
+
+  // Role election. A root started with standby=true is passive by fiat;
+  // an unflagged root with peers probes them first — finding an ACTIVE
+  // peer at a strictly higher epoch means we are the deposed incarnation
+  // and must fence (tail the winner) instead of forking quorum history.
+  bool start_active = !opt_.standby;
+  if (start_active && !peers_.empty()) {
+    for (const auto& peer : peers_) {
+      torchft_tpu::RootSyncResponse resp;
+      if (!root_sync_call(peer, recovered_epoch, 1000, &resp)) continue;
+      MutexLock lock(mu_);
+      seen_peer_epoch_ = std::max(seen_peer_epoch_, resp.root_epoch());
+      if (resp.active() && resp.root_epoch() > recovered_epoch) {
+        LOG_WARN("peer " << peer << " is ACTIVE at root epoch "
+                         << resp.root_epoch() << " > recovered "
+                         << recovered_epoch
+                         << "; starting as a fenced standby");
+        start_active = false;
+      }
+    }
+  }
+  {
+    MutexLock lock(mu_);
+    active_ = start_active;
+    last_sync_ok_ms_ = now_ms();
+    last_tick_ms_ = now_ms();
+    if (start_active) {
+      // Every active claim bumps the root epoch, WAL-fenced when a log
+      // is configured — the monotone counter split-brain detection and
+      // the chaos harness's cross-restart invariant key off.
+      root_epoch_ = std::max(root_epoch_, seen_peer_epoch_) + 1;
+      claim_nonce_ = fresh_claim_nonce();
+      if (wal_) {
+        try {
+          wal_->log_epoch(root_epoch_);
+        } catch (const std::exception& e) {
+          wal_dead_logged_ = true;
+          LOG_ERROR("root-epoch WAL append failed at startup ("
+                    << e.what() << "); refusing new quorum promises");
+        }
+      }
+    }
+  }
+
   accept_thread_ = std::thread([this] { accept_loop(); });
   tick_thread_ = std::thread([this] { tick_loop(); });
-  LOG_INFO("Lighthouse listening on: " << address());
+  if (!peers_.empty() || opt_.standby) {
+    peer_thread_ = std::thread([this] { peer_loop(); });
+  }
+  LOG_INFO("Lighthouse listening on: "
+           << address() << (start_active ? "" : " (standby)"));
 }
 
 Lighthouse::~Lighthouse() { shutdown(); }
@@ -43,7 +159,27 @@ void Lighthouse::shutdown() {
   listener_->close();
   if (accept_thread_.joinable()) accept_thread_.join();
   if (tick_thread_.joinable()) tick_thread_.join();
+  if (peer_thread_.joinable()) peer_thread_.join();
   conns_.shutdown_all();
+}
+
+bool Lighthouse::active() {
+  MutexLock lock(mu_);
+  return active_;
+}
+
+int64_t Lighthouse::root_epoch() {
+  MutexLock lock(mu_);
+  return root_epoch_;
+}
+
+bool Lighthouse::reject_if_standby(Socket& sock) {
+  {
+    MutexLock lock(mu_);
+    if (active_) return false;
+  }
+  send_error(sock, ErrorResponse::UNAVAILABLE, kStandbyMsg);
+  return true;
 }
 
 void Lighthouse::accept_loop() {
@@ -56,14 +192,80 @@ void Lighthouse::accept_loop() {
 
 void Lighthouse::tick_loop() {
   while (!shutting_down_) {
+    bool stalled = false;
     {
       MutexLock lock(mu_);
-      quorum_tick_locked();
+      int64_t now = now_ms();
+      stalled = !peers_.empty() && active_ && last_tick_ms_ > 0 &&
+                now - last_tick_ms_ > takeover_ms_;
+      last_tick_ms_ = now;
+    }
+    if (stalled) {
+      // Resumed from a stall longer than the standby takeover bound
+      // (SIGSTOP, scheduler starvation, VM pause): a peer may hold a
+      // higher epoch by now. Probe BEFORE making any further promise —
+      // this is what bounds the deposed-primary split-brain window to
+      // the stall itself, not to the next scheduled fence probe.
+      LOG_WARN("quorum tick stalled past the takeover bound ("
+               << takeover_ms_ << " ms); probing peers before serving");
+      probe_peers_fence();
+    }
+    {
+      MutexLock lock(mu_);
+      if (active_) quorum_tick_locked();
     }
     struct timespec ts;
     ts.tv_sec = opt_.quorum_tick_ms / 1000;
     ts.tv_nsec = (opt_.quorum_tick_ms % 1000) * 1000000;
     nanosleep(&ts, nullptr);
+  }
+}
+
+bool Lighthouse::wal_commit_quorum_locked(const Quorum& quorum) {
+  if (!wal_) return true;
+  try {
+    wal_->log_quorum(quorum, quorum_gen_ + 1, root_epoch_);
+    wal_quorum_logged_ = quorum.quorum_id();
+  } catch (const std::exception& e) {
+    if (!wal_dead_logged_) {
+      wal_dead_logged_ = true;
+      LOG_ERROR("quorum WAL append failed ("
+                << e.what()
+                << "); refusing new quorum promises until restart — a "
+                   "promise that outruns the log would regress on replay");
+    }
+    return false;
+  }
+  try {
+    wal_->maybe_snapshot(state_, quorum_gen_ + 1, root_epoch_, now_ms(),
+                         unix_ms());
+  } catch (const std::exception& e) {
+    // The record above is already fsync'd — the promise IS durable, so
+    // publish it (rolling back would re-form and re-append the same
+    // quorum every tick forever). Compaction is what degraded: the log
+    // grows until an operator fixes the directory.
+    if (!wal_dead_logged_) {
+      wal_dead_logged_ = true;
+      LOG_ERROR("WAL snapshot compaction failed ("
+                << e.what() << "); serving continues, log growth UNBOUNDED "
+                               "until the WAL directory recovers");
+    }
+  }
+  return true;
+}
+
+void Lighthouse::wal_log_members_locked(const std::vector<std::string>& ids) {
+  if (!wal_ || ids.empty()) return;
+  try {
+    wal_->log_lease(wal_entries_from_state(state_, ids, now_ms()), unix_ms());
+    wal_->maybe_snapshot(state_, quorum_gen_, root_epoch_, now_ms(),
+                         unix_ms());
+  } catch (const std::exception& e) {
+    if (!wal_dead_logged_) {
+      wal_dead_logged_ = true;
+      LOG_ERROR("lease WAL append failed (" << e.what()
+                                            << "); durability degraded");
+    }
   }
 }
 
@@ -74,6 +276,21 @@ void Lighthouse::quorum_tick_locked() {
   // scan is pure waste. This is what keeps root CPU flat between quorum
   // rounds at thousands-of-groups scale.
   if (state_.participants.empty() && opt_.min_replicas > 0) return;
+  // A dead WAL (torn append) freezes NEW promises entirely: a quorum the
+  // log cannot remember would regress on replay. Frozen beats regressed.
+  if (wal_ && wal_->dead()) return;
+
+  // Rollback savepoint: quorum_step mutates the state (id bump, prev
+  // quorum, participant clear) BEFORE we know the WAL accepted the
+  // promise — if the append tears, the state must roll back so status
+  // and later ticks never advertise an unpublished quorum_id.
+  int64_t saved_qid = state_.quorum_id;
+  std::optional<Quorum> saved_prev;
+  std::map<std::string, ParticipantDetails> saved_parts;
+  if (wal_) {
+    saved_prev = state_.prev_quorum;
+    saved_parts = state_.participants;
+  }
 
   auto t0 = std::chrono::steady_clock::now();
   QuorumStepResult res = quorum_step(now_ms(), unix_ms(), state_, opt_);
@@ -86,6 +303,20 @@ void Lighthouse::quorum_tick_locked() {
 
   if (!res.quorum.has_value()) return;
   const Quorum& quorum = *res.quorum;
+
+  // Durability gate: a CHANGED quorum (id bump / membership commit) is a
+  // new external promise — it must hit the WAL (and, best-effort, the
+  // standby peers) before anyone sees it. An unchanged re-formation
+  // republishes an already-durable promise.
+  if (res.changed) {
+    if (!wal_commit_quorum_locked(quorum)) {
+      state_.quorum_id = saved_qid;
+      state_.prev_quorum = std::move(saved_prev);
+      state_.participants = std::move(saved_parts);
+      return;
+    }
+    push_quorum_to_peers_locked(quorum);
+  }
 
   if (res.changed) {
     LOG_INFO("Detected quorum change, bumping quorum_id to " << state_.quorum_id);
@@ -134,11 +365,13 @@ void Lighthouse::handle_conn(Socket& sock) {
           handle_quorum_req(sock, payload);
           break;
         case MsgType::kLighthouseHeartbeatReq: {
+          if (reject_if_standby(sock)) return;
           torchft_tpu::LighthouseHeartbeatRequest req;
           req.ParseFromString(payload);
           {
             MutexLock lock(mu_);
             state_.heartbeats[req.replica_id()] = now_ms();
+            wal_log_members_locked({req.replica_id()});
           }
           send_msg(sock, MsgType::kLighthouseHeartbeatResp,
                    torchft_tpu::LighthouseHeartbeatResponse());
@@ -156,6 +389,11 @@ void Lighthouse::handle_conn(Socket& sock) {
         case MsgType::kRegionPollReq:
           handle_region_poll(sock, payload);
           break;
+        case MsgType::kRootSyncReq:
+          // Served in EVERY role: the standby's state tail, and the
+          // epoch-fencing probe a restarted/deposed root keys off.
+          handle_root_sync(sock, payload);
+          break;
         default:
           send_error(sock, ErrorResponse::INVALID_ARGUMENT,
                      "unexpected message type");
@@ -168,6 +406,7 @@ void Lighthouse::handle_conn(Socket& sock) {
 }
 
 void Lighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
+  if (reject_if_standby(sock)) return;
   torchft_tpu::LighthouseQuorumRequest req;
   if (!req.ParseFromString(payload) || !req.has_requester()) {
     send_error(sock, ErrorResponse::INVALID_ARGUMENT, "missing requester");
@@ -183,13 +422,15 @@ void Lighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
   state_.heartbeats[requester.replica_id()] = now_ms();
   state_.participants[requester.replica_id()] =
       ParticipantDetails{now_ms(), requester};
+  wal_log_members_locked({requester.replica_id()});
   int64_t gen = quorum_gen_;
   // Proactive tick so a now-complete quorum resolves without waiting a tick.
   quorum_tick_locked();
 
   while (true) {
-    // Wait for a quorum newer than our subscription point.
-    while (quorum_gen_ == gen && !shutting_down_) {
+    // Wait for a quorum newer than our subscription point (bailing out if
+    // a fencing demotion made this root a standby mid-poll).
+    while (quorum_gen_ == gen && !shutting_down_ && active_) {
       if (deadline < 0) {
         quorum_cv_.wait(lock);
       } else {
@@ -206,6 +447,11 @@ void Lighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
     if (shutting_down_) {
       lock.unlock();
       send_error(sock, ErrorResponse::CANCELLED, "lighthouse shutting down");
+      return;
+    }
+    if (!active_) {
+      lock.unlock();
+      send_error(sock, ErrorResponse::UNAVAILABLE, kStandbyMsg);
       return;
     }
     gen = quorum_gen_;
@@ -228,10 +474,12 @@ void Lighthouse::handle_quorum_req(Socket& sock, const std::string& payload) {
     LOG_INFO("Replica " << requester.replica_id() << " not in quorum, retrying");
     state_.participants[requester.replica_id()] =
         ParticipantDetails{now_ms(), requester};
+    wal_log_members_locked({requester.replica_id()});
   }
 }
 
 void Lighthouse::handle_lease_renew(Socket& sock, const std::string& payload) {
+  if (reject_if_standby(sock)) return;
   torchft_tpu::LeaseRenewRequest req;
   if (!req.ParseFromString(payload)) {
     send_error(sock, ErrorResponse::INVALID_ARGUMENT, "bad lease renew request");
@@ -246,21 +494,45 @@ void Lighthouse::handle_lease_renew(Socket& sock, const std::string& payload) {
     // nothing the periodic tick won't see — ticking for those would be
     // O(groups) per renewal, O(groups^2)/interval aggregate while a join
     // window holds the quorum open.
-    if (apply_lease_batch(state_, entries, now_ms())) quorum_tick_locked();
+    bool fresh = apply_lease_batch(state_, entries, now_ms());
+    std::vector<std::string> ids;
+    ids.reserve(entries.size());
+    for (const auto& e : entries) ids.push_back(e.replica_id);
+    wal_log_members_locked(ids);
+    if (fresh) quorum_tick_locked();
     resp.set_quorum_id(state_.quorum_id);
   }
   send_msg(sock, MsgType::kLeaseRenewResp, resp);
 }
 
 void Lighthouse::handle_depart(Socket& sock, const std::string& payload) {
+  if (reject_if_standby(sock)) return;
   torchft_tpu::DepartRequest req;
   if (!req.ParseFromString(payload) || req.replica_id().empty()) {
     send_error(sock, ErrorResponse::INVALID_ARGUMENT, "missing replica_id");
     return;
   }
   {
-    MutexLock lock(mu_);
+    UniqueMutexLock lock(mu_);
     apply_depart(state_, req.replica_id());
+    // The depart ACK is a durable promise: "this member stays departed
+    // across a root restart". Log it BEFORE the response (and before the
+    // tick that may commit a quorum excluding the member), so a torn
+    // append can only lose an un-acked depart.
+    if (wal_) {
+      try {
+        wal_->log_depart(req.replica_id());
+      } catch (const std::exception& e) {
+        if (!wal_dead_logged_) {
+          wal_dead_logged_ = true;
+          LOG_ERROR("depart WAL append failed (" << e.what() << ")");
+        }
+        lock.unlock();
+        send_error(sock, ErrorResponse::UNAVAILABLE,
+                   "wal append failed; depart not durable");
+        return;
+      }
+    }
     // An explicit depart may complete a pending quorum (the departed member
     // no longer counts against the straggler hold-the-door wait).
     quorum_tick_locked();
@@ -270,6 +542,7 @@ void Lighthouse::handle_depart(Socket& sock, const std::string& payload) {
 }
 
 void Lighthouse::handle_region_digest(Socket& sock, const std::string& payload) {
+  if (reject_if_standby(sock)) return;
   torchft_tpu::RegionDigestRequest req;
   if (!req.ParseFromString(payload) || req.region_id().empty()) {
     send_error(sock, ErrorResponse::INVALID_ARGUMENT, "missing region_id");
@@ -283,6 +556,23 @@ void Lighthouse::handle_region_digest(Socket& sock, const std::string& payload) 
     // rejoin carried in this digest's entries — entries must win.
     for (const auto& d : req.departed()) apply_depart(state_, d);
     apply_digest(state_, entries, now_ms());
+    // WAL, mirroring apply order: departs, then the POST-APPLY member
+    // slices (so the freshness gate's outcome — not its input — is what
+    // replays; a region redigest after a failed push re-logs harmlessly).
+    if (wal_) {
+      try {
+        for (const auto& d : req.departed()) wal_->log_depart(d);
+      } catch (const std::exception& e) {
+        if (!wal_dead_logged_) {
+          wal_dead_logged_ = true;
+          LOG_ERROR("digest depart WAL append failed (" << e.what() << ")");
+        }
+      }
+      std::vector<std::string> ids;
+      ids.reserve(entries.size());
+      for (const auto& e : entries) ids.push_back(e.replica_id);
+      wal_log_members_locked(ids);
+    }
     regions_[req.region_id()] =
         RegionInfo{now_ms(), static_cast<int64_t>(entries.size())};
     // A digest can both register participants and remove stragglers.
@@ -293,6 +583,7 @@ void Lighthouse::handle_region_digest(Socket& sock, const std::string& payload) 
 }
 
 void Lighthouse::handle_region_poll(Socket& sock, const std::string& payload) {
+  if (reject_if_standby(sock)) return;
   torchft_tpu::RegionPollRequest req;
   if (!req.ParseFromString(payload)) {
     send_error(sock, ErrorResponse::INVALID_ARGUMENT, "bad region poll request");
@@ -301,7 +592,7 @@ void Lighthouse::handle_region_poll(Socket& sock, const std::string& payload) {
   int64_t deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
 
   UniqueMutexLock lock(mu_);
-  while (quorum_gen_ <= req.min_gen() && !shutting_down_) {
+  while (quorum_gen_ <= req.min_gen() && !shutting_down_ && active_) {
     if (deadline < 0) {
       quorum_cv_.wait(lock);
     } else {
@@ -320,11 +611,249 @@ void Lighthouse::handle_region_poll(Socket& sock, const std::string& payload) {
     send_error(sock, ErrorResponse::CANCELLED, "lighthouse shutting down");
     return;
   }
+  if (!active_) {
+    lock.unlock();
+    send_error(sock, ErrorResponse::UNAVAILABLE, kStandbyMsg);
+    return;
+  }
   torchft_tpu::RegionPollResponse resp;
   *resp.mutable_quorum() = latest_quorum_;
   resp.set_gen(quorum_gen_);
   lock.unlock();
   send_msg(sock, MsgType::kRegionPollResp, resp);
+}
+
+void Lighthouse::push_quorum_to_peers_locked(const torchft_tpu::Quorum& q) {
+  if (peers_.empty()) return;
+  // Held-lock network IO, deliberately: the promise must reach the
+  // standby's WAL before ANY waiter can observe it, and commits are rare
+  // (membership changes only). The deadline is short — a dead peer costs
+  // one bounded stall per commit, never an unbounded one.
+  int64_t timeout = std::min<int64_t>(250, std::max<int64_t>(50, takeover_ms_ / 4));
+  for (const auto& peer : peers_) {
+    try {
+      torchft_tpu::RootSyncRequest req;
+      req.set_root_epoch(root_epoch_);
+      req.set_quorum_gen(quorum_gen_ + 1);
+      *req.mutable_quorum() = q;
+      auto resp =
+          call<torchft_tpu::RootSyncRequest, torchft_tpu::RootSyncResponse>(
+              peer, MsgType::kRootSyncReq, req, MsgType::kRootSyncResp,
+              timeout, timeout);
+      seen_peer_epoch_ = std::max(seen_peer_epoch_, resp.root_epoch());
+    } catch (const std::exception&) {
+      // Best-effort: an unreachable standby resyncs via its pull loop.
+    }
+  }
+}
+
+void Lighthouse::handle_root_sync(Socket& sock, const std::string& payload) {
+  torchft_tpu::RootSyncRequest req;
+  req.ParseFromString(payload);  // empty/garbage payload: epoch 0, harmless
+  torchft_tpu::RootSyncResponse resp;
+  {
+    MutexLock lock(mu_);
+    seen_peer_epoch_ = std::max(seen_peer_epoch_, req.root_epoch());
+    if (req.has_quorum() && req.root_epoch() >= root_epoch_) {
+      // PUSH form: the active peer replicates a fresh commit. Apply the
+      // watermark (never regress), make it durable BEFORE acking, and
+      // treat the push as proof of an alive active root. An active root
+      // receiving a higher-epoch push has been deposed — fence.
+      if (active_ && req.root_epoch() > root_epoch_) {
+        active_ = false;
+        LOG_WARN("deposed by a root-sync push at epoch "
+                 << req.root_epoch() << " > ours " << root_epoch_
+                 << "; demoting to standby");
+        quorum_cv_.notify_all();
+      }
+      if (!active_) {
+        const Quorum& q = req.quorum();
+        if (q.quorum_id() >= state_.quorum_id) {
+          state_.quorum_id = q.quorum_id();
+          state_.prev_quorum = q;
+          latest_quorum_ = q;
+          quorum_gen_ = std::max(quorum_gen_, req.quorum_gen());
+          if (wal_ && q.quorum_id() > wal_quorum_logged_) {
+            try {
+              wal_->log_quorum(q, quorum_gen_, req.root_epoch());
+              wal_quorum_logged_ = q.quorum_id();
+            } catch (const std::exception& e) {
+              if (!wal_dead_logged_) {
+                wal_dead_logged_ = true;
+                LOG_ERROR("standby push WAL append failed (" << e.what()
+                                                             << ")");
+              }
+            }
+          }
+        }
+        last_sync_ok_ms_ = now_ms();
+      }
+    }
+    resp.set_root_epoch(root_epoch_);
+    resp.set_active(active_);
+    resp.set_claim_nonce(claim_nonce_);
+    resp.set_quorum_id(state_.quorum_id);
+    resp.set_quorum_gen(quorum_gen_);
+    if (active_) {
+      // Full membership as age-relative digest entries — the exact wire
+      // form the region tier pushes, so the standby's mirror rides the
+      // same clock-skew-free reconstruction.
+      digest_to_pb(make_digest(state_, now_ms(), opt_), &resp);
+      if (state_.prev_quorum.has_value())
+        *resp.mutable_quorum() = *state_.prev_quorum;
+    }
+  }
+  send_msg(sock, MsgType::kRootSyncResp, resp);
+}
+
+void Lighthouse::probe_peers_fence() {
+  int64_t my_epoch;
+  {
+    MutexLock lock(mu_);
+    if (!active_) return;
+    my_epoch = root_epoch_;
+  }
+  for (const auto& peer : peers_) {
+    torchft_tpu::RootSyncResponse resp;
+    if (!root_sync_call(peer, my_epoch, 1000, &resp)) continue;
+    MutexLock lock(mu_);
+    seen_peer_epoch_ = std::max(seen_peer_epoch_, resp.root_epoch());
+    // Deposed: a peer claimed a higher epoch while we were down or
+    // stalled — or the SAME epoch (a collided claim: our startup probe
+    // missed it), broken by claim-nonce order so exactly one side
+    // demotes. Fence — become its standby instead of forking history.
+    bool deposed =
+        resp.active() &&
+        (resp.root_epoch() > root_epoch_ ||
+         (resp.root_epoch() == root_epoch_ &&
+          resp.claim_nonce() > claim_nonce_));
+    if (active_ && deposed) {
+      active_ = false;
+      last_sync_ok_ms_ = now_ms();
+      LOG_WARN("deposed: peer " << peer << " is ACTIVE at root epoch "
+                                << resp.root_epoch() << " (ours "
+                                << root_epoch_ << "); demoting to standby");
+      // Wake parked long-polls so they bail out with the standby error
+      // instead of stalling to their deadlines.
+      quorum_cv_.notify_all();
+    }
+  }
+}
+
+bool Lighthouse::sync_from_peers() {
+  int64_t my_epoch;
+  {
+    MutexLock lock(mu_);
+    my_epoch = root_epoch_;
+  }
+  for (const auto& peer : peers_) {
+    torchft_tpu::RootSyncResponse resp;
+    if (!root_sync_call(peer, my_epoch,
+                        std::min<int64_t>(takeover_ms_ / 2 + 1, 2000), &resp))
+      continue;
+    MutexLock lock(mu_);
+    seen_peer_epoch_ = std::max(seen_peer_epoch_, resp.root_epoch());
+    if (!resp.active()) continue;  // a fellow standby: epoch info only
+    // Full-replace the mirror from the active root's digest: members the
+    // primary departed/pruned simply stop appearing, so no tombstone
+    // protocol is needed.
+    LighthouseState fresh;
+    fresh.quorum_id = resp.quorum_id();
+    if (resp.has_quorum()) fresh.prev_quorum = resp.quorum();
+    int64_t now = now_ms();
+    for (const auto& e : digest_from_pb(resp)) {
+      if (e.replica_id.empty()) continue;
+      fresh.heartbeats[e.replica_id] = now - e.lease_age_ms;
+      if (e.ttl_ms > 0) fresh.lease_ttls[e.replica_id] = e.ttl_ms;
+      if (!e.status_json.empty())
+        fresh.member_status[e.replica_id] = e.status_json;
+      if (e.participating) {
+        fresh.participants[e.replica_id] =
+            ParticipantDetails{now - e.joined_age_ms, e.member};
+      }
+    }
+    bool advanced = fresh.quorum_id > wal_quorum_logged_;
+    state_ = std::move(fresh);
+    if (resp.has_quorum()) latest_quorum_ = resp.quorum();
+    quorum_gen_ = std::max(quorum_gen_, resp.quorum_gen());
+    last_sync_ok_ms_ = now;
+    // Standby-side durability: the mirrored watermark must survive OUR
+    // crash too, or a restart-then-takeover could regress below what the
+    // fleet already saw. A full snapshot per advance also keeps the
+    // mirrored leases warm on disk.
+    if (wal_ && advanced) {
+      try {
+        wal_->snapshot(state_, quorum_gen_, root_epoch_, now_ms(), unix_ms());
+        wal_quorum_logged_ = state_.quorum_id;
+      } catch (const std::exception& e) {
+        if (!wal_dead_logged_) {
+          wal_dead_logged_ = true;
+          LOG_ERROR("standby snapshot failed (" << e.what() << ")");
+        }
+      }
+    }
+    return true;
+  }
+  return false;
+}
+
+void Lighthouse::do_takeover() {
+  MutexLock lock(mu_);
+  if (active_ || shutting_down_) return;
+  int64_t epoch = std::max(root_epoch_, seen_peer_epoch_) + 1;
+  if (wal_) {
+    // The epoch claim must be durable BEFORE serving: a takeover that
+    // crashes and restarts must still outrank the primary it deposed.
+    try {
+      wal_->log_epoch(epoch);
+    } catch (const std::exception& e) {
+      if (!wal_dead_logged_) {
+        wal_dead_logged_ = true;
+        LOG_ERROR("takeover epoch WAL append failed (" << e.what()
+                                                       << "); staying standby");
+      }
+      return;
+    }
+  }
+  root_epoch_ = epoch;
+  claim_nonce_ = fresh_claim_nonce();
+  active_ = true;
+  last_tick_ms_ = now_ms();
+  LOG_WARN("standby TAKEOVER: no active-root sync within "
+           << takeover_ms_ << " ms; serving as root epoch " << root_epoch_
+           << " (quorum_id watermark " << state_.quorum_id << ")");
+}
+
+void Lighthouse::peer_loop() {
+  while (!shutting_down_) {
+    bool active;
+    {
+      MutexLock lock(mu_);
+      active = active_;
+    }
+    int64_t nap;
+    if (active) {
+      probe_peers_fence();
+      nap = std::max<int64_t>(500, takeover_ms_ / 2);
+    } else {
+      bool ok = sync_from_peers();
+      int64_t starving_ms;
+      {
+        MutexLock lock(mu_);
+        starving_ms = now_ms() - last_sync_ok_ms_;
+      }
+      if (!ok && starving_ms > takeover_ms_) do_takeover();
+      nap = std::max<int64_t>(50, std::min<int64_t>(takeover_ms_ / 4, 1000));
+    }
+    while (nap > 0 && !shutting_down_) {
+      int64_t chunk = nap < 50 ? nap : 50;
+      struct timespec ts;
+      ts.tv_sec = chunk / 1000;
+      ts.tv_nsec = (chunk % 1000) * 1000000;
+      nanosleep(&ts, nullptr);
+      nap -= chunk;
+    }
+  }
 }
 
 namespace {
@@ -427,7 +956,33 @@ std::string Lighthouse::render_status_locked() {
 Json Lighthouse::status_json_locked() {
   int64_t now = now_ms();
   JsonObject o;
-  o["role"] = std::string(regions_.empty() ? "flat" : "root");
+  o["role"] = std::string(!active_ ? "standby"
+                                   : (regions_.empty() ? "flat" : "root"));
+  o["active"] = active_;
+  // Durability stamps: a COLD root (nothing to remember) and an AMNESIAC
+  // one (had state, lost it) look identical in the member list — the
+  // root_epoch + wal_replayed pair tells them apart: a restarted durable
+  // root shows wal_replayed=true and root_epoch >= 2.
+  o["root_epoch"] = root_epoch_;
+  o["wal_enabled"] = wal_ != nullptr;
+  o["wal_replayed"] = wal_replayed_;
+  if (wal_) {
+    JsonObject w;
+    w["records_replayed"] = wal_records_replayed_;
+    w["dropped_tail_bytes"] = wal_dropped_tail_bytes_;
+    w["replay_ms"] = wal_replay_ms_;
+    w["records_appended"] = wal_->records_appended();
+    w["snapshots_written"] = wal_->snapshots_written();
+    w["dead"] = wal_->dead();
+    o["wal"] = Json(std::move(w));
+  }
+  if (!peers_.empty() || opt_.standby) {
+    JsonArray ps;
+    for (const auto& p : peers_) ps.push_back(Json(p));
+    o["peers"] = Json(std::move(ps));
+    o["seen_peer_epoch"] = seen_peer_epoch_;
+    if (!active_) o["last_sync_age_ms"] = now - last_sync_ok_ms_;
+  }
   o["quorum_id"] = state_.quorum_id;
   o["quorum_gen"] = quorum_gen_;
   if (state_.quorum_formed_ms >= 0) {

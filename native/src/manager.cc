@@ -4,6 +4,7 @@
 
 #include <unistd.h>
 
+#include <cctype>
 #include <chrono>
 #include <functional>
 
@@ -22,14 +23,16 @@ LighthouseClient::LighthouseClient(const std::string& addr,
                                    int64_t connect_timeout_ms)
     : addr_(addr), connect_timeout_ms_(connect_timeout_ms) {}
 
-Quorum LighthouseClient::quorum(const QuorumMember& requester, int64_t timeout_ms) {
+Quorum LighthouseClient::quorum(const QuorumMember& requester, int64_t timeout_ms,
+                                int64_t connect_timeout_ms) {
   torchft_tpu::LighthouseQuorumRequest req;
   *req.mutable_requester() = requester;
   req.set_timeout_ms(timeout_ms);
   auto resp = call<torchft_tpu::LighthouseQuorumRequest,
                    torchft_tpu::LighthouseQuorumResponse>(
       addr_, MsgType::kLighthouseQuorumReq, req, MsgType::kLighthouseQuorumResp,
-      connect_timeout_ms_, timeout_ms);
+      connect_timeout_ms > 0 ? connect_timeout_ms : connect_timeout_ms_,
+      timeout_ms);
   return resp.quorum();
 }
 
@@ -86,7 +89,8 @@ ManagerServer::ManagerServer(const std::string& replica_id,
                              int64_t connect_timeout_ms,
                              const std::string& root_addr, int64_t lease_ttl_ms,
                              const std::string& region,
-                             const std::string& host)
+                             const std::string& host,
+                             int64_t region_probe_max)
     : replica_id_(replica_id),
       lighthouse_addr_(lighthouse_addr),
       root_addr_(root_addr == lighthouse_addr ? "" : root_addr),
@@ -98,26 +102,64 @@ ManagerServer::ManagerServer(const std::string& replica_id,
       heartbeat_interval_ms_(heartbeat_interval_ms),
       connect_timeout_ms_(connect_timeout_ms),
       lease_ttl_ms_(lease_ttl_ms),
-      listener_(std::make_unique<Listener>(bind)),
-      lighthouse_client_(
-          std::make_unique<LighthouseClient>(lighthouse_addr, connect_timeout_ms)) {
-  if (!root_addr_.empty()) {
-    root_client_ =
-        std::make_unique<LighthouseClient>(root_addr_, connect_timeout_ms);
+      region_probe_max_(region_probe_max),
+      listener_(std::make_unique<Listener>(bind)) {
+  for (const auto& addr : split_addr_list(lighthouse_addr_)) {
+    lighthouse_clients_.push_back(
+        std::make_unique<LighthouseClient>(addr, connect_timeout_ms));
+  }
+  if (lighthouse_clients_.empty()) {
+    throw std::runtime_error("manager: empty lighthouse address");
+  }
+  for (const auto& addr : split_addr_list(root_addr_)) {
+    root_clients_.push_back(
+        std::make_unique<LighthouseClient>(addr, connect_timeout_ms));
   }
   // Fail fast if the lighthouse is unreachable, mirroring the reference's
-  // connect-at-construction (src/manager.rs:97). With a root fallback
-  // configured, a dead region demotes us at construction instead of failing.
-  try {
-    lighthouse_client_->heartbeat(replica_id_, connect_timeout_ms);
-  } catch (const std::exception& e) {
-    if (!root_client_) throw;
+  // connect-at-construction (src/manager.rs:97). Endpoint lists are tried
+  // in order (a standby root rejects with UNAVAILABLE and we move on);
+  // with a root fallback configured, a dead region demotes us at
+  // construction instead of failing.
+  std::string last_err;
+  bool connected = false;
+  size_t start_idx = 0;
+  for (size_t i = 0; i < lighthouse_clients_.size() && !connected; i++) {
+    try {
+      lighthouse_clients_[i]->heartbeat(replica_id_, connect_timeout_ms);
+      connected = true;
+      start_idx = i;
+    } catch (const std::exception& e) {
+      last_err = e.what();
+    }
+  }
+  if (connected) {
+    MutexLock lock(lh_mu_);
+    lh_idx_ = start_idx;
+  } else {
+    if (root_clients_.empty()) {
+      throw std::runtime_error("lighthouse unreachable at startup: " +
+                               last_err);
+    }
     LOG_WARN("region lighthouse " << lighthouse_addr_ << " unreachable at "
-                                  << "startup (" << e.what()
+                                  << "startup (" << last_err
                                   << "); registering directly at root");
-    root_client_->heartbeat(replica_id_, connect_timeout_ms);
+    bool root_ok = false;
+    for (size_t i = 0; i < root_clients_.size() && !root_ok; i++) {
+      try {
+        root_clients_[i]->heartbeat(replica_id_, connect_timeout_ms);
+        root_ok = true;
+        start_idx = i;
+      } catch (const std::exception& e) {
+        last_err = e.what();
+      }
+    }
+    if (!root_ok) {
+      throw std::runtime_error("no lighthouse or root endpoint reachable: " +
+                               last_err);
+    }
     MutexLock lock(lh_mu_);
     using_root_ = true;
+    root_idx_ = start_idx;
   }
   accept_thread_ = std::thread([this] { accept_loop(); });
   heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
@@ -150,15 +192,41 @@ bool ManagerServer::using_root_fallback() {
   return using_root_;
 }
 
+bool ManagerServer::region_probe_given_up() {
+  MutexLock lock(lh_mu_);
+  return probe_given_up_;
+}
+
 void ManagerServer::set_status_json(const std::string& status_json) {
   MutexLock lock(mu_);
   status_json_ = status_json;
 }
 
-LighthouseClient* ManagerServer::active_lighthouse() {
+ManagerServer::EndpointPick ManagerServer::pick_endpoint() {
   MutexLock lock(lh_mu_);
-  return using_root_ && root_client_ ? root_client_.get()
-                                     : lighthouse_client_.get();
+  EndpointPick pick;
+  pick.on_root = using_root_ && !root_clients_.empty();
+  if (pick.on_root) {
+    pick.idx = root_idx_ % root_clients_.size();
+    pick.client = root_clients_[pick.idx].get();
+  } else {
+    pick.idx = lh_idx_ % lighthouse_clients_.size();
+    pick.client = lighthouse_clients_[pick.idx].get();
+  }
+  return pick;
+}
+
+void ManagerServer::rotate_if_current(const EndpointPick& pick) {
+  MutexLock lock(lh_mu_);
+  bool on_root = using_root_ && !root_clients_.empty();
+  if (on_root != pick.on_root) return;  // the list itself changed
+  if (on_root) {
+    if (root_clients_.size() > 1 && root_idx_ % root_clients_.size() == pick.idx)
+      root_idx_ = (pick.idx + 1) % root_clients_.size();
+  } else if (lighthouse_clients_.size() > 1 &&
+             lh_idx_ % lighthouse_clients_.size() == pick.idx) {
+    lh_idx_ = (pick.idx + 1) % lighthouse_clients_.size();
+  }
 }
 
 void ManagerServer::accept_loop() {
@@ -181,17 +249,18 @@ void ManagerServer::heartbeat_loop() {
   const uint64_t seed = std::hash<std::string>{}(replica_id_);
   uint64_t tick = 0;
   int failures = 0;
+  int probe_failures = 0;
   int64_t next_region_probe_ms = 0;
   const int64_t probe_interval_ms =
       lease_ttl_ms_ > 0 ? lease_ttl_ms_ : heartbeat_interval_ms_ * 10;
   while (!shutting_down_) {
-    bool on_root;
-    LighthouseClient* client;
+    bool probing_enabled;
     {
       MutexLock lock(lh_mu_);
-      on_root = using_root_ && root_client_ != nullptr;
-      client = on_root ? root_client_.get() : lighthouse_client_.get();
+      probing_enabled = !probe_given_up_;
     }
+    EndpointPick pick = pick_endpoint();
+    bool on_root = pick.on_root;
     try {
       std::vector<LeaseEntry> entries(1);
       entries[0].replica_id = replica_id_;
@@ -200,14 +269,22 @@ void ManagerServer::heartbeat_loop() {
         MutexLock lock(mu_);
         entries[0].status_json = status_json_;
       }
-      client->lease_renew(entries, heartbeat_interval_ms_ * 10);
+      pick.client->lease_renew(entries, heartbeat_interval_ms_ * 10);
       failures = 0;
     } catch (const std::exception& e) {
       failures += 1;
       LOG_WARN("lease renewal to " << (on_root ? "root" : "lighthouse")
                                    << " failed (x" << failures
                                    << "): " << e.what());
-      if (!on_root && failures >= 2 && root_client_) {
+      // Rotate to the next endpoint of the active list: a killed or
+      // deposed root (a standby answers UNAVAILABLE) hands the group to
+      // the next member of the failover set on the very next renewal
+      // instead of camping on a dead address. Compare-and-rotate so a
+      // concurrent quorum-forward failure can't double-rotate us past
+      // the live endpoint.
+      rotate_if_current(pick);
+      if (!on_root && failures >= 2 * static_cast<int>(lighthouse_clients_.size())
+          && !root_clients_.empty()) {
         LOG_WARN("region lighthouse " << lighthouse_addr_
                                       << " unresponsive; demoting "
                                       << replica_id_
@@ -217,16 +294,30 @@ void ManagerServer::heartbeat_loop() {
         failures = 0;
       }
     }
-    if (on_root && now_ms() >= next_region_probe_ms) {
+    if (on_root && probing_enabled && now_ms() >= next_region_probe_ms) {
       next_region_probe_ms = now_ms() + probe_interval_ms;
       try {
-        lighthouse_client_->heartbeat(replica_id_, heartbeat_interval_ms_ * 5);
+        lighthouse_clients_[0]->heartbeat(replica_id_,
+                                          heartbeat_interval_ms_ * 5);
         LOG_INFO("region lighthouse " << lighthouse_addr_
                                       << " is back; leaving root fallback");
         MutexLock lock(lh_mu_);
         using_root_ = false;
+        probe_failures = 0;
       } catch (const std::exception&) {
         // still down; stay on the root
+        probe_failures += 1;
+        if (region_probe_max_ > 0 && probe_failures >= region_probe_max_) {
+          // Bounded give-up: a region that is GONE from the topology
+          // (not merely restarting) would otherwise eat one doomed
+          // connect attempt per TTL for the rest of this tenure.
+          LOG_WARN("region lighthouse "
+                   << lighthouse_addr_ << " still unreachable after "
+                   << probe_failures
+                   << " re-probes; giving up — staying on the root");
+          MutexLock lock(lh_mu_);
+          probe_given_up_ = true;
+        }
       }
     }
     int64_t sleep_ms =
@@ -335,23 +426,80 @@ void ManagerServer::handle_quorum(Socket& sock, const std::string& payload) {
     std::optional<Quorum> got;
     std::string err;
     ErrorResponse::Code err_code = ErrorResponse::UNAVAILABLE;
-    try {
-      got = active_lighthouse()->quorum(requester, req.timeout_ms());
-      LOG_INFO("got lighthouse quorum id=" << got->quorum_id());
-    } catch (const TimeoutError& e) {
-      // Preserve deadline semantics so the client raises TimeoutError,
-      // mirroring the reference's DeadlineExceeded mapping (src/lib.rs:321-333).
-      err = e.what();
-      err_code = ErrorResponse::DEADLINE_EXCEEDED;
-      LOG_ERROR("lighthouse quorum failed: " << err);
-    } catch (const RpcError& e) {
-      err = e.what();
-      err_code = e.code;
-      LOG_ERROR("lighthouse quorum failed: " << err);
-    } catch (const std::exception& e) {
-      err = e.what();
-      err_code = ErrorResponse::UNAVAILABLE;
-      LOG_ERROR("lighthouse quorum failed: " << err);
+    // Forward with bounded endpoint-walk retries INSIDE the client's own
+    // deadline: a root killed mid-poll (connection reset) or a standby's
+    // UNAVAILABLE rejection rotates and retries the next endpoint of the
+    // failover set, so a root failover is transparent at the manager
+    // boundary — callers see at worst added latency, not an error, and
+    // quorums re-form without any trainer-process restart. Deadline
+    // exhaustion still surfaces as the reference's TimeoutError mapping.
+    int64_t fw_deadline = req.timeout_ms() <= 0 ? -1 : now_ms() + req.timeout_ms();
+    // With a failover set, one dead endpoint must not spend the whole
+    // quorum deadline in connect retries: bound per-attempt connects and
+    // walk on. A single-endpoint manager keeps the classic full-window
+    // connect (pre-failover semantics).
+    bool multi = lighthouse_clients_.size() + root_clients_.size() > 1;
+    int64_t attempt_connect_ms =
+        multi ? std::min<int64_t>(connect_timeout_ms_, 3000) : -1;
+    while (true) {
+      EndpointPick pick = pick_endpoint();
+      int64_t remain =
+          fw_deadline < 0 ? req.timeout_ms() : fw_deadline - now_ms();
+      if (fw_deadline >= 0 && remain <= 0) {
+        err = "lighthouse quorum timed out across root endpoints";
+        err_code = ErrorResponse::DEADLINE_EXCEEDED;
+        break;
+      }
+      try {
+        got = pick.client->quorum(requester, remain, attempt_connect_ms);
+        LOG_INFO("got lighthouse quorum id=" << got->quorum_id());
+        break;
+      } catch (const TimeoutError& e) {
+        err = e.what();
+        err_code = ErrorResponse::DEADLINE_EXCEEDED;
+        LOG_ERROR("lighthouse quorum failed: " << err);
+        rotate_if_current(pick);
+        if (multi && !shutting_down_) {
+          // A bounded per-attempt CONNECT timeout is not the client's
+          // deadline: keep walking; the loop-top check surfaces the real
+          // DEADLINE_EXCEEDED (preserving the reference's TimeoutError
+          // mapping, src/lib.rs:321-333) once remain runs out.
+          continue;
+        }
+        break;
+      } catch (const RpcError& e) {
+        err = e.what();
+        err_code = e.code;
+        LOG_ERROR("lighthouse quorum failed: " << err);
+        rotate_if_current(pick);
+        if (e.code == ErrorResponse::UNAVAILABLE && multi &&
+            !shutting_down_) {
+          // A standby's rejection: walk to the next endpoint (brief
+          // pause — a takeover may still be in flight).
+          struct timespec ts = {0, 100 * 1000000};
+          nanosleep(&ts, nullptr);
+          continue;
+        }
+        break;  // real protocol errors surface to the ranks
+      } catch (const std::exception& e) {
+        err = e.what();
+        err_code = ErrorResponse::UNAVAILABLE;
+        LOG_ERROR("lighthouse quorum failed: " << err);
+        rotate_if_current(pick);
+        if (multi && !shutting_down_) {
+          // Transient transport failure (a root killed mid-poll resets
+          // the connection; the next connect is refused until the
+          // standby takes over): keep walking the failover set inside
+          // the client's own deadline — the whole point of the endpoint
+          // list is that this never surfaces as a step error. A
+          // SINGLE-endpoint manager keeps the classic fast-fail
+          // (UNAVAILABLE to the ranks after one attempt).
+          struct timespec ts = {0, 200 * 1000000};
+          nanosleep(&ts, nullptr);
+          continue;
+        }
+        break;
+      }
     }
     lock.lock();
     if (quorum_gen_ == gen) {

@@ -27,8 +27,12 @@ class LighthouseClient {
  public:
   LighthouseClient(const std::string& addr, int64_t connect_timeout_ms);
 
+  // connect_timeout_ms <= 0 uses the client's constructor value; the
+  // manager's failover walk passes a SHORT bound so one dead endpoint
+  // cannot eat the whole quorum deadline connecting.
   torchft_tpu::Quorum quorum(const torchft_tpu::QuorumMember& requester,
-                             int64_t timeout_ms);
+                             int64_t timeout_ms,
+                             int64_t connect_timeout_ms = -1);
   void heartbeat(const std::string& replica_id, int64_t timeout_ms);
   // Batched lease renewal; returns the lighthouse's current quorum_id.
   int64_t lease_renew(const std::vector<LeaseEntry>& entries, int64_t timeout_ms);
@@ -56,9 +60,17 @@ class ManagerServer {
  public:
   // `lighthouse_addr` is the group's assigned lighthouse: the flat/root
   // service, or a REGION lighthouse when a hierarchical tier is deployed.
+  // Both it and `root_addr` may be COMMA-SEPARATED endpoint lists (the
+  // durable-control-plane failover set: an active root plus its warm
+  // standbys); a failed renewal/quorum rotates to the next endpoint on
+  // the existing jittered-backoff schedule, and a standby's UNAVAILABLE
+  // rejection rotates the same way.
   // `root_addr` (optional, "" = none) is the root fallback: when the region
   // stops answering, the manager demotes itself to direct-root registration
-  // and probes the region periodically until it returns. `lease_ttl_ms`
+  // and probes the region periodically until it returns (bounded by
+  // `region_probe_max` consecutive failures — a long root-fallback tenure
+  // must not leak a connect attempt per TTL forever; 0 = probe forever).
+  // `lease_ttl_ms`
   // <= 0 leaves liveness on the lighthouse's heartbeat_timeout_ms default.
   // `region` (optional, "" = unlabeled) is the group's topology label
   // (TORCHFT_REGION): it rides the quorum requester into every member's
@@ -72,7 +84,8 @@ class ManagerServer {
                 const std::string& store_addr, uint64_t world_size,
                 int64_t heartbeat_interval_ms, int64_t connect_timeout_ms,
                 const std::string& root_addr = "", int64_t lease_ttl_ms = 0,
-                const std::string& region = "", const std::string& host = "");
+                const std::string& region = "", const std::string& host = "",
+                int64_t region_probe_max = 0);
   ~ManagerServer();
 
   std::string address() const; // "http://host:port"
@@ -80,6 +93,11 @@ class ManagerServer {
   // Whether the manager is currently registered directly at the root
   // (region failover active). Always false without a root_addr.
   bool using_root_fallback();
+  // Whether the bounded region re-probe gave up (region_probe_max
+  // consecutive failed probes while demoted): the manager stays on the
+  // root for the rest of its life instead of leaking a connect attempt
+  // per TTL at a region that is gone from the topology.
+  bool region_probe_given_up();
   // Publishes a member-health digest (JSON string) that rides every
   // subsequent lease renewal to the lighthouse, where it appears in the
   // per-member /status.json view. Display-only. Empty stops PUBLISHING
@@ -95,8 +113,20 @@ class ManagerServer {
   void handle_conn(Socket& sock);
   void handle_quorum(Socket& sock, const std::string& payload);
   void handle_should_commit(Socket& sock, const std::string& payload);
-  // The client quorum/renewal traffic should currently flow through.
-  LighthouseClient* active_lighthouse();
+  // The endpoint client quorum/renewal traffic should currently flow
+  // through, with the (list, index) it was picked from — the token
+  // rotate_if_current() needs.
+  struct EndpointPick {
+    bool on_root = false;
+    size_t idx = 0;
+    LighthouseClient* client = nullptr;
+  };
+  EndpointPick pick_endpoint();
+  // Advance to the next endpoint of the picked list after a failure —
+  // but only if nobody rotated it since the failing call picked it
+  // (compare-and-rotate): a slow failing quorum forward must not undo
+  // the renewal loop's rotation onto a live endpoint.
+  void rotate_if_current(const EndpointPick& pick);
 
   std::string replica_id_;
   std::string lighthouse_addr_;
@@ -109,16 +139,23 @@ class ManagerServer {
   int64_t heartbeat_interval_ms_;
   int64_t connect_timeout_ms_;
   int64_t lease_ttl_ms_;
+  int64_t region_probe_max_;
 
   std::unique_ptr<Listener> listener_;
-  std::unique_ptr<LighthouseClient> lighthouse_client_;
-  std::unique_ptr<LighthouseClient> root_client_; // null without root_addr
+  // One persistent client per endpoint of each (comma-separated) list;
+  // the failover sets of the durable control plane. Vectors are built in
+  // the constructor and never resized after — readers copy the active
+  // pointer under lh_mu_ and call through it lock-free (every client
+  // outlives every reader: destroyed only after the threads join).
+  std::vector<std::unique_ptr<LighthouseClient>> lighthouse_clients_;
+  std::vector<std::unique_ptr<LighthouseClient>> root_clients_; // empty without root_addr
 
-  // Region-failover state. Both clients outlive every reader (destroyed
-  // only after the threads join), so readers copy the active pointer under
-  // lh_mu_ and call through it lock-free.
+  // Region-failover + endpoint-rotation state.
   Mutex lh_mu_;
   bool using_root_ TFT_GUARDED_BY(lh_mu_) = false;
+  size_t lh_idx_ TFT_GUARDED_BY(lh_mu_) = 0;
+  size_t root_idx_ TFT_GUARDED_BY(lh_mu_) = 0;
+  bool probe_given_up_ TFT_GUARDED_BY(lh_mu_) = false;
 
   Mutex mu_;
   std::string status_json_ TFT_GUARDED_BY(mu_);

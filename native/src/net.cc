@@ -575,4 +575,19 @@ int64_t jittered_interval_ms(int64_t interval_ms, uint64_t seed, uint64_t tick) 
   return static_cast<int64_t>(static_cast<double>(interval_ms) * f);
 }
 
+std::vector<std::string> split_addr_list(const std::string& s) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (char c : s) {
+    if (c == ',') {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+    } else if (!std::isspace(static_cast<unsigned char>(c))) {
+      cur.push_back(c);
+    }
+  }
+  if (!cur.empty()) out.push_back(cur);
+  return out;
+}
+
 } // namespace tft

@@ -8,6 +8,7 @@
 #include <optional>
 #include <stdexcept>
 #include <string>
+#include <vector>
 
 namespace tft {
 
@@ -137,5 +138,12 @@ int64_t backoff_ms(int failures, int64_t base_ms, int64_t max_ms, uint64_t seed)
 // Jittered interval for periodic work: interval scaled by [0.75, 1.25),
 // deterministic in (seed, tick). Spreads renewal herds across groups.
 int64_t jittered_interval_ms(int64_t interval_ms, uint64_t seed, uint64_t tick);
+
+// Comma-separated endpoint list -> vector (whitespace stripped, empty
+// entries dropped). THE parser for root failover sets
+// (TORCHFT_LIGHTHOUSE_ROOT / TORCHFT_LH_PEERS): the manager, the region
+// tier and the lighthouse must split the same wire format identically,
+// so there is exactly one implementation.
+std::vector<std::string> split_addr_list(const std::string& s);
 
 } // namespace tft

@@ -665,6 +665,123 @@ class RegionPollResponse {
   int64_t gen_ = 0;
 };
 
+class RootSyncRequest {
+ public:
+  int64_t root_epoch() const { return root_epoch_; }
+  void set_root_epoch(int64_t v) { root_epoch_ = v; }
+  int64_t quorum_gen() const { return quorum_gen_; }
+  void set_quorum_gen(int64_t v) { quorum_gen_ = v; }
+  bool has_quorum() const { return has_quorum_; }
+  const Quorum& quorum() const { return quorum_; }
+  Quorum* mutable_quorum() {
+    has_quorum_ = true;
+    return &quorum_;
+  }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, root_epoch_);
+    tft_pb::put_int64(out, 2, quorum_gen_);
+    if (has_quorum_)
+      tft_pb::put_len_prefixed(out, 3, quorum_.SerializeAsString());
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { root_epoch_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2: if (w == 0) { quorum_gen_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 3:
+        if (w == 2) {
+          has_quorum_ = true;
+          if (!quorum_.ParseFromString(r.bytes())) r.fail = true;
+          return true;
+        }
+        break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t root_epoch_ = 0, quorum_gen_ = 0;
+  Quorum quorum_;
+  bool has_quorum_ = false;
+};
+
+class RootSyncResponse {
+ public:
+  int64_t root_epoch() const { return root_epoch_; }
+  void set_root_epoch(int64_t v) { root_epoch_ = v; }
+  bool active() const { return active_; }
+  void set_active(bool v) { active_ = v; }
+  int64_t quorum_id() const { return quorum_id_; }
+  void set_quorum_id(int64_t v) { quorum_id_ = v; }
+  int64_t quorum_gen() const { return quorum_gen_; }
+  void set_quorum_gen(int64_t v) { quorum_gen_ = v; }
+  const std::vector<DigestEntry>& entries() const { return entries_; }
+  int entries_size() const { return static_cast<int>(entries_.size()); }
+  DigestEntry* add_entries() {
+    entries_.emplace_back();
+    return &entries_.back();
+  }
+  bool has_quorum() const { return has_quorum_; }
+  const Quorum& quorum() const { return quorum_; }
+  Quorum* mutable_quorum() {
+    has_quorum_ = true;
+    return &quorum_;
+  }
+  uint64_t claim_nonce() const { return claim_nonce_; }
+  void set_claim_nonce(uint64_t v) { claim_nonce_ = v; }
+
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, root_epoch_);
+    tft_pb::put_bool(out, 2, active_);
+    tft_pb::put_int64(out, 3, quorum_id_);
+    tft_pb::put_int64(out, 4, quorum_gen_);
+    for (const auto& e : entries_)
+      tft_pb::put_len_prefixed(out, 5, e.SerializeAsString());
+    if (has_quorum_)
+      tft_pb::put_len_prefixed(out, 6, quorum_.SerializeAsString());
+    if (claim_nonce_ != 0) {
+      tft_pb::put_tag(out, 7, 0);
+      tft_pb::put_varint(out, claim_nonce_);
+    }
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 0) { root_epoch_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 2: if (w == 0) { active_ = r.varint() != 0; return true; } break;
+      case 3: if (w == 0) { quorum_id_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 4: if (w == 0) { quorum_gen_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 5:
+        if (w == 2) {
+          DigestEntry e;
+          if (!e.ParseFromString(r.bytes())) { r.fail = true; return true; }
+          entries_.push_back(std::move(e));
+          return true;
+        }
+        break;
+      case 6:
+        if (w == 2) {
+          has_quorum_ = true;
+          if (!quorum_.ParseFromString(r.bytes())) r.fail = true;
+          return true;
+        }
+        break;
+      case 7: if (w == 0) { claim_nonce_ = r.varint(); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t root_epoch_ = 0;
+  bool active_ = false;
+  int64_t quorum_id_ = 0, quorum_gen_ = 0;
+  std::vector<DigestEntry> entries_;
+  Quorum quorum_;
+  bool has_quorum_ = false;
+  uint64_t claim_nonce_ = 0;
+};
+
 class ManagerQuorumRequest {
  public:
   int64_t rank() const { return rank_; }

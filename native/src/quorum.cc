@@ -580,6 +580,37 @@ void digest_to_pb(const std::vector<DigestEntry>& entries,
   }
 }
 
+std::vector<DigestEntry> digest_from_pb(const torchft_tpu::RootSyncResponse& resp) {
+  std::vector<DigestEntry> out;
+  out.reserve(static_cast<size_t>(resp.entries_size()));
+  for (const auto& pe : resp.entries()) {
+    DigestEntry e;
+    e.replica_id = pe.replica_id();
+    e.status_json = pe.status_json();
+    e.lease_age_ms = pe.lease_age_ms();
+    e.ttl_ms = pe.ttl_ms();
+    e.participating = pe.participating();
+    e.joined_age_ms = pe.joined_age_ms();
+    e.member = pe.member();
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+void digest_to_pb(const std::vector<DigestEntry>& entries,
+                  torchft_tpu::RootSyncResponse* resp) {
+  for (const auto& e : entries) {
+    auto* pe = resp->add_entries();
+    pe->set_replica_id(e.replica_id);
+    pe->set_lease_age_ms(e.lease_age_ms);
+    pe->set_ttl_ms(e.ttl_ms);
+    pe->set_participating(e.participating);
+    pe->set_joined_age_ms(e.joined_age_ms);
+    pe->set_status_json(e.status_json);
+    if (e.participating) *pe->mutable_member() = e.member;
+  }
+}
+
 std::vector<DigestEntry> digest_from_json(const Json& j) {
   std::vector<DigestEntry> out;
   for (const auto& ej : j.as_array()) {
@@ -603,6 +634,11 @@ LighthouseOpt lighthouse_opt_from_json(const Json& j) {
   opt.min_replicas = static_cast<uint64_t>(j.get_int("min_replicas", 1));
   opt.quorum_tick_ms = j.get_int("quorum_tick_ms", 100);
   opt.heartbeat_timeout_ms = j.get_int("heartbeat_timeout_ms", 5000);
+  opt.wal_dir = j.get_string("wal_dir", "");
+  opt.snapshot_every = j.get_int("snapshot_every", 0);
+  opt.peers = j.get_string("peers", "");
+  opt.standby = j.get_bool("standby", false);
+  opt.takeover_ms = j.get_int("takeover_ms", 0);
   return opt;
 }
 

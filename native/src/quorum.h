@@ -22,6 +22,22 @@ struct LighthouseOpt {
   uint64_t min_replicas = 1;
   int64_t quorum_tick_ms = 100;
   int64_t heartbeat_timeout_ms = 5000;
+  // ---- durable control plane (empty/0 = the pre-durability behavior) ----
+  // Write-ahead quorum log + snapshot directory (TORCHFT_LH_WAL_DIR):
+  // every externally visible promise (quorum commit, lease grant, depart,
+  // root-epoch claim) is logged before publication and replayed on
+  // restart — quorum_id never regresses across a root crash.
+  std::string wal_dir;
+  int64_t snapshot_every = 0;  // records per WAL compaction (0 = 512)
+  // Comma-separated OTHER root endpoints of this root's failover set
+  // (TORCHFT_LH_PEERS). A standby tails the active peer's state via
+  // RootSync digests and takes over when its lease lapses; an active
+  // root probes peers and fences itself behind a higher root epoch.
+  std::string peers;
+  bool standby = false;        // start passive (warm standby role)
+  // How long a standby tolerates sync starvation before taking over;
+  // also the active side's stall-self-fence bound (0 = 3000).
+  int64_t takeover_ms = 0;
 };
 
 struct ParticipantDetails {
@@ -180,5 +196,10 @@ void lease_entries_to_pb(const std::vector<LeaseEntry>& entries,
 std::vector<DigestEntry> digest_from_pb(const torchft_tpu::RegionDigestRequest& req);
 void digest_to_pb(const std::vector<DigestEntry>& entries,
                   torchft_tpu::RegionDigestRequest* req);
+// Same digest wire form, carried by the root-failover sync (standby
+// tails the active root's membership through these).
+std::vector<DigestEntry> digest_from_pb(const torchft_tpu::RootSyncResponse& resp);
+void digest_to_pb(const std::vector<DigestEntry>& entries,
+                  torchft_tpu::RootSyncResponse* resp);
 
 } // namespace tft

@@ -3,6 +3,7 @@
 #include <sys/socket.h>
 
 #include <algorithm>
+#include <cctype>
 #include <chrono>
 #include <functional>
 
@@ -20,10 +21,14 @@ RegionLighthouse::RegionLighthouse(const std::string& bind_addr,
                                    const std::string& region_id,
                                    const RegionOpt& opt)
     : root_addr_(root_addr),
+      root_endpoints_(split_addr_list(root_addr)),
       region_id_(region_id),
       opt_(opt),
       listener_(std::make_unique<Listener>(bind_addr)),
       hostname_(local_hostname()) {
+  if (root_endpoints_.empty()) {
+    throw std::runtime_error("region lighthouse: empty root address");
+  }
   lh_opt_.heartbeat_timeout_ms = opt_.heartbeat_timeout_ms;
   accept_thread_ = std::thread([this] { accept_loop(); });
   digest_thread_ = std::thread([this] { digest_loop(); });
@@ -89,6 +94,7 @@ void nap_ms(int64_t total, const std::atomic<bool>& stop) {
 void RegionLighthouse::digest_loop() {
   Socket sock;
   int failures = 0;
+  size_t endpoint = 0;
   uint64_t seed = std::hash<std::string>{}(region_id_);
   while (!shutting_down_) {
     torchft_tpu::RegionDigestRequest req;
@@ -112,7 +118,8 @@ void RegionLighthouse::digest_loop() {
     try {
       if (!sock.valid()) {
         sock = connect_with_retry(
-            root_addr_, std::min<int64_t>(2000, opt_.connect_timeout_ms));
+            root_endpoints_[endpoint % root_endpoints_.size()],
+            std::min<int64_t>(2000, opt_.connect_timeout_ms));
         digest_fd_ = sock.fd();
         if (shutting_down_) break;
       }
@@ -130,6 +137,11 @@ void RegionLighthouse::digest_loop() {
       sock.close();
       digest_fd_ = -1;
       failures += 1;
+      // Rotate through the root failover set: a standby answers its
+      // UNAVAILABLE rejection (an RpcError landing here), a dead root
+      // fails to connect — either way the next attempt tries the next
+      // endpoint, finding a fresh active root within one walk.
+      endpoint = (endpoint + 1) % root_endpoints_.size();
       {
         MutexLock lock(mu_);
         root_connected_ = false;
@@ -146,6 +158,7 @@ void RegionLighthouse::digest_loop() {
 void RegionLighthouse::poll_loop() {
   Socket sock;
   int failures = 0;
+  size_t endpoint = 0;
   uint64_t seed = std::hash<std::string>{}(region_id_) ^ 0x5eedULL;
   while (!shutting_down_) {
     int64_t gen;
@@ -156,7 +169,8 @@ void RegionLighthouse::poll_loop() {
     try {
       if (!sock.valid()) {
         sock = connect_with_retry(
-            root_addr_, std::min<int64_t>(2000, opt_.connect_timeout_ms));
+            root_endpoints_[endpoint % root_endpoints_.size()],
+            std::min<int64_t>(2000, opt_.connect_timeout_ms));
         poll_fd_ = sock.fd();
         if (shutting_down_) break;
         // Fresh connection: the broadcast generation belongs to a root
@@ -203,14 +217,18 @@ void RegionLighthouse::poll_loop() {
         // consumed, so the connection is still in sync. Just re-poll.
         continue;
       }
+      // Any other error frame — a standby root's UNAVAILABLE rejection
+      // included — walks to the next endpoint of the failover set.
       sock.close();
       poll_fd_ = -1;
       failures += 1;
+      endpoint = (endpoint + 1) % root_endpoints_.size();
       nap_ms(backoff_ms(failures, 100, 5000, seed), shutting_down_);
     } catch (const std::exception&) {
       sock.close();
       poll_fd_ = -1;
       failures += 1;
+      endpoint = (endpoint + 1) % root_endpoints_.size();
       nap_ms(backoff_ms(failures, 100, 5000, seed), shutting_down_);
     }
   }

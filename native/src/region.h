@@ -81,7 +81,12 @@ class RegionLighthouse {
   void register_participant_locked(const torchft_tpu::QuorumMember& member)
       TFT_REQUIRES(mu_);
 
-  std::string root_addr_;
+  std::string root_addr_;  // the configured (possibly comma-separated) list
+  // Parsed endpoint list of the root failover set: the digest and poll
+  // loops each keep their own cursor into it and rotate on failure (a
+  // standby's UNAVAILABLE rejection counts — the loops walk to the
+  // active root on the existing backoff schedule).
+  std::vector<std::string> root_endpoints_;
   std::string region_id_;
   RegionOpt opt_;
   // LighthouseOpt view of opt_ for the shared pure functions (make_digest /

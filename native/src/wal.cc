@@ -1,0 +1,472 @@
+#include "wal.h"
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "fault.h"
+#include "log.h"
+
+namespace tft {
+
+namespace {
+
+constexpr uint8_t kRecEpoch = 1;
+constexpr uint8_t kRecLease = 2;
+constexpr uint8_t kRecDepart = 3;
+constexpr uint8_t kRecQuorum = 4;
+constexpr int64_t kDefaultSnapshotEvery = 512;
+// A record bigger than this is not a record — it is a corrupt length
+// word, and trusting it would make recovery read garbage as payload.
+constexpr uint32_t kMaxRecordBytes = 16u << 20;
+
+std::string wal_path(const std::string& dir) { return dir + "/wal.log"; }
+std::string snap_path(const std::string& dir) { return dir + "/snapshot.json"; }
+
+void put_u32(std::string& out, uint32_t v) {
+  out.push_back(static_cast<char>((v >> 24) & 0xff));
+  out.push_back(static_cast<char>((v >> 16) & 0xff));
+  out.push_back(static_cast<char>((v >> 8) & 0xff));
+  out.push_back(static_cast<char>(v & 0xff));
+}
+
+uint32_t get_u32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+void mkdirs(const std::string& dir) {
+  std::string partial;
+  for (size_t i = 0; i <= dir.size(); i++) {
+    if (i == dir.size() || dir[i] == '/') {
+      if (!partial.empty() && partial != "/") {
+        if (mkdir(partial.c_str(), 0777) != 0 && errno != EEXIST) {
+          throw std::runtime_error("mkdir " + partial + ": " +
+                                   std::strerror(errno));
+        }
+      }
+    }
+    if (i < dir.size()) partial.push_back(dir[i]);
+  }
+}
+
+// unix -> this process's monotonic clock. Can go negative for times
+// before process start; every consumer compares differences, so that is
+// fine.
+int64_t rebase(int64_t unix_when, int64_t mono_now, int64_t unix_now) {
+  return mono_now - (unix_now - unix_when);
+}
+
+// Durably journals a directory's entry table (the rename/create itself,
+// not just file contents): without this, a power loss can surface the
+// OLD directory state with NEW file contents — e.g. the pre-compaction
+// snapshot next to an already-truncated log, which would regress the
+// watermark the WAL exists to protect. Best-effort where the filesystem
+// refuses (fsync on a directory fd is EINVAL on some sandboxes).
+void fsync_dir(const std::string& dir) {
+  int dfd = ::open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd < 0) return;
+  ::fsync(dfd);
+  ::close(dfd);
+}
+
+} // namespace
+
+std::vector<WalLeaseEntry> wal_entries_from_state(
+    const LighthouseState& state, const std::vector<std::string>& ids,
+    int64_t mono_now) {
+  std::vector<WalLeaseEntry> out;
+  out.reserve(ids.size());
+  for (const auto& id : ids) {
+    auto hb = state.heartbeats.find(id);
+    if (hb == state.heartbeats.end()) continue;  // departed mid-batch
+    WalLeaseEntry e;
+    e.replica_id = id;
+    e.age_ms = mono_now - hb->second;
+    auto ttl = state.lease_ttls.find(id);
+    e.ttl_ms = ttl == state.lease_ttls.end() ? 0 : ttl->second;
+    auto p = state.participants.find(id);
+    if (p != state.participants.end()) {
+      e.participating = true;
+      e.joined_age_ms = mono_now - p->second.joined_ms;
+      e.member = p->second.member;
+    }
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+Json wal_lease_entries_to_json(const std::vector<WalLeaseEntry>& entries) {
+  JsonArray arr;
+  for (const auto& e : entries) {
+    JsonObject o;
+    o["replica_id"] = e.replica_id;
+    o["age_ms"] = e.age_ms;
+    o["ttl_ms"] = e.ttl_ms;
+    o["participating"] = e.participating;
+    if (e.participating) {
+      o["joined_age_ms"] = e.joined_age_ms;
+      o["member"] = member_to_json(e.member);
+    }
+    arr.push_back(Json(std::move(o)));
+  }
+  return Json(std::move(arr));
+}
+
+std::vector<WalLeaseEntry> wal_lease_entries_from_json(const Json& j) {
+  std::vector<WalLeaseEntry> out;
+  for (const auto& ej : j.as_array()) {
+    WalLeaseEntry e;
+    e.replica_id = ej.get_string("replica_id", "");
+    e.age_ms = ej.get_int("age_ms", 0);
+    e.ttl_ms = ej.get_int("ttl_ms", 0);
+    e.participating = ej.get_bool("participating", false);
+    e.joined_age_ms = ej.get_int("joined_age_ms", 0);
+    const Json& m = ej.at("member");
+    if (!m.is_null()) e.member = member_from_json(m);
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+DurableLog::DurableLog(const std::string& dir, int64_t snapshot_every)
+    : dir_(dir),
+      snapshot_every_(snapshot_every > 0 ? snapshot_every
+                                         : kDefaultSnapshotEvery) {
+  mkdirs(dir_);
+  MutexLock lock(mu_);
+  fd_ = ::open(wal_path(dir_).c_str(), O_CREAT | O_WRONLY | O_APPEND, 0666);
+  if (fd_ < 0) {
+    throw std::runtime_error("open " + wal_path(dir_) + ": " +
+                             std::strerror(errno));
+  }
+  // The log FILE's existence must survive a power loss too.
+  fsync_dir(dir_);
+}
+
+DurableLog::~DurableLog() {
+  MutexLock lock(mu_);
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = -1;
+}
+
+bool DurableLog::dead() {
+  MutexLock lock(mu_);
+  return dead_;
+}
+
+int64_t DurableLog::records_appended() {
+  MutexLock lock(mu_);
+  return records_;
+}
+
+int64_t DurableLog::snapshots_written() {
+  MutexLock lock(mu_);
+  return snapshots_;
+}
+
+void DurableLog::append_locked(uint8_t type, const std::string& payload,
+                               bool sync) {
+  if (dead_) throw WalTornError("log dead after a previous torn write");
+  if (fd_ < 0) throw WalTornError("log closed");
+  std::string frame;
+  frame.reserve(payload.size() + 9);
+  put_u32(frame, static_cast<uint32_t>(payload.size() + 1));
+  std::string body;
+  body.reserve(payload.size() + 1);
+  body.push_back(static_cast<char>(type));
+  body += payload;
+  put_u32(frame, fault::crc32c(body.data(), body.size()));
+  frame += body;
+
+  fault::Decision fd = TFT_FAULT_CHECK(fault::kSeamWalWrite, -1, op_seq_++);
+  if (fd.kind == fault::kDelay) {
+    struct timespec ts;
+    int64_t ms = fd.param > 0 ? fd.param : 50;
+    ts.tv_sec = ms / 1000;
+    ts.tv_nsec = (ms % 1000) * 1000000;
+    nanosleep(&ts, nullptr);
+  } else if (fd.kind == fault::kTruncate || fd.kind == fault::kDrop) {
+    // The crash-mid-append faults: `truncate` leaves half a record on
+    // disk (torn tail, dropped at recovery), `drop` crashes before any
+    // byte lands. Either way the log is DEAD — the process would be too.
+    if (fd.kind == fault::kTruncate) {
+      size_t half = frame.size() / 2;
+      ssize_t ignored = ::write(fd_, frame.data(), half);
+      (void)ignored;
+      ::fsync(fd_);
+    }
+    dead_ = true;
+    throw WalTornError("injected crash mid-append (wal_write seam)");
+  }
+
+  const char* p = frame.data();
+  size_t left = frame.size();
+  while (left > 0) {
+    ssize_t n = ::write(fd_, p, left);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      dead_ = true;
+      throw WalTornError(std::string("write: ") + std::strerror(errno));
+    }
+    p += n;
+    left -= static_cast<size_t>(n);
+  }
+  if (sync) ::fsync(fd_);
+  records_ += 1;
+  since_snapshot_ += 1;
+}
+
+void DurableLog::log_epoch(int64_t epoch) {
+  JsonObject o;
+  o["epoch"] = epoch;
+  MutexLock lock(mu_);
+  append_locked(kRecEpoch, Json(std::move(o)).dump(), /*sync=*/true);
+}
+
+void DurableLog::log_lease(const std::vector<WalLeaseEntry>& entries,
+                           int64_t unix_now) {
+  if (entries.empty()) return;
+  JsonObject o;
+  o["unix_ms"] = unix_now;
+  o["entries"] = wal_lease_entries_to_json(entries);
+  MutexLock lock(mu_);
+  append_locked(kRecLease, Json(std::move(o)).dump(), /*sync=*/false);
+}
+
+void DurableLog::log_depart(const std::string& replica_id) {
+  JsonObject o;
+  o["replica_id"] = replica_id;
+  MutexLock lock(mu_);
+  append_locked(kRecDepart, Json(std::move(o)).dump(), /*sync=*/true);
+}
+
+void DurableLog::log_quorum(const torchft_tpu::Quorum& quorum,
+                            int64_t quorum_gen, int64_t root_epoch) {
+  JsonObject o;
+  o["gen"] = quorum_gen;
+  o["epoch"] = root_epoch;
+  o["quorum"] = quorum_to_json(quorum);
+  MutexLock lock(mu_);
+  append_locked(kRecQuorum, Json(std::move(o)).dump(), /*sync=*/true);
+}
+
+void DurableLog::snapshot(const LighthouseState& state, int64_t quorum_gen,
+                          int64_t root_epoch, int64_t mono_now,
+                          int64_t unix_now) {
+  JsonObject o;
+  o["unix_ms"] = unix_now;
+  o["quorum_gen"] = quorum_gen;
+  o["root_epoch"] = root_epoch;
+  o["quorum_id"] = state.quorum_id;
+  JsonObject hb;
+  for (const auto& [id, last] : state.heartbeats)
+    hb[id] = unix_now - (mono_now - last);
+  o["heartbeats_unix"] = Json(std::move(hb));
+  JsonObject ttls;
+  for (const auto& [id, ttl] : state.lease_ttls) ttls[id] = ttl;
+  o["lease_ttls"] = Json(std::move(ttls));
+  JsonObject parts;
+  for (const auto& [id, d] : state.participants) {
+    JsonObject pj;
+    pj["joined_unix"] = unix_now - (mono_now - d.joined_ms);
+    pj["member"] = member_to_json(d.member);
+    parts[id] = Json(std::move(pj));
+  }
+  o["participants"] = Json(std::move(parts));
+  if (state.prev_quorum.has_value()) {
+    o["prev_quorum"] = quorum_to_json(*state.prev_quorum);
+  } else {
+    o["prev_quorum"] = Json();
+  }
+  std::string body = Json(std::move(o)).dump();
+
+  MutexLock lock(mu_);
+  if (dead_) throw WalTornError("log dead after a previous torn write");
+  std::string tmp = snap_path(dir_) + ".tmp";
+  int sfd = ::open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0666);
+  if (sfd < 0)
+    throw std::runtime_error("open " + tmp + ": " + std::strerror(errno));
+  const char* p = body.data();
+  size_t left = body.size();
+  bool ok = true;
+  while (left > 0) {
+    ssize_t n = ::write(sfd, p, left);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      ok = false;
+      break;
+    }
+    p += n;
+    left -= static_cast<size_t>(n);
+  }
+  ::fsync(sfd);
+  ::close(sfd);
+  if (!ok || ::rename(tmp.c_str(), snap_path(dir_).c_str()) != 0) {
+    throw std::runtime_error("snapshot write failed: " +
+                             std::string(std::strerror(errno)));
+  }
+  // The rename must be ON DISK before the log shrinks: a power loss
+  // that persisted the truncate but not the directory entry would pair
+  // the OLD snapshot with an EMPTY log — a regressed watermark. (A
+  // process crash can't reorder these; only the storage stack can.)
+  fsync_dir(dir_);
+  // Truncate AFTER the rename: a crash between the two replays the
+  // pre-snapshot records over the snapshot, which every record's
+  // idempotent/monotone application absorbs.
+  if (::ftruncate(fd_, 0) != 0) {
+    throw std::runtime_error("wal truncate failed: " +
+                             std::string(std::strerror(errno)));
+  }
+  since_snapshot_ = 0;
+  snapshots_ += 1;
+}
+
+void DurableLog::maybe_snapshot(const LighthouseState& state,
+                                int64_t quorum_gen, int64_t root_epoch,
+                                int64_t mono_now, int64_t unix_now) {
+  {
+    MutexLock lock(mu_);
+    if (since_snapshot_ < snapshot_every_) return;
+  }
+  snapshot(state, quorum_gen, root_epoch, mono_now, unix_now);
+}
+
+WalRecovery DurableLog::recover(const std::string& dir, int64_t mono_now,
+                                int64_t unix_now) {
+  WalRecovery out;
+
+  // 1. Snapshot (if present and parseable; a half-written .tmp never
+  //    carries the canonical name, so a parse failure here means real
+  //    corruption — start from the log alone rather than refuse).
+  {
+    std::ifstream f(snap_path(dir), std::ios::binary);
+    if (f) {
+      std::stringstream ss;
+      ss << f.rdbuf();
+      try {
+        Json j = Json::parse(ss.str());
+        out.quorum_gen = j.get_int("quorum_gen", 0);
+        out.root_epoch = j.get_int("root_epoch", 0);
+        out.state.quorum_id = j.get_int("quorum_id", 0);
+        const Json& hb = j.at("heartbeats_unix");
+        if (!hb.is_null()) {
+          for (const auto& [id, u] : hb.as_object())
+            out.state.heartbeats[id] = rebase(u.as_int(), mono_now, unix_now);
+        }
+        const Json& ttls = j.at("lease_ttls");
+        if (!ttls.is_null()) {
+          for (const auto& [id, ttl] : ttls.as_object())
+            out.state.lease_ttls[id] = ttl.as_int();
+        }
+        const Json& parts = j.at("participants");
+        if (!parts.is_null()) {
+          for (const auto& [id, pj] : parts.as_object()) {
+            ParticipantDetails d;
+            d.joined_ms =
+                rebase(pj.get_int("joined_unix", unix_now), mono_now, unix_now);
+            d.member = member_from_json(pj.at("member"));
+            out.state.participants[id] = std::move(d);
+          }
+        }
+        const Json& prev = j.at("prev_quorum");
+        if (!prev.is_null()) out.state.prev_quorum = quorum_from_json(prev);
+        out.replayed = true;
+      } catch (const std::exception& e) {
+        LOG_WARN("wal snapshot unreadable (" << e.what()
+                                             << "); recovering from log only");
+      }
+    }
+  }
+
+  // 2. Log records, stopping at the first torn/corrupt frame.
+  std::ifstream f(wal_path(dir), std::ios::binary);
+  if (!f) return out;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string raw = ss.str();
+  size_t pos = 0;
+  while (pos + 8 <= raw.size()) {
+    const unsigned char* base =
+        reinterpret_cast<const unsigned char*>(raw.data()) + pos;
+    uint32_t len = get_u32(base);
+    uint32_t crc = get_u32(base + 4);
+    if (len == 0 || len > kMaxRecordBytes || pos + 8 + len > raw.size()) break;
+    if (fault::crc32c(raw.data() + pos + 8, len) != crc) break;
+    uint8_t type = static_cast<uint8_t>(raw[pos + 8]);
+    std::string payload = raw.substr(pos + 9, len - 1);
+    try {
+      Json j = Json::parse(payload);
+      switch (type) {
+        case kRecEpoch:
+          out.root_epoch = std::max(out.root_epoch, j.get_int("epoch", 0));
+          break;
+        case kRecLease: {
+          int64_t rec_unix = j.get_int("unix_ms", unix_now);
+          for (const auto& e : wal_lease_entries_from_json(j.at("entries"))) {
+            if (e.replica_id.empty()) continue;
+            int64_t hb = rebase(rec_unix - e.age_ms, mono_now, unix_now);
+            auto it = out.state.heartbeats.find(e.replica_id);
+            // Monotone merge: liveness only ever moves forward, so a
+            // pre-snapshot record replayed over the snapshot (the
+            // crash-between-rename-and-truncate window) is a no-op.
+            if (it == out.state.heartbeats.end() || it->second < hb)
+              out.state.heartbeats[e.replica_id] = hb;
+            if (e.ttl_ms > 0) {
+              out.state.lease_ttls[e.replica_id] = e.ttl_ms;
+            } else {
+              out.state.lease_ttls.erase(e.replica_id);
+            }
+            if (e.participating) {
+              out.state.participants[e.replica_id] = ParticipantDetails{
+                  rebase(rec_unix - e.joined_age_ms, mono_now, unix_now),
+                  e.member};
+            }
+          }
+          break;
+        }
+        case kRecDepart:
+          apply_depart(out.state, j.get_string("replica_id", ""));
+          break;
+        case kRecQuorum: {
+          torchft_tpu::Quorum q = quorum_from_json(j.at("quorum"));
+          if (q.quorum_id() >= out.state.quorum_id) {
+            out.state.quorum_id = q.quorum_id();
+            out.state.prev_quorum = q;
+            // Mirror quorum_step's formation protocol: registrations were
+            // consumed by this quorum; later lease records re-register.
+            out.state.participants.clear();
+          }
+          out.quorum_gen = std::max(out.quorum_gen, j.get_int("gen", 0));
+          out.root_epoch = std::max(out.root_epoch, j.get_int("epoch", 0));
+          break;
+        }
+        default:
+          break;  // future record type: skip (CRC already vouched for it)
+      }
+      out.records_replayed += 1;
+      out.replayed = true;
+    } catch (const std::exception& e) {
+      // CRC passed but the payload didn't parse: treat as corruption at
+      // this point and stop, same as a torn tail.
+      LOG_WARN("wal record " << out.records_replayed
+                             << " unparseable: " << e.what());
+      break;
+    }
+    pos += 8 + len;
+  }
+  out.dropped_tail_bytes = static_cast<int64_t>(raw.size() - pos);
+  if (out.dropped_tail_bytes > 0) {
+    LOG_WARN("wal: dropped " << out.dropped_tail_bytes
+                             << " torn tail byte(s) at offset " << pos);
+  }
+  return out;
+}
+
+} // namespace tft

@@ -1,0 +1,140 @@
+// Write-ahead quorum log + snapshot for the root lighthouse (the durable
+// control plane).
+//
+// CONTRACT. Every state transition that affects an externally visible
+// promise — a quorum_id bump / membership commit, a lease grant, an
+// explicit depart, a root-epoch claim — is appended as a CRC32C-framed
+// record BEFORE the promise is published. On restart, recover() replays
+// snapshot + log back to the exact pre-crash watermark: quorum_id and
+// root_epoch never regress, members whose leases were live stay live
+// (times are stored as unix wall-clock and re-based onto the new
+// process's monotonic clock), and explicit departs stay departed. A
+// torn/truncated tail record (the crash-mid-write case) fails its length
+// or CRC check and is DROPPED, never partially applied — safe because a
+// record that never finished its append was never acked to anyone.
+//
+// FILE LAYOUT (one directory, TORCHFT_LH_WAL_DIR):
+//   snapshot.json   periodic full-state compaction (tmp + rename, atomic)
+//   wal.log         records since the last snapshot:
+//                   [u32 len BE][u32 crc32c BE][u8 type][payload JSON]
+//                   (crc covers type+payload; len counts type+payload)
+//
+// Records are appended with the file lock held by the caller's service
+// lock; epoch/quorum/depart records fsync (they are the promises), lease
+// records flush without fsync (losing a tail lease record only shortens
+// a lease — the safe direction). A crash between snapshot rename and log
+// truncation replays pre-snapshot records over the snapshot; every
+// record's application is idempotent/monotone (max-merge on times,
+// >=-guard on quorum_id) so the double-apply is a no-op.
+//
+// The kill-at-every-record property suite (tests) drives this class
+// through pure capi handles (tft_wal_*) and through the seeded fault
+// engine's `wal_write` seam (a torn append mid-record), so the recovery
+// guarantees are proven byte-by-byte, not hoped.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "quorum.h"
+#include "thread_annotations.h"
+
+namespace tft {
+
+// Raised when an append tears (injected via the wal_write seam, or a real
+// write failure): the log is DEAD from this point — the caller must stop
+// making new promises (a promise that outruns the log would regress on
+// replay), exactly as if the process had crashed at that byte.
+class WalTornError : public std::runtime_error {
+ public:
+  explicit WalTornError(const std::string& msg)
+      : std::runtime_error("wal torn: " + msg) {}
+};
+
+// One lease grant as recorded in the WAL: the POST-APPLY state slice of
+// the member (so replay is a re-apply, and the digest freshness gate's
+// outcome — not its input — is what persists). Ages are relative to the
+// record's unix_ms stamp.
+struct WalLeaseEntry {
+  std::string replica_id;
+  int64_t age_ms = 0;         // record_unix - last renewal
+  int64_t ttl_ms = 0;         // 0 = service default (no lease_ttls entry)
+  bool participating = false;
+  int64_t joined_age_ms = 0;  // record_unix - joined (participants only)
+  torchft_tpu::QuorumMember member;  // meaningful when participating
+};
+
+// Everything recover() rebuilds. Times in `state` are re-based onto the
+// recovering process's monotonic clock via mono_now/unix_now.
+struct WalRecovery {
+  LighthouseState state;
+  int64_t quorum_gen = 0;
+  int64_t root_epoch = 0;
+  bool replayed = false;          // a snapshot or >=1 record was found
+  int64_t records_replayed = 0;   // log records applied (snapshot excluded)
+  int64_t dropped_tail_bytes = 0; // torn/truncated tail, detected + dropped
+};
+
+class DurableLog {
+ public:
+  // Creates the directory if needed and opens (appends to) wal.log.
+  // snapshot_every <= 0 uses the default (512 records per snapshot).
+  DurableLog(const std::string& dir, int64_t snapshot_every);
+  ~DurableLog();
+
+  // Replays snapshot + log from `dir`. Never throws on torn/corrupt tail
+  // data (that is the crash case it exists for); throws only on I/O
+  // errors opening an existing, readable-looking layout.
+  static WalRecovery recover(const std::string& dir, int64_t mono_now,
+                             int64_t unix_now);
+
+  // Appends (all throw WalTornError once the log is dead).
+  void log_epoch(int64_t epoch);                              // fsync
+  void log_lease(const std::vector<WalLeaseEntry>& entries,
+                 int64_t unix_now);                           // no fsync
+  void log_depart(const std::string& replica_id);             // fsync
+  void log_quorum(const torchft_tpu::Quorum& quorum, int64_t quorum_gen,
+                  int64_t root_epoch);                        // fsync
+
+  // Compacts: atomically writes snapshot.json from `state` (monotonic
+  // times re-based to unix via mono_now/unix_now) and truncates wal.log.
+  void snapshot(const LighthouseState& state, int64_t quorum_gen,
+                int64_t root_epoch, int64_t mono_now, int64_t unix_now);
+  // snapshot() iff >= snapshot_every records accumulated since the last.
+  void maybe_snapshot(const LighthouseState& state, int64_t quorum_gen,
+                      int64_t root_epoch, int64_t mono_now, int64_t unix_now);
+
+  bool dead();
+  int64_t records_appended();
+  int64_t snapshots_written();
+  const std::string& dir() const { return dir_; }
+
+ private:
+  void append_locked(uint8_t type, const std::string& payload, bool sync)
+      TFT_REQUIRES(mu_);
+
+  std::string dir_;
+  int64_t snapshot_every_;
+  Mutex mu_;
+  int fd_ TFT_GUARDED_BY(mu_) = -1;
+  bool dead_ TFT_GUARDED_BY(mu_) = false;
+  int64_t records_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t since_snapshot_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t snapshots_ TFT_GUARDED_BY(mu_) = 0;
+  int64_t op_seq_ TFT_GUARDED_BY(mu_) = 0;  // wal_write seam op index
+};
+
+// Builds the POST-APPLY WAL slices for `ids` out of a lighthouse state
+// (the shared glue between the lease/digest handlers and the log).
+std::vector<WalLeaseEntry> wal_entries_from_state(
+    const LighthouseState& state, const std::vector<std::string>& ids,
+    int64_t mono_now);
+
+// JSON round trip for the capi pure entry points (the scripted
+// kill-at-every-record suite drives the same encoder/decoder the live
+// service uses).
+Json wal_lease_entries_to_json(const std::vector<WalLeaseEntry>& entries);
+std::vector<WalLeaseEntry> wal_lease_entries_from_json(const Json& j);
+
+} // namespace tft

@@ -45,6 +45,10 @@ enum class MsgType : uint8_t {
   kRegionDigestResp = 24,
   kRegionPollReq = 25,
   kRegionPollResp = 26,
+  // Durable control plane: standby root <-> active root state sync +
+  // epoch fencing (see native/src/lighthouse.h "warm standby").
+  kRootSyncReq = 27,
+  kRootSyncResp = 28,
 };
 
 // Raised when the peer replied with an ErrorResponse frame.

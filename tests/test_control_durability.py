@@ -1,0 +1,656 @@
+"""Durable control plane: write-ahead quorum log, warm-standby root
+failover, and the fencing/monotonicity contracts.
+
+Four layers of proof:
+
+1. **Kill-at-every-byte property sweep** (pure): a WAL is authored
+   through the same native ``DurableLog`` the live root writes, then
+   recovered from EVERY byte-truncation prefix — the recovered
+   ``quorum_id`` watermark must be monotone in the prefix length, never
+   exceed what was durably appended, and torn tail records must be
+   DROPPED (detected by length/CRC), never partially applied.
+2. **Seeded ``wal_write`` seam** (live): the PR-11 fault machinery tears
+   an append mid-record inside a live lighthouse; the root must freeze
+   NEW promises (frozen beats regressed) and a restart must recover
+   exactly the pre-tear watermark.
+3. **Restart + takeover continuity** (live): leases renewed within TTL
+   before a root crash are still live after replay and after a warm
+   standby's takeover; explicit departs stay departed; the deposed
+   primary fences itself behind the takeover epoch.
+4. **Manager-facing failover**: endpoint lists rotate onto the active
+   root, and the demoted manager's bounded region re-probe gives up
+   after ``region_probe_max`` failures instead of probing forever.
+"""
+
+import os
+import shutil
+import time
+from datetime import timedelta
+
+import pytest
+
+from torchft_tpu import _native
+from torchft_tpu._native import (
+    Lighthouse,
+    Manager,
+    ManagerClient,
+    RegionLighthouse,
+    Store,
+    WalLog,
+    wal_recover,
+)
+
+TIMEOUT = timedelta(seconds=20)
+
+
+def member(replica_id, step=1):
+    return {
+        "replica_id": replica_id,
+        "address": f"addr_{replica_id}",
+        "store_address": f"store_{replica_id}",
+        "step": step,
+        "world_size": 1,
+        "shrink_only": False,
+        "force_reconfigure": False,
+    }
+
+
+def wal_entry(replica_id, ttl_ms=60000, participating=True, age_ms=0,
+              joined_age_ms=0):
+    e = {
+        "replica_id": replica_id,
+        "age_ms": age_ms,
+        "ttl_ms": ttl_ms,
+        "participating": participating,
+    }
+    if participating:
+        e["joined_age_ms"] = joined_age_ms
+        e["member"] = member(replica_id)
+    return e
+
+
+def lease_entry(replica_id, ttl_ms=60000, participating=True):
+    return {
+        "replica_id": replica_id,
+        "ttl_ms": ttl_ms,
+        "participating": participating,
+        "member": member(replica_id),
+    }
+
+
+def quorum(qid, ids, created_ms=1000):
+    return {
+        "quorum_id": qid,
+        "created_ms": created_ms,
+        "participants": [member(i) for i in ids],
+    }
+
+
+def wait_until(pred, deadline_s=10.0, msg="condition"):
+    deadline = time.monotonic() + deadline_s
+    while True:
+        v = pred()
+        if v:
+            return v
+        assert time.monotonic() < deadline, f"timed out waiting for {msg}"
+        time.sleep(0.05)
+
+
+class TestWalRoundTrip:
+    def test_records_replay_to_watermark(self, tmp_path):
+        d = str(tmp_path / "wal")
+        w = WalLog(d)
+        w.log_epoch(1)
+        w.log_lease([wal_entry("g0"), wal_entry("g1")], unix_ms=5000)
+        w.log_quorum(quorum(1, ["g0", "g1"]), quorum_gen=1, root_epoch=1)
+        w.log_lease([wal_entry("g0")], unix_ms=5100)
+        w.log_depart("g1")
+        w.log_quorum(quorum(2, ["g0"]), quorum_gen=2, root_epoch=1)
+        w.log_lease([wal_entry("g0")], unix_ms=5200)
+        w.close()
+        rec = wal_recover(d, 6000, 6000)
+        assert rec["replayed"] and rec["records_replayed"] == 7
+        assert rec["dropped_tail_bytes"] == 0
+        st = rec["state"]
+        assert st["quorum_id"] == 2 and rec["root_epoch"] == 1
+        assert rec["quorum_gen"] == 2
+        # identity rebase at mono == unix: g0's last grant was at 5200
+        assert st["heartbeats"]["g0"] == 5200
+        # the explicit depart stays departed
+        assert "g1" not in st["heartbeats"]
+        assert [m["replica_id"] for m in st["prev_quorum"]["participants"]] \
+            == ["g0"]
+        # quorum replay mirrors quorum_step's participant clear; the later
+        # lease record re-registered g0
+        assert "g0" in st["participants"]
+
+    def test_snapshot_compacts_and_replays(self, tmp_path):
+        d = str(tmp_path / "wal")
+        w = WalLog(d)
+        w.log_lease([wal_entry("g0")], unix_ms=1000)
+        state = {
+            "quorum_id": 7,
+            "participants": {"g0": {"joined_ms": 900, "member": member("g0")}},
+            "heartbeats": {"g0": 1000},
+            "lease_ttls": {"g0": 60000},
+            "prev_quorum": quorum(7, ["g0"]),
+        }
+        w.snapshot(state, quorum_gen=5, root_epoch=3, mono_now=1000,
+                   unix_now=1000)
+        # post-snapshot records replay on top
+        w.log_lease([wal_entry("g1")], unix_ms=1200)
+        w.close()
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        rec = wal_recover(d, 1300, 1300)
+        st = rec["state"]
+        assert st["quorum_id"] == 7 and rec["root_epoch"] == 3
+        assert rec["quorum_gen"] == 5
+        assert st["heartbeats"]["g0"] == 1000
+        assert st["heartbeats"]["g1"] == 1200
+        assert st["participants"]["g0"]["joined_ms"] == 900
+        # only the post-compaction record remains in the log
+        assert rec["records_replayed"] == 1
+
+    def test_clock_rebase_across_restart(self, tmp_path):
+        # The recovering process's monotonic clock restarted: a lease
+        # granted at unix 10_000 must land at (mono_now - elapsed).
+        d = str(tmp_path / "wal")
+        w = WalLog(d)
+        w.log_lease([wal_entry("g0", ttl_ms=5000)], unix_ms=10_000)
+        w.close()
+        rec = wal_recover(d, 50, 11_000)  # 1s elapsed, fresh mono clock
+        assert rec["state"]["heartbeats"]["g0"] == 50 - 1000
+
+    def test_empty_dir_is_cold(self, tmp_path):
+        d = str(tmp_path / "nothing")
+        rec = wal_recover(d, 0, 0)
+        assert not rec["replayed"]
+        assert rec["state"]["quorum_id"] == 0 and rec["root_epoch"] == 0
+
+
+class TestKillAtEveryByte:
+    """The scripted kill-at-every-record property: recovery from every
+    byte-truncation prefix of the log is (a) crash-free, (b) monotone in
+    the prefix (more bytes never recover a SMALLER watermark), and (c)
+    exact at record boundaries — a torn tail is dropped, never applied."""
+
+    def test_truncation_sweep_monotone(self, tmp_path):
+        d = str(tmp_path / "wal")
+        w = WalLog(d)
+        logged_qids = [0]
+        w.log_epoch(1)
+        for qid, ids in ((1, ["g0"]), (2, ["g0", "g1"]), (3, ["g0"])):
+            w.log_lease([wal_entry(i) for i in ids], unix_ms=1000 + qid)
+            w.log_quorum(quorum(qid, ids), quorum_gen=qid, root_epoch=1)
+            logged_qids.append(qid)
+        w.log_depart("g1")
+        w.close()
+        raw = open(os.path.join(d, "wal.log"), "rb").read()
+        assert len(raw) > 100
+
+        sweep_dir = str(tmp_path / "sweep")
+        prev_qid = -1
+        prev_records = -1
+        for cut in range(len(raw) + 1):
+            shutil.rmtree(sweep_dir, ignore_errors=True)
+            os.makedirs(sweep_dir)
+            with open(os.path.join(sweep_dir, "wal.log"), "wb") as f:
+                f.write(raw[:cut])
+            rec = wal_recover(sweep_dir, 2000, 2000)
+            qid = rec["state"]["quorum_id"]
+            # (a) only promised watermarks ever appear
+            assert qid in logged_qids, (cut, qid)
+            # (b) monotone in the prefix
+            assert qid >= prev_qid, (cut, qid, prev_qid)
+            assert rec["records_replayed"] >= prev_records - 7
+            # (c) anything after the last whole record is dropped tail
+            if cut < len(raw):
+                assert rec["dropped_tail_bytes"] >= 0
+            prev_qid = qid
+            prev_records = rec["records_replayed"]
+        # the full log recovers the full watermark
+        assert prev_qid == 3
+
+    def test_corrupt_tail_bits_are_dropped_not_applied(self, tmp_path):
+        d = str(tmp_path / "wal")
+        w = WalLog(d)
+        w.log_quorum(quorum(1, ["g0"]), quorum_gen=1, root_epoch=1)
+        w.log_quorum(quorum(2, ["g0"]), quorum_gen=2, root_epoch=1)
+        w.close()
+        path = os.path.join(d, "wal.log")
+        raw = bytearray(open(path, "rb").read())
+        # flip one payload bit inside the LAST record: its CRC must fail
+        # and recovery must fall back to the first record's watermark
+        raw[-3] ^= 0x10
+        open(path, "wb").write(bytes(raw))
+        rec = wal_recover(d, 1000, 1000)
+        assert rec["state"]["quorum_id"] == 1
+        assert rec["dropped_tail_bytes"] > 0
+
+
+class TestWalWriteSeam:
+    """The PR-11 seeded fault machinery on the new ``wal_write`` seam: a
+    torn append inside a LIVE root freezes new promises, and restart
+    recovers exactly the pre-tear watermark."""
+
+    def teardown_method(self):
+        _native.fault_disarm()
+
+    def test_torn_append_freezes_promises_and_recovers(self, tmp_path):
+        d = str(tmp_path / "wal")
+        lh = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=100,
+                        wal_dir=d)
+        try:
+            c = _native.LeaseClient(lh.address())
+            c.renew([lease_entry("g0")])
+            wait_until(lambda: lh.status_json()["quorum_id"] >= 1,
+                       msg="first quorum")
+            qid = lh.status_json()["quorum_id"]
+
+            # Arm: the NEXT wal append tears mid-record (crash-mid-write).
+            _native.fault_arm({
+                "seed": 1,
+                "rules": [{"seam": "wal_write", "kind": "truncate",
+                           "member": -1, "permille": 1000, "max_fires": 1}],
+            })
+            # A new member would bump quorum_id — but the promise cannot
+            # be made durable, so it must never be published.
+            c.renew([lease_entry("g0"), lease_entry("g1")])
+            time.sleep(0.5)
+            st = lh.status_json()
+            assert st["quorum_id"] == qid, "promise published past a torn WAL"
+            assert st["wal"]["dead"] is True
+            stats = _native.fault_stats()
+            assert stats["fired"].get("wal_write:truncate", 0) >= 1
+            _native.fault_disarm()
+        finally:
+            lh.shutdown()
+        # Restart: the torn tail is dropped; the watermark is exactly the
+        # last PUBLISHED promise.
+        lh2 = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=100,
+                         wal_dir=d)
+        try:
+            st = lh2.status_json()
+            assert st["wal_replayed"] is True
+            assert st["quorum_id"] == qid
+            assert st["wal"]["dropped_tail_bytes"] > 0
+        finally:
+            lh2.shutdown()
+
+
+class TestRestartContinuity:
+    def test_lease_continuity_and_departs_across_restart(self, tmp_path):
+        d = str(tmp_path / "wal")
+        lh = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=100,
+                        wal_dir=d)
+        c = _native.LeaseClient(lh.address())
+        c.renew([lease_entry("gA"), lease_entry("gB"),
+                 lease_entry("gC", participating=False)])
+        wait_until(lambda: lh.status_json()["quorum_id"] >= 1, msg="quorum")
+        c.depart("gB")
+        wait_until(
+            lambda: all(m["replica_id"] != "gB"
+                        for m in lh.status_json()["members"]),
+            msg="depart applied",
+        )
+        qid = lh.status_json()["quorum_id"]
+        epoch = lh.root_epoch()
+        lh.shutdown()
+        del lh, c
+
+        lh2 = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=100,
+                         wal_dir=d)
+        try:
+            st = lh2.status_json()
+            # amnesia stamps: replayed, epoch bumped, watermark intact
+            assert st["wal_replayed"] is True
+            assert st["root_epoch"] == epoch + 1
+            assert st["quorum_id"] == qid
+            members = {m["replica_id"]: m for m in st["members"]}
+            # renewed-within-TTL members are still LIVE after replay
+            assert members["gA"]["lease_remaining_ms"] > 0
+            assert members["gC"]["lease_remaining_ms"] > 0
+            # the explicit depart stayed departed
+            assert "gB" not in members
+            # and the root keeps serving: a fresh registration bumps the
+            # quorum PAST the replayed watermark, never below it
+            c2 = _native.LeaseClient(lh2.address())
+            c2.renew([lease_entry("gA"), lease_entry("gD")])
+            wait_until(lambda: lh2.status_json()["quorum_id"] > qid,
+                       msg="post-replay quorum")
+        finally:
+            lh2.shutdown()
+
+    def test_fresh_wal_root_is_cold_not_amnesiac(self, tmp_path):
+        lh = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=100,
+                        wal_dir=str(tmp_path / "fresh"))
+        try:
+            st = lh.status_json()
+            assert st["wal_enabled"] is True
+            assert st["wal_replayed"] is False  # cold, nothing to remember
+            assert st["root_epoch"] == 1
+        finally:
+            lh.shutdown()
+
+    def test_non_wal_root_stamps(self):
+        lh = Lighthouse(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        try:
+            st = lh.status_json()
+            assert st["wal_enabled"] is False
+            assert st["wal_replayed"] is False
+            assert "wal" not in st
+            assert st["active"] is True
+        finally:
+            lh.shutdown()
+
+
+class TestStandbyTakeover:
+    def test_takeover_preserves_watermark_and_leases(self, tmp_path):
+        dp, ds = str(tmp_path / "p"), str(tmp_path / "s")
+        primary = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=100, wal_dir=dp)
+        paddr = primary.address()
+        standby = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=100, wal_dir=ds, peers=paddr,
+                             standby=True, takeover_ms=800)
+        saddr = standby.address()
+        try:
+            assert primary.active() and not standby.active()
+            assert standby.status_json()["role"] == "standby"
+
+            c = _native.LeaseClient(paddr)
+            c.renew([lease_entry("gA"), lease_entry("gB")])
+            wait_until(lambda: primary.status_json()["quorum_id"] >= 1,
+                       msg="primary quorum")
+            qid = primary.status_json()["quorum_id"]
+            # The commit was PUSH-replicated: the standby holds the
+            # watermark synchronously, not a sync interval later.
+            wait_until(lambda: standby.status_json()["quorum_id"] >= qid,
+                       deadline_s=3, msg="standby mirror")
+
+            primary.shutdown()
+            wait_until(standby.active, msg="takeover")
+            st = standby.status_json()
+            assert st["quorum_id"] >= qid  # never regresses across epochs
+            assert st["root_epoch"] == 2
+            members = {m["replica_id"]: m for m in st["members"]}
+            # lease continuity across the takeover
+            assert members["gA"]["lease_remaining_ms"] > 0
+            assert members["gB"]["lease_remaining_ms"] > 0
+
+            # the new active root actually serves: quorum advances there
+            c2 = _native.LeaseClient(saddr)
+            c2.renew([lease_entry("gA"), lease_entry("gB"),
+                      lease_entry("gC")])
+            wait_until(lambda: standby.status_json()["quorum_id"] > qid,
+                       msg="post-takeover quorum")
+        finally:
+            standby.shutdown()
+            primary.shutdown()
+
+    def test_deposed_primary_fences_on_restart(self, tmp_path):
+        dp, ds = str(tmp_path / "p"), str(tmp_path / "s")
+        primary = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=100, wal_dir=dp)
+        pport = primary.address().rsplit(":", 1)[1]
+        standby = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=100, wal_dir=ds,
+                             peers=primary.address(), standby=True,
+                             takeover_ms=800)
+        try:
+            c = _native.LeaseClient(primary.address())
+            c.renew([lease_entry("gA")])
+            wait_until(lambda: primary.status_json()["quorum_id"] >= 1,
+                       msg="quorum")
+            primary.shutdown()
+            wait_until(standby.active, msg="takeover")
+            takeover_epoch = standby.root_epoch()
+
+            # the deposed incarnation returns on its own WAL: it must
+            # find the higher-epoch active peer and start FENCED
+            p2 = Lighthouse(bind=f"[::]:{pport}", min_replicas=1,
+                            join_timeout_ms=100, wal_dir=dp,
+                            peers=standby.address())
+            try:
+                assert not p2.active()
+                assert p2.root_epoch() < takeover_epoch
+                assert p2.status_json()["role"] == "standby"
+                # and it now TAILS the new active root (watermark flows)
+                c2 = _native.LeaseClient(standby.address())
+                c2.renew([lease_entry("gA"), lease_entry("gNew")])
+                wait_until(
+                    lambda: p2.status_json()["quorum_id"]
+                    >= standby.status_json()["quorum_id"],
+                    msg="fenced primary mirrors the new active",
+                )
+            finally:
+                p2.shutdown()
+        finally:
+            standby.shutdown()
+            primary.shutdown()
+
+
+class TestEpochCollisionTieBreak:
+    def test_two_equal_epoch_actives_resolve_to_one(self, tmp_path):
+        # The collided-claim case: two roots activate at the SAME epoch
+        # (here: both start unflagged, each probing before the other is
+        # active — the restarted-primary-during-standby-partition race
+        # in miniature). Strictly-greater epoch fencing alone would
+        # leave BOTH active forever; the per-claim nonce tie-break must
+        # demote exactly one within a probe round.
+        # In-process Lighthouses can't be mutually peered (peers are ctor
+        # state and ephemeral ports are unknown until bound), so use the
+        # fixed-port subprocess roots.
+        from torchft_tpu.chaos import RootProcess, free_port
+
+        ports = [free_port(), free_port()]
+        addrs = [f"http://localhost:{p}" for p in ports]
+        # BOTH unflagged: each starts, probes the other (not yet serving
+        # or serving-inactive), and claims epoch 1 — the collision.
+        r0 = RootProcess(ports[0], wal_dir=str(tmp_path / "p0"),
+                         peers=addrs[1], takeover_ms=600)
+        r1 = RootProcess(ports[1], wal_dir=str(tmp_path / "p1"),
+                         peers=addrs[0], takeover_ms=600)
+        try:
+            r0.wait_serving()
+            r1.wait_serving()
+
+            def exactly_one_active():
+                st0, st1 = r0.status(), r1.status()
+                if st0 is None or st1 is None:
+                    return False
+                return (st0["active"] + st1["active"]) == 1
+
+            # within a fence-probe round (<= max(500, takeover/2) + rpc)
+            wait_until(exactly_one_active, deadline_s=15,
+                       msg="nonce tie-break to a single active root")
+            # and it STAYS resolved (no demote flapping)
+            time.sleep(1.5)
+            assert exactly_one_active()
+        finally:
+            r0.stop()
+            r1.stop()
+
+
+class TestStallSelfFence:
+    """The stalled-not-dead primary (SIGSTOP past the takeover bound):
+    the standby takes over; the RESUMED primary must detect its own tick
+    stall, probe peers BEFORE serving again, and fence itself behind the
+    takeover epoch — the split-brain path clean kills never exercise.
+    Needs subprocess roots (SIGSTOP of an in-process lighthouse would
+    stop the test runner with it)."""
+
+    def test_resumed_primary_fences(self, tmp_path):
+        from torchft_tpu.chaos import RootProcess, free_port
+
+        ports = [free_port(), free_port()]
+        addrs = [f"http://localhost:{p}" for p in ports]
+        primary = RootProcess(
+            ports[0], wal_dir=str(tmp_path / "p"), peers=addrs[1],
+            takeover_ms=800,
+        )
+        standby = RootProcess(
+            ports[1], wal_dir=str(tmp_path / "s"), peers=addrs[0],
+            standby=True, takeover_ms=800,
+        )
+        try:
+            primary.wait_serving()
+            standby.wait_serving()
+            stall = primary.partition(3.0)  # ~4x the takeover bound
+            wait_until(
+                lambda: (standby.status() or {}).get("active", False),
+                deadline_s=15,
+                msg="takeover during the stall",
+            )
+            stall.join()
+            # the resumed primary must end up PASSIVE at a lower epoch
+            def fenced():
+                st = primary.status()
+                return st is not None and not st.get("active", True)
+
+            wait_until(fenced, deadline_s=15, msg="resumed-primary fence")
+            pst, sst = primary.status(), standby.status()
+            assert sst["active"] and sst["root_epoch"] > pst["root_epoch"]
+            assert pst["role"] == "standby"
+        finally:
+            primary.stop()
+            standby.stop()
+
+
+class TestManagerEndpointList:
+    def test_manager_rotates_past_standby_to_active(self, tmp_path):
+        # The endpoint list leads with the STANDBY: the manager must
+        # rotate onto the active root and form a quorum anyway.
+        primary = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=200)
+        standby = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=200, peers=primary.address(),
+                             standby=True, takeover_ms=60000)
+        store = Store()
+        m = Manager(
+            "repL", f"{standby.address()},{primary.address()}", "localhost",
+            "[::]:0", store.address(), 1,
+            heartbeat_interval=timedelta(milliseconds=50),
+        )
+        client = ManagerClient(m.address())
+        try:
+            res = client.quorum(0, 1, "ck", timeout=TIMEOUT)
+            assert res.replica_world_size == 1
+            assert res.quorum_id >= 1
+        finally:
+            m.shutdown()
+            standby.shutdown()
+            primary.shutdown()
+            store.shutdown()
+
+    def test_region_tier_follows_takeover(self, tmp_path):
+        # Region tier pointed at the (primary, standby) list: after the
+        # primary dies and the standby takes over, digests/polls drift to
+        # the standby and quorums keep forming through the region.
+        dp, ds = str(tmp_path / "p"), str(tmp_path / "s")
+        primary = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=200, wal_dir=dp)
+        standby = Lighthouse(bind="[::]:0", min_replicas=1,
+                             join_timeout_ms=200, wal_dir=ds,
+                             peers=primary.address(), standby=True,
+                             takeover_ms=800)
+        roots = f"{primary.address()},{standby.address()}"
+        ra = RegionLighthouse(roots, "ra", digest_interval_ms=50)
+        try:
+            c = _native.LeaseClient(ra.address())
+            c.renew([lease_entry("g0")])
+            wait_until(lambda: ra.status_json()["quorum_id"] >= 1,
+                       msg="quorum via region")
+            qid = ra.status_json()["quorum_id"]
+
+            primary.shutdown()
+            wait_until(standby.active, msg="takeover")
+            # a NEW member must reach a quorum through region -> standby
+            deadline = time.monotonic() + 20
+            while True:
+                c.renew([lease_entry("g0"), lease_entry("g1")])
+                st = ra.status_json()
+                q = st.get("quorum") or {}
+                ids = [p["replica_id"] for p in q.get("participants", [])]
+                if "g1" in ids:
+                    break
+                assert time.monotonic() < deadline, st
+                time.sleep(0.1)
+            assert st["quorum_id"] >= qid
+        finally:
+            ra.shutdown()
+            standby.shutdown()
+            primary.shutdown()
+
+
+class TestRegionProbeGiveUp:
+    def _wait(self, pred, deadline_s, msg):
+        wait_until(pred, deadline_s, msg)
+
+    def test_bounded_give_up_stops_probing(self):
+        root = Lighthouse(min_replicas=1, join_timeout_ms=200)
+        ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
+        ra_port = int(ra.address().rsplit(":", 1)[1])
+        store = Store()
+        m = Manager(
+            "repG", ra.address(), "localhost", "[::]:0", store.address(), 1,
+            heartbeat_interval=timedelta(milliseconds=50),
+            root_addr=root.address(),
+            lease_ttl=timedelta(milliseconds=300),
+            region_probe_max=3,
+        )
+        try:
+            assert not m.region_probe_given_up()
+            ra.shutdown()
+            self._wait(m.using_root_fallback, 10, "demotion")
+            # 3 probes at one per 300 ms TTL -> given up within ~2 s
+            self._wait(m.region_probe_given_up, 15, "probe give-up")
+            # region returns on the SAME port: the manager must NOT drift
+            # back — it stopped probing for good
+            ra2 = RegionLighthouse(
+                root.address(), "ra", bind=f"[::]:{ra_port}",
+                digest_interval_ms=50,
+            )
+            try:
+                time.sleep(1.5)  # several TTLs of would-be probes
+                assert m.using_root_fallback()
+                assert m.region_probe_given_up()
+            finally:
+                ra2.shutdown()
+        finally:
+            m.shutdown()
+            root.shutdown()
+            store.shutdown()
+
+    def test_probe_max_zero_probes_forever(self):
+        # The pre-bound behavior stays available: probe_max=0 keeps
+        # probing and the revived region wins the manager back.
+        root = Lighthouse(min_replicas=1, join_timeout_ms=200)
+        ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
+        ra_port = int(ra.address().rsplit(":", 1)[1])
+        store = Store()
+        m = Manager(
+            "repF", ra.address(), "localhost", "[::]:0", store.address(), 1,
+            heartbeat_interval=timedelta(milliseconds=50),
+            root_addr=root.address(),
+            lease_ttl=timedelta(milliseconds=300),
+            region_probe_max=0,
+        )
+        try:
+            ra.shutdown()
+            self._wait(m.using_root_fallback, 10, "demotion")
+            time.sleep(1.5)  # more failed probes than any small bound
+            assert not m.region_probe_given_up()
+            ra2 = RegionLighthouse(
+                root.address(), "ra", bind=f"[::]:{ra_port}",
+                digest_interval_ms=50,
+            )
+            try:
+                self._wait(lambda: not m.using_root_fallback(), 10,
+                           "drift back")
+            finally:
+                ra2.shutdown()
+        finally:
+            m.shutdown()
+            root.shutdown()
+            store.shutdown()

@@ -116,11 +116,45 @@ def run_flat(history, horizon, opt=OPT):
     return formed
 
 
-def run_hierarchical(history, horizon, regions, opt=OPT):
+def run_hierarchical(history, horizon, regions, opt=OPT, wal_dir=None):
+    """``wal_dir`` (optional) runs the root DURABLY: every mutation is
+    logged through the native DurableLog exactly the way the live root
+    logs it (post-apply member slices, departs, quorum commits), and a
+    ``(t, "root_restart")`` event DROPS the in-memory root state and
+    recovers it from the WAL — the mid-history crash. The quorum output
+    must stay bit-identical to flat either way."""
     root = dict(EMPTY)
     region_states = {r: dict(EMPTY) for r in regions}
     alive = {r: True for r in regions}
     formed = []
+    wal = _native.WalLog(wal_dir) if wal_dir else None
+    quorum_gen = 0
+    epoch = 1
+    if wal is not None:
+        wal.log_epoch(epoch)
+
+    def wal_log_members(ids, t):
+        # The live root's wal_entries_from_state: POST-APPLY slices.
+        if wal is None:
+            return
+        entries = []
+        for rid in ids:
+            if rid not in root["heartbeats"]:
+                continue
+            e = {
+                "replica_id": rid,
+                "age_ms": t - root["heartbeats"][rid],
+                "ttl_ms": root["lease_ttls"].get(rid, 0),
+                "participating": rid in root["participants"],
+            }
+            if e["participating"]:
+                p = root["participants"][rid]
+                e["joined_age_ms"] = t - p["joined_ms"]
+                e["member"] = p["member"]
+            entries.append(e)
+        if entries:
+            wal.log_lease(entries, t)
+
     by_time = sorted(history, key=lambda e: e[0])
     i = 0
     for t in range(0, horizon + TICK, TICK):
@@ -131,6 +165,7 @@ def run_hierarchical(history, horizon, regions, opt=OPT):
             if ev[1] == "lease":
                 if ev[2] == "direct":
                     root = lease_apply(root, ev[3], t)
+                    wal_log_members([e["replica_id"] for e in ev[3]], t)
                 else:
                     assert alive[ev[2]], f"lease via dead region {ev[2]}"
                     region_states[ev[2]] = lease_apply(region_states[ev[2]], ev[3], t)
@@ -145,6 +180,17 @@ def run_hierarchical(history, horizon, regions, opt=OPT):
                 region_states[ev[2]] = dict(EMPTY)  # process state is lost
             elif ev[1] == "region_revive":
                 alive[ev[2]] = True
+            elif ev[1] == "root_restart":
+                # The root crashes and comes back: in-memory state is
+                # LOST; the WAL is the only thing it remembers. Scripted
+                # clocks make the rebase an identity, so a correct replay
+                # reconstructs the exact pre-crash state.
+                assert wal is not None, "root_restart needs wal_dir"
+                rec = _native.wal_recover(wal_dir, t, t)
+                root = rec["state"]
+                quorum_gen = rec["quorum_gen"]
+                epoch = rec["root_epoch"] + 1
+                wal.log_epoch(epoch)
             i += 1
         # live regions push their digests (ages on the region clock, applied
         # on the root clock — same t here, which is exactly the live
@@ -155,19 +201,29 @@ def run_hierarchical(history, horizon, regions, opt=OPT):
             if alive[r]:
                 for d in departed[r]:
                     root = depart_apply(root, d)
+                    if wal is not None:
+                        wal.log_depart(d)
                 digest = digest_make(region_states[r], t, opt)
                 root = digest_apply(root, digest, t)
+                wal_log_members([e["replica_id"] for e in digest], t)
         for d in direct_departs:
             root = depart_apply(root, d)
+            if wal is not None:
+                wal.log_depart(d)
         res = quorum_step(t, t, root, opt)
         root = res["state"]
         if res["quorum"] is not None:
+            quorum_gen += 1
+            if wal is not None:
+                wal.log_quorum(res["quorum"], quorum_gen, epoch)
             formed.append((t, res["quorum"]))
             # regions observe the new quorum and mirror the root's
             # participant clear (the poll_loop contract)
             for r in regions:
                 if alive[r]:
                     region_states[r]["participants"] = {}
+    if wal is not None:
+        wal.close()
     return formed
 
 
@@ -182,9 +238,9 @@ def renew_all(groups, t0, t1, every, via):
     return out
 
 
-def assert_equivalent(history, horizon, regions):
+def assert_equivalent(history, horizon, regions, wal_dir=None):
     flat = run_flat(history, horizon)
-    hier = run_hierarchical(history, horizon, regions)
+    hier = run_hierarchical(history, horizon, regions, wal_dir=wal_dir)
     assert len(flat) == len(hier), (len(flat), len(hier))
     for (tf, qf), (th, qh) in zip(flat, hier):
         assert tf == th
@@ -264,6 +320,90 @@ class TestEquivalenceSuite:
         assert sizes[0] == 3 and sizes[-1] == 2
         ids = [q["quorum_id"] for _, q in formed]
         assert len(set(ids)) == 3  # join(1) -> depart(2) -> force(3)
+
+
+class TestRootRestartEquivalence:
+    """Durable-control-plane extension of the property suite: the SAME
+    scripted histories, but the hierarchical root runs on a WAL and is
+    crash-restarted mid-history — the quorum sequence must stay
+    bit-identical to the never-restarted flat service, including
+    quorum_id monotonicity across the restart."""
+
+    def test_restart_mid_history_bit_identical(self, tmp_path):
+        via = {"A": ["g0", "g1", "g2"], "B": ["g3", "g4", "g5"]}
+        groups = set(sum(via.values(), []))
+        hist = renew_all(groups, 0, 800, 50, via)
+        # membership churn before the crash: g3 silently dies at 800
+        hist += renew_all(groups - {"g3"}, 800, 1400, 50, via)
+        hist.append((1100, "root_restart"))
+        hist += renew_all(groups, 1400, 2000, 50, via)
+        formed = assert_equivalent(
+            hist, 2000, ["A", "B"], wal_dir=str(tmp_path / "wal")
+        )
+        sizes = [len(q["participants"]) for _, q in formed]
+        assert 6 in sizes and 5 in sizes
+
+    def test_restart_at_every_window(self, tmp_path):
+        # The kill-at-every-point sweep at history granularity: one
+        # restart per run, swept across the whole horizon — every
+        # placement must keep the hierarchical output bit-identical.
+        via = {"A": ["g0", "g1"], "B": ["g2"]}
+        groups = set(sum(via.values(), []))
+        base = renew_all(groups, 0, 600, 50, via)
+        base.append((300, "depart", "B", "g2"))
+        base = [
+            e for e in base
+            if not (e[1] == "lease" and e[2] == "B" and e[0] > 300)
+        ]
+        for k, restart_t in enumerate(range(50, 600, 100)):
+            hist = list(base) + [(restart_t, "root_restart")]
+            assert_equivalent(
+                hist, 600, ["A", "B"],
+                wal_dir=str(tmp_path / f"wal_{k}"),
+            )
+
+    def test_restart_with_simultaneous_region_death(self, tmp_path):
+        # The outage window compounds: the root restarts at the SAME tick
+        # a region dies, and the dead region's groups demote to
+        # direct-root renewals — exactly the correlated-failure case
+        # (whole rack/zone loss) the durability tier exists for.
+        via = {"A": ["g0", "g1"], "B": ["g2", "g3"]}
+        groups = set(sum(via.values(), []))
+        hist = renew_all(groups, 0, 500, 50, via)
+        hist.append((500, "root_restart"))
+        hist.append((500, "region_die", "B"))
+        hist.append((500, "lease", "A", [entry("g_new")]))
+        hist += renew_all(
+            groups | {"g_new"},
+            550,
+            1500,
+            50,
+            {"A": ["g0", "g1", "g_new"], "direct": ["g2", "g3"]},
+        )
+        formed = assert_equivalent(
+            hist, 1500, ["A", "B"], wal_dir=str(tmp_path / "wal")
+        )
+        assert len(formed[-1][1]["participants"]) == 5
+        # the demotion + restart was seamless: no shrink below 4
+        assert min(len(q["participants"]) for _, q in formed) >= 4
+
+    def test_wal_disabled_matches_wal_enabled_without_restart(self, tmp_path):
+        # Logging itself must be output-invariant: the durable root and
+        # the in-memory root produce identical histories when no crash
+        # happens.
+        via = {"A": ["g0", "g1"], "B": ["g2"]}
+        groups = set(sum(via.values(), []))
+        hist = renew_all(groups, 0, 1000, 50, via)
+        hist.append((400, "depart", "B", "g2"))
+        hist = [
+            e for e in hist
+            if not (e[1] == "lease" and e[2] == "B" and e[0] > 400)
+        ]
+        plain = run_hierarchical(hist, 1000, ["A", "B"])
+        durable = run_hierarchical(
+            hist, 1000, ["A", "B"], wal_dir=str(tmp_path / "wal")
+        )
+        assert plain == durable
 
 
 class TestDigestFreshnessGate:

@@ -44,11 +44,20 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_int64,
         ctypes.c_int64,
+        ctypes.c_char_p,  # wal dir ("" = no durability)
+        ctypes.c_int64,   # snapshot every N records (0 = default 512)
+        ctypes.c_char_p,  # peer root endpoints, comma-separated ("" = none)
+        ctypes.c_int,     # standby (1 = start passive)
+        ctypes.c_int64,   # takeover ms (0 = default 3000)
     ]
     lib.tft_lighthouse_address.restype = ctypes.c_void_p
     lib.tft_lighthouse_address.argtypes = [ctypes.c_void_p]
     lib.tft_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
     lib.tft_lighthouse_destroy.argtypes = [ctypes.c_void_p]
+    lib.tft_lighthouse_active.restype = ctypes.c_int
+    lib.tft_lighthouse_active.argtypes = [ctypes.c_void_p]
+    lib.tft_lighthouse_root_epoch.restype = ctypes.c_int64
+    lib.tft_lighthouse_root_epoch.argtypes = [ctypes.c_void_p]
     lib.tft_lighthouse_heartbeat.restype = ctypes.c_int
     lib.tft_lighthouse_heartbeat.argtypes = [
         ctypes.c_char_p,
@@ -116,10 +125,11 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_uint64,
         ctypes.c_int64,
         ctypes.c_int64,
-        ctypes.c_char_p,  # root fallback addr ("" = none)
+        ctypes.c_char_p,  # root fallback addr list ("" = none)
         ctypes.c_int64,   # lease ttl ms (<=0 = lighthouse default)
         ctypes.c_char_p,  # region label ("" = unlabeled)
         ctypes.c_char_p,  # host label ("" = unlabeled)
+        ctypes.c_int64,   # region re-probe give-up bound (0 = forever)
     ]
     lib.tft_manager_address.restype = ctypes.c_void_p
     lib.tft_manager_address.argtypes = [ctypes.c_void_p]
@@ -127,6 +137,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.tft_manager_destroy.argtypes = [ctypes.c_void_p]
     lib.tft_manager_using_root.restype = ctypes.c_int
     lib.tft_manager_using_root.argtypes = [ctypes.c_void_p]
+    lib.tft_manager_probe_given_up.restype = ctypes.c_int
+    lib.tft_manager_probe_given_up.argtypes = [ctypes.c_void_p]
     lib.tft_manager_set_status.restype = ctypes.c_int
     lib.tft_manager_set_status.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
 
@@ -249,6 +261,44 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_char_p,
         ctypes.c_char_p,
         ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    # Write-ahead quorum log (pure entry points: the kill-at-every-record
+    # property suites drive the exact encoder/decoder the live root runs).
+    lib.tft_wal_open.restype = ctypes.c_void_p
+    lib.tft_wal_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tft_wal_close.argtypes = [ctypes.c_void_p]
+    lib.tft_wal_log_lease.restype = ctypes.c_int
+    lib.tft_wal_log_lease.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,  # post-apply member slices JSON
+        ctypes.c_int64,   # unix ms stamp
+    ]
+    lib.tft_wal_log_depart.restype = ctypes.c_int
+    lib.tft_wal_log_depart.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tft_wal_log_quorum.restype = ctypes.c_int
+    lib.tft_wal_log_quorum.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,  # quorum JSON
+        ctypes.c_int64,   # quorum gen
+        ctypes.c_int64,   # root epoch
+    ]
+    lib.tft_wal_log_epoch.restype = ctypes.c_int
+    lib.tft_wal_log_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tft_wal_snapshot.restype = ctypes.c_int
+    lib.tft_wal_snapshot.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,  # lighthouse state JSON (monotonic times)
+        ctypes.c_int64,   # quorum gen
+        ctypes.c_int64,   # root epoch
+        ctypes.c_int64,   # mono now
+        ctypes.c_int64,   # unix now
+    ]
+    lib.tft_wal_recover.restype = ctypes.c_int
+    lib.tft_wal_recover.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,   # mono now
+        ctypes.c_int64,   # unix now
         ctypes.POINTER(ctypes.c_void_p),
     ]
     lib.tft_backoff_ms.restype = ctypes.c_int64
@@ -622,7 +672,15 @@ class QuorumResult:
 
 
 class Lighthouse:
-    """In-process global quorum server (C++). Reference: src/lib.rs:266-319."""
+    """In-process global quorum server (C++). Reference: src/lib.rs:266-319.
+
+    Durable-control-plane knobs (all optional; see docs/OPERATIONS.md
+    "control-plane durability & failover"): ``wal_dir`` enables the
+    write-ahead quorum log + snapshot (``TORCHFT_LH_WAL_DIR``) so a
+    restart replays to the exact pre-crash quorum_id watermark;
+    ``peers`` is the comma-separated list of the OTHER roots of this
+    root's failover set; ``standby=True`` starts passive (tails the
+    active peer, takes over after ``takeover_ms`` of sync starvation)."""
 
     def __init__(
         self,
@@ -631,6 +689,11 @@ class Lighthouse:
         join_timeout_ms: int = 100,
         quorum_tick_ms: int = 100,
         heartbeat_timeout_ms: int = 5000,
+        wal_dir: str = "",
+        snapshot_every: int = 0,
+        peers: str = "",
+        standby: bool = False,
+        takeover_ms: int = 0,
     ) -> None:
         self._handle = _lib.tft_lighthouse_create(
             bind.encode(),
@@ -638,6 +701,11 @@ class Lighthouse:
             join_timeout_ms,
             quorum_tick_ms,
             heartbeat_timeout_ms,
+            wal_dir.encode(),
+            snapshot_every,
+            peers.encode(),
+            1 if standby else 0,
+            takeover_ms,
         )
         if not self._handle:
             _check(2)
@@ -646,10 +714,24 @@ class Lighthouse:
     def address(self) -> str:
         return _take_string(_lib.tft_lighthouse_address(self._handle))
 
+    def active(self) -> bool:
+        """True while this root SERVES (vs a passive warm standby that
+        rejects the protocol with UNAVAILABLE so clients rotate)."""
+        return bool(_lib.tft_lighthouse_active(self._handle))
+
+    def root_epoch(self) -> int:
+        """Monotonic root epoch: bumped at every active claim (startup or
+        standby takeover) and fenced through the WAL when one is
+        configured. 0 = never active."""
+        return int(_lib.tft_lighthouse_root_epoch(self._handle))
+
     def status_json(self) -> dict:
         """Machine-readable status: members + lease deadlines, last quorum,
-        tier role (``flat``/``root``), tick cost counters, region digests.
-        Served over HTTP as ``GET /status.json`` on the same port."""
+        tier role (``flat``/``root``/``standby``), tick cost counters,
+        region digests, and the durability stamps (``root_epoch``,
+        ``wal_replayed``, ``wal`` replay/append counters) that tell a
+        COLD root from an AMNESIAC one. Served over HTTP as
+        ``GET /status.json`` on the same port."""
         out = ctypes.c_void_p()
         _check(_lib.tft_lighthouse_status_json(self._handle, ctypes.byref(out)))
         return json.loads(_take_string(out))
@@ -814,14 +896,22 @@ class Manager:
         lease_ttl: Optional[timedelta] = None,
         region: str = "",
         host: str = "",
+        region_probe_max: int = 0,
     ) -> None:
         """``lighthouse_addr`` is this group's assigned lighthouse (the
         flat/root service, or a REGION lighthouse under a hierarchical
         tier). ``root_addr`` is the optional root fallback: a dead region
         demotes the group to direct-root registration until it returns.
+        Both addresses may be COMMA-SEPARATED endpoint lists (a root
+        failover set: active root + warm standbys); a failed renewal
+        rotates to the next endpoint on the jittered-backoff schedule.
         ``lease_ttl`` (None = lighthouse default) is how long the group
         stays live without a renewal; renewals are jittered and back off
-        exponentially while the lighthouse is unreachable. ``region``
+        exponentially while the lighthouse is unreachable.
+        ``region_probe_max`` bounds the demoted manager's once-per-TTL
+        region re-probes: after that many consecutive failures it stops
+        probing (stays on the root) instead of leaking a doomed connect
+        attempt per TTL forever; 0 = probe forever. ``region``
         ("" = unlabeled) is the group's topology label: it rides the
         quorum requester into every member's QuorumMember, and the quorum
         result's region map is what the data plane compiles into the
@@ -841,6 +931,7 @@ class Manager:
             _ms(lease_ttl) if lease_ttl is not None else 0,
             region.encode(),
             host.encode(),
+            region_probe_max,
         )
         if not self._handle:
             _check(2)
@@ -853,6 +944,12 @@ class Manager:
         """True while region failover has this group registered directly at
         the root (always False without a ``root_addr``)."""
         return bool(_lib.tft_manager_using_root(self._handle))
+
+    def region_probe_given_up(self) -> bool:
+        """True once the bounded region re-probe (``region_probe_max``)
+        exhausted its budget: the manager stays on the root and probes no
+        more (the region is gone from the topology, not restarting)."""
+        return bool(_lib.tft_manager_probe_given_up(self._handle))
 
     def set_status(self, status: dict) -> None:
         """Publishes a member-health digest that rides every subsequent
@@ -1141,6 +1238,83 @@ def digest_apply(state: dict, digest: list, now_ms: int) -> dict:
             ctypes.byref(out),
         )
     )
+    return json.loads(_take_string(out))
+
+
+class WalLog:
+    """A handle on the root's write-ahead quorum log (native DurableLog) —
+    the pure-function surface the kill-at-every-record property suites
+    and the scripted hierarchy interpreter drive. The LIVE lighthouse
+    writes through the identical C++ class; this wrapper exists so tests
+    can author byte-exact logs with scripted clocks (pass the scripted
+    ``t`` as both mono and unix everywhere — the rebase is then an
+    identity)."""
+
+    def __init__(self, dir: str, snapshot_every: int = 0) -> None:
+        self._handle = _lib.tft_wal_open(dir.encode(), snapshot_every)
+        if not self._handle:
+            _check(2)
+
+    def log_lease(self, entries: List[dict], unix_ms: int) -> None:
+        """Appends post-apply member slices: each entry is ``{replica_id,
+        age_ms, ttl_ms, participating, joined_age_ms, member}`` with ages
+        relative to ``unix_ms``."""
+        _check(
+            _lib.tft_wal_log_lease(
+                self._handle, json.dumps(entries).encode(), unix_ms
+            )
+        )
+
+    def log_depart(self, replica_id: str) -> None:
+        _check(_lib.tft_wal_log_depart(self._handle, replica_id.encode()))
+
+    def log_quorum(self, quorum: dict, quorum_gen: int, root_epoch: int) -> None:
+        _check(
+            _lib.tft_wal_log_quorum(
+                self._handle, json.dumps(quorum).encode(), quorum_gen, root_epoch
+            )
+        )
+
+    def log_epoch(self, epoch: int) -> None:
+        _check(_lib.tft_wal_log_epoch(self._handle, epoch))
+
+    def snapshot(
+        self,
+        state: dict,
+        quorum_gen: int,
+        root_epoch: int,
+        mono_now: int,
+        unix_now: int,
+    ) -> None:
+        """Compacts: writes snapshot.json (atomic) and truncates the log."""
+        _check(
+            _lib.tft_wal_snapshot(
+                self._handle,
+                json.dumps(state).encode(),
+                quorum_gen,
+                root_epoch,
+                mono_now,
+                unix_now,
+            )
+        )
+
+    def close(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and _lib is not None:
+            _lib.tft_wal_close(handle)
+
+    def __del__(self) -> None:
+        self.close()
+
+
+def wal_recover(dir: str, mono_now: int, unix_now: int) -> dict:
+    """Replays a WAL directory (snapshot + log): returns ``{"state",
+    "quorum_gen", "root_epoch", "replayed", "records_replayed",
+    "dropped_tail_bytes"}`` with times re-based onto ``mono_now``. Torn
+    or truncated tail records are detected (length/CRC) and dropped,
+    never partially applied."""
+    out = ctypes.c_void_p()
+    _check(_lib.tft_wal_recover(dir.encode(), mono_now, unix_now, ctypes.byref(out)))
     return json.loads(_take_string(out))
 
 

@@ -192,11 +192,18 @@ class _NativeLib:
         min_replicas: int,
         join_timeout_ms: int,
         quorum_tick_ms: int,
-        heartbeat_timeout_ms: int
+        heartbeat_timeout_ms: int,
+        wal_dir: bytes,
+        snapshot_every: int,
+        peers: bytes,
+        standby: int,
+        takeover_ms: int
     ) -> Any: ...
     def tft_lighthouse_address(self, handle: Any) -> Any: ...
     def tft_lighthouse_shutdown(self, handle: Any) -> None: ...
     def tft_lighthouse_destroy(self, handle: Any) -> None: ...
+    def tft_lighthouse_active(self, handle: Any) -> int: ...
+    def tft_lighthouse_root_epoch(self, handle: Any) -> int: ...
     def tft_lighthouse_heartbeat(
         self,
         addr: bytes,
@@ -256,13 +263,48 @@ class _NativeLib:
         root_addr: bytes,
         lease_ttl_ms: int,
         region: bytes,
-        host: bytes
+        host: bytes,
+        region_probe_max: int
     ) -> Any: ...
     def tft_manager_address(self, handle: Any) -> Any: ...
     def tft_manager_shutdown(self, handle: Any) -> None: ...
     def tft_manager_destroy(self, handle: Any) -> None: ...
     def tft_manager_using_root(self, handle: Any) -> int: ...
+    def tft_manager_probe_given_up(self, handle: Any) -> int: ...
     def tft_manager_set_status(self, handle: Any, status_json: Any) -> int: ...
+    def tft_wal_open(self, dir: bytes, snapshot_every: int) -> Any: ...
+    def tft_wal_close(self, handle: Any) -> None: ...
+    def tft_wal_log_lease(
+        self,
+        handle: Any,
+        entries_json: bytes,
+        unix_ms: int
+    ) -> int: ...
+    def tft_wal_log_depart(self, handle: Any, replica_id: bytes) -> int: ...
+    def tft_wal_log_quorum(
+        self,
+        handle: Any,
+        quorum_json: bytes,
+        quorum_gen: int,
+        root_epoch: int
+    ) -> int: ...
+    def tft_wal_log_epoch(self, handle: Any, epoch: int) -> int: ...
+    def tft_wal_snapshot(
+        self,
+        handle: Any,
+        state_json: bytes,
+        quorum_gen: int,
+        root_epoch: int,
+        mono_now: int,
+        unix_now: int
+    ) -> int: ...
+    def tft_wal_recover(
+        self,
+        dir: bytes,
+        mono_now: int,
+        unix_now: int,
+        out: Any
+    ) -> int: ...
     def tft_client_create(
         self,
         addr: bytes,

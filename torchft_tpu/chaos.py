@@ -46,10 +46,10 @@ from . import _native
 
 _MASK = (1 << 64) - 1
 
-# Seams a plan may name. The native engine owns the first three; the
+# Seams a plan may name. The native engine owns the first group; the
 # rest are realized Python-side by the injectors in this module.
-NATIVE_SEAMS = ("ring_send", "ring_hdr", "net_send", "shm_ring")
-PYTHON_SEAMS = ("store", "heal", "child", "shm", "lighthouse")
+NATIVE_SEAMS = ("ring_send", "ring_hdr", "net_send", "shm_ring", "wal_write")
+PYTHON_SEAMS = ("store", "heal", "child", "shm", "lighthouse", "root")
 SEAMS = NATIVE_SEAMS + PYTHON_SEAMS
 
 # Kinds per seam (what a random plan may draw). Native ring kinds map
@@ -66,12 +66,24 @@ SEAM_KINDS: Dict[str, Tuple[str, ...]] = {
     # (half a frame + poisoned ring magic).
     "shm_ring": ("drop", "delay", "truncate", "bit_flip"),
     "net_send": ("drop", "delay", "truncate", "bit_flip"),
+    # The root lighthouse's write-ahead quorum log (native/src/wal.cc):
+    # truncate = crash mid-append (half a record on disk — recovery must
+    # detect + drop the torn tail), drop = crash before any byte lands,
+    # delay = slow disk. Both crash kinds kill the log; the root then
+    # refuses NEW quorum promises (frozen beats regressed) until restart.
+    "wal_write": ("truncate", "drop", "delay"),
     "store": ("drop", "delay", "stale"),
     "heal": ("truncate_body", "reset_mid_range", "slow_loris", "error_500",
              "blackhole"),
     "child": ("sigkill", "sigstop"),
     "shm": ("tear",),
     "lighthouse": ("stall", "kill"),
+    # The ROOT lighthouse process (a RootProcess subprocess): kill =
+    # SIGKILL the active root mid-promise, restart = kill + respawn on
+    # the same port + WAL dir (the replay path), partition = SIGSTOP for
+    # `param` ms then SIGCONT (unreachable-but-alive — the takeover +
+    # deposed-primary fencing path).
+    "root": ("kill", "restart", "partition"),
 }
 
 
@@ -145,7 +157,7 @@ class FaultPlan:
             )
             h = splitmix64(h)
             param = (h % max_delay_ms) + 1 if kind in ("delay",) else 0
-            if kind in ("sigstop", "stall"):
+            if kind in ("sigstop", "stall", "partition"):
                 param = 300 + (h % 700)  # ms stopped before SIGCONT
             events.append(FaultEvent(step, seam, kind, member, param))
         events.sort(key=lambda e: (e.step, e.seam, e.kind, e.member))
@@ -525,6 +537,160 @@ class ProcessStall:
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:
             self._thread.join(timeout)
+
+
+class RootProcess:
+    """A root lighthouse hosted in a SUBPROCESS — the ``root`` seam's
+    substrate. In-process lighthouses cannot be SIGKILLed without taking
+    the harness down with them; this wrapper runs ``python -m
+    torchft_tpu.lighthouse`` on a FIXED port (so managers' endpoint lists
+    and a restart's address both survive the kill) with an optional WAL
+    dir, peer list and standby role, and exposes the three root
+    injectors:
+
+    - :meth:`kill` — SIGKILL (the mid-promise crash; with a WAL dir the
+      next :meth:`restart` replays to the pre-crash watermark).
+    - :meth:`restart` — kill + respawn with the same port/WAL/peers (the
+      recovery path; a deposed primary fences itself at startup when a
+      peer took over meanwhile).
+    - :meth:`partition` — SIGSTOP for ``duration_s`` then SIGCONT: the
+      root is unreachable but ALIVE, the takeover + stall-self-fence
+      path clean deaths never exercise.
+    """
+
+    def __init__(
+        self,
+        port: int,
+        wal_dir: str = "",
+        peers: str = "",
+        standby: bool = False,
+        takeover_ms: int = 0,
+        min_replicas: int = 1,
+        join_timeout_ms: int = 200,
+        heartbeat_timeout_ms: int = 5000,
+        extra_env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.port = port
+        self.wal_dir = wal_dir
+        self.peers = peers
+        self.standby = standby
+        self.takeover_ms = takeover_ms
+        self.min_replicas = min_replicas
+        self.join_timeout_ms = join_timeout_ms
+        self.heartbeat_timeout_ms = heartbeat_timeout_ms
+        self.extra_env = dict(extra_env or {})
+        self.proc: Optional[Any] = None
+        self.restarts = 0
+        self.spawn()
+
+    def address(self) -> str:
+        return f"http://localhost:{self.port}"
+
+    @property
+    def pid(self) -> Optional[int]:
+        return None if self.proc is None else self.proc.pid
+
+    def _argv(self) -> List[str]:
+        import sys
+
+        argv = [
+            sys.executable,
+            "-m",
+            "torchft_tpu.lighthouse",
+            "--role",
+            "root",
+            "--bind",
+            f"[::]:{self.port}",
+            "--min_replicas",
+            str(self.min_replicas),
+            "--join_timeout_ms",
+            str(self.join_timeout_ms),
+            "--heartbeat_timeout_ms",
+            str(self.heartbeat_timeout_ms),
+        ]
+        if self.wal_dir:
+            argv += ["--wal-dir", self.wal_dir]
+        if self.peers:
+            argv += ["--peers", self.peers]
+        if self.standby:
+            argv += ["--standby"]
+        if self.takeover_ms:
+            argv += ["--takeover-ms", str(self.takeover_ms)]
+        return argv
+
+    def spawn(self) -> None:
+        import subprocess
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # The child resolves `-m torchft_tpu.lighthouse` via PYTHONPATH,
+        # not the harness's cwd.
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        env.update(self.extra_env)
+        self.proc = subprocess.Popen(self._argv(), env=env)
+
+    def status(self, timeout: float = 2.0) -> Optional[dict]:
+        """One /status.json read, or None while unreachable."""
+        try:
+            with urllib.request.urlopen(
+                self.address() + "/status.json", timeout=timeout
+            ) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 - down/partitioned is the point
+            return None
+
+    def wait_serving(self, deadline_s: float = 20.0) -> dict:
+        """Blocks until /status.json answers (any role); returns it."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            st = self.status()
+            if st is not None:
+                return st
+            time.sleep(0.05)
+        raise TimeoutError(f"root on port {self.port} never served status")
+
+    def kill(self) -> None:
+        """SIGKILL — the root seam's clean-crash fault."""
+        if self.proc is not None and self.proc.poll() is None:
+            kill_process(self.proc.pid)
+            self.proc.wait(timeout=10)
+
+    def restart(self) -> None:
+        """kill + respawn on the same port/WAL/peers: the replay path."""
+        self.kill()
+        self.restarts += 1
+        self.spawn()
+
+    def partition(self, duration_s: float) -> ProcessStall:
+        """SIGSTOP for ``duration_s`` then SIGCONT (started; join() the
+        returned stall to wait for the CONT)."""
+        assert self.proc is not None
+        return ProcessStall(self.proc.pid, duration_s).start()
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                self.proc.kill()
+
+
+def free_port() -> int:
+    """Reserves an ephemeral port and releases it (the usual bind-probe;
+    RootProcess needs FIXED ports so kills and restarts keep the
+    address). The close-to-spawn window is racy in principle; harness
+    fleets allocate their ports up front, back to back, so collisions
+    would need an outside writer."""
+    s = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+    try:
+        s.bind(("::", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
 
 
 def tear_shm(name: str) -> None:

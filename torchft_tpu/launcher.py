@@ -151,6 +151,7 @@ def launch(
     env: Optional[Dict[str, str]] = None,
     hot_spare: bool = False,
     regions: int = 0,
+    root_addrs: str = "",
 ) -> int:
     """Runs one process per replica group locally, restarting any that exit
     non-zero up to ``max_restarts`` times (torchelastic's role in the
@@ -174,11 +175,20 @@ def launch(
     root), with groups assigned round-robin. Each group gets its region as
     ``TORCHFT_LIGHTHOUSE`` and the root as ``TORCHFT_LIGHTHOUSE_ROOT`` so a
     region death demotes its groups to direct-root registration (see
-    docs/OPERATIONS.md control-plane deployment)."""
+    docs/OPERATIONS.md control-plane deployment).
+
+    ``root_addrs`` (default: ``lighthouse_addr``) is the comma-separated
+    ROOT FAILOVER SET — the active root plus its warm standbys (durable
+    control plane). The whole list rides ``TORCHFT_LIGHTHOUSE_ROOT`` into
+    every group and into the region tier's upstream, so a root kill fails
+    the fleet over to a standby without any relaunch."""
     import tempfile
     import uuid as _uuid
 
     standby_dir = tempfile.mkdtemp(prefix="torchft_standby_") if hot_spare else None
+    root_addrs = root_addrs or os.environ.get(
+        "TORCHFT_LIGHTHOUSE_ROOT", ""
+    ) or lighthouse_addr
     region_tier = []
     if regions > 0:
         from . import _native
@@ -186,12 +196,12 @@ def launch(
         for i in range(regions):
             region_tier.append(
                 _native.RegionLighthouse(
-                    root_addr=lighthouse_addr, region_id=f"region_{i}"
+                    root_addr=root_addrs, region_id=f"region_{i}"
                 )
             )
         logger.info(
             f"region tier up: {[r.address() for r in region_tier]} -> root "
-            f"{lighthouse_addr}"
+            f"{root_addrs}"
         )
     # Probe ONCE, at spawn time: standbys only warm at idle priority when
     # the supervisor can lift them back at promotion, and cold restarts
@@ -214,7 +224,7 @@ def launch(
         group_lighthouse = lighthouse_addr
         if region_tier:
             group_lighthouse = region_tier[g % len(region_tier)].address()
-            group_env.setdefault("TORCHFT_LIGHTHOUSE_ROOT", lighthouse_addr)
+            group_env.setdefault("TORCHFT_LIGHTHOUSE_ROOT", root_addrs)
             # The same label the lighthouse tier is deployed by also
             # labels the DATA plane: it rides the quorum and, on a >= 2-
             # region cohort, compiles the two-tier collective schedule

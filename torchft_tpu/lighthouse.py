@@ -101,6 +101,41 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--join_timeout_ms", type=int, default=60000)
     parser.add_argument("--quorum_tick_ms", type=int, default=100)
     parser.add_argument("--heartbeat_timeout_ms", type=int, default=5000)
+    # ---- durable control plane (flat/root roles; see OPERATIONS.md
+    # "control-plane durability & failover") ----
+    parser.add_argument(
+        "--wal-dir",
+        default=os.environ.get("TORCHFT_LH_WAL_DIR", ""),
+        help="write-ahead quorum log + snapshot directory (env "
+        "TORCHFT_LH_WAL_DIR); empty = in-memory only",
+    )
+    parser.add_argument(
+        "--snapshot-every",
+        type=int,
+        default=int(os.environ.get("TORCHFT_LH_SNAPSHOT_EVERY", "0")),
+        help="WAL records per snapshot compaction (env "
+        "TORCHFT_LH_SNAPSHOT_EVERY; 0 = default 512)",
+    )
+    parser.add_argument(
+        "--peers",
+        default=os.environ.get("TORCHFT_LH_PEERS", ""),
+        help="comma-separated OTHER root endpoints of this root's "
+        "failover set (env TORCHFT_LH_PEERS)",
+    )
+    parser.add_argument(
+        "--standby",
+        action="store_true",
+        default=os.environ.get("TORCHFT_LH_STANDBY", "") in ("1", "on", "true"),
+        help="start as a passive warm standby: tail the active peer and "
+        "take over when its lease lapses (env TORCHFT_LH_STANDBY=1)",
+    )
+    parser.add_argument(
+        "--takeover-ms",
+        type=int,
+        default=int(os.environ.get("TORCHFT_LH_TAKEOVER_MS", "0")),
+        help="standby takeover bound: sync starvation longer than this "
+        "claims a new root epoch (env TORCHFT_LH_TAKEOVER_MS; 0 = 3000)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -121,6 +156,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             join_timeout_ms=args.join_timeout_ms,
             quorum_tick_ms=args.quorum_tick_ms,
             heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+            wal_dir=args.wal_dir,
+            snapshot_every=args.snapshot_every,
+            peers=args.peers,
+            standby=args.standby,
+            takeover_ms=args.takeover_ms,
         )
     logger.info(f"{args.role} lighthouse serving on {server.address()}")  # type: ignore[attr-defined]
 

@@ -80,6 +80,7 @@ class Manager:
         store_addr: Optional[str] = None,
         lighthouse_addr: Optional[str] = None,
         lighthouse_root_addr: Optional[str] = None,
+        region_probe_max: Optional[int] = None,
         lease_ttl: Optional[timedelta] = None,
         region: Optional[str] = None,
         host_label: Optional[str] = None,
@@ -112,6 +113,19 @@ class Manager:
             lighthouse_root_addr: root fallback for the hierarchical tier
                 (env ``TORCHFT_LIGHTHOUSE_ROOT``): a dead region demotes
                 the group to direct-root registration until it returns.
+                May be a COMMA-SEPARATED endpoint list (the durable
+                control plane's root failover set — active root + warm
+                standbys); renewals rotate to the next endpoint on
+                failure. ``lighthouse_addr`` accepts a list the same way.
+            region_probe_max: bounded give-up for the demoted manager's
+                once-per-TTL region re-probes (env
+                ``TORCHFT_REGION_PROBE_MAX``, default 20): after this
+                many consecutive failed probes the manager stops probing
+                and stays on the root — a region GONE from the topology
+                must not leak a doomed connect attempt per TTL for the
+                rest of the tenure. 0 = probe forever (the pre-bound
+                behavior; a revived region then always wins the group
+                back).
             lease_ttl: membership lease duration (env
                 ``TORCHFT_LEASE_TTL_MS``; None = the lighthouse's
                 heartbeat-timeout default). Renewals are jittered and back
@@ -226,6 +240,11 @@ class Manager:
         lighthouse_root_addr = lighthouse_root_addr or os.environ.get(
             "TORCHFT_LIGHTHOUSE_ROOT", ""
         )
+        if region_probe_max is None:
+            region_probe_max = int(
+                os.environ.get("TORCHFT_REGION_PROBE_MAX", "20")
+            )
+        self._region_probe_max = region_probe_max
         if lease_ttl is None:
             env_ttl = os.environ.get("TORCHFT_LEASE_TTL_MS")
             if env_ttl:
@@ -269,6 +288,7 @@ class Manager:
                 lease_ttl=lease_ttl,
                 region=region,
                 host=host_label,
+                region_probe_max=region_probe_max,
             )
             self._store.set(MANAGER_ADDR_KEY, self._manager.address().encode())
             self._store.set(REPLICA_ID_KEY, replica_id.encode())
