@@ -24,6 +24,17 @@ fault-tolerance overhead):
                    outer FLOPs/memory, not bytes, and the artifact says
                    which side won honestly. --dryrun shrinks the payload
                    and iterations to a smoke test (no artifact written).
+  --sharded-step-sweep
+                   PER-STEP ZeRO vs the fused plan-f32 per-step schedule:
+                   plan reduce-scatter (q8 grad wire, owner shard full
+                   f32) -> optimizer update on the owned ~1/W shard ->
+                   bf16 param allgather, vs plan-f32 allreduce + the
+                   redundant full-model update — at W=2 and W=3 under the
+                   starved-link cap, with both legs' MEASURED wire bytes
+                   and each member's resident optimizer bytes (∝ 1/W) in
+                   the rows -> merged into SHARD_BENCH.json under
+                   "per_step". --dryrun shrinks payload/iters to a smoke
+                   test asserting the 1/W scaling (no artifact written).
   --plan-sweep     legacy managed gradient sync vs the persistent native
                    COMM PLAN on a ddp_small-shaped gradient tree (the
                    real model's param signature: ~0.72M params over its
@@ -149,6 +160,21 @@ SHARD_WIRES = ("f32", "q8")
 SHARD_ITERS = 3
 # Nesterov outer step, the standard DiLoCo outer optimizer.
 SHARD_OUTER_LR, SHARD_OUTER_MOM = 0.7, 0.9
+
+# Sharded-step-sweep knobs: PER-STEP ZeRO (plan reduce-scatter on the q8
+# wire -> optimizer update on the owned 1/W shard -> bf16 param
+# allgather) vs the fused plan-f32 per-step schedule (full allreduce +
+# redundant full-model update), at W=2 and W=3 under the same
+# starved-link cap the plan sweep models. Two stories, both honest: the
+# sharded schedule cuts WIRE BYTES only vs plan-f32 (vs a fused q8 ring
+# it trades bytes for exactness — SHARD_BENCH's q8 rows); it always
+# cuts optimizer update FLOPs and resident state by ~W.
+SHSTEP_PAYLOAD_MB = 8
+SHSTEP_WIRE_CAP_MBPS = 12
+SHSTEP_STRIPES = 4
+SHSTEP_CHUNKS = 8
+SHSTEP_ITERS = 3
+SHSTEP_WORLDS = (2, 3)
 
 # Plan-sweep knobs: the ddp_small gradient signature under the same
 # measured-tunnel-rate cap the sharded sweep uses (the regime where
@@ -665,6 +691,8 @@ def _apply_cap(mode) -> None:
         os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(WIRE_CAP_MBPS)
     elif mode == "sharded_capped":
         os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(SHARD_WIRE_CAP_MBPS)
+    elif mode.startswith("shstep"):
+        os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(SHSTEP_WIRE_CAP_MBPS)
     elif mode in ("plan_capped", "devpack_capped"):
         os.environ["TORCHFT_HC_WIRE_CAP_MBPS"] = str(PLAN_WIRE_CAP_MBPS)
     else:
@@ -741,6 +769,89 @@ def _sync_sharded(hc, tree, wire, box):
     return out
 
 
+def _shstep_payload_mb() -> int:
+    return 2 if "--dryrun" in sys.argv else SHSTEP_PAYLOAD_MB
+
+
+def _shstep_iters() -> int:
+    return 1 if "--dryrun" in sys.argv else SHSTEP_ITERS
+
+
+def _shstep_tree(fill: float):
+    import jax.numpy as jnp
+
+    n = _shstep_payload_mb() * (1 << 20) // 4 // N_LEAVES
+    return {f"g{i}": jnp.full((n,), fill, jnp.float32)
+            for i in range(N_LEAVES)}
+
+
+def _shstep_fused(hc, tree, world, box):
+    """The plan-f32 per-step baseline: fused plan allreduce + redundant
+    full-model optimizer update on every member."""
+    import jax
+
+    from torchft_tpu.collectives import ReduceOp
+
+    res = hc.plan_allreduce(
+        tree, ReduceOp.SUM, divisor=float(world)
+    ).wait()
+    leaves = jax.tree_util.tree_leaves(res)
+    if box.get("m") is None:
+        box["m"] = [np.zeros(l.size, np.float32) for l in leaves]
+        box["p"] = [np.zeros(l.size, np.float32) for l in leaves]
+    for i, leaf in enumerate(leaves):
+        _nesterov(np.asarray(leaf).ravel(), box["m"][i], box["p"][i])
+    return res
+
+
+def _shstep_sharded(hc, tree, world, box):
+    """The per-step ZeRO schedule: plan reduce-scatter (q8 grad wire,
+    owner shard full f32) -> optimizer update on the owned ~1/W shard ->
+    bf16 param allgather through the same plan."""
+    import jax
+
+    from torchft_tpu.collectives import ReduceOp
+
+    sh = hc.plan_reduce_scatter(
+        tree, ReduceOp.SUM, divisor=float(world),
+        wire="q8", ag_wire="bf16",
+    ).wait()
+    avg = np.asarray(sh.values["float32"])
+    if box.get("m") is None or box["m"].size != avg.size:
+        box["m"] = np.zeros(avg.size, np.float32)
+        box["p"] = np.zeros(avg.size, np.float32)
+    _nesterov(avg, box["m"], box["p"])
+    out = hc.plan_allgather_into(
+        sh.replace_values({"float32": box["p"].copy()}), wire="bf16"
+    ).wait()
+    jax.block_until_ready(out)
+    return out
+
+
+def _shstep_member(hc, tree, world) -> dict:
+    """The full sharded-step protocol for one member (measurer and peers
+    run the same sequence — the ring has no slack for divergence): warm
+    both schedules, then ITERS of each. Returns the member's boxes."""
+    fbox, sbox = {}, {}
+    _shstep_fused(hc, tree, world, fbox)
+    _shstep_sharded(hc, tree, world, sbox)
+    hc.pop_op_stats()  # drop warmup timings
+    iters = _shstep_iters()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        _shstep_fused(hc, tree, world, fbox)
+    fused_s = (time.perf_counter() - t0) / iters
+    fused_stats = hc.pop_op_stats()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        _shstep_sharded(hc, tree, world, sbox)
+    sharded_s = (time.perf_counter() - t0) / iters
+    sharded_stats = hc.pop_op_stats()
+    return {"fbox": fbox, "sbox": sbox, "fused_s": fused_s,
+            "sharded_s": sharded_s, "fused_stats": fused_stats,
+            "sharded_stats": sharded_stats}
+
+
 def peer(store_addr: str, mode: str) -> None:
     from torchft_tpu.platform import apply_jax_platform_env
 
@@ -754,6 +865,21 @@ def peer(store_addr: str, mode: str) -> None:
     _apply_cap(mode)
     apply_jax_platform_env()
     from torchft_tpu.collectives import HostCollectives, ReduceOp
+
+    if mode.startswith("shstep:"):
+        # Sharded-step member: rank r of a W-member ring, mirroring the
+        # measurer's op sequence exactly.
+        _, r, world = mode.split(":")
+        r, world = int(r), int(world)
+        zeros = _shstep_tree(0.0)
+        hc = HostCollectives(timeout=timedelta(seconds=600),
+                             connect_timeout=timedelta(seconds=600),
+                             pipeline_chunks=SHSTEP_CHUNKS,
+                             stripes=SHSTEP_STRIPES)
+        hc.configure(f"{store_addr}/shstep{world}", r, world)
+        _shstep_member(hc, zeros, world)
+        hc.shutdown()
+        return
 
     if mode.startswith("sharded"):
         # Mirror the measuring side's op sequence exactly (the ring has no
@@ -1098,6 +1224,87 @@ def _run_mode(mode):
     return results
 
 
+def _run_shstep(world: int) -> dict:
+    """One W-member sharded-step row: spawns W-1 peer processes, runs the
+    measurer in-process, returns the row with measured per-leg bytes."""
+    import jax
+
+    from torchft_tpu import Store
+    from torchft_tpu.collectives import HostCollectives
+
+    store = Store()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    peers = []
+    for r in range(1, world):
+        args = [sys.executable, os.path.abspath(__file__), "--peer",
+                store.address(), f"shstep:{r}:{world}"]
+        if "--dryrun" in sys.argv:
+            args.append("--dryrun")
+        peers.append(subprocess.Popen(args, env=env))
+    _apply_cap("shstep")
+    tree = _shstep_tree(1.0)
+    jax.block_until_ready(tree)
+    total_bytes = sum(
+        int(l.size) for l in jax.tree_util.tree_leaves(tree)
+    ) * 4
+    try:
+        hc = HostCollectives(timeout=timedelta(seconds=600),
+                             connect_timeout=timedelta(seconds=600),
+                             pipeline_chunks=SHSTEP_CHUNKS,
+                             stripes=SHSTEP_STRIPES)
+        hc.configure(f"{store.address()}/shstep{world}", 0, world)
+        m = _shstep_member(hc, tree, world)
+        hc.shutdown()
+        for p in peers:
+            assert p.wait(timeout=900) == 0
+    finally:
+        for p in peers:
+            if p.poll() is None:
+                p.kill()
+        store.shutdown()
+    iters = _shstep_iters()
+    fused_wire = sum(
+        st.get("wire_bytes") or st["bytes"] for st in m["fused_stats"]
+    ) / iters
+    rs_stats = [st for st in m["sharded_stats"]
+                if st["op"] == "plan_reduce_scatter"]
+    ag_stats = [st for st in m["sharded_stats"]
+                if st["op"] == "plan_allgather_into"]
+    rs_wire = sum(st["wire_bytes"] for st in rs_stats) / iters
+    ag_wire = sum(st["wire_bytes"] for st in ag_stats) / iters
+    # Optimizer residency: the momentum buffer each member actually
+    # holds — the full model for the fused schedule, the owned shard
+    # for the sharded one (~1/W).
+    opt_fused = sum(mm.nbytes for mm in m["fbox"]["m"])
+    opt_sharded = int(m["sbox"]["m"].nbytes)
+    row = {
+        "world": world,
+        "payload_MB": _shstep_payload_mb(),
+        "fused_s": round(m["fused_s"], 3),
+        "sharded_s": round(m["sharded_s"], 3),
+        "steps_per_s_fused": round(1.0 / m["fused_s"], 3),
+        "steps_per_s_sharded": round(1.0 / m["sharded_s"], 3),
+        "speedup": round(m["fused_s"] / m["sharded_s"], 3),
+        "fused_wire_MB_per_step": round(fused_wire / (1 << 20), 2),
+        "rs_wire_MB_per_step": round(rs_wire / (1 << 20), 2),
+        "ag_wire_MB_per_step": round(ag_wire / (1 << 20), 2),
+        "model_bytes": total_bytes,
+        "opt_state_bytes_fused": opt_fused,
+        "opt_state_bytes_sharded": opt_sharded,
+    }
+    print(
+        f"W={world}: fused {m['fused_s']:.3f}s/step, sharded "
+        f"{m['sharded_s']:.3f}s/step -> {row['speedup']:.2f}x; wire/step "
+        f"fused {row['fused_wire_MB_per_step']}MB vs rs "
+        f"{row['rs_wire_MB_per_step']}MB + ag "
+        f"{row['ag_wire_MB_per_step']}MB; opt bytes {opt_fused} -> "
+        f"{opt_sharded}",
+        flush=True,
+    )
+    return row
+
+
 def _run_hier():
     """Spawns W-1 member processes, runs the measurer in-process, then
     verifies cross-member digests and peer exit codes (the kill victim
@@ -1244,6 +1451,59 @@ def main() -> None:
         print(json.dumps({
             "sharded_speedup": report["sharded_speedup"],
             "headline_config": best_key,
+        }))
+        return
+
+    if "--sharded-step-sweep" in sys.argv:
+        rows = [_run_shstep(w) for w in SHSTEP_WORLDS]
+        per_step = {
+            "platform": jax.devices()[0].platform,
+            "leaves": N_LEAVES,
+            "iters": _shstep_iters(),
+            "stripes": SHSTEP_STRIPES,
+            "per_connection_cap_MBps": SHSTEP_WIRE_CAP_MBPS,
+            "sync": "fused = plan-f32 allreduce + redundant full-model "
+                    "update on every member; sharded = plan "
+                    "reduce-scatter (q8 grad wire, owner shard full "
+                    "f32) -> update on the owned ~1/W shard -> bf16 "
+                    "param allgather",
+            "optimizer": {"kind": "nesterov-sgd", "lr": SHARD_OUTER_LR,
+                          "momentum": SHARD_OUTER_MOM},
+            "rows": rows,
+            "note": "wins steps/s vs plan-f32 (fewer f32 wire bytes AND "
+                    "~W x less update work); vs a fused q8 ring it wins "
+                    "memory/FLOPs, not bytes — the rs+ag legs ship "
+                    "~1.5B/elem where fused q8 ships ~1B/elem",
+        }
+        if "--dryrun" in sys.argv:
+            r2 = next(r for r in rows if r["world"] == 2)
+            r3 = next(r for r in rows if r["world"] == 3)
+            ratio = (r2["opt_state_bytes_sharded"]
+                     / max(r3["opt_state_bytes_sharded"], 1))
+            # 1/W scaling: W=2 shard ~ 1.5x the W=3 shard (3/2).
+            assert 1.2 < ratio < 1.9, f"opt shard not ~1/W: {ratio}"
+            for r in rows:
+                assert (r["opt_state_bytes_sharded"]
+                        < r["opt_state_bytes_fused"])
+                assert r["rs_wire_MB_per_step"] > 0
+                assert r["ag_wire_MB_per_step"] > 0
+            print(json.dumps({
+                "dryrun": True,
+                "speedup_w2": r2["speedup"],
+                "speedup_w3": r3["speedup"],
+                "opt_bytes_w2": r2["opt_state_bytes_sharded"],
+                "opt_bytes_w3": r3["opt_state_bytes_sharded"],
+            }))
+            return
+        path = os.path.join(REPO, "SHARD_BENCH.json")
+        with open(path) as f:
+            report = json.load(f)
+        report["per_step"] = per_step
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps({
+            "per_step_speedups": {str(r["world"]): r["speedup"]
+                                  for r in rows},
         }))
         return
 

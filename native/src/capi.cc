@@ -607,6 +607,59 @@ int tft_plan_execute_pre(void* handle, int64_t plan_id,
   });
 }
 
+// ---- sharded comm plans (per-step ZeRO) ----
+
+// Builds a SHARDED CommPlan: the fused allreduce split at the
+// reduce-scatter boundary so the caller can update only the 1/W shard it
+// owns and allgather the updated params. f32 leaves only; rs_wire
+// (0 native, 1 bf16, 2 q8) encodes the grad leg — the owner's shard
+// lands full f32 regardless — and ag_wire (0 native, 1 bf16) the param
+// leg. Returns the plan id (> 0) or -1 with tft_last_error set.
+int64_t tft_plan_build_sharded(void* handle, const int64_t* counts,
+                               const int32_t* dtypes, int64_t n_leaves,
+                               int rs_wire, int ag_wire) {
+  int64_t id = -1;
+  int rc = guarded([&] {
+    id = static_cast<HostCollectives*>(handle)->plan_build_sharded(
+        counts, dtypes, n_leaves, static_cast<PlanWire>(rs_wire),
+        static_cast<PlanWire>(ag_wire));
+  });
+  return rc == kOk ? id : -1;
+}
+
+// Grad leg of a sharded plan: packs leaf_in (n_leaves, signature order),
+// rides the reduce-scatter phase, compacts the rank-owned shard into
+// shard_out (tft_plan_sharded_meta's shard_count f32 elements) with the
+// divisor applied to the shard only.
+int tft_plan_execute_rs(void* handle, int64_t plan_id,
+                        const void* const* leaf_in, float* shard_out,
+                        double divisor, int has_divisor, int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->plan_execute_rs(
+        plan_id, leaf_in, shard_out, divisor, has_divisor != 0, timeout_ms);
+  });
+}
+
+// Param leg of a sharded plan: scatters shard_in (the updated shard,
+// same layout) back, rides the allgather phase at the plan's ag wire and
+// unpacks into leaf_out (n_leaves, signature order), no divisor.
+int tft_plan_execute_ag(void* handle, int64_t plan_id, const float* shard_in,
+                        void* const* leaf_out, int64_t timeout_ms) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->plan_execute_ag(
+        plan_id, shard_in, leaf_out, timeout_ms);
+  });
+}
+
+// out3[0] = this rank's shard element count, out3[1] = the plan's stripe
+// partition (pass it to tft_hc_shard_ranges as layout_stripes), out3[2]
+// = total flat element count.
+int tft_plan_sharded_meta(void* handle, int64_t plan_id, int64_t* out3) {
+  return guarded([&] {
+    static_cast<HostCollectives*>(handle)->plan_sharded_meta(plan_id, out3);
+  });
+}
+
 int tft_plan_free(void* handle, int64_t plan_id) {
   return guarded(
       [&] { static_cast<HostCollectives*>(handle)->plan_free(plan_id); });

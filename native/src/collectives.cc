@@ -2310,6 +2310,90 @@ int64_t HostCollectives::plan_build(const int64_t* counts,
   return next_plan_id_++;
 }
 
+int64_t HostCollectives::plan_build_sharded(const int64_t* counts,
+                                            const int32_t* dtypes,
+                                            int64_t n_leaves, PlanWire rs_wire,
+                                            PlanWire ag_wire) {
+  if (world_size_ <= 0)
+    throw SocketError("plan_build before configure (layout needs the ring)");
+  if (n_leaves <= 0) throw SocketError("plan_build of an empty signature");
+  if (rs_wire == PlanWire::kQ8EF)
+    throw SocketError(
+        "sharded plans take no q8ef grad wire (error feedback corrects a "
+        "FUSED lossy result; the shard owner keeps full f32 here, so there "
+        "is no owner-side loss to feed back)");
+  if (ag_wire != PlanWire::kNative && ag_wire != PlanWire::kBF16)
+    throw SocketError(
+        "sharded plans allgather params at native or bf16 wires only (a "
+        "quantized param broadcast would drift the cohort's weights)");
+  auto p = std::make_unique<CommPlan>();
+  p->wire = rs_wire;
+  p->ag_wire = ag_wire;
+  p->sharded = true;
+  p->leaves.resize(n_leaves);
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&h](uint64_t v) {
+    for (int i = 0; i < 8; i++) {
+      h ^= (v >> (8 * i)) & 0xff;
+      h *= 1099511628211ull;
+    }
+  };
+  mix(static_cast<uint64_t>(rs_wire));
+  mix(static_cast<uint64_t>(world_size_));
+  mix(static_cast<uint64_t>(stripes_));
+  // The sharded schedule (and its second wire) is part of the contract a
+  // peer must share: a sharded plan meeting a fused plan of the same
+  // signature — or one gathering at a different param wire — must error
+  // at the header, not desync.
+  mix(0x53485244ull /*"SHRD"*/);
+  mix(static_cast<uint64_t>(ag_wire));
+  p->groups.emplace_back();
+  CommPlan::Group& g = p->groups.back();
+  g.dtype = Dtype::kF32;
+  for (int64_t i = 0; i < n_leaves; i++) {
+    if (counts[i] < 0) throw SocketError("plan_build: negative leaf count");
+    if (static_cast<Dtype>(dtypes[i]) != Dtype::kF32)
+      throw SocketError(
+          "sharded plans take f32 leaves only (the shard layout is one flat "
+          "f32 group; callers keep f32 master weights or use a fused plan)");
+    p->leaves[i] = {static_cast<size_t>(counts[i]), Dtype::kF32};
+    mix(static_cast<uint64_t>(counts[i]));
+    mix(static_cast<uint64_t>(dtypes[i]));
+    g.leaf_idx.push_back(i);
+    g.leaf_off.push_back(g.count);
+    g.count += static_cast<size_t>(counts[i]);
+  }
+  // The stripe partition derives from the GRAD leg's wire bytes (the
+  // fused op's own rule: q8 ~1 byte, bf16 2, f32 4 per element) and is
+  // shared by both legs — shard boundaries must be one arithmetic fact.
+  const size_t rs_esize = rs_wire == PlanWire::kQ8     ? 1
+                          : rs_wire == PlanWire::kBF16 ? 2
+                                                       : 4;
+  g.eff = effective_stripes(g.count * rs_esize, stripes_);
+  g.staging.resize(g.count * sizeof(float));
+  if (rs_wire == PlanWire::kBF16 || ag_wire == PlanWire::kBF16)
+    p->wirebuf.resize(g.count * 2);
+  p->sig = h;
+  MutexLock lock(plan_mu_);
+  plans_[next_plan_id_] = std::move(p);
+  return next_plan_id_++;
+}
+
+void HostCollectives::plan_sharded_meta(int64_t plan_id, int64_t* out) {
+  MutexLock op_lock(op_mu_);
+  CommPlan& p = plan_get(plan_id);
+  if (!p.sharded)
+    throw SocketError("plan_sharded_meta on a non-sharded plan");
+  const CommPlan::Group& g = p.groups[0];
+  size_t shard_count = 0;
+  for (auto [start, len] :
+       shard_ranges(g.count, sizeof(float), rank_, g.eff))
+    shard_count += len;
+  out[0] = static_cast<int64_t>(shard_count);
+  out[1] = g.eff;
+  out[2] = static_cast<int64_t>(g.count);
+}
+
 CommPlan& HostCollectives::plan_get(int64_t plan_id) {
   MutexLock lock(plan_mu_);
   auto it = plans_.find(plan_id);
@@ -2345,6 +2429,7 @@ std::string HostCollectives::plan_stats_json(int64_t plan_id) {
     JsonObject b;
     b["group"] = Json(st.group);
     b["stripe"] = Json(st.stripe);
+    b["leg"] = Json(st.leg);
     b["bytes"] = Json(st.bytes);
     b["pack_s"] = Json(st.pack_ns / 1e9);
     b["ring_s"] = Json(st.ring_ns / 1e9);
@@ -2977,6 +3062,169 @@ void HostCollectives::plan_execute(int64_t plan_id,
         st.unpack_ns = ns_between(t2, t3);
       });
     }
+  });
+  p.execs++;
+}
+
+void HostCollectives::plan_execute_rs(int64_t plan_id,
+                                      const void* const* leaf_in,
+                                      float* shard_out, double divisor,
+                                      bool has_divisor, int64_t timeout_ms) {
+  MutexLock lock(op_mu_);
+  op_seq_++;
+  CommPlan& p = plan_get(plan_id);
+  if (!p.sharded)
+    throw SocketError("plan_execute_rs on a non-sharded plan");
+  p.stats.clear();
+  CommPlan::Group& g = p.groups[0];
+  float* stg = reinterpret_cast<float*>(g.staging.data());
+  const float div32 = static_cast<float>(divisor);
+  if (world_size_ == 1) {
+    // Solo: the shard IS the whole payload — pack, divide, done.
+    plan_pack_range(p, g, leaf_in, 0, g.count);
+    for (size_t i = 0; i < g.count; i++)
+      shard_out[i] = has_divisor ? stg[i] / div32 : stg[i];
+    p.execs++;
+    return;
+  }
+  if (aborted_) throw SocketError("collectives not configured");
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // kind 11 = sharded grad leg: a sharded rs meeting a fused plan
+    // execute (kind 8) or the param leg (kind 12) errors at the header.
+    check_op_header(flat_, 11, p.sig, static_cast<uint32_t>(p.wire), 0,
+                    deadline);
+    const size_t wesize = p.wire == PlanWire::kQ8     ? 1
+                          : p.wire == PlanWire::kBF16 ? 2
+                                                      : 4;
+    p.stats.resize(g.eff);
+    last_stripe_ns_.assign(g.eff, 0);
+    const int64_t own_c = (rank_ + 1) % world_size_;
+    // Each stripe bucket runs pack -> rs phase end-to-end on its own
+    // pool worker — the fused plan's triple pipeline, minus the phase
+    // the schedule exists to drop.
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, g.eff, s);
+      CommPlan::BucketStat& st = p.stats[s];
+      st.group = 0;
+      st.stripe = s;
+      st.leg = 1;
+      st.bytes = static_cast<int64_t>(len * wesize);
+      if (len == 0) return;
+      auto t0 = std::chrono::steady_clock::now();
+      plan_pack_range(p, g, leaf_in, start, len);
+      auto t1 = std::chrono::steady_clock::now();
+      if (p.wire == PlanWire::kQ8) {
+        // Per-hop dequant-accumulate in f32: the owner's chunk ends as
+        // the FULL f32 running sum — the fused op's phase-2 owner
+        // quantization only existed to ship the chunk, and here it
+        // never ships (the PR-2 reduce_scatter_q8 discipline).
+        rs_q8_phase_stripe(flat_, s, stg + start, len, deadline);
+      } else if (p.wire == PlanWire::kBF16) {
+        // Cast the stripe to bf16 wire words, ride the rs phase at half
+        // width (per-hop f32 math, RNE back — the native bf16 body),
+        // then decode only the OWNER chunk back into f32 staging: the
+        // non-owned chunks' partial sums never leave the wire buffer.
+        uint16_t* w = reinterpret_cast<uint16_t*>(p.wirebuf.data()) + start;
+        for (size_t i = 0; i < len; i++) w[i] = f32_to_bf16(stg[start + i]);
+        rs_phase_stripe(flat_, s, reinterpret_cast<char*>(w), len, 2,
+                        Dtype::kBF16, ReduceOp::kSum, deadline);
+        auto [cs, cl] = chunk_range(len, world_size_, own_c);
+        for (size_t i = 0; i < cl; i++)
+          stg[start + cs + i] = bf16_to_f32(w[cs + i]);
+      } else {
+        rs_phase_stripe(flat_, s, reinterpret_cast<char*>(stg + start), len,
+                        sizeof(float), Dtype::kF32, ReduceOp::kSum, deadline);
+      }
+      auto t2 = std::chrono::steady_clock::now();
+      st.pack_ns = ns_between(t0, t1);
+      st.ring_ns = ns_between(t1, t2);
+    });
+    auto u0 = std::chrono::steady_clock::now();
+    copy_shard(reinterpret_cast<char*>(stg),
+               reinterpret_cast<char*>(shard_out), g.count, sizeof(float),
+               g.eff, /*to_shard=*/true);
+    if (has_divisor) {
+      size_t sn = 0;
+      for (auto [start, len] :
+           shard_ranges(g.count, sizeof(float), rank_, g.eff))
+        sn += len;
+      // The owner's slice of the fused unpack arithmetic: f32 / f32.
+      for (size_t i = 0; i < sn; i++) shard_out[i] /= div32;
+    }
+    if (!p.stats.empty())
+      p.stats[0].unpack_ns = ns_between(u0, std::chrono::steady_clock::now());
+  });
+  p.execs++;
+}
+
+void HostCollectives::plan_execute_ag(int64_t plan_id, const float* shard_in,
+                                      void* const* leaf_out,
+                                      int64_t timeout_ms) {
+  MutexLock lock(op_mu_);
+  op_seq_++;
+  CommPlan& p = plan_get(plan_id);
+  if (!p.sharded)
+    throw SocketError("plan_execute_ag on a non-sharded plan");
+  CommPlan::Group& g = p.groups[0];
+  float* stg = reinterpret_cast<float*>(g.staging.data());
+  if (world_size_ == 1) {
+    memcpy(stg, shard_in, g.count * sizeof(float));
+    plan_unpack_range(p, g, leaf_out, 0, g.count, 1.0, /*has_divisor=*/false);
+    p.execs++;
+    return;
+  }
+  if (aborted_) throw SocketError("collectives not configured");
+  run_op([&] {
+    int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+    // kind 12 = sharded param leg; the header carries the AG wire so a
+    // native-gathering member and a bf16-gathering one error apart.
+    check_op_header(flat_, 12, p.sig, static_cast<uint32_t>(p.ag_wire), 0,
+                    deadline);
+    copy_shard(reinterpret_cast<char*>(stg),
+               const_cast<char*>(reinterpret_cast<const char*>(shard_in)),
+               g.count, sizeof(float), g.eff, /*to_shard=*/false);
+    const size_t wesize = p.ag_wire == PlanWire::kBF16 ? 2 : 4;
+    const size_t stat_base = p.stats.size();  // append after the rs leg
+    p.stats.resize(stat_base + g.eff);
+    last_stripe_ns_.assign(g.eff, 0);
+    const int64_t own_c = (rank_ + 1) % world_size_;
+    run_striped([&](int64_t s) {
+      auto [start, len] = stripe_range(g.count, g.eff, s);
+      CommPlan::BucketStat& st = p.stats[stat_base + s];
+      st.group = 0;
+      st.stripe = s;
+      st.leg = 2;
+      st.bytes = static_cast<int64_t>(len * wesize);
+      if (len == 0) return;
+      auto t0 = std::chrono::steady_clock::now();
+      auto t1 = t0;
+      if (p.ag_wire == PlanWire::kBF16) {
+        // Encode only the OWNED chunk (the rest arrives over the ring),
+        // circulate the bf16 words, then decode the WHOLE stripe: every
+        // member adopts the identical decoded words, so the gathered
+        // params are bit-identical across the cohort — the property the
+        // commit vote's determinism oracle rests on.
+        uint16_t* w = reinterpret_cast<uint16_t*>(p.wirebuf.data()) + start;
+        auto [cs, cl] = chunk_range(len, world_size_, own_c);
+        for (size_t i = 0; i < cl; i++)
+          w[cs + i] = f32_to_bf16(stg[start + cs + i]);
+        t1 = std::chrono::steady_clock::now();
+        ag_phase_stripe(flat_, s, reinterpret_cast<char*>(w), len, 2,
+                        deadline);
+        for (size_t i = 0; i < len; i++) stg[start + i] = bf16_to_f32(w[i]);
+      } else {
+        ag_phase_stripe(flat_, s, reinterpret_cast<char*>(stg + start), len,
+                        sizeof(float), deadline);
+      }
+      auto t2 = std::chrono::steady_clock::now();
+      plan_unpack_range(p, g, leaf_out, start, len, 1.0,
+                        /*has_divisor=*/false);
+      auto t3 = std::chrono::steady_clock::now();
+      st.pack_ns = ns_between(t0, t1);
+      st.ring_ns = ns_between(t1, t2);
+      st.unpack_ns = ns_between(t2, t3);
+    });
   });
   p.execs++;
 }

@@ -308,9 +308,13 @@ struct CommPlan {
   };
   // Per-bucket (= per stripe sub-range) phase timings of the last
   // execute; the plan-path analog of the bulk path's bucket stats.
+  // `leg` distinguishes a sharded plan's two halves (1 = reduce-scatter
+  // grad leg, 2 = allgather param leg; 0 = fused execute) so the
+  // accounting layer can bill each leg's wire separately.
   struct BucketStat {
     int64_t group = 0;
     int64_t stripe = 0;
+    int64_t leg = 0;
     int64_t bytes = 0;
     int64_t pack_ns = 0, ring_ns = 0, unpack_ns = 0;
   };
@@ -337,6 +341,21 @@ struct CommPlan {
   // Baked into the signature hash: a hier plan meeting a flat plan must
   // error, not desync.
   bool hier = false;
+  // Sharded plan (per-step ZeRO): the fused schedule split at the
+  // reduce-scatter boundary into two first-class executes. `wire` is the
+  // GRAD reduce-scatter leg's encoding; `ag_wire` the PARAM allgather
+  // leg's (native or bf16). One flat f32 group; the rank-owned shard —
+  // shard_ranges over the group's eff — always lands in FULL f32
+  // precision (a lossy wire only ever paid to ship bytes the owner never
+  // ships). Both legs share the group's eff, so the two partitions can
+  // never disagree. Runs on the FLAT ring regardless of topology (the
+  // flat ring always exists; the shard layout is its layout).
+  bool sharded = false;
+  PlanWire ag_wire = PlanWire::kNative;
+  // Persistent bf16 wire staging for a sharded plan's bf16 leg(s)
+  // (grow-only, the hier_wire_buf_ discipline, but per plan: sized once
+  // at build).
+  std::vector<char> wirebuf;
   std::vector<Leaf> leaves;
   std::vector<Group> groups;
   // kQ8EF: persistent error-feedback carry, laid out exactly like the
@@ -550,6 +569,45 @@ class HostCollectives {
   void plan_execute_pre(int64_t plan_id, const void* const* group_in,
                         const void* const* group_aux, void* const* leaf_out,
                         double divisor, bool has_divisor, int64_t timeout_ms);
+
+  // ---- sharded comm plans (per-step ZeRO weight-update sharding) ----
+  //
+  // plan_build_sharded compiles a SHARDED CommPlan: the fused allreduce
+  // schedule split at the reduce-scatter boundary so a caller can update
+  // only the 1/W shard it owns (optimizer state sharded with it) and
+  // allgather the *updated* params — "Automatic Cross-Replica Sharding
+  // of Weight Update in Data-Parallel Training" (Xu et al.) on the
+  // per-step path. f32 leaves only (they pack one flat f32 group whose
+  // shard_ranges over the group eff IS the shard layout); `rs_wire`
+  // encodes the grad leg (native/bf16/q8 — the owner's shard stays full
+  // f32 either way), `ag_wire` the param leg (native/bf16). Like every
+  // plan: valid until the next configure(), signature exchanged in the
+  // op headers (kinds 11/12) so mismatched plans error, not desync.
+  int64_t plan_build_sharded(const int64_t* counts, const int32_t* dtypes,
+                             int64_t n_leaves, PlanWire rs_wire,
+                             PlanWire ag_wire);
+
+  // Grad leg: packs leaf_in into the f32 staging, runs the rs phase per
+  // stripe bucket (the fused op's own body at the plan's partition),
+  // compacts the rank-owned chunks into `shard_out` (plan_sharded_meta's
+  // shard_count f32 elements) and applies the divisor to the SHARD only
+  // — the owner's slice of the fused unpack arithmetic (f32 / f32).
+  void plan_execute_rs(int64_t plan_id, const void* const* leaf_in,
+                       float* shard_out, double divisor, bool has_divisor,
+                       int64_t timeout_ms);
+
+  // Param leg: scatters `shard_in` (the UPDATED shard, same layout) back
+  // into staging, rides the ag phase at `ag_wire` (bf16: every member
+  // decodes the identical wire words, so gathered params are
+  // bit-identical across the cohort) and unpacks into leaf_out, no
+  // divisor.
+  void plan_execute_ag(int64_t plan_id, const float* shard_in,
+                       void* const* leaf_out, int64_t timeout_ms);
+
+  // out[0] = this rank's shard element count, out[1] = the plan's stripe
+  // partition (the layout_stripes to pass shard_ranges), out[2] = total
+  // flat element count.
+  void plan_sharded_meta(int64_t plan_id, int64_t* out);
 
   void plan_free(int64_t plan_id);
   // Zeroes a kQ8EF plan's error-feedback carry (no-op otherwise): the

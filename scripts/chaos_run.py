@@ -34,6 +34,13 @@ monotonicity ACROSS ROOT EPOCHS, zero split-brain, a bounded
 formation-liveness gap, and that a restarted root replays its WAL and
 fences behind the takeover epoch — with zero manager restarts.
 
+The ``sharded_reshard`` config turns the faults on the per-step ZeRO
+data plane: a member dies mid reduce-scatter (seeded ring partition +
+departure), the vote discards the broken step, the shrunken quorum
+RE-PARTITIONS the ~1/W optimizer shards (momentum carried through the
+cohort mask-allgather), and the next step commits bit-identically
+across the survivors.
+
 Also run here (and recorded in CHAOS_BENCH.json):
 
   - the SIGKILL vs SIGSTOP isolated-child probes: a stopped child must
@@ -1064,6 +1071,236 @@ def run_policy_schedule(seed: int, deadline_s: float = 240.0) -> dict:
     }
 
 
+def run_sharded_reshard(seed: int, deadline_s: float = 180.0) -> dict:
+    """The per-step ZeRO data plane under a mid-reduce-scatter death:
+    3 groups run ShardedOptimizerWrapper steps (rs -> ~1/W shard update
+    -> param allgather); at the death step a seeded ring partition fires
+    on the victim AND the victim drops off the ring without voting — the
+    survivors' in-flight reduce-scatter breaks, the vote discards the
+    step, the quorum shrinks to 2, the optimizer shards RE-PARTITION
+    (each survivor's shard grows from ~1/3 to ~1/2 of the model, with
+    the surviving positions' momentum carried through the cohort
+    mask-allgather), and the next step commits bit-identically."""
+    import optax
+    import jax.numpy as jnp
+
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+    from torchft_tpu.train_state import FTTrainState
+
+    groups, victim, death_step, loop_steps = 3, 2, 3, 8
+    n_elems = 4096
+    plan = FaultPlan(
+        seed=seed,
+        events=(
+            chaos.FaultEvent(step=death_step, seam="ring_send",
+                             kind="partition", member=victim),
+        ),
+    )
+    injector = ChaosInjector(plan)
+    repro = (
+        f"replay: --config sharded_reshard --seed {seed} "
+        f"--plan '{plan.to_json()}'"
+    )
+    lighthouse = Lighthouse(
+        bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=50, heartbeat_timeout_ms=4000,
+    )
+    records = [_MemberRecord() for _ in range(groups)]
+    reshards: List[List[Tuple[int, int, int]]] = [[] for _ in range(groups)]
+    stop_flag = threading.Event()
+
+    def member_main(gid: int) -> None:
+        state = FTTrainState(
+            {"w": jnp.full(n_elems, 1.0, jnp.float32)},
+            optax.sgd(0.05, momentum=0.9),
+            opt_state=(),  # the wrapper owns the ~1/W shard
+        )
+        store = Store()
+        wrapper: Optional[ShardedOptimizerWrapper] = None
+        collectives = HostCollectives(
+            timeout=timedelta(seconds=OP_TIMEOUT_S),
+            connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+            stripes=1,
+            wire_crc=True,
+        )
+        manager = Manager(
+            collectives=collectives,
+            load_state_dict=lambda s: wrapper.load_state_dict(s),
+            state_dict=lambda: wrapper.state_dict(),
+            min_replica_size=groups - 1,
+            use_async_quorum=False,
+            timeout=timedelta(seconds=OP_TIMEOUT_S),
+            quorum_timeout=timedelta(seconds=OP_TIMEOUT_S * 4),
+            connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+            rank=0,
+            world_size=1,
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"chaos_zero_{gid}",
+        )
+        wrapper = ShardedOptimizerWrapper(manager, state, shard_wire="q8")
+        rec = records[gid]
+        deadline = time.monotonic() + deadline_s
+        armed_for = -1
+        last_shard: Optional[Tuple[int, int]] = None
+        try:
+            while not stop_flag.is_set() and time.monotonic() < deadline:
+                attempted = manager.current_step()
+                if attempted >= loop_steps:
+                    break
+                if gid == 0 and attempted != armed_for:
+                    injector.begin_step(attempted)
+                    armed_for = attempted
+                err: Optional[Exception] = None
+                try:
+                    wrapper.zero_grad()
+                    grads = {
+                        "w": jnp.full(
+                            n_elems, 0.01 * (gid + 1) + attempted * 0.001,
+                            jnp.float32,
+                        )
+                    }
+                    if wrapper.step(grads):
+                        qid = manager.quorum_id()
+                        rec.commits[attempted] = qid
+                        meta = wrapper._core._shard_meta
+                        shard_sig = (
+                            meta["quorum_id"], wrapper.opt_state_bytes()
+                        )
+                        if shard_sig != last_shard:
+                            # A (re-)partition landed this step: record
+                            # (step, quorum_id, resident opt bytes).
+                            reshards[gid].append(
+                                (attempted,) + shard_sig
+                            )
+                            last_shard = shard_sig
+                    else:
+                        err = manager.errored()
+                        rec.discards.append(attempted)
+                except Exception as e:  # noqa: BLE001 - chaos surfaces here
+                    err = e
+                    try:
+                        if manager.errored() is None:
+                            manager.report_error(e)
+                        manager.should_commit(
+                            timeout=timedelta(seconds=OP_TIMEOUT_S)
+                        )
+                    except Exception:
+                        pass
+                    rec.discards.append(attempted)
+                _classify(rec, err)
+                if gid == victim and attempted >= death_step:
+                    # The armed ring partition just broke this member's
+                    # reduce-scatter mid-flight; die here — off the ring
+                    # for good, without retrying the step. The survivors
+                    # discarded the same window, shrink the quorum, and
+                    # re-partition the shards.
+                    break
+            rec.final_digest = _digest(
+                {"w": np.asarray(state.params["w"])}
+            )
+            rec.alive = gid != victim
+        finally:
+            try:
+                manager.shutdown()
+            except Exception:
+                pass
+            try:
+                collectives.shutdown()
+            except Exception:
+                pass
+            store.shutdown()
+
+    threads = [
+        threading.Thread(target=member_main, args=(g,), name=f"zero_g{g}")
+        for g in range(groups)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(deadline_s + 30)
+    stop_flag.set()
+    stats = injector.finish()
+    lighthouse.shutdown()
+    wall_s = time.monotonic() - t0
+
+    survivors = [g for g in range(groups) if g != victim]
+    for g in survivors:
+        assert records[g].final_digest is not None, (
+            f"survivor {g} did not finish ({repro})"
+        )
+
+    # 1. The death window discarded: the broken reduce-scatter never
+    # committed silently on any survivor.
+    all_discards = set().union(
+        *(set(records[g].discards) for g in survivors)
+    )
+    assert {death_step - 1, death_step, death_step + 1} & all_discards, (
+        f"no survivor discarded around the death step {death_step} "
+        f"(discards={sorted(all_discards)}, {repro})"
+    )
+
+    # 2. RESHARD: every survivor re-partitioned after the quorum shrank —
+    # a later record with a HIGHER quorum id and a BIGGER resident shard
+    # (~1/3 of the model -> ~1/2), i.e. the shards really re-covered the
+    # departed member's range.
+    for g in survivors:
+        assert len(reshards[g]) >= 2, (
+            f"survivor {g} never re-partitioned "
+            f"(reshards={reshards[g]}, {repro})"
+        )
+        first_step, first_qid, first_bytes = reshards[g][0]
+        last_step, last_qid, last_bytes = reshards[g][-1]
+        assert last_qid > first_qid and last_step > death_step - 1, (
+            f"survivor {g}'s re-partition did not follow the quorum "
+            f"change (reshards={reshards[g]}, {repro})"
+        )
+        assert last_bytes > first_bytes, (
+            f"survivor {g}'s shard did not grow when W shrank 3->2 "
+            f"(reshards={reshards[g]}, {repro})"
+        )
+
+    # 3. LIVENESS: a clean commit after the death step, on every survivor.
+    for g in survivors:
+        assert any(s > death_step for s in records[g].commits), (
+            f"survivor {g} never committed after the death "
+            f"(commits={sorted(records[g].commits)}, {repro})"
+        )
+
+    # 4. EPOCH PURITY + BIT IDENTITY across survivors.
+    for g in survivors:
+        steps_sorted = sorted(records[g].commits)
+        for a, b in zip(steps_sorted, steps_sorted[1:]):
+            assert records[g].commits[a] <= records[g].commits[b], (
+                f"quorum epoch went backward on survivor {g} ({repro})"
+            )
+    digests = {records[g].final_digest for g in survivors}
+    assert len(digests) == 1, (
+        f"survivors ended with diverged params {digests} ({repro})"
+    )
+
+    return {
+        "config": "sharded_reshard",
+        "seed": seed,
+        "groups": groups,
+        "victim": victim,
+        "death_step": death_step,
+        "plan": json.loads(plan.to_json()),
+        "wall_s": round(wall_s, 3),
+        "faults_fired": stats.get("fired", {}),
+        "commits_per_member": [len(r.commits) for r in records],
+        "discards_per_member": [len(r.discards) for r in records],
+        "reshards_per_member": [
+            [list(t) for t in reshards[g]] for g in range(groups)
+        ],
+        "resharded": True,
+        "liveness_ok": True,
+        "epoch_purity_ok": True,
+        "bit_identity_ok": True,
+    }
+
+
 # -- entry point -------------------------------------------------------------
 
 
@@ -1077,7 +1314,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="replay an explicit plan JSON")
     parser.add_argument("--config", type=str, default="ddp",
                         choices=("ddp", "plan", "hier", "hier_shm",
-                                 "policy", "root_outage"))
+                                 "policy", "root_outage",
+                                 "sharded_reshard"))
     parser.add_argument("--seeds", type=int, default=3,
                         help="seeds per configuration for the full run")
     parser.add_argument("--out", default=os.path.join(REPO, "CHAOS_BENCH.json"))
@@ -1087,6 +1325,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # replay mode: one schedule, loud verdict
         if args.config == "policy":
             rec = run_policy_schedule(args.seed)
+        elif args.config == "sharded_reshard":
+            rec = run_sharded_reshard(args.seed)
         elif args.config == "root_outage":
             plan = FaultPlan.from_json(args.plan) if args.plan else None
             rec = run_root_outage(args.seed, plan=plan)
@@ -1183,6 +1423,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"commits={outage_rec['commits_per_member']}", flush=True,
     )
 
+    # Sharded-reshard schedule (per-step ZeRO): a member dies mid
+    # reduce-scatter, the vote discards, the shrunken quorum
+    # RE-PARTITIONS the optimizer shards (momentum carried through the
+    # mask-allgather), and the next step commits bit-identically. Pinned
+    # (death at step 3) so the reshard record is guaranteed, not
+    # seed-lucky.
+    reshard_rec = run_sharded_reshard(13)
+    records.append(reshard_rec)
+    print(
+        f"[chaos] sharded reshard: "
+        f"reshards={reshard_rec['reshards_per_member']}, "
+        f"commits={reshard_rec['commits_per_member']}", flush=True,
+    )
+
     probes = run_iso_probes()
     print(f"[chaos] iso probes: {json.dumps(probes)}", flush=True)
 
@@ -1200,6 +1454,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     assert root_restart_records, (
         "no root-restart record with monotone quorum_id was produced"
     )
+    reshard_records = [
+        r
+        for r in records
+        if r.get("config") == "sharded_reshard" and r.get("resharded")
+    ]
+    assert reshard_records, (
+        "no sharded re-partition record was produced"
+    )
 
     if args.dryrun:
         print(
@@ -1210,6 +1472,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "detected_corruption_records": len(detected),
                     "sigstop_stall_records": len(stalls),
                     "root_restart_records": len(root_restart_records),
+                    "sharded_reshard_records": len(reshard_records),
                 }
             )
         )

@@ -28,6 +28,7 @@ from torchft_tpu import (
     Lighthouse,
     Manager,
     OptimizerWrapper,
+    ShardedOptimizerWrapper,
 )
 from torchft_tpu.parallel import shard_pytree
 
@@ -54,16 +55,22 @@ class ReshardingFTTrainState(FTTrainState):
     """Heal path re-shards healed leaves (host numpy off the ring) onto
     the group's mesh so the jitted step's in_shardings contract holds."""
 
-    def __init__(self, params, tx, mesh, rules) -> None:
-        super().__init__(shard_pytree(params, rules, mesh), tx)
+    def __init__(self, params, tx, mesh, rules, zero: bool = False) -> None:
+        # zero: the per-step ZeRO engine owns optimizer state as a ~1/W
+        # flat shard — never allocate (or rebuild) the full-size state.
+        super().__init__(
+            shard_pytree(params, rules, mesh), tx,
+            opt_state=() if zero else None,
+        )
         self._mesh = mesh
         self._rules = rules
+        self._zero = zero
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         self.params = shard_pytree(
             state_dict["params"], self._rules, self._mesh
         )
-        self.opt_state = self.tx.init(self.params)
+        self.opt_state = () if self._zero else self.tx.init(self.params)
 
 
 class ShardedGroupRunner:
@@ -86,7 +93,10 @@ class ShardedGroupRunner:
         gate_step: Optional[int] = None,
         gate_event: Optional[threading.Event] = None,
         announce_restart: Optional[threading.Event] = None,
+        engine: str = "allreduce",
     ) -> None:
+        assert engine in ("allreduce", "zero")
+        self.engine = engine
         self.family = family
         self.setup_fn = setup_fn
         self.replica_id = replica_id
@@ -117,18 +127,26 @@ class ShardedGroupRunner:
         if su is None:
             su = self._setup_cache[key] = self.setup_fn(gid)
 
+        zero = self.engine == "zero"
         state = ReshardingFTTrainState(
-            su.fresh_params(), optax.sgd(0.05), su.mesh, su.rules
+            su.fresh_params(), optax.sgd(0.05), su.mesh, su.rules,
+            zero=zero,
         )
         # Pre-warm the compile BEFORE joining the control plane: a long
         # jit inside the quorum window would time out the peer's long-poll.
         jax.block_until_ready(su.grad_step(state.params, su.batch_fn(0)))
 
+        # Indirection so the ZeRO engine can re-route the heal callbacks
+        # to the wrapper (which carries the optimizer shard alongside the
+        # params) after the Manager — which the wrapper needs — exists.
+        state_cb: Dict[str, Any] = {
+            "sd": state.state_dict, "ld": state.load_state_dict
+        }
         collectives = HostCollectives(timeout=timedelta(seconds=60))
         manager = Manager(
             collectives=collectives,
-            load_state_dict=state.load_state_dict,
-            state_dict=state.state_dict,
+            load_state_dict=lambda s: state_cb["ld"](s),
+            state_dict=lambda: state_cb["sd"](),
             min_replica_size=1,
             timeout=timedelta(seconds=60),
             quorum_timeout=timedelta(seconds=60),
@@ -136,7 +154,14 @@ class ShardedGroupRunner:
             lighthouse_addr=self.lighthouse_address,
             replica_id=f"{self.family}_{gid}",
         )
-        optimizer = OptimizerWrapper(manager, state)
+        if zero:
+            optimizer = ShardedOptimizerWrapper(
+                manager, state, shard_wire="q8"
+            )
+            state_cb["sd"] = optimizer.state_dict
+            state_cb["ld"] = optimizer.load_state_dict
+        else:
+            optimizer = OptimizerWrapper(manager, state)
         if attempt > 0 and self.announce_restart is not None:
             self.announce_restart.set()
         try:
@@ -151,11 +176,21 @@ class ShardedGroupRunner:
                 loss, grads = su.grad_step(
                     state.params, su.batch_fn(manager.current_step())
                 )
-                # Cross-group (DCN) average through the real ring; the
-                # ring returns unsharded leaves — re-place on the mesh.
-                avg = manager.allreduce(grads).wait()
-                avg = shard_pytree(avg, su.rules, su.mesh)
-                optimizer.step(avg)
+                if zero:
+                    # RAW grads: the sharded transaction reduce-scatters
+                    # (averaging on the wire), updates the ~1/W optimizer
+                    # shard, and allgathers the params back — which land
+                    # unplaced, so re-shard them onto the group's mesh.
+                    if optimizer.step(grads):
+                        state.params = shard_pytree(
+                            state.params, su.rules, su.mesh
+                        )
+                else:
+                    # Cross-group (DCN) average through the real ring; the
+                    # ring returns unsharded leaves — re-place on the mesh.
+                    avg = manager.allreduce(grads).wait()
+                    avg = shard_pytree(avg, su.rules, su.mesh)
+                    optimizer.step(avg)
             leaves_tree = (
                 state.params[su.check_subtree]
                 if su.check_subtree is not None
@@ -182,6 +217,7 @@ def run_sharded_groups(
     num_steps: int,
     injectors: Optional[List[FailureInjector]] = None,
     gates: Optional[Dict[int, Dict[str, Any]]] = None,
+    engine: str = "allreduce",
 ) -> List[Dict[str, Any]]:
     assert len(jax.devices()) >= 2 * DEVICES_PER_GROUP
     lighthouse = Lighthouse(
@@ -203,6 +239,7 @@ def run_sharded_groups(
                         lighthouse_address=lighthouse.address(),
                         injector=injectors[i],
                         num_steps=num_steps,
+                        engine=engine,
                         **(gates or {}).get(i, {}),
                     ).run
                 )
@@ -223,7 +260,9 @@ def assert_bitwise_identical(results: List[Dict[str, Any]]) -> None:
         )
 
 
-def run_kill_and_heal(family: str, setup_fn) -> List[Dict[str, Any]]:
+def run_kill_and_heal(
+    family: str, setup_fn, engine: str = "allreduce"
+) -> List[Dict[str, Any]]:
     """Standard scenario: group 1 dies at step 2, group 0 gates at step 4
     until the restart is live; 6 steps total; asserts heal + identity."""
     injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
@@ -237,6 +276,7 @@ def run_kill_and_heal(family: str, setup_fn) -> List[Dict[str, Any]]:
             0: {"gate_step": 4, "gate_event": rejoined},
             1: {"announce_restart": rejoined},
         },
+        engine=engine,
     )
     assert injectors[1].count == 1
     for r in results:

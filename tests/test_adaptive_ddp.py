@@ -615,3 +615,169 @@ class TestPlanHierCandidate:
         ddp.flush()
         assert ddp.mode == "plan_hier"
         assert mgr.hier_dispatches == 3
+
+
+class TestShardedCandidate:
+    """The per-step ZeRO candidate: env opt-in (TORCHFT_DDP_SHARDED),
+    structural gates (f32 masters, no int8), the pinned mode's
+    equivalence with the fused per-step path, and the sentinel
+    discipline on a backend that can't serve sharded plans."""
+
+    def test_absent_by_default_present_on_opt_in(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_DDP_SHARDED", raising=False)
+        ddp = AdaptiveDDP(_ManagerStub(), _state(), _grad_fn, mode="auto")
+        assert "ddp_sharded" not in ddp._candidates
+        monkeypatch.setenv("TORCHFT_DDP_SHARDED", "1")
+        ddp = AdaptiveDDP(_ManagerStub(), _state(), _grad_fn, mode="auto")
+        assert "ddp_sharded" in ddp._candidates
+
+    def test_structural_gates_drop_candidate(self, monkeypatch):
+        import jax.numpy as jnp
+        import optax
+
+        monkeypatch.setenv("TORCHFT_DDP_SHARDED", "1")
+        ddp = AdaptiveDDP(
+            _ManagerStub(), _state(), _grad_fn, compress="int8",
+            mode="auto",
+        )
+        assert "ddp_sharded" not in ddp._candidates
+        bf16 = FTTrainState(
+            {"w": jnp.ones((8, 8), jnp.bfloat16)}, optax.sgd(0.1)
+        )
+        ddp = AdaptiveDDP(_ManagerStub(), bf16, _grad_fn, mode="auto")
+        assert "ddp_sharded" not in ddp._candidates
+
+    def test_pinned_mode_validates_eagerly(self):
+        import jax.numpy as jnp
+        import optax
+
+        with pytest.raises(ValueError, match="int8"):
+            AdaptiveDDP(
+                _ManagerStub(), _state(), _grad_fn, compress="int8",
+                mode="ddp_sharded",
+            )
+        bf16 = FTTrainState(
+            {"w": jnp.ones((8, 8), jnp.bfloat16)}, optax.sgd(0.1)
+        )
+        with pytest.raises(ValueError, match="f32 master"):
+            AdaptiveDDP(_ManagerStub(), bf16, _grad_fn, mode="ddp_sharded")
+
+    def test_pinned_sharded_matches_fused_per_step(self):
+        # Solo manager: the pinned ddp_sharded trajectory must be
+        # bit-identical to the fused plan transport's (rs + ag of a solo
+        # cohort is identity movement; the shard-local update IS the
+        # full update at W=1).
+        import jax
+        import jax.numpy as jnp
+
+        e2e = TestEndToEnd()
+        manager, store, lighthouse = e2e._manager()
+        try:
+            x = jnp.ones((4, 8), jnp.float32)
+            results = {}
+            for mode in ("plan", "ddp_sharded"):
+                state = _state()
+                ddp = AdaptiveDDP(
+                    manager, state, _grad_fn, mode=mode,
+                    device_pack="off",
+                )
+                for _ in range(3):
+                    ddp.step(x)
+                ddp.flush()
+                assert ddp._ddp.last_commit is True
+                results[mode] = np.asarray(
+                    jax.tree_util.tree_leaves(state.params)[0]
+                )
+            assert results["plan"].tobytes() == results[
+                "ddp_sharded"
+            ].tobytes()
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+    def test_backend_without_sharded_plans_records_sentinel(
+        self, monkeypatch
+    ):
+        # DummyCollectives has no sharded plan: every ddp_sharded probe
+        # step latches the base-class NotImplementedError, records the
+        # failure sentinel, and the candidate can never win — the
+        # never-a-crash discipline plan_hier proves for topology.
+        import jax.numpy as jnp
+
+        from torchft_tpu import Lighthouse
+        from torchft_tpu._native import Store
+        from torchft_tpu.manager import Manager
+
+        monkeypatch.setenv("TORCHFT_DDP_SHARDED", "1")
+        lighthouse = Lighthouse(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=200,
+            quorum_tick_ms=50, heartbeat_timeout_ms=2000,
+        )
+        store = Store()
+        manager = Manager(
+            collectives=DummyCollectives(world_size=1),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {},
+            min_replica_size=1,
+            rank=0,
+            world_size=1,
+            use_async_quorum=False,
+            timeout=timedelta(seconds=10),
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="nosharded_e2e",
+        )
+        try:
+            state = _state()
+            ddp = AdaptiveDDP(
+                manager, state, _grad_fn, probe_steps=2,
+                device_pack="off",
+            )
+            assert "ddp_sharded" in ddp._candidates
+            x = jnp.ones((4, 8), jnp.float32)
+            for _ in range(12):
+                ddp.step(x)
+            ddp.flush()
+            assert ddp.mode is not None, "probe must terminate"
+            assert ddp.mode != "ddp_sharded"
+            assert ddp.decision["probe_s"]["ddp_sharded"] >= 1e8
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()
+
+    def test_tenure_boundary_resets_optimizer_state(self):
+        # Crossing into ddp_sharded drops the stale shard; crossing out
+        # re-inits the full-size state the unsharded engines update —
+        # both deterministic from cohort-identical params.
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        e2e = TestEndToEnd()
+        manager, store, lighthouse = e2e._manager()
+        try:
+            params = {"w": jnp.ones((8, 8), jnp.float32)}
+            state = FTTrainState(params, optax.adam(1e-2))
+            ddp = AdaptiveDDP(
+                manager, state, _grad_fn, mode="ddp_sharded",
+                device_pack="off",
+            )
+            x = jnp.ones((4, 8), jnp.float32)
+            ddp.step(x)
+            assert ddp._sharded()._opt_shard is not None
+            # leave the sharded tenure: full state re-initialized
+            ddp._run_step("blocking", x)
+            counts = [
+                l for l in jax.tree_util.tree_leaves(state.opt_state)
+                if getattr(l, "size", 0) == 64
+            ]
+            assert counts, "full-size optimizer state was not rebuilt"
+            # re-enter: the stale shard is dropped before the step
+            ddp._run_step("ddp_sharded", x)
+            assert ddp._sharded()._shard_meta is not None
+        finally:
+            manager.shutdown()
+            store.shutdown()
+            lighthouse.shutdown()

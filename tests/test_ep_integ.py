@@ -74,3 +74,18 @@ class TestExpertParallelUnderFaults:
 
     def test_ep_group_kill_and_heal(self):
         run_kill_and_heal("ep", _setup)
+
+    def test_zero_sharded_groups_stay_identical(self):
+        # Per-step ZeRO engine (rs grads, ~1/W opt shard, param ag)
+        # composed with the dp x expert sharding.
+        results = run_sharded_groups(
+            "ep", _setup, num_steps=4, engine="zero"
+        )
+        for r in results:
+            assert r["manager_state"]["step"] == 4
+        assert_bitwise_identical(results)
+
+    def test_zero_sharded_group_kill_and_heal(self):
+        # The heal carries the optimizer shard (donor's shard + meta);
+        # the rejoin's quorum bump forces the cohort-wide re-partition.
+        run_kill_and_heal("ep", _setup, engine="zero")

@@ -85,3 +85,19 @@ class TestHSDPUnderFaults:
 
     def test_sharded_group_kill_and_heal(self):
         run_kill_and_heal("hsdp", _setup)
+
+    def test_zero_sharded_groups_stay_identical(self):
+        # Per-step ZeRO engine: reduce-scattered grads (q8 wire), ~1/W
+        # optimizer shard, bf16 param allgather — composed with the
+        # intra-group dp x tp sharding.
+        results = run_sharded_groups(
+            "hsdp", _setup, num_steps=4, engine="zero"
+        )
+        for r in results:
+            assert r["manager_state"]["step"] == 4
+        assert_bitwise_identical(results)
+
+    def test_zero_sharded_group_kill_and_heal(self):
+        # The heal carries the optimizer shard (donor's shard + meta);
+        # the rejoin's quorum bump forces the cohort-wide re-partition.
+        run_kill_and_heal("hsdp", _setup, engine="zero")

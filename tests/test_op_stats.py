@@ -363,6 +363,77 @@ class TestPlanStats:
             c.shutdown()
 
 
+class TestShardedPlanLegStats:
+    """The per-step ZeRO plan's honest wire accounting: the grad
+    reduce-scatter leg and the param allgather leg bill as SEPARATE
+    phase keys, each with its own wire_bytes/d2h_bytes, and the plan's
+    per-bucket detail tags each bucket with its leg — the data the
+    SHARD_BENCH "wins memory/FLOPs, not bytes" caveat is read from."""
+
+    def _sharded_step(self, c, tree, wire=None, ag_wire=None):
+        sh = c.plan_reduce_scatter(
+            tree, ReduceOp.SUM, divisor=2.0, wire=wire, ag_wire=ag_wire
+        ).wait()
+        return c.plan_allgather_into(sh, wire=ag_wire).wait()
+
+    def test_f32_legs_bill_separately(self, store):
+        cols = _ring(store, "shst", world_size=2, stripes=2)
+        tree = {"g": np.ones(50021, np.float32)}
+        _run_all(
+            cols, lambda r, c: self._sharded_step(c, tree)
+        )  # warmup: plan build
+        cols[0].pop_op_stats()
+        _run_all(cols, lambda r, c: self._sharded_step(c, tree))
+        stats = cols[0].pop_op_stats()
+        rs = [s for s in stats if s["op"] == "plan_reduce_scatter"][-1]
+        ag = [s for s in stats if s["op"] == "plan_allgather_into"][-1]
+        assert rs["bytes"] == ag["bytes"] >= 50021 * 4
+        # f32 on both legs: each leg's wire carries the full payload once
+        assert rs["wire_bytes"] == rs["bytes"]
+        assert ag["wire_bytes"] == ag["bytes"]
+        # the shard leg scales with 1/world: strictly smaller than full
+        assert 0 < rs["shard_bytes"] < rs["bytes"]
+        # numpy input: nothing crossed a device link on either leg
+        assert rs["d2h_bytes"] == 0 and ag["d2h_bytes"] == 0
+        assert rs["py_staging_allocs"] == 0  # zero-allocation contract
+        # per-leg bucket tags: the rs entry's window holds grad-leg
+        # buckets only; the ag entry appends the param leg's after them,
+        # so the pair reads as one step.
+        assert {b["leg"] for b in rs["buckets"]} == {1}
+        assert {b["leg"] for b in ag["buckets"]} == {1, 2}
+        for st in (rs, ag):
+            for key in ("d2h", "ring", "h2d"):
+                assert st[key] >= 0.0
+        for c in cols:
+            c.shutdown()
+
+    def test_q8_rs_bf16_ag_wire_bytes(self, store):
+        import jax.numpy as jnp
+
+        cols = _ring(store, "shstq", world_size=2, stripes=2)
+        tree = {"g": jnp.ones(50021, jnp.float32)}
+        _run_all(
+            cols,
+            lambda r, c: self._sharded_step(
+                c, tree, wire="q8", ag_wire="bf16"
+            ),
+        )
+        stats = cols[0].pop_op_stats()
+        rs = [s for s in stats if s["op"] == "plan_reduce_scatter"][-1]
+        ag = [s for s in stats if s["op"] == "plan_allgather_into"][-1]
+        # q8 grad leg: ~1 byte/element + sidecar/header overhead —
+        # strictly between a quarter and half of the f32 bill
+        assert rs["bytes"] // 4 <= rs["wire_bytes"] < rs["bytes"] // 2
+        # bf16 param leg: exactly half the f32 bill
+        assert ag["wire_bytes"] == ag["bytes"] // 2
+        # jax leaves: the full tree crosses down on the grad leg; only
+        # the updated shard crosses down on the param leg
+        assert rs["d2h_bytes"] == rs["bytes"]
+        assert 0 < ag["d2h_bytes"] == rs["shard_bytes"]
+        for c in cols:
+            c.shutdown()
+
+
 class TestHierStats:
     """The two-tier schedule's accounting: per-tier phase keys
     (intra_rs_s / inter_ring_s / intra_ag_s / intra_bcast_s) and per-tier

@@ -94,8 +94,11 @@ class TestCostModel:
     churn rises."""
 
     def test_quiet_fat_link_picks_per_step_ddp(self):
+        # ddp_sharded's combined (q8 rs + bf16 ag) wire term undercuts
+        # the f32 per-step candidates, so it may edge out plain ddp here
+        # — either way a PER-STEP strategy wins the quiet fat link.
         best, costs = _best(dict(_BASE_SIG))
-        assert best == "ddp", costs
+        assert best in ("ddp", "ddp_sharded"), costs
 
     def test_degraded_bandwidth_picks_diloco_q8(self):
         best, costs = _best(dict(_BASE_SIG, wire_eff_MBps=2.0))
@@ -230,16 +233,18 @@ class TestDecisionRules:
         eng = self._engine()
         k = len(eng._candidates)
 
-        def vec(ok, compute, bw, churn, intra=0.0, inter=0.0):
+        def vec(ok, compute, bw, churn, intra=0.0, inter=0.0, opt_b=0.0):
             return np.asarray(
-                [ok, compute, bw, churn, 0.001, 0.1, 0.0, intra, inter]
+                [ok, compute, bw, churn, 0.001, 0.1, 0.0, intra, inter,
+                 opt_b]
                 + [1.0] * k + [0.0] * k,
                 np.float64,
             )
 
         agg = eng._aggregate(
             [
-                vec(1.0, 0.01, 100.0, 0.0, intra=800.0, inter=12.0),
+                vec(1.0, 0.01, 100.0, 0.0, intra=800.0, inter=12.0,
+                    opt_b=2048.0),
                 vec(1.0, 0.02, 10.0, 2.0, intra=400.0),  # inter unmeasured
                 vec(0.0, 0.0, 0.0, 0.0),  # healing/spare: zeroed, excluded
             ]
@@ -251,6 +256,8 @@ class TestDecisionRules:
         # per-tier bottleneck: min over MEASURED (non-zero) entries only
         assert agg["tier_intra_MBps"] == 400.0
         assert agg["tier_inter_MBps"] == 12.0
+        # worst resident optimizer state across live members
+        assert agg["opt_state_bytes"] == 2048.0
 
     def test_backstop_sentinels_incumbent_and_falls_to_base(self):
         class _M:

@@ -102,3 +102,13 @@ class TestPipelineUnderFaults:
 
     def test_pipelined_group_kill_and_heal(self):
         run_kill_and_heal("pp", _setup)
+
+    def test_zero_sharded_groups_stay_identical(self):
+        # Per-step ZeRO engine (rs grads, ~1/W opt shard, param ag)
+        # composed with the dp x pipe sharding.
+        results = run_sharded_groups(
+            "pp", _setup, num_steps=4, engine="zero"
+        )
+        for r in results:
+            assert r["manager_state"]["step"] == 4
+        assert_bitwise_identical(results)

@@ -19,13 +19,16 @@ from . import Violation
 
 RULE = "cache_mutation"
 
-# (file, attribute) -> methods allowed to mutate it. _plan_for is the
-# build-and-memoize entry; configure is the invalidation entry.
+# (file, attribute) -> methods allowed to mutate it. _plan_for and
+# _sharded_plan_for are the build-and-memoize entries (fused and
+# reduce-scatter/allgather plans share one cache and one invalidation
+# discipline); configure is the invalidation entry.
 DEFAULT_TARGETS: Dict[Tuple[str, str], Sequence[str]] = {
     ("torchft_tpu/collectives.py", "_plans"): (
         "__init__",
         "configure",
         "_plan_for",
+        "_sharded_plan_for",
     ),
 }
 

@@ -33,11 +33,16 @@ from torchft_tpu.isolated_xla import (
     ChildStalledError,
     IsolatedXLACollectives,
 )
-from torchft_tpu.ddp import AdaptiveDDP, DistributedDataParallel, PipelinedDDP
+from torchft_tpu.ddp import (
+    AdaptiveDDP,
+    DistributedDataParallel,
+    PipelinedDDP,
+    ShardedDDP,
+)
 from torchft_tpu.local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
-from torchft_tpu.optim import OptimizerWrapper
+from torchft_tpu.optim import OptimizerWrapper, ShardedOptimizerWrapper
 from torchft_tpu.policy import CostKnobs, PolicyEngine, StrategySpec
 from torchft_tpu.pipeline import pipeline_blocks, stack_blocks
 from torchft_tpu.profiling import Profiler
@@ -72,6 +77,8 @@ __all__ = [
     "Optimizer",
     "OptimizerWrapper",
     "PipelinedDDP",
+    "ShardedDDP",
+    "ShardedOptimizerWrapper",
     "PolicyEngine",
     "CostKnobs",
     "StrategySpec",

@@ -511,6 +511,43 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int,                    # has_divisor
         ctypes.c_int64,
     ]
+    # Sharded plans (per-step ZeRO): the fused schedule split at the
+    # reduce-scatter boundary — a grad rs leg, a shard-local update in
+    # the caller, and a param allgather leg (consumed by
+    # HostCollectives.plan_reduce_scatter / plan_allgather_into).
+    lib.tft_plan_build_sharded.restype = ctypes.c_int64
+    lib.tft_plan_build_sharded.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),  # per-leaf flat element counts
+        ctypes.POINTER(ctypes.c_int32),  # per-leaf native dtype codes (f32)
+        ctypes.c_int64,                  # leaf count
+        ctypes.c_int,                    # rs wire: 0 native, 1 bf16, 2 q8
+        ctypes.c_int,                    # ag wire: 0 native, 1 bf16
+    ]
+    lib.tft_plan_execute_rs.restype = ctypes.c_int
+    lib.tft_plan_execute_rs.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,                  # plan id
+        ctypes.POINTER(ctypes.c_void_p),  # leaf input pointers
+        ctypes.POINTER(ctypes.c_float),  # shard output (f32)
+        ctypes.c_double,                 # divisor
+        ctypes.c_int,                    # has_divisor
+        ctypes.c_int64,
+    ]
+    lib.tft_plan_execute_ag.restype = ctypes.c_int
+    lib.tft_plan_execute_ag.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,                  # plan id
+        ctypes.POINTER(ctypes.c_float),  # updated shard input (f32)
+        ctypes.POINTER(ctypes.c_void_p),  # leaf output pointers
+        ctypes.c_int64,
+    ]
+    lib.tft_plan_sharded_meta.restype = ctypes.c_int
+    lib.tft_plan_sharded_meta.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,                  # plan id
+        ctypes.POINTER(ctypes.c_int64),  # out[3]: shard count, eff, total
+    ]
     lib.tft_plan_free.restype = ctypes.c_int
     lib.tft_plan_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.tft_plan_reset_feedback.restype = ctypes.c_int

@@ -513,6 +513,39 @@ class _NativeLib:
         has_divisor: int,
         timeout_ms: int
     ) -> int: ...
+    def tft_plan_build_sharded(
+        self,
+        handle: Any,
+        counts: Any,
+        dtypes: Any,
+        n_leaves: int,
+        rs_wire: int,
+        ag_wire: int
+    ) -> int: ...
+    def tft_plan_execute_rs(
+        self,
+        handle: Any,
+        plan_id: int,
+        leaf_in: Any,
+        shard_out: Any,
+        divisor: float,
+        has_divisor: int,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_plan_execute_ag(
+        self,
+        handle: Any,
+        plan_id: int,
+        shard_in: Any,
+        leaf_out: Any,
+        timeout_ms: int
+    ) -> int: ...
+    def tft_plan_sharded_meta(
+        self,
+        handle: Any,
+        plan_id: int,
+        out3: Any
+    ) -> int: ...
     def tft_plan_free(self, handle: Any, plan_id: int) -> int: ...
     def tft_plan_reset_feedback(self, handle: Any, plan_id: int) -> int: ...
     def tft_plan_stats_json(

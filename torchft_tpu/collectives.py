@@ -186,6 +186,10 @@ class TreeShard:
     packer: Any = None
     # host path only: which leaves were jax arrays on input
     was_jax: Any = None
+    # sharded comm plan that produced this shard (plan_reduce_scatter
+    # only): plan_allgather_into routes the updated shard back through
+    # the same precompiled schedule — layout agreement by construction.
+    plan: Any = None
 
     def replace_values(self, values: Dict[str, Any]) -> "TreeShard":
         """Same shard layout, new per-group values (e.g. the updated
@@ -361,6 +365,47 @@ class Collectives(ABC):
         reduce_scatter (same layout)."""
         raise NotImplementedError(
             f"{type(self).__name__} has no sharded split ops"
+        )
+
+    # Sharded PLAN ops (the per-step ZeRO hot path): not abstract —
+    # callers feature-detect by catching NotImplementedError, exactly
+    # like the fused plan path.
+    def plan_reduce_scatter(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+        ag_wire: Optional[str] = None,
+    ) -> Work:
+        """Like :meth:`reduce_scatter` (SUM/AVG only) but through a
+        persistent precompiled SHARDED comm plan: leaf layout, staging and
+        the stripe partition are compiled once per (signature, wires) and
+        the grad leg runs as one GIL-released native call. The returned
+        :class:`TreeShard` carries the plan, and
+        :meth:`plan_allgather_into` MUST receive it back — both legs share
+        the plan's partition, so shard boundaries are one arithmetic fact.
+        ``wire`` encodes the grad leg (``None``/``"bf16"``/``"q8"``; the
+        owned shard lands full f32 regardless); ``ag_wire`` pre-declares
+        the param leg's encoding (``None``/``"bf16"``), baked into the
+        plan so a native-gathering member and a bf16-gathering one error
+        apart at the header. f32 leaves only — the shard layout is one
+        flat f32 group (keep f32 master weights, the same constraint the
+        sharded DiLoCo path enforces)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no sharded comm plans"
+        )
+
+    def plan_allgather_into(
+        self, shard: "TreeShard", wire: Optional[str] = None
+    ) -> Work:
+        """Param leg of the sharded plan: gathers every rank's (updated)
+        shard back into the full pytree through the plan that produced it
+        (:meth:`plan_reduce_scatter`). ``wire`` must match the plan's
+        ``ag_wire`` (``"bf16"``: every member adopts the identical decoded
+        words, so gathered params stay bit-identical across the cohort)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no sharded comm plans"
         )
 
     @abstractmethod
@@ -731,6 +776,77 @@ class _CommPlan:
             )
         else:
             self.wire_bytes = self.bytes
+
+
+class _ShardedPlan:
+    """Python handle for one native SHARDED CommPlan (per-step ZeRO).
+
+    Like :class:`_CommPlan`, everything a step needs is allocated once:
+    the input pointer array, two alternating f32 shard buffers for the
+    grad leg (the caller may still hold step k's shard while step k+1
+    reduces — so shards double-buffer like plan outputs), and two
+    alternating full-leaf output sets for the param leg.
+    """
+
+    def __init__(self, handle: Any, sig: Sequence[Any], treedef: Any,
+                 wire: Optional[str], ag_wire: Optional[str],
+                 stripes: int = 1, world: int = 1) -> None:
+        f32 = np.dtype(np.float32)
+        if any(np.dtype(dt) != f32 for _, dt in sig):
+            # The callers' fallback signal, like _plan_groups.
+            raise KeyError("sharded plans take f32 leaves only")
+        self.treedef = treedef
+        self.sig = tuple(sig)
+        self.wire = wire
+        self.ag_wire = ag_wire
+        n = len(self.sig)
+        counts = [int(np.prod(s)) if s else 1 for s, _ in self.sig]
+        codes = [_NATIVE_DTYPES[np.dtype(dt)] for _, dt in self.sig]
+        plan_id = _lib.tft_plan_build_sharded(
+            handle,
+            (ctypes.c_int64 * n)(*counts),
+            (ctypes.c_int32 * n)(*codes),
+            n,
+            _PLAN_WIRES[wire],
+            _PLAN_WIRES[ag_wire],
+        )
+        if plan_id < 0:
+            _check(2)
+        self.plan_id = plan_id
+        self._handle = handle
+        meta = (ctypes.c_int64 * 3)()
+        _check(_lib.tft_plan_sharded_meta(handle, plan_id, meta))
+        self.shard_count = int(meta[0])
+        self.eff = int(meta[1])
+        self.total = int(meta[2])
+        self.in_ptrs = (ctypes.c_void_p * n)()
+        self.shard_sets = [
+            np.empty(self.shard_count, np.float32) for _ in range(2)
+        ]
+        self.shard_flip = 0
+        self.out_sets: List[List[np.ndarray]] = []
+        self.out_ptrs: List[Any] = []
+        for _ in range(2):
+            outs = [np.empty(s, dt) for s, dt in self.sig]
+            self.out_sets.append(outs)
+            self.out_ptrs.append(
+                (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+            )
+        self.flip = 0
+        self.execs = 0
+        self.bytes = self.total * 4
+        # Per-leg wire bills (the honest accounting satellite): the grad
+        # leg runs ONE ring phase at the rs wire, the param leg one at
+        # the ag wire.
+        if wire == "q8":
+            self.rs_wire_bytes = self.total + _q8_wire_overhead(
+                self.eff, world, phases=1
+            )
+        elif wire == "bf16":
+            self.rs_wire_bytes = self.total * 2
+        else:
+            self.rs_wire_bytes = self.total * 4
+        self.ag_wire_bytes = self.total * (2 if ag_wire == "bf16" else 4)
 
 
 class OpStatsMixin:
@@ -2520,6 +2636,240 @@ class HostCollectives(OpStatsMixin, Collectives):
             "ring": ring_s,
             "h2d": time.perf_counter() - t1,
             "stripe_s": stripe_s,
+        })
+        return out
+
+    def plan_reduce_scatter(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.SUM,
+        divisor: Optional[float] = None,
+        wire: Optional[str] = None,
+        ag_wire: Optional[str] = None,
+    ) -> Work:
+        """The plan-path grad leg (see Collectives.plan_reduce_scatter):
+        one native call over a precompiled sharded plan — pack, rs phase,
+        shard compaction and the divisor in one GIL release. At
+        ``wire=None`` the reduced shard is bit-identical to the matching
+        slice of ``plan_allreduce(wire=None)``'s result (same partition,
+        same phase body, same f32 divide)."""
+        timeout_ms = _ms(self._timeout)
+        if wire not in (None, "bf16", "q8"):
+            raise ValueError(f"unsupported wire: {wire!r}")
+        if ag_wire not in (None, "bf16"):
+            raise ValueError(f"unsupported ag_wire: {ag_wire!r}")
+        if op == ReduceOp.AVG:
+            if divisor is not None:
+                raise ValueError("divisor only composes with ReduceOp.SUM")
+            divisor, op = float(self._world_size), ReduceOp.SUM
+        if op != ReduceOp.SUM:
+            raise ValueError("plan_reduce_scatter supports SUM/AVG only")
+        return self._submit(
+            lambda: self._plan_reduce_scatter_sync(
+                tree, divisor, wire, ag_wire, timeout_ms
+            )
+        )
+
+    def _sharded_plan_for(
+        self, leaves: Sequence[Any], treedef: Any, wire: Optional[str],
+        ag_wire: Optional[str],
+    ) -> Optional[_ShardedPlan]:
+        sig = tuple((l.shape, np.dtype(l.dtype)) for l in leaves)
+        key: Any = (wire, ag_wire, treedef, sig, "sharded")
+        if key in self._plans:
+            return self._plans[key]
+        try:
+            plan: Optional[_ShardedPlan] = _ShardedPlan(
+                self._handle, sig, treedef, wire, ag_wire,
+                stripes=self._stripes, world=self._world_size,
+            )
+        except (KeyError, RuntimeError):
+            # Non-f32 leaves (or a wire combination native rejects):
+            # cache the verdict like the fused plan path.
+            plan = None
+        self._plans[key] = plan
+        return plan
+
+    def _plan_reduce_scatter_sync(
+        self,
+        tree: Any,
+        divisor: Optional[float],
+        wire: Optional[str],
+        ag_wire: Optional[str],
+        timeout_ms: int,
+    ) -> TreeShard:
+        leaves, treedef = _flatten(tree)
+        if not leaves:
+            raise ValueError("plan_reduce_scatter of an empty tree")
+        plan = self._sharded_plan_for(leaves, treedef, wire, ag_wire)
+        if plan is None:
+            raise ValueError(
+                "sharded comm plans take f32 leaves only (keep f32 master "
+                "weights — the DiLoCo sharded-outer constraint — or use "
+                "the fused plan path)"
+            )
+        t0 = time.perf_counter()
+        staging_allocs = 0
+        refs = []  # keep host views alive across the native call
+        in_ptrs = plan.in_ptrs
+        all_jax = True
+        for i, l in enumerate(leaves):
+            a = np.asarray(l)  # zero-copy for numpy / CPU jax leaves
+            if not a.flags.c_contiguous:
+                a = np.ascontiguousarray(a)
+                staging_allocs += 1
+            refs.append(a)
+            in_ptrs[i] = a.ctypes.data
+            all_jax = all_jax and _is_jax_array(l)
+        t1 = time.perf_counter()
+        # Shards double-buffer like plan outputs: the caller may still
+        # hold step k's shard while step k+1 reduces; older shards are
+        # clobbered.
+        shard_buf = plan.shard_sets[plan.shard_flip]
+        plan.shard_flip ^= 1
+        _check(
+            _lib.tft_plan_execute_rs(
+                self._handle,
+                plan.plan_id,
+                in_ptrs,
+                shard_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                float(divisor if divisor is not None else 1.0),
+                0 if divisor is None else 1,
+                timeout_ms,
+            )
+        )
+        ring_s = time.perf_counter() - t1
+        del refs
+        plan.execs += 1
+        t2 = time.perf_counter()
+        values: Dict[str, Any] = {"float32": shard_buf}
+        if all_jax:
+            import jax.numpy as jnp
+
+            values = {"float32": jnp.asarray(shard_buf)}
+        self._record_op_stats({
+            # Its own phase key: the grad leg bills separately from the
+            # param leg (and from any fused plan op) in pop_op_stats.
+            "op": "plan_reduce_scatter",
+            "wire": wire,
+            "bytes": plan.bytes,
+            "shard_bytes": plan.shard_count * 4,
+            "wire_bytes": plan.rs_wire_bytes,
+            # the full tree crosses down once (when it started on
+            # device); only the shard returns
+            "d2h_bytes": plan.bytes if all_jax else 0,
+            "d2h": t1 - t0,
+            "ring": ring_s,
+            "h2d": time.perf_counter() - t2,
+            "_buckets_json": self._plan_stats_json(plan.plan_id),
+            "py_staging_allocs": staging_allocs,
+            "plan_execs": plan.execs,
+        })
+        return TreeShard(
+            values=values,
+            counts={"float32": plan.total},
+            ranges={"float32": self._shard_ranges(plan.total, 4, plan.eff)},
+            layout={"float32": plan.eff},
+            dtypes={"float32": np.dtype(np.float32)},
+            groups={"float32": list(range(len(leaves)))},
+            treedef=treedef,
+            sig=plan.sig,
+            rank=self._rank,
+            world_size=self._world_size,
+            packer=None,
+            was_jax=[_is_jax_array(l) for l in leaves],
+            plan=plan,
+        )
+
+    def plan_allgather_into(
+        self, shard: TreeShard, wire: Optional[str] = None
+    ) -> Work:
+        timeout_ms = _ms(self._timeout)
+        if wire not in (None, "bf16"):
+            raise ValueError(f"unsupported wire: {wire!r}")
+        return self._submit(
+            lambda: self._plan_allgather_into_sync(shard, wire, timeout_ms)
+        )
+
+    def _plan_allgather_into_sync(
+        self, shard: TreeShard, wire: Optional[str], timeout_ms: int
+    ) -> Any:
+        """Param leg of the sharded plan: scatter the updated shard back,
+        one ag phase at the plan's ag wire, unpack into the double-
+        buffered output leaves. bf16: every member (owner included)
+        adopts the identical decoded words — gathered params stay
+        bit-identical across the cohort."""
+        plan = shard.plan
+        if plan is None:
+            # A bulk-path TreeShard (reduce_scatter): same contract, bulk
+            # ops serve it.
+            return self._allgather_into_sync(shard, wire, timeout_ms)
+        if wire != plan.ag_wire:
+            raise ValueError(
+                f"plan_allgather_into wire {wire!r} does not match the "
+                f"plan's ag_wire {plan.ag_wire!r} (pre-declared at "
+                "plan_reduce_scatter — the header pins it cohort-wide)"
+            )
+        vals = shard.values.get("float32")
+        if vals is None or len(shard.values) != 1:
+            raise ValueError(
+                "pass the TreeShard from plan_reduce_scatter (values "
+                "replaced, layout intact)"
+            )
+        t0 = time.perf_counter()
+        d2h_bytes = 0
+        if _is_jax_array(vals):
+            d2h_bytes = np.asarray(vals).nbytes
+        v = np.ascontiguousarray(np.asarray(vals))
+        if v.dtype != np.dtype(np.float32):
+            v = v.astype(np.float32)
+        if v.size != plan.shard_count:
+            raise ValueError(
+                f"shard has {v.size} elements, the plan's layout expects "
+                f"{plan.shard_count} — pass the TreeShard from "
+                "plan_reduce_scatter (values replaced, layout intact)"
+            )
+        t1 = time.perf_counter()
+        outs = plan.out_sets[plan.flip]
+        out_ptrs = plan.out_ptrs[plan.flip]
+        plan.flip ^= 1
+        _check(
+            _lib.tft_plan_execute_ag(
+                self._handle,
+                plan.plan_id,
+                v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out_ptrs,
+                timeout_ms,
+            )
+        )
+        ring_s = time.perf_counter() - t1
+        plan.execs += 1
+        t2 = time.perf_counter()
+        out_leaves: List[Any] = []
+        for i in range(len(plan.sig)):
+            leaf: Any = outs[i]
+            if shard.was_jax is not None and shard.was_jax[i]:
+                import jax.numpy as jnp
+
+                leaf = jnp.asarray(leaf)
+            out_leaves.append(leaf)
+        out = _unflatten(shard.treedef, out_leaves)
+        self._record_op_stats({
+            # The param leg's own phase key, billed at the AG wire. Its
+            # buckets (leg=2) append after the grad leg's (leg=1) in the
+            # plan's stat window, so the pair reads as one step.
+            "op": "plan_allgather_into",
+            "wire": wire,
+            "bytes": plan.bytes,
+            "wire_bytes": plan.ag_wire_bytes,
+            # only this rank's (updated) shard crosses down; the full
+            # gathered tree returns on the h2d leg
+            "d2h_bytes": d2h_bytes,
+            "d2h": t1 - t0,
+            "ring": ring_s,
+            "h2d": time.perf_counter() - t2,
+            "_buckets_json": self._plan_stats_json(plan.plan_id),
+            "plan_execs": plan.execs,
         })
         return out
 

@@ -14,15 +14,20 @@ process_group.py:1067-1341).
 
 from __future__ import annotations
 
+import logging
 import os
 import time
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 # One parser for the TORCHFT_DEVICE_PACK knob across every layer —
 # duplicating the mapping here would let the two layers drift.
-from .collectives import Work, _resolve_device_pack_setting
+from .collectives import ReduceOp, Work, _resolve_device_pack_setting
 from .manager import Manager
-from .train_state import FTTrainState
+from .train_state import FTTrainState, _to_device_tree
+
+logger: logging.Logger = logging.getLogger(__name__)
 
 
 def _device_pack_available() -> bool:
@@ -410,6 +415,335 @@ class PipelinedDDP:
         return self._settle()
 
 
+class ShardedDDP:
+    """Per-step ZeRO across replica groups: each step reduce-scatters the
+    gradients, runs the optimizer on this group's ~1/W shard of the
+    (flat-packed) parameters, and allgathers the updated parameters back
+    — optimizer state and update FLOPs scale with the shard, not the
+    model (ZeRO stage 1/2 across the DCN replicate dimension, per step
+    rather than per DiLoCo window).
+
+    The data plane is the precompiled SHARDED comm plan
+    (``Manager.plan_reduce_scatter`` / ``plan_allgather_into``): one
+    GIL-released native call per leg, composed from the proven rs/ag ring
+    phase bodies over the flat ring. On the f32 wire the whole step is
+    BIT-IDENTICAL to the fused plan-f32 step — same stripe partition,
+    same ring sums, same f32 divide, and every member applies the same
+    optimizer arithmetic to its slice. ``shard_wire="q8"`` quantizes the
+    grad leg's ring hops while this rank's owned shard stays full f32
+    (the PR-2 reduce-scatter discipline); ``param_wire="bf16"`` (the
+    DEFAULT whenever ``shard_wire="q8"``) halves the param leg, with
+    every member — owner included — adopting the identical decoded bf16
+    words, so params stay bit-identical across the cohort on every wire.
+
+    Fault tolerance is the DiLoCo sharded-outer machinery at per-step
+    cadence: the optimizer shard is keyed by ``quorum_id`` — membership
+    changes re-partition it through a cohort mask-allgather
+    (first-owner-wins; positions a departed member took with it restart
+    at zero), and a heal voids the meta (``load_state_dict`` sets
+    ``quorum_id=-1``) so the healed member re-shards the donor's shard
+    into its own ranges at the next step. Any leg's failure latches, the
+    commit vote fails, and params + optimizer shard keep their pre-step
+    values — committed-or-discarded, same as every other strategy.
+
+    Requires f32 master params (the flat shard layout is one f32 group).
+    Construct the FTTrainState with ``opt_state=()`` so no full-size
+    optimizer state is ever allocated::
+
+        state = FTTrainState(params, optax.adamw(1e-3), opt_state=())
+        ddp = ShardedDDP(manager, state, grad_fn, shard_wire="q8")
+        for batch in batches:
+            loss = ddp.step(batch)
+
+    Wire the manager's state callbacks to :meth:`state_dict` /
+    :meth:`load_state_dict` so a heal carries the donor's shard + meta
+    (not ``state.state_dict``, which never sees the shard)."""
+
+    def __init__(
+        self,
+        manager: Manager,
+        state: FTTrainState,
+        grad_fn: Optional[Callable[..., Tuple[Any, Any]]],
+        shard_wire: Optional[str] = None,
+        param_wire: Optional[str] = "auto",
+    ) -> None:
+        """``grad_fn(params, *batch) -> (loss, grads)`` — the PipelinedDDP
+        contract (None is allowed when only :meth:`apply_gradients` is
+        used, e.g. under ``ShardedOptimizerWrapper``). ``param_wire``
+        defaults to ``"auto"``: bf16 when ``shard_wire="q8"`` (the
+        quantized grad leg already accepts wire loss; a full-f32 param
+        broadcast would dominate the step's bytes), native f32 otherwise
+        — pass ``None`` explicitly to force the f32 param leg."""
+        if shard_wire not in (None, "bf16", "q8"):
+            raise ValueError(f"unsupported shard_wire: {shard_wire!r}")
+        if param_wire == "auto":
+            param_wire = "bf16" if shard_wire == "q8" else None
+        if param_wire not in (None, "bf16"):
+            raise ValueError(f"unsupported param_wire: {param_wire!r}")
+        import jax
+
+        bad = {
+            str(np.dtype(l.dtype))
+            for l in jax.tree_util.tree_leaves(state.params)
+            if np.dtype(l.dtype) != np.dtype(np.float32)
+        }
+        if bad:
+            raise ValueError(
+                "ShardedDDP requires f32 master params (found "
+                f"{sorted(bad)}); keep masters in f32 and use "
+                "shard_wire/param_wire for wire compression"
+            )
+        self._manager = manager
+        self._state = state
+        self._grad_fn = grad_fn
+        self._shard_wire = shard_wire
+        self._param_wire = param_wire
+        # Sharded optimizer state: built lazily at the first committed
+        # step over the shard this replica owns under the quorum's
+        # partition (unknowable before the first quorum forms).
+        self._opt_shard: Any = None
+        self._shard_meta: Optional[Dict[str, Any]] = None
+        self._slice_fns: Dict[Any, Any] = {}
+        self._apply_jit: Optional[Any] = None
+        self.last_commit: Optional[bool] = None
+
+    # -- train-loop surface (blocking per-step) --
+
+    def step(self, *batch: Any) -> Any:
+        """One full sharded step: quorum, grads, rs -> shard update ->
+        ag, vote. Returns the loss."""
+        assert self._grad_fn is not None, "construct with a grad_fn"
+        self._manager.start_quorum()
+        loss, grads = self._grad_fn(self._state.params, *batch)
+        self.apply_gradients(grads)
+        return loss
+
+    def blocking_step(self, *batch: Any) -> Any:
+        """Alias of :meth:`step` (every ShardedDDP step is blocking) —
+        the PolicyEngine's per-step-DDP engine surface."""
+        return self.step(*batch)
+
+    def flush(self) -> bool:
+        """Nothing is ever left in flight (each step settles in-step);
+        returns the last step's outcome for surface parity."""
+        return bool(self.last_commit)
+
+    def apply_gradients(self, grads: Any) -> bool:
+        """The sharded transaction for already-computed ``grads``:
+        reduce-scatter, shard-local optimizer update, param allgather,
+        commit vote. Applies iff committed; returns whether it did. The
+        quorum must already be started (``step`` does; so does
+        ``ShardedOptimizerWrapper.zero_grad``)."""
+        shard = self._manager.plan_reduce_scatter(
+            grads, op=ReduceOp.AVG, wire=self._shard_wire,
+            ag_wire=self._param_wire,
+        ).wait()
+        gathered = None
+        new_opt = None
+        new_meta = None
+        resharded = False
+        if shard is not None:
+            try:
+                qid = self._manager.quorum_id()
+                opt_shard, resharded = self._opt_state_for(shard, qid)
+                p_shard = self._slice_params(shard)
+                if self._apply_jit is None:
+                    from .parallel import build_shard_apply_step
+
+                    self._apply_jit = build_shard_apply_step(self._state.tx)
+                new_p, new_opt = self._apply_jit(
+                    p_shard, opt_shard, shard.values["float32"]
+                )
+                gathered = self._manager.plan_allgather_into(
+                    shard.replace_values({"float32": new_p}),
+                    wire=self._param_wire,
+                ).wait()
+                new_meta = {
+                    "quorum_id": qid,
+                    "counts": dict(shard.counts),
+                    "ranges": {
+                        k: [tuple(r) for r in v]
+                        for k, v in shard.ranges.items()
+                    },
+                }
+            except Exception as e:  # noqa: BLE001 - latch, vote, roll back
+                logger.exception("sharded step failed: %s", e)
+                self._manager.report_error(e)
+                gathered = None
+        committed = self._manager.should_commit() and gathered is not None
+        self.last_commit = committed
+        if committed:
+            self._state.params = _to_device_tree(gathered)
+            self._opt_shard = new_opt
+            self._shard_meta = new_meta
+            if resharded:
+                # New partition (first step, membership change, or a
+                # healed member's re-shard): publish the shard's resident
+                # footprint — the policy engine's opt-memory signal.
+                self._manager.report_opt_state_bytes(self.opt_state_bytes())
+        # abort: params and the optimizer shard keep their pre-step
+        # values (new_opt was computed into fresh buffers; the old shard
+        # is never donated).
+        return committed
+
+    # -- sharded optimizer state --
+
+    def opt_state_bytes(self) -> int:
+        """Resident bytes of this replica's optimizer-state shard (0
+        before the first committed step) — scales ~1/W with the cohort."""
+        import jax
+
+        return int(
+            sum(
+                int(getattr(l, "nbytes", 0) or 0)
+                for l in jax.tree_util.tree_leaves(self._opt_shard)
+            )
+        )
+
+    def begin_fresh_shard(self) -> None:
+        """Strategy re-entry discipline (the AdaptiveDDP/PolicyEngine
+        tenure boundary): drops the shard and its meta so the next step
+        re-initializes the optimizer over the live params — a
+        deterministic momentum cold start on every member, never a
+        cross-member divergence (the shard belongs to a trajectory
+        another strategy superseded)."""
+        self._opt_shard = None
+        self._shard_meta = None
+
+    def _opt_state_for(self, shard: Any, qid: int) -> Tuple[Any, bool]:
+        """The optimizer state matching ``shard``'s partition (and
+        whether it was (re)built): reused when the quorum — and so the
+        partition — is unchanged, initialized fresh at the first step,
+        re-partitioned through a cohort mask-allgather after a
+        membership change."""
+        meta = self._shard_meta
+        if (
+            self._opt_shard is not None
+            and meta is not None
+            and meta["quorum_id"] == qid
+            and meta["counts"] == shard.counts
+            and {k: [tuple(r) for r in v] for k, v in shard.ranges.items()}
+            == {k: [tuple(r) for r in v] for k, v in meta["ranges"].items()}
+        ):
+            return self._opt_shard, False
+        if self._opt_shard is None:
+            # First step of a fresh run (or after begin_fresh_shard):
+            # init over the owned param shard — state ∝ 1/W from step 0.
+            return self._state.tx.init(self._slice_params(shard)), True
+        return self._reshard_opt_state(shard), True
+
+    def _slice_params(self, shard: Any) -> Any:
+        """This rank's owned flat slice of the master params — on device
+        (jitted pack + slice, cached per partition) for jax trees, host-
+        side otherwise. Leaf order is tree-flatten order, the same order
+        the plan packed the gradients, so the slice aligns with the grad
+        shard element-for-element."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self._state.params)
+        rng = tuple(tuple(r) for r in shard.ranges["float32"])
+        if leaves and all(isinstance(l, jax.Array) for l in leaves):
+            fn = self._slice_fns.get(rng)
+            if fn is None:
+                import jax.numpy as jnp
+
+                def slice_fn(ls: Any, _rng: Any = rng) -> Any:
+                    flat = jnp.concatenate([l.reshape(-1) for l in ls])
+                    return jnp.concatenate(
+                        [flat[s: s + n] for s, n in _rng]
+                    )
+
+                fn = self._slice_fns[rng] = jax.jit(slice_fn)
+            return fn(leaves)
+        flat = np.concatenate(
+            [np.asarray(l).ravel() for l in leaves]
+        ).astype(np.float32, copy=False)
+        return np.concatenate([flat[s: s + n] for s, n in rng])
+
+    def _reshard_opt_state(self, shard: Any) -> Any:
+        """Re-partitions the optimizer shard after a membership change:
+        every member scatters its OLD shard of each shard-shaped state
+        leaf into a full-size (mask, vals) pair, the cohort allgathers
+        them, and this member slices its NEW ranges out of the
+        first-owner-wins merge. Positions no surviving member owned (a
+        departed replica took its shard with it) restart at zero — a
+        one-step momentum cold start on 1/W_old of the model (the DiLoCo
+        sharded-outer reshard, at per-step cadence)."""
+        import jax
+        import jax.numpy as jnp
+
+        meta = self._shard_meta
+        assert meta is not None
+        count = shard.counts["float32"]
+        old_ranges = [tuple(r) for r in meta["ranges"]["float32"]]
+        old_len = sum(n for _, n in old_ranges)
+
+        state_leaves, state_def = jax.tree_util.tree_flatten(
+            self._opt_shard
+        )
+        shard_like = [
+            i
+            for i, l in enumerate(state_leaves)
+            if getattr(l, "ndim", None) == 1 and l.size == old_len
+        ]
+        mask = np.zeros(count, np.uint8)
+        for s, n in old_ranges:
+            mask[s: s + n] = 1
+        scattered = []
+        for i in shard_like:
+            arr = np.asarray(state_leaves[i]).astype(np.float32)
+            full = np.zeros(count, np.float32)
+            off = 0
+            for s, n in old_ranges:
+                full[s: s + n] = arr[off: off + n]
+                off += n
+            scattered.append(full)
+        members = self._manager.allgather(
+            {"m": mask, "v": scattered}
+        ).wait()
+
+        new_leaves = list(state_leaves)
+        for j, i in enumerate(shard_like):
+            acc = np.zeros(count, np.float32)
+            seen = np.zeros(count, bool)
+            for m in members:
+                mm = np.asarray(m["m"]).astype(bool)
+                take = mm & ~seen
+                if take.any():
+                    acc[take] = np.asarray(m["v"][j], np.float32)[take]
+                    seen |= take
+            new_shard = np.concatenate(
+                [acc[s: s + n] for s, n in shard.ranges["float32"]]
+            )
+            new_leaves[i] = jnp.asarray(new_shard)
+        return jax.tree_util.tree_unflatten(state_def, new_leaves)
+
+    # -- checkpoint plumbing (manager state callbacks) --
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "state": self._state.state_dict(),
+            "opt_shard": self._opt_shard,
+            "shard_meta": self._shard_meta,
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self._state.load_state_dict(sd["state"])
+        self._opt_shard = (
+            _to_device_tree(sd["opt_shard"])
+            if sd["opt_shard"] is not None
+            else None
+        )
+        # The restored shard is the SOURCE replica's (a heal copies the
+        # donor's state verbatim); keep its meta so the next re-shard
+        # scatters it at the right positions, and force a re-partition by
+        # voiding the quorum id — this replica's join bumped it anyway.
+        meta = sd.get("shard_meta")
+        if meta is not None:
+            meta = dict(meta, quorum_id=-1)
+        self._shard_meta = meta
+
+
 class AdaptiveDDP:
     """Per-step DDP that PICKS its schedule per cohort instead of trusting
     a static choice: a cheap runtime probe times a few steps of each
@@ -523,7 +857,7 @@ class AdaptiveDDP:
     ) -> None:
         mode = mode or os.environ.get("TORCHFT_DDP_MODE", "auto")
         if mode not in ("auto", "blocking", "pipelined", "plan",
-                        "plan_hier", "xla_iso"):
+                        "plan_hier", "xla_iso", "ddp_sharded"):
             raise ValueError(f"unsupported TORCHFT_DDP_MODE: {mode!r}")
         self._manager = manager
         # One underlying engine; mode switches flip (transport, overlap).
@@ -533,6 +867,36 @@ class AdaptiveDDP:
             c for c in self._CANDIDATES
             if not (c == "plan" and compress == "int8")
         ]
+        import jax
+
+        f32_masters = all(
+            np.dtype(l.dtype) == np.dtype(np.float32)
+            for l in jax.tree_util.tree_leaves(state.params)
+        )
+        if mode == "ddp_sharded":
+            if compress == "int8":
+                raise ValueError("compress='int8' has no sharded transport")
+            if not f32_masters:
+                raise ValueError(
+                    "TORCHFT_DDP_MODE=ddp_sharded requires f32 master "
+                    "params (the flat shard layout is one f32 group)"
+                )
+        if (
+            os.environ.get("TORCHFT_DDP_SHARDED", "")
+            not in ("", "0", "false", "off")
+            and compress != "int8"
+            and f32_masters
+        ):
+            # Opt-in probe candidate (TORCHFT_DDP_SHARDED=1): the per-step
+            # ZeRO engine joins the race on its measured step wall. Opt-in
+            # rather than default because mode switches around a sharded
+            # tenure reset optimizer momentum (see _run_step) — a cost the
+            # operator should choose, not inherit. A cohort whose backend
+            # can't serve sharded plans latches every probe step into the
+            # failure sentinel, so the candidate can never win there —
+            # the same never-a-crash discipline as plan_hier. All members
+            # must set the knob or none, like every other schedule knob.
+            self._candidates.append("ddp_sharded")
         # Topology opt-in markers. Region: the member carries a label
         # (TORCHFT_REGION / Manager(region=)). Host: the operator set
         # TORCHFT_HOST EXPLICITLY — the Manager's hostname DEFAULT is
@@ -594,6 +958,11 @@ class AdaptiveDDP:
                     "Manager(iso_collectives=...)"
                 )
         self._probe_steps = max(int(probe_steps), 2)
+        self._sharded_engine: Optional[ShardedDDP] = None
+        # Mode the previous _run_step ran: crossing the ddp_sharded
+        # tenure boundary in either direction resets optimizer state
+        # deterministically on every member (see _run_step).
+        self._prev_run_mode: Optional[str] = None
         self._mode: Optional[str] = mode if mode != "auto" else None
         self._auto = mode == "auto"
         # Probe clock: attempted steps since the anchor transaction (the
@@ -640,8 +1009,43 @@ class AdaptiveDDP:
             return False
         return self._devpack_setting
 
+    def _sharded(self) -> ShardedDDP:
+        if self._sharded_engine is None:
+            d = self._ddp
+            shard_wire = {None: None, "bf16": "bf16", "q8": "q8"}[
+                d._compress_mode
+            ]
+            self._sharded_engine = ShardedDDP(
+                self._manager, d._state, d._grad_fn, shard_wire=shard_wire
+            )
+        return self._sharded_engine
+
     def _run_step(self, mode: str, *batch: Any) -> Any:
         d = self._ddp
+        if mode != self._prev_run_mode:
+            # Crossing the sharded tenure boundary is a trajectory change
+            # for OPTIMIZER state (the two regimes hold it in different
+            # shapes): entering drops the stale shard, leaving re-inits
+            # the full state the unsharded engines update through
+            # state.apply_gradients. Both resets are deterministic from
+            # the (cohort-identical) params, so every member takes them
+            # at the same step and cross-member identity holds — the
+            # begin_fresh_window discipline, paid only at mode switches
+            # (a pinned TORCHFT_DDP_MODE=ddp_sharded run never pays it).
+            if mode == "ddp_sharded":
+                self._sharded().begin_fresh_shard()
+            elif self._prev_run_mode == "ddp_sharded":
+                st = d._state
+                st.opt_state = st.tx.init(st.params)
+        self._prev_run_mode = mode
+        if mode == "ddp_sharded":
+            if d._inflight is not None:
+                d.flush()  # settle any pipelined overlap before sharding
+            s = self._sharded()
+            loss = s.step(*batch)
+            # the probe's error signal reads the shared engine's outcome
+            d.last_commit = s.last_commit
+            return loss
         if mode == "pipelined":
             d._transport = "legacy"
             if d._inflight is None:

@@ -232,6 +232,11 @@ class Manager:
         # (MB/s per tier key; shm host tiers measure ring movement over
         # phase wall). Empty until a hier op has been observed.
         self._last_tier_mbps: Dict[str, float] = {}
+        # Resident optimizer-state bytes as reported by the training
+        # strategy (ShardedDDP reports its ~1/W shard); None until one
+        # reports. Exported through signals() so the policy engine can
+        # price the sharded candidate's memory term.
+        self._opt_state_bytes: Optional[int] = None
         self._profiler = (
             profiler if profiler is not None else Profiler.from_env()
         )
@@ -832,6 +837,75 @@ class Manager:
             zero_nonparticipating=False,
         )
 
+    def plan_reduce_scatter(
+        self,
+        tree: Any,
+        op: ReduceOp = ReduceOp.AVG,
+        wire: Optional[str] = None,
+        ag_wire: Optional[str] = None,
+    ) -> Work:
+        """Fault-tolerantly reduces a gradient pytree through the PLAN
+        path but stops at the reduce-scatter boundary: one GIL-released
+        native call over a precompiled sharded schedule, resolving to
+        this rank's :class:`~torchft_tpu.collectives.TreeShard` of the
+        averaged flat tree (``shard.plan`` set — route the updated shard
+        back through :meth:`plan_allgather_into`). The per-step ZeRO
+        grad leg. ``wire``: None | "bf16" | "q8" (the returned shard is
+        full f32 on every wire — the owner's chunk never rides a lossy
+        hop, the PR-2 discipline; no "q8ef": error feedback corrects a
+        FUSED lossy result, and the shard isn't one). ``ag_wire``
+        (None | "bf16") pre-declares the param leg's wire — it is baked
+        into the plan schedule and checked cohort-wide in the op header.
+        Failure default ``None`` (plan buffers may hold a partial
+        result), the error latches, ``should_commit`` discards — same
+        contract as :meth:`plan_allreduce`. A cohort whose backend or
+        leaves can't take the sharded plan (non-f32 leaves, no plan
+        support) latches the dispatch error — the sentinel discipline
+        AdaptiveDDP's ``ddp_sharded`` candidate relies on, never a
+        crash."""
+        if op not in (ReduceOp.AVG, ReduceOp.SUM):
+            # Static usage error: raise eagerly, don't latch.
+            raise ValueError(
+                f"unsupported managed plan_reduce_scatter op: {op}"
+            )
+
+        def dispatch(zeroed_tree: Any) -> Work:
+            if op == ReduceOp.AVG:
+                num_participants = self.num_participants()
+                assert num_participants >= 1
+                divisor: Optional[float] = float(num_participants)
+            else:
+                divisor = None
+            return self._collectives.plan_reduce_scatter(
+                zeroed_tree, ReduceOp.SUM, divisor=divisor, wire=wire,
+                ag_wire=ag_wire,
+            )
+
+        return self._managed_dispatch(
+            "plan_reduce_scatter", tree, dispatch, lambda t: None
+        )
+
+    def plan_allgather_into(
+        self, shard: Any, wire: Optional[str] = None
+    ) -> Work:
+        """Fault-tolerantly gathers the cohort's (updated) plan shards
+        back into the full pytree — the param leg of the per-step ZeRO
+        schedule, one native call over the same precompiled plan that
+        produced the shard. ``wire`` must equal the ``ag_wire`` declared
+        at :meth:`plan_reduce_scatter` (``"bf16"`` halves the leg's
+        bytes; every member — owner included — adopts the identically
+        decoded words, so gathered params stay bit-identical across the
+        cohort). Failure default ``None``; like :meth:`allgather_into`,
+        a non-participating member's shard is NOT zeroed — the gather is
+        replicated state, not a contribution sum."""
+        return self._managed_dispatch(
+            "plan_allgather_into",
+            shard,
+            lambda s: self._collectives.plan_allgather_into(s, wire=wire),
+            lambda s: None,
+            zero_nonparticipating=False,
+        )
+
     def allgather(self, tree: Any) -> Work:
         """Fault-tolerantly gathers ``tree`` from every cohort member.
 
@@ -1100,6 +1174,11 @@ class Manager:
           ``last_fetch_stats``: path/wire/bytes/fetch_s/h2d_s), plus the
           ``heal_fetch``/``heal_apply`` timer snapshots — ``None`` when
           this replica never healed.
+        - ``opt_state_bytes``: resident optimizer-state bytes as last
+          reported by the training strategy via
+          :meth:`report_opt_state_bytes` (ShardedDDP reports its ~1/W
+          shard each reshard; ``None`` until a strategy reports) — the
+          policy engine's memory term for pricing ``ddp_sharded``.
 
         Also the payload pushed to the lighthouse ``status.json`` member
         view (see :meth:`push_status`)."""
@@ -1121,7 +1200,15 @@ class Manager:
             "wire_eff_MBps": self._last_wire_eff_mbps,
             "tier_eff_MBps": dict(self._last_tier_mbps) or None,
             "heal": heal,
+            "opt_state_bytes": getattr(self, "_opt_state_bytes", None),
         }
+
+    def report_opt_state_bytes(self, nbytes: Optional[int]) -> None:
+        """Records the strategy's resident optimizer-state footprint for
+        :meth:`signals`. ShardedDDP calls this on every (re)shard with
+        its ~1/W shard's bytes; an unsharded strategy may report its
+        full state. ``None`` clears the signal."""
+        self._opt_state_bytes = None if nbytes is None else int(nbytes)
 
     def push_status(self, extra: Optional[Dict[str, Any]] = None) -> None:
         """Publishes the current :meth:`signals` digest (plus step/commit

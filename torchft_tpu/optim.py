@@ -10,7 +10,7 @@ that follows it (the reference gets this from torch's in-place
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Dict, Optional
 
 from .manager import Manager
 from .train_state import FTTrainState
@@ -50,3 +50,66 @@ class OptimizerWrapper:
             return False
         self.state.apply_gradients(grads)
         return True
+
+
+class ShardedOptimizerWrapper:
+    """The :class:`OptimizerWrapper` loop shape over the per-step ZeRO
+    engine: ``zero_grad()`` starts the quorum, ``step(grads)`` runs the
+    whole sharded transaction — reduce-scatter, ~1/W shard-local
+    optimizer update, param allgather, commit vote — instead of the
+    fused allreduce + full-size update. Drop-in where the canonical loop
+    computes raw (un-averaged) gradients::
+
+        state = FTTrainState(params, optax.adamw(1e-3), opt_state=())
+        optimizer = ShardedOptimizerWrapper(manager, state,
+                                            shard_wire="q8")
+        for step in ...:
+            optimizer.zero_grad()                 # starts async quorum
+            loss, grads = grad_fn(state.params, batch)
+            optimizer.step(grads)                 # rs -> update -> ag
+
+    Note the contract difference from :class:`OptimizerWrapper`: pass
+    RAW gradients (the reduce-scatter averages them); there is no
+    separate ``manager.allreduce`` call. Construct the train state with
+    ``opt_state=()`` so no full-size optimizer state is ever allocated,
+    and wire the manager's state callbacks to :meth:`state_dict` /
+    :meth:`load_state_dict` so heals carry the optimizer shard."""
+
+    def __init__(
+        self,
+        manager: Manager,
+        state: FTTrainState,
+        shard_wire: Optional[str] = None,
+        param_wire: Optional[str] = "auto",
+    ) -> None:
+        from .ddp import ShardedDDP
+
+        self.manager = manager
+        self.state = state
+        self._core = ShardedDDP(
+            manager, state, grad_fn=None,
+            shard_wire=shard_wire, param_wire=param_wire,
+        )
+
+    def zero_grad(self) -> None:
+        """Starts the (async) quorum for this step."""
+        self.manager.start_quorum()
+
+    def step(self, grads: Any) -> bool:
+        """Runs the sharded transaction for ``grads``; applies iff the
+        cohort committed. Returns whether it did."""
+        return self._core.apply_gradients(grads)
+
+    @property
+    def last_commit(self) -> Optional[bool]:
+        return self._core.last_commit
+
+    def opt_state_bytes(self) -> int:
+        """Resident bytes of this replica's optimizer-state shard."""
+        return self._core.opt_state_bytes()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return self._core.state_dict()
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self._core.load_state_dict(sd)

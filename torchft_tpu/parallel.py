@@ -112,6 +112,31 @@ def build_apply_step(tx: Any) -> Callable[[Any, Any, Any], Tuple[Any, Any]]:
     return make_apply_fn(tx)
 
 
+def build_shard_apply_step(tx: Any) -> Callable[[Any, Any, Any], Tuple[Any, Any]]:
+    """Jits the SHARD-LOCAL optax update ``(param_shard, opt_shard,
+    grad_shard) -> (new_param_shard, new_opt_shard)`` — the per-step ZeRO
+    weight update: state and FLOPs scale with the ~1/W shard, not the
+    model. Shardings are inferred from the (mesh-placed) inputs like
+    :func:`build_apply_step`, so the same jitted program serves a
+    replicated shard on the slice mesh or a single device. Only the param
+    shard is donated: it is re-sliced from the gathered params every
+    step, while the optimizer shard must survive a discarded step (the
+    commit-or-rollback discipline keeps the pre-step state live on
+    abort)."""
+    import jax
+    import optax
+
+    def apply(param_shard: Any, opt_shard: Any, grad_shard: Any):
+        grad_shard = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype) if g.dtype != p.dtype else g,
+            grad_shard, param_shard,
+        )
+        updates, new_opt = tx.update(grad_shard, opt_shard, param_shard)
+        return optax.apply_updates(param_shard, updates), new_opt
+
+    return jax.jit(apply, donate_argnums=(0,))
+
+
 def cross_group_average(manager: Any, grads: Any) -> Any:
     """Blocking cross-replica-group gradient average through the manager's
     fault-tolerant host collectives (the DCN/replicate dimension)."""

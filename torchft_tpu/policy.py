@@ -57,7 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .ddp import PipelinedDDP
+from .ddp import PipelinedDDP, ShardedDDP
 from .local_sgd import AsyncDiLoCo, DiLoCo, LocalSGD
 from .manager import Manager
 from .train_state import FTTrainState
@@ -84,8 +84,12 @@ class StrategySpec:
     (``None`` f32 | ``"bf16"`` | ``"q8"``). ``transport`` (ddp only)
     selects the data path: ``"legacy"`` managed ring, ``"plan"``
     persistent native comm plan, ``"iso"`` the isolated-child XLA plane.
-    ``sharded`` (diloco only) uses the weight-update-sharded outer sync
-    (requires f32 masters and an elementwise outer optimizer). ``hier``
+    ``sharded``: for diloco, the weight-update-sharded outer sync
+    (requires f32 masters and an elementwise outer optimizer); for ddp,
+    the per-step ZeRO engine (:class:`~torchft_tpu.ddp.ShardedDDP` —
+    reduce-scatter grads, ~1/W optimizer shard, bf16 param allgather;
+    requires f32 masters and rides the sharded comm plan, so
+    ``transport="plan"`` and the flat ring only). ``hier``
     (ddp/plan or diloco) runs the sync over the topology-aware
     hierarchical schedule (shm host rings -> intra-region rings -> the
     inter-region leader ring); such candidates are priced on the
@@ -116,6 +120,19 @@ class StrategySpec:
             raise ValueError("localsgd has no hier schedule")
         if self.hier and self.kind == "ddp" and self.transport != "plan":
             raise ValueError("hier ddp rides the plan transport")
+        if self.sharded and self.kind == "localsgd":
+            raise ValueError("localsgd has no sharded form")
+        if self.sharded and self.kind == "ddp":
+            if self.transport != "plan":
+                raise ValueError(
+                    "sharded ddp rides the plan transport (the sharded "
+                    "schedule IS a comm-plan form)"
+                )
+            if self.hier:
+                raise ValueError(
+                    "sharded ddp rides the flat ring (no hierarchical "
+                    "reduce-scatter schedule is composed)"
+                )
 
     def wire_factor(self) -> float:
         """Sync payload bytes relative to f32."""
@@ -126,12 +143,14 @@ def default_candidates(
     f32_masters: bool = True, topology_labeled: bool = False
 ) -> Tuple[StrategySpec, ...]:
     """The default ladder, ordered from tightest to loosest sync: per-step
-    DDP (legacy and plan transports), LocalSGD, and two DiLoCo(q8) window
-    lengths — sharded outer sync when the masters are f32 (the ISSUE's
-    ``DiLoCo(sharded, q8)`` point), plain q8 otherwise. Availability is
-    still checked per cohort at construction (a diloco candidate without
-    an outer optimizer or under an async-quorum manager simply can't
-    win).
+    DDP (legacy and plan transports; plus ``ddp_sharded`` — the per-step
+    ZeRO engine with q8 grad reduce-scatter, ~1/W optimizer shards and a
+    bf16 param allgather — when the masters are f32), LocalSGD, and two
+    DiLoCo(q8) window lengths — sharded outer sync when the masters are
+    f32 (the ISSUE's ``DiLoCo(sharded, q8)`` point), plain q8 otherwise.
+    Availability is still checked per cohort at construction (a diloco
+    candidate without an outer optimizer or under an async-quorum manager
+    simply can't win).
 
     ``topology_labeled`` (the AdaptiveDDP construction gate: this member
     carries TORCHFT_REGION or an explicit TORCHFT_HOST) adds the
@@ -147,6 +166,18 @@ def default_candidates(
     if topology_labeled:
         ladder.append(
             StrategySpec("ddp_plan_hier", "ddp", transport="plan", hier=True)
+        )
+    if sharded:
+        # Per-step ZeRO: q8 grad reduce-scatter + bf16 param allgather
+        # through the sharded comm plan, optimizer state ~1/W. Wins
+        # memory and update FLOPs; its wire term (q8 rs + bf16 ag) still
+        # beats the f32 per-step candidates, though not fused q8 — the
+        # cost model prices exactly that trade.
+        ladder.append(
+            StrategySpec(
+                "ddp_sharded", "ddp", transport="plan", wire="q8",
+                sharded=True,
+            )
         )
     ladder += [
         StrategySpec("localsgd_h16", "localsgd", sync_every=16),
@@ -177,12 +208,23 @@ class CostKnobs:
     — a fault inside this horizon of a transaction fails THAT transaction
     and discards the window, so windows shorter than the horizon are hit
     by essentially every fault while windows much longer than it absorb
-    most faults in local compute."""
+    most faults in local compute.
+    ``opt_mem_weight`` (env ``TORCHFT_POLICY_OPT_MEM``, default 0 =
+    off): seconds of modeled cost per GiB of RESIDENT optimizer state —
+    the memory-pressure term that lets ``ddp_sharded``'s ~1/W shard win
+    against byte-equivalent unsharded candidates on memory-bound hosts.
+    Pricing uses the adam-class estimate (2 f32 moments per master
+    weight, / world for sharded-ddp candidates) rather than the measured
+    ``opt_state_bytes`` signal: the measurement describes the ACTIVE
+    strategy's residency, while every candidate must be priced by what
+    it WOULD hold — the signal stays exported for observability and for
+    validating the estimate."""
 
     staleness_weight: float = 0.05
     sync_fixed_s: float = 0.002
     hysteresis: float = 0.1
     surface_s: float = 1.0
+    opt_mem_weight: float = 0.0
 
     @classmethod
     def from_env(cls) -> "CostKnobs":
@@ -198,6 +240,9 @@ class CostKnobs:
             ),
             surface_s=float(
                 os.environ.get("TORCHFT_POLICY_SURFACE_S", "1.0")
+            ),
+            opt_mem_weight=float(
+                os.environ.get("TORCHFT_POLICY_OPT_MEM", "0.0")
             ),
         )
 
@@ -222,7 +267,12 @@ def strategy_cost(
       when the fault lands mid-transaction, and the victim's lost half
       window (cohort-normalized) — the term that caps window growth;
     - staleness: a (1 + w·(H-1)) effective-progress discount, the term
-      that keeps per-step DDP optimal on quiet fat links.
+      that keeps per-step DDP optimal on quiet fat links;
+    - optimizer memory (off unless ``opt_mem_weight`` > 0): the modeled
+      adam-class resident state (2 f32 moments per master weight),
+      ~1/world for the sharded per-step engine — the term that lets
+      ``ddp_sharded`` win on memory-bound hosts even though its wire
+      (q8 rs + bf16 ag, factor 0.375) loses to fused q8 (0.25).
     """
     c = max(float(signals["compute_s"]), 1e-6)
     bw_mbps = float(signals.get("wire_eff_MBps") or 0.0)
@@ -249,6 +299,18 @@ def strategy_cost(
         # Unmeasured bandwidth: price syncs at the fixed cost only; the
         # first windows' op stats fill this in.
         wire_s = 0.0
+    elif spec.kind == "ddp" and spec.sharded:
+        # Two sequential legs over the same bottleneck link, each moving
+        # ~half an allreduce's bytes: grad reduce-scatter at the shard
+        # wire + the param allgather (bf16 when the shard wire is q8 —
+        # ShardedDDP's "auto" default — else full f32). For the q8
+        # default this folds to factor (0.25 + 0.5)/2 = 0.375: the
+        # honest "wins memory/FLOPs, not bytes" accounting.
+        ag_factor = 0.5 if spec.wire == "q8" else 1.0
+        wire_s = (
+            model_bytes * (spec.wire_factor() + ag_factor) / 2.0
+            / (bw_mbps * (1 << 20))
+        )
     else:
         wire_s = (
             model_bytes * spec.wire_factor() / (bw_mbps * (1 << 20))
@@ -288,7 +350,22 @@ def strategy_cost(
         # (a window longer than the fault interval almost never commits).
         t = t / max(1.0 - lam * per_fault_s, 0.05)
 
-    return t * (1.0 + knobs.staleness_weight * (h - 1.0))
+    cost = t * (1.0 + knobs.staleness_weight * (h - 1.0))
+    if knobs.opt_mem_weight > 0.0:
+        # Modeled resident optimizer state, NOT the measured
+        # opt_state_bytes signal: every candidate is priced by what it
+        # WOULD hold, and the pure model keeps the argmin
+        # cohort-identical (see CostKnobs).
+        mem_world = max(float(signals.get("world") or 1.0), 1.0)
+        share = (
+            1.0 / mem_world
+            if (spec.kind == "ddp" and spec.sharded)
+            else 1.0
+        )
+        cost += (
+            knobs.opt_mem_weight * 2.0 * model_bytes * share / float(1 << 30)
+        )
+    return cost
 
 
 class PolicyEngine:
@@ -415,6 +492,10 @@ class PolicyEngine:
                 return bool(
                     getattr(self._manager, "has_iso_plane", lambda: False)()
                 )
+            if spec.sharded and not self._masters_are_f32():
+                # ShardedDDP's shard/gather arithmetic is defined on f32
+                # masters (the sharded plan carries one flat f32 group).
+                return False
             return True
         if spec.kind == "localsgd":
             return True
@@ -452,7 +533,12 @@ class PolicyEngine:
         eng = self._engines.get(spec.name)
         if eng is not None:
             return eng
-        if spec.kind == "ddp":
+        if spec.kind == "ddp" and spec.sharded:
+            eng = ShardedDDP(
+                self._manager, self._state, self._grad_fn,
+                shard_wire=spec.wire,
+            )
+        elif spec.kind == "ddp":
             eng = PipelinedDDP(
                 self._manager, self._state, self._grad_fn,
                 compress=spec.wire, transport=spec.transport,
@@ -526,7 +612,7 @@ class PolicyEngine:
         eng = self._engines.get(self._candidates[self._current].name)
         if eng is None:
             return bool(self.last_commit)
-        if isinstance(eng, PipelinedDDP):
+        if isinstance(eng, (PipelinedDDP, ShardedDDP)):
             return eng.flush()
         if isinstance(eng, AsyncDiLoCo):
             eng.flush()
@@ -596,6 +682,10 @@ class PolicyEngine:
             # bottleneck tier instead of the folded flat average.
             float(tiers.get("intra") or 0.0),
             float(tiers.get("inter") or 0.0),
+            # Measured resident optimizer-state bytes (0 until a sharded
+            # engine reports): observability + model validation — the
+            # cost model prices candidates by the pure estimate instead.
+            float(sig.get("opt_state_bytes") or 0.0),
         ]
         avail = [1.0 if a else 0.0 for a in self._avail]
         failed = [1.0 if f else 0.0 for f in self._failed]
@@ -610,7 +700,7 @@ class PolicyEngine:
         k = len(self._candidates)
         live = [
             e for e in entries
-            if e.shape == (9 + 2 * k,) and np.isfinite(e).all() and e[0] > 0.5
+            if e.shape == (10 + 2 * k,) and np.isfinite(e).all() and e[0] > 0.5
         ]
         if not live:
             raise RuntimeError("no live signal entries in decision gather")
@@ -625,8 +715,8 @@ class PolicyEngine:
             v = v[v > 0.0]
             return float(v.min()) if v.size else 0.0
 
-        avail = mat[:, 9:9 + k].min(axis=0)  # AND across members
-        failed = mat[:, 9 + k:].max(axis=0)  # OR across members
+        avail = mat[:, 10:10 + k].min(axis=0)  # AND across members
+        failed = mat[:, 10 + k:].max(axis=0)  # OR across members
         return {
             "compute_s": float(mat[:, 1].max()),
             "wire_eff_MBps": float(bws.min()) if bws.size else 0.0,
@@ -636,6 +726,7 @@ class PolicyEngine:
             "heal_s": float(mat[:, 6].max()),
             "tier_intra_MBps": _tier_min(7),
             "tier_inter_MBps": _tier_min(8),
+            "opt_state_bytes": float(mat[:, 9].max()),
             "world": float(len(live)),
             "model_bytes": float(self._model_bytes),
             "avail": avail,
@@ -767,8 +858,17 @@ class PolicyEngine:
         eng = self._engine(spec)
         if spec.kind == "ddp":
             eng.last_commit = None
-            eng._residual = None
-            eng._prev_residual = None
+            if isinstance(eng, ShardedDDP):
+                # Tenure boundary for the sharded engine: void the
+                # quorum-keyed shard meta so the first step under the new
+                # tenure re-partitions against the live cohort, and let
+                # the optimizer restart from a deterministic fresh init —
+                # every member computes it from cohort-identical params,
+                # so cross-member identity holds through the switch.
+                eng.begin_fresh_shard()
+            else:
+                eng._residual = None
+                eng._prev_residual = None
             if spec.transport == "plan" and spec.wire == "q8":
                 # the NATIVE q8ef carry lives in the comm plan, not in
                 # eng._residual — same tenure-boundary reset discipline
@@ -807,8 +907,13 @@ class PolicyEngine:
 
     def state_dict(self) -> Dict[str, Any]:
         spec = self._candidates[self._current]
-        if spec.kind == "ddp":
-            inner: Dict[str, Any] = {"state": self._state.state_dict()}
+        if spec.kind == "ddp" and spec.sharded:
+            # The sharded engine's own surface: ships the donor's opt
+            # shard + quorum-keyed meta; the recipient voids the meta on
+            # load so its first step re-partitions under the live cohort.
+            inner: Dict[str, Any] = self._engine(spec).state_dict()
+        elif spec.kind == "ddp":
+            inner = {"state": self._state.state_dict()}
         else:
             inner = self._engine(spec).state_dict()
         return {
@@ -830,7 +935,9 @@ class PolicyEngine:
         self._decide_epoch = int(pol["decide_epoch"])
         self._failed = [bool(f) for f in pol["failed"]]
         spec = self._candidates[self._current]
-        if spec.kind == "ddp":
+        if spec.kind == "ddp" and spec.sharded:
+            self._engine(spec).load_state_dict(sd["inner"])
+        elif spec.kind == "ddp":
             self._state.load_state_dict(sd["inner"]["state"])
         else:
             self._engine(spec).load_state_dict(sd["inner"])
