@@ -25,9 +25,19 @@ Reported (CHURN_BENCH.json + one JSON line on stdout):
                so single-host numbers are pessimistic: the restarting
                process competes for this machine's CPUs).
 
+A separate ``--durable`` mode benches the durable checkpoint tier
+(DURABLE_BENCH.json): per-checkpoint trainer stall of the async sharded
+zero-copy snapshot vs the v1-shaped synchronous writer, per-member
+durable bytes (~1/W), and the cold no-donor restore split into
+manifest-read / shard-fetch / reshard / h2d / compile buckets.
+``--durable --dryrun`` is the CI smoke: asserts one committed
+async-snapshot record and one no-donor-restore record, writes no
+artifact.
+
 Usage::
 
     python bench_churn.py --groups 4 --steps 300 --kill-every 100
+    python bench_churn.py --durable
 """
 
 from __future__ import annotations
@@ -942,6 +952,309 @@ def _run_phase(
     }
 
 
+# --------------------------------------------------------------------------
+# durable phase: async sharded snapshot stall + no-donor restore
+# --------------------------------------------------------------------------
+
+
+def run_durable_phase(
+    n_elems: int = 8_000_000,
+    checkpoints: int = 4,
+    world_old: int = 3,
+    world_new: int = 2,
+) -> dict:
+    """Bench the durable tier in-process with a fake-manager fleet (the
+    durable pipeline's only inputs are ``(step, quorum_id, rank, world)``
+    at the commit boundary; the live-Manager integration is covered by
+    the chaos ``fleet_loss`` config and tests/test_durable.py).
+
+    Three measurements on an adam-shaped state (f32 params + 2x f32
+    opt-state, bf16 wire):
+
+      sync_baseline:  W=1 ``mode="sync"`` — the v1-shaped blocking
+                      d2h + serialize + write + fsync pipeline on the
+                      trainer thread, per checkpoint.
+      async_sharded:  W=world_old ``mode="async"`` + ``zero_copy`` —
+                      each member's trainer pays only the layout walk of
+                      its ~1/W shard; cast/CRC/write/fsync ride the
+                      background writer.
+      durable_restore: a COLD fleet of W=world_new (no live donor, no
+                      overlap with world_old) reassembles the newest
+                      committed set, split into manifest-read /
+                      shard-fetch / reshard / h2d / compile buckets.
+    """
+    import statistics
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchft_tpu.durable import DurableCheckpointer
+
+    class _Mgr:
+        def __init__(self, rank: int, world: int) -> None:
+            self._rank, self._world = rank, world
+            self._step, self._bc = 0, 0
+
+        def current_step(self) -> int:
+            return self._step
+
+        def quorum_id(self) -> int:
+            return 1
+
+        def participating_rank(self) -> int:
+            return self._rank
+
+        def num_participants(self) -> int:
+            return self._world
+
+        def replica_id(self) -> str:
+            return f"durable_bench_{self._rank}"
+
+        def state_dict(self) -> dict:
+            return {"step": self._step, "batches_committed": self._bc}
+
+        def load_state_dict(self, sd: dict) -> None:
+            self._step = sd["step"]
+            self._bc = sd["batches_committed"]
+
+        def add_commit_hook(self, fn) -> None:
+            pass
+
+    class _St:
+        def __init__(self) -> None:
+            z = jnp.zeros((n_elems,), jnp.float32)
+            self.params = {"w": z + 0.5}
+            self.opt_state = {"m": z, "v": z}
+
+        def state_dict(self) -> dict:
+            return {"params": self.params, "opt_state": self.opt_state}
+
+        def load_state_dict(self, sd) -> None:
+            self.params = sd["params"]
+            self.opt_state = sd["opt_state"]
+
+    # functional (non-donating) update — the regime TORCHFT_DURABLE_
+    # ZEROCOPY is sound for
+    update = jax.jit(
+        lambda w, m, v, g: (
+            w - 0.1 * (0.9 * m + 0.1 * g),
+            0.9 * m + 0.1 * g,
+            0.99 * v + 0.01 * g * g,
+        )
+    )
+
+    def train_step(st: "_St", step: int) -> None:
+        g = jnp.full((n_elems,), 0.001 * step, jnp.float32)
+        w, m, v = update(
+            st.params["w"], st.opt_state["m"], st.opt_state["v"], g
+        )
+        st.params = {"w": w}
+        st.opt_state = {"m": m, "v": v}
+        jax.block_until_ready(w)
+
+    record: Dict[str, object] = {
+        "phase": "durable",
+        "config": {
+            "n_elems": n_elems,
+            "checkpoints": checkpoints,
+            "world_old": world_old,
+            "world_new": world_new,
+            "wire": "bf16",
+            "host_cpus": os.cpu_count(),
+        },
+    }
+
+    with tempfile.TemporaryDirectory(prefix="durable_bench_") as tmp:
+        # -- sync baseline (v1-shaped blocking writer, full state) --
+        sync_dir = os.path.join(tmp, "sync")
+        mgr = _Mgr(0, 1)
+        st = _St()
+        train_step(st, 0)  # warm jit; materialize state
+        cp = DurableCheckpointer(
+            sync_dir, mgr, st, every=1, keep=2, mode="sync",
+            commit_timeout_s=60.0,
+        )
+        for step in range(1, checkpoints + 1):
+            train_step(st, step)
+            mgr._step, mgr._bc = step, step
+            cp.maybe_save()
+        cp.flush(120.0)
+        cp.close()
+        sync_stalls = [r["stall_s"] for r in cp.snapshots]
+        total_bytes = int(cp.snapshots[0]["total_bytes"])
+        record["config"]["total_bytes"] = total_bytes  # type: ignore[index]
+        record["sync_baseline"] = {
+            "mode": "sync",
+            "world": 1,
+            "stall_s": [round(s, 6) for s in sync_stalls],
+            "stall_p50_s": round(statistics.median(sync_stalls), 6),
+            "durable_bytes_per_member": total_bytes,
+        }
+
+        # -- async sharded zero-copy snapshots at W=world_old --
+        async_dir = os.path.join(tmp, "async")
+        mgrs = [_Mgr(r, world_old) for r in range(world_old)]
+        sts = [_St() for _ in range(world_old)]
+        for s in sts:
+            train_step(s, 0)
+        cps = [
+            DurableCheckpointer(
+                async_dir, m, s, every=1, keep=2, mode="async",
+                zero_copy=True, commit_timeout_s=60.0,
+            )
+            for m, s in zip(mgrs, sts)
+        ]
+        for step in range(1, checkpoints + 1):
+            for s in sts:  # deterministic: members stay replicated
+                train_step(s, step)
+            for m in mgrs:
+                m._step, m._bc = step, step * world_old
+            for c in cps:
+                c.maybe_save()
+        flushed = all(c.flush(120.0) for c in cps)
+        for c in cps:
+            c.close()
+        committed_steps = cps[0].committed_steps()
+        rows = [r for c in cps for r in c.snapshots]
+        async_stalls = [r["stall_s"] for r in rows]
+        shard_bytes = sorted({int(r["shard_bytes"]) for r in rows})
+        record["async_sharded"] = {
+            "mode": "async",
+            "world": world_old,
+            "zero_copy": True,
+            "rows": [
+                {
+                    k: (round(v, 6) if k == "stall_s" else v)
+                    for k, v in r.items()
+                }
+                for r in rows
+            ],
+            "stall_p50_s": round(statistics.median(async_stalls), 6),
+            "stall_mean_s": round(
+                sum(async_stalls) / len(async_stalls), 6
+            ),
+            "shard_bytes": shard_bytes,
+            "committed_steps": committed_steps,
+            "flushed": flushed,
+        }
+        sync_mean = sum(sync_stalls) / len(sync_stalls)
+        async_mean = sum(async_stalls) / len(async_stalls)
+        record["stall_ratio_vs_sync"] = round(
+            async_mean / sync_mean, 4
+        ) if sync_mean else None
+        # per-member durable bytes ~ total/W (floor split slack < W)
+        record["shard_scaling_ok"] = bool(
+            max(int(r["shard_bytes"]) for r in rows)
+            <= total_bytes // world_old + world_old
+        )
+
+        # -- no-donor cold restore at a DIFFERENT W --
+        new_mgrs = [_Mgr(r, world_new) for r in range(world_new)]
+        new_sts = [_St() for _ in range(world_new)]
+        restores = []
+        t_all = time.perf_counter()
+        for m, s in zip(new_mgrs, new_sts):
+            rcp = DurableCheckpointer(async_dir, m, s, every=1)
+            t0 = time.perf_counter()
+            step = rcp.restore_latest(device_put=True)
+            wall = time.perf_counter() - t0
+            stats = dict(rcp.last_restore_stats or {})
+            stats["restored_step"] = step
+            stats["wall_s"] = wall
+            restores.append(stats)
+            rcp.close()
+        # compile bucket: first jitted step on the restored state — a
+        # fresh function object so jax cannot reuse the warm executable
+        restep = jax.jit(lambda w, g: w - 0.1 * g)
+        t0 = time.perf_counter()
+        jax.block_until_ready(
+            restep(
+                new_sts[0].params["w"],
+                jnp.full((n_elems,), 0.001, jnp.float32),
+            )
+        )
+        compile_s = time.perf_counter() - t0
+        digests = {
+            hash(np.asarray(s.params["w"]).tobytes()) for s in new_sts
+        }
+        r0 = restores[0]
+        record["durable_restore"] = {
+            "kind": "no_donor_cold_restore",
+            "world_old": world_old,
+            "world_new": world_new,
+            "restored_step": r0.get("restored_step"),
+            "bytes": r0.get("bytes"),
+            "manifest_read_s": round(r0.get("manifest_read_s", 0.0), 6),
+            "shard_fetch_s": round(r0.get("shard_fetch_s", 0.0), 6),
+            "reshard_s": round(r0.get("reshard_s", 0.0), 6),
+            "h2d_s": round(r0.get("h2d_s", 0.0), 6),
+            "compile_s": round(compile_s, 6),
+            "wall_s": round(r0.get("wall_s", 0.0), 6),
+            "fleet_wall_s": round(time.perf_counter() - t_all, 6),
+            "members_bit_identical": len(digests) == 1,
+            "per_member": [
+                {
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in r.items()
+                }
+                for r in restores
+            ],
+        }
+    return record
+
+
+def run_durable_main(dryrun: bool, out: str) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    record = run_durable_phase(
+        n_elems=1_000_000 if dryrun else 8_000_000,
+        checkpoints=2 if dryrun else 4,
+    )
+    snaps = record["async_sharded"]
+    restore = record["durable_restore"]
+    ratio = record["stall_ratio_vs_sync"]
+    # one committed async-snapshot record + one no-donor-restore record
+    # with every bucket present: the dryrun contract, asserted on full
+    # runs too (a bench that can't produce its own headline rows should
+    # fail, not publish an empty artifact)
+    ok = (
+        bool(snaps["committed_steps"])
+        and bool(snaps["flushed"])
+        and any(r["committed"] for r in snaps["rows"])
+        and restore["restored_step"] == max(snaps["committed_steps"])
+        and restore["members_bit_identical"]
+        and all(
+            restore[k] is not None
+            for k in (
+                "manifest_read_s", "shard_fetch_s", "reshard_s",
+                "h2d_s", "compile_s",
+            )
+        )
+        and record["shard_scaling_ok"]
+    )
+    record["measurement_ok"] = ok and ratio is not None and ratio <= 0.05
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "durable_dryrun_ok" if dryrun else "durable_stall_ratio"
+                ),
+                "value": (1 if ok else 0) if dryrun else ratio,
+                "unit": "bool" if dryrun else "ratio",
+                "stall_ratio_vs_sync": ratio,
+                "restored_step": restore["restored_step"],
+                "restore_wall_s": restore["wall_s"],
+            }
+        )
+    )
+    if dryrun:
+        return 0 if ok else 1  # smoke only, NO artifact
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+    return 0 if ok else 1
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--worker", action="store_true")
@@ -965,6 +1278,14 @@ def main() -> None:
         "restarting",
     )
     parser.add_argument(
+        "--durable",
+        action="store_true",
+        help="bench the durable checkpoint tier instead of churn: async "
+        "sharded snapshot stall vs the synchronous writer, 1/W shard "
+        "bytes, and the cold no-donor restore breakdown "
+        "(DURABLE_BENCH.json; with --dryrun: CI smoke, no artifact)",
+    )
+    parser.add_argument(
         "--dryrun",
         action="store_true",
         help="seconds-scale CI smoke: 2 groups, a few dozen steps, one "
@@ -974,6 +1295,13 @@ def main() -> None:
     )
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
+    if args.durable:
+        sys.exit(
+            run_durable_main(
+                dryrun=args.dryrun,
+                out=args.out or os.path.join(REPO, "DURABLE_BENCH.json"),
+            )
+        )
     if args.dryrun and not args.worker:
         # Kill early in a window long enough that the donor is still
         # alive and committing when the victim's restart comes up — a

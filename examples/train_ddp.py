@@ -328,6 +328,11 @@ def main() -> None:
         f"[group {replica_group}] done: step={manager.current_step()} "
         f"batches_committed={manager.batches_committed()}"
     )
+    if ckpt is not None:
+        # drain the async writer: the last snapshot's manifest commit
+        # must land before the process exits
+        ckpt.flush()
+        ckpt.close()
     manager.shutdown()
     collectives.shutdown()
 

@@ -34,6 +34,15 @@ monotonicity ACROSS ROOT EPOCHS, zero split-brain, a bounded
 formation-liveness gap, and that a restarted root replays its WAL and
 fences behind the takeover epoch — with zero manager restarts.
 
+The ``fleet_loss`` config turns the faults on the DURABLE CHECKPOINT
+tier — the one failure the live streamed heal cannot cover: SIGKILL
+every member AND the root mid-step (subprocess fleet), tear the
+manifest log mid-record (the ``wal_write`` truncate seam applied to the
+durable tier's own log), cold-restart the fleet with no donor anywhere,
+and assert it resumes from the newest surviving COMMITTED manifest —
+bit-identical to the pre-kill fleet at that step, with zero
+torn-manifest wins and committed post-resume liveness.
+
 The ``sharded_reshard`` config turns the faults on the per-step ZeRO
 data plane: a member dies mid reduce-scatter (seeded ring partition +
 departure), the vote discards the broken step, the shrunken quorum
@@ -53,8 +62,10 @@ Also run here (and recorded in CHAOS_BENCH.json):
     asserted by tests/test_chaos_invariants.py (measured tx bytes).
 
 ``--dryrun`` runs a seconds-scale subset (CI smoke) asserting at least
-one detected-corruption record and one SIGSTOP-stall record; no
-artifact is written.
+one detected-corruption record, one SIGSTOP-stall record, one
+root-restart-with-WAL-replay record, one sharded re-partition record,
+and one whole-fleet-loss durable-restore record; no artifact is
+written.
 """
 
 from __future__ import annotations
@@ -1301,10 +1312,360 @@ def run_sharded_reshard(seed: int, deadline_s: float = 180.0) -> dict:
     }
 
 
+# -- whole-fleet loss (durable checkpoint tier) ------------------------------
+
+
+def fleet_member_main(argv: List[str]) -> int:
+    """One fleet-loss member, run as a SIGKILL-able SUBPROCESS (in-thread
+    members would take the harness down with them). Phase 1 trains with
+    async durable snapshots until killed; phase 2 cold-starts with no
+    donor anywhere, restores from the durable tier, and proves liveness
+    with a couple more committed steps. Progress/result records go to
+    ``--out`` as atomically-renamed JSON files the parent asserts over."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--fleet-member", action="store_true")
+    parser.add_argument("--root", required=True)
+    parser.add_argument("--dir", required=True)
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--gid", type=int, required=True)
+    parser.add_argument("--groups", type=int, required=True)
+    parser.add_argument("--phase", type=int, required=True)
+    parser.add_argument("--extra-steps", type=int, default=2)
+    args = parser.parse_args(argv)
+
+    from torchft_tpu.durable import DurableCheckpointer
+
+    def emit(name: str, payload: dict) -> None:
+        path = os.path.join(args.out, name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    params_box = {"w": np.full(4096, 1.0, dtype=np.float32)}
+
+    class _State:
+        def state_dict(self) -> dict:
+            return {"params": {k: np.asarray(v) for k, v in params_box.items()}}
+
+        def load_state_dict(self, sd: dict) -> None:
+            for k, v in sd["params"].items():
+                params_box[k] = np.array(v, dtype=np.float32)
+
+    state = _State()
+    store = Store()
+    collectives = HostCollectives(
+        timeout=timedelta(seconds=OP_TIMEOUT_S),
+        connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+        stripes=1,
+        wire_crc=True,
+    )
+    manager = Manager(
+        collectives=collectives,
+        load_state_dict=state.load_state_dict,
+        state_dict=state.state_dict,
+        # Full-width quorum only: every committed step's snapshot set
+        # tiles all W members, so any commit record is fleet-restorable.
+        min_replica_size=args.groups,
+        use_async_quorum=False,
+        timeout=timedelta(seconds=OP_TIMEOUT_S),
+        quorum_timeout=timedelta(seconds=OP_TIMEOUT_S * 5),
+        connect_timeout=timedelta(seconds=OP_TIMEOUT_S * 3),
+        rank=0,
+        world_size=1,
+        store_addr=store.address(),
+        lighthouse_addr=args.root,
+        replica_id=f"fleet_{args.gid}",
+    )
+    ckpt = DurableCheckpointer(
+        args.dir, manager, state, every=1, keep=10, mode="async"
+    )
+    result: Dict[str, Any] = {
+        "gid": args.gid, "phase": args.phase, "commits": [],
+    }
+    try:
+        restored = ckpt.restore_latest()
+        if args.phase == 2:
+            result["restored_step"] = restored
+            result["restored_digest"] = _digest(params_box)
+        stop_at = (
+            (restored or 0) + args.extra_steps if args.phase == 2 else 1 << 30
+        )
+        deadline = time.monotonic() + 150.0
+        while time.monotonic() < deadline:
+            step = manager.current_step()
+            if step >= stop_at:
+                break
+            try:
+                manager.start_quorum()
+                # deterministic per-step gradient, identical on every
+                # member: both phases replay the same trajectory
+                grads = {
+                    "w": np.full(
+                        4096, 0.01 + 0.001 * step, dtype=np.float32
+                    )
+                }
+                avg = manager.allreduce(grads).wait()
+                if manager.should_commit() and avg is not None:
+                    params_box["w"] = (
+                        params_box["w"] - 0.1 * np.asarray(avg["w"])
+                    )
+                    committed = manager.current_step()
+                    ckpt.maybe_save()
+                    result["commits"].append(committed)
+                    if args.phase == 1:
+                        emit(
+                            f"p1_g{args.gid}_s{committed:06d}.json",
+                            {
+                                "step": committed,
+                                "digest": _digest(params_box),
+                                "quorum_id": manager.quorum_id(),
+                            },
+                        )
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                try:
+                    if manager.errored() is None:
+                        manager.report_error(e)
+                    manager.should_commit(
+                        timeout=timedelta(seconds=OP_TIMEOUT_S)
+                    )
+                except Exception:
+                    pass
+        if args.phase == 2:
+            if not ckpt.flush(30):
+                result["flush_timeout"] = True
+            result["final_step"] = manager.current_step()
+            result["final_digest"] = _digest(params_box)
+            emit(f"p2_g{args.gid}.json", result)
+    finally:
+        try:
+            ckpt.close()
+        except Exception:
+            pass
+        try:
+            manager.shutdown()
+        except Exception:
+            pass
+        try:
+            collectives.shutdown()
+        except Exception:
+            pass
+        store.shutdown()
+    return 0
+
+
+def run_fleet_loss(groups: int = 3, deadline_s: float = 240.0) -> dict:
+    """WHOLE-FLEET LOSS: SIGKILL every member AND the root mid-step, then
+    cold-restart the fleet with no live donor anywhere — the one failure
+    the live streamed heal cannot cover, and exactly what the durable
+    tier exists for. Asserts:
+
+      1. RESUME FROM NEWEST COMMITTED MANIFEST: the cold fleet restores
+         the newest commit record that survives the torn manifest tail.
+      2. ZERO TORN-MANIFEST WINS: the parent tears the manifest mid-
+         record after the kill (the ``wal_write`` truncate-seam
+         discipline turned on the durable tier's own log) — the torn
+         commit must never be restored.
+      3. BIT IDENTITY: every cold member's restored params digest equals
+         the digest the phase-1 fleet recorded at that committed step.
+      4. LIVENESS: the restored fleet commits further steps and stays
+         bit-identical.
+    """
+    import shutil
+    import subprocess
+
+    from torchft_tpu.chaos import RootProcess, free_port, kill_process
+    from torchft_tpu.durable import _FRAME, LocalDirStore, ManifestLog
+
+    durable_dir = tempfile.mkdtemp(prefix="tft_fleet_ckpt_")
+    out_dir = tempfile.mkdtemp(prefix="tft_fleet_out_")
+    repro = f"replay: --config fleet_loss (durable tier chaos, W={groups})"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+
+    def spawn_members(root_addr: str, phase: int) -> List[Any]:
+        return [
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--fleet-member", "--root", root_addr,
+                    "--dir", durable_dir, "--out", out_dir,
+                    "--gid", str(g), "--groups", str(groups),
+                    "--phase", str(phase),
+                ],
+                env=env,
+            )
+            for g in range(groups)
+        ]
+
+    manifest = ManifestLog(LocalDirStore(durable_dir))
+    t0 = time.monotonic()
+    root = RootProcess(
+        free_port(), min_replicas=groups, join_timeout_ms=200,
+        heartbeat_timeout_ms=4000,
+    )
+    procs: List[Any] = []
+    root2 = None
+    try:
+        root.wait_serving()
+        procs = spawn_members(root.address(), phase=1)
+        # Phase 1 runs until at least 3 committed sets exist (>= 2 must
+        # survive the tear below), then dies mid-step.
+        poll_deadline = time.monotonic() + deadline_s
+        while True:
+            records, _ = manifest.replay()
+            commits = [r for r in records if r.get("t") == "commit"]
+            if len(commits) >= 3:
+                break
+            dead = [p for p in procs if p.poll() is not None]
+            assert not dead and time.monotonic() < poll_deadline, (
+                f"phase-1 fleet never produced 3 committed manifests "
+                f"(commits={len(commits)}, exited="
+                f"{[p.returncode for p in dead]}, {repro})"
+            )
+            time.sleep(0.1)
+        # THE FAULT: SIGKILL the whole fleet and its root mid-step.
+        for p in procs:
+            kill_process(p.pid)
+        root.kill()
+        for p in procs:
+            p.wait(timeout=20)
+
+        # THE TORN SEAM: truncate the manifest inside its last intact
+        # record — the crash-mid-append discipline (wal_write) applied to
+        # the durable tier's own log. Frame-walk for the real boundary:
+        # arbitrary tail offsets can land between records and tear
+        # nothing.
+        mpath = os.path.join(durable_dir, "MANIFEST.log")
+        with open(mpath, "rb") as f:
+            raw = f.read()
+        frame = _FRAME
+        pos, frames = 0, []
+        while pos + frame.size <= len(raw):
+            ln, _crc = frame.unpack_from(raw, pos)
+            if pos + frame.size + ln > len(raw):
+                break  # natural torn tail from the SIGKILL itself
+            frames.append((pos, pos + frame.size + ln))
+            pos = pos + frame.size + ln
+        assert len(frames) >= 3, f"too few intact records ({repro})"
+        last_begin, last_end = frames[-1]
+        torn_rec = json.loads(raw[last_begin + frame.size:last_end])
+        cut = last_begin + frame.size + max(1, (last_end - last_begin) // 3)
+        with open(mpath, "r+b") as f:
+            f.truncate(cut)
+        surviving = [
+            json.loads(raw[b + frame.size:e]) for b, e in frames[:-1]
+        ]
+        retired = {
+            r["dir"] for r in surviving if r.get("t") == "retire"
+        }
+        expect_step = max(
+            int(r["step"])
+            for r in surviving
+            if r.get("t") == "commit" and r["dir"] not in retired
+        )
+        torn_step = (
+            int(torn_rec["step"]) if torn_rec.get("t") == "commit" else None
+        )
+        phase1_digests: Dict[int, set] = {}
+        for fname in os.listdir(out_dir):
+            if fname.startswith("p1_") and fname.endswith(".json"):
+                with open(os.path.join(out_dir, fname)) as f:
+                    rec = json.load(f)
+                phase1_digests.setdefault(rec["step"], set()).add(
+                    rec["digest"]
+                )
+
+        # Phase 2: cold fleet — fresh root, fresh processes, no donor.
+        root2 = RootProcess(
+            free_port(), min_replicas=groups, join_timeout_ms=200,
+            heartbeat_timeout_ms=4000,
+        )
+        root2.wait_serving()
+        procs2 = spawn_members(root2.address(), phase=2)
+        procs.extend(procs2)
+        for p in procs2:
+            p.wait(timeout=deadline_s)
+            assert p.returncode == 0, (
+                f"phase-2 member exited {p.returncode} ({repro})"
+            )
+        results = []
+        for g in range(groups):
+            path = os.path.join(out_dir, f"p2_g{g}.json")
+            assert os.path.exists(path), (
+                f"phase-2 member {g} left no result ({repro})"
+            )
+            with open(path) as f:
+                results.append(json.load(f))
+
+        # 1+2. newest COMMITTED manifest wins; the torn record never does.
+        for r in results:
+            assert r["restored_step"] == expect_step, (
+                f"member {r['gid']} resumed from step {r['restored_step']}"
+                f", expected newest surviving commit {expect_step} "
+                f"(torn record step={torn_step}, {repro})"
+            )
+            if torn_step is not None and torn_step != expect_step:
+                assert r["restored_step"] != torn_step, (
+                    f"TORN manifest record won the restore ({repro})"
+                )
+        # 3. bit identity with the phase-1 fleet at that step.
+        restored_digests = {r["restored_digest"] for r in results}
+        assert len(restored_digests) == 1, (
+            f"cold members restored diverged state {restored_digests} "
+            f"({repro})"
+        )
+        assert phase1_digests.get(expect_step) == restored_digests, (
+            f"restored digest differs from the phase-1 fleet's at step "
+            f"{expect_step}: {phase1_digests.get(expect_step)} vs "
+            f"{restored_digests} ({repro})"
+        )
+        # 4. liveness + post-resume identity.
+        for r in results:
+            assert r["final_step"] > expect_step and r["commits"], (
+                f"member {r['gid']} never committed after the cold "
+                f"restore (final={r['final_step']}, {repro})"
+            )
+        final_digests = {r["final_digest"] for r in results}
+        assert len(final_digests) == 1, (
+            f"cold fleet diverged after resume {final_digests} ({repro})"
+        )
+        wall_s = time.monotonic() - t0
+        return {
+            "config": "fleet_loss",
+            "groups": groups,
+            "wall_s": round(wall_s, 3),
+            "commits_before_kill": len(frames),
+            "torn_record_step": torn_step,
+            "resumed_step": expect_step,
+            "post_resume_steps": results[0]["final_step"] - expect_step,
+            "resumed_from_committed": True,
+            "torn_manifest_wins": 0,
+            "bit_identity_ok": True,
+            "liveness_ok": True,
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                kill_process(p.pid)
+        root.stop()
+        if root2 is not None:
+            root2.stop()
+        shutil.rmtree(durable_dir, ignore_errors=True)
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+
 # -- entry point -------------------------------------------------------------
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    args_in = sys.argv[1:] if argv is None else argv
+    if "--fleet-member" in args_in:
+        # subprocess re-entry: one fleet-loss member (see run_fleet_loss)
+        return fleet_member_main(args_in)
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--dryrun", action="store_true",
                         help="seconds-scale CI smoke; no artifact")
@@ -1315,11 +1676,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--config", type=str, default="ddp",
                         choices=("ddp", "plan", "hier", "hier_shm",
                                  "policy", "root_outage",
-                                 "sharded_reshard"))
+                                 "sharded_reshard", "fleet_loss"))
     parser.add_argument("--seeds", type=int, default=3,
                         help="seeds per configuration for the full run")
     parser.add_argument("--out", default=os.path.join(REPO, "CHAOS_BENCH.json"))
     args = parser.parse_args(argv)
+
+    if args.config == "fleet_loss" and args.seed is None:
+        # standalone fleet-loss run (the CI smoke invokes it this way):
+        # the schedule is pinned, not seeded, so no --seed is required;
+        # --dryrun only shrinks the fleet
+        rec = run_fleet_loss(groups=2 if args.dryrun else 3)
+        print(json.dumps(rec, indent=2))
+        return 0
 
     if args.seed is not None:
         # replay mode: one schedule, loud verdict
@@ -1327,6 +1696,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             rec = run_policy_schedule(args.seed)
         elif args.config == "sharded_reshard":
             rec = run_sharded_reshard(args.seed)
+        elif args.config == "fleet_loss":
+            rec = run_fleet_loss()
         elif args.config == "root_outage":
             plan = FaultPlan.from_json(args.plan) if args.plan else None
             rec = run_root_outage(args.seed, plan=plan)
@@ -1437,6 +1808,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"commits={reshard_rec['commits_per_member']}", flush=True,
     )
 
+    # Whole-fleet loss (durable checkpoint tier): SIGKILL every member
+    # AND the root mid-step, tear the manifest tail, cold-restart with no
+    # donor — resume must come from the newest surviving COMMITTED
+    # manifest, bit-identical to the pre-kill fleet at that step.
+    fleet_rec = run_fleet_loss(groups=2 if args.dryrun else 3)
+    records.append(fleet_rec)
+    print(
+        f"[chaos] fleet loss: resumed step {fleet_rec['resumed_step']} "
+        f"(torn record step={fleet_rec['torn_record_step']}), "
+        f"+{fleet_rec['post_resume_steps']} steps post-resume, "
+        f"{fleet_rec['wall_s']:.1f}s", flush=True,
+    )
+
     probes = run_iso_probes()
     print(f"[chaos] iso probes: {json.dumps(probes)}", flush=True)
 
@@ -1462,6 +1846,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     assert reshard_records, (
         "no sharded re-partition record was produced"
     )
+    fleet_records = [
+        r
+        for r in records
+        if r.get("config") == "fleet_loss"
+        and r.get("resumed_from_committed")
+        and r.get("bit_identity_ok")
+        and r.get("torn_manifest_wins") == 0
+    ]
+    assert fleet_records, (
+        "no whole-fleet-loss durable-restore record was produced"
+    )
 
     if args.dryrun:
         print(
@@ -1473,6 +1868,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "sigstop_stall_records": len(stalls),
                     "root_restart_records": len(root_restart_records),
                     "sharded_reshard_records": len(reshard_records),
+                    "fleet_loss_records": len(fleet_records),
                 }
             )
         )

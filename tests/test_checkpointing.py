@@ -629,3 +629,117 @@ def test_stream_fault_cancels_surviving_readers(server):
         assert server._stream_inflight == 0
     finally:
         proxy.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Range-limited capture (the durable tier's shard_of= discipline)
+# ---------------------------------------------------------------------------
+
+
+def _shard_state():
+    # Odd sizes chosen so W=3 floor-split boundaries land mid-leaf AND
+    # mid-element on the bf16 wire (opt_state halves its byte width).
+    return {
+        "params": {
+            "w": np.arange(1001, dtype=np.float32),
+            "b": np.arange(7, dtype=np.float32) * 0.5,
+        },
+        "opt_state": {
+            "m": np.arange(503, dtype=np.float32) * 0.25,
+            "v": np.arange(129, dtype=np.float32) * 4.0,
+        },
+        "step": 9,
+    }
+
+
+@pytest.mark.parametrize("wire", [None, "bf16"])
+@pytest.mark.parametrize("world", [1, 2, 3, 5])
+def test_range_capture_reassembles_full_stream(wire, world):
+    """Concatenating every member's shard_of capture over its
+    shard_bounds span must be byte-identical to the unsharded stream —
+    straddling leaves contribute exactly their in-range element slice,
+    with the wire-itemsize outward alignment covering split elements."""
+    import io
+
+    from torchft_tpu.checkpointing import _StreamStaging
+    from torchft_tpu.durable import shard_bounds
+
+    state = _shard_state()
+    full = _StreamStaging(state, wire, snapshot=True)
+    buf = io.BytesIO()
+    full.write_range(buf, 0, full.total)
+    want = buf.getvalue()
+    assert len(want) == full.total
+
+    got = b""
+    for rank in range(world):
+        bounds = shard_bounds(full.total, world)
+        begin, end = bounds[rank], bounds[rank + 1]
+        st = _StreamStaging(
+            state, wire, snapshot=True, shard_of=(rank, world)
+        )
+        assert st.total == full.total  # layout is shard-blind
+        b = io.BytesIO()
+        st.write_range(b, begin, end)
+        piece = b.getvalue()
+        assert len(piece) == end - begin
+        # capture cost is the member's span plus at most one wire
+        # element of outward alignment per straddled boundary (params
+        # stay f32 even on the bf16 wire, so the element is <= 4 bytes)
+        assert st.captured_bytes <= (end - begin) + 2 * 4
+        assert st.range_crc32c(begin, end) == full.range_crc32c(begin, end)
+        got += piece
+    assert got == want
+
+
+def test_range_capture_out_of_span_read_raises():
+    """A shard-limited staging must refuse reads outside its captured
+    span — a silent zero-fill or a bisect wrap to the wrong segment
+    would ship a torn shard that still CRCs clean."""
+    import io
+
+    from torchft_tpu.checkpointing import _StreamStaging
+    from torchft_tpu.durable import shard_bounds
+
+    state = _shard_state()
+    probe = _StreamStaging(state, None, snapshot=True)
+    bounds = shard_bounds(probe.total, 3)
+    begin, end = bounds[1], bounds[2]
+    st = _StreamStaging(state, None, snapshot=True, shard_of=(1, 3))
+    for bad in [(0, end), (begin, probe.total), (begin - 1, end)]:
+        with pytest.raises(ValueError, match="outside captured span"):
+            st.write_range(io.BytesIO(), *bad)
+        with pytest.raises(ValueError, match="outside captured span"):
+            st.range_crc32c(*bad)
+    # the span itself stays servable after the failed reads
+    b = io.BytesIO()
+    st.write_range(b, begin, end)
+    assert len(b.getvalue()) == end - begin
+
+
+def test_range_capture_pinned_defers_wire_cast():
+    """pin_leaves=True with jax leaves: capture stores views + deferred
+    (slice, wdtype) casts, and the writer-side _seg() resolution yields
+    bytes identical to the eager-copy capture."""
+    import io
+
+    import jax.numpy as jnp
+
+    from torchft_tpu.checkpointing import _StreamStaging
+    from torchft_tpu.durable import shard_bounds
+
+    state = {
+        "params": {"w": jnp.arange(257, dtype=jnp.float32)},
+        "opt_state": {"m": jnp.arange(130, dtype=jnp.float32) * 0.5},
+    }
+    eager = _StreamStaging(state, "bf16", snapshot=True, shard_of=(0, 2))
+    pinned = _StreamStaging(
+        state, "bf16", snapshot=True, shard_of=(0, 2), pin_leaves=True
+    )
+    assert pinned._pins  # jax leaves really were pinned, not copied
+    begin, end = shard_bounds(eager.total, 2)[:2]
+    be, bp = io.BytesIO(), io.BytesIO()
+    eager.write_range(be, begin, end)
+    pinned.write_range(bp, begin, end)
+    assert be.getvalue() == bp.getvalue()
+    assert pinned.range_crc32c(begin, end) == eager.range_crc32c(begin, end)

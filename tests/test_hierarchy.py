@@ -451,22 +451,49 @@ class TestLiveHierarchy:
         assert not errors, errors
         return results
 
+    def _wait_fresh_leases(self, root, margin_ms, replica_ids, deadline_s=10):
+        # Readiness probe: a manager flips using_root_fallback() after two
+        # FAILED region renewals, i.e. BEFORE any successful direct renewal
+        # has reached the root — by then its root lease (last fed by the dead
+        # region's digest) may already be expired. A quorum issued in that
+        # gap forms without the demoted member, and its lone late intent then
+        # parks behind the split-brain guard (1 participant <= half of 2
+        # healthy workers) for the full client timeout. Gate on the root
+        # actually holding a fresh lease for every member first.
+        deadline = time.monotonic() + deadline_s
+        while True:
+            lease = {
+                m["replica_id"]: m["lease_remaining_ms"]
+                for m in root.status_json()["members"]
+            }
+            if all(lease.get(rid, -1) >= margin_ms for rid in replica_ids):
+                return
+            assert time.monotonic() < deadline, lease
+            time.sleep(0.02)
+
     def test_managers_through_regions_with_failover(self):
         root = Lighthouse(min_replicas=1, join_timeout_ms=200)
         ra = RegionLighthouse(root.address(), "ra", digest_interval_ms=50)
         rb = RegionLighthouse(root.address(), "rb", digest_interval_ms=50)
         store = Store()
+        # lease_ttl must outlive the demotion gap: a region death costs two
+        # failed renewals (500 ms connect timeout each) before the manager
+        # falls back to direct root registration, and under full-suite CPU
+        # contention that gap stretches past 1.5 s — a 500 ms TTL then
+        # expires ONCE PER FAILOVER WINDOW (demotion + return = two quorum
+        # bumps), which is legitimate behavior but not what this test is
+        # probing for.
         mA = Manager(
             "repA", ra.address(), "localhost", "[::]:0", store.address(), 1,
             heartbeat_interval=timedelta(milliseconds=50),
             root_addr=root.address(),
-            lease_ttl=timedelta(milliseconds=500),
+            lease_ttl=timedelta(milliseconds=2500),
         )
         mB = Manager(
             "repB", rb.address(), "localhost", "[::]:0", store.address(), 1,
             heartbeat_interval=timedelta(milliseconds=50),
             root_addr=root.address(),
-            lease_ttl=timedelta(milliseconds=500),
+            lease_ttl=timedelta(milliseconds=2500),
         )
         cA, cB = ManagerClient(mA.address()), ManagerClient(mB.address())
         quorum_ids = []
@@ -501,6 +528,7 @@ class TestLiveHierarchy:
             while not mA.using_root_fallback():
                 assert time.monotonic() < deadline, "manager A never demoted"
                 time.sleep(0.05)
+            self._wait_fresh_leases(root, 250, ("repA", "repB"))
 
             r = self._both_quorum(cA, cB, step=2)
             assert r["A"].replica_world_size == 2
@@ -514,6 +542,7 @@ class TestLiveHierarchy:
             while mA.using_root_fallback():
                 assert time.monotonic() < deadline, "manager A never returned"
                 time.sleep(0.05)
+            self._wait_fresh_leases(root, 250, ("repA", "repB"))
 
             r = self._both_quorum(cA, cB, step=3)
             assert r["A"].replica_world_size == 2

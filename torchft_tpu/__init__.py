@@ -28,7 +28,12 @@ from torchft_tpu.collectives import (
     Work,
 )
 from torchft_tpu.data import DistributedSampler, StatefulDataLoader
-from torchft_tpu.durable import DurableCheckpointer
+from torchft_tpu.durable import (
+    CheckpointStore,
+    DurableCheckpointer,
+    LocalDirStore,
+    ManifestLog,
+)
 from torchft_tpu.isolated_xla import (
     ChildStalledError,
     IsolatedXLACollectives,
@@ -65,6 +70,9 @@ __all__ = [
     "DistributedSampler",
     "DummyCollectives",
     "DurableCheckpointer",
+    "CheckpointStore",
+    "LocalDirStore",
+    "ManifestLog",
     "LocalSGD",
     "HostCollectives",
     "IsolatedXLACollectives",

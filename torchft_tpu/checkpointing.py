@@ -317,18 +317,41 @@ class _StreamStaging:
     memoryview slices straight off the live host buffers: no per-request
     pickle, no concatenated serialized copy. ``wire="bf16"`` casts f32
     leaves INSIDE an ``opt_state`` subtree once at build (the only
-    copies the staging ever makes beyond non-contiguous inputs)."""
+    copies the staging ever makes beyond non-contiguous inputs).
+
+    ``shard_of=(rank, world)`` range-limits the capture: the layout
+    (offsets, skeleton, ``total``) is computed from shapes alone, then
+    only the byte span intersecting this member's ``total*rank//world
+    .. total*(rank+1)//world`` range is materialized — a straddling
+    leaf contributes just its in-range element slice (aligned to the
+    wire itemsize), never the whole array. A durable snapshot member
+    only ever writes its own ~1/W shard, so this caps the
+    trainer-visible capture cost at ~1/W of the packed stream instead
+    of all of it. The floor split MUST mirror ``durable.shard_bounds``;
+    range reads outside the captured span raise rather than ship
+    silent gaps."""
 
     def __init__(
-        self, state_dict: Any, wire: Optional[str], seq: int = 0
+        self,
+        state_dict: Any,
+        wire: Optional[str],
+        seq: int = 0,
+        snapshot: bool = False,
+        shard_of: Optional[Tuple[int, int]] = None,
+        pin_leaves: bool = False,
     ) -> None:
         import jax
 
         leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
             state_dict
         )
-        segments: List[memoryview] = []
-        starts: List[int] = []
+        # Pass 1 — layout only. Offsets, wire dtypes and the packed
+        # total follow from shapes, so the full skeleton exists before a
+        # single byte of array data is touched. ``None`` plan entries
+        # keep alignment with the skeleton's non-array leaves.
+        plan: List[
+            Optional[Tuple[Any, Any, np.dtype, np.dtype, int, int]]
+        ] = []
         skeleton_leaves: List[Any] = []
         offset = 0
         for path, leaf in leaves_with_path:
@@ -336,9 +359,9 @@ class _StreamStaging:
                 # scalars / strings / exotic leaves ride the skeleton
                 # pickle exactly as before
                 skeleton_leaves.append(leaf)
+                plan.append(None)
                 continue
-            arr = np.ascontiguousarray(np.asarray(leaf))
-            odtype = arr.dtype
+            odtype = np.dtype(leaf.dtype)
             if (
                 wire == "bf16"
                 and odtype == np.dtype(np.float32)
@@ -346,25 +369,132 @@ class _StreamStaging:
             ):
                 import ml_dtypes
 
-                arr = arr.astype(np.dtype(ml_dtypes.bfloat16))
+                wdtype = np.dtype(ml_dtypes.bfloat16)
+            else:
+                wdtype = odtype
+            shape = tuple(leaf.shape)
+            nbytes = int(np.prod(shape, dtype=np.int64)) * wdtype.itemsize
+            skeleton_leaves.append(
+                _ArraySlot(
+                    shape=shape,
+                    dtype=odtype.name,
+                    wire_dtype=wdtype.name,
+                    offset=offset,
+                    nbytes=nbytes,
+                )
+            )
+            plan.append((path, leaf, odtype, wdtype, offset, nbytes))
+            offset += nbytes
+        self.total = offset
+        if shard_of is not None:
+            rank, world = shard_of
+            begin = offset * rank // world
+            end = offset * (rank + 1) // world
+        else:
+            begin, end = 0, offset
+        self._range = (begin, end)
+        if snapshot:
+            # Snapshot capture: dispatch every in-range leaf's d2h
+            # before materializing any of them, so the transfers overlap
+            # each other instead of serializing leaf by leaf — this is
+            # the whole trainer stall of an async durable snapshot.
+            for ent in plan:
+                if ent is None:
+                    continue
+                _, leaf, _, _, off, nbytes = ent
+                if off < end and off + nbytes > begin and _is_jax_leaf(
+                    leaf
+                ):
+                    try:
+                        leaf.copy_to_host_async()
+                    except AttributeError:
+                        pass
+        # entries: materialized memoryview, or a deferred-cast
+        # ``(f32_slice_view, wire_dtype)`` pair resolved by _seg()
+        segments: List[Any] = []
+        starts: List[int] = []
+        captured = 0
+        # ``pin_leaves``: instead of an owning host copy, an uncompressed
+        # jax leaf is captured as a zero-copy view with the immutable
+        # Array itself pinned here — the XLA buffer cannot be freed while
+        # the staging lives. ONLY sound when the trainer never donates
+        # these buffers to a jit (donation reuses the device allocation
+        # under the view); numpy leaves are mutable in place and always
+        # get the owning copy regardless.
+        self._pins: List[Any] = []
+        for ent in plan:
+            if ent is None:
+                continue
+            path, leaf, odtype, wdtype, off, nbytes = ent
+            if off >= end or off + nbytes <= begin:
+                # outside this member's shard: layout only, no copy
+                continue
+            # Leaf-local byte span this shard needs, aligned outward to
+            # whole wire elements (a floor-split boundary can land
+            # mid-element; the overlapping element is captured by both
+            # neighbours, and write_range slices it back to the exact
+            # byte). Only the in-range element slice is ever
+            # materialized — the straddled remainder of a huge leaf is
+            # a peer's duty, not this member's stall.
+            ws = wdtype.itemsize
+            lo = (max(begin, off) - off) // ws * ws
+            hi = min(
+                nbytes, -(-(min(end, off + nbytes) - off) // ws) * ws
+            )
+            sub = np.ascontiguousarray(np.asarray(leaf)).reshape(-1)[
+                lo // ws: hi // ws
+            ]
+            if wdtype != odtype:
+                if snapshot and pin_leaves and _is_jax_leaf(leaf):
+                    # Deferred wire downcast: the pin keeps the
+                    # immutable f32 leaf alive, so the astype (the
+                    # compression itself) runs on the WRITER thread at
+                    # first segment access — off the trainer stall
+                    # entirely.
+                    self._pins.append(leaf)
+                    segments.append((sub, wdtype))
+                    starts.append(off + lo)
+                    captured += hi - lo
+                    continue
+                arr = sub.astype(wdtype)  # the cast owns its bytes
+            elif not snapshot:
+                # live heal staging: views of the trainer's buffers are
+                # fine, the trainer blocks while ranges are read
+                arr = np.ascontiguousarray(sub)
+            elif pin_leaves and _is_jax_leaf(leaf):
+                # zero-copy capture: the pinned immutable Array backs
+                # the view for the staging's whole lifetime
+                self._pins.append(leaf)
+                arr = sub
+            elif isinstance(leaf, np.ndarray) and not np.may_share_memory(
+                sub, leaf
+            ):
+                arr = sub  # ascontiguousarray above already copied
+            else:
+                # Donation/aliasing guard: a SNAPSHOT staging outlives
+                # the commit boundary — the background writer reads it
+                # while the trainer runs steps N+1..N+k. Every captured
+                # slice must own its bytes: a numpy leaf the trainer
+                # mutates in place, or a jax leaf whose ``__array__``
+                # aliased the device buffer (CPU backend zero-copy /
+                # cached npy value) that a later donated jit overwrites,
+                # would otherwise leak step-N+1 tensors into the step-N
+                # snapshot.
+                arr = sub.copy()
+            if arr.nbytes != hi - lo:
+                raise AssertionError(
+                    f"packed layout drift: leaf materialized to "
+                    f"{arr.nbytes} bytes, layout planned {hi - lo}"
+                )
             # byte view (not a copy): numpy refuses buffer-protocol
             # export of non-native dtypes (ml_dtypes bfloat16), so go
             # through a uint8 reinterpret first
             segments.append(
                 memoryview(arr.reshape(-1).view(np.uint8)).cast("B")
             )
-            starts.append(offset)
-            skeleton_leaves.append(
-                _ArraySlot(
-                    shape=tuple(arr.shape),
-                    dtype=odtype.name,
-                    wire_dtype=arr.dtype.name,
-                    offset=offset,
-                    nbytes=arr.nbytes,
-                )
-            )
-            offset += arr.nbytes
-        self.total = offset
+            starts.append(off + lo)
+            captured += hi - lo
+        self.captured_bytes = captured
         self._segments = segments
         self._starts = starts
         skeleton = jax.tree_util.tree_unflatten(treedef, skeleton_leaves)
@@ -382,6 +512,26 @@ class _StreamStaging:
         )
         self.meta = buf.getvalue()
 
+    def _seg(self, i: int) -> memoryview:
+        """Segment ``i`` as a byte view, resolving a deferred wire cast
+        on first access (writer-thread side of the zero-copy capture;
+        cached so crc + write cast once)."""
+        seg = self._segments[i]
+        if not isinstance(seg, memoryview):
+            sub, wdtype = seg
+            arr = sub.astype(wdtype)
+            seg = memoryview(arr.reshape(-1).view(np.uint8)).cast("B")
+            self._segments[i] = seg
+        return seg
+
+    def _check_range(self, begin: int, end: int) -> None:
+        cb, ce = self._range
+        if begin < cb or end > ce:
+            raise ValueError(
+                f"range [{begin}, {end}) outside captured span "
+                f"[{cb}, {ce}) of a shard-limited staging"
+            )
+
     def write_range(self, wfile: Any, begin: int, end: int) -> None:
         """Streams bytes [begin, end) of the packed layout into ``wfile``
         as zero-copy slices of the staged buffers."""
@@ -389,10 +539,11 @@ class _StreamStaging:
 
         if begin >= end:
             return
+        self._check_range(begin, end)
         i = bisect.bisect_right(self._starts, begin) - 1
         pos = begin
         while pos < end and i < len(self._segments):
-            seg = self._segments[i]
+            seg = self._seg(i)
             seg_start = self._starts[i]
             lo = pos - seg_start
             hi = min(len(seg), end - seg_start)
@@ -412,11 +563,12 @@ class _StreamStaging:
 
         if begin >= end:
             return _crc32c(b"")
+        self._check_range(begin, end)
         i = bisect.bisect_right(self._starts, begin) - 1
         pos = begin
         parts: List[memoryview] = []
         while pos < end and i < len(self._segments):
-            seg = self._segments[i]
+            seg = self._seg(i)
             seg_start = self._starts[i]
             lo = pos - seg_start
             hi = min(len(seg), end - seg_start)
@@ -432,6 +584,65 @@ def _is_jax_leaf(leaf: Any) -> bool:
 
     jax = sys.modules.get("jax")
     return jax is not None and isinstance(leaf, jax.Array)
+
+
+def load_packed_meta(raw: bytes) -> Dict[str, Any]:
+    """Safelisted unpickle of a packed-stream meta blob (the
+    :class:`_StreamStaging` ``meta`` bytes): layout skeleton and wire
+    parameters, never arbitrary code (same ``_SafeUnpickler`` the heal
+    receiver applies to donor metadata)."""
+    meta = _SafeUnpickler(io.BytesIO(raw)).load()
+    if not isinstance(meta, dict) or "skeleton" not in meta:
+        raise ValueError("packed meta blob missing skeleton")
+    return meta
+
+
+def rebuild_from_packed(
+    meta: Dict[str, Any], buf: Any, *, device_put: bool = False
+) -> Any:
+    """Reconstruct a state tree from a packed byte buffer laid out by
+    :class:`_StreamStaging` — the streamed-heal walker without the wire.
+    ``buf`` must hold all ``meta['total']`` bytes (a durable snapshot
+    reassembled from its shard files, or one donor range already
+    verified). Wire-downcast leaves (bf16 opt-state) are cast back to
+    their original dtype; with ``device_put`` each rebuilt leaf
+    dispatches its async upload and the call blocks only on the residual
+    drain."""
+    import jax
+
+    total = int(meta["total"])
+    if len(buf) < total:
+        raise ValueError(
+            f"packed buffer holds {len(buf)} bytes, layout needs {total}"
+        )
+    slots, treedef = jax.tree_util.tree_flatten(meta["skeleton"])
+    out_leaves: List[Any] = []
+    device_leaves: List[Any] = []
+    for slot in slots:
+        if not isinstance(slot, _ArraySlot):
+            out_leaves.append(slot)
+            continue
+        wdtype = _dtype_by_name(slot.wire_dtype)
+        arr = np.frombuffer(
+            buf,
+            dtype=wdtype,
+            count=slot.nbytes // wdtype.itemsize,
+            offset=slot.offset,
+        ).reshape(slot.shape)
+        odtype = _dtype_by_name(slot.dtype)
+        if wdtype != odtype:
+            arr = arr.astype(odtype)
+        if device_put and jax.dtypes.canonicalize_dtype(odtype) == odtype:
+            import jax.numpy as jnp
+
+            leaf: Any = jnp.asarray(arr)
+            device_leaves.append(leaf)
+        else:
+            leaf = arr
+        out_leaves.append(leaf)
+    if device_leaves:
+        jax.block_until_ready(device_leaves)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 
 class _TimedAcquire:

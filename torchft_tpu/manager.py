@@ -221,6 +221,7 @@ class Manager:
         self._force_reconfigure = False
         self._healing = False
         self._pending_work: List[Work] = []
+        self._commit_hooks: List[Any] = []
         self._pending_state_dict: Optional[Dict[str, object]] = None
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
@@ -1089,6 +1090,16 @@ class Manager:
         if self._errored is not None:
             self._metrics.incr("errors")
         self._healing = False
+        # Commit boundary: the quorum thread is settled (wait_quorum above)
+        # and the vote is final, so (step, quorum_id) here names exactly
+        # one committed fleet state — the only point where a durable
+        # snapshot may capture. Hooks are observers: a failing snapshot
+        # must never abort training, so exceptions are logged and dropped.
+        for hook in self._commit_hooks:
+            try:
+                hook(self._step, self._quorum_id, should_commit)
+            except Exception as e:  # noqa: BLE001
+                self._logger.warn(f"commit hook failed: {e}")
         return should_commit
 
     # -- state --
@@ -1254,6 +1265,23 @@ class Manager:
     def current_step(self) -> int:
         """Committed step count; skipped steps don't increment it."""
         return self._step
+
+    def replica_id(self) -> str:
+        """This group's replica id (stable across restarts when the
+        launcher pins it — what the durable tier keys per-member local
+        state, e.g. the dataloader position, on)."""
+        return self._replica_id
+
+    def add_commit_hook(self, hook: Any) -> None:
+        """Registers ``hook(step, quorum_id, committed)`` to fire at every
+        ``should_commit`` resolution, after the vote settled (and after
+        the step counter advanced on a commit). This is the durable
+        tier's capture point: the hook runs on the trainer thread with
+        the state dict quiescent — the optimizer has not yet mutated the
+        next step — so a snapshot captured here is provably step-pure.
+        Hooks must not raise; exceptions are swallowed and logged (a
+        failing snapshot never aborts training)."""
+        self._commit_hooks.append(hook)
 
     def batches_committed(self) -> int:
         """Total batches committed across all replicas and steps."""
