@@ -1,0 +1,492 @@
+"""Weight-distribution serving-plane benchmark (PS_BENCH.json).
+
+Puts numbers on the serving tier's three perf claims (serving.py):
+
+  wire efficiency   per-subscriber bytes are proportional to the WIRE
+                    size, not the f32 size — measured from real
+                    subscriber fetch counters: q8 <= 0.30x and
+                    bf16 <= 0.55x of the f32 bytes for the same tree.
+  fan-out scaling   publish cost is amortized once per version: with a
+                    two-tier relay chain, the ROOT's payload egress per
+                    version is identical at 50 and at 200+ subscribers
+                    (bytes move out of the root once per child, never
+                    per subscriber), while the p99 publish->install
+                    version lag across the whole fleet stays bounded.
+  fault recovery    a late/paused subscriber catches up via DELTAS (not
+                    a full snapshot), and a publisher SIGKILLed MID-range
+                    (drip-throttled bodies guarantee the kill lands
+                    inside a transfer) then respawned leaves every
+                    downstream install intact: zero torn installs,
+                    detections counted.
+
+Topology (all on this host, CPU JAX): one publisher, relay tier 1 (one
+relay), relay tier 2 (two relays), subscribers split across tier 2.
+Subscribers are real WeightSubscriber sessions driven round-robin by a
+small worker pool — "simulated" in the sense that they share threads,
+not sockets; every fetch is a real HTTP range read with the full
+integrity ladder.
+
+``--dryrun`` is the CI smoke: seconds-scale, asserts at least one
+delta-catch-up record and one publisher-kill-mid-range recovery record,
+writes no artifact. The full run stamps PS_BENCH.json with
+``chaos.bench_fault_stamp`` so a bench-observed anomaly replays via
+``scripts/chaos_run.py --config serving_churn``.
+
+Usage::
+
+    python bench_ps.py                  # full sweep -> PS_BENCH.json
+    python bench_ps.py --dryrun         # CI smoke, no artifact
+    python bench_ps.py --subscribers 400
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from torchft_tpu import chaos  # noqa: E402
+from torchft_tpu.serving import (  # noqa: E402
+    WeightPublisher,
+    WeightRelay,
+    WeightSubscriber,
+    demo_params,
+    tree_digest,
+)
+
+
+def _pct(values: List[float], q: float) -> float:
+    if not values:
+        return float("nan")
+    return float(np.percentile(np.asarray(values, dtype=np.float64), q))
+
+
+# --------------------------------------------------------------------------
+# phase 1: wire efficiency (measured from subscriber fetch counters)
+# --------------------------------------------------------------------------
+
+
+def bench_wire_bytes(leaves: int, elems: int, versions: int) -> Dict[str, Any]:
+    """Per-subscriber bytes by wire, measured end to end: one subscriber
+    follows ``versions`` publishes (snapshot + deltas) and its
+    ``bytes_fetched`` counter IS the per-subscriber cost."""
+    out: Dict[str, Any] = {"leaves": leaves, "elems": elems,
+                           "versions": versions}
+    f32_nbytes = leaves * elems * 4
+    measured: Dict[str, int] = {}
+    for wire in ("f32", "bf16", "q8"):
+        pub = WeightPublisher(wire=wire, snapshot_every=versions + 1)
+        try:
+            sub = WeightSubscriber(
+                pub.server.local_address(), name=f"wire-{wire}"
+            )
+            t0 = time.monotonic()
+            for v in range(versions):
+                pub.publish(demo_params(3, leaves, elems, v), step=v)
+                assert sub.poll() is True
+            wall = time.monotonic() - t0
+            assert sub.version() == versions - 1
+            assert sub.stats["torn_installs"] == 0
+            measured[wire] = sub.stats["bytes_fetched"]
+            out[wire] = {
+                "bytes_fetched": sub.stats["bytes_fetched"],
+                "bytes_per_version": sub.stats["bytes_fetched"] // versions,
+                "installs": sub.stats["installs"],
+                "wall_s": round(wall, 3),
+            }
+            sub.close()
+        finally:
+            pub.shutdown()
+    out["f32_nbytes_per_version"] = f32_nbytes
+    out["q8_ratio_vs_f32"] = round(measured["q8"] / measured["f32"], 4)
+    out["bf16_ratio_vs_f32"] = round(measured["bf16"] / measured["f32"], 4)
+    # the tentpole's measured wire targets
+    assert out["q8_ratio_vs_f32"] <= 0.30, out
+    assert out["bf16_ratio_vs_f32"] <= 0.55, out
+    return out
+
+
+# --------------------------------------------------------------------------
+# phase 2: fan-out scaling (root egress flat, p99 lag bounded)
+# --------------------------------------------------------------------------
+
+
+def bench_fanout(
+    n_subscribers: int,
+    versions: int,
+    leaves: int,
+    elems: int,
+    publish_every_ms: int,
+    pool_workers: int = 8,
+) -> Dict[str, Any]:
+    """``n_subscribers`` real subscriber sessions behind a two-tier relay
+    chain, a worker pool driving their polls; measures the publish ->
+    install lag distribution fleet-wide and the ROOT's payload egress per
+    version."""
+    pub = WeightPublisher(wire="q8", snapshot_every=4)
+    r1 = WeightRelay(pub.server.local_address(), name="fan-r1",
+                     poll_timeout_ms=200).start()
+    tier2 = [
+        WeightRelay(r1.server.local_address(), name=f"fan-r2{i}",
+                    poll_timeout_ms=200).start()
+        for i in range(2)
+    ]
+    subs = [
+        WeightSubscriber(
+            tier2[i % len(tier2)].server.local_address(),
+            name=f"fan-s{i}",
+            lease_ttl_ms=30_000,
+        )
+        for i in range(n_subscribers)
+    ]
+    publish_mono: Dict[int, float] = {}
+    install_lags_ms: List[float] = []
+    lag_lock = threading.Lock()
+    stop = threading.Event()
+
+    def drive(shard: List[WeightSubscriber]) -> None:
+        while not stop.is_set():
+            idle = True
+            for s in shard:
+                before = s.version()
+                if s.poll() and not stop.is_set():
+                    idle = False
+                    now = time.monotonic()
+                    after = s.version()
+                    with lag_lock:
+                        for v in range(before + 1, after + 1):
+                            if v in publish_mono:
+                                install_lags_ms.append(
+                                    (now - publish_mono[v]) * 1000.0
+                                )
+            if idle:
+                stop.wait(0.05)
+
+    shards = [subs[i::pool_workers] for i in range(pool_workers)]
+    threads = [
+        threading.Thread(target=drive, args=(sh,), daemon=True)
+        for sh in shards if sh
+    ]
+    try:
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        root0 = dict(pub.node.counters)
+        for v in range(versions):
+            with lag_lock:
+                publish_mono[v] = time.monotonic()
+            pub.publish(demo_params(3, leaves, elems, v), step=v)
+            time.sleep(publish_every_ms / 1000.0)
+        # drain: every subscriber reaches the last version
+        deadline = time.monotonic() + 120.0
+        last = versions - 1
+        while time.monotonic() < deadline:
+            if all(s.version() == last for s in subs):
+                break
+            time.sleep(0.1)
+        assert all(s.version() == last for s in subs), (
+            f"fleet never converged to v{last} "
+            f"(behind={sum(1 for s in subs if s.version() < last)})"
+        )
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        root1 = dict(pub.node.counters)
+        want = pub.node.store.get(last).manifest["digest"]
+        sample = subs[:: max(1, n_subscribers // 16)]
+        for s in sample:
+            assert tree_digest(s.current()[1]) == want
+        torn = sum(s.stats["torn_installs"] for s in subs)
+        assert torn == 0, f"{torn} torn installs"
+        wall = time.monotonic() - t0
+        per_sub_bytes = [s.stats["bytes_fetched"] for s in subs]
+        return {
+            "subscribers": n_subscribers,
+            "versions": versions,
+            "relay_tiers": 2,
+            "pool_workers": pool_workers,
+            "wall_s": round(wall, 3),
+            "lag_ms": {
+                "n": len(install_lags_ms),
+                "p50": round(_pct(install_lags_ms, 50), 1),
+                "p95": round(_pct(install_lags_ms, 95), 1),
+                "p99": round(_pct(install_lags_ms, 99), 1),
+                "max": round(max(install_lags_ms), 1)
+                if install_lags_ms else float("nan"),
+            },
+            "root": {
+                "ranges_served_per_version": (
+                    (root1["ranges_served"] - root0["ranges_served"])
+                    / versions
+                ),
+                "meta_served_per_version": (
+                    (root1["meta_served"] - root0["meta_served"]) / versions
+                ),
+                "payload_egress_bytes": (
+                    root1["egress_bytes"] - root0["egress_bytes"]
+                ),
+            },
+            "per_subscriber_bytes": {
+                "p50": int(_pct([float(b) for b in per_sub_bytes], 50)),
+                "max": max(per_sub_bytes),
+            },
+            "torn_installs": 0,
+        }
+    finally:
+        stop.set()
+        for s in subs:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+        for r in tier2:
+            r.shutdown()
+        r1.shutdown()
+        pub.shutdown()
+
+
+# --------------------------------------------------------------------------
+# phase 3: fault-path records (the dryrun's asserted evidence)
+# --------------------------------------------------------------------------
+
+
+def bench_delta_catch_up(versions: int = 8) -> Dict[str, Any]:
+    """A subscriber that pauses, misses several publishes, then catches
+    up: the catch-up must ride DELTAS (cheap) whenever the chain is
+    held, not re-fetch a snapshot."""
+    pub = WeightPublisher(wire="q8", snapshot_every=64)
+    try:
+        sub = WeightSubscriber(pub.server.local_address(), name="cu")
+        pub.publish(demo_params(5, 2, 8192, 0), step=0)
+        assert sub.poll() is True
+        # the pause: publisher moves on without us
+        for v in range(1, versions):
+            pub.publish(demo_params(5, 2, 8192, v), step=v)
+        t0 = time.monotonic()
+        assert sub.poll() is True
+        catch_up_s = time.monotonic() - t0
+        assert sub.version() == versions - 1
+        assert sub.stats["catch_up_deltas"] >= versions - 1
+        assert sub.stats["snapshot_installs"] == 1  # only the initial one
+        assert tree_digest(sub.current()[1]) == (
+            pub.node.store.get(versions - 1).manifest["digest"]
+        )
+        rec = {
+            "type": "delta_catch_up",
+            "missed_versions": versions - 1,
+            "catch_up_deltas": sub.stats["catch_up_deltas"],
+            "snapshot_refetches": 0,
+            "catch_up_s": round(catch_up_s, 3),
+            "bytes_fetched": sub.stats["bytes_fetched"],
+            "bit_identity_ok": True,
+        }
+        sub.close()
+        return rec
+    finally:
+        pub.shutdown()
+
+
+def bench_kill_mid_range(seed: int = 4242) -> Dict[str, Any]:
+    """Publisher SIGKILL mid-range (drip-throttled subprocess), respawn
+    on the same port, downstream recovery: the relay's in-flight fetch
+    dies as a SHORT body (counted), the subscriber never sees a torn
+    tree, and the fleet converges on the respawned history."""
+    from torchft_tpu.chaos import PublisherProcess, free_port
+    from torchft_tpu.serving import _http_json
+
+    pub = PublisherProcess(
+        free_port(), wire="q8", leaves=4, elems=65536, seed=seed,
+        publish_every_ms=150, snapshot_every=4, drip_ms=15,
+    )
+    relay = None
+    sub = None
+    try:
+        pub.wait_serving(min_version=1)
+        relay = WeightRelay(pub.address(), name="kill-r",
+                            poll_timeout_ms=200).start()
+        sub = WeightSubscriber(
+            relay.server.local_address(), name="kill-s"
+        ).start(poll_ms=100)
+        deadline = time.monotonic() + 30.0
+        while sub.version() < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sub.version() >= 1, "subscriber never started installing"
+        v_before = sub.version()
+        t_kill = time.monotonic()
+        pub.kill()
+        time.sleep(0.4)  # short bodies land at the relay
+        pub.restart()
+        pub.wait_serving(min_version=1)
+        # recovery: the subscriber converges onto the NEW history
+        deadline = time.monotonic() + 60.0
+        recovered_v = -1
+        while time.monotonic() < deadline:
+            v = sub.version()
+            listing = _http_json(f"{pub.address()}/ps/versions", 5.0)
+            manifests = {
+                int(m["version"]): m for m in listing.get("versions", [])
+            }
+            if v in manifests and tree_digest(sub.current()[1]) == (
+                manifests[v]["digest"]
+            ):
+                recovered_v = v
+                break
+            time.sleep(0.1)
+        recovery_s = time.monotonic() - t_kill
+        assert recovered_v >= 0, "subscriber never recovered post-kill"
+        assert sub.stats["torn_installs"] == 0
+        detections = {
+            k: v for k, v in sub.stats.items()
+            if k.startswith("detect_") and v
+        }
+        relay_errors = relay.node.counters["upstream_errors"]
+        assert relay_errors > 0 or detections, (
+            "kill produced no counted detection anywhere downstream"
+        )
+        return {
+            "type": "kill_mid_range_recovery",
+            "drip_ms": 15,
+            "version_at_kill": v_before,
+            "recovered_version": recovered_v,
+            "recovery_s": round(recovery_s, 3),
+            "relay_upstream_errors": relay_errors,
+            "subscriber_detections": detections,
+            "torn_installs": 0,
+            "bit_identity_ok": True,
+        }
+    finally:
+        if sub is not None:
+            sub.close()
+        if relay is not None:
+            relay.shutdown()
+        pub.stop()
+
+
+# --------------------------------------------------------------------------
+# entry point
+# --------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dryrun", action="store_true",
+                        help="seconds-scale CI smoke; no artifact")
+    parser.add_argument("--subscribers", type=int, default=200,
+                        help="fleet size for the big fan-out point")
+    parser.add_argument("--versions", type=int, default=8)
+    parser.add_argument("--leaves", type=int, default=2)
+    parser.add_argument("--elems", type=int, default=8192)
+    parser.add_argument("--publish-every-ms", type=int, default=400)
+    parser.add_argument("--out", default=os.path.join(REPO, "PS_BENCH.json"))
+    args = parser.parse_args(argv)
+
+    t0 = time.monotonic()
+    records: List[Dict[str, Any]] = []
+
+    wire = bench_wire_bytes(
+        leaves=4, elems=4096 if args.dryrun else 65536,
+        versions=3 if args.dryrun else 6,
+    )
+    print(f"[ps] wire bytes: q8={wire['q8_ratio_vs_f32']}x "
+          f"bf16={wire['bf16_ratio_vs_f32']}x of f32", flush=True)
+
+    fan_points: List[Dict[str, Any]] = []
+    sizes = [24] if args.dryrun else [50, args.subscribers]
+    for n in sizes:
+        point = bench_fanout(
+            n_subscribers=n,
+            versions=3 if args.dryrun else args.versions,
+            leaves=args.leaves,
+            elems=args.elems,
+            publish_every_ms=200 if args.dryrun else args.publish_every_ms,
+        )
+        fan_points.append(point)
+        print(
+            f"[ps] fanout n={n}: p99 lag {point['lag_ms']['p99']}ms, "
+            f"root {point['root']['ranges_served_per_version']} "
+            f"ranges/version", flush=True,
+        )
+    if len(fan_points) == 2:
+        # THE fan-out claim: scaling subscribers 4x moves zero extra
+        # payload out of the root.
+        a, b = fan_points
+        assert a["root"]["ranges_served_per_version"] == (
+            b["root"]["ranges_served_per_version"]
+        ), (a["root"], b["root"])
+        assert a["root"]["meta_served_per_version"] == (
+            b["root"]["meta_served_per_version"]
+        ), (a["root"], b["root"])
+
+    catch_up = bench_delta_catch_up(versions=4 if args.dryrun else 8)
+    records.append(catch_up)
+    print(f"[ps] delta catch-up: {catch_up['catch_up_deltas']} deltas in "
+          f"{catch_up['catch_up_s']}s", flush=True)
+
+    kill = bench_kill_mid_range()
+    records.append(kill)
+    print(f"[ps] kill mid-range: recovered v{kill['recovered_version']} "
+          f"in {kill['recovery_s']}s, "
+          f"relay errors={kill['relay_upstream_errors']}", flush=True)
+
+    # the dryrun's contract: both fault-path records present and clean
+    assert any(
+        r["type"] == "delta_catch_up" and r["catch_up_deltas"] >= 1
+        for r in records
+    ), "no delta-catch-up record was produced"
+    assert any(
+        r["type"] == "kill_mid_range_recovery"
+        and r["torn_installs"] == 0
+        and r["bit_identity_ok"]
+        for r in records
+    ), "no publisher-kill-mid-range recovery record was produced"
+
+    if args.dryrun:
+        print(json.dumps({
+            "dryrun": True,
+            "q8_ratio_vs_f32": wire["q8_ratio_vs_f32"],
+            "bf16_ratio_vs_f32": wire["bf16_ratio_vs_f32"],
+            "fanout_points": len(fan_points),
+            "delta_catch_up_records": 1,
+            "kill_recovery_records": 1,
+        }))
+        print("ps bench dryrun OK (no artifact written)")
+        return 0
+
+    artifact = {
+        "phase": "serving",
+        "host": {"cpus": os.cpu_count()},
+        "wall_s": round(time.monotonic() - t0, 1),
+        "config": {
+            "leaves": args.leaves,
+            "elems": args.elems,
+            "publish_every_ms": args.publish_every_ms,
+            "relay_tiers": 2,
+        },
+        "wire_bytes": wire,
+        "fanout": fan_points,
+        "fault_records": records,
+        "fault_plan": chaos.bench_fault_stamp(
+            kill_drip_ms=15,
+            kill_config="serving_churn",
+        ),
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

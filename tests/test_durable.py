@@ -721,3 +721,25 @@ def test_marker_consistency_rejected(tmp_path):
     cp.close()
     assert cp.snapshots[0]["aborted"]
     assert cp.committed_steps() == []
+
+
+def test_ctor_registers_durable_restore(tmp_path):
+    # Constructing the checkpointer wires the manager's cold-start
+    # fallback (restore-time donor/durable arbitration) — and managers
+    # without the hook (this file's _FakeManager) keep working.
+    class _Registering(_FakeManager):
+        def __init__(self):
+            super().__init__(0, 1, "rep0")
+            self.registered = None
+
+        def set_durable_restore(self, fn):
+            self.registered = fn
+
+    mgr = _Registering()
+    cp = DurableCheckpointer(str(tmp_path), mgr, _RepState(0))
+    assert mgr.registered == cp.restore_latest
+    cp.close()
+
+    plain = _FakeManager(0, 1, "rep1")
+    cp2 = DurableCheckpointer(str(tmp_path), plain, _RepState(0))
+    cp2.close()

@@ -772,3 +772,105 @@ class TestPolicySignals:
         m, _, _, _ = _create_manager(store)
         m.push_status({"policy": "ddp"})  # must not raise
         m.shutdown()
+
+
+class TestDurableArbitration:
+    """Restore-time donor/durable arbitration: start_quorum consults the
+    durable tier's restore_latest exactly once, and only on a cold fleet
+    (no live donor, nothing restored locally)."""
+
+    def _restore_fn(self, m, step):
+        calls = []
+
+        def restore():
+            calls.append(1)
+            m.load_state_dict({"step": step, "batches_committed": step * 2})
+            return step
+
+        return restore, calls
+
+    def test_durable_only_cold_fleet_restores(self, store):
+        m, client, _, _ = _create_manager(store)
+        restore, calls = self._restore_fn(m, 7)
+        m.set_durable_restore(restore)
+        client.quorum.return_value = _quorum_result(max_step=0)
+        client.should_commit.return_value = True
+        m.start_quorum()
+        m.wait_quorum()
+        assert calls == [1]
+        assert m.current_step() == 7
+        assert m.batches_committed() == 14
+        # one-shot: the next quorum never re-consults
+        m.start_quorum()
+        m.wait_quorum()
+        assert calls == [1]
+        m.shutdown()
+
+    def test_donor_beats_durable(self, store):
+        # A live donor (max_step > 0) wins: the durable fallback is
+        # never invoked; the normal heal path owns recovery.
+        m, client, _, _ = _create_manager(store)
+        restore, calls = self._restore_fn(m, 7)
+        m.set_durable_restore(restore)
+        client.quorum.return_value = _quorum_result(max_step=5)
+        m.start_quorum()
+        m.wait_quorum()
+        assert calls == []
+        assert m.current_step() == 0  # donor state arrives via heal, not here
+        m.shutdown()
+
+    def test_trainer_restore_first_disarms(self, store):
+        # The pre-arbitration idiom — trainer calls restore_latest()
+        # before the first quorum — must keep working: a nonzero local
+        # step disarms the consult even when the quorum sees max_step 0.
+        m, client, _, _ = _create_manager(store)
+        restore, calls = self._restore_fn(m, 7)
+        m.set_durable_restore(restore)
+        m.load_state_dict({"step": 3, "batches_committed": 6})
+        client.quorum.return_value = _quorum_result(max_step=0)
+        m.start_quorum()
+        m.wait_quorum()
+        assert calls == []
+        assert m.current_step() == 3
+        m.shutdown()
+
+    def test_restore_none_trains_from_scratch(self, store):
+        # Empty durable store: the consult happens, returns None, and
+        # training starts cold at step 0.
+        m, client, _, _ = _create_manager(store)
+        calls = []
+
+        def restore():
+            calls.append(1)
+            return None
+
+        m.set_durable_restore(restore)
+        client.quorum.return_value = _quorum_result(max_step=0)
+        m.start_quorum()
+        m.wait_quorum()
+        assert calls == [1]
+        assert m.current_step() == 0
+        m.shutdown()
+
+    def test_ctor_arg_registers(self, store):
+        import torchft_tpu.manager as manager_mod
+        from torchft_tpu.collectives import DummyCollectives
+
+        calls = []
+        m = Manager(
+            collectives=DummyCollectives(),
+            load_state_dict=None,
+            state_dict=None,
+            min_replica_size=2,
+            rank=1,
+            world_size=2,
+            store_addr=store.address(),
+            checkpoint_transport=MagicMock(metadata=MagicMock(return_value="x")),
+            durable_restore=lambda: calls.append(1) or None,
+        )
+        client = manager_mod.ManagerClient.return_value
+        client.quorum.return_value = _quorum_result(max_step=0)
+        m.start_quorum()
+        m.wait_quorum()
+        assert calls == [1]
+        m.shutdown()

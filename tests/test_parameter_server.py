@@ -1,6 +1,7 @@
 """Parameter server end-to-end in one process.
 Mirrors reference parameter_server_test.py:33-47."""
 
+import threading
 from datetime import timedelta
 
 import numpy as np
@@ -50,5 +51,220 @@ def test_multiple_sessions():
             # finish the session protocol so the server thread completes
             client.allreduce({"w": np.zeros(4, np.float32)}, ReduceOp.AVG).wait()
             client.shutdown()
+    finally:
+        server.shutdown()
+
+# -- addressing (TORCHFT_PS_HOST) --------------------------------------------
+
+
+def test_address_honors_env_host(monkeypatch):
+    monkeypatch.setenv("TORCHFT_PS_HOST", "ps.example.internal")
+    server = EchoAverageServer()
+    try:
+        addr = server.address()
+        assert addr.startswith("http://ps.example.internal:")
+        assert addr.endswith("/new_session")
+        assert server.serving_address().startswith(
+            "http://ps.example.internal:"
+        )
+    finally:
+        server.shutdown()
+
+
+def test_address_falls_back_to_hostname(monkeypatch):
+    import socket
+
+    monkeypatch.delenv("TORCHFT_PS_HOST", raising=False)
+    server = EchoAverageServer()
+    try:
+        assert server.address() == (
+            f"http://{socket.gethostname()}:"
+            f"{server.publisher.server.port}/new_session"
+        )
+    finally:
+        server.shutdown()
+
+
+def test_address_brackets_ipv6_literal(monkeypatch):
+    monkeypatch.setenv("TORCHFT_PS_HOST", "fd00::1234")
+    server = EchoAverageServer()
+    try:
+        assert server.address().startswith("http://[fd00::1234]:")
+    finally:
+        server.shutdown()
+
+
+def test_listener_is_dual_stack_ipv6():
+    import socket
+
+    server = EchoAverageServer()
+    try:
+        assert (
+            server.publisher.server._server.address_family
+            == socket.AF_INET6
+        )
+    finally:
+        server.shutdown()
+
+
+# -- session lifecycle -------------------------------------------------------
+
+
+class RecordingServer(ParameterServer):
+    """Tracks every collectives it hands to sessions so tests can assert
+    they were freed; ``fail_first`` makes the first forward() raise
+    mid-session."""
+
+    # Recording is routed through a thread-local sink: each handler
+    # thread tags itself in _handle_session, so overlapping sessions
+    # (and the client-side new_collectives calls on the test thread)
+    # never clobber each other the way a temporary classmethod swap
+    # would.
+    _local = threading.local()
+
+    def __init__(self, fail_first: bool = False) -> None:
+        self.handed_out = []
+        self.fail_first = fail_first
+        self._sessions = 0
+        super().__init__()
+
+    @classmethod
+    def new_collectives(cls) -> Collectives:
+        c = HostCollectives(timeout=timedelta(seconds=10))
+        sink = getattr(cls._local, "sink", None)
+        if sink is not None:
+            sink.append(c)
+        return c
+
+    def _handle_session(self, session_id, store_addr):
+        type(self)._local.sink = self.handed_out
+        try:
+            super()._handle_session(session_id, store_addr)
+        finally:
+            type(self)._local.sink = None
+
+    def forward(self, session_id, collectives):
+        self._sessions += 1
+        if self.fail_first and self._sessions == 1:
+            collectives.allreduce(
+                {"w": np.full(4, 2.0, np.float32)}, ReduceOp.AVG
+            ).wait()
+            raise RuntimeError("mid-session failure")
+        for _ in range(2):
+            collectives.allreduce(
+                {"w": np.full(4, 2.0, np.float32)}, ReduceOp.AVG
+            ).wait()
+
+
+def _drain(client):
+    out = client.allreduce(
+        {"w": np.full(4, 4.0, np.float32)}, ReduceOp.AVG
+    ).wait()
+    return out["w"]
+
+
+def _wait_until(pred, timeout_s=10.0):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.02)
+    return True
+
+
+def test_session_error_frees_collectives():
+    server = RecordingServer(fail_first=True)
+    try:
+        client = RecordingServer.new_session(server.address())
+        np.testing.assert_array_equal(_drain(client), np.full(4, 3.0))
+        # the server's forward raises after the first op; its collectives
+        # must be shut down by the session wrapper, not left to GC
+        assert _wait_until(
+            lambda: len(server.handed_out) == 1
+            and server.handed_out[0]._shutdown
+        )
+        client.shutdown()
+    finally:
+        server.shutdown()
+
+
+def test_client_reconnects_after_session_failure():
+    server = RecordingServer(fail_first=True)
+    try:
+        first = RecordingServer.new_session(server.address())
+        np.testing.assert_array_equal(_drain(first), np.full(4, 3.0))
+        first.shutdown()
+        assert _wait_until(
+            lambda: server.handed_out
+            and server.handed_out[0]._shutdown
+        )
+        # reconnect: a fresh session works end to end
+        second = RecordingServer.new_session(server.address())
+        np.testing.assert_array_equal(_drain(second), np.full(4, 3.0))
+        np.testing.assert_array_equal(_drain(second), np.full(4, 3.0))
+        second.shutdown()
+        assert _wait_until(
+            lambda: len(server.handed_out) == 2
+            and all(c._shutdown for c in server.handed_out)
+        )
+    finally:
+        server.shutdown()
+
+
+def test_concurrent_sessions():
+    import threading
+
+    server = EchoAverageServer()
+    results = []
+    try:
+
+        def run_one():
+            client = EchoAverageServer.new_session(server.address())
+            for _ in range(2):
+                out = client.allreduce(
+                    {"w": np.full(4, 4.0, np.float32)}, ReduceOp.AVG
+                ).wait()
+                results.append(out["w"].copy())
+            client.shutdown()
+
+        threads = [threading.Thread(target=run_one) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert len(results) == 6
+        for r in results:
+            np.testing.assert_array_equal(r, np.full(4, 3.0))
+    finally:
+        server.shutdown()
+
+
+# -- serving surface on the same listener ------------------------------------
+
+
+def test_ps_surface_rides_session_port():
+    from torchft_tpu.serving import WeightSubscriber, _http_json
+
+    server = EchoAverageServer(wire="f32")
+    try:
+        base = f"http://[::1]:{server.publisher.server.port}"
+        st = _http_json(f"{base}/ps/status", 5.0)
+        assert st["role"] == "publisher"
+        assert st["latest"] == -1  # nothing published yet
+        server.publish({"w": np.arange(8, dtype=np.float32)}, step=3)
+        sub = WeightSubscriber(base, name="ps-sub")
+        assert sub.poll() is True
+        version, tree, _age = sub.current()
+        assert version == 0
+        np.testing.assert_array_equal(
+            tree["w"], np.arange(8, dtype=np.float32)
+        )
+        # ...while the legacy session API still answers on the same port
+        client = EchoAverageServer.new_session(server.address())
+        np.testing.assert_array_equal(_drain(client), np.full(4, 3.0))
+        np.testing.assert_array_equal(_drain(client), np.full(4, 3.0))
+        client.shutdown()
     finally:
         server.shutdown()

@@ -1,0 +1,583 @@
+"""Serving-plane tests: wire encodings, the publisher's error-feedback
+delta discipline, the zero-copy relay re-serve, lease batching, and the
+integrity ladder (range CRC -> payload CRC -> nonce -> digest) that
+makes torn installs impossible."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchft_tpu import serving
+from torchft_tpu.serving import (
+    StaleWeightsError,
+    WeightPublisher,
+    WeightRelay,
+    WeightSubscriber,
+    WireDetection,
+    _BytesSource,
+    _catch_up_plan,
+    _fetch_version,
+    _http_json,
+    decode_tree,
+    demo_params,
+    encode_tree,
+    tree_digest,
+)
+
+
+def _tree(seed=0, leaves=3, elems=2048, version=0):
+    return demo_params(seed, leaves, elems, version)
+
+
+def _wait_until(pred, timeout_s=10.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(interval_s)
+    return True
+
+
+# -- wire encodings ----------------------------------------------------------
+
+
+class TestWire:
+    @pytest.mark.parametrize("wire", ["q8", "bf16", "f32"])
+    def test_roundtrip_shapes_and_error(self, wire):
+        tree = _tree()
+        dec = decode_tree(encode_tree(tree, wire), wire)
+        for k in tree:
+            assert dec[k].dtype == np.float32
+            assert dec[k].shape == tree[k].shape
+        err = max(
+            float(np.max(np.abs(dec[k] - tree[k]))) for k in tree
+        )
+        if wire == "f32":
+            assert err == 0.0
+        elif wire == "bf16":
+            assert err < 0.05
+        else:  # q8: bounded by scale/2 = max|d|/254
+            bound = max(
+                float(np.max(np.abs(tree[k]))) / 254.0 * 1.01 for k in tree
+            )
+            assert err <= bound
+
+    def test_q8_matches_quantize_oracle(self):
+        # One wire contract: serving's q8 must produce the exact
+        # quantize.py numerics (scale floor, round-half-even).
+        from torchft_tpu.quantize import quantize_with_feedback
+
+        leaf = np.linspace(-3.0, 3.0, 1000, dtype=np.float32)
+        enc = serving._q8_encode_leaf(leaf)
+        import jax
+
+        ref = quantize_with_feedback(
+            {"x": jax.numpy.asarray(leaf)},
+            {"x": jax.numpy.zeros_like(leaf)},
+        )
+        np.testing.assert_array_equal(enc["q"], np.asarray(ref["q"]["x"]))
+        np.testing.assert_allclose(
+            float(enc["s"]), float(ref["scale"]["x"]), rtol=1e-6
+        )
+
+    def test_q8_zero_leaf_scale_floor(self):
+        enc = serving._q8_encode_leaf(np.zeros(64, np.float32))
+        assert float(enc["s"]) == pytest.approx(1e-12)
+        assert not enc["q"].any()
+
+    def test_non_float_leaf_rejected(self):
+        with pytest.raises(ValueError, match="FLOAT weight trees"):
+            encode_tree({"ids": np.arange(4)}, "q8")
+
+    def test_wire_sizes(self):
+        # the measured per-subscriber bytes story starts here: wire
+        # payloads must hit the q8<=0.3x / bf16<=0.55x targets
+        pub = {}
+        tree = _tree(elems=4096)
+        f32 = sum(v.nbytes for v in tree.values())
+        for wire in ("q8", "bf16", "f32"):
+            p = WeightPublisher(wire=wire)
+            try:
+                m = p.publish(tree)
+                pub[wire] = m["total"] + m["meta_len"]
+                assert m["f32_nbytes"] == f32
+            finally:
+                p.shutdown()
+        assert pub["q8"] <= 0.3 * pub["f32"]
+        assert pub["bf16"] <= 0.55 * pub["f32"]
+
+    def test_tree_digest_sensitive(self):
+        t = _tree()
+        d1 = tree_digest(t)
+        t2 = {k: v.copy() for k, v in t.items()}
+        t2["layer0"][3] += 1e-3
+        assert tree_digest(t2) != d1
+        assert tree_digest(t) == d1
+
+
+# -- catch-up planning -------------------------------------------------------
+
+
+class TestCatchUpPlan:
+    def _manifests(self, kinds):
+        return {
+            v: {"version": v, "kind": k} for v, k in kinds.items()
+        }
+
+    def test_pure_delta_chain(self):
+        ms = self._manifests({3: "delta", 4: "delta", 5: "delta"})
+        assert _catch_up_plan(2, ms) == [3, 4, 5]
+
+    def test_late_joiner_snapshot_path(self):
+        ms = self._manifests({4: "snapshot", 5: "delta", 6: "delta"})
+        assert _catch_up_plan(-1, ms) == [4, 5, 6]
+
+    def test_gap_raises(self):
+        ms = self._manifests({5: "delta", 6: "delta"})
+        with pytest.raises(WireDetection, match="gap"):
+            _catch_up_plan(-1, ms)
+
+    def test_current_is_noop(self):
+        ms = self._manifests({4: "snapshot", 5: "delta"})
+        assert _catch_up_plan(5, ms) == []
+
+    def test_missing_delta_falls_back_to_snapshot(self):
+        ms = self._manifests({2: "snapshot", 3: "delta", 4: "delta"})
+        # have=0 but v1 evicted: the pure chain is broken, replan from
+        # the snapshot
+        assert _catch_up_plan(0, ms) == [2, 3, 4]
+
+
+# -- publisher ---------------------------------------------------------------
+
+
+class TestPublisher:
+    def test_snapshot_cadence_and_eviction(self):
+        pub = WeightPublisher(wire="q8", snapshot_every=4, keep=5)
+        try:
+            for v in range(10):
+                pub.publish(_tree(version=v))
+            ms = {m["version"]: m for m in pub.node.store.manifests()}
+            assert ms[8]["kind"] == "snapshot"
+            assert ms[9]["kind"] == "delta"
+            # keep=5 with latest snapshot at 8: everything below the
+            # snapshot beyond the budget is gone, the chain 8..9 stays
+            assert 8 in ms and 9 in ms
+            assert len(ms) <= 5
+            assert _catch_up_plan(-1, ms)[0] == 8
+        finally:
+            pub.shutdown()
+
+    def test_delta_error_feedback_bounds_drift(self):
+        # EF at the publisher: a subscriber applying every delta matches
+        # the served tree exactly, and the served tree tracks the true
+        # params within one quantization step (error does not grow with
+        # the number of deltas).
+        pub = WeightPublisher(wire="q8", snapshot_every=100)
+        try:
+            acc = None
+            for v in range(12):
+                true = _tree(version=v)
+                m = pub.publish(true)
+                meta, payload = _fetch_version(
+                    pub.server.local_address(), m, 2, 10.0
+                )
+                dec = decode_tree(
+                    serving.rebuild_from_packed(
+                        serving.load_packed_meta(meta), payload
+                    ),
+                    m["wire"],
+                )
+                acc = dec if m["kind"] == "snapshot" else serving._tree_add(acc, dec)
+                assert tree_digest(acc) == m["digest"]
+            err = max(
+                float(np.max(np.abs(acc[k] - true[k]))) for k in true
+            )
+            scale_bound = max(
+                float(np.max(np.abs(true[k]))) for k in true
+            ) / 127.0
+            assert err <= 2.5 * scale_bound
+        finally:
+            pub.shutdown()
+
+    def test_publish_on_commit_hook(self):
+        class _Mgr:
+            def __init__(self):
+                self.hooks = []
+
+            def add_commit_hook(self, h):
+                self.hooks.append(h)
+
+        pub = WeightPublisher(wire="f32")
+        try:
+            mgr = _Mgr()
+            serving.publish_on_commit(mgr, pub, lambda: _tree(), every=2)
+            (hook,) = mgr.hooks
+            hook(1, 1, True)   # not an every-boundary
+            hook(2, 1, False)  # aborted step: no publish
+            hook(2, 1, True)
+            hook(4, 1, True)
+            assert pub.node.store.latest() == 1  # two publishes: v0, v1
+            assert pub.node.store.get(0).manifest["step"] == 2
+        finally:
+            pub.shutdown()
+
+
+# -- integrity ladder --------------------------------------------------------
+
+
+class TestIntegrity:
+    def test_nonce_mismatch_is_400(self):
+        pub = WeightPublisher(wire="f32")
+        try:
+            m = dict(pub.publish(_tree()))
+            m["nonce"] = "deadbeef00000000"
+            with pytest.raises(WireDetection, match="nonce"):
+                _fetch_version(pub.server.local_address(), m, 1, 10.0)
+            assert pub.node.counters["nonce_rejects"] >= 1
+        finally:
+            pub.shutdown()
+
+    def test_evicted_version_is_gone(self):
+        pub = WeightPublisher(wire="f32")
+        try:
+            m = pub.publish(_tree())
+            fake = dict(m)
+            fake["version"] = 99
+            with pytest.raises(WireDetection, match="gone"):
+                _fetch_version(pub.server.local_address(), fake, 1, 10.0)
+        finally:
+            pub.shutdown()
+
+    def test_corrupt_relay_cache_detected_by_payload_crc(self):
+        # A relay re-signs range CRCs off its own buffer, so in-memory
+        # corruption at the relay passes the RANGE check — the manifest's
+        # full-payload CRC (minted by the publisher) is what catches it
+        # end-to-end.
+        pub = WeightPublisher(wire="f32")
+        relay = WeightRelay(pub.server.local_address(), name="rx")
+        try:
+            m = pub.publish(_tree())
+            relay.sync_once()
+            held = relay.node.store.get(0)
+            corrupted = bytearray(held.source._view.tobytes())
+            corrupted[7] ^= 0xFF
+            held.source = _BytesSource(bytes(corrupted))
+            sub = WeightSubscriber(
+                relay.server.local_address(), name="s-crc"
+            )
+            assert sub.poll() is False
+            assert sub.stats["detect_crc"] == 1
+            assert sub.version() == -1  # nothing installed
+        finally:
+            relay.shutdown()
+            pub.shutdown()
+
+    def test_truncated_meta_detected(self):
+        pub = WeightPublisher(wire="f32")
+        try:
+            pub.publish(_tree())
+            held = pub.node.store.get(0)
+            held.meta = held.meta[:-10]
+            sub = WeightSubscriber(
+                pub.server.local_address(), name="s-meta"
+            )
+            assert sub.poll() is False
+            assert sub.stats["detect_short"] == 1
+        finally:
+            pub.shutdown()
+
+    def test_digest_gate_catches_wrong_end_state(self):
+        # Everything on the wire verifies but the advertised end-state
+        # digest disagrees: the install must be averted at the last gate.
+        pub = WeightPublisher(wire="f32")
+        try:
+            pub.publish(_tree())
+            pub.node.store.get(0).manifest["digest"] = "0" * 8
+            sub = WeightSubscriber(
+                pub.server.local_address(), name="s-dig"
+            )
+            assert sub.poll() is False
+            assert sub.stats["detect_digest"] == 1
+            assert sub.version() == -1
+        finally:
+            pub.shutdown()
+
+
+# -- relay tree --------------------------------------------------------------
+
+
+class TestRelay:
+    def test_verbatim_reserve_bit_identity(self):
+        pub = WeightPublisher(wire="q8")
+        relay = WeightRelay(pub.server.local_address(), name="rv")
+        try:
+            m = pub.publish(_tree())
+            relay.sync_once()
+            up_meta, up_payload = _fetch_version(
+                pub.server.local_address(), m, 3, 10.0
+            )
+            dn_meta, dn_payload = _fetch_version(
+                relay.server.local_address(), m, 3, 10.0
+            )
+            assert up_meta == dn_meta
+            assert up_payload == dn_payload
+        finally:
+            relay.shutdown()
+            pub.shutdown()
+
+    def test_publisher_egress_independent_of_subscribers(self):
+        # The fan-out story by accounting: adding subscribers behind the
+        # relay moves ZERO additional bytes out of the publisher.
+        pub = WeightPublisher(wire="q8")
+        relay = WeightRelay(pub.server.local_address(), name="re").start()
+        try:
+            pub.publish(_tree())
+            subs = [
+                WeightSubscriber(
+                    relay.server.local_address(), name=f"se{i}"
+                )
+                for i in range(4)
+            ]
+            assert _wait_until(
+                lambda: relay.node.store.latest() == 0, 10.0
+            )
+            before_ranges = pub.node.counters["ranges_served"]
+            before_meta = pub.node.counters["meta_served"]
+            for s in subs:
+                assert s.wait_version(0, 10.0)
+            # payload bytes left the publisher exactly once (the relay's
+            # sync); subscribers fetching through the relay moved ZERO
+            # additional ranges or metas out of the root
+            assert pub.node.counters["ranges_served"] == before_ranges
+            assert pub.node.counters["meta_served"] == before_meta
+            assert relay.node.counters["ranges_served"] >= 4
+            for s in subs:
+                s.close()
+        finally:
+            relay.shutdown()
+            pub.shutdown()
+
+    def test_partitioned_relay_serves_with_honest_age(self):
+        pub = WeightPublisher(wire="f32")
+        relay = WeightRelay(pub.server.local_address(), name="rp")
+        try:
+            pub.publish(_tree())
+            relay.sync_once()
+            age0 = relay._age_ms()
+            assert 0 <= age0 < 5_000
+            relay.set_partitioned(True)
+            with pytest.raises(WireDetection):
+                relay.sync_once()
+            time.sleep(0.15)
+            st = _http_json(
+                f"{relay.server.local_address()}/ps/status", 5.0
+            )
+            assert st["latest"] == 0  # still serving
+            assert st["age_ms"] >= 150  # and honest about staleness
+            relay.set_partitioned(False)
+            relay.sync_once()
+            assert relay._age_ms() < st["age_ms"]
+        finally:
+            relay.shutdown()
+            pub.shutdown()
+
+    def test_upstream_regression_resyncs(self):
+        # A publisher that died and restarted publishes version numbers
+        # from scratch under fresh nonces: the relay must drop its stale
+        # chain and resync rather than serve a mixed history.
+        pub1 = WeightPublisher(wire="f32", snapshot_every=1)
+        relay = WeightRelay(pub1.server.local_address(), name="rr")
+        try:
+            for v in range(3):
+                pub1.publish(_tree(version=v))
+            relay.sync_once()
+            assert relay.node.store.latest() == 2
+            pub2 = WeightPublisher(wire="f32", snapshot_every=1)
+            try:
+                pub2.publish(_tree(seed=9))
+                relay.upstream = pub2.server.local_address()
+                relay.sync_once()
+                assert relay.node.store.latest() == 0
+                held = relay.node.store.get(0)
+                assert held.manifest["digest"] == tree_digest(
+                    decode_tree(
+                        serving.rebuild_from_packed(
+                            serving.load_packed_meta(held.meta),
+                            held.source._view.tobytes(),
+                        ),
+                        "f32",
+                    )
+                )
+            finally:
+                pub2.shutdown()
+        finally:
+            relay.shutdown()
+            pub1.shutdown()
+
+
+# -- subscriber sessions -----------------------------------------------------
+
+
+class TestSubscriber:
+    def test_late_joiner_snapshot_plus_delta(self):
+        pub = WeightPublisher(wire="q8", snapshot_every=4)
+        try:
+            for v in range(7):
+                pub.publish(_tree(version=v))
+            sub = WeightSubscriber(pub.server.local_address(), name="lj")
+            assert sub.poll() is True
+            assert sub.version() == 6
+            # one install: snapshot v4 + deltas v5, v6
+            assert sub.stats["installs"] == 1
+            assert sub.stats["snapshot_installs"] == 1
+            assert sub.stats["catch_up_deltas"] == 2
+            v, tree, age = sub.current()
+            assert v == 6 and age >= 0
+            assert tree_digest(tree) == pub.node.store.get(6).manifest["digest"]
+        finally:
+            pub.shutdown()
+
+    def test_staleness_bounded_read(self):
+        pub = WeightPublisher(wire="f32")
+        try:
+            sub = WeightSubscriber(pub.server.local_address(), name="sb")
+            with pytest.raises(StaleWeightsError, match="no weights"):
+                sub.current()
+            pub.publish(_tree())
+            assert sub.poll() is True
+            v, _, age = sub.current(max_age_ms=60_000)
+            assert v == 0
+            time.sleep(0.12)
+            with pytest.raises(StaleWeightsError, match="exceeds bound"):
+                sub.current(max_age_ms=100)
+            # a fresh poll against a live node resets the age
+            sub.poll()
+            sub.current(max_age_ms=60_000)
+        finally:
+            pub.shutdown()
+
+    def test_background_thread_follows_publishes(self):
+        pub = WeightPublisher(wire="q8", snapshot_every=4)
+        try:
+            sub = WeightSubscriber(
+                pub.server.local_address(), name="bg"
+            ).start(poll_ms=200)
+            for v in range(5):
+                pub.publish(_tree(version=v))
+            assert _wait_until(lambda: sub.version() == 4, 15.0)
+            sub.close()
+            assert sub.stats["torn_installs"] == 0
+        finally:
+            pub.shutdown()
+
+    def test_publisher_restart_regression_recovers(self):
+        pub1 = WeightPublisher(wire="f32", snapshot_every=1)
+        sub = None
+        try:
+            for v in range(4):
+                pub1.publish(_tree(version=v))
+            sub = WeightSubscriber(pub1.server.local_address(), name="rg")
+            assert sub.poll() is True and sub.version() == 3
+            pub2 = WeightPublisher(wire="f32", snapshot_every=1)
+            try:
+                pub2.publish(_tree(seed=5))
+                sub.base = pub2.server.local_address()
+                assert sub.poll() is True
+                assert sub.version() == 0  # new history accepted
+                _, tree, _ = sub.current()
+                assert tree_digest(tree) == pub2.node.store.get(0).manifest[
+                    "digest"
+                ]
+            finally:
+                pub2.shutdown()
+        finally:
+            pub1.shutdown()
+
+
+# -- leases ------------------------------------------------------------------
+
+
+class TestLeases:
+    def test_lease_expiry_prunes(self):
+        pub = WeightPublisher(wire="f32", lease_ttl_ms=100)
+        try:
+            pub.node.renew_lease("a", 100, 1)
+            pub.node.renew_lease("b", 10_000, 2)
+            leases, subs = pub.node.lease_totals()
+            assert (leases, subs) == (2, 3)
+            assert _wait_until(
+                lambda: pub.node.lease_totals() == (1, 2), 5.0
+            )
+        finally:
+            pub.shutdown()
+
+    def test_relay_batches_downstream_population_upstream(self):
+        # 3 subscriber leases at the relay become ONE upstream lease
+        # entry whose weight is the whole population.
+        pub = WeightPublisher(wire="f32")
+        relay = WeightRelay(pub.server.local_address(), name="rl")
+        try:
+            pub.publish(_tree())
+            relay.sync_once()
+            for i in range(3):
+                relay.node.renew_lease(f"s{i}", 10_000, 1)
+            relay._lease_due = 0.0
+            relay._renew_upstream_lease()
+            st = pub.node.status()
+            assert st["leases"] == 1
+            assert st["subscribers"] == 3
+        finally:
+            relay.shutdown()
+            pub.shutdown()
+
+    def test_subscriber_renews_and_drops_lease(self):
+        pub = WeightPublisher(wire="f32")
+        try:
+            pub.publish(_tree())
+            sub = WeightSubscriber(
+                pub.server.local_address(), name="ld", lease_ttl_ms=10_000
+            )
+            sub.poll()
+            assert pub.node.lease_totals() == (1, 1)
+            sub.close()  # releases via a 1ms renewal with weight 0
+            assert _wait_until(
+                lambda: pub.node.lease_totals() == (0, 0), 5.0
+            )
+        finally:
+            pub.shutdown()
+
+
+# -- two-tier end-to-end -----------------------------------------------------
+
+
+def test_two_tier_fanout_end_to_end():
+    pub = WeightPublisher(wire="q8", snapshot_every=4)
+    r1 = WeightRelay(pub.server.local_address(), name="t1").start()
+    r2 = WeightRelay(r1.server.local_address(), name="t2").start()
+    subs = []
+    try:
+        subs = [
+            WeightSubscriber(r2.server.local_address(), name=f"e{i}").start(
+                poll_ms=150
+            )
+            for i in range(3)
+        ]
+        for v in range(6):
+            pub.publish(_tree(version=v))
+        assert _wait_until(
+            lambda: all(s.version() == 5 for s in subs), 20.0
+        )
+        want = pub.node.store.get(5).manifest["digest"]
+        for s in subs:
+            _, tree, _ = s.current()
+            assert tree_digest(tree) == want
+            assert s.stats["torn_installs"] == 0
+    finally:
+        for s in subs:
+            s.close()
+        r2.shutdown()
+        r1.shutdown()
+        pub.shutdown()

@@ -49,6 +49,13 @@ from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import OptimizerWrapper as Optimizer
 from torchft_tpu.optim import OptimizerWrapper, ShardedOptimizerWrapper
 from torchft_tpu.policy import CostKnobs, PolicyEngine, StrategySpec
+from torchft_tpu.serving import (
+    StaleWeightsError,
+    WeightPublisher,
+    WeightRelay,
+    WeightSubscriber,
+    publish_on_commit,
+)
 from torchft_tpu.pipeline import pipeline_blocks, stack_blocks
 from torchft_tpu.profiling import Profiler
 from torchft_tpu.train_state import FTTrainState
@@ -95,8 +102,13 @@ __all__ = [
     "pipeline_blocks",
     "stack_blocks",
     "ReduceOp",
+    "StaleWeightsError",
     "StatefulDataLoader",
     "Store",
+    "WeightPublisher",
+    "WeightRelay",
+    "WeightSubscriber",
+    "publish_on_commit",
     "StoreClient",
     "TreeShard",
     "Work",

@@ -49,7 +49,8 @@ _MASK = (1 << 64) - 1
 # Seams a plan may name. The native engine owns the first group; the
 # rest are realized Python-side by the injectors in this module.
 NATIVE_SEAMS = ("ring_send", "ring_hdr", "net_send", "shm_ring", "wal_write")
-PYTHON_SEAMS = ("store", "heal", "child", "shm", "lighthouse", "root")
+PYTHON_SEAMS = ("store", "heal", "child", "shm", "lighthouse", "root",
+                "serving")
 SEAMS = NATIVE_SEAMS + PYTHON_SEAMS
 
 # Kinds per seam (what a random plan may draw). Native ring kinds map
@@ -84,6 +85,16 @@ SEAM_KINDS: Dict[str, Tuple[str, ...]] = {
     # `param` ms then SIGCONT (unreachable-but-alive — the takeover +
     # deposed-primary fencing path).
     "root": ("kill", "restart", "partition"),
+    # The weight-distribution serving plane (serving.py): kill = SIGKILL
+    # the publisher subprocess MID-range (TORCHFT_PS_DRIP_MS throttles
+    # the body so the kill reliably lands inside a transfer — the
+    # short-body + CRC + nonce ladder must avert the install), restart =
+    # kill + respawn on the same port (fresh nonces over reused version
+    # numbers: the torn-republish 400 path), partition = cut one relay
+    # from its upstream (it keeps serving with honestly growing age_ms),
+    # churn = subscriber join/leave storm (lease table pruning under
+    # load).
+    "serving": ("kill", "restart", "partition", "churn"),
 }
 
 
@@ -669,6 +680,134 @@ class RootProcess:
         returned stall to wait for the CONT)."""
         assert self.proc is not None
         return ProcessStall(self.proc.pid, duration_s).start()
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                self.proc.kill()
+
+
+class PublisherProcess:
+    """A demo weight publisher hosted in a SUBPROCESS — the ``serving``
+    seam's substrate (``python -m torchft_tpu.serving`` on a FIXED port,
+    so relays keep dialing the same upstream across kills). The chaos
+    point: ``TORCHFT_PS_DRIP_MS`` makes the publisher stream range
+    bodies in 64 KiB dribbles, so :meth:`kill` reliably lands MID-range
+    — the subscriber-side short-body/CRC ladder must avert the install,
+    never tear it. :meth:`restart` respawns on the same port with a
+    FRESH version history (new nonces over reused version numbers),
+    which is exactly the torn-republish case the 400-nonce contract and
+    the downstream regression-resync guard.
+
+    The deterministic ``seed`` means every incarnation publishes the
+    same weight trees (:func:`torchft_tpu.serving.demo_params`), so the
+    harness can verify any subscriber's installed tree bit-for-bit
+    without talking to the (possibly dead) publisher."""
+
+    def __init__(
+        self,
+        port: int,
+        wire: str = "q8",
+        leaves: int = 4,
+        elems: int = 16384,
+        seed: int = 0,
+        publish_every_ms: int = 250,
+        snapshot_every: int = 4,
+        keep: int = 16,
+        drip_ms: int = 0,
+        extra_env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.port = port
+        self.wire = wire
+        self.leaves = leaves
+        self.elems = elems
+        self.seed = seed
+        self.publish_every_ms = publish_every_ms
+        self.snapshot_every = snapshot_every
+        self.keep = keep
+        self.drip_ms = drip_ms
+        self.extra_env = dict(extra_env or {})
+        self.proc: Optional[Any] = None
+        self.restarts = 0
+        self.spawn()
+
+    def address(self) -> str:
+        return f"http://[::1]:{self.port}"
+
+    @property
+    def pid(self) -> Optional[int]:
+        return None if self.proc is None else self.proc.pid
+
+    def _argv(self) -> List[str]:
+        import sys
+
+        return [
+            sys.executable,
+            "-m",
+            "torchft_tpu.serving",
+            "--port", str(self.port),
+            "--wire", self.wire,
+            "--leaves", str(self.leaves),
+            "--elems", str(self.elems),
+            "--seed", str(self.seed),
+            "--publish-every-ms", str(self.publish_every_ms),
+            "--snapshot-every", str(self.snapshot_every),
+            "--keep", str(self.keep),
+        ]
+
+    def spawn(self) -> None:
+        import subprocess
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        if self.drip_ms > 0:
+            env["TORCHFT_PS_DRIP_MS"] = str(self.drip_ms)
+        env.update(self.extra_env)
+        self.proc = subprocess.Popen(self._argv(), env=env)
+
+    def status(self, timeout: float = 2.0) -> Optional[dict]:
+        """One /ps/status read, or None while unreachable."""
+        try:
+            with urllib.request.urlopen(
+                self.address() + "/ps/status", timeout=timeout
+            ) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 - down IS a state here
+            return None
+
+    def wait_serving(self, deadline_s: float = 30.0, min_version: int = 0) -> dict:
+        """Blocks until /ps/status answers with ``latest >=
+        min_version``; returns the status."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            st = self.status()
+            if st is not None and int(st.get("latest", -1)) >= min_version:
+                return st
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"publisher on port {self.port} never reached v{min_version}"
+        )
+
+    def kill(self) -> None:
+        """SIGKILL — with ``drip_ms`` set, this lands mid-range on any
+        in-flight transfer (the serving seam's signature fault)."""
+        if self.proc is not None and self.proc.poll() is None:
+            kill_process(self.proc.pid)
+            self.proc.wait(timeout=10)
+
+    def restart(self) -> None:
+        """kill + respawn on the same port: version numbers restart at 0
+        under fresh nonces — the torn-republish path."""
+        self.kill()
+        self.restarts += 1
+        self.spawn()
 
     def stop(self) -> None:
         if self.proc is not None and self.proc.poll() is None:

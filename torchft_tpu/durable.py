@@ -532,6 +532,16 @@ class DurableCheckpointer:
         self.last_restore_stats: Optional[Dict[str, Any]] = None
         if register_hook:
             manager.add_commit_hook(self._on_commit)
+        # Restore-time donor/durable arbitration: hand the manager the
+        # cold-start fallback, so a cold fleet's FIRST start_quorum
+        # restores the latest committed checkpoint when no live donor
+        # exists — the trainer no longer has to call restore_latest()
+        # before its loop (it still may: the manager's consult is
+        # one-shot and disarmed by a nonzero step). Guarded so stub
+        # managers without the hook keep working.
+        register_restore = getattr(manager, "set_durable_restore", None)
+        if callable(register_restore):
+            register_restore(self.restore_latest)
 
     # -- capture (trainer thread) --
 

@@ -90,6 +90,7 @@ class Manager:
         checkpoint_transport: Optional[CheckpointTransport[Dict[str, T]]] = None,
         profiler: Optional["Profiler"] = None,
         iso_collectives: Optional[Collectives] = None,
+        durable_restore: Optional[Callable[[], Optional[int]]] = None,
     ) -> None:
         """
         Args:
@@ -161,6 +162,18 @@ class Manager:
                 :meth:`iso_allreduce`. AdaptiveDDP's ``xla_iso``
                 candidate probes it against the host ring with the same
                 lockstep-vote argmin that picks the schedule.
+            durable_restore: the durable tier's cold-start fallback —
+                a callable (``DurableCheckpointer.restore_latest``)
+                that applies the latest committed durable checkpoint
+                (user + manager state) and returns its step, or None
+                when nothing is committed. Consulted ONCE, inside the
+                first quorum, and only when the quorum reports no live
+                donor (``max_step == 0``): a cold fleet restores
+                without the trainer calling restore before its loop,
+                while a live donor always wins (its weights are at
+                least as fresh as any durable snapshot).
+                ``DurableCheckpointer`` registers itself through
+                :meth:`set_durable_restore`.
         """
         self._load_state_dict = load_state_dict
         self._user_state_dict = state_dict
@@ -222,6 +235,8 @@ class Manager:
         self._healing = False
         self._pending_work: List[Work] = []
         self._commit_hooks: List[Any] = []
+        self._durable_restore = durable_restore
+        self._durable_consulted = False
         self._pending_state_dict: Optional[Dict[str, object]] = None
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
@@ -420,6 +435,27 @@ class Manager:
         self._participating_rank = participating_rank
         self._participating_world_size = participating_world
         heal = allow_heal and result.heal
+
+        if self._durable_restore is not None and not self._durable_consulted:
+            # Restore-time donor/durable arbitration, one-shot at the
+            # first quorum. A live donor (max_step > 0) always beats the
+            # durable tier — its weights are at least as fresh as any
+            # committed snapshot and the normal heal path ships them —
+            # so the durable fallback only fires on a COLD fleet: no
+            # member has committed a step and this member hasn't
+            # restored anything itself. Every member consults its own
+            # restore_latest against the shared store, so the fleet
+            # rises at one consistent committed step; members that find
+            # nothing init-sync from a restored peer as usual.
+            self._durable_consulted = True
+            if self._step == 0 and result.max_step == 0:
+                restored = self._durable_restore()
+                if restored is not None:
+                    self._metrics.incr("durable_cold_restores")
+                    self._logger.info(
+                        f"cold fleet: restored durable step {restored} "
+                        "(no live donor in quorum)"
+                    )
 
         if quorum_id != self._quorum_id:
             if self._quorum_id != -1:
@@ -1282,6 +1318,17 @@ class Manager:
         Hooks must not raise; exceptions are swallowed and logged (a
         failing snapshot never aborts training)."""
         self._commit_hooks.append(hook)
+
+    def set_durable_restore(
+        self, fn: Optional[Callable[[], Optional[int]]]
+    ) -> None:
+        """Registers (or clears) the durable tier's cold-start fallback —
+        see the ``durable_restore`` constructor arg.
+        ``DurableCheckpointer.__init__`` calls this so the arbitration
+        is wired by merely constructing the checkpointer; a trainer that
+        still calls ``restore_latest()`` itself before the first quorum
+        is unaffected (a nonzero restored step disarms the consult)."""
+        self._durable_restore = fn
 
     def batches_committed(self) -> int:
         """Total batches committed across all replicas and steps."""

@@ -1,97 +1,123 @@
-"""Prototype fault-tolerant parameter server on reconfigurable collectives.
+"""Fault-tolerant parameter server, rebuilt on the serving plane.
 
-Reference: torchft/parameter_server.py:31-195. No lighthouse needed
-(reference README.md:142-145): the server owns a rendezvous Store and an
-HTTP endpoint; each ``GET /new_session`` mints a uuid-prefixed store
-namespace, replies with JSON, then hijacks the handler thread to run
-``forward(session_id, collectives)`` over a world-size-2 ring (server
-rank 0, client rank 1). A failed session frees the collectives; the client
-just opens a new session.
+Reference: torchft/parameter_server.py:31-195 — the world-size-2
+prototype where ``GET /new_session`` mints a uuid-prefixed store
+namespace and hijacks the handler thread to run
+``forward(session_id, collectives)`` over a 2-member ring. That session
+API is kept VERBATIM as a thin compat shim, but the HTTP listener is now
+a :class:`torchft_tpu.serving.ServingServer`: the same port also serves
+the ``/ps/*`` pub/sub weight-distribution surface (zero-copy versioned
+ranges, leases, staleness-bounded reads) through an owned
+:class:`~torchft_tpu.serving.WeightPublisher` — ``publish()`` hands a
+weight tree to thousands of subscribers while legacy clients keep
+opening 2-world sessions against ``/new_session``.
+
+Addressing: peers may not resolve this machine's bare hostname, so all
+advertised URLs honor env ``TORCHFT_PS_HOST`` (falling back to the
+hostname) via :func:`torchft_tpu.serving.advertise_host`.
 """
 
 from __future__ import annotations
 
 import json
 import logging
-import socket
-import threading
 import urllib.request
 import uuid
 from abc import ABC, abstractmethod
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, Optional
 
 from . import _native
 from .collectives import Collectives
+from .serving import WeightPublisher, advertise_host, _url_host
 
 logger: logging.Logger = logging.getLogger(__name__)
 
 
 class ParameterServer(ABC):
-    """Threaded parameter server over the reconfigurable collectives."""
+    """Threaded parameter server over the reconfigurable collectives,
+    fronted by the serving plane's HTTP listener."""
 
-    def __init__(self, port: int = 0) -> None:
+    def __init__(
+        self,
+        port: int = 0,
+        wire: Optional[str] = None,
+        snapshot_every: Optional[int] = None,
+        keep: Optional[int] = None,
+    ) -> None:
         self.store = _native.Store()
-
-        ps = self
-
-        class RequestHandler(BaseHTTPRequestHandler):
-            def do_GET(self) -> None:
-                if self.path != "/new_session":
-                    self.send_error(400, f"invalid path, got {self.path}")
-                    return
-                try:
-                    session_id = str(uuid.uuid4())
-                    store_addr = f"{ps.store.address()}/session/{session_id}"
-                    logger.info(f"creating new session {session_id}")
-
-                    data = (
-                        json.dumps(
-                            {"session_id": session_id, "store_addr": store_addr}
-                        )
-                        + "\n"
-                    ).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                    # Close eagerly so the client knows the JSON is complete,
-                    # then hijack this handler thread for the session
-                    # (reference parameter_server.py:91-97).
-                    self.finish()
-                    self.connection.close()
-
-                    ps._handle_session(session_id, store_addr)
-                except Exception:
-                    logger.exception(
-                        f"got exception in request handler for {self.path}"
-                    )
-                    raise
-
-            def log_message(self, format: str, *args: object) -> None:
-                logger.debug(f"parameter server: {format % args}")
-
-        class _Server(ThreadingHTTPServer):
-            address_family = socket.AF_INET6
-            daemon_threads = True
-
-        self._server = _Server(("::", port), RequestHandler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="parameter_server",
+        # The serving tier owns the listener; /new_session rides it as
+        # the compat shim. The publisher starts empty — /ps/* answers
+        # (latest = -1) even before the first publish.
+        self.publisher = WeightPublisher(
+            port=port,
+            wire=wire,
+            snapshot_every=snapshot_every,
+            keep=keep,
+            extra_get=self._handle_legacy_get,
         )
-        self._thread.start()
+        self._server = self.publisher.server
         logger.info(f"Started ParameterServer on {self.address()}...")
 
+    def _handle_legacy_get(
+        self, handler: BaseHTTPRequestHandler, path: str
+    ) -> bool:
+        """The pre-serving session API: consumes ``/new_session`` and
+        leaves every other path (the /ps/* surface) to the serving
+        router. Runs ON the handler thread — the session hijacks it
+        exactly as before (reference parameter_server.py:91-97)."""
+        if path.split("?")[0] != "/new_session":
+            return False
+        try:
+            session_id = str(uuid.uuid4())
+            store_addr = f"{self.store.address()}/session/{session_id}"
+            logger.info(f"creating new session {session_id}")
+
+            data = (
+                json.dumps(
+                    {"session_id": session_id, "store_addr": store_addr}
+                )
+                + "\n"
+            ).encode()
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
+            # Close eagerly so the client knows the JSON is complete,
+            # then hijack this handler thread for the session
+            # (reference parameter_server.py:91-97).
+            handler.finish()
+            handler.connection.close()
+
+            self._handle_session(session_id, store_addr)
+        except Exception:
+            logger.exception(
+                f"got exception in request handler for {path}"
+            )
+            raise
+        return True
+
     def address(self) -> str:
-        """HTTP address for creating sessions: http://host:port/new_session"""
-        port = self._server.socket.getsockname()[1]
-        return f"http://{socket.gethostname()}:{port}/new_session"
+        """HTTP address for creating sessions:
+        ``http://host:port/new_session``. The host honors env
+        ``TORCHFT_PS_HOST`` (peers may not resolve the bare hostname);
+        IPv6 literals are bracketed."""
+        port = self._server.port
+        return f"http://{_url_host(advertise_host())}:{port}/new_session"
+
+    def serving_address(self) -> str:
+        """Base URL of the pub/sub serving surface (``/ps/*``) — what
+        relays and subscribers dial."""
+        return self._server.address()
+
+    def publish(self, params: Any, step: Optional[int] = None) -> Dict[str, Any]:
+        """Publish one weight version into the serving plane (see
+        :meth:`torchft_tpu.serving.WeightPublisher.publish`)."""
+        return self.publisher.publish(params, step=step)
 
     def shutdown(self) -> None:
-        self._server.shutdown()
-        self._thread.join()
-        self._server.server_close()
+        self.publisher.shutdown()
         self.store.shutdown()
 
     @classmethod
