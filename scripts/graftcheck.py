@@ -1,0 +1,206 @@
+#!/usr/bin/env python3
+"""graftcheck CLI: exhaustive protocol model checking.
+
+Usage:
+    python scripts/graftcheck.py                     # sweep all models
+    python scripts/graftcheck.py --model wal         # sweep one model
+    python scripts/graftcheck.py --regressions       # every broken
+        # variant must produce a counterexample with a replay line
+    python scripts/graftcheck.py --model durable --broken commit_without_fence
+        # run one broken variant (exits 0 iff it yields a counterexample)
+    python scripts/graftcheck.py --dryrun            # CI smoke: reduced
+        # budget; asserts >=1 model explores >10k distinct states
+    python scripts/graftcheck.py --model step_txn --trace '["work0", ...]'
+        # replay a counterexample trace, printing each visited state
+
+Exits 0 when every sweep met its expectation, 1 on a property violation
+(or a broken variant that failed to produce one), 2 on usage errors.
+A violation prints a replay line in the chaos_run.py format:
+
+    replay: --model <name> --trace '<json action labels>'
+
+Models and the explorer live in tools/graftcheck/ (see its package
+docstring; docs/DEVELOPING.md explains how to model a new protocol).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+import graftcheck  # noqa: E402
+from graftcheck.core import ReplayError, explore, replay  # noqa: E402
+
+# The --dryrun smoke budget: enough for the big models to clear the 10k
+# distinct-state bar, small enough to finish in seconds.
+DRYRUN_MAX_STATES = 20_000
+DRYRUN_ASSERT_STATES = 10_000
+
+
+def _sweep(model, max_depth, max_states, expect_violation=False):
+    result = explore(model, max_depth=max_depth, max_states=max_states)
+    print(result.summary())
+    if result.violation is not None:
+        print("  property violated: %s" % result.violation.prop)
+        print("  %s" % result.violation.replay_line())
+    if expect_violation:
+        return result.violation is not None
+    return result.violation is None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", help="model name (default: all)")
+    parser.add_argument(
+        "--broken",
+        default="",
+        help="broken variant of --model; the sweep must find a violation",
+    )
+    parser.add_argument(
+        "--regressions",
+        action="store_true",
+        help="run every broken variant; each must yield a counterexample",
+    )
+    parser.add_argument(
+        "--dryrun",
+        action="store_true",
+        help="reduced-budget smoke; asserts >=1 model explores >%d states"
+        % DRYRUN_ASSERT_STATES,
+    )
+    parser.add_argument(
+        "--trace", help="JSON action-label list to replay against --model"
+    )
+    parser.add_argument("--max-depth", type=int, default=None)
+    parser.add_argument("--max-states", type=int, default=None)
+    parser.add_argument(
+        "--list", action="store_true", help="list models and broken variants"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in graftcheck.MODEL_NAMES:
+            model = graftcheck.make(name)
+            print(
+                "%-10s properties: %s" % (name, ", ".join(model.properties))
+            )
+            for b in graftcheck.broken_variants(name):
+                print("%-10s   broken: %s" % ("", b))
+        return 0
+
+    if args.broken and not args.model:
+        print("--broken requires --model", file=sys.stderr)
+        return 2
+    if args.trace and not args.model:
+        print("--trace requires --model", file=sys.stderr)
+        return 2
+    if args.model and args.model not in graftcheck.MODEL_NAMES:
+        print(
+            "unknown model %r (have: %s)"
+            % (args.model, ", ".join(graftcheck.MODEL_NAMES)),
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.trace:
+        model = graftcheck.make(args.model, args.broken)
+        try:
+            labels = json.loads(args.trace)
+            states = replay(model, labels)
+        except (ValueError, ReplayError) as e:
+            print("replay failed: %s" % e, file=sys.stderr)
+            return 2
+        for i, state in enumerate(states):
+            label = "(initial)" if i == 0 else labels[i - 1]
+            print("%3d %-24s %r" % (i, label, state))
+        violated = model.check(states[-1])
+        if violated:
+            print("final state violates: %s" % ", ".join(violated))
+            return 1
+        print("final state satisfies all properties")
+        return 0
+
+    if args.regressions:
+        ok = True
+        for name in graftcheck.MODEL_NAMES:
+            for b in graftcheck.broken_variants(name):
+                model = graftcheck.make(name, b)
+                found = _sweep(
+                    model, args.max_depth, args.max_states,
+                    expect_violation=True,
+                )
+                if not found:
+                    print(
+                        "REGRESSION FAILED: %s/%s found no counterexample"
+                        % (name, b),
+                        file=sys.stderr,
+                    )
+                    ok = False
+        if ok:
+            print("graftcheck: all broken variants produced counterexamples")
+        return 0 if ok else 1
+
+    if args.broken:
+        if args.broken not in graftcheck.broken_variants(args.model):
+            print(
+                "unknown broken variant %r of %s (have: %s)"
+                % (args.broken, args.model,
+                   ", ".join(graftcheck.broken_variants(args.model))),
+                file=sys.stderr,
+            )
+            return 2
+        model = graftcheck.make(args.model, args.broken)
+        found = _sweep(
+            model, args.max_depth, args.max_states, expect_violation=True
+        )
+        if not found:
+            print(
+                "REGRESSION FAILED: no counterexample found", file=sys.stderr
+            )
+            return 1
+        return 0
+
+    names = (args.model,) if args.model else graftcheck.MODEL_NAMES
+    max_states = args.max_states
+    if args.dryrun and max_states is None:
+        max_states = DRYRUN_MAX_STATES
+
+    ok = True
+    best = 0
+    for name in names:
+        model = graftcheck.make(name)
+        result = explore(
+            model, max_depth=args.max_depth, max_states=max_states
+        )
+        print(result.summary())
+        best = max(best, result.states)
+        if result.violation is not None:
+            print("  property violated: %s" % result.violation.prop)
+            print("  %s" % result.violation.replay_line())
+            ok = False
+
+    if args.dryrun:
+        if best <= DRYRUN_ASSERT_STATES:
+            print(
+                "graftcheck --dryrun: no model explored >%d distinct states "
+                "(max %d)" % (DRYRUN_ASSERT_STATES, best),
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            "graftcheck --dryrun: ok (max %d distinct states)" % best
+        )
+    if not ok:
+        print("graftcheck: property violation(s) found", file=sys.stderr)
+        return 1
+    if not args.dryrun:
+        print("graftcheck: all models clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+# graftlint fixture: a chaos seam registry with deliberate drift against
+# bad_fault.h (see TestFaultGuard for the violation each entry seeds).
+NATIVE_SEAMS = ("ring_send", "wal_write", "ghost_seam")
+PYTHON_SEAMS = ("store", "serving")
+
+SEAM_KINDS = {
+    "ring_send": ("drop", "bit_flip"),
+    "wal_write": ("truncate",),
+    "ghost_seam": ("drop",),
+    "store": ("drop",),
+    # "serving" missing -> kind-totality violation
+    # not a registered seam -> orphan-vocabulary violation
+    "orphan_kind": ("drop",),
+}

@@ -1,0 +1,15 @@
+// graftlint fixture: a fault-engine Seam enum drifted against
+// bad_chaos.py's registry.
+#pragma once
+
+namespace tft::fault {
+
+enum Seam {
+  kSeamRingSend = 0,  // reachable: bad_fault.cc's TFT_FAULT_CHECK site
+  kSeamWalWrite = 1,  // no call site in the fixture tree -> unreachable
+  kSeamStore = 2,     // reserved for the Python-side injector: ok
+  kSeamPhantom = 3,   // no seam in bad_chaos.py -> orphan enumerator
+  // bad_chaos.py's "ghost_seam" has no enumerator -> sync violation
+};
+
+}  // namespace tft::fault

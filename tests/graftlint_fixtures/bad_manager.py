@@ -2,8 +2,9 @@
 
 
 class Manager:
-    def __init__(self, collectives):
+    def __init__(self, collectives, iso_collectives=None):
         self._collectives = collectives
+        self._iso_collectives = iso_collectives
 
     def allreduce(self, tree):
         # Violation: touches a managed collective without routing through
@@ -21,6 +22,22 @@ class Manager:
             raise
         finally:
             self._collectives.reduce_scatter  # managed-op reference
+
+    def iso_allreduce(self, tree):
+        # Violation: the isolated data plane carries the same discipline
+        # — a raw self._iso_collectives collective outside dispatch.
+        return self._iso_collectives.allreduce(tree)
+
+    def plan_reduce_scatter(self, tree):
+        # Violation: non-ValueError raised at method level (outside any
+        # dispatch closure) on a managed plan-path op.
+        if tree is None:
+            raise RuntimeError("no tree to shard")
+
+        def dispatch(t):
+            return self._collectives.plan_reduce_scatter(t)
+
+        return self._managed_dispatch("plan_reduce_scatter", tree, dispatch)
 
     def _managed_dispatch(self, op_name, tree):
         # Violation: the dispatch helper re-raises instead of latching.

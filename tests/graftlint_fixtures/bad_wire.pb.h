@@ -1,0 +1,84 @@
+// proto_sync fixture: the pb_fallback side of a deliberately drifted
+// pair (see bad_wire.proto for the failure mode each field seeds).
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace torchft_tpu {
+
+class FixMember {
+ public:
+  void AppendTo(std::string& out) const {
+    tft_pb::put_str(out, 1, replica_id_);
+    tft_pb::put_int64(out, 2, step_);
+    // field 4 in the proto -> number mismatch
+    tft_pb::put_str(out, 5, shifted_);
+    if (nonce_ != 0) {
+      tft_pb::put_tag(out, 6, 0);
+      tft_pb::put_varint(out, nonce_);
+    }
+    // not in the proto at all -> header-only violation; Field() below
+    // has no case 9 either -> write-only (parser drops it) violation
+    tft_pb::put_bool(out, 9, extra_in_header_);
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    switch (f) {
+      case 1: if (w == 2) { replica_id_ = r.bytes(); return true; } break;
+      case 2: if (w == 0) { step_ = static_cast<int64_t>(r.varint()); return true; } break;
+      case 5: if (w == 2) { shifted_ = r.bytes(); return true; } break;
+      case 6: if (w == 0) { nonce_ = r.varint(); return true; } break;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  std::string replica_id_;
+  int64_t step_ = 0;
+  std::string shifted_;
+  uint64_t nonce_ = 0;
+  bool extra_in_header_ = false;
+};
+
+// clean control: matches its message exactly (single-field "if" parser
+// style and a repeated sub-message written from a for-loop)
+class FixQuorum {
+ public:
+  void AppendTo(std::string& out) const {
+    tft_pb::put_int64(out, 1, quorum_id_);
+    for (const auto& p : participants_)
+      tft_pb::put_len_prefixed(out, 2, p.SerializeAsString());
+  }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 0) { quorum_id_ = static_cast<int64_t>(r.varint()); return true; }
+    if (f == 2 && w == 2) {
+      FixMember m;
+      if (!m.ParseFromString(r.bytes())) { r.fail = true; return true; }
+      participants_.push_back(std::move(m));
+      return true;
+    }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t quorum_id_ = 0;
+  std::vector<FixMember> participants_;
+};
+
+// no message in the proto -> missing-message violation
+class FixOnlyHeader {
+ public:
+  void AppendTo(std::string& out) const { tft_pb::put_int64(out, 1, y_); }
+  bool Field(tft_pb::Reader& r, uint32_t f, uint32_t w) {
+    if (f == 1 && w == 0) { y_ = static_cast<int64_t>(r.varint()); return true; }
+    return false;
+  }
+  TFT_PB_COMMON()
+
+ private:
+  int64_t y_ = 0;
+};
+
+}  // namespace torchft_tpu

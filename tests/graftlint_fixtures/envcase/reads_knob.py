@@ -1,6 +1,24 @@
-# graftlint fixture: reads a TORCHFT_* knob the fixture docs don't
-# mention (and one they do, as the clean control).
+# graftlint fixture: reads TORCHFT_* knobs the fixture docs don't
+# mention (and one they do, as the clean control) — covering the direct
+# os.environ forms, the typed _env_* helper form, and the _ENV_*
+# module-constant indirection.
 import os
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name)
+    return default if raw is None else int(raw)
+
 
 UNDOCUMENTED = os.environ.get("TORCHFT_FIXTURE_UNDOCUMENTED", "0")
 DOCUMENTED = os.getenv("TORCHFT_FIXTURE_DOCUMENTED")
+
+# helper-read form: must be seen as a read of the named knob
+HELPER_READ = _env_int("TORCHFT_FIXTURE_HELPER", 3)
+
+# constant-indirection form: the read happens via the _ENV_* name
+_ENV_INDIRECT = "TORCHFT_FIXTURE_INDIRECT"
+# defined but never passed to a read: must NOT count as a read
+_ENV_NEVER_READ = "TORCHFT_FIXTURE_NEVER_READ"
+
+INDIRECT = os.environ.get(_ENV_INDIRECT)

@@ -2,8 +2,9 @@
 
 
 class Manager:
-    def __init__(self, collectives):
+    def __init__(self, collectives, iso_collectives=None):
         self._collectives = collectives
+        self._iso_collectives = iso_collectives
         self._errored = None
 
     def allreduce(self, tree, op="avg"):
@@ -15,6 +16,20 @@ class Manager:
             return self._collectives.allreduce(t)
 
         return self._managed_dispatch("allreduce", tree, dispatch)
+
+    def iso_allreduce(self, tree):
+        if tree is None:
+            # Eager static-usage error: allowed.
+            raise ValueError("tree required")
+
+        def dispatch(t):
+            if self._errored:
+                # Runs under _managed_dispatch's try, so raising here IS
+                # latching — the rule must not flag it.
+                raise RuntimeError("isolated plane unusable this quorum")
+            return self._iso_collectives.allreduce(t)
+
+        return self._managed_dispatch("iso_allreduce", tree, dispatch)
 
     def _managed_dispatch(self, op_name, tree, dispatch):
         try:

@@ -1,0 +1,217 @@
+"""graftcheck's own tests: the BFS explorer, the replay contract, every
+protocol model's clean sweep (budget-capped for CI; the committed full
+budget is the slow tier + the CI ``graftcheck`` job), and the seeded
+regressions — every deliberately-broken variant must produce a
+counterexample with a replay line, or the checker has stopped seeing
+the bug its fence exists to prevent.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+import graftcheck  # noqa: E402
+from graftcheck.core import (  # noqa: E402
+    Model,
+    ReplayError,
+    explore,
+    replay,
+)
+
+# (model, broken-variant) -> the property the counterexample must hit.
+EXPECTED_REGRESSIONS = {
+    ("step_txn", "stale_votes"): "silent_commit",
+    ("lease", "stale_digest"): "hb_monotonic",
+    ("lease", "no_prune"): "no_expired_in_quorum",
+    ("wal", "publish_before_log"): "promise_durable",
+    ("wal", "no_fence_probe"): "qid_monotone",
+    ("durable", "commit_without_fence"): "commit_complete",
+    ("durable", "delete_before_retire"): "commit_complete",
+    ("durable", "use_torn_tail"): "torn_manifest_wins",
+    ("decision", "leader_broadcast"): "uniform_data_step",
+    ("decision", "argmin_all_sentinel"): "adopt_sentinel",
+    ("serving", "no_integrity"): "no_torn_install",
+}
+
+
+class _Counter(Model):
+    """Tiny reference system: a counter that may inc or (once) skip, with
+    the property that it never reaches 4 via a skip."""
+
+    name = "counter"
+    properties = ("no_skip_to_4",)
+
+    def initial(self):
+        return (0, 0)  # (value, skipped)
+
+    def actions(self, state):
+        v, skipped = state
+        acts = []
+        if v < 4:
+            acts.append(("inc", (v + 1, skipped)))
+        if not skipped and v < 3:
+            acts.append(("skip", (v + 2, 1)))
+        return acts
+
+    def check(self, state):
+        v, skipped = state
+        return ["no_skip_to_4"] if (v == 4 and skipped) else []
+
+
+class TestCore:
+    def test_bfs_finds_shortest_violation(self):
+        result = explore(_Counter())
+        assert result.violation is not None
+        assert result.violation.prop == "no_skip_to_4"
+        # BFS: the 3-action witness (skip, inc, inc), not a longer one.
+        assert len(result.violation.trace) == 3
+        assert result.violation.trace.count("skip") == 1
+
+    def test_exploration_complete_and_deduped(self):
+        class Clean(_Counter):
+            def check(self, state):
+                return []
+
+        result = explore(Clean())
+        assert result.complete and not result.truncated_by
+        # states (v, s): (0,0) (1,0) (2,0) (3,0) (4,0) (2,1) (3,1) (4,1)
+        assert result.states == 8
+        assert result.ok
+
+    def test_budget_truncation_flagged(self):
+        class Clean(_Counter):
+            def check(self, state):
+                return []
+
+        result = explore(Clean(), max_states=3)
+        assert not result.complete and result.truncated_by == "max_states"
+
+    def test_replay_follows_labels_and_rejects_unknown(self):
+        model = _Counter()
+        states = replay(model, ["skip", "inc", "inc"])
+        assert states[0] == (0, 0) and states[-1] == (4, 1)
+        assert model.check(states[-1]) == ["no_skip_to_4"]
+        with pytest.raises(ReplayError):
+            replay(model, ["warp"])
+
+    def test_replay_line_format(self):
+        result = explore(_Counter())
+        line = result.violation.replay_line()
+        assert line.startswith("replay: --model counter --trace '")
+        labels = json.loads(line.split("--trace ", 1)[1].strip("'"))
+        assert tuple(labels) == tuple(result.violation.trace)
+
+
+class TestModelsClean:
+    """Every model's correct variant is violation-free. CI-tier budget is
+    capped (seconds); the committed full budget runs in the slow tier
+    and the dedicated CI job."""
+
+    @pytest.mark.parametrize("name", graftcheck.MODEL_NAMES)
+    def test_capped_sweep_clean(self, name):
+        result = explore(graftcheck.make(name), max_states=60_000)
+        assert result.violation is None, result.violation.replay_line()
+        # a model this small would assert nothing worth checking
+        assert result.states > 1_000
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name", graftcheck.MODEL_NAMES)
+    def test_full_budget_sweep_clean(self, name):
+        result = explore(graftcheck.make(name))
+        assert result.violation is None, result.violation.replay_line()
+
+    def test_nontrivial_state_spaces(self):
+        # The --dryrun CI smoke's contract: >=1 model clears 10k distinct
+        # states even under a 20k cap (here: wal completes under it).
+        result = explore(graftcheck.make("wal"))
+        assert result.complete and result.states > 10_000
+
+
+class TestRegressions:
+    """The acceptance-criteria seeded regressions: a deliberately broken
+    protocol variant (e.g. a manifest commit without the WAL fence) must
+    yield a counterexample whose replay reaches the violated property."""
+
+    def test_registry_matches_expectations(self):
+        have = {
+            (name, b)
+            for name in graftcheck.MODEL_NAMES
+            for b in graftcheck.broken_variants(name)
+        }
+        assert have == set(EXPECTED_REGRESSIONS)
+
+    @pytest.mark.parametrize(
+        "name,broken", sorted(EXPECTED_REGRESSIONS), ids="/".join
+    )
+    def test_broken_variant_produces_counterexample(self, name, broken):
+        model = graftcheck.make(name, broken)
+        result = explore(model)
+        assert result.violation is not None, (
+            f"{name}/{broken}: no counterexample — the checker no longer "
+            "sees the bug this fence exists to prevent"
+        )
+        assert result.violation.prop == EXPECTED_REGRESSIONS[(name, broken)]
+        # the counterexample replays: same labels, same violating state
+        states = replay(model, result.violation.trace)
+        assert states[-1] == result.violation.state
+        assert result.violation.prop in model.check(states[-1])
+
+    def test_commit_without_fence_replay_line(self):
+        # The ISSUE's canonical regression, pinned end to end: manifest
+        # commit without the all-writers marker fence -> an incomplete
+        # set wins restore.
+        result = explore(graftcheck.make("durable", "commit_without_fence"))
+        line = result.violation.replay_line()
+        assert "--model durable_commit_without_fence" in line
+        assert "commit" in "".join(result.violation.trace)
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, str(REPO_ROOT / "scripts/graftcheck.py"), *args],
+            capture_output=True,
+            text=True,
+        )
+
+    def test_dryrun_smoke(self):
+        proc = self._run("--dryrun")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ok (max" in proc.stdout
+
+    def test_broken_variant_exits_zero_only_when_found(self):
+        proc = self._run(
+            "--model", "durable", "--broken", "commit_without_fence"
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "replay: --model durable_commit_without_fence" in proc.stdout
+
+    def test_trace_replay_reports_violation(self):
+        find = self._run(
+            "--model", "wal", "--broken", "publish_before_log"
+        )
+        trace_line = next(
+            ln for ln in find.stdout.splitlines() if "replay:" in ln
+        )
+        trace = trace_line.split("--trace ", 1)[1].strip().strip("'")
+        proc = self._run(
+            "--model", "wal", "--broken", "publish_before_log",
+            "--trace", trace,
+        )
+        assert proc.returncode == 1
+        assert "violates: promise_durable" in proc.stdout
+
+    def test_unknown_model_usage_error(self):
+        assert self._run("--model", "nope").returncode == 2
+
+    @pytest.mark.slow
+    def test_full_sweep_clean(self):
+        proc = self._run()
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "all models clean" in proc.stdout

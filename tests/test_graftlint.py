@@ -4,8 +4,9 @@ stay silent on the clean control — and the real repo must be clean.
 The fixtures under tests/graftlint_fixtures/ carry one deliberate
 violation per failure mode (C-API three-way drift, latch-discipline
 breach, undocumented env knob, deadline-less sleep loop, out-of-entry
-plan-cache mutation). If a rule's detector regresses, the seeded fixture
-stops firing and these tests — not a 2am bridge corruption — catch it.
+plan-cache mutation, chaos seam-registry drift, proto/pb_fallback wire
+drift). If a rule's detector regresses, the seeded fixture stops firing
+and these tests — not a 2am bridge corruption — catch it.
 """
 
 import sys
@@ -24,6 +25,7 @@ from tools.graftlint import (  # noqa: E402
     env_docs,
     fault_guard,
     latch_discipline,
+    proto_sync,
     sleep_deadline,
 )
 
@@ -92,6 +94,12 @@ class TestLatchDiscipline:
         assert "raises a non-ValueError on the managed path" in found
         assert "bare re-raise on the managed path" in found
         assert "_managed_dispatch exception handler re-raises" in found
+        # the isolated data plane carries the same discipline
+        assert "Manager.iso_allreduce touches a managed collective" in found
+        # the plan-path ops added since PR 4 are managed surface too
+        assert (
+            "Manager.plan_reduce_scatter raises a non-ValueError" in found
+        )
 
     def test_clean_fixture_passes(self):
         assert (
@@ -115,6 +123,13 @@ class TestEnvDocs:
         found = messages(violations)
         assert "TORCHFT_FIXTURE_UNDOCUMENTED" in found
         assert "TORCHFT_FIXTURE_DOCUMENTED" not in found
+        # the typed-helper read form (_env_int("TORCHFT_X", d)) counts
+        assert "TORCHFT_FIXTURE_HELPER" in found
+        # ...and the _ENV_* module-constant indirection
+        assert "TORCHFT_FIXTURE_INDIRECT" in found
+        # a constant that is defined but never passed to a read is not
+        # a read
+        assert "TORCHFT_FIXTURE_NEVER_READ" not in found
 
     def test_real_knobs_are_documented(self):
         assert env_docs.check(REPO_ROOT) == []
@@ -127,16 +142,40 @@ class TestEnvDocs:
 
 
 class TestFaultGuard:
-    def test_detects_raw_call_and_passes_macro_form(self):
-        violations = fault_guard.check(
-            REPO_ROOT, scan_dir=Path("tests/graftlint_fixtures")
+    def fixture_violations(self):
+        return fault_guard.check(
+            REPO_ROOT,
+            scan_dir=Path("tests/graftlint_fixtures"),
+            chaos_path=FIXTURES / "bad_chaos.py",
+            fault_h_path=FIXTURES / "bad_fault.h",
         )
-        found = messages(violations)
-        assert "raw tft_fault_maybe" in found
+
+    def test_detects_raw_call_and_passes_macro_form(self):
+        raw = [
+            v
+            for v in self.fixture_violations()
+            if "raw tft_fault_maybe" in v.message
+        ]
         # exactly the one raw call fires — the TFT_FAULT_CHECK form in
         # the same fixture must not
-        assert len(violations) == 1
-        assert "bad_fault.cc" in violations[0].file
+        assert len(raw) == 1
+        assert "bad_fault.cc" in raw[0].file
+
+    def test_detects_seam_registry_drift(self):
+        found = messages(self.fixture_violations())
+        # a native seam with no enumerator is silently unarmable
+        assert "'ghost_seam' (chaos.py NATIVE_SEAMS) has no kSeamGhostSeam" in found
+        # an enumerator with no TFT_FAULT_CHECK site tests nothing
+        assert "'wal_write' has no TFT_FAULT_CHECK call site" in found
+        # ring_send IS reachable (bad_fault.cc's macro form): not flagged
+        assert "'ring_send' has no TFT_FAULT_CHECK" not in found
+        # an enumerator no seam maps to is dead wiring
+        assert "kSeamPhantom maps to no seam" in found
+        # reserved Python-side enumerators (kSeamStore) are fine
+        assert "kSeamStore" not in found
+        # SEAM_KINDS must cover the registry exactly, both ways
+        assert "'serving' has no SEAM_KINDS vocabulary" in found
+        assert "SEAM_KINDS entry 'orphan_kind' is not a registered seam" in found
 
     def test_engine_files_are_exempt(self):
         # fault.h declares tft_fault_maybe and defines the macro;
@@ -147,6 +186,73 @@ class TestFaultGuard:
 
     def test_real_native_tree_is_clean(self):
         assert fault_guard.check(REPO_ROOT) == []
+
+
+class TestProtoSync:
+    def fixture_violations(self):
+        return proto_sync.check(
+            REPO_ROOT,
+            proto_path=FIXTURES / "bad_wire.proto",
+            header_path=FIXTURES / "bad_wire.pb.h",
+        )
+
+    def test_detects_each_drift_flavor(self):
+        found = messages(self.fixture_violations())
+        # a proto field the header never serializes
+        assert (
+            "FixMember.missing_in_header (field 3) is not serialized"
+            in found
+        )
+        # same field name, different field number
+        assert (
+            "FixMember.shifted is field 5 in the header but 4 in the "
+            "proto" in found
+        )
+        # a header field the proto doesn't know
+        assert (
+            "FixMember.extra_in_header (field 9) serialized by the "
+            "header but absent from the proto" in found
+        )
+        # write-only field: AppendTo emits it, Field() drops it
+        assert (
+            "AppendTo writes field 9 (extra_in_header) but Field() has "
+            "no case" in found
+        )
+        # whole-message drift, both directions
+        assert "message FixOnlyProto has no class" in found
+        assert "class FixOnlyHeader has no message" in found
+
+    def test_clean_controls_not_flagged(self):
+        found = messages(self.fixture_violations())
+        # repeated sub-message via for-loop, single-field "if (f == N)"
+        # parser style, and the raw put_tag/put_varint pair all parse
+        assert "FixQuorum" not in found
+        assert "nonce" not in found
+
+    def test_real_wire_contract_is_clean(self):
+        assert proto_sync.check(REPO_ROOT) == []
+
+    def test_real_pair_parses_nontrivially(self):
+        # Guards against a parser regression silently passing vacuously.
+        msgs = proto_sync.parse_proto(
+            (REPO_ROOT / "native/torchft.proto").read_text()
+        )
+        classes, problems = proto_sync.parse_header(
+            (REPO_ROOT / "native/src/pb_fallback/torchft.pb.h").read_text(),
+            "torchft.pb.h",
+        )
+        assert problems == []
+        assert len(msgs) >= 30 and len(msgs) == len(classes)
+        proto_fields = sum(len(f) for f in msgs.values())
+        header_fields = sum(len(c.fields) for c in classes.values())
+        assert proto_fields == header_fields >= 80
+        # spot-check a deep message parsed on both sides with matching
+        # numbers (the ZeRO response carries optional + packed + repeated
+        # string fields — the exotic encodings)
+        mqr = msgs["ManagerQuorumResponse"]
+        cqr = classes["ManagerQuorumResponse"].fields
+        assert mqr.keys() == cqr.keys()
+        assert all(mqr[k].number == cqr[k].number for k in mqr)
 
 
 class TestSleepDeadline:

@@ -1,0 +1,65 @@
+"""graftcheck: exhaustive protocol model checking for torchft_tpu.
+
+Six protocol cores are extracted as pure transition systems and swept
+exhaustively (bounded depth, state-hash dedup) against the chaos-plane
+invariants:
+
+- ``step_txn``  -- per-step AND-vote commit (epoch purity, no silent
+  commit over a latched error)
+- ``lease``     -- lease membership + hierarchy digests (heartbeat
+  monotonicity, no expired member in a formed quorum)
+- ``wal``       -- WAL-fenced root promises + epoch-fenced takeover
+  (promise durability, quorum_id monotonicity, single publisher)
+- ``durable``   -- durable manifest ladder (a commit record implies a
+  complete restorable set; a torn tail never wins)
+- ``decision``  -- policy decision transaction (identical argmin or
+  cohort-wide abort; never adopt a sentineled strategy)
+- ``serving``   -- serving install ladder (no torn install past the
+  nonce/CRC/digest gates)
+
+Every model ships deliberately *broken* variants (``BROKEN``) proving
+the checker finds the bug each fence exists to prevent; violations
+print a replay line in the established ``chaos_run.py`` format.
+
+Use ``make(name, broken)`` to build a model and ``core.explore`` /
+``core.replay`` to drive it; ``scripts/graftcheck.py`` is the CLI.
+"""
+
+from __future__ import annotations
+
+from . import decision, durable, lease, serving, step_txn, wal
+from .core import (  # noqa: F401  (re-exported API)
+    Counterexample,
+    Exploration,
+    Model,
+    ReplayError,
+    explore,
+    replay,
+)
+
+_MODULES = {
+    "step_txn": step_txn,
+    "lease": lease,
+    "wal": wal,
+    "durable": durable,
+    "decision": decision,
+    "serving": serving,
+}
+
+MODEL_NAMES = tuple(_MODULES)
+
+
+def make(name: str, broken: str = "") -> Model:
+    """Build a registered model (optionally one of its broken variants)."""
+    try:
+        mod = _MODULES[name]
+    except KeyError:
+        raise KeyError(
+            "unknown model %r (have: %s)" % (name, ", ".join(_MODULES))
+        )
+    return mod.make(broken)
+
+
+def broken_variants(name: str) -> tuple:
+    """The deliberately-broken variant names a model ships."""
+    return tuple(_MODULES[name].BROKEN)

@@ -1,0 +1,234 @@
+"""graftcheck core: explicit-state exploration of protocol transition systems.
+
+A *model* is a small pure transition system extracted from one of the
+repo's distributed protocols (step transaction, leases, WAL, durable
+manifest, decision transaction, serving install).  It exposes:
+
+- ``name``        -- registry key, also used in replay lines
+- ``properties``  -- documented names of the invariants ``check`` enforces
+- ``initial()``   -- the initial state, a hashable nested tuple
+- ``actions(s)``  -- ``[(label, next_state), ...]`` in deterministic order;
+                     labels are strings, unique per state (replay keys on
+                     them)
+- ``check(s)``    -- list of violated property names for state ``s``
+
+The explorer runs a breadth-first sweep with state-hash deduplication, a
+depth bound and a distinct-state budget.  Parent pointers reconstruct the
+shortest counterexample trace, which is printed as a replay line in the
+established ``chaos_run.py`` format::
+
+    replay: --model step_txn --trace '["work0", "latch1", ...]'
+
+``replay()`` re-executes a trace label-by-label from ``initial()`` so a
+counterexample can be stepped through deterministically (and so the
+conformance tests can drive the real Python objects with the same
+schedule the model explored).
+
+Determinism contract: models must not consult wall-clock time or
+ambient randomness -- all nondeterminism is enumerated as actions.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+State = Any  # hashable nested tuples
+
+
+@dataclass(frozen=True)
+class Counterexample:
+    """Shortest-path witness of a property violation."""
+
+    model: str
+    prop: str
+    trace: tuple  # action labels from initial() to the violating state
+    state: State
+
+    def replay_line(self) -> str:
+        # Mirrors scripts/chaos_run.py's "replay: --config ... --seed ..."
+        return "replay: --model %s --trace '%s'" % (
+            self.model,
+            json.dumps(list(self.trace)),
+        )
+
+
+@dataclass
+class Exploration:
+    """Result of one exhaustive sweep."""
+
+    model: str
+    states: int = 0  # distinct states reached
+    transitions: int = 0  # edges examined (including duplicates)
+    depth_reached: int = 0
+    complete: bool = False  # frontier drained within the budget
+    truncated_by: str = ""  # "", "max_states", or "max_depth"
+    violation: Optional[Counterexample] = None
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+    def summary(self) -> str:
+        status = "ok" if self.ok else "VIOLATION(%s)" % self.violation.prop
+        scope = "complete" if self.complete else "truncated:%s" % self.truncated_by
+        return "%-12s %9d states %10d transitions  depth %3d  %-22s %6.1fs  %s" % (
+            self.model,
+            self.states,
+            self.transitions,
+            self.depth_reached,
+            scope,
+            self.elapsed_s,
+            status,
+        )
+
+
+class Model:
+    """Base class for protocol models (subclasses override the four hooks)."""
+
+    name = "model"
+    properties: tuple = ()
+
+    def initial(self) -> State:
+        raise NotImplementedError
+
+    def actions(self, state: State) -> list:
+        raise NotImplementedError
+
+    def check(self, state: State) -> list:
+        raise NotImplementedError
+
+    # Committed exploration budget: exhaustive up to this depth / state count.
+    def budget(self) -> dict:
+        return {"max_depth": 64, "max_states": 400_000}
+
+
+def explore(
+    model: Model,
+    max_depth: Optional[int] = None,
+    max_states: Optional[int] = None,
+    progress: Optional[Callable[[int], None]] = None,
+) -> Exploration:
+    """Breadth-first exhaustive sweep of ``model`` with dedup and budgets.
+
+    Stops at the first property violation (BFS order makes the witness a
+    shortest trace) or when the frontier drains / the budget trips.
+    """
+    budget = model.budget()
+    if max_depth is None:
+        max_depth = budget["max_depth"]
+    if max_states is None:
+        max_states = budget["max_states"]
+
+    t0 = time.monotonic()
+    result = Exploration(model=model.name)
+
+    init = model.initial()
+    # state -> (parent_state, label_from_parent); None for the root.
+    seen: dict = {init: None}
+    queue: deque = deque([(init, 0)])
+    result.states = 1
+
+    violated = model.check(init)
+    if violated:
+        result.violation = Counterexample(model.name, violated[0], (), init)
+        result.elapsed_s = time.monotonic() - t0
+        return result
+
+    truncated_depth = False
+    while queue:
+        state, depth = queue.popleft()
+        if depth > result.depth_reached:
+            result.depth_reached = depth
+        if depth >= max_depth:
+            truncated_depth = True
+            continue
+        for label, nxt in model.actions(state):
+            result.transitions += 1
+            if nxt in seen:
+                continue
+            seen[nxt] = (state, label)
+            result.states += 1
+            if progress is not None and result.states % 50_000 == 0:
+                progress(result.states)
+            violated = model.check(nxt)
+            if violated:
+                result.violation = Counterexample(
+                    model.name, violated[0], _trace(seen, nxt), nxt
+                )
+                result.elapsed_s = time.monotonic() - t0
+                return result
+            if result.states >= max_states:
+                result.truncated_by = "max_states"
+                result.elapsed_s = time.monotonic() - t0
+                return result
+            queue.append((nxt, depth + 1))
+
+    result.complete = not truncated_depth
+    if truncated_depth:
+        result.truncated_by = "max_depth"
+    result.elapsed_s = time.monotonic() - t0
+    return result
+
+
+def _trace(seen: dict, state: State) -> tuple:
+    labels = []
+    cur = state
+    while seen[cur] is not None:
+        parent, label = seen[cur]
+        labels.append(label)
+        cur = parent
+    return tuple(reversed(labels))
+
+
+class ReplayError(Exception):
+    pass
+
+
+def replay(model: Model, trace: Iterable[str]) -> list:
+    """Re-execute ``trace`` from ``initial()``; returns the visited states.
+
+    Each label must name exactly one enabled action in the state where it
+    is applied -- models keep labels unique per state for this reason.
+    """
+    state = model.initial()
+    states = [state]
+    for i, label in enumerate(trace):
+        matches = [nxt for lbl, nxt in model.actions(state) if lbl == label]
+        if not matches:
+            raise ReplayError(
+                "%s: step %d: action %r not enabled" % (model.name, i, label)
+            )
+        if len(matches) > 1:
+            raise ReplayError(
+                "%s: step %d: action %r ambiguous (%d matches)"
+                % (model.name, i, label, len(matches))
+            )
+        state = matches[0]
+        states.append(state)
+    return states
+
+
+# ---------------------------------------------------------------------------
+# Small helpers shared by the models.
+
+
+def tup_set(items) -> tuple:
+    """Canonical (sorted, deduplicated) tuple -- a hashable set."""
+    return tuple(sorted(set(items)))
+
+
+def tup_bag(items) -> tuple:
+    """Canonical (sorted) tuple with duplicates kept -- a hashable multiset."""
+    return tuple(sorted(items))
+
+
+def bag_remove(bag: tuple, item) -> tuple:
+    """Remove one occurrence of ``item`` from a canonical multiset."""
+    out = list(bag)
+    out.remove(item)
+    return tuple(out)

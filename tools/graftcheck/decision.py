@@ -1,0 +1,300 @@
+"""Model: policy decision transaction (identical-argmin-or-abort).
+
+Protocol core being modeled (torchft_tpu/policy.py
+``_decide_and_maybe_switch``):
+
+- At a window boundary every member contributes its measured signal
+  vector to one allgather.  The collective is all-or-nothing: either
+  every live member receives the identical gathered table, or it fails
+  for the whole cohort and the window is skipped.
+- Every member runs the same deterministic aggregation + pure argmin
+  (``_choose``) over the identical table: a challenger must beat the
+  incumbent by the hysteresis margin; a strategy whose cohort is
+  unusable carries ``SENTINEL_COST_S`` and is never adopted; if every
+  strategy is sentineled the incumbent is kept.
+- The switch rides the same AND-vote commit as a training step
+  (``should_commit(count_batches=False)``): on commit every member
+  adopts the (identical) choice; on abort nobody does.
+- A member that crashes re-joins by healing from a donor, adopting the
+  donor's strategy -- never by replaying its own stale decision.
+
+Fault actions: cohort-wide gather failure, member crash, member rejoin
+(heal), and -- in the broken variant -- a dropped adoption broadcast.
+
+Properties:
+
+- ``uniform_data_step`` -- a data (training) step never runs while live
+  members disagree on the strategy (mixed strategies means mixed
+  collective schedules: a hang or a silent gradient mismatch).
+- ``adopt_sentinel``    -- a decision never switches *to* a strategy
+  whose cohort is currently unusable (sentinel cost).
+
+Broken variants:
+
+- ``leader_broadcast`` replaces the voted transaction with a leader
+  computing the choice and broadcasting per-member adopt messages; one
+  dropped message leaves the fleet mixed at the next data step.
+- ``argmin_all_sentinel`` argmins over the raw table even when every
+  strategy is sentineled, switching onto an unusable cohort instead of
+  keeping the incumbent.
+"""
+
+from __future__ import annotations
+
+from .core import Model, bag_remove, tup_bag
+
+MEASURE, MEASURED, DECIDED, READY = 0, 1, 2, 3
+SENT = 100  # stands in for SENTINEL_COST_S
+HYST_NUM, HYST_DEN = 3, 4  # hysteresis: challenger must beat cur * 3/4
+
+# Per-member measured signal vectors (cost contribution of strategy 0,
+# strategy 1).  MEAS_SENT reports the member's cohort unusable for that
+# strategy; the aggregated cost saturates at SENT.
+MEASURES = ((1, 2), (2, 1), (1, SENT), (SENT, SENT))
+
+
+def aggregate(vectors):
+    """The gather's deterministic aggregation: saturating elementwise sum."""
+    costs = [0, 0]
+    for v in vectors:
+        for s in range(2):
+            costs[s] = min(SENT, costs[s] + v[s])
+    return tuple(costs)
+
+
+def choose(costs, cur):
+    """Mirror of policy._choose: hysteresis argmin with sentinel guards."""
+    usable = [s for s in range(len(costs)) if costs[s] < SENT]
+    if not usable:
+        return cur  # every cohort unusable: keep the incumbent
+    if costs[cur] >= SENT:
+        return min(usable, key=lambda s: (costs[s], s))
+    best = min(usable, key=lambda s: (costs[s], s))
+    # Challenger must beat cur * (1 - hysteresis) with hysteresis = 1/4.
+    if best != cur and costs[best] * HYST_DEN < costs[cur] * HYST_NUM:
+        return best
+    return cur
+
+
+class DecisionModel(Model):
+    name = "decision"
+    properties = ("uniform_data_step", "adopt_sentinel")
+
+    def __init__(
+        self,
+        world: int = 3,
+        rounds: int = 3,
+        crashes: int = 1,
+        gfails: int = 1,
+        drops: int = 1,
+        leader_broadcast: bool = False,
+        argmin_all_sentinel: bool = False,
+    ):
+        self.world = world
+        self.rounds = rounds
+        self.faults0 = (crashes, gfails, drops)
+        self.leader_broadcast = bool(leader_broadcast)
+        self.argmin_all_sentinel = bool(argmin_all_sentinel)
+        if leader_broadcast:
+            self.name = "decision_leader_broadcast"
+        elif argmin_all_sentinel:
+            self.name = "decision_argmin_all_sentinel"
+
+    def budget(self) -> dict:
+        return {"max_depth": 48, "max_states": 400_000}
+
+    def _choose(self, costs, cur):
+        if self.argmin_all_sentinel:
+            return min(range(len(costs)), key=lambda s: (costs[s], s))
+        return choose(costs, cur)
+
+    # State:
+    #   members : tuple of (alive, strategy, phase, pending_choice, vec)
+    #             vec = index into MEASURES picked this window (-1 unset)
+    #   round   : decision windows completed
+    #   costs   : the gathered, aggregated cost table for the current
+    #             window (() before the gather)
+    #   msgs    : adopt messages in flight (broken variant only):
+    #             ("adopt", member, choice)
+    #   flags   : (mixed_data_step, adopted_sentinel)
+    #   faults  : (crashes, gfails, drops) remaining
+    def initial(self):
+        members = tuple((1, 0, MEASURE, -1, -1) for _ in range(self.world))
+        return (members, 0, (), (), (0, 0), self.faults0)
+
+    def check(self, state):
+        flags = state[4]
+        out = []
+        if flags[0]:
+            out.append("uniform_data_step")
+        if flags[1]:
+            out.append("adopt_sentinel")
+        return out
+
+    def actions(self, state):
+        members, rnd, costs, msgs, flags, faults = state
+        crashes, gfails, drops = faults
+        acts = []
+        live = [i for i in range(self.world) if members[i][0]]
+        if not live or rnd >= self.rounds:
+            return acts
+
+        all_phase = {members[i][2] for i in live}
+
+        # Each member measures its local signal vector for the window.
+        for i in live:
+            a, st, ph, pc, vec = members[i]
+            if ph == MEASURE:
+                for v in range(len(MEASURES)):
+                    nm = _set(members, i, (a, st, MEASURED, pc, v))
+                    acts.append(
+                        ("measure%d_v%d" % (i, v),
+                         (nm, rnd, costs, msgs, flags, faults))
+                    )
+
+        # Window gather: all-or-nothing; every member receives the same
+        # aggregated table and runs the same pure argmin.
+        if all_phase == {MEASURED} and not costs:
+            table = aggregate(tuple(MEASURES[members[i][4]] for i in live))
+            nm = list(members)
+            for i in live:
+                a, st, _ph, _pc, vec = members[i]
+                nm[i] = (a, st, DECIDED, self._choose(table, st), vec)
+            acts.append(
+                ("gather_r%d" % rnd,
+                 (tuple(nm), rnd, table, msgs, flags, faults))
+            )
+            if gfails > 0:
+                # Cohort-wide collective failure: window skipped.
+                nm = tuple(
+                    (a, st, READY, -1, -1) if a else m
+                    for m in members
+                    for (a, st, ph, pc, vec) in (m,)
+                )
+                acts.append(
+                    ("gather_r%d_fail" % rnd,
+                     (nm, rnd, costs, msgs, flags,
+                      (crashes, gfails - 1, drops)))
+                )
+
+        # The voted transaction: on commit every live member adopts its
+        # (identical) choice atomically; on abort nobody does.
+        if all_phase == {DECIDED} and costs:
+            if self.leader_broadcast:
+                # Broken: the leader (lowest live id) broadcasts per-member
+                # adopt messages instead of riding the vote.
+                leader_choice = members[live[0]][3]
+                adopts = tuple(("adopt", i, leader_choice) for i in live)
+                acts.append(
+                    ("bcast_r%d" % rnd,
+                     (members, rnd, costs, tup_bag(msgs + adopts), flags,
+                      faults))
+                )
+            else:
+                nm = list(members)
+                sent_flag = flags[1]
+                for i in live:
+                    a, st, _ph, pc, vec = members[i]
+                    if pc != st and costs[pc] >= SENT:
+                        sent_flag = 1
+                    nm[i] = (a, pc, READY, -1, vec)
+                acts.append(
+                    ("commit_r%d" % rnd,
+                     (tuple(nm), rnd, costs, msgs, (flags[0], sent_flag),
+                      faults))
+                )
+            nm = tuple(
+                (a, st, READY, -1, vec) if a else m
+                for m in members
+                for (a, st, ph, pc, vec) in (m,)
+            )
+            acts.append(
+                ("abort_r%d" % rnd,
+                 (nm, rnd, costs, msgs, flags, faults))
+            )
+
+        # Broken-variant adopt delivery / drop.
+        for m in sorted(set(msgs)):
+            rest = bag_remove(msgs, m)
+            _k, i, choice = m
+            a, st, ph, pc, vec = members[i]
+            nm = members
+            sent_flag = flags[1]
+            if a and ph == DECIDED:
+                if choice != st and costs[choice] >= SENT:
+                    sent_flag = 1
+                nm = _set(members, i, (a, choice, READY, -1, vec))
+            acts.append(
+                ("rx_adopt%d_c%d" % (i, choice),
+                 (nm, rnd, costs, rest, (flags[0], sent_flag), faults))
+            )
+            if drops > 0:
+                # The dropped broadcast: the member times out waiting and
+                # keeps its current strategy for the next window.
+                nm = members
+                if a and ph == DECIDED:
+                    nm = _set(members, i, (a, st, READY, -1, vec))
+                acts.append(
+                    ("drop_adopt%d" % i,
+                     (nm, rnd, costs, rest, flags,
+                      (crashes, gfails, drops - 1)))
+                )
+
+        # Data step: a lockstep collective over the live members.  Mixed
+        # strategies here is the property violation.
+        if all_phase == {READY} and not msgs:
+            strategies = {members[i][1] for i in live}
+            nflags = (flags[0] or (1 if len(strategies) > 1 else 0), flags[1])
+            nm = tuple(
+                (a, st, MEASURE, -1, -1) if a else m
+                for m in members
+                for (a, st, ph, pc, vec) in (m,)
+            )
+            acts.append(
+                ("data_step_r%d" % rnd,
+                 (nm, rnd + 1, (), msgs, nflags, faults))
+            )
+
+        # Faults: crash / heal-rejoin.
+        for i in live:
+            if crashes > 0:
+                a, st, ph, pc, vec = members[i]
+                nm = _set(members, i, (0, st, ph, pc, vec))
+                acts.append(
+                    ("crash%d" % i,
+                     (nm, rnd, costs, msgs, flags,
+                      (crashes - 1, gfails, drops)))
+                )
+        for i in range(self.world):
+            if not members[i][0] and live:
+                # Heal: adopt a donor's strategy; the rejoiner enters at
+                # the cohort's next window boundary.
+                donor = members[live[0]][1]
+                nm = _set(members, i, (1, donor, MEASURE, -1, -1))
+                only_measure = all(
+                    members[j][2] == MEASURE for j in live
+                )
+                if only_measure and not costs:
+                    acts.append(
+                        ("rejoin%d" % i,
+                         (nm, rnd, costs, msgs, flags, faults))
+                    )
+
+        return acts
+
+
+def _set(t, i, v):
+    return t[:i] + (v,) + t[i + 1:]
+
+
+def make(broken: str = "") -> Model:
+    if broken == "leader_broadcast":
+        return DecisionModel(leader_broadcast=True)
+    if broken == "argmin_all_sentinel":
+        return DecisionModel(argmin_all_sentinel=True)
+    if broken:
+        raise ValueError("decision: unknown broken variant %r" % broken)
+    return DecisionModel()
+
+
+BROKEN = ("leader_broadcast", "argmin_all_sentinel")

@@ -1,0 +1,332 @@
+"""Model: durable manifest ladder (async sharded checkpoint commit).
+
+Protocol core being modeled (torchft_tpu/durable.py):
+
+- Each of the W members writes its shard payload durably, then -- and
+  only then -- publishes its marker (``_write_snapshot``: marker JSON
+  lands strictly after the payload fsync).
+- Rank 0 polls; when it has seen *all W* markers, and they are mutually
+  consistent (same step / quorum_id / world), it appends a CRC-framed
+  ``commit`` record to the manifest log.  A torn manifest append kills
+  the log (no further commits).
+- A quorum change aborts in-flight snapshot sets; aborted objects are
+  cleaned up (payload first, then marker -- a marker without a payload
+  belongs to a stale quorum and fails the consistency check).
+- Old committed sets are garbage-collected only behind a ``retire``
+  record: retire is appended durably *before* any object of that set is
+  deleted.
+- Restore replays the manifest, drops a torn tail, and picks the newest
+  committed non-retired set whose objects all verify.
+
+Fault actions: member crash mid-write, quorum change, torn manifest
+append.
+
+Properties:
+
+- ``commit_complete``    -- every committed, non-retired set has all W
+  shard objects durably present (a commit record is a promise that a
+  restore from this set cannot fail).
+- ``torn_manifest_wins`` -- a torn tail record is never interpreted as
+  a commit (its CRC frame cannot verify; its bytes are garbage).
+
+Broken variants:
+
+- ``commit_without_fence`` commits once *any* marker is present instead
+  of all W: a member crash between its peers' markers and its own shard
+  write leaves a committed set missing a shard -- the acceptance
+  regression from the issue.
+- ``delete_before_retire`` deletes a superseded set's objects before
+  appending the retire record: a committed, still-live set loses its
+  shards.
+- ``use_torn_tail`` replays a torn tail record as if it were a valid
+  commit.
+"""
+
+from __future__ import annotations
+
+from .core import Model
+
+INFLIGHT, DONE, ABORTED = 0, 1, 2
+
+
+class DurableModel(Model):
+    name = "durable"
+    properties = ("commit_complete", "torn_manifest_wins")
+
+    def __init__(
+        self,
+        world: int = 2,
+        nsets: int = 3,
+        crashes: int = 1,
+        qchanges: int = 1,
+        torn: int = 1,
+        commit_without_fence: bool = False,
+        delete_before_retire: bool = False,
+        use_torn_tail: bool = False,
+    ):
+        self.world = world
+        self.nsets = nsets
+        self.faults0 = (crashes, qchanges, torn)
+        self.commit_without_fence = bool(commit_without_fence)
+        self.delete_before_retire = bool(delete_before_retire)
+        self.use_torn_tail = bool(use_torn_tail)
+        if commit_without_fence:
+            self.name = "durable_commit_without_fence"
+        elif delete_before_retire:
+            self.name = "durable_delete_before_retire"
+        elif use_torn_tail:
+            self.name = "durable_use_torn_tail"
+
+    def budget(self) -> dict:
+        return {"max_depth": 64, "max_states": 400_000}
+
+    # State:
+    #   sets     : tuple over set ids 1..nsets of
+    #              (status, qid, per-writer (shard, marker) bit pairs);
+    #              set 0 is the pre-existing committed baseline, its
+    #              objects tracked in `objects0`
+    #   objects0 : per-writer shard-present bits for baseline set 0
+    #   manifest : tuple of ("commit", set) | ("retire", set) | ("torn", set)
+    #   qid      : current quorum id
+    #   crashed  : per-writer crashed bits
+    #   faults   : (crashes, qchanges, torn) remaining
+    def initial(self):
+        sets = tuple(
+            (INFLIGHT if s == 0 else -1, 1, ((0, 0),) * self.world)
+            for s in range(self.nsets)
+        )
+        return (
+            sets,
+            (1,) * self.world,
+            (("commit", 0),),
+            1,
+            (0,) * self.world,
+            self.faults0,
+        )
+
+    def _live_commits(self, manifest):
+        """Committed, non-retired set ids from the replayable prefix."""
+        committed, retired = [], set()
+        for rec in manifest:
+            if rec[0] == "torn":
+                if self.use_torn_tail:
+                    committed.append(rec[1])  # garbage interpreted as commit
+                break
+            if rec[0] == "commit":
+                committed.append(rec[1])
+            else:
+                retired.add(rec[1])
+        return [s for s in committed if s not in retired]
+
+    def check(self, state):
+        sets, objects0, manifest, qid, crashed, faults = state
+        out = []
+        for s in self._live_commits(manifest):
+            if s == 0:
+                complete = all(objects0)
+            else:
+                complete = all(w[0] for w in sets[s - 1][2])
+            if not complete:
+                out.append("commit_complete")
+                break
+        for rec in manifest:
+            if rec[0] == "torn" and self.use_torn_tail:
+                # Interpreting garbage bytes as a record is itself the
+                # violation the CRC frame exists to prevent.
+                if rec[1] in self._live_commits(manifest):
+                    out.append("torn_manifest_wins")
+                break
+        return out
+
+    def actions(self, state):
+        sets, objects0, manifest, qid, crashed, faults = state
+        crashes, qchanges, torn = faults
+        acts = []
+        log_dead = any(rec[0] == "torn" for rec in manifest)
+        committed = [
+            rec[1] for rec in manifest if rec[0] == "commit"
+        ]
+        retired = {rec[1] for rec in manifest if rec[0] == "retire"}
+
+        # Start the next snapshot set once the previous one resolved.
+        for si in range(self.nsets):
+            status = sets[si][0]
+            if status == -1:
+                prev_ok = si == 0 or sets[si - 1][0] in (DONE, ABORTED)
+                if prev_ok and not log_dead:
+                    nsets_ = _set(sets, si, (INFLIGHT, qid, sets[si][2]))
+                    acts.append(
+                        ("start_set%d" % (si + 1),
+                         (nsets_, objects0, manifest, qid, crashed, faults))
+                    )
+                break
+
+        for si in range(self.nsets):
+            status, sqid, writers = sets[si]
+            if status != INFLIGHT:
+                continue
+            sid = si + 1
+            if sqid == qid:
+                for w in range(self.world):
+                    if crashed[w]:
+                        continue
+                    shard, marker = writers[w]
+                    if not shard:
+                        nw = _set(writers, w, (1, 0))
+                        acts.append(
+                            ("shard_s%d_w%d" % (sid, w),
+                             (_set(sets, si, (status, sqid, nw)), objects0,
+                              manifest, qid, crashed, faults))
+                        )
+                    elif not marker:
+                        # The ladder: marker strictly after the payload.
+                        nw = _set(writers, w, (1, 1))
+                        acts.append(
+                            ("marker_s%d_w%d" % (sid, w),
+                             (_set(sets, si, (status, sqid, nw)), objects0,
+                              manifest, qid, crashed, faults))
+                        )
+                markers = [w[1] for w in writers]
+                fence_ok = (
+                    any(markers) if self.commit_without_fence
+                    else all(markers)
+                )
+                if fence_ok and not log_dead:
+                    nm = manifest + (("commit", sid),)
+                    acts.append(
+                        ("commit_s%d" % sid,
+                         (_set(sets, si, (DONE, sqid, writers)), objects0, nm,
+                          qid, crashed, faults))
+                    )
+                    if torn > 0:
+                        nm = manifest + (("torn", sid),)
+                        acts.append(
+                            ("commit_s%d_torn" % sid,
+                             (_set(sets, si, (ABORTED, sqid, writers)),
+                              objects0, nm, qid, crashed,
+                              (crashes, qchanges, torn - 1)))
+                        )
+                # Deadline abandon: a crashed member will never produce
+                # its marker; rank0 gives up on the set.
+                if any(crashed) and not all(markers):
+                    acts.append(
+                        ("abandon_s%d" % sid,
+                         (_set(sets, si, (ABORTED, sqid, writers)), objects0,
+                          manifest, qid, crashed, faults))
+                    )
+            else:
+                # Stale quorum: the fence aborts the in-flight set.
+                acts.append(
+                    ("fence_s%d" % sid,
+                     (_set(sets, si, (ABORTED, sqid, writers)), objects0,
+                      manifest, qid, crashed, faults))
+                )
+
+        # Cleanup of aborted sets: payload first, then marker.
+        for si in range(self.nsets):
+            status, sqid, writers = sets[si]
+            if status != ABORTED:
+                continue
+            sid = si + 1
+            for w in range(self.world):
+                shard, marker = writers[w]
+                if shard:
+                    nw = _set(writers, w, (0, marker))
+                    acts.append(
+                        ("clean_shard_s%d_w%d" % (sid, w),
+                         (_set(sets, si, (status, sqid, nw)), objects0,
+                          manifest, qid, crashed, faults))
+                    )
+                elif marker:
+                    nw = _set(writers, w, (0, 0))
+                    acts.append(
+                        ("clean_marker_s%d_w%d" % (sid, w),
+                         (_set(sets, si, (status, sqid, nw)), objects0,
+                          manifest, qid, crashed, faults))
+                    )
+
+        # Retire + garbage-collect superseded committed sets.
+        live = [s for s in committed if s not in retired]
+        if len(live) > 1:
+            old = min(live)
+            if self.delete_before_retire:
+                # Broken: delete objects of a still-live committed set.
+                for w in range(self.world):
+                    present = objects0[w] if old == 0 else sets[old - 1][2][w][0]
+                    if present:
+                        if old == 0:
+                            nobj0 = _set(objects0, w, 0)
+                            nsets_ = sets
+                        else:
+                            nobj0 = objects0
+                            si = old - 1
+                            st, sq, wr = sets[si]
+                            nsets_ = _set(
+                                sets, si,
+                                (st, sq, _set(wr, w, (0, wr[w][1]))),
+                            )
+                        acts.append(
+                            ("gc_shard_s%d_w%d" % (old, w),
+                             (nsets_, nobj0, manifest, qid, crashed, faults))
+                        )
+            elif not log_dead:
+                # The retire fence: record first, delete after.
+                acts.append(
+                    ("retire_s%d" % old,
+                     (sets, objects0, manifest + (("retire", old),), qid,
+                      crashed, faults))
+                )
+        for old in sorted(retired):
+            for w in range(self.world):
+                present = objects0[w] if old == 0 else sets[old - 1][2][w][0]
+                if present:
+                    if old == 0:
+                        nobj0 = _set(objects0, w, 0)
+                        nsets_ = sets
+                    else:
+                        nobj0 = objects0
+                        si = old - 1
+                        st, sq, wr = sets[si]
+                        nsets_ = _set(
+                            sets, si, (st, sq, _set(wr, w, (0, wr[w][1]))),
+                        )
+                    acts.append(
+                        ("gc_shard_s%d_w%d" % (old, w),
+                         (nsets_, nobj0, manifest, qid, crashed, faults))
+                    )
+
+        # Faults.
+        for w in range(self.world):
+            if crashes > 0 and not crashed[w]:
+                acts.append(
+                    ("crash_w%d" % w,
+                     (sets, objects0, manifest, qid, _set(crashed, w, 1),
+                      (crashes - 1, qchanges, torn)))
+                )
+        if qchanges > 0:
+            acts.append(
+                ("qchange_q%d" % (qid + 1),
+                 (sets, objects0, manifest, qid + 1, crashed,
+                  (crashes, qchanges - 1, torn)))
+            )
+
+        return acts
+
+
+def _set(t, i, v):
+    return t[:i] + (v,) + t[i + 1:]
+
+
+def make(broken: str = "") -> Model:
+    if broken == "commit_without_fence":
+        return DurableModel(commit_without_fence=True)
+    if broken == "delete_before_retire":
+        return DurableModel(delete_before_retire=True)
+    if broken == "use_torn_tail":
+        return DurableModel(use_torn_tail=True)
+    if broken:
+        raise ValueError("durable: unknown broken variant %r" % broken)
+    return DurableModel()
+
+
+BROKEN = ("commit_without_fence", "delete_before_retire", "use_torn_tail")

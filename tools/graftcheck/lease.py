@@ -1,0 +1,276 @@
+"""Model: lease membership + hierarchy digests (quorum.cc pure core).
+
+Protocol core being modeled (native/src/quorum.cc, exposed through the
+PR-7 pure entries ``lease_apply`` / ``depart_apply`` / ``quorum_step``):
+
+- Members renew leases against their *region* lighthouse (renewal sets
+  the heartbeat to now, keeps ``joined_ms``); an explicit depart removes
+  the member immediately and is forwarded to the root.
+- The region periodically emits a *digest* of its heartbeats upward.
+  Digests travel over the network: they can be delayed (delivered in any
+  order), duplicated, or dropped.  The root applies a digest entry only
+  through the freshness gate -- an entry whose reconstructed heartbeat
+  is older than what the root already knows is skipped (max-merge), so a
+  stale digest can never regress a member's lease.
+- ``quorum_step`` forms a quorum from the registered participants whose
+  leases are live at formation time, and bumps ``quorum_id`` only when
+  the membership actually changed.
+
+Fault actions: member crash (stops renewing -- lease runs out its TTL),
+explicit depart, digest duplication, digest drop.  Delay is implicit in
+the interleaving (a digest in flight can be delivered at any later
+point).
+
+Properties:
+
+- ``hb_monotonic``         -- the root's heartbeat view of a member
+  never moves backward (the digest freshness gate).
+- ``no_expired_in_quorum`` -- a formed quorum never contains a member
+  whose lease had already expired at formation time.
+- ``quorum_id_discipline`` -- quorum_id is monotone and bumps only when
+  the membership changed (no spurious reconfigure).
+
+Broken variants:
+
+- ``stale_digest`` removes the freshness gate: a delayed duplicate
+  digest overwrites a newer renewal and regresses the heartbeat.
+- ``no_prune`` skips the expiry filter at formation: a crashed member
+  whose TTL ran out is still placed in the formed quorum.
+"""
+
+from __future__ import annotations
+
+from .core import Model, bag_remove, tup_bag
+
+ALIVE, CRASHED, DEPARTED = 0, 1, 2
+NONE = -1
+
+
+class LeaseModel(Model):
+    name = "lease"
+    properties = (
+        "hb_monotonic",
+        "no_expired_in_quorum",
+        "quorum_id_discipline",
+    )
+
+    def __init__(
+        self,
+        world: int = 3,
+        horizon: int = 5,
+        ttl: int = 3,
+        min_replicas: int = 1,
+        dups: int = 1,
+        drops: int = 1,
+        crashes: int = 1,
+        departs: int = 1,
+        stale_digest: bool = False,
+        no_prune: bool = False,
+    ):
+        self.world = world
+        self.horizon = horizon
+        self.ttl = ttl
+        self.min_replicas = min_replicas
+        self.faults0 = (dups, drops, crashes, departs)
+        self.stale_digest = bool(stale_digest)
+        self.no_prune = bool(no_prune)
+        if stale_digest:
+            self.name = "lease_stale_digest"
+        elif no_prune:
+            self.name = "lease_no_prune"
+
+    def budget(self) -> dict:
+        return {"max_depth": 40, "max_states": 400_000}
+
+    # State:
+    #   now       : bounded clock
+    #   members   : tuple of ALIVE | CRASHED | DEPARTED
+    #   region_hb : per-member heartbeat at the region (-1 = none)
+    #   root_hb   : per-member heartbeat view at the root (-1 = none)
+    #   msgs      : multiset of ("digest", ((i, hb), ...)) | ("depart", i)
+    #   prev_q    : membership of the last formed quorum (tuple of ids)
+    #   qid       : quorum id
+    #   flags     : (hb_regressed, expired_in_quorum, spurious_reconfig)
+    #   faults    : (dups, drops, crashes, departs) remaining
+    def initial(self):
+        w = self.world
+        return (
+            0,
+            (ALIVE,) * w,
+            (0,) * w,  # everyone renewed at t=0 at the region
+            (0,) * w,  # and the root has seen it
+            (),
+            tuple(range(w)),
+            1,
+            (0, 0, 0),
+            self.faults0,
+        )
+
+    def check(self, state):
+        flags = state[7]
+        out = []
+        if flags[0]:
+            out.append("hb_monotonic")
+        if flags[1]:
+            out.append("no_expired_in_quorum")
+        if flags[2]:
+            out.append("quorum_id_discipline")
+        return out
+
+    def actions(self, state):
+        now, members, region_hb, root_hb, msgs, prev_q, qid, flags, faults = state
+        dups, drops, crashes, departs = faults
+        acts = []
+
+        if now < self.horizon:
+            acts.append(
+                (
+                    "tick",
+                    (now + 1, members, region_hb, root_hb, msgs, prev_q, qid,
+                     flags, faults),
+                )
+            )
+
+        for i, st in enumerate(members):
+            if st == ALIVE:
+                if region_hb[i] != now:
+                    nr = _set(region_hb, i, now)
+                    acts.append(
+                        (
+                            "renew%d" % i,
+                            (now, members, nr, root_hb, msgs, prev_q, qid,
+                             flags, faults),
+                        )
+                    )
+                if crashes > 0:
+                    nm = _set(members, i, CRASHED)
+                    acts.append(
+                        (
+                            "crash%d" % i,
+                            (now, nm, region_hb, root_hb, msgs, prev_q, qid,
+                             flags, (dups, drops, crashes - 1, departs)),
+                        )
+                    )
+                if departs > 0:
+                    nm = _set(members, i, DEPARTED)
+                    nr = _set(region_hb, i, NONE)
+                    nmsgs = tup_bag(msgs + (("depart", i),))
+                    acts.append(
+                        (
+                            "depart%d" % i,
+                            (now, nm, nr, root_hb, nmsgs, prev_q, qid, flags,
+                             (dups, drops, crashes, departs - 1)),
+                        )
+                    )
+
+        # Region emits a digest snapshot of its current heartbeats.
+        entries = tuple(
+            (i, hb) for i, hb in enumerate(region_hb) if hb != NONE
+        )
+        if entries:
+            dmsg = ("digest", entries)
+            if msgs.count(dmsg) < 2:  # bound in-flight identical digests
+                acts.append(
+                    (
+                        "emit_digest",
+                        (now, members, region_hb, root_hb,
+                         tup_bag(msgs + (dmsg,)), prev_q, qid, flags, faults),
+                    )
+                )
+
+        for m in sorted(set(msgs)):
+            rest = bag_remove(msgs, m)
+            if m[0] == "digest":
+                nhb = list(root_hb)
+                regressed = flags[0]
+                for i, hb in m[1]:
+                    if self.stale_digest:
+                        if hb < nhb[i] and nhb[i] != NONE:
+                            regressed = 1
+                        nhb[i] = hb
+                    else:
+                        if nhb[i] == NONE or hb > nhb[i]:
+                            nhb[i] = hb
+                nflags = (regressed, flags[1], flags[2])
+                acts.append(
+                    (
+                        "rx_digest_%s" % "_".join(
+                            "%d.%d" % e for e in m[1]
+                        ),
+                        (now, members, region_hb, tuple(nhb), rest, prev_q,
+                         qid, nflags, faults),
+                    )
+                )
+            else:  # depart
+                i = m[1]
+                nhb = _set(root_hb, i, NONE)
+                acts.append(
+                    (
+                        "rx_depart%d" % i,
+                        (now, members, region_hb, nhb, rest, prev_q, qid,
+                         flags, faults),
+                    )
+                )
+            if dups > 0:
+                acts.append(
+                    (
+                        "dup_%s" % _mkey(m),
+                        (now, members, region_hb, root_hb,
+                         tup_bag(msgs + (m,)), prev_q, qid, flags,
+                         (dups - 1, drops, crashes, departs)),
+                    )
+                )
+            if drops > 0:
+                acts.append(
+                    (
+                        "drop_%s" % _mkey(m),
+                        (now, members, region_hb, root_hb, rest, prev_q, qid,
+                         flags, (dups, drops - 1, crashes, departs)),
+                    )
+                )
+
+        # quorum_step: form from live-leased participants; bump quorum_id
+        # only on membership change.
+        live = tuple(
+            i for i, hb in enumerate(root_hb)
+            if hb != NONE and (self.no_prune or hb + self.ttl > now)
+        )
+        if len(live) >= self.min_replicas and live != prev_q:
+            expired = flags[1]
+            for i in live:
+                if root_hb[i] == NONE or root_hb[i] + self.ttl <= now:
+                    expired = 1
+            nqid = qid + 1  # membership changed => bump
+            nflags = (flags[0], expired, flags[2])
+            acts.append(
+                (
+                    "form_q%d" % nqid,
+                    (now, members, region_hb, root_hb, msgs, live, nqid,
+                     nflags, faults),
+                )
+            )
+
+        return acts
+
+
+def _set(t, i, v):
+    return t[:i] + (v,) + t[i + 1:]
+
+
+def _mkey(m):
+    if m[0] == "depart":
+        return "depart%d" % m[1]
+    return "digest_%s" % "_".join("%d.%d" % e for e in m[1])
+
+
+def make(broken: str = "") -> Model:
+    if broken == "stale_digest":
+        return LeaseModel(stale_digest=True)
+    if broken == "no_prune":
+        return LeaseModel(no_prune=True)
+    if broken:
+        raise ValueError("lease: unknown broken variant %r" % broken)
+    return LeaseModel()
+
+
+BROKEN = ("stale_digest", "no_prune")

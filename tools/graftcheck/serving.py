@@ -1,0 +1,201 @@
+"""Model: serving install ladder (nonce-pinned, CRC-guarded fetch).
+
+Protocol core being modeled (torchft_tpu/serving.py):
+
+- The publisher serves versioned weight payloads split into ranges.  A
+  republish (new version, or the same version after a publisher restart)
+  carries a *fresh nonce* and overwrites the served ranges one at a time
+  -- there is a torn window where some ranges hold new bytes and some
+  hold old.
+- A subscriber session reads the meta (version, nonce), then fetches
+  every range with the request pinned to that nonce.  The ladder of
+  gates: the server answers a stale-nonce request with a hard 400; every
+  range is CRC-checked on receipt; the final assembled payload's digest
+  must match the manifest before the new version is swapped in.  Any
+  gate failure aborts the session (a detection, never an install).
+
+Fault actions: republish mid-fetch, publisher restart (same version,
+fresh nonce), bit-flip of a served range.
+
+Properties:
+
+- ``no_torn_install``   -- an installed version is complete, all bytes
+  from exactly one (version, nonce) publication, uncorrupted.
+- ``version_monotonic`` -- the subscriber's installed version never
+  moves backward.
+
+Broken variant ``no_integrity`` turns off the ladder (no stale-nonce
+400, no range CRC, no final digest): a republish racing the fetch
+installs a torn mix of two publications, and a bit-flip installs
+corrupted bytes.
+"""
+
+from __future__ import annotations
+
+from .core import Model
+
+NRANGES = 2
+
+
+class ServingModel(Model):
+    name = "serving"
+    properties = ("no_torn_install", "version_monotonic")
+
+    def __init__(
+        self,
+        max_versions: int = 3,
+        republishes: int = 2,
+        restarts: int = 1,
+        flips: int = 1,
+        no_integrity: bool = False,
+    ):
+        self.max_versions = max_versions
+        self.faults0 = (republishes, restarts, flips)
+        self.no_integrity = bool(no_integrity)
+        if no_integrity:
+            self.name = "serving_no_integrity"
+
+    def budget(self) -> dict:
+        return {"max_depth": 64, "max_states": 400_000}
+
+    # State:
+    #   pub     : (version, nonce, written_mask) -- the publication being
+    #             written; meta flips to it once the mask is full
+    #   meta    : (version, nonce) the subscriber would read
+    #   store   : per-range (version, nonce, corrupt) of the served bytes
+    #   sub     : (installed_version, session); session is () or
+    #             (version, nonce, fetched) with per-range fetched tags
+    #             ((version, nonce, corrupt) | None)
+    #   flags   : (torn_install, version_regressed)
+    #   faults  : (republishes, restarts, flips) remaining
+    def initial(self):
+        pub = (1, 1, (1 << NRANGES) - 1)
+        store = tuple((1, 1, 0) for _ in range(NRANGES))
+        return (pub, (1, 1), store, (0, ()), (0, 0), self.faults0)
+
+    def check(self, state):
+        flags = state[4]
+        out = []
+        if flags[0]:
+            out.append("no_torn_install")
+        if flags[1]:
+            out.append("version_monotonic")
+        return out
+
+    def actions(self, state):
+        pub, meta, store, sub, flags, faults = state
+        republishes, restarts, flips = faults
+        pv, pn, mask = pub
+        installed, session = sub
+        acts = []
+        full = (1 << NRANGES) - 1
+
+        # Publisher writes the pending ranges of the current publication.
+        if mask != full:
+            for r in range(NRANGES):
+                if not (mask & (1 << r)):
+                    nstore = _set(store, r, (pv, pn, 0))
+                    nmask = mask | (1 << r)
+                    npub = (pv, pn, nmask)
+                    nmeta = (pv, pn) if nmask == full else meta
+                    acts.append(
+                        ("pub_range%d_v%d_n%d" % (r, pv, pn),
+                         (npub, nmeta, nstore, sub, flags, faults))
+                    )
+        else:
+            if republishes > 0 and pv < self.max_versions:
+                # New version, fresh nonce; ranges rewritten one by one.
+                acts.append(
+                    ("republish_v%d_n%d" % (pv + 1, pn + 1),
+                     ((pv + 1, pn + 1, 0), meta, store, sub, flags,
+                      (republishes - 1, restarts, flips)))
+                )
+            if restarts > 0:
+                # Publisher restart: same version republished under a
+                # fresh nonce (the torn-republish guard's reason to exist).
+                acts.append(
+                    ("restart_v%d_n%d" % (pv, pn + 1),
+                     ((pv, pn + 1, 0), meta, store, sub, flags,
+                      (republishes, restarts - 1, flips)))
+                )
+
+        # Bit-flip of a served range.
+        if flips > 0:
+            for r in range(NRANGES):
+                rv, rn, _c = store[r]
+                acts.append(
+                    ("flip_range%d" % r,
+                     (pub, meta, _set(store, r, (rv, rn, 1)), sub, flags,
+                      (republishes, restarts, flips - 1)))
+                )
+
+        # Subscriber: open a session against the current meta.
+        if not session:
+            mv, mn = meta
+            if mv >= installed:
+                acts.append(
+                    ("sub_meta_v%d_n%d" % (mv, mn),
+                     (pub, meta, store,
+                      (installed, (mv, mn, (None,) * NRANGES)),
+                      flags, faults))
+                )
+        else:
+            sv, sn, fetched = session
+            for r in range(NRANGES):
+                if fetched[r] is not None:
+                    continue
+                if pn != sn and not self.no_integrity:
+                    # Server-side stale-nonce 400: the session dies.
+                    acts.append(
+                        ("fetch%d_nonce400" % r,
+                         (pub, meta, store, (installed, ()), flags, faults))
+                    )
+                    continue
+                tag = store[r]
+                if tag[2] and not self.no_integrity:
+                    # Per-range CRC detection: the session dies.
+                    acts.append(
+                        ("fetch%d_crc" % r,
+                         (pub, meta, store, (installed, ()), flags, faults))
+                    )
+                    continue
+                nf = _set(fetched, r, tag)
+                acts.append(
+                    ("fetch%d_v%d_n%d%s" % (r, tag[0], tag[1],
+                                            "_bad" if tag[2] else ""),
+                     (pub, meta, store, (installed, (sv, sn, nf)), flags,
+                      faults))
+                )
+            if all(f is not None for f in fetched):
+                ok = all(f == (sv, sn, 0) for f in fetched)
+                if ok or self.no_integrity:
+                    torn = flags[0] or (0 if ok else 1)
+                    regress = flags[1] or (1 if sv < installed else 0)
+                    acts.append(
+                        ("install_v%d_n%d" % (sv, sn),
+                         (pub, meta, store, (sv, ()), (torn, regress),
+                          faults))
+                    )
+                else:
+                    # Final digest-vs-manifest gate: detection, no swap.
+                    acts.append(
+                        ("install_digest_abort",
+                         (pub, meta, store, (installed, ()), flags, faults))
+                    )
+
+        return acts
+
+
+def _set(t, i, v):
+    return t[:i] + (v,) + t[i + 1:]
+
+
+def make(broken: str = "") -> Model:
+    if broken == "no_integrity":
+        return ServingModel(no_integrity=True)
+    if broken:
+        raise ValueError("serving: unknown broken variant %r" % broken)
+    return ServingModel()
+
+
+BROKEN = ("no_integrity",)

@@ -1,0 +1,303 @@
+"""Model: per-step AND-vote commit transaction (Manager.should_commit).
+
+Protocol core being modeled (torchft_tpu/manager.py):
+
+- Every member of the current quorum finishes its step work and votes
+  ``local_ok`` (False iff an error latched during the step) to a central
+  collector (lighthouse client ``should_commit``), tagged with its
+  ``(step, quorum_id)``.  The vote value for a given (member, step,
+  quorum_id) is immutable: RPC retries resend the same value, and an
+  error that strikes after the vote was computed latches for the *next*
+  step, not this one.
+- The collector AND-reduces votes *for the matching (step, quorum_id)
+  round only* and, once every quorum member has voted, answers every
+  collected vote with a single commit/abort decision.  A collector
+  timeout answers the collected votes with an abort.
+- A member applies a decision only if it matches its own
+  ``(step, quorum_id)``; commit advances the step, abort retries the
+  vote.  A latched member does not retry -- its only path forward is the
+  reconfigure.
+- A reconfigure bumps ``quorum_id``, heals latched members from the most
+  advanced survivor, and strands in-flight messages of the old epoch
+  behind the (step, quorum_id) guards.
+
+Fault actions: error latch mid-step (before the vote is computed),
+member crash, message drop, message duplication.  All bounded by a
+per-fault budget so the sweep terminates.
+
+Properties:
+
+- ``epoch_purity``  -- among *live* members, every committed step
+  commits under exactly one quorum_id (no mixed-quorum commit; a member
+  that commits and then crashes is excluded -- survivors legitimately
+  redo its step under the reformed quorum, and the dead member can only
+  come back through a heal that overwrites its state).
+- ``silent_commit`` -- the collector never emits (and no member ever
+  applies) a commit for a round in which a live quorum member's vote
+  for that step was No.
+
+Broken variant ``stale_votes`` removes the collector's (step, quorum_id)
+round guard: a duplicated Yes vote from an earlier step can then fill a
+later round's tally over a latched member's No vote and commit the step
+-- the model finds the interleaving and prints its replay line.
+"""
+
+from __future__ import annotations
+
+from .core import Model, bag_remove, tup_bag, tup_set
+
+WORK, VOTED = 0, 1
+NO_CAST = -1
+
+
+class StepTxnModel(Model):
+    name = "step_txn"
+    properties = ("epoch_purity", "silent_commit")
+
+    def __init__(
+        self,
+        world: int = 2,
+        max_step: int = 2,
+        latches: int = 1,
+        crashes: int = 1,
+        drops: int = 1,
+        dups: int = 1,
+        stale_votes: bool = False,
+    ):
+        self.world = world
+        self.max_step = max_step
+        self.faults0 = (latches, crashes, drops, dups)
+        # Broken variant: collector ignores the (step, qid) round guard.
+        self.stale_votes = bool(stale_votes)
+        if stale_votes:
+            self.name = "step_txn_stale_votes"
+
+    def budget(self) -> dict:
+        return {"max_depth": 48, "max_states": 600_000}
+
+    # State:
+    #   members : tuple[(alive, step, qid, latched, phase, cast)]
+    #             cast = the ok this member voted for its current step
+    #             (NO_CAST until the first vote; immutable until the
+    #             step commits or the quorum reforms)
+    #   qmembers: tuple of member ids in the current quorum
+    #   qid     : current quorum id
+    #   msgs    : multiset of ("vote", i, step, qid, ok)
+    #                       | ("decide", i, step, qid, commit)
+    #   tally   : None | (step, qid, mask, all_ok)
+    #   commits : set of (step, qid, member) applied in the fleet
+    #   silent  : 1 if a commit was emitted/applied over a latched No
+    #   faults  : (latches, crashes, drops, dups) remaining
+    def initial(self):
+        members = tuple(
+            (1, 0, 1, 0, WORK, NO_CAST) for _ in range(self.world)
+        )
+        qmembers = tuple(range(self.world))
+        return (members, qmembers, 1, (), None, (), 0, self.faults0)
+
+    def check(self, state):
+        members, qmembers, qid, msgs, tally, commits, silent, faults = state
+        out = []
+        steps = {}
+        for s, q, i in commits:
+            if not members[i][0]:
+                continue  # dead committer: survivors may redo its step
+            if steps.setdefault(s, q) != q:
+                out.append("epoch_purity")
+                break
+        if silent:
+            out.append("silent_commit")
+        return out
+
+    def actions(self, state):
+        members, qmembers, qid, msgs, tally, commits, silent, faults = state
+        latches, crashes, drops, dups = faults
+        acts = []
+
+        for i, (alive, step, mqid, latched, phase, cast) in enumerate(members):
+            if not alive or step >= self.max_step:
+                continue
+            if phase == WORK and not (latched and cast != NO_CAST):
+                # Finish the step's work and cast the vote.  The value is
+                # computed once per (step, qid); retries resend it.
+                ok = cast if cast != NO_CAST else (0 if latched else 1)
+                vote = ("vote", i, step, mqid, ok)
+                nm = _set(members, i, (alive, step, mqid, latched, VOTED, ok))
+                acts.append(
+                    (
+                        "work%d" % i,
+                        (nm, qmembers, qid, tup_bag(msgs + (vote,)), tally,
+                         commits, silent, faults),
+                    )
+                )
+            if phase == WORK and latches > 0 and not latched and cast == NO_CAST:
+                # An error latches mid-step (report_error, never raises),
+                # before the vote value is computed.
+                nm = _set(members, i, (alive, step, mqid, 1, phase, cast))
+                acts.append(
+                    (
+                        "latch%d" % i,
+                        (nm, qmembers, qid, msgs, tally, commits, silent,
+                         (latches - 1, crashes, drops, dups)),
+                    )
+                )
+            if phase == VOTED:
+                # Member-side deadline: give up waiting, re-send the vote.
+                nm = _set(members, i, (alive, step, mqid, latched, WORK, cast))
+                acts.append(
+                    (
+                        "mtimeout%d" % i,
+                        (nm, qmembers, qid, msgs, tally, commits, silent,
+                         faults),
+                    )
+                )
+            if crashes > 0:
+                nm = _set(members, i, (0, step, mqid, latched, phase, cast))
+                acts.append(
+                    (
+                        "crash%d" % i,
+                        (nm, qmembers, qid, msgs, tally, commits, silent,
+                         (latches, crashes - 1, drops, dups)),
+                    )
+                )
+
+        for m in sorted(set(msgs)):
+            rest = bag_remove(msgs, m)
+            if m[0] == "vote":
+                _, i, vstep, vqid, ok = m
+                nt, out_msgs, emitted_silent = self._collect(
+                    members, qmembers, tally, i, vstep, vqid, ok
+                )
+                acts.append(
+                    (
+                        "rx_vote%d_s%d_q%d" % (i, vstep, vqid),
+                        (members, qmembers, qid, tup_bag(rest + out_msgs), nt,
+                         commits, silent or emitted_silent, faults),
+                    )
+                )
+            else:  # decide
+                _, i, dstep, dqid, commit = m
+                alive, step, mqid, latched, phase, cast = members[i]
+                nm, ncommits, nsilent = members, commits, silent
+                if alive and phase == VOTED and step == dstep and mqid == dqid:
+                    if commit:
+                        nm = _set(
+                            members, i,
+                            (alive, step + 1, mqid, latched, WORK, NO_CAST),
+                        )
+                        ncommits = tup_set(commits + ((dstep, dqid, i),))
+                        if latched:
+                            nsilent = 1
+                    else:
+                        nm = _set(
+                            members, i,
+                            (alive, step, mqid, latched, WORK, cast),
+                        )
+                acts.append(
+                    (
+                        "rx_decide%d_s%d_q%d_c%d" % (i, dstep, dqid, commit),
+                        (nm, qmembers, qid, rest, tally, ncommits, nsilent,
+                         faults),
+                    )
+                )
+            if drops > 0:
+                acts.append(
+                    (
+                        "drop_%s" % _mkey(m),
+                        (members, qmembers, qid, rest, tally, commits, silent,
+                         (latches, crashes, drops - 1, dups)),
+                    )
+                )
+            if dups > 0:
+                acts.append(
+                    (
+                        "dup_%s" % _mkey(m),
+                        (members, qmembers, qid, tup_bag(msgs + (m,)), tally,
+                         commits, silent,
+                         (latches, crashes, drops, dups - 1)),
+                    )
+                )
+
+        # Collector deadline: answer the collected votes with an abort.
+        if tally is not None:
+            ts, tq, mask, _ok = tally
+            aborts = tuple(
+                ("decide", j, ts, tq, 0) for j in qmembers if mask & (1 << j)
+            )
+            acts.append(
+                (
+                    "timeout_s%d_q%d" % (ts, tq),
+                    (members, qmembers, qid, tup_bag(msgs + aborts), None,
+                     commits, silent, faults),
+                )
+            )
+
+        # Reconfigure: quorum reforms around the live members, healing
+        # latched members from the most advanced survivor.
+        need_reform = any(
+            not members[i][0] or members[i][3] for i in qmembers
+        )
+        alive_ids = tuple(i for i in range(self.world) if members[i][0])
+        if need_reform and alive_ids:
+            donor_step = max(members[i][1] for i in alive_ids)
+            nq = qid + 1
+            nm = tuple(
+                (a, donor_step if a else st, nq if a else mq, 0 if a else la,
+                 WORK if a else ph, NO_CAST if a else ca)
+                for (a, st, mq, la, ph, ca) in members
+            )
+            acts.append(
+                (
+                    "reform_q%d" % nq,
+                    (nm, alive_ids, nq, msgs, None, commits, silent, faults),
+                )
+            )
+
+        return acts
+
+    def _collect(self, members, qmembers, tally, i, vstep, vqid, ok):
+        """Collector AND-reduce; returns (tally', out_msgs, emitted_silent)."""
+        if tally is None:
+            tally = (vstep, vqid, 0, 1)
+        ts, tq, mask, all_ok = tally
+        if (vstep, vqid) != (ts, tq) and not self.stale_votes:
+            # Stale round: answer it with an abort so the sender retries.
+            return tally, (("decide", i, vstep, vqid, 0),), 0
+        bit = 1 << i
+        if not (mask & bit):
+            mask |= bit
+            all_ok &= ok
+        full = 0
+        for j in qmembers:
+            full |= 1 << j
+        if mask & full == full:
+            decides = tuple(("decide", j, ts, tq, all_ok) for j in qmembers)
+            # The property: a commit emitted while a live quorum member's
+            # vote for this step was No is a silent commit.
+            emitted_silent = 0
+            if all_ok:
+                for j in qmembers:
+                    alive, step, mqid, latched, phase, cast = members[j]
+                    if alive and latched and step == ts and mqid == tq:
+                        emitted_silent = 1
+            return None, decides, emitted_silent
+        return (ts, tq, mask, all_ok), (), 0
+
+
+def _set(members, i, v):
+    return members[:i] + (v,) + members[i + 1:]
+
+
+def _mkey(m):
+    return "%s%d_s%d_q%d_%d" % (m[0][0], m[1], m[2], m[3], m[4])
+
+
+def make(broken: str = "") -> Model:
+    if broken == "stale_votes":
+        return StepTxnModel(stale_votes=True)
+    if broken:
+        raise ValueError("step_txn: unknown broken variant %r" % broken)
+    return StepTxnModel()
+
+
+BROKEN = ("stale_votes",)

@@ -1,0 +1,257 @@
+"""Model: WAL-fenced root promises + epoch-fenced standby takeover.
+
+Protocol core being modeled (native/src/wal.h, native/src/lighthouse.cc):
+
+- Every root promise (a quorum formation with a new quorum_id) is
+  appended to the CRC-framed write-ahead log *before* it is published to
+  the fleet.  A torn append (crash/ENOSPC mid-record) makes the log dead
+  (``WalTornError``): the root freezes and issues no further promises.
+- On restart the log is replayed; a torn tail record is dropped, and the
+  replay watermark (highest logged quorum_id) seeds the next promise, so
+  a quorum_id is never re-issued.  The restarting root probes its peers
+  first (``probe_peers_fence``): a higher epoch anywhere means it was
+  deposed while down, and it freezes instead of resuming.
+- A warm standby takes over by claiming ``epoch = max(seen) + 1`` --
+  logged before any promise is published under it -- and adopts the
+  fleet-reported quorum_id watermark.  A deposed primary that un-stalls
+  must run the same probe fence before resuming.
+
+Fault actions: torn append, primary crash/restart, primary stall (GC
+pause / partition) and un-stall, standby takeover.
+
+Properties:
+
+- ``promise_durable``  -- a published promise is always recoverable:
+  it is in some root's replayable log, or its publisher is still up.
+- ``qid_monotone``     -- the sequence of published promises is strictly
+  monotone in quorum_id (a re-issued quorum_id means two different
+  quorums share an id -- split brain at the data plane).
+- ``single_publisher`` -- the epoch sequence of published promises never
+  moves backward (an old-epoch root publishing after a takeover is a
+  second concurrent root -- split brain at the control plane).
+
+Broken variants:
+
+- ``publish_before_log`` publishes the promise before appending it: a
+  torn append + crash then loses a published promise, and the restarted
+  root re-issues its quorum_id.
+- ``no_fence_probe`` lets a stalled-then-deposed primary resume without
+  probing peers: two roots publish concurrently.
+"""
+
+from __future__ import annotations
+
+from .core import Model
+
+# Root runtime states.
+DOWN, RUNNING, STALLED, FROZEN = 0, 1, 2, 3
+
+
+class WalModel(Model):
+    name = "wal"
+    properties = ("promise_durable", "qid_monotone", "single_publisher")
+
+    def __init__(
+        self,
+        max_promises: int = 4,
+        torn: int = 1,
+        crashes: int = 2,
+        stalls: int = 1,
+        publish_before_log: bool = False,
+        no_fence_probe: bool = False,
+    ):
+        self.max_promises = max_promises
+        self.faults0 = (torn, crashes, stalls)
+        self.publish_before_log = bool(publish_before_log)
+        self.no_fence_probe = bool(no_fence_probe)
+        if publish_before_log:
+            self.name = "wal_publish_before_log"
+        elif no_fence_probe:
+            self.name = "wal_no_fence_probe"
+
+    def budget(self) -> dict:
+        return {"max_depth": 48, "max_states": 400_000}
+
+    # State:
+    #   roots    : tuple of (status, epoch, known_qid) for 2 roots;
+    #              known_qid is the root's quorum_id watermark (from its
+    #              log replay or the fleet report at takeover)
+    #   logs     : tuple of per-root logs; each log is a tuple of
+    #              ("epoch", e) | ("promise", qid, e) records; a torn
+    #              tail is encoded as ("torn",)
+    #   published: tuple of (qid, epoch) in publication order
+    #   faults   : (torn, crashes, stalls) remaining
+    def initial(self):
+        roots = ((RUNNING, 1, 0), (DOWN, 0, 0))
+        logs = ((("epoch", 1),), ())
+        return (roots, logs, (), self.faults0)
+
+    def check(self, state):
+        roots, logs, published, faults = state
+        out = []
+        qids = [q for q, _e in published]
+        if any(b <= a for a, b in zip(qids, qids[1:])):
+            out.append("qid_monotone")
+        for q, e in published:
+            durable = False
+            alive_holder = False
+            for rid, (status, epoch, _kq) in enumerate(roots):
+                if ("promise", q, e) in _replay(logs[rid]):
+                    durable = True
+                if status in (RUNNING, STALLED) and epoch == e:
+                    alive_holder = True
+            if not durable and not alive_holder:
+                out.append("promise_durable")
+                break
+        epochs = [e for _q, e in published]
+        if any(b < a for a, b in zip(epochs, epochs[1:])):
+            out.append("single_publisher")
+        return out
+
+    def actions(self, state):
+        roots, logs, published, faults = state
+        torn, crashes, stalls = faults
+        acts = []
+
+        for rid, (status, epoch, known_qid) in enumerate(roots):
+            log = logs[rid]
+            dead_log = log and log[-1] == ("torn",)
+            if status == RUNNING and not dead_log \
+                    and len(published) < self.max_promises:
+                qid = known_qid + 1
+                rec = ("promise", qid, epoch)
+                nroot = (status, epoch, qid)
+                if self.publish_before_log:
+                    acts.append(
+                        ("promise%d_q%d" % (rid, qid),
+                         (_set(roots, rid, nroot), _set(logs, rid, log + (rec,)),
+                          published + ((qid, epoch),), faults))
+                    )
+                    if torn > 0:
+                        # Published first; the append tore and the root
+                        # crashed: the promise exists nowhere durable.
+                        acts.append(
+                            ("promise%d_q%d_torn" % (rid, qid),
+                             (_set(roots, rid, (DOWN, epoch, qid)),
+                              _set(logs, rid, log + (("torn",),)),
+                              published + ((qid, epoch),),
+                              (torn - 1, crashes, stalls)))
+                        )
+                else:
+                    # The WAL fence: append durably, then publish.
+                    acts.append(
+                        ("promise%d_q%d" % (rid, qid),
+                         (_set(roots, rid, nroot), _set(logs, rid, log + (rec,)),
+                          published + ((qid, epoch),), faults))
+                    )
+                    if torn > 0:
+                        # Append tore before publication: nothing was
+                        # published; WalTornError freezes the root.
+                        acts.append(
+                            ("promise%d_q%d_torn" % (rid, qid),
+                             (_set(roots, rid, (FROZEN, epoch, known_qid)),
+                              _set(logs, rid, log + (("torn",),)),
+                              published, (torn - 1, crashes, stalls)))
+                        )
+            if status in (RUNNING, STALLED, FROZEN) and crashes > 0:
+                acts.append(
+                    ("crash%d" % rid,
+                     (_set(roots, rid, (DOWN, epoch, known_qid)), logs,
+                      published, (torn, crashes - 1, stalls)))
+                )
+            if status == RUNNING and stalls > 0:
+                acts.append(
+                    ("stall%d" % rid,
+                     (_set(roots, rid, (STALLED, epoch, known_qid)), logs,
+                      published, (torn, crashes, stalls - 1)))
+                )
+            if status == STALLED:
+                deposed = self._deposed(roots, published, rid, epoch)
+                if deposed and not self.no_fence_probe:
+                    acts.append(
+                        ("unstall%d_fenced" % rid,
+                         (_set(roots, rid, (FROZEN, epoch, known_qid)), logs,
+                          published, faults))
+                    )
+                else:
+                    acts.append(
+                        ("unstall%d" % rid,
+                         (_set(roots, rid, (RUNNING, epoch, known_qid)), logs,
+                          published, faults))
+                    )
+            if status == DOWN and log:
+                # Restart: replay (drop torn tail), probe peers, resume at
+                # the logged watermark -- or freeze if deposed while down.
+                replayed = _replay(log)
+                repoch = max(
+                    [r[1] for r in replayed if r[0] == "epoch"]
+                    + [r[2] for r in replayed if r[0] == "promise"] + [1]
+                )
+                # The probe also re-learns the fleet's quorum_id watermark
+                # (managers re-register carrying their previous quorum).
+                wm = max(
+                    [r[1] for r in replayed if r[0] == "promise"]
+                    + [q for q, _e in published] + [0]
+                )
+                deposed = self._deposed(roots, published, rid, repoch)
+                nstatus = FROZEN if deposed else RUNNING
+                acts.append(
+                    ("restart%d" % rid,
+                     (_set(roots, rid, (nstatus, repoch, wm)),
+                      _set(logs, rid, tuple(replayed)), published, faults))
+                )
+
+        # Standby takeover once no root is RUNNING: claim
+        # epoch = max(seen)+1 (logged first), adopt the fleet-reported
+        # quorum_id watermark.
+        if not any(r[0] == RUNNING for r in roots):
+            for rid, (status, epoch, known_qid) in enumerate(roots):
+                if status != DOWN:
+                    continue
+                seen = max(
+                    [r[1] for r in roots] + [e for _q, e in published] + [1]
+                )
+                nepoch = seen + 1
+                wm = max([q for q, _e in published] + [0])
+                replayed = _replay(logs[rid])
+                acts.append(
+                    ("takeover%d_e%d" % (rid, nepoch),
+                     (_set(roots, rid, (RUNNING, nepoch, wm)),
+                      _set(logs, rid, tuple(replayed) + (("epoch", nepoch),)),
+                      published, faults))
+                )
+
+        return acts
+
+    def _deposed(self, roots, published, rid, epoch):
+        peer_epochs = [
+            r[1] for orid, r in enumerate(roots) if orid != rid
+        ] + [e for _q, e in published]
+        return any(pe > epoch for pe in peer_epochs)
+
+
+def _replay(log):
+    """Replay a log, dropping the torn tail record."""
+    out = []
+    for rec in log:
+        if rec[0] == "torn":
+            break
+        out.append(rec)
+    return tuple(out)
+
+
+def _set(t, i, v):
+    return t[:i] + (v,) + t[i + 1:]
+
+
+def make(broken: str = "") -> Model:
+    if broken == "publish_before_log":
+        return WalModel(publish_before_log=True)
+    if broken == "no_fence_probe":
+        return WalModel(no_fence_probe=True)
+    if broken:
+        raise ValueError("wal: unknown broken variant %r" % broken)
+    return WalModel()
+
+
+BROKEN = ("publish_before_log", "no_fence_probe")

@@ -21,6 +21,10 @@ general-purpose linter can see:
 - ``fault_guard``: every native chaos injection point reaches
   ``tft_fault_maybe`` through the ``TFT_FAULT_CHECK`` macro, preserving
   the disarmed single-relaxed-load fast path.
+- ``proto_sync``: two-way field-name/field-number diff between
+  ``native/torchft.proto`` and the handwritten
+  ``native/src/pb_fallback/torchft.pb.h`` wire fallback (plus an
+  internal AppendTo-vs-Field round-trip check).
 
 Run via ``python scripts/graftlint.py`` (CI gates on it); extend by adding
 a module under ``tools/graftlint/`` and registering it in ``RULES``.
@@ -59,6 +63,7 @@ def _load_rules() -> Dict[str, Callable[[Path], List[Violation]]]:
         env_docs,
         fault_guard,
         latch_discipline,
+        proto_sync,
         sleep_deadline,
     )
 
@@ -69,6 +74,7 @@ def _load_rules() -> Dict[str, Callable[[Path], List[Violation]]]:
         "sleep_deadline": sleep_deadline.check,
         "cache_mutation": cache_mutation.check,
         "fault_guard": fault_guard.check,
+        "proto_sync": proto_sync.check,
     }
 
 

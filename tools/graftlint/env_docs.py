@@ -27,13 +27,39 @@ SCAN_DIRS = (Path("torchft_tpu"), Path("native/src"), Path("scripts"))
 
 # Read forms only (setting an env var for a child process is the caller's
 # business): os.environ.get("X"), os.getenv("X"), os.environ["X"] in
-# Python; getenv("X") / std::getenv("X") in C++.
+# Python; getenv("X") / std::getenv("X") in C++. Two indirect Python
+# forms also count as reads — the typed helpers durable.py/serving.py
+# grew (``_env_int("TORCHFT_X", d)``) and the ``_ENV_FOO = "TORCHFT_X"``
+# module constants profiling.py routes its reads through; both are how
+# a knob escapes a literal-only scan.
 _PY_READ = re.compile(
     r"(?:os\.getenv\(|os\.environ\.get\(|os\.environ\[)\s*"
     r"[\"'](TORCHFT_[A-Z0-9_]+)[\"']",
     re.S,
 )
+_PY_HELPER_READ = re.compile(
+    r"\b_env_[a-z_]+\(\s*[\"'](TORCHFT_[A-Z0-9_]+)[\"']"
+)
+_PY_CONST_DEF = re.compile(
+    r"^(_ENV_[A-Z0-9_]+)\s*=\s*[\"'](TORCHFT_[A-Z0-9_]+)[\"']", re.M
+)
 _CC_READ = re.compile(r"getenv\(\s*\"(TORCHFT_[A-Z0-9_]+)\"")
+
+
+def _py_const_reads(text: str):
+    """(knob, match_start) for each env read routed through an ``_ENV_*``
+    module constant. Only constants actually passed to a read form count
+    (the definition alone is not a read)."""
+    consts = dict(_PY_CONST_DEF.findall(text))
+    out = []
+    for name, knob in consts.items():
+        for m in re.finditer(
+            r"(?:os\.getenv\(|os\.environ\.get\(|os\.environ\[)\s*"
+            + re.escape(name) + r"\b",
+            text,
+        ):
+            out.append((knob, m.start()))
+    return out
 
 
 def collect_reads(root: Path, dirs: Sequence[Path]) -> Dict[str, List[str]]:
@@ -51,10 +77,19 @@ def collect_reads(root: Path, dirs: Sequence[Path]) -> Dict[str, List[str]]:
             else:
                 continue
             text = path.read_text()
-            for m in pattern.finditer(text):
-                line = text[: m.start()].count("\n") + 1
-                rel = str(path.relative_to(root))
-                reads.setdefault(m.group(1), []).append(f"{rel}:{line}")
+            rel = str(path.relative_to(root))
+            hits = [
+                (m.group(1), m.start()) for m in pattern.finditer(text)
+            ]
+            if pattern is _PY_READ:
+                hits += [
+                    (m.group(1), m.start())
+                    for m in _PY_HELPER_READ.finditer(text)
+                ]
+                hits += _py_const_reads(text)
+            for knob, start in sorted(hits, key=lambda h: h[1]):
+                line = text[:start].count("\n") + 1
+                reads.setdefault(knob, []).append(f"{rel}:{line}")
     return reads
 
 

@@ -1,18 +1,33 @@
-"""Every native fault-injection point sits behind the disarmed fast path.
+"""The chaos plane's injection-seam contract, checked end to end.
 
-The chaos plane's hot-path contract is that a DISARMED injection point
-costs exactly one relaxed atomic load and a branch — which holds only
-when every call site reaches ``tft_fault_maybe`` through the
-``TFT_FAULT_CHECK`` macro (native/src/fault.h), never directly. A raw
-call would pay the decision mutex + hash on every frame of every ring op
-in production. The rule greps ``native/src`` for ``tft_fault_maybe``
-outside the fault engine's own files (fault.h declares it and defines
-the macro; fault.cc defines it) and flags any line that is not the macro
-definition itself.
+Four sub-checks:
+
+- **Guarded call sites**: a DISARMED injection point costs exactly one
+  relaxed atomic load and a branch — which holds only when every call
+  site reaches ``tft_fault_maybe`` through the ``TFT_FAULT_CHECK`` macro
+  (native/src/fault.h), never directly. A raw call would pay the
+  decision mutex + hash on every frame of every ring op in production.
+  Any literal ``tft_fault_maybe`` outside the engine's own files flags.
+- **Seam-enum sync**: every seam in ``chaos.py``'s ``NATIVE_SEAMS``
+  must have its ``kSeam<CamelCase>`` enumerator in ``fault.h``'s Seam
+  enum (a plan arming an unknown seam is silently ignored by the native
+  engine), and every enumerator must map back to a seam ``chaos.py``
+  knows (native or reserved Python-side) — orphan enumerators are dead
+  wiring the next seam author copies.
+- **Armed-seam reachability**: every native seam's enumerator must
+  appear at a call site outside the engine files — a seam with no
+  ``TFT_FAULT_CHECK`` reaching it arms rules that can never fire, and
+  every chaos sweep over it silently tests nothing (how the serving/
+  durable seams of PRs 17-18 would rot).
+- **Kind totality**: ``SEAMS`` and the ``SEAM_KINDS`` vocabulary must
+  cover each other exactly (the random plan generator draws kinds per
+  seam; a missing entry is a KeyError at fuzz time, an orphan entry is
+  a vocabulary nothing can draw).
 """
 
 from __future__ import annotations
 
+import ast
 import re
 from pathlib import Path
 from typing import List, Optional, Sequence
@@ -24,43 +39,174 @@ RULE = "fault_guard"
 SCAN_DIR = Path("native/src")
 # The engine's own files: declaration, definition, and the macro.
 ENGINE_FILES = ("fault.h", "fault.cc")
+CHAOS_PY = Path("torchft_tpu/chaos.py")
+FAULT_H = Path("native/src/fault.h")
 
 _CALL = re.compile(r"\btft_fault_maybe\b")
+_ENUMERATOR = re.compile(r"\bkSeam([A-Z]\w*)\s*=")
+
+
+def _camel(seam: str) -> str:
+    return "".join(p.capitalize() for p in seam.split("_"))
+
+
+def _snake(camel: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", camel).lower()
+
+
+def _chaos_registry(text: str):
+    """(NATIVE_SEAMS, PYTHON_SEAMS, SEAM_KINDS keys) literals from
+    chaos.py, any of them None when not statically readable."""
+    native = python = kinds = None
+    for node in ast.parse(text).body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            targets = [node.target.id]
+            value = node.value
+        for name in targets:
+            try:
+                lit = ast.literal_eval(value) if value is not None else None
+            except ValueError:
+                continue
+            if name == "NATIVE_SEAMS":
+                native = tuple(lit)
+            elif name == "PYTHON_SEAMS":
+                python = tuple(lit)
+            elif name == "SEAM_KINDS":
+                kinds = dict(lit)
+    return native, python, kinds
 
 
 def check(
     root: Path, scan_dir: Optional[Path] = None,
     engine_files: Optional[Sequence[str]] = None,
+    chaos_path: Optional[Path] = None,
+    fault_h_path: Optional[Path] = None,
 ) -> List[Violation]:
     base = root / (scan_dir or SCAN_DIR)
     engine = tuple(engine_files or ENGINE_FILES)
+    chaos_path = chaos_path or root / CHAOS_PY
+    fault_h_path = fault_h_path or root / FAULT_H
     out: List[Violation] = []
-    if not base.exists():
+    scanned_text: List[str] = []
+    if base.exists():
+        for path in sorted(base.rglob("*")):
+            if path.suffix not in (".cc", ".h"):
+                continue
+            if path.name in engine:
+                continue
+            text = path.read_text()
+            scanned_text.append(text)
+            for m in _CALL.finditer(text):
+                line_no = text[: m.start()].count("\n") + 1
+                line = text.splitlines()[line_no - 1]
+                # TFT_FAULT_CHECK expands to the guarded call; a call
+                # site USING the macro never spells tft_fault_maybe
+                # itself, so any literal appearance outside the engine
+                # is a violation (comments included — a commented recipe
+                # showing the raw call is how the next raw call gets
+                # written).
+                out.append(
+                    Violation(
+                        RULE,
+                        relpath(root, path),
+                        line_no,
+                        "raw tft_fault_maybe call outside the "
+                        "TFT_FAULT_CHECK guard (disarmed fast-path "
+                        f"contract): {line.strip()[:80]!r} — route the "
+                        "injection point through TFT_FAULT_CHECK "
+                        "(native/src/fault.h)",
+                    )
+                )
+
+    if not (chaos_path.exists() and fault_h_path.exists()):
         return out
-    for path in sorted(base.rglob("*")):
-        if path.suffix not in (".cc", ".h"):
-            continue
-        if path.name in engine:
-            continue
-        text = path.read_text()
-        for m in _CALL.finditer(text):
-            line_no = text[: m.start()].count("\n") + 1
-            line = text.splitlines()[line_no - 1]
-            # TFT_FAULT_CHECK expands to the guarded call; a call site
-            # USING the macro never spells tft_fault_maybe itself, so
-            # any literal appearance outside the engine is a violation
-            # (comments included — a commented recipe showing the raw
-            # call is how the next raw call gets written).
+    chaos_rel = relpath(root, chaos_path)
+    fault_rel = relpath(root, fault_h_path)
+    native, python, kinds = _chaos_registry(chaos_path.read_text())
+    if native is None or python is None or kinds is None:
+        out.append(
+            Violation(
+                RULE,
+                chaos_rel,
+                1,
+                "NATIVE_SEAMS / PYTHON_SEAMS / SEAM_KINDS are not "
+                "statically readable literals",
+            )
+        )
+        return out
+
+    fault_text = fault_h_path.read_text()
+    enumerators = {}
+    for m in _ENUMERATOR.finditer(fault_text):
+        enumerators[m.group(1)] = fault_text[: m.start()].count("\n") + 1
+
+    for seam in native:
+        cam = _camel(seam)
+        if cam not in enumerators:
             out.append(
                 Violation(
                     RULE,
-                    relpath(root, path),
-                    line_no,
-                    "raw tft_fault_maybe call outside the "
-                    "TFT_FAULT_CHECK guard (disarmed fast-path "
-                    f"contract): {line.strip()[:80]!r} — route the "
-                    "injection point through TFT_FAULT_CHECK "
-                    "(native/src/fault.h)",
+                    fault_rel,
+                    1,
+                    f"native seam {seam!r} (chaos.py NATIVE_SEAMS) has "
+                    f"no kSeam{cam} enumerator in the fault engine: "
+                    "plans arming it are silently ignored",
+                )
+            )
+        elif not any(
+            f"fault::kSeam{cam}" in t for t in scanned_text
+        ):
+            out.append(
+                Violation(
+                    RULE,
+                    fault_rel,
+                    enumerators[cam],
+                    f"native seam {seam!r} has no TFT_FAULT_CHECK call "
+                    f"site reaching fault::kSeam{cam}: armed rules can "
+                    "never fire, chaos sweeps over it test nothing",
+                )
+            )
+    all_seams = set(native) | set(python)
+    for cam, line in enumerators.items():
+        if _snake(cam) not in all_seams:
+            out.append(
+                Violation(
+                    RULE,
+                    fault_rel,
+                    line,
+                    f"kSeam{cam} maps to no seam in chaos.py "
+                    "(NATIVE_SEAMS + PYTHON_SEAMS): orphan enumerator",
+                )
+            )
+    for seam in all_seams:
+        if seam not in kinds or not kinds[seam]:
+            out.append(
+                Violation(
+                    RULE,
+                    chaos_rel,
+                    1,
+                    f"seam {seam!r} has no SEAM_KINDS vocabulary: the "
+                    "random plan generator KeyErrors drawing for it",
+                )
+            )
+    for seam in kinds:
+        if seam not in all_seams:
+            out.append(
+                Violation(
+                    RULE,
+                    chaos_rel,
+                    1,
+                    f"SEAM_KINDS entry {seam!r} is not a registered "
+                    "seam: nothing can draw it",
                 )
             )
     return out

@@ -6,10 +6,14 @@ documented default, and ``should_commit`` discards the step. The rule
 checks the two halves statically:
 
 - every ``Manager`` method that touches a managed collective op
-  (``self._collectives.allreduce`` etc.) must route through
+  (``self._collectives.allreduce`` etc. — the isolated data plane
+  ``self._iso_collectives`` included) must route through
   ``_managed_dispatch`` and may only ``raise ValueError`` (the eager
   static-usage errors the docstrings carve out) — no bare ``raise``, no
-  other exception types on the managed path;
+  other exception types on the managed path. Raises inside nested
+  functions are exempt: the dispatch closure executes under
+  ``_managed_dispatch``'s try, so raising there IS latching (the
+  ``iso_allreduce`` unusable-plane RuntimeError rides this);
 - ``_managed_dispatch`` itself must keep the latch: a ``try`` whose
   handler calls ``self.report_error`` and contains no ``raise``.
 """
@@ -31,10 +35,16 @@ MANAGER_PY = Path("torchft_tpu/manager.py")
 MANAGED_OPS = {
     "allreduce",
     "plan_allreduce",
+    "allreduce_hier",
     "reduce_scatter",
     "allgather_into",
     "allgather",
+    "plan_reduce_scatter",
+    "plan_allgather_into",
 }
+# Both data planes carry the discipline: the primary backend and the
+# disposable-child isolated one.
+RECEIVERS = ("_collectives", "_iso_collectives")
 DISPATCH = "_managed_dispatch"
 LATCH = "report_error"
 
@@ -45,12 +55,23 @@ def _touches_managed_op(fn: ast.FunctionDef) -> bool:
             isinstance(node, ast.Attribute)
             and node.attr in MANAGED_OPS
             and isinstance(node.value, ast.Attribute)
-            and node.value.attr == "_collectives"
+            and node.value.attr in RECEIVERS
             and isinstance(node.value.value, ast.Name)
             and node.value.value.id == "self"
         ):
             return True
     return False
+
+
+def _walk_outside_closures(node: ast.AST):
+    """ast.walk that does not descend into nested function bodies — code
+    there runs under the dispatch latch, not on the caller's path."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _walk_outside_closures(child)
 
 
 def _calls_self_method(fn: ast.FunctionDef, method: str) -> bool:
@@ -153,7 +174,7 @@ def check(root: Path, manager_path: Optional[Path] = None) -> List[Violation]:
                     "default + latch -> vote-discard)",
                 )
             )
-        for node in ast.walk(fn):
+        for node in _walk_outside_closures(fn):
             if isinstance(node, ast.Raise):
                 if node.exc is None:
                     out.append(

@@ -1,0 +1,265 @@
+"""Wire-contract drift check: torchft.proto <-> pb_fallback header.
+
+When the real protobuf toolchain is absent the native layer serializes
+with the handwritten ``native/src/pb_fallback/torchft.pb.h``.  Nothing
+compiles the two against each other: a field renamed or renumbered in
+``native/torchft.proto`` (the contract the Rust/protoc side speaks)
+silently desynchronizes the fallback wire format — messages parse, the
+drifted field just reads as its default.  This rule parses both and
+diffs them two ways:
+
+- every ``message`` in the proto must have a matching ``class`` in the
+  header, and vice versa;
+- within a message, every proto field name must be serialized by the
+  header's ``AppendTo`` (members follow the ``<field_name>_``
+  convention) and every member the header serializes must exist in the
+  proto — with the *same field number* on both sides;
+- internally, every field number ``AppendTo`` writes must have a
+  ``case N:`` handler in ``Field`` (a write-only field round-trips to
+  its default through the fallback parser).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from . import Violation, relpath
+
+RULE = "proto_sync"
+
+PROTO = Path("native/torchft.proto")
+HEADER = Path("native/src/pb_fallback/torchft.pb.h")
+
+
+class Field(NamedTuple):
+    number: int
+    line: int
+
+
+_MSG_RE = re.compile(r"^message\s+(\w+)\s*\{", re.M)
+_CLASS_RE = re.compile(r"^class\s+(\w+)\s*\{", re.M)
+# "repeated int64 step = 4;" — two identifier tokens before '=' keeps
+# enum values ("UNKNOWN = 0;") and reserved/option lines from matching.
+_PROTO_FIELD_RE = re.compile(
+    r"^\s*(?:repeated\s+|optional\s+)?[A-Za-z_][\w.]*\s+([A-Za-z_]\w*)"
+    r"\s*=\s*(\d+)\s*;",
+    re.M,
+)
+_PUT_RE = re.compile(
+    r"tft_pb::put_(?!tag\b|varint\b)\w+\(\s*out\s*,\s*(\d+)\s*,\s*(.*)"
+)
+# Raw-encoded fields write put_tag(out, N, wire) then put_varint(out, m_).
+_PUT_TAG_RE = re.compile(r"tft_pb::put_tag\(\s*out\s*,\s*(\d+)\s*,")
+_PUT_VARINT_RE = re.compile(r"tft_pb::put_varint\(\s*out\s*,\s*(.*)")
+_FOR_RE = re.compile(r"for\s*\(.*?:\s*(\w+)_\s*\)")
+_MEMBER_RE = re.compile(r"([A-Za-z]\w*)_(?![\w])")
+# Single-field messages use "if (f == 1 && ...)" instead of a switch.
+_CASE_RE = re.compile(r"case\s+(\d+)\s*:|\bf\s*==\s*(\d+)")
+
+
+def _block(text: str, open_brace: int) -> str:
+    """Text of a brace-balanced block starting at ``open_brace``
+    (inclusive of the braces)."""
+    depth = 0
+    for i in range(open_brace, len(text)):
+        c = text[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return text[open_brace : i + 1]
+    return text[open_brace:]
+
+
+def _strip_nested(body: str) -> str:
+    """Blanks nested enum blocks (their values would otherwise shadow
+    field lines) while preserving line offsets."""
+    out = body
+    for m in re.finditer(r"\benum\s+\w+\s*\{", out):
+        nested = _block(out, m.end() - 1)
+        blank = "".join(c if c == "\n" else " " for c in nested)
+        out = out[: m.end() - 1] + blank + out[m.end() - 1 + len(nested) :]
+    return out
+
+
+def parse_proto(text: str) -> Dict[str, Dict[str, Field]]:
+    """{message: {field_name: Field}} of every top-level message."""
+    out: Dict[str, Dict[str, Field]] = {}
+    for m in _MSG_RE.finditer(text):
+        body = _strip_nested(_block(text, m.end() - 1))
+        base_line = text[: m.start()].count("\n") + 1
+        fields: Dict[str, Field] = {}
+        for fm in _PROTO_FIELD_RE.finditer(body):
+            line = base_line + body[: fm.start()].count("\n")
+            fields[fm.group(1)] = Field(int(fm.group(2)), line)
+        out[m.group(1)] = fields
+    return out
+
+
+class HeaderMsg(NamedTuple):
+    fields: Dict[str, Field]  # member name (sans trailing _) -> Field
+    cases: frozenset  # field numbers Field() can parse
+    line: int
+
+
+def _method_body(cls_body: str, signature: str) -> Tuple[str, int]:
+    """(body text, offset) of a method inside a class body, or ("", 0)."""
+    m = re.search(signature, cls_body)
+    if not m:
+        return "", 0
+    return _block(cls_body, cls_body.index("{", m.start())), m.start()
+
+
+def parse_header(
+    text: str, rel: str
+) -> Tuple[Dict[str, HeaderMsg], List[Violation]]:
+    out: Dict[str, HeaderMsg] = {}
+    problems: List[Violation] = []
+    for m in _CLASS_RE.finditer(text):
+        cls_body = _block(text, m.end() - 1)
+        base_line = text[: m.start()].count("\n") + 1
+        append, aoff = _method_body(cls_body, r"void\s+AppendTo\s*\(")
+        fields: Dict[str, Field] = {}
+        loop_member: Optional[str] = None
+        pending_tag: Optional[Field] = None
+        pos = 0
+        for raw in append.splitlines(keepends=True):
+            fm = _FOR_RE.search(raw)
+            if fm:
+                loop_member = fm.group(1)
+            tm = _PUT_TAG_RE.search(raw)
+            if tm:
+                pending_tag = Field(
+                    int(tm.group(1)),
+                    base_line
+                    + cls_body[:aoff].count("\n")
+                    + append[:pos].count("\n"),
+                )
+            vm = _PUT_VARINT_RE.search(raw)
+            if vm and pending_tag is not None:
+                members = _MEMBER_RE.findall(vm.group(1))
+                if members:
+                    fields[members[-1]] = pending_tag
+                pending_tag = None
+            pm = _PUT_RE.search(raw)
+            if pm:
+                line = (
+                    base_line
+                    + cls_body[:aoff].count("\n")
+                    + append[:pos].count("\n")
+                )
+                members = _MEMBER_RE.findall(pm.group(2))
+                name = members[-1] if members else loop_member
+                if name is None:
+                    problems.append(
+                        Violation(
+                            RULE,
+                            rel,
+                            line,
+                            "%s.AppendTo writes field %s from an "
+                            "unrecognized member expression"
+                            % (m.group(1), pm.group(1)),
+                        )
+                    )
+                else:
+                    fields[name] = Field(int(pm.group(1)), line)
+                if not fm:
+                    loop_member = None
+            pos += len(raw)
+        parse, _ = _method_body(cls_body, r"bool\s+Field\s*\(")
+        cases = frozenset(int(a or b) for a, b in _CASE_RE.findall(parse))
+        out[m.group(1)] = HeaderMsg(fields, cases, base_line)
+    return out, problems
+
+
+def check(
+    root: Path,
+    proto_path: Optional[Path] = None,
+    header_path: Optional[Path] = None,
+) -> List[Violation]:
+    proto_path = proto_path or root / PROTO
+    header_path = header_path or root / HEADER
+    proto_rel = relpath(root, proto_path)
+    header_rel = relpath(root, header_path)
+
+    messages = parse_proto(proto_path.read_text())
+    classes, out = parse_header(header_path.read_text(), header_rel)
+
+    if not messages:
+        out.append(Violation(RULE, proto_rel, 1, "no messages parsed"))
+    if not classes:
+        out.append(Violation(RULE, header_rel, 1, "no classes parsed"))
+
+    for name, fields in messages.items():
+        cls = classes.get(name)
+        if cls is None:
+            out.append(
+                Violation(
+                    RULE,
+                    header_rel,
+                    1,
+                    "message %s has no class in the pb_fallback header"
+                    % name,
+                )
+            )
+            continue
+        for fname, f in fields.items():
+            h = cls.fields.get(fname)
+            if h is None:
+                out.append(
+                    Violation(
+                        RULE,
+                        proto_rel,
+                        f.line,
+                        "%s.%s (field %d) is not serialized by the "
+                        "pb_fallback header" % (name, fname, f.number),
+                    )
+                )
+            elif h.number != f.number:
+                out.append(
+                    Violation(
+                        RULE,
+                        header_rel,
+                        h.line,
+                        "%s.%s is field %d in the header but %d in the "
+                        "proto" % (name, fname, h.number, f.number),
+                    )
+                )
+        for fname, h in cls.fields.items():
+            if fname not in fields:
+                out.append(
+                    Violation(
+                        RULE,
+                        header_rel,
+                        h.line,
+                        "%s.%s (field %d) serialized by the header but "
+                        "absent from the proto" % (name, fname, h.number),
+                    )
+                )
+        for fname, h in cls.fields.items():
+            if h.number not in cls.cases:
+                out.append(
+                    Violation(
+                        RULE,
+                        header_rel,
+                        h.line,
+                        "%s.AppendTo writes field %d (%s) but Field() has "
+                        "no case for it: the fallback parser drops it"
+                        % (name, h.number, fname),
+                    )
+                )
+
+    for name, cls in classes.items():
+        if name not in messages:
+            out.append(
+                Violation(
+                    RULE,
+                    header_rel,
+                    cls.line,
+                    "class %s has no message in the proto" % name,
+                )
+            )
+    return out

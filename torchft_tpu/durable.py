@@ -395,6 +395,51 @@ def _env_float(name: str, default: float) -> float:
     return float(raw) if raw else default
 
 
+def inconsistent_marker(
+    markers: Dict[int, Dict[str, Any]],
+    *,
+    step: int,
+    quorum_id: int,
+    world: int,
+    total: int,
+    wire: str,
+) -> Optional[Tuple[int, Optional[Dict[str, Any]]]]:
+    """The commit fence's consistency predicate, extracted pure (PR-7
+    pattern): all W shard markers must be present and agree with the
+    snapshot's identity before a commit record may be appended.  Returns
+    the first offending ``(rank, marker_or_None)`` or ``None`` when the
+    set is commit-eligible.  graftcheck's ``durable`` model verifies the
+    fence; the conformance suite pins this exact predicate to it."""
+    for r in range(world):
+        m = markers.get(r)
+        if m is None:
+            return (r, None)
+        ok = (
+            m.get("step") == step
+            and m.get("quorum_id") == quorum_id
+            and m.get("world") == world
+            and m.get("total") == total
+            and m.get("wire") == wire
+            and m.get("rank") == r
+        )
+        if not ok:
+            return (r, m)
+    return None
+
+
+def live_commits(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Committed, non-retired manifest records in commit order — the
+    restorable candidates.  Pure: shared by the committer's retention
+    pass and the no-donor restore so both see the same live set, and by
+    the graftcheck conformance suite."""
+    retired = {r["dir"] for r in records if r.get("t") == "retire"}
+    return [
+        r
+        for r in records
+        if r.get("t") == "commit" and r["dir"] not in retired
+    ]
+
+
 class DurableCheckpointer:
     """Asynchronous sharded durable checkpoints of (user state, manager
     state, loader position) behind a WAL-fenced manifest.
@@ -785,22 +830,21 @@ class DurableCheckpointer:
                 )
                 return False
             time.sleep(0.02)
-        for r, m in sorted(markers.items()):
-            ok = (
-                m.get("step") == snap.step
-                and m.get("quorum_id") == snap.quorum_id
-                and m.get("world") == snap.world
-                and m.get("total") == snap.staging.total
-                and m.get("wire") == (self._wire or "none")
-                and m.get("rank") == r
+        bad = inconsistent_marker(
+            markers,
+            step=snap.step,
+            quorum_id=snap.quorum_id,
+            world=snap.world,
+            total=snap.staging.total,
+            wire=self._wire or "none",
+        )
+        if bad is not None:
+            logger.warning(
+                "durable snapshot %s abandoned: shard %d marker "
+                "inconsistent (%s)", d, bad[0], bad[1],
             )
-            if not ok:
-                logger.warning(
-                    "durable snapshot %s abandoned: shard %d marker "
-                    "inconsistent (%s)", d, r, m,
-                )
-                snap.stats["aborted"] = True
-                return False
+            snap.stats["aborted"] = True
+            return False
         if snap.abort.is_set():
             snap.stats["aborted"] = True
             return False
@@ -835,11 +879,7 @@ class DurableCheckpointer:
         objects disappear) and compact the log when it accumulates."""
         records, _ = self._manifest.replay()
         retired = {r["dir"] for r in records if r.get("t") == "retire"}
-        commits = [
-            r
-            for r in records
-            if r.get("t") == "commit" and r["dir"] not in retired
-        ]
+        commits = live_commits(records)
         for rec in commits[: -self._keep] if len(commits) > self._keep else []:
             self._manifest.append({"t": "retire", "dir": rec["dir"]})
             retired.add(rec["dir"])
@@ -874,12 +914,7 @@ class DurableCheckpointer:
         snapshot can never win."""
         t_replay = time.perf_counter()
         records, dropped = self._manifest.replay()
-        retired = {r["dir"] for r in records if r.get("t") == "retire"}
-        commits = [
-            r
-            for r in records
-            if r.get("t") == "commit" and r["dir"] not in retired
-        ]
+        commits = live_commits(records)
         replay_s = time.perf_counter() - t_replay
         for rec in reversed(commits):
             try:

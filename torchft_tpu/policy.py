@@ -73,6 +73,31 @@ SENTINEL_COST_S = 1e9
 _WIRE_FACTOR = {None: 1.0, "bf16": 0.5, "q8": 0.25, "int8": 0.25}
 
 
+def choose_target(costs: List[float], current: int, hysteresis: float) -> int:
+    """Deterministic choice from cohort-identical costs: the argmin, but a
+    challenger must beat the incumbent by the hysteresis margin — ties and
+    near-ties stand still. A sentineled incumbent always loses (it cannot
+    be run), unless everything is sentineled, in which case standing still
+    is all that's left.
+
+    Pure (PR-7 extraction pattern): every member feeds identical gathered
+    costs through this and must reach the identical index — the property
+    graftcheck's ``decision`` model exhaustively verifies, and the
+    conformance suite pins this exact function to that model.
+    """
+    best = int(np.argmin(costs))
+    if costs[best] >= SENTINEL_COST_S:
+        # Everything is sentineled (a cohort-wide misconfiguration):
+        # standing still is all that's left.
+        return current
+    cur = costs[current]
+    if cur >= SENTINEL_COST_S:
+        return best
+    if costs[best] < cur * (1.0 - hysteresis):
+        return best
+    return current
+
+
 @dataclass(frozen=True)
 class StrategySpec:
     """One candidate point in the strategy × wire × sync-interval space.
@@ -743,22 +768,7 @@ class PolicyEngine:
         return costs
 
     def _choose(self, costs: List[float]) -> int:
-        """Deterministic choice from cohort-identical costs: the argmin,
-        but a challenger must beat the incumbent by the hysteresis margin
-        — ties and near-ties stand still. A sentineled incumbent always
-        loses (it cannot be run), unless everything is sentineled, in
-        which case standing still is all that's left."""
-        best = int(np.argmin(costs))
-        if costs[best] >= SENTINEL_COST_S:
-            # Everything is sentineled (a cohort-wide misconfiguration):
-            # standing still is all that's left.
-            return self._current
-        cur = costs[self._current]
-        if cur >= SENTINEL_COST_S:
-            return best
-        if costs[best] < cur * (1.0 - self._knobs.hysteresis):
-            return best
-        return self._current
+        return choose_target(costs, self._current, self._knobs.hysteresis)
 
     # -- the decision transaction --
 
